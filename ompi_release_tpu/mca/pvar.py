@@ -1,0 +1,125 @@
+"""Performance variables (pvars): runtime counters exposed for tools.
+
+Analogue of ``opal/mca/base/mca_base_pvar.c`` + the MPI_T performance
+variable interface (``ompi/mpi/tool/``): components register named
+counters/timers/levels; tools (``tpu_info``, tracing layer) read and reset
+them without recompiling anything.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class PvarClass(enum.Enum):
+    COUNTER = "counter"        # monotonically increasing
+    LEVEL = "level"            # current utilization level
+    HIGHWATERMARK = "highwatermark"
+    TIMER = "timer"            # accumulated seconds
+    STATE = "state"            # discrete state value
+
+
+class Pvar:
+    def __init__(self, name: str, pclass: PvarClass, help: str = "",
+                 getter: Optional[Callable[[], Any]] = None) -> None:
+        self.name = name
+        self.pclass = pclass
+        self.help = help
+        self._value: float = 0
+        self._getter = getter
+        self._lock = threading.Lock()
+
+    def add(self, delta: float = 1) -> None:
+        with self._lock:
+            self._value += delta
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            if self.pclass is PvarClass.HIGHWATERMARK:
+                self._value = max(self._value, value)
+            else:
+                self._value = value
+
+    def read(self) -> Any:
+        if self._getter is not None:
+            return self._getter()
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+    class _TimerCtx:
+        def __init__(self, pvar: "Pvar") -> None:
+            self._pvar = pvar
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._pvar.add(time.perf_counter() - self._t0)
+            return False
+
+    def timing(self) -> "_TimerCtx":
+        assert self.pclass is PvarClass.TIMER
+        return Pvar._TimerCtx(self)
+
+
+class PvarRegistry:
+    def __init__(self) -> None:
+        self._pvars: Dict[str, Pvar] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, pclass: PvarClass = PvarClass.COUNTER,
+                 help: str = "", getter: Optional[Callable[[], Any]] = None) -> Pvar:
+        with self._lock:
+            if name in self._pvars:
+                return self._pvars[name]
+            pv = Pvar(name, pclass, help, getter)
+            self._pvars[name] = pv
+            return pv
+
+    def lookup(self, name: str) -> Optional[Pvar]:
+        with self._lock:
+            return self._pvars.get(name)
+
+    def read_all(self) -> Dict[str, Any]:
+        with self._lock:
+            return {n: p.read() for n, p in sorted(self._pvars.items())}
+
+    def describe_all(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {"name": p.name, "class": p.pclass.value, "help": p.help,
+                 "value": p.read()}
+                for p in sorted(self._pvars.values(), key=lambda p: p.name)
+            ]
+
+    def reset_all(self) -> None:
+        with self._lock:
+            for p in self._pvars.values():
+                p.reset()
+
+    def _reset_for_tests(self) -> None:
+        with self._lock:
+            self._pvars.clear()
+
+
+PVARS = PvarRegistry()
+
+
+def counter(name: str, help: str = "") -> Pvar:
+    return PVARS.register(name, PvarClass.COUNTER, help)
+
+
+def timer(name: str, help: str = "") -> Pvar:
+    return PVARS.register(name, PvarClass.TIMER, help)
+
+
+def highwatermark(name: str, help: str = "") -> Pvar:
+    return PVARS.register(name, PvarClass.HIGHWATERMARK, help)

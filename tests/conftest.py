@@ -1,0 +1,35 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirror of the reference's clusterless test strategy (SURVEY §4): the
+``ras/simulator`` analogue is N fake XLA host devices, so every
+collective/algorithm runs multi-"device" in CI without a TPU. Must set
+env before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fresh_mca(monkeypatch):
+    """Isolated MCA var/pvar state for config-system tests."""
+    from ompi_release_tpu.mca.var import VarRegistry
+    from ompi_release_tpu.mca.pvar import PvarRegistry
+    from ompi_release_tpu.mca import var as var_mod, pvar as pvar_mod
+
+    fresh_vars = VarRegistry()
+    fresh_pvars = PvarRegistry()
+    monkeypatch.setattr(var_mod, "VARS", fresh_vars)
+    monkeypatch.setattr(pvar_mod, "PVARS", fresh_pvars)
+    yield fresh_vars
