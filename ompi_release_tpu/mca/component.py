@@ -95,7 +95,9 @@ class Framework:
         self.description = description
         self._components: Dict[str, Component] = {}
         self._opened = False
-        self._log = output.stream(f"mca.{name}")
+        # stream name == framework name so the registered
+        # ``<name>_verbose`` variable is the one the stream reads
+        self._log = output.stream(name)
         mca_var.register(
             name, "str", "",
             f"Comma list of {name} components to include "
@@ -134,10 +136,13 @@ class Framework:
         comp.state = ComponentState.OPENED if ok else ComponentState.CLOSED
 
     def open(self) -> None:
+        # open ALL registered components, not just the currently-filtered
+        # set: the selection variable may change later (scope ALL), and a
+        # then-included component must already be usable
         if self._opened:
             return
         self._opened = True
-        for comp in self._filtered():
+        for comp in self._components.values():
             self._open_one(comp)
 
     def close(self) -> None:

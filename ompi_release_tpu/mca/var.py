@@ -199,8 +199,10 @@ class VarRegistry:
                 choices=tuple(choices) if choices else None,
                 synonyms=tuple(synonyms),
             )
-            self._vars[name] = var
+            # resolve before publishing: an invalid env/file value must not
+            # leave a half-initialized var in the registry
             self._resolve(var)
+            self._vars[name] = var
             return var
 
     # -- value resolution (precedence) ------------------------------------
@@ -248,13 +250,15 @@ class VarRegistry:
     def set_value(self, name: str, value: Any) -> None:
         """Programmatic/CLI override (highest precedence)."""
         with self._lock:
-            self._overrides[name] = value
             var = self._vars.get(name)
+            if var is not None and var.scope in (
+                VarScope.CONSTANT, VarScope.READONLY
+            ):
+                raise PermissionError(
+                    f"variable {name!r} has scope {var.scope.name}"
+                )
+            self._overrides[name] = value
             if var is not None:
-                if var.scope in (VarScope.CONSTANT, VarScope.READONLY):
-                    raise PermissionError(
-                        f"variable {name!r} has scope {var.scope.name}"
-                    )
                 self._resolve(var)
 
     def unset(self, name: str) -> None:
@@ -267,26 +271,38 @@ class VarRegistry:
     # -- param files / CLI -------------------------------------------------
     def load_param_file(self, path: str) -> int:
         """Load ``key = value`` lines; later files win over earlier ones."""
-        count = 0
+        parsed: Dict[str, str] = {}
         with open(path, "r", encoding="utf-8") as fh:
             for line in fh:
                 line = line.split("#", 1)[0].strip()
-                if not line:
-                    continue
-                if "=" not in line:
+                if not line or "=" not in line:
                     continue
                 key, _, val = line.partition("=")
-                self._file_values[key.strip()] = val.strip()
-                count += 1
+                parsed[key.strip()] = val.strip()
         with self._lock:
+            self._file_values.update(parsed)
             self._files_loaded.append(path)
             self._resolve_all()
-        return count
+        return len(parsed)
 
     def apply_cli(self, pairs: Iterable[tuple]) -> None:
-        """Apply ``--mca key value`` pairs from a command line."""
+        """Apply ``--mca key value`` pairs from a command line.
+
+        READONLY/CONSTANT variables are skipped with a warning instead
+        of raising — a bad CLI flag must not abort the whole launch.
+        """
+        from ..utils import output
+
         with self._lock:
             for key, val in pairs:
+                var = self._vars.get(key)
+                if var is not None and var.scope in (
+                    VarScope.CONSTANT, VarScope.READONLY
+                ):
+                    output.stream("mca.var").warn(
+                        f"ignoring --mca {key}: scope {var.scope.name}"
+                    )
+                    continue
                 self._overrides[key] = val
             self._resolve_all()
 

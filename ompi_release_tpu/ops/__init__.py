@@ -1,0 +1,12 @@
+"""Reduction ops (MPI_Op analogue) + op framework for overrides."""
+
+from .op import (
+    BAND, BOR, BXOR, LAND, LOR, LXOR, MAX, MAXLOC, MIN, MINLOC, NO_OP,
+    OP_FRAMEWORK, PREDEFINED_OPS, PROD, REPLACE, SUM, Op, user_op,
+)
+
+__all__ = [
+    "Op", "user_op", "PREDEFINED_OPS", "OP_FRAMEWORK",
+    "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR", "BAND", "BOR",
+    "BXOR", "MAXLOC", "MINLOC", "REPLACE", "NO_OP",
+]

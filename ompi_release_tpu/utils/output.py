@@ -41,16 +41,17 @@ class Stream:
 
     @property
     def verbosity(self) -> int:
-        # late import: mca.var imports output for nothing, but keep safe
+        # late import to avoid an import cycle with mca.var
         from ..mca import var as mca_var
 
         v = mca_var.get(self._var_name)
         if v is None:
-            env = os.environ.get(
-                "OMPITPU_MCA_" + self._var_name
-            )
-            return int(env) if env else 0
-        return int(v)
+            v = os.environ.get(mca_var.ENV_PREFIX + self._var_name)
+        try:
+            return int(v) if v is not None else 0
+        except (TypeError, ValueError):
+            # logging must never crash the caller on a garbage env value
+            return 0
 
     def _emit(self, prefix: str, msg: str) -> None:
         pid = os.getpid()

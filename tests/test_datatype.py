@@ -1,0 +1,140 @@
+"""Datatype + convertor tests — analogue of test/datatype/ddt_pack.c etc."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ompi_release_tpu import datatype as dt
+from ompi_release_tpu.datatype import Convertor
+
+
+def _buf(n, dtype=np.float32):
+    return jnp.arange(n, dtype=dtype)
+
+
+def test_predefined_sizes():
+    assert dt.FLOAT.size_bytes == 4
+    assert dt.INT64.size_bytes == 8
+    assert dt.BFLOAT16.size_bytes == 2
+    assert dt.FLOAT.is_contiguous
+
+
+def test_contiguous():
+    t = dt.create_contiguous(5, dt.FLOAT)
+    assert t.count == 5 and t.is_contiguous
+    c = Convertor(t, count=2)
+    buf = _buf(10)
+    packed = c.pack(buf)
+    np.testing.assert_array_equal(np.asarray(packed), np.arange(10, dtype=np.float32))
+
+
+def test_vector_pack_unpack():
+    # 3 blocks of 2 elements, stride 4: offsets 0,1,4,5,8,9
+    t = dt.create_vector(3, 2, 4, dt.FLOAT)
+    assert list(t.offsets()) == [0, 1, 4, 5, 8, 9]
+    buf = _buf(12)
+    c = Convertor(t)
+    packed = c.pack(buf)
+    np.testing.assert_array_equal(
+        np.asarray(packed), [0, 1, 4, 5, 8, 9]
+    )
+    # unpack into zeros: scattered back to the same offsets
+    out = c.unpack(packed * 10, jnp.zeros(12, jnp.float32))
+    expect = np.zeros(12, np.float32)
+    expect[[0, 1, 4, 5, 8, 9]] = [0, 10, 40, 50, 80, 90]
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_vector_multi_item_extent():
+    t = dt.create_vector(2, 1, 3, dt.FLOAT)  # offsets 0,3 ; extent 4
+    assert t.get_extent() == 4
+    c = Convertor(t, count=2)  # items at 0 and 4: offsets 0,3,4,7
+    assert list(c.dtype.offsets(2)) == [0, 3, 4, 7]
+
+
+def test_resized_extent():
+    t = dt.create_vector(2, 1, 3, dt.FLOAT).resized(8)
+    assert t.get_extent() == 8
+    assert list(t.offsets(2)) == [0, 3, 8, 11]
+
+
+def test_hindexed():
+    t = dt.create_hindexed([2, 3], [1, 6], dt.FLOAT)
+    assert list(t.offsets()) == [1, 2, 6, 7, 8]
+    buf = _buf(10)
+    packed = Convertor(t).pack(buf)
+    np.testing.assert_array_equal(np.asarray(packed), [1, 2, 6, 7, 8])
+
+
+def test_indexed_block():
+    t = dt.create_indexed_block(2, [0, 4], dt.FLOAT)
+    assert list(t.offsets()) == [0, 1, 4, 5]
+
+
+def test_struct_homogeneous():
+    t = dt.create_struct([1, 2], [0, 3], [dt.FLOAT, dt.FLOAT])
+    assert list(t.offsets()) == [0, 3, 4]
+
+
+def test_struct_heterogeneous_rejected():
+    with pytest.raises(ValueError):
+        dt.create_struct([1, 1], [0, 1], [dt.FLOAT, dt.INT32])
+
+
+def test_subarray():
+    # 4x4 array, take 2x2 block at (1,1): rows 1-2, cols 1-2
+    t = dt.create_subarray([4, 4], [2, 2], [1, 1], dt.FLOAT)
+    assert list(t.offsets()) == [5, 6, 9, 10]
+    buf = _buf(16)
+    packed = Convertor(t).pack(buf)
+    np.testing.assert_array_equal(np.asarray(packed), [5, 6, 9, 10])
+
+
+def test_partial_pack_roundtrip():
+    """Segmented pack/unpack — the pipelined-protocol path."""
+    t = dt.create_vector(4, 2, 3, dt.FLOAT)  # 8 elements packed
+    buf = _buf(12)
+    c = Convertor(t)
+    segs = []
+    pos = 0
+    while pos < c.packed_elements:
+        seg, pos = c.pack_partial(buf, pos, 3)
+        segs.append(np.asarray(seg))
+    whole = np.concatenate(segs)
+    np.testing.assert_array_equal(whole, np.asarray(c.pack(buf)))
+    # unpack the segments into a fresh buffer
+    out = jnp.zeros(12, jnp.float32)
+    pos = 0
+    for seg in segs:
+        out, pos = c.unpack_partial(jnp.asarray(seg), out, pos)
+    np.testing.assert_array_equal(
+        np.asarray(c.pack(out)), whole
+    )
+
+
+def test_to_self_roundtrip():
+    """Self-send loopback of a complex datatype (test/datatype/to_self.c)."""
+    t = dt.create_struct([2, 1], [0, 5], [dt.FLOAT, dt.FLOAT])
+    send = _buf(8)
+    c = Convertor(t)
+    recv = c.unpack(c.pack(send), jnp.zeros(8, jnp.float32))
+    for off in t.offsets():
+        assert recv[int(off)] == send[int(off)]
+
+
+def test_checksum_detects_corruption():
+    payload = _buf(64)
+    c1 = Convertor.checksum(payload)
+    corrupted = payload.at[13].set(999.0)
+    c2 = Convertor.checksum(corrupted)
+    assert int(c1) != int(c2)
+    # position-dependence: swapping two elements changes the sum
+    swapped = payload.at[0].set(payload[1]).at[1].set(payload[0])
+    assert int(Convertor.checksum(swapped)) != int(c1)
+
+
+def test_from_jax_dtype():
+    assert dt.from_jax_dtype(jnp.float32) is dt.FLOAT
+    assert dt.from_jax_dtype(jnp.bfloat16) is dt.BFLOAT16
+    assert dt.from_jax_dtype(np.int32) is dt.INT32

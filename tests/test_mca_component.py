@@ -84,3 +84,29 @@ def test_broken_component_skipped(fresh_mca):
     fw.register(CompBroken())
     fw.register(CompA())
     assert fw.select().NAME == "alpha"
+
+
+def test_selection_var_change_after_open(fresh_mca):
+    """Changing the include list after open must still find components."""
+    fw = Framework("tfw8")
+    fw.register(CompA())
+    fw.register(CompB())
+    mca_var.VARS.set_value("tfw8", "alpha")
+    assert fw.select().NAME == "alpha"
+    mca_var.VARS.set_value("tfw8", "beta")
+    assert fw.select().NAME == "beta"
+
+
+def test_framework_verbose_var_reaches_stream(fresh_mca):
+    import io
+    from ompi_release_tpu.utils import output
+    buf = io.StringIO()
+    output.set_sink(buf)
+    try:
+        fw = Framework("tfw9")
+        fw.register(CompA())
+        mca_var.VARS.set_value("tfw9_verbose", 5)
+        fw.select()
+        assert "selected component alpha" in buf.getvalue()
+    finally:
+        output.set_sink(None)

@@ -100,3 +100,28 @@ def test_describe_all(fresh_mca):
     fresh_mca.register("zz", "int", 1, "help text")
     descs = fresh_mca.describe_all()
     assert any(d["name"] == "zz" and d["help"] == "help text" for d in descs)
+
+
+def test_readonly_not_leaked_via_refresh(fresh_mca):
+    """A rejected set_value must not apply on a later resolve."""
+    v = fresh_mca.register("ro_var", "int", 5, scope=VarScope.READONLY)
+    with pytest.raises(PermissionError):
+        fresh_mca.set_value("ro_var", 6)
+    fresh_mca.refresh_from_env()
+    assert v.value == 5
+
+
+def test_invalid_env_does_not_half_register(fresh_mca, monkeypatch):
+    monkeypatch.setenv(ENV_PREFIX + "half_reg", "garbage")
+    with pytest.raises(ValueError):
+        fresh_mca.register("half_reg", "int", 5)
+    assert fresh_mca.lookup("half_reg") is None
+    monkeypatch.delenv(ENV_PREFIX + "half_reg")
+    assert fresh_mca.register("half_reg", "int", 5).value == 5
+
+
+def test_apply_cli_skips_readonly(fresh_mca):
+    v = fresh_mca.register("ro2", "int", 5, scope=VarScope.READONLY)
+    w = fresh_mca.register("rw2", "int", 1)
+    fresh_mca.apply_cli([("ro2", "9"), ("rw2", "2")])
+    assert v.value == 5 and w.value == 2

@@ -1,0 +1,74 @@
+"""Reduction op tests — analogue of the op_base_functions.c kernel table."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ompi_release_tpu import ops
+
+
+@pytest.mark.parametrize("name,expect", [
+    ("sum", 10), ("prod", 24), ("max", 4), ("min", 1),
+])
+def test_arith_ops(name, expect):
+    op = ops.PREDEFINED_OPS[name]
+    vals = [jnp.array(v, jnp.float32) for v in [1, 2, 3, 4]]
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = op(acc, v)
+    assert float(acc) == expect
+
+
+def test_logical_ops():
+    t, f = jnp.array(True), jnp.array(False)
+    assert bool(ops.LAND(t, f)) is False
+    assert bool(ops.LOR(t, f)) is True
+    assert bool(ops.LXOR(t, t)) is False
+
+
+def test_bitwise_ops():
+    a, b = jnp.array(0b1100, jnp.int32), jnp.array(0b1010, jnp.int32)
+    assert int(ops.BAND(a, b)) == 0b1000
+    assert int(ops.BOR(a, b)) == 0b1110
+    assert int(ops.BXOR(a, b)) == 0b0110
+
+
+def test_identities():
+    assert ops.SUM.identity_for(np.float32) == 0
+    assert ops.PROD.identity_for(np.int32) == 1
+    assert ops.MIN.identity_for(np.int32) == np.iinfo(np.int32).max
+    assert float(ops.MAX.identity_for(np.float32)) == -np.inf
+    assert int(ops.BAND.identity_for(np.uint8)) == 0xFF
+
+
+def test_maxloc_minloc_tie_lower_index():
+    v = jnp.array([3.0, 5.0]), jnp.array([0, 1])
+    w = jnp.array([3.0, 5.0]), jnp.array([2, 0])
+    mv, mi = ops.MAXLOC(v, w)
+    np.testing.assert_array_equal(np.asarray(mv), [3.0, 5.0])
+    np.testing.assert_array_equal(np.asarray(mi), [0, 0])  # ties -> lower idx
+    nv, ni = ops.MINLOC(v, w)
+    np.testing.assert_array_equal(np.asarray(ni), [0, 0])
+
+
+def test_replace_noop():
+    a, b = jnp.array(1.0), jnp.array(2.0)
+    assert float(ops.REPLACE(a, b)) == 2.0
+    assert float(ops.NO_OP(a, b)) == 1.0
+
+
+def test_user_op():
+    op = ops.user_op("avg2", lambda a, b: (a + b) / 2, commute=True)
+    assert float(op(jnp.array(2.0), jnp.array(4.0))) == 3.0
+    assert op.commutative
+
+
+def test_op_framework_selection():
+    mod = ops.OP_FRAMEWORK.select()
+    assert mod.lookup("sum") is ops.SUM
+
+
+def test_non_commutative_flag():
+    assert not ops.REPLACE.commutative
+    assert ops.SUM.commutative
