@@ -1,0 +1,6 @@
+"""Collectives: SPMD kernels, host driver, framework + components."""
+
+from . import spmd
+from .base import COLL_FRAMEWORK, OP_NAMES, comm_select
+
+__all__ = ["spmd", "COLL_FRAMEWORK", "OP_NAMES", "comm_select"]

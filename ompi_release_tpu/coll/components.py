@@ -1,0 +1,506 @@
+"""coll components: ``xla`` (compiler-scheduled), ``tuned`` (named
+algorithms + decision rules), ``basic`` (linear reference), ``self``
+(size-1 fast path).
+
+Priorities mirror the reference's layering logic: the hardware-offload
+component outranks tuned outranks basic (reference: fca/hcoll > tuned 30
+> basic 10), and ``self`` claims only size-1 communicators
+(``ompi/mca/coll/self``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..mca import component as mca_component
+from ..mca import var as mca_var
+from ..ops.op import Op
+from ..utils import output
+from . import spmd
+from .base import COLL_FRAMEWORK
+from .driver import run_sharded
+
+_log = output.stream("coll")
+
+AXIS = "rank"  # every comm submesh uses this axis name
+
+
+def _per_rank_bytes(x) -> int:
+    per_rank = x[0] if hasattr(x, "shape") else x
+    return int(per_rank.size * per_rank.dtype.itemsize)
+
+
+# ---------------------------------------------------------------------------
+# xla component — lower straight to XLA collectives
+# ---------------------------------------------------------------------------
+
+class _XlaModule:
+    """Collectives as single fused XLA ops; the compiler plans the ICI
+    schedule. This is the default data plane (BASELINE.json coll/xla)."""
+
+    def __init__(self, comm) -> None:
+        self.comm = comm
+
+    def fns(self) -> Dict[str, Callable]:
+        return {
+            "allreduce": self.allreduce,
+            "reduce": self.reduce,
+            "bcast": self.bcast,
+            "allgather": self.allgather,
+            "gather": self.gather,
+            "scatter": self.scatter,
+            "reduce_scatter_block": self.reduce_scatter_block,
+            "alltoall": self.alltoall,
+            "scan": self.scan,
+            "exscan": self.exscan,
+            "barrier": self.barrier,
+        }
+
+    # each driver fn: key identifies the compiled program; all static
+    # parameters (op name, root) must be part of the key
+    def allreduce(self, comm, x, op: Op):
+        if op.is_pair_op:
+            vals, idxs = x
+            return run_sharded(
+                comm, ("xla", "allreduce_pair", op.name),
+                lambda v, i: spmd.allreduce_pair_lax(v, i, op, AXIS),
+                vals, extra_arrays=(idxs,),
+            )
+        return run_sharded(
+            comm, ("xla", "allreduce", op.name),
+            lambda xb: spmd.allreduce_lax(xb, op, AXIS), x,
+        )
+
+    def reduce(self, comm, x, op: Op, root: int):
+        n = comm.size
+
+        def body(xb):
+            red = spmd.allreduce_lax(xb, op, AXIS)
+            rank = lax.axis_index(AXIS)
+            return jnp.where(rank == root, red, jnp.zeros_like(red))
+
+        return run_sharded(comm, ("xla", "reduce", op.name, root), body, x)
+
+    def bcast(self, comm, x, root: int):
+        return run_sharded(
+            comm, ("xla", "bcast", root),
+            lambda xb: spmd.bcast_masked_psum(xb, xb.dtype, AXIS, root), x,
+        )
+
+    def allgather(self, comm, x):
+        def body(xb):
+            g = lax.all_gather(xb, AXIS, axis=0)  # (n, ...)
+            return g.reshape((-1,) + g.shape[2:])
+
+        return run_sharded(comm, ("xla", "allgather"), body, x)
+
+    def gather(self, comm, x, root: int):
+        def body(xb):
+            g = lax.all_gather(xb, AXIS, axis=0)
+            g = g.reshape((-1,) + g.shape[2:])
+            rank = lax.axis_index(AXIS)
+            return jnp.where(rank == root, g, jnp.zeros_like(g))
+
+        return run_sharded(comm, ("xla", "gather", root), body, x)
+
+    def scatter(self, comm, x, root: int):
+        n = comm.size
+
+        def body(xb):
+            # xb: root's slice holds n chunks back-to-back
+            full = spmd.bcast_masked_psum(xb, xb.dtype, AXIS, root)
+            chunks = full.reshape((n, -1) + full.shape[1:])
+            rank = lax.axis_index(AXIS)
+            return jnp.take(chunks, rank, axis=0)
+
+        return run_sharded(comm, ("xla", "scatter", root), body, x)
+
+    def reduce_scatter_block(self, comm, x, op: Op):
+        n = comm.size
+        return run_sharded(
+            comm, ("xla", "reduce_scatter_block", op.name),
+            lambda xb: spmd.reduce_scatter_lax(xb, op, AXIS, n), x,
+        )
+
+    def alltoall(self, comm, x):
+        n = comm.size
+
+        def body(xb):
+            blocks = xb.reshape((n, -1) + xb.shape[1:])
+            out = spmd.alltoall_lax(blocks, AXIS, n)
+            return out.reshape(xb.shape)
+
+        return run_sharded(comm, ("xla", "alltoall"), body, x)
+
+    def scan(self, comm, x, op: Op, *, exclusive: bool = False):
+        n = comm.size
+
+        def body(xb):
+            g = lax.all_gather(xb, AXIS, axis=0)  # (n, ...)
+            s = lax.associative_scan(op, g, axis=0)
+            rank = lax.axis_index(AXIS)
+            if exclusive:
+                prev = jnp.take(
+                    s, jnp.maximum(rank - 1, 0), axis=0
+                )
+                return jnp.where(
+                    rank == 0, jnp.zeros_like(prev), prev
+                )
+            return jnp.take(s, rank, axis=0)
+
+        return run_sharded(
+            comm, ("xla", "scan", op.name, exclusive), body, x
+        )
+
+    def exscan(self, comm, x, op: Op):
+        return self.scan(comm, x, op, exclusive=True)
+
+    def barrier(self, comm):
+        out = run_sharded(
+            comm, ("xla", "barrier"),
+            lambda xb: spmd.barrier_psum(AXIS) + xb,
+            jnp.zeros((comm.size,), jnp.int32),
+        )
+        jax.block_until_ready(out)
+
+
+class XlaCollComponent(mca_component.Component):
+    NAME = "xla"
+    PRIORITY = 100
+
+    def query(self, ctx=None):
+        if ctx is None:
+            return (self.priority, self)
+        return (self.priority, _XlaModule(ctx))
+
+
+# ---------------------------------------------------------------------------
+# tuned component — named algorithms + fixed decision rules
+# ---------------------------------------------------------------------------
+
+ALLREDUCE_ALGORITHMS = (
+    # mirror of the enum coll_tuned_allreduce.c:46-54
+    "auto", "basic_linear", "nonoverlapping", "recursive_doubling",
+    "ring", "segmented_ring",
+)
+BCAST_ALGORITHMS = ("auto", "binomial", "masked_psum")
+ALLGATHER_ALGORITHMS = ("auto", "ring", "lax")
+ALLTOALL_ALGORITHMS = ("auto", "pairwise", "lax")
+
+
+class _TunedModule:
+    """Hand-written ppermute schedules with tuned's decision rules.
+
+    Decision constants are the reference's
+    (``coll_tuned_decision_fixed.c:51-83``): <10 kB → recursive
+    doubling; commutative && count > comm_size → ring, segmented ring
+    past comm_size × 1 MiB; otherwise nonoverlapping.
+    """
+
+    def __init__(self, comm) -> None:
+        self.comm = comm
+
+    def fns(self) -> Dict[str, Callable]:
+        return {
+            "allreduce": self.allreduce,
+            "bcast": self.bcast,
+            "reduce": self.reduce,
+            "allgather": self.allgather,
+            "reduce_scatter_block": self.reduce_scatter_block,
+            "alltoall": self.alltoall,
+            "scan": self.scan,
+            "exscan": self.exscan,
+            "barrier": self.barrier,
+        }
+
+    # -- allreduce --------------------------------------------------------
+    def _pick_allreduce(self, x, op: Op) -> str:
+        forced = mca_var.get("coll_tuned_allreduce_algorithm", "auto")
+        if forced != "auto":
+            return forced
+        n = self.comm.size
+        count = x[0].size
+        block_dsize = _per_rank_bytes(x)
+        if block_dsize < mca_var.get("coll_tuned_small_message", 10000):
+            return "recursive_doubling"
+        if op.commutative and count > n and op.identity is not None:
+            seg = mca_var.get("coll_tuned_segment_size", 1 << 20)
+            if n * seg >= block_dsize:
+                return "ring"
+            return "segmented_ring"
+        return "nonoverlapping"
+
+    def allreduce(self, comm, x, op: Op):
+        if op.is_pair_op:
+            return None  # pair ops stay with xla's gather path
+        alg = self._pick_allreduce(x, op)
+        n = comm.size
+        segsize = mca_var.get("coll_tuned_segment_size", 1 << 20)
+        seg_elems = max(1, segsize // x.dtype.itemsize)
+        bodies = {
+            "basic_linear": lambda xb: spmd.allreduce_basic_linear(
+                xb, op, AXIS, n
+            ),
+            "nonoverlapping": lambda xb: spmd.allreduce_nonoverlapping(
+                xb, op, AXIS, n
+            ),
+            "recursive_doubling": lambda xb: spmd.allreduce_recursive_doubling(
+                xb, op, AXIS, n
+            ),
+            "ring": lambda xb: spmd.allreduce_ring(xb, op, AXIS, n),
+            "segmented_ring": lambda xb: spmd.allreduce_segmented_ring(
+                xb, op, AXIS, n, seg_elems
+            ),
+        }
+        _log.verbose(3, f"{comm.name}: tuned allreduce -> {alg}")
+        return run_sharded(
+            comm, ("tuned", "allreduce", alg, op.name), bodies[alg], x
+        )
+
+    # -- others -----------------------------------------------------------
+    def bcast(self, comm, x, root: int):
+        alg = mca_var.get("coll_tuned_bcast_algorithm", "auto")
+        if alg in ("auto", "binomial"):
+            body = lambda xb: spmd.bcast_binomial(xb, AXIS, comm.size, root)
+            alg = "binomial"
+        else:
+            body = lambda xb: spmd.bcast_masked_psum(xb, xb.dtype, AXIS, root)
+        return run_sharded(comm, ("tuned", "bcast", alg, root), body, x)
+
+    def reduce(self, comm, x, op: Op, root: int):
+        n = comm.size
+        if not op.commutative:
+            return None  # defer to a lower-priority linear implementation
+
+        def body(xb):
+            red = spmd.reduce_binomial(xb, op, AXIS, n, root)
+            rank = lax.axis_index(AXIS)
+            return jnp.where(rank == root, red, jnp.zeros_like(red))
+
+        return run_sharded(comm, ("tuned", "reduce", op.name, root), body, x)
+
+    def allgather(self, comm, x):
+        alg = mca_var.get("coll_tuned_allgather_algorithm", "auto")
+        n = comm.size
+        if alg in ("auto", "ring"):
+            def body(xb):
+                g = spmd.allgather_ring(xb, AXIS, n)
+                return g.reshape((-1,) + g.shape[2:])
+            alg = "ring"
+        else:
+            def body(xb):
+                g = spmd.allgather_lax(xb, AXIS)
+                return g.reshape((-1,) + g.shape[2:])
+        return run_sharded(comm, ("tuned", "allgather", alg), body, x)
+
+    def reduce_scatter_block(self, comm, x, op: Op):
+        n = comm.size
+        if not op.commutative:
+            return None
+
+        def body(xb):
+            blocks = xb.reshape((n, -1) + xb.shape[1:])
+            return spmd.reduce_scatter_ring(blocks, op, AXIS, n)
+
+        return run_sharded(
+            comm, ("tuned", "reduce_scatter_block", op.name), body, x
+        )
+
+    def alltoall(self, comm, x):
+        alg = mca_var.get("coll_tuned_alltoall_algorithm", "auto")
+        n = comm.size
+
+        def body(xb):
+            blocks = xb.reshape((n, -1) + xb.shape[1:])
+            out = spmd.alltoall_pairwise(blocks, AXIS, n)
+            return out.reshape(xb.shape)
+
+        return run_sharded(comm, ("tuned", "alltoall", "pairwise"), body, x)
+
+    def scan(self, comm, x, op: Op):
+        n = comm.size
+        return run_sharded(
+            comm, ("tuned", "scan", op.name),
+            lambda xb: spmd.scan_recursive_doubling(xb, op, AXIS, n), x,
+        )
+
+    def exscan(self, comm, x, op: Op):
+        n = comm.size
+        return run_sharded(
+            comm, ("tuned", "exscan", op.name),
+            lambda xb: spmd.scan_recursive_doubling(
+                xb, op, AXIS, n, exclusive=True
+            ), x,
+        )
+
+    def barrier(self, comm):
+        out = run_sharded(
+            comm, ("tuned", "barrier"),
+            lambda xb: spmd.barrier_psum(AXIS) + xb,
+            jnp.zeros((comm.size,), jnp.int32),
+        )
+        jax.block_until_ready(out)
+
+
+class TunedCollComponent(mca_component.Component):
+    NAME = "tuned"
+    PRIORITY = 50
+
+    def register_vars(self) -> None:
+        mca_var.register(
+            "coll_tuned_allreduce_algorithm", "enum", "auto",
+            "Force a specific allreduce algorithm",
+            choices=ALLREDUCE_ALGORITHMS,
+        )
+        mca_var.register(
+            "coll_tuned_bcast_algorithm", "enum", "auto",
+            "Force a specific bcast algorithm", choices=BCAST_ALGORITHMS,
+        )
+        mca_var.register(
+            "coll_tuned_allgather_algorithm", "enum", "auto",
+            "Force a specific allgather algorithm",
+            choices=ALLGATHER_ALGORITHMS,
+        )
+        mca_var.register(
+            "coll_tuned_alltoall_algorithm", "enum", "auto",
+            "Force a specific alltoall algorithm",
+            choices=ALLTOALL_ALGORITHMS,
+        )
+        mca_var.register(
+            "coll_tuned_small_message", "size", 10000,
+            "Below this many bytes per rank, allreduce uses recursive "
+            "doubling (coll_tuned_decision_fixed.c:51)",
+        )
+        mca_var.register(
+            "coll_tuned_segment_size", "size", 1 << 20,
+            "Ring segment size (coll_tuned_decision_fixed.c:71)",
+        )
+
+    def query(self, ctx=None):
+        if ctx is None:
+            return (self.priority, self)
+        return (self.priority, _TunedModule(ctx))
+
+
+# ---------------------------------------------------------------------------
+# basic component — linear/log reference algorithms (always correct)
+# ---------------------------------------------------------------------------
+
+class _BasicModule:
+    """Linear algorithms (``ompi/mca/coll/basic``): the correctness
+    yardstick; also the only non-commutative-safe reduce path."""
+
+    def __init__(self, comm) -> None:
+        self.comm = comm
+
+    def fns(self) -> Dict[str, Callable]:
+        return {
+            "allreduce": self.allreduce,
+            "reduce": self.reduce,
+            "scatter": self.scatter,
+            "gather": self.gather,
+        }
+
+    def allreduce(self, comm, x, op: Op):
+        if op.is_pair_op:
+            return None
+        n = comm.size
+        return run_sharded(
+            comm, ("basic", "allreduce", op.name),
+            lambda xb: spmd.allreduce_basic_linear(xb, op, AXIS, n), x,
+        )
+
+    def reduce(self, comm, x, op: Op, root: int):
+        n = comm.size
+
+        def body(xb):
+            red = spmd.allreduce_basic_linear(xb, op, AXIS, n)
+            rank = lax.axis_index(AXIS)
+            return jnp.where(rank == root, red, jnp.zeros_like(red))
+
+        return run_sharded(comm, ("basic", "reduce", op.name, root), body, x)
+
+    def scatter(self, comm, x, root: int):
+        n = comm.size
+
+        def body(xb):
+            full = spmd.bcast_masked_psum(xb, xb.dtype, AXIS, root)
+            chunks = full.reshape((n, -1) + full.shape[1:])
+            rank = lax.axis_index(AXIS)
+            return jnp.take(chunks, rank, axis=0)
+
+        return run_sharded(comm, ("basic", "scatter", root), body, x)
+
+    def gather(self, comm, x, root: int):
+        def body(xb):
+            g = lax.all_gather(xb, AXIS, axis=0)
+            g = g.reshape((-1,) + g.shape[2:])
+            rank = lax.axis_index(AXIS)
+            return jnp.where(rank == root, g, jnp.zeros_like(g))
+
+        return run_sharded(comm, ("basic", "gather", root), body, x)
+
+
+class BasicCollComponent(mca_component.Component):
+    NAME = "basic"
+    PRIORITY = 10
+
+    def query(self, ctx=None):
+        if ctx is None:
+            return (self.priority, self)
+        return (self.priority, _BasicModule(ctx))
+
+
+# ---------------------------------------------------------------------------
+# self component — size-1 communicators never touch the mesh
+# ---------------------------------------------------------------------------
+
+class _SelfModule:
+    def __init__(self, comm) -> None:
+        self.comm = comm
+
+    def fns(self) -> Dict[str, Callable]:
+        import numpy as _np
+
+        def identity(comm, x, *a, **k):
+            return jnp.asarray(x)
+
+        def allreduce(comm, x, op):
+            return jnp.asarray(x)
+
+        return {
+            "allreduce": allreduce,
+            "reduce": lambda comm, x, op, root: jnp.asarray(x),
+            "bcast": lambda comm, x, root: jnp.asarray(x),
+            "allgather": identity,
+            "gather": lambda comm, x, root: jnp.asarray(x),
+            "scatter": lambda comm, x, root: jnp.asarray(x),
+            "reduce_scatter_block": lambda comm, x, op: jnp.asarray(x),
+            "alltoall": identity,
+            "scan": lambda comm, x, op: jnp.asarray(x),
+            "exscan": lambda comm, x, op: jnp.zeros_like(jnp.asarray(x)),
+            "barrier": lambda comm: None,
+        }
+
+
+class SelfCollComponent(mca_component.Component):
+    NAME = "self"
+    PRIORITY = 0
+
+    def query(self, ctx=None):
+        if ctx is None:
+            return (self.priority, self)
+        if ctx.size == 1:
+            return (1000, _SelfModule(ctx))  # claim size-1 comms outright
+        return None
+
+
+COLL_FRAMEWORK.register(XlaCollComponent())
+COLL_FRAMEWORK.register(TunedCollComponent())
+COLL_FRAMEWORK.register(BasicCollComponent())
+COLL_FRAMEWORK.register(SelfCollComponent())

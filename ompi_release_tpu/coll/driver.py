@@ -1,0 +1,72 @@
+"""Host-side collective driver: persistent compiled programs per comm.
+
+Wraps the SPMD kernels (``coll/spmd.py``) into MPI-semantic host calls:
+inputs/outputs carry a leading ``size`` axis (slice i = rank i's
+buffer). Each (comm, operation, algorithm) pair gets ONE persistent
+jitted ``shard_map`` program, cached on the communicator — re-invoking
+with the same shapes never retraces (the "no per-call retrace"
+requirement from SURVEY §6's north star).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..mca import pvar
+
+_invoke_count = pvar.counter(
+    "coll_invocations", "host-driver collective invocations"
+)
+_compile_count = pvar.counter(
+    "coll_programs_compiled", "distinct compiled collective programs"
+)
+
+
+def _program_cache(comm) -> Dict[Tuple, Callable]:
+    cache = getattr(comm, "_coll_programs", None)
+    if cache is None:
+        cache = {}
+        comm._coll_programs = cache
+    return cache
+
+
+def run_sharded(comm, key: Tuple, body: Callable, x, *,
+                extra_arrays: Tuple = ()) -> Any:
+    """Run ``body(block, *extra_blocks)`` under shard_map over the comm's
+    1-D ``rank`` axis. ``x`` has leading axis == comm.size; every extra
+    array is sharded the same way. Result keeps the leading rank axis.
+    """
+    _invoke_count.add()
+    if x.shape[0] != comm.size:
+        from ..utils.errors import ErrorCode, MPIError
+
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"driver-mode buffer leading axis {x.shape[0]} != comm size "
+            f"{comm.size} (one slice per rank)",
+        )
+    cache = _program_cache(comm)
+    prog = cache.get(key)
+    if prog is None:
+        _compile_count.add()
+        mesh = comm.submesh
+        n_extra = len(extra_arrays)
+
+        def wrapper(xb, *eb):
+            out = body(xb[0], *[e[0] for e in eb])
+            return jax.tree.map(lambda a: a[None], out)
+
+        prog = jax.jit(
+            jax.shard_map(
+                wrapper,
+                mesh=mesh,
+                in_specs=tuple([P("rank")] * (1 + n_extra)),
+                out_specs=P("rank"),
+            )
+        )
+        cache[key] = prog
+    return prog(jnp.asarray(x), *[jnp.asarray(e) for e in extra_arrays])

@@ -1,0 +1,304 @@
+"""Communicators — the ``ompi/communicator`` analogue, mesh-native.
+
+A communicator binds a :class:`Group` to a sub-mesh of the world device
+mesh, carries a CID, attributes, an error handler, and — the load-
+bearing part, exactly as in the reference — a per-communicator table of
+collective implementations installed by priority query over the coll
+framework (``ompi/mca/coll/base/coll_base_comm_select.c:66-88``).
+
+Driver-mode data convention (single-controller SPMD): operations whose
+MPI result is rank-dependent take/return arrays with a leading ``size``
+axis (slice i = rank i's buffer, matching the reference's oversubscribed
+-mpirun test style, SURVEY §4); operations whose result is identical on
+every rank return it once. The in-jit SPMD API (``coll.allreduce`` under
+``shard_map``) is the performance path; this host API is the semantic
+(MPI-compatible) path and compiles one persistent program per
+(op, shape, dtype, algorithm).
+
+CID allocation: the reference runs an iterated MAX-allreduce agreement
+(``ompi/communicator/comm_cid.c:190,264-318``); under a static mesh
+with a single controller the agreement outcome is a deterministic
+monotone counter, so that is what we use.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..mca import pvar
+from ..utils import output
+from ..utils.errors import Errhandler, ErrorCode, MPIError, ERRORS_ARE_FATAL
+from .group import Group, UNDEFINED
+
+_log = output.stream("comm")
+_cid_counter = itertools.count(0)
+_cid_lock = threading.Lock()
+_comm_registry: Dict[int, "Communicator"] = {}
+
+_comm_count = pvar.counter("comm_active_count", "live communicators")
+
+
+def _next_cid() -> int:
+    with _cid_lock:
+        return next(_cid_counter)
+
+
+def clear_comm_registry() -> None:
+    _comm_registry.clear()
+
+
+class Keyval:
+    """MPI_Comm_create_keyval analogue."""
+
+    _counter = itertools.count(0)
+
+    def __init__(self, copy_fn: Optional[Callable] = None,
+                 delete_fn: Optional[Callable] = None,
+                 extra_state: Any = None) -> None:
+        self.id = next(Keyval._counter)
+        self.copy_fn = copy_fn
+        self.delete_fn = delete_fn
+        self.extra_state = extra_state
+
+
+class Communicator:
+    def __init__(self, runtime, group: Group, *, name: str = "",
+                 parent: Optional["Communicator"] = None,
+                 topo: Optional[Any] = None) -> None:
+        from ..runtime.mesh import build_submesh  # local: avoid cycle
+
+        self.runtime = runtime
+        self.group = group
+        self.cid = _next_cid()
+        self.name = name or f"comm{self.cid}"
+        self.errhandler: Errhandler = (
+            parent.errhandler if parent else ERRORS_ARE_FATAL
+        )
+        self.info: Dict[str, str] = dict(getattr(parent, "info", {}) or {})
+        self.topo = topo  # topology module (cart/graph), if any
+        self._attrs: Dict[int, Any] = {}
+        self._freed = False
+
+        # sub-mesh over this group's devices, 1-D "rank" axis: collectives
+        # ride ICI in world-mesh order regardless of group order
+        self.submesh = build_submesh(runtime.mesh, group.world_ranks)
+
+        # per-comm collective table (c_coll analogue), installed at
+        # creation time exactly like coll_base_comm_select
+        from ..coll import base as coll_base
+
+        self.c_coll = coll_base.comm_select(self)
+
+        _comm_registry[self.cid] = self
+        _comm_count.add()
+        _log.verbose(2, f"created {self.name} cid={self.cid} size={self.size}")
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    def rank_of(self, world_rank: int) -> int:
+        return self.group.rank_of(world_rank)
+
+    @property
+    def is_self(self) -> bool:
+        return self.size == 1
+
+    def _check_alive(self) -> None:
+        if self._freed:
+            raise MPIError(ErrorCode.ERR_COMM, f"{self.name} already freed")
+
+    # -- construction ------------------------------------------------------
+    def dup(self, name: str = "") -> "Communicator":
+        self._check_alive()
+        c = Communicator(
+            self.runtime, self.group,
+            name=name or f"dup({self.name})", parent=self, topo=self.topo,
+        )
+        # MPI_Comm_dup runs attribute copy callbacks
+        for kv_id, value in list(self._attrs.items()):
+            kv = _keyval_table.get(kv_id)
+            if kv and kv.copy_fn:
+                keep, new_val = kv.copy_fn(self, kv, value, kv.extra_state)
+                if keep:
+                    c._attrs[kv_id] = new_val
+            elif kv:
+                c._attrs[kv_id] = value
+        return c
+
+    def create(self, group: Group, name: str = "") -> Optional["Communicator"]:
+        """MPI_Comm_create: new comm over a subgroup (None if empty)."""
+        self._check_alive()
+        if group.size == 0:
+            return None
+        for r in group.world_ranks:
+            if self.group.rank_of(r) == UNDEFINED:
+                raise MPIError(
+                    ErrorCode.ERR_GROUP,
+                    f"rank {r} not in parent {self.name}",
+                )
+        return Communicator(self.runtime, group, name=name, parent=self)
+
+    def split(self, colors: Sequence[int], keys: Optional[Sequence[int]] = None
+              ) -> List[Optional["Communicator"]]:
+        """MPI_Comm_split, driver mode: per-rank colors/keys vectors.
+
+        Returns one entry per local rank: the communicator that rank
+        landed in (ranks sharing a color share the object), or None for
+        color=UNDEFINED. Single-controller makes the exchange the
+        reference does (allgather of color/key) a local sort.
+        """
+        self._check_alive()
+        if len(colors) != self.size:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"need {self.size} colors, got {len(colors)}",
+            )
+        keys = list(keys) if keys is not None else [0] * self.size
+        buckets: Dict[int, List[Tuple[int, int]]] = {}
+        for local, (color, key) in enumerate(zip(colors, keys)):
+            if color == UNDEFINED:
+                continue
+            if color < 0:
+                raise MPIError(ErrorCode.ERR_ARG, f"negative color {color}")
+            buckets.setdefault(color, []).append((key, local))
+        result: List[Optional[Communicator]] = [None] * self.size
+        for color in sorted(buckets):
+            members = sorted(buckets[color])  # by (key, local-rank), MPI rule
+            g = Group([self.group.world_rank(l) for _, l in members])
+            sub = Communicator(
+                self.runtime, g,
+                name=f"split({self.name},{color})", parent=self,
+            )
+            for _, local in members:
+                result[local] = sub
+        return result
+
+    def split_type_shared(self) -> List["Communicator"]:
+        """MPI_Comm_split_type(COMM_TYPE_SHARED): group by host process."""
+        eps = {e.rank: e for e in self.runtime.endpoints}
+        colors = [
+            eps[self.group.world_rank(i)].process_index
+            for i in range(self.size)
+        ]
+        return self.split(colors)  # type: ignore[return-value]
+
+    def free(self) -> None:
+        self._check_alive()
+        for kv_id, value in list(self._attrs.items()):
+            kv = _keyval_table.get(kv_id)
+            if kv and kv.delete_fn:
+                kv.delete_fn(self, kv, value, kv.extra_state)
+        self._attrs.clear()
+        _comm_registry.pop(self.cid, None)
+        self._freed = True
+        _comm_count.add(-1)
+
+    # -- attributes (MPI keyvals) ------------------------------------------
+    def set_attr(self, keyval: Keyval, value: Any) -> None:
+        self._check_alive()
+        self._attrs[keyval.id] = value
+
+    def get_attr(self, keyval: Keyval) -> Tuple[bool, Any]:
+        v = self._attrs.get(keyval.id, _MISSING)
+        if v is _MISSING:
+            return False, None
+        return True, v
+
+    def delete_attr(self, keyval: Keyval) -> None:
+        v = self._attrs.pop(keyval.id, _MISSING)
+        if v is not _MISSING and keyval.delete_fn:
+            keyval.delete_fn(self, keyval, v, keyval.extra_state)
+
+    # -- errors ------------------------------------------------------------
+    def set_errhandler(self, handler: Errhandler) -> None:
+        self.errhandler = handler
+
+    def call_errhandler(self, err: MPIError) -> None:
+        self.errhandler.invoke(self, err)
+
+    def abort(self, errorcode: int = 1):
+        """MPI_Abort analogue."""
+        raise SystemExit(
+            f"MPI_Abort on {self.name} with errorcode {errorcode}"
+        )
+
+    # -- collectives (dispatch through the installed c_coll table) ---------
+    def _coll(self, op_name: str) -> Callable:
+        self._check_alive()
+        fn = self.c_coll.get(op_name)
+        if fn is None:
+            raise MPIError(
+                ErrorCode.ERR_INTERN,
+                f"no {op_name} implementation installed on {self.name}",
+            )
+        return fn
+
+    def allreduce(self, x, op=None, **kw):
+        from .. import ops as ops_mod
+
+        return self._coll("allreduce")(self, x, op or ops_mod.SUM, **kw)
+
+    def reduce(self, x, op=None, root: int = 0, **kw):
+        from .. import ops as ops_mod
+
+        return self._coll("reduce")(self, x, op or ops_mod.SUM, root, **kw)
+
+    def bcast(self, x, root: int = 0, **kw):
+        return self._coll("bcast")(self, x, root, **kw)
+
+    def allgather(self, x, **kw):
+        return self._coll("allgather")(self, x, **kw)
+
+    def gather(self, x, root: int = 0, **kw):
+        return self._coll("gather")(self, x, root, **kw)
+
+    def scatter(self, x, root: int = 0, **kw):
+        return self._coll("scatter")(self, x, root, **kw)
+
+    def reduce_scatter_block(self, x, op=None, **kw):
+        from .. import ops as ops_mod
+
+        return self._coll("reduce_scatter_block")(
+            self, x, op or ops_mod.SUM, **kw
+        )
+
+    def alltoall(self, x, **kw):
+        return self._coll("alltoall")(self, x, **kw)
+
+    def scan(self, x, op=None, **kw):
+        from .. import ops as ops_mod
+
+        return self._coll("scan")(self, x, op or ops_mod.SUM, **kw)
+
+    def exscan(self, x, op=None, **kw):
+        from .. import ops as ops_mod
+
+        return self._coll("exscan")(self, x, op or ops_mod.SUM, **kw)
+
+    def barrier(self) -> None:
+        self._coll("barrier")(self)
+
+    def __repr__(self) -> str:
+        return (
+            f"Communicator({self.name}, cid={self.cid}, size={self.size})"
+        )
+
+
+_MISSING = object()
+_keyval_table: Dict[int, Keyval] = {}
+
+
+def create_keyval(copy_fn=None, delete_fn=None, extra_state=None) -> Keyval:
+    kv = Keyval(copy_fn, delete_fn, extra_state)
+    _keyval_table[kv.id] = kv
+    return kv
+
+
+def free_keyval(kv: Keyval) -> None:
+    _keyval_table.pop(kv.id, None)

@@ -1,0 +1,16 @@
+"""WORLD/SELF communicator creation (``ompi_comm_init`` analogue)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from .communicator import Communicator
+from .group import Group
+
+
+def create_world(runtime) -> Tuple[Communicator, Communicator]:
+    world_group = Group(range(runtime.world_size))
+    world = Communicator(runtime, world_group, name="MPI_COMM_WORLD")
+    self_group = Group([0])
+    comm_self = Communicator(runtime, self_group, name="MPI_COMM_SELF")
+    return world, comm_self

@@ -41,12 +41,13 @@ class Convertor:
     def __init__(self, dtype: Datatype, count: int = 1) -> None:
         self.dtype = dtype
         self.count = count
+        # identity map when items are contiguous and (for count>1)
+        # back-to-back; only then can pack be a plain slice
+        back_to_back = count == 1 or dtype.get_extent() == dtype.span
         self._offsets: Optional[np.ndarray] = (
-            None if dtype.is_contiguous and count == 1
+            None if dtype.is_contiguous and back_to_back
             else dtype.offsets(count)
         )
-        if dtype.is_contiguous and count > 1 and dtype.get_extent() == dtype.span:
-            self._offsets = None  # N contiguous items back-to-back
 
     # -- totals ------------------------------------------------------------
     @property
@@ -110,6 +111,7 @@ class Convertor:
         by pipelined/segmented protocols). Returns (payload, new_pos)."""
         end = min(position + max_elements, self.packed_elements)
         flat = buffer.reshape(-1)
+        self._check_span(flat)
         if self._offsets is None:
             seg = flat[position:end]
         else:
@@ -122,6 +124,7 @@ class Convertor:
     def unpack_partial(self, payload: jax.Array, buffer: jax.Array,
                        position: int) -> Tuple[jax.Array, int]:
         flat = buffer.reshape(-1)
+        self._check_span(flat)
         n = payload.reshape(-1).shape[0]
         end = position + n
         payload = payload.reshape(-1).astype(flat.dtype)

@@ -150,9 +150,9 @@ PREDEFINED = {
 
 def from_jax_dtype(dtype) -> Datatype:
     """Map a jax/numpy dtype to the matching predefined Datatype."""
-    d = np.dtype(dtype) if not (str(dtype) == "bfloat16") else None
-    if d is None or str(dtype) == "bfloat16":
+    if str(dtype) == "bfloat16":
         return BFLOAT16
+    d = np.dtype(dtype)
     for t in PREDEFINED.values():
         if t.base_dtype == d:
             return t
@@ -228,6 +228,12 @@ def create_struct(blocklengths: Sequence[int], displacements: Sequence[int],
     splitting into one message per dtype, the same strategy the
     reference's heterogeneous-arch path uses for conversions).
     """
+    if not (len(blocklengths) == len(displacements) == len(types)):
+        raise ValueError(
+            f"struct argument lengths differ: {len(blocklengths)} "
+            f"blocklengths, {len(displacements)} displacements, "
+            f"{len(types)} types"
+        )
     dtypes = {t.base_dtype for t in types}
     if len(dtypes) != 1:
         raise ValueError(

@@ -114,8 +114,8 @@ class Framework:
             return self._components[component.NAME]
         component.framework = self
         self._components[component.NAME] = component
-        if self._opened:
-            self._open_one(component)
+        # if the framework is already open, the new component is opened
+        # lazily by available() (respecting the include/exclude filter)
         return component
 
     def components(self) -> List[Component]:
@@ -136,13 +136,15 @@ class Framework:
         comp.state = ComponentState.OPENED if ok else ComponentState.CLOSED
 
     def open(self) -> None:
-        # open ALL registered components, not just the currently-filtered
-        # set: the selection variable may change later (scope ALL), and a
-        # then-included component must already be usable
+        # only open components passing the include/exclude filter — an
+        # excluded component's open() must never run (the user may have
+        # excluded it precisely because its open misbehaves). If the
+        # selection variable changes later, available() lazily opens
+        # newly-included components on demand.
         if self._opened:
             return
         self._opened = True
-        for comp in self._components.values():
+        for comp in self._filtered():
             self._open_one(comp)
 
     def close(self) -> None:
@@ -179,6 +181,8 @@ class Framework:
             self.open()
         out: List[Tuple[int, Component, Any]] = []
         for comp in self._filtered():
+            if comp.state is ComponentState.REGISTERED:
+                self._open_one(comp)  # included after a selection change
             if comp.state is not ComponentState.OPENED:
                 continue
             res = comp.query(ctx)

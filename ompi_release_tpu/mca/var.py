@@ -39,7 +39,14 @@ class VarSource(enum.IntEnum):
 
 
 class VarScope(enum.IntEnum):
-    """Mirror of MCA_BASE_VAR_SCOPE_*: may the value change after init?"""
+    """Mirror of MCA_BASE_VAR_SCOPE_*: may the value change after init?
+
+    READONLY/CONSTANT forbid *runtime* writes (set_value/apply_cli after
+    the variable is registered). Launch-time sources — env, param files,
+    and CLI overrides recorded before registration — still apply, same
+    as the reference, where READONLY MCA vars are set via OMPI_MCA_* at
+    launch but rejected by MPI_T_cvar_write afterwards.
+    """
 
     CONSTANT = 0   # never changes
     READONLY = 1   # fixed once registered/resolved

@@ -51,10 +51,6 @@ class Op:
         return f"Op({self.name}, commutative={self.commutative})"
 
 
-def _int_like(dtype) -> bool:
-    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer) or jnp.dtype(dtype) == jnp.bool_
-
-
 def _min_identity(dtype):
     d = jnp.dtype(dtype)
     if d == jnp.bool_:

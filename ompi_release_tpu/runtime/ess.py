@@ -1,0 +1,99 @@
+"""ESS — environment-specific bootstrap (``orte/mca/ess`` analogue).
+
+How does this process learn its identity and device set? The reference
+has one component per launch environment (env/singleton/pmi/slurm...,
+``orte/mca/ess/``). Here:
+
+  - ``singleton``: one controller process owning all locally-visible
+    devices (the common JAX case; ``ess/singleton`` analogue).
+  - ``distributed``: multi-controller via ``jax.distributed`` —
+    coordinator address/rank from env (the ``ess/env``+``ess/pmi``
+    analogue; the jax coordinator service replaces the orted tree).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from ..mca import component as mca_component
+from ..mca import var as mca_var
+from ..utils import output
+
+_log = output.stream("ess")
+
+
+class SingletonEss(mca_component.Component):
+    """Single-controller bootstrap: all visible devices, process 0."""
+
+    NAME = "singleton"
+    PRIORITY = 10
+
+    def bootstrap(self):
+        import jax
+
+        return {
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "devices": jax.devices(),
+            "local_devices": jax.local_devices(),
+        }
+
+
+class DistributedEss(mca_component.Component):
+    """Multi-host bootstrap through the jax.distributed coordinator.
+
+    Selected when coordinator env vars are present (the analogue of
+    ess/env detecting mpirun's environment variables).
+    """
+
+    NAME = "distributed"
+    PRIORITY = 50
+
+    def register_vars(self) -> None:
+        mca_var.register(
+            "ess_distributed_coordinator", "str",
+            os.environ.get("OMPITPU_COORDINATOR", ""),
+            "host:port of the jax.distributed coordinator service",
+        )
+        mca_var.register(
+            "ess_distributed_process_id", "int",
+            int(os.environ.get("OMPITPU_PROCESS_ID", "-1")),
+            "this controller's process id within the job (-1 = unset)",
+        )
+        mca_var.register(
+            "ess_distributed_num_processes", "int",
+            int(os.environ.get("OMPITPU_NUM_PROCESSES", "0")),
+            "total controller processes in the job",
+        )
+
+    def query(self, ctx=None):
+        if not mca_var.get("ess_distributed_coordinator"):
+            return None  # not launched under a coordinator
+        return (self.priority, self)
+
+    def bootstrap(self):
+        import jax
+
+        coord = mca_var.get("ess_distributed_coordinator")
+        pid = mca_var.get("ess_distributed_process_id")
+        nprocs = mca_var.get("ess_distributed_num_processes")
+        _log.verbose(1, f"jax.distributed.initialize({coord}, {nprocs}, {pid})")
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=nprocs if nprocs > 0 else None,
+            process_id=pid if pid >= 0 else None,
+        )
+        return {
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "devices": jax.devices(),
+            "local_devices": jax.local_devices(),
+        }
+
+
+ESS_FRAMEWORK = mca_component.framework(
+    "ess", "environment-specific bootstrap (orte/mca/ess analogue)"
+)
+ESS_FRAMEWORK.register(SingletonEss())
+ESS_FRAMEWORK.register(DistributedEss())

@@ -1,0 +1,273 @@
+"""Collective correctness tests on the 8-device CPU mesh.
+
+Mirrors the reference's clusterless strategy (SURVEY §4): every
+algorithm runs multi-"device" with parity checked against numpy.
+BASELINE.json configs #2-#5 in miniature.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu import ops
+from ompi_release_tpu.mca import var as mca_var
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+def _per_rank(world, n, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    if np.issubdtype(np.dtype(dtype), np.floating):
+        return rng.randn(world.size, n).astype(dtype)
+    return rng.randint(0, 100, size=(world.size, n)).astype(dtype)
+
+
+ALGS = ["basic_linear", "nonoverlapping", "recursive_doubling", "ring",
+        "segmented_ring"]
+
+
+@pytest.mark.parametrize("alg", ALGS)
+def test_allreduce_algorithms_parity(world, alg):
+    """Every named algorithm must agree with numpy (configs #2)."""
+    x = _per_rank(world, 1000)
+    expect = x.sum(axis=0)
+    mca_var.set_value("coll_tuned_allreduce_algorithm", alg)
+    mca_var.set_value("coll", "tuned")
+    try:
+        out = world.allreduce(x, ops.SUM)
+    finally:
+        mca_var.VARS.unset("coll_tuned_allreduce_algorithm")
+        mca_var.VARS.unset("coll")
+    assert out.shape == x.shape
+    for r in range(world.size):
+        np.testing.assert_allclose(np.asarray(out[r]), expect, rtol=2e-5)
+
+
+def test_allreduce_xla_default(world):
+    x = _per_rank(world, 257)  # non-divisible size
+    out = world.allreduce(x, ops.SUM)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), x.sum(axis=0), rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("opname,npfn", [
+    ("max", np.max), ("min", np.min), ("prod", np.prod),
+])
+def test_allreduce_other_ops(world, opname, npfn):
+    x = _per_rank(world, 64, seed=3)
+    out = world.allreduce(x, ops.PREDEFINED_OPS[opname])
+    np.testing.assert_allclose(
+        np.asarray(out[0]), npfn(x, axis=0), rtol=1e-5
+    )
+
+
+def test_allreduce_int_bitwise(world):
+    x = _per_rank(world, 50, dtype=np.int32, seed=5)
+    out = world.allreduce(x, ops.BXOR)
+    expect = np.bitwise_xor.reduce(x, axis=0)
+    np.testing.assert_array_equal(np.asarray(out[0]), expect)
+
+
+def test_allreduce_maxloc(world):
+    vals = _per_rank(world, 16, seed=7)
+    idxs = np.tile(np.arange(world.size)[:, None], (1, 16)).astype(np.int32)
+    mv, mi = world.allreduce((vals, idxs), ops.MAXLOC)
+    np.testing.assert_allclose(np.asarray(mv[0]), vals.max(axis=0), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(mi[0]), vals.argmax(axis=0))
+
+
+def test_bcast(world):
+    x = _per_rank(world, 100, seed=11)
+    out = world.bcast(x, root=3)
+    for r in range(world.size):
+        np.testing.assert_array_equal(np.asarray(out[r]), x[3])
+
+
+def test_bcast_binomial(world):
+    x = _per_rank(world, 100, seed=12)
+    mca_var.set_value("coll", "tuned")
+    try:
+        out = world.bcast(x, root=5)
+    finally:
+        mca_var.VARS.unset("coll")
+    for r in range(world.size):
+        np.testing.assert_array_equal(np.asarray(out[r]), x[5])
+
+
+def test_reduce(world):
+    x = _per_rank(world, 100, seed=13)
+    out = world.reduce(x, ops.SUM, root=2)
+    np.testing.assert_allclose(np.asarray(out[2]), x.sum(axis=0), rtol=2e-5)
+
+
+def test_allgather(world):
+    x = _per_rank(world, 10, seed=17)
+    out = world.allgather(x)
+    expect = x.reshape(-1)
+    assert out.shape == (world.size, world.size * 10)
+    for r in range(world.size):
+        np.testing.assert_array_equal(np.asarray(out[r]), expect)
+
+
+def test_allgather_ring(world):
+    x = _per_rank(world, 10, seed=18)
+    mca_var.set_value("coll", "tuned")
+    try:
+        out = world.allgather(x)
+    finally:
+        mca_var.VARS.unset("coll")
+    for r in range(world.size):
+        np.testing.assert_array_equal(np.asarray(out[r]), x.reshape(-1))
+
+
+def test_gather_scatter(world):
+    x = _per_rank(world, 10, seed=19)
+    g = world.gather(x, root=1)
+    np.testing.assert_array_equal(np.asarray(g[1]), x.reshape(-1))
+    assert np.all(np.asarray(g[0]) == 0)  # non-root undefined -> zeros
+
+    # scatter: root's buffer holds size chunks
+    big = _per_rank(world, world.size * 5, seed=20)
+    s = world.scatter(big, root=1)
+    for r in range(world.size):
+        np.testing.assert_array_equal(
+            np.asarray(s[r]), big[1][r * 5:(r + 1) * 5]
+        )
+
+
+def test_reduce_scatter_block(world):
+    """ZeRO-style gradient shard (config #4)."""
+    n = world.size
+    x = _per_rank(world, n * 25, seed=23)
+    out = world.reduce_scatter_block(x, ops.SUM)
+    assert out.shape == (n, 25)
+    full = x.sum(axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(
+            np.asarray(out[r]), full[r * 25:(r + 1) * 25], rtol=2e-5
+        )
+
+
+def test_reduce_scatter_ring_parity(world):
+    n = world.size
+    x = _per_rank(world, n * 25, seed=24)
+    mca_var.set_value("coll", "tuned")
+    try:
+        out = world.reduce_scatter_block(x, ops.SUM)
+    finally:
+        mca_var.VARS.unset("coll")
+    full = x.sum(axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(
+            np.asarray(out[r]), full[r * 25:(r + 1) * 25], rtol=2e-5
+        )
+
+
+def test_alltoall(world):
+    """int32 block shuffle (config #5)."""
+    n = world.size
+    x = _per_rank(world, n * 4, dtype=np.int32, seed=29)
+    out = world.alltoall(x)
+    blocks = x.reshape(n, n, 4)
+    expect = blocks.transpose(1, 0, 2)  # out[i][j] = in[j][i]
+    np.testing.assert_array_equal(
+        np.asarray(out).reshape(n, n, 4), expect
+    )
+
+
+def test_alltoall_pairwise(world):
+    n = world.size
+    x = _per_rank(world, n * 4, dtype=np.int32, seed=31)
+    mca_var.set_value("coll", "tuned")
+    try:
+        out = world.alltoall(x)
+    finally:
+        mca_var.VARS.unset("coll")
+    expect = x.reshape(n, n, 4).transpose(1, 0, 2).reshape(n, -1)
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_scan_exscan(world):
+    x = _per_rank(world, 20, seed=37)
+    out = world.scan(x, ops.SUM)
+    expect = np.cumsum(x, axis=0)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-5)
+
+    ex = world.exscan(x, ops.SUM)
+    np.testing.assert_allclose(np.asarray(ex[0]), np.zeros(20), atol=0)
+    np.testing.assert_allclose(
+        np.asarray(ex[1:]), expect[:-1], rtol=2e-5
+    )
+
+
+def test_scan_tuned(world):
+    x = _per_rank(world, 20, seed=38)
+    mca_var.set_value("coll", "tuned")
+    try:
+        out = world.scan(x, ops.SUM)
+    finally:
+        mca_var.VARS.unset("coll")
+    np.testing.assert_allclose(
+        np.asarray(out), np.cumsum(x, axis=0), rtol=2e-5
+    )
+
+
+def test_barrier(world):
+    world.barrier()  # must simply not hang or raise
+
+
+def test_collectives_on_subcomm(world):
+    sub = world.create(world.group.incl([1, 3, 5]), name="odds3")
+    x = _per_rank(sub, 40, seed=41)
+    out = sub.allreduce(x, ops.SUM)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), x.sum(axis=0), rtol=2e-5
+    )
+    sub.free()
+
+
+def test_self_comm_collectives(world):
+    from ompi_release_tpu.runtime.runtime import Runtime
+
+    cs = Runtime.current().self_comm
+    x = np.ones((1, 5), np.float32)
+    np.testing.assert_array_equal(np.asarray(cs.allreduce(x)), x)
+    np.testing.assert_array_equal(np.asarray(cs.bcast(x, 0)), x)
+    assert cs._coll_providers["allreduce"] == ["self", "xla", "tuned", "basic"][0:1] or \
+        cs._coll_providers["allreduce"][0] == "self"
+
+
+def test_decision_rules(world):
+    """Size-based algorithm pick mirrors coll_tuned_decision_fixed.c."""
+    from ompi_release_tpu.coll.components import _TunedModule
+
+    m = _TunedModule(world)
+    small = np.zeros((8, 100), np.float32)   # 400 B < 10 kB
+    assert m._pick_allreduce(small, ops.SUM) == "recursive_doubling"
+    mid = np.zeros((8, 300_000), np.float32)  # 1.2 MB, n*1MiB=8MiB >= it
+    assert m._pick_allreduce(mid, ops.SUM) == "ring"
+    huge = np.zeros((8, 3_000_000), np.float32)  # 12 MB > 8 MiB
+    assert m._pick_allreduce(huge, ops.SUM) == "segmented_ring"
+    noncommut = ops.user_op("left", lambda a, b: a, commute=False)
+    assert m._pick_allreduce(mid, noncommut) == "nonoverlapping"
+
+
+def test_bitwise_parity_ring_vs_linear(world):
+    """SURVEY §6 hard part: fixed per-algorithm reduction order means
+    the same algorithm must be bitwise-reproducible run to run."""
+    x = _per_rank(world, 4096, seed=43)
+    mca_var.set_value("coll_tuned_allreduce_algorithm", "ring")
+    mca_var.set_value("coll", "tuned")
+    try:
+        a = np.asarray(world.allreduce(x, ops.SUM))
+        b = np.asarray(world.allreduce(jnp.asarray(x), ops.SUM))
+    finally:
+        mca_var.VARS.unset("coll_tuned_allreduce_algorithm")
+        mca_var.VARS.unset("coll")
+    np.testing.assert_array_equal(a, b)  # bitwise

@@ -138,3 +138,18 @@ def test_from_jax_dtype():
     assert dt.from_jax_dtype(jnp.float32) is dt.FLOAT
     assert dt.from_jax_dtype(jnp.bfloat16) is dt.BFLOAT16
     assert dt.from_jax_dtype(np.int32) is dt.INT32
+
+
+def test_struct_mismatched_lengths_rejected():
+    with pytest.raises(ValueError):
+        dt.create_struct([1, 2, 3], [0, 3], [dt.FLOAT, dt.FLOAT])
+
+
+def test_partial_pack_truncate_guard():
+    t = dt.create_vector(4, 1, 4, dt.FLOAT)  # spans 13
+    c = Convertor(t)
+    small = jnp.arange(8, dtype=jnp.float32)
+    with pytest.raises(Exception):
+        c.pack_partial(small, 0, 2)
+    with pytest.raises(Exception):
+        c.unpack_partial(jnp.zeros(2, jnp.float32), small, 0)

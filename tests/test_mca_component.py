@@ -110,3 +110,26 @@ def test_framework_verbose_var_reaches_stream(fresh_mca):
         assert "selected component alpha" in buf.getvalue()
     finally:
         output.set_sink(None)
+
+
+def test_excluded_component_never_opened(fresh_mca):
+    opened = []
+
+    class Tracker(Component):
+        NAME = "tracker"
+        PRIORITY = 99
+
+        def open(self):
+            opened.append(self.NAME)
+            return True
+
+    mca_var.VARS.set_value("tfw10", "^tracker")
+    fw = Framework("tfw10")
+    fw.register(Tracker())
+    fw.register(CompA())
+    assert fw.select().NAME == "alpha"
+    assert opened == []  # exclusion respected at open time
+    # late re-inclusion opens on demand
+    mca_var.VARS.set_value("tfw10", "tracker")
+    assert fw.select().NAME == "tracker"
+    assert opened == ["tracker"]

@@ -125,3 +125,14 @@ def test_apply_cli_skips_readonly(fresh_mca):
     w = fresh_mca.register("rw2", "int", 1)
     fresh_mca.apply_cli([("ro2", "9"), ("rw2", "2")])
     assert v.value == 5 and w.value == 2
+
+
+def test_readonly_launch_time_override_applies(fresh_mca):
+    """CLI/env overrides recorded BEFORE registration are launch-time
+    config and legitimately set READONLY vars (reference semantics);
+    only post-registration writes are rejected."""
+    fresh_mca.apply_cli([("early_ro", "9")])
+    v = fresh_mca.register("early_ro", "int", 5, scope=VarScope.READONLY)
+    assert v.value == 9
+    with pytest.raises(PermissionError):
+        fresh_mca.set_value("early_ro", 10)
