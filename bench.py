@@ -1,0 +1,134 @@
+"""Headline benchmark: allreduce bus-bandwidth at 256 MiB float32.
+
+Mirrors BASELINE.json config #2 (OSU-style MPI_Allreduce sweep; the
+north-star size is 256 MiB f32). With n >= 2 devices this times the
+framework's psum allreduce over a 1-D mesh and reports ring bus
+bandwidth 2(n-1)/n * bytes / t. On a single chip (the driver's bench
+environment) it times the on-device SUM op kernel (out = acc + a, the
+``ompi/op`` hot loop of BASELINE's north star): 3x bytes through HBM
+per iteration.
+
+Timing method: the tunneled single-chip backend has ~100 ms fixed
+per-call round-trip latency, so each measurement jits a fori_loop of K
+kernel iterations and takes the slope between K_lo and K_hi — pure
+device time, latency cancelled. Completion is forced by fetching an
+8-byte checksum (block_until_ready alone can return early through the
+tunnel).
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so
+the baseline is the measured HBM copy ceiling of the same chip — the
+ratio is "fraction of achievable memory bandwidth", target >= 0.8 per
+the north star.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+K_LO, K_HI = 2, 66
+
+
+def _median_call(fn, *args, iters=5):
+    def sync(r):
+        np.asarray(r)  # tiny checksum fetch forces remote completion
+
+    sync(fn(*args))  # compile + warm
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _per_iter_time(loop_fn, *args):
+    """Seconds per kernel iteration via the K_hi/K_lo slope."""
+    t_lo = _median_call(loop_fn, *args, K_LO)
+    t_hi = _median_call(loop_fn, *args, K_HI)
+    return max((t_hi - t_lo) / (K_HI - K_LO), 1e-12)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    size_bytes = 256 * 1024 * 1024
+    elems = size_bytes // 4
+
+    if n >= 2:
+        mesh = Mesh(np.array(devices), ("rank",))
+        sh = NamedSharding(mesh, P("rank"))
+        x = jax.device_put(
+            jnp.ones((n * elems,), jnp.float32), sh
+        )
+        inv_n = np.float32(1.0 / n)
+
+        @partial(jax.jit, static_argnums=1)
+        def allreduce_loop(x, k):
+            def spmd(b):
+                def body(i, acc):
+                    return lax.psum(acc, "rank") * inv_n
+
+                acc = lax.fori_loop(0, k, body, b)
+                return (acc[0] + acc[-1])[None]
+
+            s = jax.shard_map(spmd, mesh=mesh, in_specs=P("rank"),
+                              out_specs=P("rank"))(x)
+            return s[0]
+
+        per = _per_iter_time(allreduce_loop, x)
+        # each rank holds `elems` f32; the ring moves 2(n-1)/n of the
+        # full payload per allreduce
+        value = (2 * (n - 1) / n) * size_bytes / per / 1e9
+        metric = f"allreduce_256MiB_f32_busbw_{n}dev"
+    else:
+        a = jax.device_put(jnp.ones((elems,), jnp.float32), devices[0])
+
+        @partial(jax.jit, static_argnums=1)
+        def op_loop(a, k):
+            def body(i, acc):
+                return acc * np.float32(0.999) + a  # read acc,a; write
+
+            acc = lax.fori_loop(0, k, body, jnp.zeros_like(a))
+            return acc[0] + acc[-1]
+
+        per = _per_iter_time(op_loop, a)
+        value = 3 * size_bytes / per / 1e9
+        metric = "op_sum_256MiB_f32_hbm_bw"
+
+    # HBM copy ceiling on device 0: read + write = 2x bytes per iter
+    c = jax.device_put(jnp.ones((elems,), jnp.float32), devices[0])
+
+    @partial(jax.jit, static_argnums=1)
+    def copy_loop(c, k):
+        def body(i, acc):
+            # add the (varying) loop counter: a streaming read+write
+            # XLA cannot algebraically collapse across iterations (a
+            # constant multiply/add chain gets folded to one op)
+            return acc + lax.convert_element_type(i, jnp.float32)
+
+        acc = lax.fori_loop(0, k, body, c)
+        return acc[0] + acc[-1]
+
+    per_copy = _per_iter_time(copy_loop, c)
+    ceiling = 2 * size_bytes / per_copy / 1e9
+
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(value / ceiling, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

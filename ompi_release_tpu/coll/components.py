@@ -77,8 +77,6 @@ class _XlaModule:
         )
 
     def reduce(self, comm, x, op: Op, root: int):
-        n = comm.size
-
         def body(xb):
             red = spmd.allreduce_lax(xb, op, AXIS)
             rank = lax.axis_index(AXIS)
@@ -258,9 +256,13 @@ class _TunedModule:
             ),
         }
         _log.verbose(3, f"{comm.name}: tuned allreduce -> {alg}")
-        return run_sharded(
-            comm, ("tuned", "allreduce", alg, op.name), bodies[alg], x
+        # the segment size is baked into the compiled program, so it
+        # must be part of the cache key or later var changes would be
+        # silently ignored
+        key = ("tuned", "allreduce", alg, op.name) + (
+            (seg_elems,) if alg == "segmented_ring" else ()
         )
+        return run_sharded(comm, key, bodies[alg], x)
 
     # -- others -----------------------------------------------------------
     def bcast(self, comm, x, root: int):
@@ -303,9 +305,9 @@ class _TunedModule:
         if not op.commutative:
             return None
 
+        # reduce_scatter_ring blocks the flat per-rank buffer itself
         def body(xb):
-            blocks = xb.reshape((n, -1) + xb.shape[1:])
-            return spmd.reduce_scatter_ring(blocks, op, AXIS, n)
+            return spmd.reduce_scatter_ring(xb, op, AXIS, n)
 
         return run_sharded(
             comm, ("tuned", "reduce_scatter_block", op.name), body, x
@@ -313,14 +315,16 @@ class _TunedModule:
 
     def alltoall(self, comm, x):
         alg = mca_var.get("coll_tuned_alltoall_algorithm", "auto")
+        if alg == "auto":
+            alg = "pairwise"
         n = comm.size
+        fn = spmd.alltoall_lax if alg == "lax" else spmd.alltoall_pairwise
 
         def body(xb):
             blocks = xb.reshape((n, -1) + xb.shape[1:])
-            out = spmd.alltoall_pairwise(blocks, AXIS, n)
-            return out.reshape(xb.shape)
+            return fn(blocks, AXIS, n).reshape(xb.shape)
 
-        return run_sharded(comm, ("tuned", "alltoall", "pairwise"), body, x)
+        return run_sharded(comm, ("tuned", "alltoall", alg), body, x)
 
     def scan(self, comm, x, op: Op):
         n = comm.size

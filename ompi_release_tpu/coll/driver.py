@@ -41,6 +41,15 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
     array is sharded the same way. Result keeps the leading rank axis.
     """
     _invoke_count.add()
+    if not hasattr(x, "shape"):
+        from ..utils.errors import ErrorCode, MPIError
+
+        raise MPIError(
+            ErrorCode.ERR_TYPE,
+            "driver-mode collectives take a single array with a leading "
+            "rank axis; pair-op (value, index) tuples are only supported "
+            "by allreduce (MINLOC/MAXLOC)",
+        )
     if x.shape[0] != comm.size:
         from ..utils.errors import ErrorCode, MPIError
 

@@ -48,6 +48,12 @@ def _next_cid() -> int:
 
 
 def clear_comm_registry() -> None:
+    """Finalize-time teardown: mark every live communicator freed (so
+    stale handles raise instead of silently working) and keep the
+    comm_active_count pvar honest."""
+    for c in list(_comm_registry.values()):
+        c._freed = True
+        _comm_count.add(-1)
     _comm_registry.clear()
 
 

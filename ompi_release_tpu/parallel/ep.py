@@ -1,0 +1,89 @@
+"""Expert parallelism — capacity-bounded token routing over all-to-all.
+
+The alltoallv pattern (``coll_tuned_alltoallv.c``) made static-shape
+for XLA: top-1 (switch) routing with a fixed per-expert capacity so the
+dispatch/combine tensors have compile-time shapes; the two
+``lax.all_to_all`` calls move each token to its expert's rank and back.
+Tokens over capacity are dropped (standard switch-transformer
+semantics) and their outputs fall back to zero (residual carries them).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _one_hot_dispatch(logits: jax.Array, n_experts: int, capacity: int
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Build (dispatch, combine) for top-1 routing.
+
+    logits: (T, E). dispatch: (T, E, C) one-hot slot assignment;
+    combine: (T, E, C) = dispatch * gate prob.
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    eh = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)  # (T, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(eh, axis=0) * eh - eh  # (T, E), valid where eh==1
+    keep = (pos < capacity) & (eh == 1)
+    slot = jnp.where(keep, pos, 0)
+    dispatch = (
+        jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        * keep[..., None]
+    )  # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
+              expert_params, *, axis_name: str = "ep",
+              capacity_factor: float = 1.25) -> Tuple[jax.Array, jax.Array]:
+    """Switch-MoE layer under shard_map over the ep axis.
+
+    x: (T, D) this rank's tokens; router_w: (D, E_global) replicated;
+    expert_params: this rank's local experts' params with leading axis
+    E_local; ``expert_fn(params_e, tokens) -> tokens`` applied per local
+    expert via vmap. Returns (output (T, D), aux_loss scalar).
+    """
+    n = lax.psum(1, axis_name)
+    t, dmodel = x.shape
+    e_global = router_w.shape[1]
+    if e_global % n:
+        raise ValueError(f"{e_global} experts not divisible by ep={n}")
+    e_local = e_global // n
+    capacity = max(1, int(capacity_factor * t / e_global))
+
+    logits = jnp.matmul(x, router_w, preferred_element_type=jnp.float32)
+    dispatch, combine = _one_hot_dispatch(logits, e_global, capacity)
+
+    # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e
+    probs = jax.nn.softmax(logits, axis=-1)
+    frac_tokens = jnp.mean(dispatch.sum(-1), axis=0)  # (E,)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e_global * jnp.sum(frac_tokens * frac_probs)
+    aux = lax.pmean(aux, axis_name)
+
+    # local tokens -> (E, C, D) expert queues
+    sent = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # route: (E, C, D) -> (n, E_local, C, D): each rank keeps its experts'
+    # queues from every peer
+    sent = sent.reshape(n, e_local, capacity, dmodel)
+    recv = lax.all_to_all(sent, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)  # (n, E_local, C, D)
+    # run local experts over all peers' tokens
+    per_expert = recv.transpose(1, 0, 2, 3).reshape(
+        e_local, n * capacity, dmodel
+    ).astype(x.dtype)
+    done = jax.vmap(expert_fn)(expert_params, per_expert)
+    done = done.reshape(e_local, n, capacity, dmodel).transpose(1, 0, 2, 3)
+    # route back
+    back = lax.all_to_all(done.astype(jnp.float32), axis_name,
+                          split_axis=0, concat_axis=0, tiled=False)
+    back = back.reshape(e_global, capacity, dmodel)
+    out = jnp.einsum("tec,ecd->td", combine, back)
+    return out.astype(x.dtype), aux

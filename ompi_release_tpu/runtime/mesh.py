@@ -77,11 +77,10 @@ def register_vars() -> None:
         "rmaps_mesh_axes", "str", "world",
         "Comma list of mesh axis names matching rmaps_mesh_shape",
     )
-    mca_var.register(
-        "rmaps_allow_oversubscribe", "bool", False,
-        "Permit more ranks than physical devices (reference: mpirun "
-        "oversubscription); ranks wrap onto devices round-robin",
-    )
+    # NOTE: no oversubscription variable — a jax Mesh requires unique
+    # devices, so ranks-per-device wrapping (mpirun oversubscription)
+    # has no TPU analogue; the simulator backend (forced host device
+    # count) covers the reference's oversubscribed-test use case.
 
 
 def device_coords(dev) -> Tuple[int, ...]:

@@ -128,7 +128,9 @@ class Runtime:
             self.job_state.activate(JobState.TERMINATED)
             self.finalized = True
             self.initialized = False
-            Runtime._instance = None
+            # keep the instance so a later init() hits the
+            # re-init-after-finalize guard (MPI semantics) instead of
+            # silently building a fresh runtime
 
     # -- queries -----------------------------------------------------------
     @property

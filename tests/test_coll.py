@@ -20,6 +20,22 @@ def world():
     yield mpi.init()
 
 
+@pytest.fixture()
+def tuned(world):
+    """A communicator whose c_coll table is served by the tuned
+    component: the coll table is frozen at communicator creation
+    (coll_base_comm_select analogue), so the selection var must be set
+    BEFORE the dup — setting it afterwards would silently test xla."""
+    mca_var.set_value("coll", "tuned")
+    try:
+        c = world.dup(name="tuned_dup")
+    finally:
+        mca_var.VARS.unset("coll")
+    assert c._coll_providers["allreduce"] == ["tuned"]
+    yield c
+    c.free()
+
+
 def _per_rank(world, n, dtype=np.float32, seed=0):
     rng = np.random.RandomState(seed)
     if np.issubdtype(np.dtype(dtype), np.floating):
@@ -32,20 +48,25 @@ ALGS = ["basic_linear", "nonoverlapping", "recursive_doubling", "ring",
 
 
 @pytest.mark.parametrize("alg", ALGS)
-def test_allreduce_algorithms_parity(world, alg):
+def test_allreduce_algorithms_parity(tuned, alg):
     """Every named algorithm must agree with numpy (configs #2)."""
-    x = _per_rank(world, 1000)
+    x = _per_rank(tuned, 1000)
     expect = x.sum(axis=0)
     mca_var.set_value("coll_tuned_allreduce_algorithm", alg)
-    mca_var.set_value("coll", "tuned")
     try:
-        out = world.allreduce(x, ops.SUM)
+        out = tuned.allreduce(x, ops.SUM)
     finally:
         mca_var.VARS.unset("coll_tuned_allreduce_algorithm")
-        mca_var.VARS.unset("coll")
     assert out.shape == x.shape
-    for r in range(world.size):
-        np.testing.assert_allclose(np.asarray(out[r]), expect, rtol=2e-5)
+    # prove the named algorithm actually compiled (not a fallback)
+    assert any(
+        k[:3] == ("tuned", "allreduce", alg)
+        for k in getattr(tuned, "_coll_programs", {})
+    )
+    for r in range(tuned.size):
+        # atol covers reduction-order float noise on near-zero sums
+        np.testing.assert_allclose(np.asarray(out[r]), expect, rtol=2e-5,
+                                   atol=1e-4)
 
 
 def test_allreduce_xla_default(world):
@@ -89,14 +110,11 @@ def test_bcast(world):
         np.testing.assert_array_equal(np.asarray(out[r]), x[3])
 
 
-def test_bcast_binomial(world):
-    x = _per_rank(world, 100, seed=12)
-    mca_var.set_value("coll", "tuned")
-    try:
-        out = world.bcast(x, root=5)
-    finally:
-        mca_var.VARS.unset("coll")
-    for r in range(world.size):
+def test_bcast_binomial(tuned):
+    x = _per_rank(tuned, 100, seed=12)
+    out = tuned.bcast(x, root=5)
+    assert ("tuned", "bcast", "binomial", 5) in tuned._coll_programs
+    for r in range(tuned.size):
         np.testing.assert_array_equal(np.asarray(out[r]), x[5])
 
 
@@ -115,14 +133,11 @@ def test_allgather(world):
         np.testing.assert_array_equal(np.asarray(out[r]), expect)
 
 
-def test_allgather_ring(world):
-    x = _per_rank(world, 10, seed=18)
-    mca_var.set_value("coll", "tuned")
-    try:
-        out = world.allgather(x)
-    finally:
-        mca_var.VARS.unset("coll")
-    for r in range(world.size):
+def test_allgather_ring(tuned):
+    x = _per_rank(tuned, 10, seed=18)
+    out = tuned.allgather(x)
+    assert ("tuned", "allgather", "ring") in tuned._coll_programs
+    for r in range(tuned.size):
         np.testing.assert_array_equal(np.asarray(out[r]), x.reshape(-1))
 
 
@@ -154,18 +169,16 @@ def test_reduce_scatter_block(world):
         )
 
 
-def test_reduce_scatter_ring_parity(world):
-    n = world.size
-    x = _per_rank(world, n * 25, seed=24)
-    mca_var.set_value("coll", "tuned")
-    try:
-        out = world.reduce_scatter_block(x, ops.SUM)
-    finally:
-        mca_var.VARS.unset("coll")
+def test_reduce_scatter_ring_parity(tuned):
+    n = tuned.size
+    x = _per_rank(tuned, n * 25, seed=24)
+    out = tuned.reduce_scatter_block(x, ops.SUM)
+    assert ("tuned", "reduce_scatter_block", "sum") in tuned._coll_programs
     full = x.sum(axis=0)
     for r in range(n):
         np.testing.assert_allclose(
-            np.asarray(out[r]), full[r * 25:(r + 1) * 25], rtol=2e-5
+            np.asarray(out[r]), full[r * 25:(r + 1) * 25], rtol=2e-5,
+            atol=1e-4,
         )
 
 
@@ -181,14 +194,24 @@ def test_alltoall(world):
     )
 
 
-def test_alltoall_pairwise(world):
-    n = world.size
-    x = _per_rank(world, n * 4, dtype=np.int32, seed=31)
-    mca_var.set_value("coll", "tuned")
+def test_alltoall_pairwise(tuned):
+    n = tuned.size
+    x = _per_rank(tuned, n * 4, dtype=np.int32, seed=31)
+    out = tuned.alltoall(x)
+    assert ("tuned", "alltoall", "pairwise") in tuned._coll_programs
+    expect = x.reshape(n, n, 4).transpose(1, 0, 2).reshape(n, -1)
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_alltoall_lax_forced(tuned):
+    n = tuned.size
+    x = _per_rank(tuned, n * 4, dtype=np.int32, seed=32)
+    mca_var.set_value("coll_tuned_alltoall_algorithm", "lax")
     try:
-        out = world.alltoall(x)
+        out = tuned.alltoall(x)
     finally:
-        mca_var.VARS.unset("coll")
+        mca_var.VARS.unset("coll_tuned_alltoall_algorithm")
+    assert ("tuned", "alltoall", "lax") in tuned._coll_programs
     expect = x.reshape(n, n, 4).transpose(1, 0, 2).reshape(n, -1)
     np.testing.assert_array_equal(np.asarray(out), expect)
 
@@ -206,13 +229,10 @@ def test_scan_exscan(world):
     )
 
 
-def test_scan_tuned(world):
-    x = _per_rank(world, 20, seed=38)
-    mca_var.set_value("coll", "tuned")
-    try:
-        out = world.scan(x, ops.SUM)
-    finally:
-        mca_var.VARS.unset("coll")
+def test_scan_tuned(tuned):
+    x = _per_rank(tuned, 20, seed=38)
+    out = tuned.scan(x, ops.SUM)
+    assert ("tuned", "scan", "sum") in tuned._coll_programs
     np.testing.assert_allclose(
         np.asarray(out), np.cumsum(x, axis=0), rtol=2e-5
     )
@@ -258,16 +278,18 @@ def test_decision_rules(world):
     assert m._pick_allreduce(mid, noncommut) == "nonoverlapping"
 
 
-def test_bitwise_parity_ring_vs_linear(world):
+def test_bitwise_parity_ring_vs_linear(tuned):
     """SURVEY §6 hard part: fixed per-algorithm reduction order means
     the same algorithm must be bitwise-reproducible run to run."""
-    x = _per_rank(world, 4096, seed=43)
+    x = _per_rank(tuned, 4096, seed=43)
     mca_var.set_value("coll_tuned_allreduce_algorithm", "ring")
-    mca_var.set_value("coll", "tuned")
     try:
-        a = np.asarray(world.allreduce(x, ops.SUM))
-        b = np.asarray(world.allreduce(jnp.asarray(x), ops.SUM))
+        a = np.asarray(tuned.allreduce(x, ops.SUM))
+        b = np.asarray(tuned.allreduce(jnp.asarray(x), ops.SUM))
     finally:
         mca_var.VARS.unset("coll_tuned_allreduce_algorithm")
-        mca_var.VARS.unset("coll")
+    assert any(
+        k[:3] == ("tuned", "allreduce", "ring")
+        for k in tuned._coll_programs
+    )
     np.testing.assert_array_equal(a, b)  # bitwise
