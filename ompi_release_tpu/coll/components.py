@@ -317,6 +317,14 @@ class _TunedModule:
         alg = mca_var.get("coll_tuned_alltoall_algorithm", "auto")
         if alg == "auto":
             alg = "pairwise"
+        if alg not in ("pairwise", "lax"):
+            from ..utils.errors import ErrorCode, MPIError
+
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"unknown alltoall algorithm '{alg}' "
+                f"(choices: {ALLTOALL_ALGORITHMS})",
+            )
         n = comm.size
         fn = spmd.alltoall_lax if alg == "lax" else spmd.alltoall_pairwise
 

@@ -234,6 +234,74 @@ class Communicator:
             f"MPI_Abort on {self.name} with errorcode {errorcode}"
         )
 
+    # -- point-to-point (dispatched through the selected PML engine) -------
+    @property
+    def pml(self):
+        """Per-comm PML engine, installed on first use
+        (mca_pml_base_select analogue)."""
+        eng = getattr(self, "_pml", None)
+        if eng is None:
+            self._check_alive()
+            from ..p2p import pml as pml_mod
+
+            eng = pml_mod.comm_select(self)
+            self._pml = eng
+        return eng
+
+    def isend(self, data, dest: int, tag: int = 0, *, rank: int, **kw):
+        """Nonblocking send issued by ``rank`` (driver mode: the acting
+        rank is explicit because one controller plays every rank)."""
+        self._check_alive()
+        return self.pml.isend(data, dest, tag, src=rank, **kw)
+
+    def send(self, data, dest: int, tag: int = 0, *, rank: int, **kw):
+        self._check_alive()
+        return self.pml.send(data, dest, tag, src=rank, **kw)
+
+    def irecv(self, source: int = -1, tag: int = -1, *, rank: int):
+        self._check_alive()
+        return self.pml.irecv(source, tag, dst=rank)
+
+    def recv(self, source: int = -1, tag: int = -1, *, rank: int):
+        self._check_alive()
+        return self.pml.recv(source, tag, dst=rank)
+
+    def iprobe(self, source: int = -1, tag: int = -1, *, rank: int):
+        self._check_alive()
+        return self.pml.iprobe(source, tag, dst=rank)
+
+    def sendrecv(self, sendbufs, dests, sendtag: int = 0,
+                 sources=None, recvtag: int = -1):
+        """MPI_Sendrecv, driver mode: EVERY rank's exchange in one call
+        (like split's per-rank vectors) — all sends post first, then
+        all recvs complete, which is what makes it deadlock-free. A
+        per-rank blocking sendrecv cannot work under a single
+        controller: rank 0's recv would block before rank 1 ever ran.
+
+        sendbufs/dests (and optional sources): sequences of length
+        ``size``. Returns (values, statuses) lists.
+        """
+        self._check_alive()
+        n = self.size
+        if len(sendbufs) != n or len(dests) != n:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"sendrecv needs {n} sendbufs/dests (one per rank)",
+            )
+        sreqs = [
+            self.pml.isend(sendbufs[r], dests[r], sendtag, src=r)
+            for r in range(n)
+        ]
+        values, statuses = [], []
+        for r in range(n):
+            src = sources[r] if sources is not None else -1
+            v, st = self.pml.recv(src, recvtag, dst=r)
+            values.append(v)
+            statuses.append(st)
+        for sr in sreqs:
+            sr.wait()
+        return values, statuses
+
     # -- collectives (dispatch through the installed c_coll table) ---------
     def _coll(self, op_name: str) -> Callable:
         self._check_alive()
@@ -289,6 +357,63 @@ class Communicator:
 
     def barrier(self) -> None:
         self._coll("barrier")(self)
+
+    # -- nonblocking collectives (libnbc analogue) -------------------------
+    # XLA dispatch is already asynchronous: invoking the compiled
+    # collective returns immediately with arrays that are futures, so a
+    # nonblocking collective is the blocking call's result wrapped in a
+    # Request whose readiness is the arrays' readiness (the libnbc
+    # round-schedule becomes the compiled program itself).
+    def _async(self, value):
+        import jax
+
+        from ..request.request import Request
+
+        arrs = [a for a in jax.tree.leaves(value) if hasattr(a, "is_ready")]
+        req = Request(
+            ready_fn=lambda: all(a.is_ready() for a in arrs),
+            block_fn=lambda: jax.block_until_ready(value),
+        )
+        req.value = value
+        return req
+
+    def iallreduce(self, x, op=None, **kw):
+        return self._async(self.allreduce(x, op, **kw))
+
+    def ireduce(self, x, op=None, root: int = 0, **kw):
+        return self._async(self.reduce(x, op, root, **kw))
+
+    def ibcast(self, x, root: int = 0, **kw):
+        return self._async(self.bcast(x, root, **kw))
+
+    def iallgather(self, x, **kw):
+        return self._async(self.allgather(x, **kw))
+
+    def igather(self, x, root: int = 0, **kw):
+        return self._async(self.gather(x, root, **kw))
+
+    def iscatter(self, x, root: int = 0, **kw):
+        return self._async(self.scatter(x, root, **kw))
+
+    def ireduce_scatter_block(self, x, op=None, **kw):
+        return self._async(self.reduce_scatter_block(x, op, **kw))
+
+    def ialltoall(self, x, **kw):
+        return self._async(self.alltoall(x, **kw))
+
+    def iscan(self, x, op=None, **kw):
+        return self._async(self.scan(x, op, **kw))
+
+    def iexscan(self, x, op=None, **kw):
+        return self._async(self.exscan(x, op, **kw))
+
+    def ibarrier(self):
+        from ..request.request import Request
+
+        req = Request(ready_fn=lambda: True, block_fn=lambda: None)
+        self.barrier()
+        req.complete()
+        return req
 
     def __repr__(self) -> str:
         return (

@@ -1,0 +1,5 @@
+"""One-sided communication (RMA) — the ``ompi/mca/osc`` analogue."""
+
+from .window import (  # noqa: F401
+    Window, win_create, win_allocate, LOCK_EXCLUSIVE, LOCK_SHARED,
+)

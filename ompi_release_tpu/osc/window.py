@@ -1,0 +1,283 @@
+"""MPI_Win windows over device buffers.
+
+The reference's osc framework (``ompi/mca/osc/osc.h:205-338``: put/get/
+accumulate/CAS/fetch-op + fence/PSCW/lock epochs, ``osc/rdma`` data
+movement) recast for a single-controller device mesh:
+
+- A window is a device-resident array with a leading rank axis — slice
+  i lives in rank i's HBM (NamedSharding over the comm's sub-mesh), the
+  MPI_Win_allocate memory model.
+- RMA calls during an epoch queue; closing the epoch (fence, unlock,
+  complete, flush) applies them in submission order as ONE jitted
+  sharded program per epoch — the MPI completion rule ("RMA completes
+  at synchronization") is the natural XLA execution model, and the
+  epoch batch is the osc/rdma "aggregate and issue at sync" strategy.
+- get/get_accumulate/fetch_and_op/compare_and_swap return Requests
+  whose values materialize at epoch close.
+
+Epoch rules enforced (``ompi/win/win.c`` access-epoch checks): RMA
+outside any epoch raises; fence/lock/PSCW cannot be mixed.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..mca import pvar
+from ..ops.op import Op, REPLACE, NO_OP, SUM
+from ..request.request import Request, Status
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+
+_log = output.stream("osc")
+
+_epoch_count = pvar.counter("osc_epochs", "RMA epochs closed")
+_rma_ops = pvar.counter("osc_rma_ops", "RMA operations issued")
+
+LOCK_EXCLUSIVE = 1
+LOCK_SHARED = 2
+
+
+class _EpochKind(enum.Enum):
+    NONE = "none"
+    FENCE = "fence"
+    LOCK = "lock"
+    PSCW = "pscw"
+
+
+class _PendingOp:
+    __slots__ = ("kind", "target", "data", "op", "request", "compare")
+
+    def __init__(self, kind, target, data=None, op=None, request=None,
+                 compare=None) -> None:
+        self.kind = kind
+        self.target = target
+        self.data = data
+        self.op = op
+        self.request = request
+        self.compare = compare
+
+
+class Window:
+    def __init__(self, comm, base: jax.Array, name: str = "") -> None:
+        if base.shape[0] != comm.size:
+            raise MPIError(
+                ErrorCode.ERR_WIN,
+                f"window base leading axis {base.shape[0]} != comm size "
+                f"{comm.size}",
+            )
+        self.comm = comm
+        self.name = name or f"win{id(self):x}"
+        self._shard = NamedSharding(comm.submesh, P("rank"))
+        self._data = jax.device_put(jnp.asarray(base), self._shard)
+        self._epoch = _EpochKind.NONE
+        self._locked: Dict[int, int] = {}  # target -> lock type
+        self._pending: List[_PendingOp] = []
+        self._lock = threading.RLock()
+        self._group_exposed = None  # PSCW exposure group
+        self._freed = False
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape[1:])
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    def read(self) -> jax.Array:
+        """Local loads of the whole window (valid outside access epochs
+        or after a flush; driver mode sees every rank's slice)."""
+        return self._data
+
+    # -- epoch state machine ----------------------------------------------
+    def _require(self, *kinds: _EpochKind) -> None:
+        if self._freed:
+            raise MPIError(ErrorCode.ERR_WIN, f"{self.name} freed")
+        if self._epoch not in kinds:
+            raise MPIError(
+                ErrorCode.ERR_RMA_SYNC,
+                f"operation requires epoch {[k.value for k in kinds]}, "
+                f"window is in '{self._epoch.value}'",
+            )
+
+    def fence(self) -> None:
+        """Open/continue a fence epoch; applies queued ops (MPI fence
+        both closes the previous access epoch and opens the next)."""
+        self._require(_EpochKind.NONE, _EpochKind.FENCE)
+        self._apply_pending()
+        self._epoch = _EpochKind.FENCE
+        self.comm.barrier()
+
+    def fence_end(self) -> None:
+        """Final fence (MPI_MODE_NOSUCCEED): close the epoch."""
+        self._require(_EpochKind.FENCE)
+        self._apply_pending()
+        self._epoch = _EpochKind.NONE
+        self.comm.barrier()
+
+    def lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
+        self._require(_EpochKind.NONE, _EpochKind.LOCK)
+        if target in self._locked:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           f"target {target} already locked")
+        self._locked[target] = lock_type
+        self._epoch = _EpochKind.LOCK
+
+    def lock_all(self) -> None:
+        self._require(_EpochKind.NONE)
+        for t in range(self.comm.size):
+            self._locked[t] = LOCK_SHARED
+        self._epoch = _EpochKind.LOCK
+
+    def unlock(self, target: int) -> None:
+        self._require(_EpochKind.LOCK)
+        if target not in self._locked:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           f"target {target} not locked")
+        self._apply_pending(only_target=target)
+        del self._locked[target]
+        if not self._locked:
+            self._epoch = _EpochKind.NONE
+
+    def unlock_all(self) -> None:
+        self._require(_EpochKind.LOCK)
+        self._apply_pending()
+        self._locked.clear()
+        self._epoch = _EpochKind.NONE
+
+    def flush(self, target: int) -> None:
+        """Complete pending ops to one target inside a passive epoch."""
+        self._require(_EpochKind.LOCK)
+        self._apply_pending(only_target=target)
+
+    def flush_all(self) -> None:
+        self._require(_EpochKind.LOCK)
+        self._apply_pending()
+
+    # PSCW (generalized active target)
+    def post(self, group) -> None:
+        """Exposure epoch: this window's slices may be targeted by the
+        ranks of ``group`` (driver mode keeps one state machine)."""
+        self._require(_EpochKind.NONE)
+        self._group_exposed = group
+        self._epoch = _EpochKind.PSCW
+
+    def start(self, group) -> None:
+        self._require(_EpochKind.NONE, _EpochKind.PSCW)
+        self._epoch = _EpochKind.PSCW
+
+    def complete(self) -> None:
+        self._require(_EpochKind.PSCW)
+        self._apply_pending()
+        self._epoch = _EpochKind.NONE
+        self._group_exposed = None
+
+    def wait(self) -> None:
+        self.complete()
+
+    def free(self) -> None:
+        if self._pending:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           "free with unsynchronized RMA operations")
+        self._freed = True
+
+    # -- RMA operations ----------------------------------------------------
+    def _queue(self, op: _PendingOp) -> Optional[Request]:
+        self._require(_EpochKind.FENCE, _EpochKind.LOCK, _EpochKind.PSCW)
+        if (self._epoch is _EpochKind.LOCK
+                and op.target not in self._locked):
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           f"target {op.target} not locked")
+        if not 0 <= op.target < self.comm.size:
+            raise MPIError(ErrorCode.ERR_RANK,
+                           f"RMA target {op.target} out of range")
+        _rma_ops.add()
+        self._pending.append(op)
+        return op.request
+
+    def put(self, data, target: int) -> None:
+        self._queue(_PendingOp("put", target, jnp.asarray(data), REPLACE))
+
+    def get(self, target: int) -> Request:
+        req = Request()
+        self._queue(_PendingOp("get", target, request=req))
+        return req
+
+    def accumulate(self, data, target: int, op: Op = SUM) -> None:
+        self._queue(_PendingOp("acc", target, jnp.asarray(data), op))
+
+    def get_accumulate(self, data, target: int, op: Op = SUM) -> Request:
+        req = Request()
+        self._queue(
+            _PendingOp("get_acc", target, jnp.asarray(data), op, req)
+        )
+        return req
+
+    def fetch_and_op(self, value, target: int, op: Op = SUM) -> Request:
+        return self.get_accumulate(value, target, op)
+
+    def compare_and_swap(self, value, compare, target: int) -> Request:
+        req = Request()
+        self._queue(
+            _PendingOp("cas", target, jnp.asarray(value), None, req,
+                       compare=jnp.asarray(compare))
+        )
+        return req
+
+    # -- application -------------------------------------------------------
+    def _apply_pending(self, only_target: Optional[int] = None) -> None:
+        """Apply queued ops in submission order (MPI same-origin
+        ordering); driver mode's single queue is globally ordered."""
+        if not self._pending:
+            return
+        _epoch_count.add()
+        todo = [p for p in self._pending
+                if only_target is None or p.target == only_target]
+        self._pending = [p for p in self._pending if p not in todo]
+        data = self._data
+        for p in todo:
+            if p.kind == "put":
+                data = data.at[p.target].set(p.data.astype(data.dtype))
+            elif p.kind == "get":
+                p.request.complete(value=data[p.target],
+                                   status=Status(source=p.target))
+            elif p.kind in ("acc", "get_acc"):
+                cur = data[p.target]
+                if p.kind == "get_acc":
+                    p.request.complete(value=cur,
+                                       status=Status(source=p.target))
+                new = p.op(cur, p.data.astype(data.dtype))
+                data = data.at[p.target].set(new)
+            elif p.kind == "cas":
+                cur = data[p.target]
+                p.request.complete(value=cur,
+                                   status=Status(source=p.target))
+                new = jnp.where(cur == p.compare.astype(data.dtype),
+                                p.data.astype(data.dtype), cur)
+                data = data.at[p.target].set(new)
+        self._data = data
+
+
+def win_create(comm, base, name: str = "") -> Window:
+    """MPI_Win_create: wrap existing per-rank buffers (leading rank
+    axis)."""
+    return Window(comm, jnp.asarray(base), name)
+
+
+def win_allocate(comm, shape: Tuple[int, ...], dtype=jnp.float32,
+                 name: str = "") -> Window:
+    """MPI_Win_allocate: fresh zeroed window, one ``shape`` block per
+    rank."""
+    return Window(
+        comm, jnp.zeros((comm.size,) + tuple(shape), dtype), name
+    )

@@ -12,7 +12,7 @@ bucket compiles to one fused ICI collective.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,13 +30,16 @@ def register_vars() -> None:
 
 
 def allreduce_gradients(grads: Any, axis_name: str, *, mean: bool = True,
-                        bucket_bytes: int = 4 * 1024 * 1024) -> Any:
+                        bucket_bytes: Optional[int] = None) -> Any:
     """Allreduce a gradient pytree over the dp axis.
 
-    Leaves smaller than ``bucket_bytes`` are packed into flat buckets so
-    each bucket is ONE psum; large leaves go through psum individually
-    (XLA already tiles/pipelines a single large collective well).
+    Leaves smaller than ``bucket_bytes`` (default: the dp_bucket_bytes
+    config variable) are packed into flat buckets so each bucket is ONE
+    psum; large leaves go through psum individually (XLA already
+    tiles/pipelines a single large collective well).
     """
+    if bucket_bytes is None:
+        bucket_bytes = mca_var.get("dp_bucket_bytes", 4 * 1024 * 1024)
     leaves, treedef = jax.tree.flatten(grads)
     n = lax.psum(1, axis_name)
 

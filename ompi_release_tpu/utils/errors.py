@@ -37,6 +37,7 @@ class ErrorCode(enum.IntEnum):
     ERR_IN_STATUS = 18
     ERR_PENDING = 19
     ERR_WIN = 45
+    ERR_RMA_SYNC = 50
     ERR_BASE = 46
     ERR_DISP = 52
     ERR_IO = 32

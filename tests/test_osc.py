@@ -1,0 +1,158 @@
+"""One-sided/RMA window tests (osc analogue)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu import ops
+from ompi_release_tpu.osc import (
+    LOCK_EXCLUSIVE, Window, win_allocate, win_create,
+)
+from ompi_release_tpu.utils.errors import MPIError
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+@pytest.fixture()
+def win(world):
+    w = win_allocate(world, (4,), jnp.float32)
+    yield w
+    if w._epoch.name != "NONE":
+        pytest.fail("test left an open epoch")
+    w.free()
+
+
+class TestFenceEpochs:
+    def test_put_get_fence(self, world, win):
+        win.fence()
+        win.put(np.full(4, 7.0, np.float32), target=3)
+        g = win.get(target=3)
+        assert not g.is_complete  # completes at the closing fence
+        win.fence()
+        np.testing.assert_array_equal(np.asarray(g.value), np.full(4, 7.0))
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[3], np.full(4, 7.0)
+        )
+        win.fence_end()
+
+    def test_rma_outside_epoch_raises(self, win):
+        with pytest.raises(MPIError):
+            win.put(np.zeros(4, np.float32), target=0)
+
+    def test_ordering_put_then_get(self, world, win):
+        """Same-epoch ordering: get sees the preceding put (MPI
+        same-origin ordering for overlapping ops)."""
+        win.fence()
+        win.put(np.full(4, 1.0, np.float32), target=0)
+        g1 = win.get(target=0)
+        win.put(np.full(4, 2.0, np.float32), target=0)
+        g2 = win.get(target=0)
+        win.fence_end()
+        np.testing.assert_array_equal(np.asarray(g1.value), np.full(4, 1.0))
+        np.testing.assert_array_equal(np.asarray(g2.value), np.full(4, 2.0))
+
+    def test_accumulate_sum_and_max(self, world, win):
+        win.fence()
+        for t in (1, 1, 2):
+            win.accumulate(np.full(4, 2.0, np.float32), target=t, op=ops.SUM)
+        win.accumulate(np.full(4, -5.0, np.float32), target=2, op=ops.MAX)
+        win.fence_end()
+        out = np.asarray(win.read())
+        np.testing.assert_array_equal(out[1], np.full(4, 4.0))
+        np.testing.assert_array_equal(out[2], np.full(4, 2.0))  # max(2,-5)
+
+
+class TestPassiveTarget:
+    def test_lock_unlock(self, world, win):
+        win.lock(2, LOCK_EXCLUSIVE)
+        win.put(np.full(4, 9.0, np.float32), target=2)
+        win.unlock(2)
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[2], np.full(4, 9.0)
+        )
+
+    def test_lock_required_for_target(self, win):
+        win.lock(1)
+        with pytest.raises(MPIError):
+            win.put(np.zeros(4, np.float32), target=3)  # not locked
+        win.unlock(1)
+
+    def test_lock_all_flush(self, world, win):
+        win.lock_all()
+        win.accumulate(np.ones(4, np.float32), target=0)
+        win.flush(0)
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[0], np.ones(4)
+        )
+        win.accumulate(np.ones(4, np.float32), target=0)
+        win.unlock_all()
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[0], np.full(4, 2.0)
+        )
+
+    def test_fetch_and_op(self, world, win):
+        win.lock(5)
+        f = win.fetch_and_op(np.full(4, 3.0, np.float32), target=5, op=ops.SUM)
+        win.unlock(5)
+        np.testing.assert_array_equal(np.asarray(f.value), np.zeros(4))
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[5], np.full(4, 3.0)
+        )
+
+    def test_compare_and_swap(self, world, win):
+        win.lock(4)
+        win.put(np.full(4, 1.0, np.float32), target=4)
+        win.flush(4)
+        c = win.compare_and_swap(
+            np.full(4, 8.0, np.float32), compare=np.full(4, 1.0, np.float32),
+            target=4,
+        )
+        win.unlock(4)
+        np.testing.assert_array_equal(np.asarray(c.value), np.full(4, 1.0))
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[4], np.full(4, 8.0)
+        )
+
+
+class TestPSCW:
+    def test_post_start_complete(self, world, win):
+        win.post(world.group)
+        win.start(world.group)
+        win.put(np.full(4, 6.0, np.float32), target=1)
+        win.complete()
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[1], np.full(4, 6.0)
+        )
+
+
+class TestCreate:
+    def test_win_create_from_existing(self, world):
+        base = np.arange(world.size * 2, dtype=np.float32).reshape(
+            world.size, 2
+        )
+        w = win_create(world, base)
+        w.fence()
+        g = w.get(target=world.size - 1)
+        w.fence_end()
+        np.testing.assert_array_equal(
+            np.asarray(g.value), base[world.size - 1]
+        )
+        w.free()
+
+    def test_bad_shape_raises(self, world):
+        with pytest.raises(MPIError):
+            win_create(world, np.zeros((world.size + 1, 3), np.float32))
+
+    def test_free_with_pending_raises(self, world):
+        w = win_allocate(world, (2,), jnp.float32)
+        w.fence()
+        w.put(np.ones(2, np.float32), target=0)
+        with pytest.raises(MPIError):
+            w.free()
+        w.fence_end()
+        w.free()
