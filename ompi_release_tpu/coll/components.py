@@ -317,7 +317,7 @@ class _TunedModule:
         alg = mca_var.get("coll_tuned_alltoall_algorithm", "auto")
         if alg == "auto":
             alg = "pairwise"
-        if alg not in ("pairwise", "lax"):
+        if alg not in ALLTOALL_ALGORITHMS:
             from ..utils.errors import ErrorCode, MPIError
 
             raise MPIError(

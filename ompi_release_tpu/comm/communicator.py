@@ -283,10 +283,12 @@ class Communicator:
         """
         self._check_alive()
         n = self.size
-        if len(sendbufs) != n or len(dests) != n:
+        if (len(sendbufs) != n or len(dests) != n
+                or (sources is not None and len(sources) != n)):
             raise MPIError(
                 ErrorCode.ERR_ARG,
-                f"sendrecv needs {n} sendbufs/dests (one per rank)",
+                f"sendrecv needs {n} sendbufs/dests/sources "
+                "(one per rank)",
             )
         sreqs = [
             self.pml.isend(sendbufs[r], dests[r], sendtag, src=r)

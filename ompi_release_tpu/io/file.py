@@ -1,0 +1,146 @@
+"""MPI_File API over host files — the ompio surface.
+
+The surface of ``ompi/mca/io`` (open/close/read_at/write_at/
+read_all/write_all/shared pointer/set_view) with ompio's component
+split honored in miniature: fs = python file open/close per rank
+handle, fbtl = individual pread/pwrite at explicit offsets, fcoll =
+collective write_all/read_all where every rank's block lands at its
+view offset (the two-phase exchange is unnecessary when each "rank"
+writes a disjoint contiguous extent — the driver already holds the
+aggregated blocks), sharedfp = an ordered shared file pointer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils.errors import ErrorCode, MPIError
+
+MODE_RDONLY = os.O_RDONLY
+MODE_WRONLY = os.O_WRONLY
+MODE_RDWR = os.O_RDWR
+MODE_CREATE = os.O_CREAT
+
+
+class File:
+    """MPI_File analogue bound to a communicator."""
+
+    def __init__(self, comm, path: str,
+                 mode: int = MODE_RDWR | MODE_CREATE) -> None:
+        self.comm = comm
+        self.path = path
+        try:
+            self._fd = os.open(path, mode, 0o644)
+        except OSError as e:
+            raise MPIError(ErrorCode.ERR_FILE, f"open {path}: {e}")
+        self._lock = threading.Lock()
+        self._shared_ptr = 0  # sharedfp analogue
+        # view: (displacement bytes, elementary dtype)
+        self._disp = 0
+        self._etype = np.dtype(np.uint8)
+        self._closed = False
+
+    # -- view (MPI_File_set_view) -----------------------------------------
+    def set_view(self, disp: int = 0, etype=np.uint8) -> None:
+        self._disp = int(disp)
+        self._etype = np.dtype(etype)
+
+    def _byte_offset(self, offset_elems: int) -> int:
+        return self._disp + offset_elems * self._etype.itemsize
+
+    def _check(self) -> None:
+        if self._closed:
+            raise MPIError(ErrorCode.ERR_FILE, f"{self.path} closed")
+
+    # -- individual (fbtl) -------------------------------------------------
+    def write_at(self, offset: int, data) -> int:
+        """pwrite at an element offset in the current view."""
+        self._check()
+        buf = np.ascontiguousarray(np.asarray(data, self._etype))
+        n = os.pwrite(self._fd, buf.tobytes(), self._byte_offset(offset))
+        return n // self._etype.itemsize
+
+    def read_at(self, offset: int, count: int) -> np.ndarray:
+        self._check()
+        raw = os.pread(
+            self._fd, count * self._etype.itemsize,
+            self._byte_offset(offset),
+        )
+        return np.frombuffer(raw, self._etype).copy()
+
+    # -- collective (fcoll) ------------------------------------------------
+    def write_at_all(self, offsets, blocks) -> int:
+        """Collective write: rank i's block at element offset i
+        (driver mode: per-rank lists). Disjoint contiguous extents per
+        rank = the post-aggregation phase of fcoll/two_phase."""
+        self._check()
+        if len(offsets) != self.comm.size or len(blocks) != self.comm.size:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"need {self.comm.size} offsets/blocks (one per rank)",
+            )
+        total = 0
+        for off, blk in zip(offsets, blocks):
+            total += self.write_at(off, blk)
+        self.comm.barrier()
+        return total
+
+    def read_at_all(self, offsets, counts):
+        self._check()
+        out = [self.read_at(o, c) for o, c in zip(offsets, counts)]
+        self.comm.barrier()
+        return out
+
+    # -- shared file pointer (sharedfp) ------------------------------------
+    def write_ordered(self, blocks) -> None:
+        """Rank-ordered append at the shared pointer (sharedfp
+        'ordered' semantics)."""
+        self._check()
+        with self._lock:
+            for blk in blocks:
+                buf = np.ascontiguousarray(np.asarray(blk, self._etype))
+                os.pwrite(self._fd, buf.tobytes(),
+                          self._byte_offset(self._shared_ptr))
+                self._shared_ptr += buf.size
+
+    def read_shared(self, count: int) -> np.ndarray:
+        self._check()
+        with self._lock:
+            out = self.read_at(self._shared_ptr, count)
+            self._shared_ptr += count
+        return out
+
+    # -- admin -------------------------------------------------------------
+    def size(self) -> int:
+        self._check()
+        return os.fstat(self._fd).st_size
+
+    def preallocate(self, nbytes: int) -> None:
+        self._check()
+        os.ftruncate(self._fd, nbytes)
+
+    def sync(self) -> None:
+        self._check()
+        os.fsync(self._fd)
+
+    def close(self) -> None:
+        if not self._closed:
+            os.close(self._fd)
+            self._closed = True
+
+    @staticmethod
+    def delete(path: str) -> None:
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+
+    def __enter__(self) -> "File":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

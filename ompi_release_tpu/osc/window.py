@@ -22,17 +22,14 @@ outside any epoch raises; fence/lock/PSCW cannot be mixed.
 from __future__ import annotations
 
 import enum
-import threading
-from typing import Any, Dict, List, Optional, Tuple
-
-import numpy as np
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..mca import pvar
-from ..ops.op import Op, REPLACE, NO_OP, SUM
+from ..ops.op import Op, REPLACE, SUM
 from ..request.request import Request, Status
 from ..utils import output
 from ..utils.errors import ErrorCode, MPIError
@@ -81,7 +78,6 @@ class Window:
         self._epoch = _EpochKind.NONE
         self._locked: Dict[int, int] = {}  # target -> lock type
         self._pending: List[_PendingOp] = []
-        self._lock = threading.RLock()
         self._group_exposed = None  # PSCW exposure group
         self._freed = False
 
@@ -177,13 +173,23 @@ class Window:
         self._epoch = _EpochKind.PSCW
 
     def complete(self) -> None:
+        """Close the access side of a PSCW epoch (MPI_Win_complete)."""
         self._require(_EpochKind.PSCW)
         self._apply_pending()
         self._epoch = _EpochKind.NONE
-        self._group_exposed = None
 
     def wait(self) -> None:
-        self.complete()
+        """Close the exposure side (MPI_Win_wait). The single driver
+        state machine conflates access/exposure, so wait() after the
+        origin's complete() must succeed — it applies anything still
+        pending and clears the exposure group."""
+        if self._epoch is _EpochKind.PSCW:
+            self._apply_pending()
+            self._epoch = _EpochKind.NONE
+        elif self._group_exposed is None:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           "wait() without a matching post()")
+        self._group_exposed = None
 
     def free(self) -> None:
         if self._pending:
@@ -241,9 +247,13 @@ class Window:
         if not self._pending:
             return
         _epoch_count.add()
-        todo = [p for p in self._pending
-                if only_target is None or p.target == only_target]
-        self._pending = [p for p in self._pending if p not in todo]
+        if only_target is None:
+            todo, self._pending = self._pending, []
+        else:
+            todo = [p for p in self._pending if p.target == only_target]
+            self._pending = [
+                p for p in self._pending if p.target != only_target
+            ]
         data = self._data
         for p in todo:
             if p.kind == "put":

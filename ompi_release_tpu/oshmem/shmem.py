@@ -1,0 +1,199 @@
+"""OpenSHMEM — symmetric heap + put/get/AMO + collectives.
+
+The reference's OSHMEM stack (SURVEY §1.4): ``memheap`` (symmetric
+heap over ``sshmem`` segments), ``spml`` (put/get over the OMPI BTLs —
+``spml/yoda``), ``atomic`` (AMOs), ``scoll`` (collectives, including
+the delegate-to-MPI ``scoll/mpi`` component). TPU-native recast:
+
+- The symmetric heap is per-PE HBM: a symmetric allocation is one
+  device array with a leading PE axis (slice i in PE i's HBM) — the
+  same "address" (python handle) is valid for every PE, which is the
+  whole symmetric-heap contract (``oshmem/mca/memheap``).
+- put/get queue onto the underlying RMA window machinery (the spml →
+  BTL path, here spml → osc) and complete at ``quiet``/``barrier_all``
+  — OpenSHMEM's own completion rule. Fetch AMOs and get are blocking
+  (they flush), put/add are posted.
+- scoll delegates to the coll framework over the same communicator
+  (exactly what ``scoll/mpi`` does to OMPI).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops as ops_mod
+from ..mca import pvar
+from ..osc.window import Window
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+
+_log = output.stream("shmem")
+
+_heap_bytes = pvar.highwatermark(
+    "shmem_heap_bytes", "symmetric heap bytes allocated"
+)
+
+
+class SymmetricArray:
+    """One symmetric allocation: ``shape`` per PE, PE i's block in PE
+    i's HBM. The handle itself is the symmetric address."""
+
+    def __init__(self, ctx: "ShmemCtx", win: Window) -> None:
+        self._ctx = ctx
+        self._win = win
+        win.lock_all()  # SHMEM has no epochs: one standing passive epoch
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._win.shape
+
+    @property
+    def dtype(self):
+        return self._win.dtype
+
+    def local(self, pe: int) -> jax.Array:
+        """PE ``pe``'s local view (shmem_ptr analogue; driver mode sees
+        every PE)."""
+        self._win.flush_all()
+        return self._win.read()[pe]
+
+    def free(self) -> None:
+        self._win.unlock_all()
+        self._win.free()
+        self._ctx._allocs.discard(self)
+
+
+class ShmemCtx:
+    """The OpenSHMEM world (``shmem_init`` state)."""
+
+    def __init__(self, comm) -> None:
+        self.comm = comm
+        self._allocs: set = set()
+
+    # -- setup / query (shmem.h accessors) ---------------------------------
+    @property
+    def n_pes(self) -> int:
+        return self.comm.size
+
+    def malloc(self, shape: Tuple[int, ...], dtype=jnp.float32
+               ) -> SymmetricArray:
+        """shmem_malloc: symmetric allocation (memheap analogue)."""
+        from ..osc.window import win_allocate
+
+        win = win_allocate(self.comm, tuple(shape), dtype)
+        arr = SymmetricArray(self, win)
+        self._allocs.add(arr)
+        _heap_bytes.add(
+            int(np.prod(shape)) * jnp.dtype(dtype).itemsize * self.n_pes
+        )
+        return arr
+
+    # -- data movement (spml put/get) --------------------------------------
+    def put(self, sym: SymmetricArray, data, pe: int) -> None:
+        """shmem_put: posted; completes at quiet/barrier_all."""
+        sym._win.put(jnp.asarray(data), pe)
+
+    def get(self, sym: SymmetricArray, pe: int) -> jax.Array:
+        """shmem_get: blocking (flushes pending ops first)."""
+        sym._win.flush_all()
+        req = sym._win.get(pe)
+        sym._win.flush_all()
+        return req.value
+
+    def put_elem(self, sym: SymmetricArray, value, index, pe: int) -> None:
+        """Scalar/sub-array put at a flat index (shmem_p)."""
+        cur_shape = sym.shape
+        data = self.get(sym, pe)
+        flat = data.reshape(-1).at[index].set(value)
+        sym._win.put(flat.reshape(cur_shape), pe)
+
+    # -- atomics (oshmem/mca/atomic) ---------------------------------------
+    def atomic_add(self, sym: SymmetricArray, value, pe: int) -> None:
+        sym._win.accumulate(jnp.asarray(value), pe, op=ops_mod.SUM)
+
+    def atomic_fetch_add(self, sym: SymmetricArray, value, pe: int
+                         ) -> jax.Array:
+        req = sym._win.fetch_and_op(jnp.asarray(value), pe, op=ops_mod.SUM)
+        sym._win.flush(pe)
+        return req.value
+
+    def atomic_swap(self, sym: SymmetricArray, value, pe: int) -> jax.Array:
+        req = sym._win.fetch_and_op(jnp.asarray(value), pe,
+                                    op=ops_mod.REPLACE)
+        sym._win.flush(pe)
+        return req.value
+
+    def atomic_compare_swap(self, sym: SymmetricArray, cond, value, pe: int
+                            ) -> jax.Array:
+        req = sym._win.compare_and_swap(jnp.asarray(value),
+                                        jnp.asarray(cond), pe)
+        sym._win.flush(pe)
+        return req.value
+
+    # -- ordering (shmem_quiet / shmem_fence) ------------------------------
+    def quiet(self) -> None:
+        """Complete all outstanding puts/AMOs (shmem_quiet)."""
+        for a in self._allocs:
+            a._win.flush_all()
+
+    def fence(self) -> None:
+        """Ordering only; driver mode applies in submission order, so
+        fence == quiet here (stronger is allowed)."""
+        self.quiet()
+
+    def barrier_all(self) -> None:
+        self.quiet()
+        self.comm.barrier()
+
+    # -- collectives (scoll -> coll framework, the scoll/mpi path) ---------
+    def broadcast(self, x, root: int = 0):
+        return self.comm.bcast(x, root=root)
+
+    def fcollect(self, x):
+        """shmem_fcollect: concatenation of every PE's block."""
+        return self.comm.allgather(x)
+
+    def alltoall(self, x):
+        return self.comm.alltoall(x)
+
+    def sum_to_all(self, x):
+        return self.comm.allreduce(x, ops_mod.SUM)
+
+    def max_to_all(self, x):
+        return self.comm.allreduce(x, ops_mod.MAX)
+
+    def min_to_all(self, x):
+        return self.comm.allreduce(x, ops_mod.MIN)
+
+    def finalize(self) -> None:
+        for a in list(self._allocs):
+            a.free()
+
+
+_ctx: Optional[ShmemCtx] = None
+
+
+def shmem_init(comm=None) -> ShmemCtx:
+    """shmem_init: reuses the runtime (OSHMEM sits beside OMPI on the
+    same ORTE, SURVEY §1.4)."""
+    global _ctx
+    if _ctx is not None:
+        return _ctx
+    if comm is None:
+        from ..runtime import runtime as rt_mod
+
+        comm = rt_mod.init()
+    _ctx = ShmemCtx(comm)
+    return _ctx
+
+
+def shmem_finalize() -> None:
+    global _ctx
+    if _ctx is not None:
+        _ctx.finalize()
+        _ctx = None

@@ -255,6 +255,7 @@ class PmlEngine:
                dst: int) -> Optional[Status]:
         """Nonblocking probe of the unexpected queue (MPI_Iprobe)."""
         with self._lock:
+            self._purge_cancelled(dst)
             for s in self._unexpected[dst]:
                 if (source in (ANY_SOURCE, s.src)) and _tag_match(tag, s.tag):
                     return Status(source=s.src, tag=s.tag,
@@ -298,6 +299,8 @@ class PmlEngine:
     # -- teardown ----------------------------------------------------------
     def pending_counts(self) -> Tuple[int, int]:
         with self._lock:
+            for dst in set(self._unexpected) | set(self._posted):
+                self._purge_cancelled(dst)
             return (
                 sum(len(q) for q in self._unexpected.values()),
                 sum(len(q) for q in self._posted.values()),

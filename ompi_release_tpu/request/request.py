@@ -250,9 +250,11 @@ def wait_any(reqs: Sequence[Request]) -> Tuple[int, Status]:
 
 def wait_some(reqs: Sequence[Request]) -> Tuple[List[int], List[Status]]:
     idx, sts = [], []
-    i, st = wait_any(reqs)
+    wait_any(reqs)
     for j, r in enumerate(reqs):
-        done, s = r.test()
+        if r.state is RequestState.INACTIVE:
+            continue  # MPI_Waitsome ignores inactive requests
+        done, _ = r.test()
         if done:
             idx.append(j)
             sts.append(r.status)

@@ -1,0 +1,219 @@
+"""Parallel I/O + checkpoint/restart + failure detection tests."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.ft import (
+    Checkpointer, ErrMgr, FtTester, Heartbeat, run_with_restart,
+    resource_usage,
+)
+from ompi_release_tpu.ft.sensor import InjectedFault
+from ompi_release_tpu.io import File, MODE_CREATE, MODE_RDWR
+from ompi_release_tpu.io.sharded import (
+    load_pytree, load_sharded, save_pytree, save_sharded,
+)
+from ompi_release_tpu.utils.errors import MPIError
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+class TestShardedIO:
+    def test_roundtrip(self, tmp_path):
+        x = np.random.RandomState(0).randn(8, 16, 4).astype(np.float32)
+        save_sharded(str(tmp_path), x, name="w")
+        y = load_sharded(str(tmp_path), name="w")
+        np.testing.assert_array_equal(x, y)
+        # one object per shard on disk
+        assert len([f for f in os.listdir(tmp_path)
+                    if f.endswith(".npy")]) == 8
+
+    def test_async_write(self, tmp_path):
+        x = np.ones((4, 1000), np.float32)
+        futs = save_sharded(str(tmp_path), x, name="a", async_=True)
+        for f in futs:
+            f.result()
+        np.testing.assert_array_equal(
+            load_sharded(str(tmp_path), name="a"), x
+        )
+
+    def test_bfloat16_roundtrip(self, tmp_path):
+        x = jnp.asarray(np.random.RandomState(1).randn(4, 8),
+                        jnp.bfloat16)
+        save_sharded(str(tmp_path), x, name="b")
+        y = load_sharded(str(tmp_path), name="b")
+        assert y.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(x, np.float32), np.asarray(y, np.float32)
+        )
+
+    def test_pytree_roundtrip(self, tmp_path):
+        tree = {
+            "w": np.random.RandomState(2).randn(4, 3).astype(np.float32),
+            "b": np.float32(2.5),  # scalar leaf
+            "nested": {"i": np.arange(6, dtype=np.int32)},
+        }
+        save_pytree(str(tmp_path), tree)
+        out = load_pytree(str(tmp_path), tree)
+        np.testing.assert_array_equal(out["w"], tree["w"])
+        assert float(out["b"]) == 2.5
+        np.testing.assert_array_equal(out["nested"]["i"],
+                                      tree["nested"]["i"])
+
+    def test_missing_manifest_raises(self, tmp_path):
+        with pytest.raises(MPIError):
+            load_sharded(str(tmp_path), name="nope")
+
+
+class TestFileAPI:
+    def test_write_read_at_with_view(self, world, tmp_path):
+        p = str(tmp_path / "f.bin")
+        with File(world, p, MODE_RDWR | MODE_CREATE) as f:
+            f.set_view(disp=8, etype=np.float32)
+            f.write_at(2, np.array([1.5, 2.5], np.float32))
+            out = f.read_at(2, 2)
+        np.testing.assert_array_equal(out, [1.5, 2.5])
+        assert os.stat(p).st_size == 8 + 4 * 4  # disp + 4 elements
+
+    def test_collective_write_all(self, world, tmp_path):
+        p = str(tmp_path / "c.bin")
+        n = world.size
+        blocks = [np.full(4, r, np.float32) for r in range(n)]
+        with File(world, p) as f:
+            f.set_view(etype=np.float32)
+            f.write_at_all([r * 4 for r in range(n)], blocks)
+            whole = f.read_at(0, 4 * n)
+        np.testing.assert_array_equal(
+            whole.reshape(n, 4), np.stack(blocks)
+        )
+
+    def test_shared_pointer_ordered(self, world, tmp_path):
+        p = str(tmp_path / "s.bin")
+        with File(world, p) as f:
+            f.set_view(etype=np.int32)
+            f.write_ordered([np.array([r], np.int32)
+                             for r in range(world.size)])
+            f._shared_ptr = 0
+            out = f.read_shared(world.size)
+        np.testing.assert_array_equal(out, np.arange(world.size))
+
+
+class TestCheckpoint:
+    def test_save_restore(self, world, tmp_path):
+        ck = Checkpointer(str(tmp_path), comm=world)
+        state = {"p": np.random.RandomState(3).randn(4, 4).astype(
+            np.float32), "step": np.int32(7)}
+        ck.save(7, state, async_=False)
+        assert ck.steps() == [7]
+        out = ck.restore(state)
+        np.testing.assert_array_equal(out["p"], state["p"])
+        assert int(out["step"]) == 7
+
+    def test_async_commit_and_gc(self, world, tmp_path):
+        ck = Checkpointer(str(tmp_path), keep=2, comm=world)
+        s = {"x": np.ones(8, np.float32)}
+        for step in (1, 2, 3, 4):
+            ck.save(step, {"x": s["x"] * step})
+        ck.wait()
+        assert ck.steps() == [3, 4]  # keep=2
+        out = ck.restore(s, 3)
+        np.testing.assert_array_equal(out["x"], np.full(8, 3.0))
+
+    def test_uncommitted_tmp_not_restored(self, world, tmp_path):
+        ck = Checkpointer(str(tmp_path), comm=world)
+        ck.save(1, {"x": np.ones(2, np.float32)}, async_=False)
+        # simulate crash mid-write of step 2: tmp dir, no marker
+        os.makedirs(str(tmp_path / "step_0000000002.tmp"))
+        assert ck.latest_step() == 1
+
+    def test_quiesce_rejects_posted_recvs(self, world, tmp_path):
+        ck = Checkpointer(str(tmp_path), comm=world)
+        r = world.irecv(source=0, tag=4242, rank=1)
+        with pytest.raises(MPIError):
+            ck.save(1, {"x": np.zeros(2, np.float32)})
+        r.cancel()
+        ck.save(1, {"x": np.zeros(2, np.float32)}, async_=False)
+
+
+class TestSensors:
+    def test_heartbeat_detects_silence(self):
+        fired = []
+        hb = Heartbeat(interval_s=0.05, miss_limit=2,
+                       on_failure=lambda: fired.append(1)).start()
+        hb.beat()
+        time.sleep(0.3)
+        hb.stop()
+        assert hb.failed and fired
+
+    def test_heartbeat_stays_alive_with_beats(self):
+        hb = Heartbeat(interval_s=0.05, miss_limit=3).start()
+        for _ in range(10):
+            hb.beat()
+            time.sleep(0.02)
+        assert not hb.failed
+        hb.stop()
+
+    def test_ft_tester_deterministic(self):
+        t = FtTester(fail_prob=1.0, seed=0)
+        with pytest.raises(InjectedFault):
+            t.maybe_fail("here")
+        t2 = FtTester(fail_prob=0.0, seed=0)
+        for _ in range(100):
+            t2.maybe_fail()
+        assert t2.injected == 0
+
+    def test_resource_usage(self):
+        ru = resource_usage()
+        assert ru["rss"] > 0 and ru["vmsize"] >= ru["rss"]
+
+
+class TestErrMgr:
+    def test_handler_registry(self):
+        em = ErrMgr()
+        seen = []
+        em.register(ValueError, lambda e: seen.append(repr(e)))
+        assert em.handle(ValueError("x"))
+        assert not em.handle(KeyError("y"))
+        assert len(seen) == 1
+
+    def test_run_with_restart_recovers(self, world, tmp_path):
+        """Fault injection mid-training: training must complete with
+        the same result as a fault-free run (deterministic replay)."""
+        ck = Checkpointer(str(tmp_path), comm=world)
+        tester = FtTester(seed=7)
+        fail_at = {13, 27}  # inject at these steps, once each
+
+        def step_fn(step, state):
+            if step in fail_at:
+                fail_at.discard(step)
+                raise InjectedFault(f"boom@{step}")
+            return {"acc": state["acc"] + step}
+
+        init = {"acc": np.float32(0.0)}
+        final, stats = run_with_restart(
+            step_fn, init, num_steps=30, checkpointer=ck,
+            checkpoint_every=5,
+        )
+        assert stats["restarts"] == 2
+        assert float(final["acc"]) == float(sum(range(30)))
+
+    def test_run_with_restart_gives_up(self, world, tmp_path):
+        ck = Checkpointer(str(tmp_path / "b"), comm=world)
+
+        def always_fail(step, state):
+            raise InjectedFault("always")
+
+        with pytest.raises(InjectedFault):
+            run_with_restart(
+                always_fail, {"x": np.float32(0)}, num_steps=5,
+                checkpointer=ck, checkpoint_every=1, max_restarts=2,
+            )

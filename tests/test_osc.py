@@ -156,3 +156,20 @@ class TestCreate:
             w.free()
         w.fence_end()
         w.free()
+
+
+class TestPSCWWait:
+    def test_complete_then_wait(self, world, win):
+        """Canonical PSCW: origin complete()s, target wait()s."""
+        win.post(world.group)
+        win.start(world.group)
+        win.put(np.full(4, 2.0, np.float32), target=0)
+        win.complete()
+        win.wait()  # must close the exposure side, not raise
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[0], np.full(4, 2.0)
+        )
+
+    def test_wait_without_post_raises(self, win):
+        with pytest.raises(MPIError):
+            win.wait()

@@ -1,0 +1,170 @@
+"""OSHMEM symmetric heap + topology tests."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.oshmem import ShmemCtx, shmem_init
+from ompi_release_tpu.topo import (
+    cart_create, dims_create, graph_create,
+)
+from ompi_release_tpu.utils.errors import MPIError
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+@pytest.fixture(scope="module")
+def shmem(world):
+    ctx = ShmemCtx(world)
+    yield ctx
+    ctx.finalize()
+
+
+class TestShmem:
+    def test_put_get_roundtrip(self, shmem):
+        sym = shmem.malloc((4,), jnp.float32)
+        shmem.put(sym, np.full(4, 3.5, np.float32), pe=2)
+        shmem.quiet()
+        np.testing.assert_array_equal(
+            np.asarray(shmem.get(sym, pe=2)), np.full(4, 3.5)
+        )
+        # untouched PE stays zero
+        np.testing.assert_array_equal(
+            np.asarray(shmem.get(sym, pe=1)), np.zeros(4)
+        )
+        sym.free()
+
+    def test_atomic_add_and_fetch(self, shmem):
+        sym = shmem.malloc((2,), jnp.float32)
+        for _ in range(3):
+            shmem.atomic_add(sym, np.ones(2, np.float32), pe=0)
+        old = shmem.atomic_fetch_add(sym, np.ones(2, np.float32), pe=0)
+        np.testing.assert_array_equal(np.asarray(old), np.full(2, 3.0))
+        np.testing.assert_array_equal(
+            np.asarray(shmem.get(sym, pe=0)), np.full(2, 4.0)
+        )
+        sym.free()
+
+    def test_atomic_swap_cswap(self, shmem):
+        sym = shmem.malloc((1,), jnp.int32)
+        old = shmem.atomic_swap(sym, np.array([5], np.int32), pe=3)
+        assert int(old[0]) == 0
+        old = shmem.atomic_compare_swap(
+            sym, cond=np.array([5], np.int32),
+            value=np.array([9], np.int32), pe=3,
+        )
+        assert int(old[0]) == 5
+        assert int(shmem.get(sym, pe=3)[0]) == 9
+        # failed CAS leaves value
+        shmem.atomic_compare_swap(
+            sym, cond=np.array([5], np.int32),
+            value=np.array([1], np.int32), pe=3,
+        )
+        assert int(shmem.get(sym, pe=3)[0]) == 9
+        sym.free()
+
+    def test_barrier_all_flushes_puts(self, shmem):
+        sym = shmem.malloc((3,), jnp.float32)
+        for pe in range(shmem.n_pes):
+            shmem.put(sym, np.full(3, float(pe), np.float32), pe=pe)
+        shmem.barrier_all()
+        for pe in range(shmem.n_pes):
+            assert float(sym.local(pe)[0]) == float(pe)
+        sym.free()
+
+    def test_scoll_delegates(self, shmem, world):
+        x = np.random.RandomState(0).randn(world.size, 8).astype(np.float32)
+        s = shmem.sum_to_all(x)
+        np.testing.assert_allclose(
+            np.asarray(s)[0], x.sum(0), rtol=2e-5, atol=1e-5
+        )
+        f = shmem.fcollect(x[:, :2])
+        assert np.asarray(f).shape == (world.size, world.size * 2)
+
+
+class TestDims:
+    def test_dims_create_balanced(self):
+        assert dims_create(8, 3) == (2, 2, 2)
+        assert dims_create(12, 2) == (4, 3)
+
+    def test_dims_create_partial(self):
+        assert dims_create(8, 2, [2, 0]) == (2, 4)
+        with pytest.raises(MPIError):
+            dims_create(7, 2, [2, 0])
+
+
+class TestCart:
+    def test_coords_rank_roundtrip(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[True, False])
+        for r in range(world.size):
+            assert topo.rank(topo.coords(r)) == r
+        assert topo.coords(0) == (0, 0)
+        assert topo.coords(7) == (1, 3)
+        c.free()
+
+    def test_shift_periodic_and_edge(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[True, False])
+        src, dst = topo.shift(0, 1, 0)  # periodic dim of size 2
+        assert (src, dst) == (4, 4)
+        src, dst = topo.shift(1, 1, 3)  # non-periodic edge: (1,3)+1 -> NULL
+        assert src == 2 and dst == -1
+        c.free()
+
+    def test_neighbor_allgather_2d_torus(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[True, True])
+        x = np.arange(world.size, dtype=np.float32)[:, None]
+        out = np.asarray(topo.neighbor_allgather(x))
+        # out: (size, 4 neighbors, 1)
+        assert out.shape == (world.size, 4, 1)
+        for r in range(world.size):
+            nbrs = topo.neighbors(r)
+            np.testing.assert_array_equal(
+                out[r, :, 0], np.array(nbrs, np.float32)
+            )
+        c.free()
+
+    def test_neighbor_alltoall_exchanges_blocks(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[True, True])
+        nn = 4
+        # block value encodes (sender, slot)
+        x = np.zeros((world.size, nn, 1), np.float32)
+        for r in range(world.size):
+            for j in range(nn):
+                x[r, j, 0] = 100 * r + j
+        out = np.asarray(topo.neighbor_alltoall(x))
+        for r in range(world.size):
+            nbrs = topo.neighbors(r)
+            for j in range(nn):
+                # slot j holds neighbor j's block aimed at me (their j^1)
+                assert out[r, j, 0] == 100 * nbrs[j] + (j ^ 1)
+        c.free()
+
+    def test_cart_sub_splits_rows(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[False, False])
+        subs = topo.sub([False, True])  # keep columns: 2 row-comms of 4
+        assert all(s is not None for s in subs)
+        sc0, st0 = subs[0]
+        assert sc0.size == 4 and st0.dims == (4,)
+        # ranks 0-3 share a subcomm; 4-7 share another
+        assert subs[0][0].cid == subs[3][0].cid
+        assert subs[0][0].cid != subs[4][0].cid
+        c.free()
+
+    def test_graph_topo(self, world):
+        # ring graph over 4 ranks inside an 8-comm is invalid; build on all 8
+        index, edges = [], []
+        acc = 0
+        for r in range(world.size):
+            nbrs = [(r - 1) % world.size, (r + 1) % world.size]
+            acc += len(nbrs)
+            index.append(acc)
+            edges.extend(nbrs)
+        g, topo = graph_create(world, index, edges)
+        assert topo.neighbors(0) == [world.size - 1, 1]
+        assert topo.neighbors(3) == [2, 4]
+        g.free()

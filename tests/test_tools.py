@@ -1,0 +1,73 @@
+"""tpu_info CLI + tracing interposition tests."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu import ops
+from ompi_release_tpu.tools import tpu_info, trace
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+class TestTpuInfo:
+    def test_gather_structure(self, world):
+        info = tpu_info.gather()
+        names = [f["name"] for f in info["frameworks"]]
+        assert "coll" in names and "pml" in names and "op" in names
+        coll = next(f for f in info["frameworks"] if f["name"] == "coll")
+        comp_names = [c["name"] for c in coll["components"]]
+        assert "tuned" in comp_names and "xla" in comp_names
+        assert any(v["name"] == "pml_eager_limit"
+                   for v in info["variables"])
+        assert len(info["devices"]) >= 1
+
+    def test_render_text(self, world):
+        info = tpu_info.gather()
+        text = tpu_info.render_text(info, show_vars=True)
+        assert "Frameworks:" in text and "pml_eager_limit" in text
+
+    def test_cli_json_subprocess(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "ompi_release_tpu.tools.tpu_info",
+             "--json", "--param", "coll"],
+            capture_output=True, text=True, timeout=120, cwd="/root/repo",
+            env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+                 "HOME": "/root"},
+        )
+        assert out.returncode == 0, out.stderr
+        info = json.loads(out.stdout)
+        assert all("coll" in v["name"] for v in info["variables"])
+
+
+class TestTracing:
+    def test_interposition_records_events(self, world, tmp_path):
+        sink = str(tmp_path / "trace.jsonl")
+        tc = trace.wrap(world, sink)
+        x = np.ones((world.size, 100), np.float32)
+        tc.allreduce(x, ops.SUM)
+        tc.bcast(x, root=0)
+        tc.barrier()
+        tc.send(np.int32(1), dest=1, tag=600, rank=0)
+        tc.recv(source=0, tag=600, rank=1)
+        s = tc.summary()
+        assert s["allreduce"]["calls"] == 1
+        assert s["allreduce"]["bytes"] == x.nbytes
+        assert s["barrier"]["calls"] == 1 and s["recv"]["calls"] == 1
+        tc.close()
+        lines = [json.loads(l) for l in open(sink)]
+        assert len(lines) == 5
+        assert lines[0]["op"] == "allreduce" and lines[0]["dt"] >= 0
+
+    def test_passthrough_untraced(self, world):
+        tc = trace.wrap(world)
+        assert tc.size == world.size  # attribute passthrough
+        sub = tc.dup("traced_dup")  # untraced method passthrough
+        sub.free()
