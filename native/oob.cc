@@ -1,0 +1,308 @@
+// OOB/RML — tagged, tree-routable TCP messaging for the control plane.
+//
+// The reference's out-of-band stack: oob/tcp moves framed bytes over
+// sockets with a connection state machine, rml adds tagged send/recv
+// on top, routed supplies the overlay tree so daemons relay messages
+// they are not the destination of (SURVEY §2.2 oob/rml/routed). This
+// is that stack rebuilt small and native for the TPU framework's
+// multi-host coordinator: every endpoint has a listener, frames carry
+// (src, dst, tag), a routing table forwards frames not addressed to
+// this node (tree routing), and received frames land in a
+// condition-variable-guarded queue that Python drains.
+//
+// C ABI for ctypes; threads: one acceptor + one reader per connection.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4f4d5054;  // "OMPT"
+
+struct Frame {
+  int32_t src;
+  int32_t dst;
+  int32_t tag;
+  std::vector<uint8_t> payload;
+};
+
+struct Header {
+  uint32_t magic;
+  int32_t src;
+  int32_t dst;
+  int32_t tag;
+  uint32_t len;
+} __attribute__((packed));
+
+bool read_full(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Endpoint {
+  int32_t id = -1;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+
+  std::mutex mu;                     // guards peers/routes/queue
+  std::mutex wmu;                    // serializes frame writes
+  std::map<int32_t, int> peer_fd;    // directly connected peers
+  std::map<int32_t, int32_t> route;  // dst -> next-hop peer
+  std::deque<Frame> queue;
+  std::deque<Frame> undeliverable;   // forwards awaiting a peer/route
+  std::condition_variable cv;
+  std::vector<std::thread> threads;
+  std::thread acceptor;
+
+  ~Endpoint() { stop(); }
+
+  void stop() {
+    if (stopping.exchange(true)) return;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    {
+      std::lock_guard<std::mutex> l(mu);
+      for (auto& kv : peer_fd) {
+        ::shutdown(kv.second, SHUT_RDWR);
+        ::close(kv.second);
+      }
+      peer_fd.clear();
+    }
+    cv.notify_all();
+    if (acceptor.joinable()) acceptor.join();
+    for (auto& t : threads)
+      if (t.joinable()) t.join();
+  }
+
+  int next_hop_fd(int32_t dst) {
+    std::lock_guard<std::mutex> l(mu);
+    auto it = peer_fd.find(dst);
+    if (it != peer_fd.end()) return it->second;
+    auto r = route.find(dst);
+    if (r != route.end()) {
+      auto h = peer_fd.find(r->second);
+      if (h != peer_fd.end()) return h->second;
+    }
+    auto d = route.find(-1);  // default route (toward the root)
+    if (d != route.end()) {
+      auto h = peer_fd.find(d->second);
+      if (h != peer_fd.end()) return h->second;
+    }
+    return -1;
+  }
+
+  bool send_frame(const Frame& f) {
+    int fd = next_hop_fd(f.dst);
+    if (fd < 0) return false;
+    Header h{kMagic, f.src, f.dst, f.tag,
+             static_cast<uint32_t>(f.payload.size())};
+    std::lock_guard<std::mutex> l(wmu);  // serialize frame writes
+    if (!write_full(fd, &h, sizeof h)) return false;
+    return f.payload.empty() ||
+           write_full(fd, f.payload.data(), f.payload.size());
+  }
+
+  void deliver_or_forward(Frame&& f) {
+    if (f.dst == id || f.dst == -1) {
+      std::lock_guard<std::mutex> l(mu);
+      queue.push_back(std::move(f));
+      cv.notify_all();
+    } else if (!send_frame(f)) {
+      // tree relay (routed analogue); a frame can arrive before the
+      // next hop has announced itself — hold it until a peer registers
+      std::lock_guard<std::mutex> l(mu);
+      undeliverable.push_back(std::move(f));
+    }
+  }
+
+  void flush_undeliverable() {
+    std::deque<Frame> retry;
+    {
+      std::lock_guard<std::mutex> l(mu);
+      retry.swap(undeliverable);
+    }
+    for (auto& f : retry) deliver_or_forward(std::move(f));
+  }
+
+  void reader_loop(int fd) {
+    for (;;) {
+      Header h;
+      if (!read_full(fd, &h, sizeof h) || h.magic != kMagic) break;
+      Frame f;
+      f.src = h.src;
+      f.dst = h.dst;
+      f.tag = h.tag;
+      f.payload.resize(h.len);
+      if (h.len && !read_full(fd, f.payload.data(), h.len)) break;
+      // first frame on an inbound connection announces the peer id
+      if (h.tag == -999) {
+        {
+          std::lock_guard<std::mutex> l(mu);
+          peer_fd[h.src] = fd;
+        }
+        flush_undeliverable();
+        continue;
+      }
+      deliver_or_forward(std::move(f));
+    }
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) return;  // listener closed
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      std::lock_guard<std::mutex> l(mu);
+      threads.emplace_back([this, fd] { reader_loop(fd); });
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create an endpoint with a listener on 127.0.0.1:port (0 = ephemeral).
+void* oob_create(int32_t id, int port) {
+  auto* ep = new Endpoint();
+  ep->id = id;
+  ep->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(ep->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(ep->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+           sizeof addr) != 0 ||
+      listen(ep->listen_fd, 64) != 0) {
+    delete ep;
+    return nullptr;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(ep->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  ep->port = ntohs(addr.sin_port);
+  ep->acceptor = std::thread([ep] { ep->accept_loop(); });
+  return ep;
+}
+
+int oob_port(void* h) { return static_cast<Endpoint*>(h)->port; }
+
+// Outbound connection to a peer's listener; announces our id.
+int oob_connect(void* h, int32_t peer_id, const char* host, int port) {
+  auto* ep = static_cast<Endpoint*>(h);
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  Header hello{kMagic, ep->id, peer_id, -999, 0};
+  if (!write_full(fd, &hello, sizeof hello)) {
+    ::close(fd);
+    return -1;
+  }
+  std::lock_guard<std::mutex> l(ep->mu);
+  ep->peer_fd[peer_id] = fd;
+  ep->threads.emplace_back([ep, fd] { ep->reader_loop(fd); });
+  return 0;
+}
+
+// Static route: frames for dst leave via directly-connected peer `via`.
+// dst == -1 installs the default route (toward the tree root).
+void oob_add_route(void* h, int32_t dst, int32_t via) {
+  auto* ep = static_cast<Endpoint*>(h);
+  std::lock_guard<std::mutex> l(ep->mu);
+  ep->route[dst] = via;
+}
+
+int oob_send(void* h, int32_t dst, int32_t tag, const uint8_t* data,
+             int32_t len) {
+  auto* ep = static_cast<Endpoint*>(h);
+  Frame f;
+  f.src = ep->id;
+  f.dst = dst;
+  f.tag = tag;
+  f.payload.assign(data, data + len);
+  if (dst == ep->id) {  // self-send: straight to the queue
+    ep->deliver_or_forward(std::move(f));
+    return 0;
+  }
+  return ep->send_frame(f) ? 0 : -1;
+}
+
+// Pop the next frame matching tag (-1 = any). Returns payload length,
+// -1 on timeout, -2 if the output buffer is too small (frame stays).
+int oob_recv(void* h, int32_t* src, int32_t* tag, uint8_t* out,
+             int32_t maxlen, int timeout_ms) {
+  auto* ep = static_cast<Endpoint*>(h);
+  std::unique_lock<std::mutex> l(ep->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    for (auto it = ep->queue.begin(); it != ep->queue.end(); ++it) {
+      if (*tag == -1 || it->tag == *tag) {
+        if (static_cast<int32_t>(it->payload.size()) > maxlen) return -2;
+        *src = it->src;
+        *tag = it->tag;
+        int n = static_cast<int>(it->payload.size());
+        if (n) std::memcpy(out, it->payload.data(), n);
+        ep->queue.erase(it);
+        return n;
+      }
+    }
+    if (ep->stopping ||
+        ep->cv.wait_until(l, deadline) == std::cv_status::timeout)
+      return -1;
+  }
+}
+
+int oob_pending(void* h) {
+  auto* ep = static_cast<Endpoint*>(h);
+  std::lock_guard<std::mutex> l(ep->mu);
+  return static_cast<int>(ep->queue.size());
+}
+
+void oob_destroy(void* h) { delete static_cast<Endpoint*>(h); }
+
+}  // extern "C"

@@ -46,11 +46,13 @@ class Checkpointer:
             pml = getattr(self.comm, "_pml", None)
             if pml is not None:
                 unex, posted = pml.pending_counts()
-                if posted:
+                if posted or unex:
                     raise MPIError(
                         ErrorCode.ERR_PENDING,
-                        f"checkpoint with {posted} posted receives "
-                        "outstanding (drain or cancel them first)",
+                        f"checkpoint with in-flight p2p state "
+                        f"({unex} undelivered sends, {posted} posted "
+                        "receives) — drain or cancel them first; host "
+                        "queues are not part of the snapshot",
                     )
             self.comm.barrier()
 
@@ -98,6 +100,26 @@ class Checkpointer:
                 fu.result()
             commit()
         self._pending = []
+
+    def abort(self) -> None:
+        """Discard the in-flight checkpoint WITHOUT committing: cancel
+        what hasn't started, join what has (so no orphan writer races a
+        replayed save into the same tmp dir), and sweep stale tmp
+        directories. Used by restart paths where the snapshot taken
+        around a failure is suspect."""
+        for futs, _commit in self._pending:
+            for fu in futs:
+                fu.cancel()
+            for fu in futs:
+                try:
+                    fu.result()
+                except Exception:
+                    pass
+        self._pending = []
+        for name in os.listdir(self.directory):
+            if name.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
 
     # -- restore -----------------------------------------------------------
     def steps(self) -> List[int]:

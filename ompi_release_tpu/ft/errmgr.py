@@ -82,7 +82,7 @@ def run_with_restart(
             _restarts.add()
             if stats["restarts"] > max_restarts:
                 raise
-            checkpointer._pending = []  # in-flight snapshot is suspect
+            checkpointer.abort()  # in-flight snapshot is suspect
             latest = checkpointer.latest_step()
             if latest is None:
                 state = init_state

@@ -61,6 +61,9 @@ class Heartbeat:
                 return
 
     def start(self) -> "Heartbeat":
+        # the clock starts when monitoring starts — construction-to-
+        # start delay must not count as missed beats
+        self._last = time.monotonic()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         return self

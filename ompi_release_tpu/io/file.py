@@ -91,6 +91,11 @@ class File:
 
     def read_at_all(self, offsets, counts):
         self._check()
+        if len(offsets) != self.comm.size or len(counts) != self.comm.size:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"need {self.comm.size} offsets/counts (one per rank)",
+            )
         out = [self.read_at(o, c) for o, c in zip(offsets, counts)]
         self.comm.barrier()
         return out

@@ -182,13 +182,14 @@ class Window:
         """Close the exposure side (MPI_Win_wait). The single driver
         state machine conflates access/exposure, so wait() after the
         origin's complete() must succeed — it applies anything still
-        pending and clears the exposure group."""
+        pending and clears the exposure group. A bare start() access
+        epoch has no exposure to wait on and is rejected."""
+        if self._group_exposed is None:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           "wait() without a matching post()")
         if self._epoch is _EpochKind.PSCW:
             self._apply_pending()
             self._epoch = _EpochKind.NONE
-        elif self._group_exposed is None:
-            raise MPIError(ErrorCode.ERR_RMA_SYNC,
-                           "wait() without a matching post()")
         self._group_exposed = None
 
     def free(self) -> None:

@@ -249,6 +249,8 @@ def wait_any(reqs: Sequence[Request]) -> Tuple[int, Status]:
 
 
 def wait_some(reqs: Sequence[Request]) -> Tuple[List[int], List[Status]]:
+    if all(r.state is RequestState.INACTIVE for r in reqs):
+        return [], []  # MPI_Waitsome: outcount undefined, nothing waits
     idx, sts = [], []
     wait_any(reqs)
     for j, r in enumerate(reqs):

@@ -1,0 +1,143 @@
+"""Multi-host coordinator over the native OOB — the HNP/orted wire-up.
+
+The reference's launch wire-up (SURVEY §3.2): daemons report to the
+HNP, the modex allgathers every proc's business card through the
+daemon tree, and a runtime barrier gates MPI_Init completion. Here the
+HNP is the job coordinator process and each host runs a WorkerAgent;
+messages are DSS-packed frames over the native tree-routable OOB
+(``native/oob.cc``). In a real multi-host TPU job this wire-up runs
+BEFORE ``jax.distributed.initialize`` — the modex distributes each
+host's coordinator address/device coords; jax's own runtime then forms
+the ICI/DCN data plane.
+
+Tags mirror the RML usage pattern (``rml.h:318`` tagged send/recv).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from ..native import DssBuffer, OobEndpoint
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+
+_log = output.stream("coord")
+
+TAG_JOIN = 1
+TAG_MODEX = 2
+TAG_BARRIER_ENTER = 3
+TAG_BARRIER_RELEASE = 4
+TAG_XCAST = 5
+TAG_FIN = 6
+TAG_HEARTBEAT = 7
+
+
+def _pack_card(node_id: int, card: Dict[str, Any]) -> bytes:
+    b = DssBuffer()
+    b.pack_int64(node_id)
+    b.pack_string(json.dumps(card))
+    return b.tobytes()
+
+
+def _unpack_card(raw: bytes):
+    b = DssBuffer(raw)
+    (node_id,) = b.unpack_int64()
+    return int(node_id), json.loads(b.unpack_string())
+
+
+class HnpCoordinator:
+    """Rank-0 side: owns the listener, drives modex/barrier/xcast."""
+
+    def __init__(self, num_nodes: int, port: int = 0) -> None:
+        if num_nodes < 1:
+            raise MPIError(ErrorCode.ERR_ARG, "num_nodes must be >= 1")
+        self.num_nodes = num_nodes
+        self.ep = OobEndpoint(0, port)
+        self._barrier_seq = 0
+
+    @property
+    def port(self) -> int:
+        return self.ep.port
+
+    def run_modex(self, my_card: Dict[str, Any], *,
+                  timeout_ms: int = 30_000) -> List[Dict[str, Any]]:
+        """Collect every worker's card, broadcast the full list
+        (grpcomm_base_modex.c:67 allgather-through-daemons)."""
+        cards: Dict[int, Dict[str, Any]] = {0: my_card}
+        deadline = time.monotonic() + timeout_ms / 1000
+        while len(cards) < self.num_nodes:
+            left = max(1, int((deadline - time.monotonic()) * 1000))
+            src, _, raw = self.ep.recv(tag=TAG_JOIN, timeout_ms=left)
+            nid, card = _unpack_card(raw)
+            cards[nid] = card
+            _log.verbose(2, f"modex: node {nid} joined ({len(cards)}/"
+                            f"{self.num_nodes})")
+        ordered = [cards[i] for i in range(self.num_nodes)]
+        payload = DssBuffer().pack_string(json.dumps(ordered)).tobytes()
+        for nid in range(1, self.num_nodes):
+            self.ep.send(nid, TAG_MODEX, payload)
+        return ordered
+
+    def barrier(self, *, timeout_ms: int = 30_000) -> None:
+        """Wait for every worker's ENTER, then release all (the rte
+        barrier of ompi_mpi_init.c:811)."""
+        self._barrier_seq += 1
+        seen = set()
+        deadline = time.monotonic() + timeout_ms / 1000
+        while len(seen) < self.num_nodes - 1:
+            left = max(1, int((deadline - time.monotonic()) * 1000))
+            src, _, raw = self.ep.recv(tag=TAG_BARRIER_ENTER,
+                                       timeout_ms=left)
+            seen.add(src)
+        rel = DssBuffer().pack_int64(self._barrier_seq).tobytes()
+        for nid in range(1, self.num_nodes):
+            self.ep.send(nid, TAG_BARRIER_RELEASE, rel)
+
+    def xcast(self, payload: bytes, tag: int = TAG_XCAST) -> None:
+        """Broadcast through the tree (grpcomm xcast analogue; with a
+        star topology this is direct, with routes it relays)."""
+        for nid in range(1, self.num_nodes):
+            self.ep.send(nid, tag, payload)
+
+    def shutdown(self) -> None:
+        try:
+            self.xcast(b"", tag=TAG_FIN)
+        finally:
+            self.ep.close()
+
+
+class WorkerAgent:
+    """Per-host agent (the orted-equivalent participant)."""
+
+    def __init__(self, node_id: int, hnp_host: str, hnp_port: int) -> None:
+        if node_id < 1:
+            raise MPIError(ErrorCode.ERR_ARG,
+                           "worker node_id must be >= 1 (0 is the HNP)")
+        self.node_id = node_id
+        self.ep = OobEndpoint(node_id)
+        self.ep.connect(0, hnp_host, hnp_port)
+        self.ep.set_default_route(0)  # everything flows toward the root
+
+    def run_modex(self, my_card: Dict[str, Any], *,
+                  timeout_ms: int = 30_000) -> List[Dict[str, Any]]:
+        self.ep.send(0, TAG_JOIN, _pack_card(self.node_id, my_card))
+        _, _, raw = self.ep.recv(tag=TAG_MODEX, timeout_ms=timeout_ms)
+        return json.loads(DssBuffer(raw).unpack_string())
+
+    def barrier(self, *, timeout_ms: int = 30_000) -> None:
+        self.ep.send(0, TAG_BARRIER_ENTER, b"")
+        self.ep.recv(tag=TAG_BARRIER_RELEASE, timeout_ms=timeout_ms)
+
+    def recv_xcast(self, tag: int = TAG_XCAST, *,
+                   timeout_ms: int = 30_000) -> bytes:
+        _, _, raw = self.ep.recv(tag=tag, timeout_ms=timeout_ms)
+        return raw
+
+    def heartbeat(self) -> None:
+        self.ep.send(0, TAG_HEARTBEAT, b"")
+
+    def wait_fin(self, *, timeout_ms: int = 60_000) -> None:
+        self.ep.recv(tag=TAG_FIN, timeout_ms=timeout_ms)
+        self.ep.close()

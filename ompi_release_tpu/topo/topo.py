@@ -37,7 +37,15 @@ def dims_create(nnodes: int, ndims: int,
             f"cannot fill dims {dims} for {nnodes} nodes",
         )
     free = [i for i, d in enumerate(dims) if d <= 0]
-    fills = factorize_torus(nnodes // fixed, len(free)) if free else ()
+    if not free:
+        if fixed != nnodes:
+            raise MPIError(
+                ErrorCode.ERR_DIMS,
+                f"fully-specified dims {dims} have product {fixed} != "
+                f"{nnodes} nodes",
+            )
+        return tuple(dims)
+    fills = factorize_torus(nnodes // fixed, len(free))
     for i, f in zip(free, fills):
         dims[i] = f
     return tuple(dims)

@@ -1,0 +1,153 @@
+"""Native control plane tests: DSS, routed OOB, multi-process
+coordinator (the oob_stress / orte system-test analogue, SURVEY §4.3 —
+real processes over localhost)."""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ompi_release_tpu.native import DssBuffer, OobEndpoint
+from ompi_release_tpu.runtime.coordinator import HnpCoordinator
+from ompi_release_tpu.utils.errors import MPIError
+
+
+class TestDss:
+    def test_roundtrip_all_types(self):
+        b = DssBuffer()
+        b.pack_int64([1, -2, 3]).pack_string("héllo").pack_double(
+            [3.25, -0.5]
+        ).pack_bytes(b"\x00\xff\x80")
+        r = DssBuffer(b.tobytes())
+        assert r.peek() == ("int64", 3)
+        assert r.unpack_int64() == [1, -2, 3]
+        assert r.unpack_string() == "héllo"
+        assert r.unpack_double() == [3.25, -0.5]
+        assert r.unpack_bytes() == b"\x00\xff\x80"
+        assert r.peek() is None  # exhausted
+
+    def test_type_mismatch_raises_and_preserves_cursor(self):
+        b = DssBuffer()
+        b.pack_int64(7).pack_string("x")
+        r = DssBuffer(b.tobytes())
+        with pytest.raises(MPIError):
+            r.unpack_string()
+        assert r.unpack_int64() == [7]  # cursor unharmed by the miss
+
+    def test_truncated_buffer_raises(self):
+        b = DssBuffer()
+        b.pack_int64([1, 2, 3, 4])
+        r = DssBuffer(b.tobytes()[:10])  # cut mid-payload
+        with pytest.raises(MPIError):
+            r.unpack_int64()
+
+    def test_rewind(self):
+        b = DssBuffer()
+        b.pack_string("again")
+        raw = DssBuffer(b.tobytes())
+        assert raw.unpack_string() == "again"
+        raw.rewind()
+        assert raw.unpack_string() == "again"
+
+
+class TestOob:
+    def test_direct_send_recv(self):
+        a, b = OobEndpoint(0), OobEndpoint(1)
+        try:
+            b.connect(0, "127.0.0.1", a.port)
+            b.send(0, 7, b"hi root")
+            src, tag, p = a.recv(tag=7, timeout_ms=5000)
+            assert (src, tag, p) == (1, 7, b"hi root")
+            a.send(1, 8, b"hi leaf")  # reverse over same connection
+            assert b.recv(tag=8, timeout_ms=5000)[2] == b"hi leaf"
+        finally:
+            a.close()
+            b.close()
+
+    def test_tree_routing_three_hop(self):
+        """A - B - C chain: frames relay through B both directions."""
+        a, mid, c = OobEndpoint(0), OobEndpoint(1), OobEndpoint(2)
+        try:
+            a.connect(1, "127.0.0.1", mid.port)
+            c.connect(1, "127.0.0.1", mid.port)
+            a.add_route(2, 1)
+            c.set_default_route(1)
+            a.send(2, 42, b"down")
+            assert c.recv(tag=42, timeout_ms=5000)[2] == b"down"
+            c.send(0, 43, b"up")
+            assert a.recv(tag=43, timeout_ms=5000)[2] == b"up"
+        finally:
+            for e in (a, mid, c):
+                e.close()
+
+    def test_large_payload_and_tag_selectivity(self):
+        a, b = OobEndpoint(0), OobEndpoint(1)
+        try:
+            b.connect(0, "127.0.0.1", a.port)
+            big = bytes(range(256)) * 8192  # 2 MiB
+            b.send(0, 2, b"second")
+            b.send(0, 1, big)
+            src, tag, p = a.recv(tag=1, timeout_ms=5000)
+            assert p == big  # picked by tag, not arrival order
+            assert a.recv(tag=2, timeout_ms=5000)[2] == b"second"
+        finally:
+            a.close()
+            b.close()
+
+    def test_recv_timeout(self):
+        a = OobEndpoint(0)
+        try:
+            with pytest.raises(MPIError):
+                a.recv(tag=9, timeout_ms=100)
+        finally:
+            a.close()
+
+
+WORKER_SCRIPT = textwrap.dedent("""
+    import sys, json
+    sys.path.insert(0, "/root/repo")
+    from ompi_release_tpu.runtime.coordinator import WorkerAgent
+
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    agent = WorkerAgent(rank, "127.0.0.1", port)
+    cards = agent.run_modex({"host": f"worker{rank}", "devices": rank})
+    assert cards[rank]["devices"] == rank, cards
+    agent.barrier()
+    payload = agent.recv_xcast()
+    agent.barrier()
+    print(json.dumps({"rank": rank, "n_cards": len(cards),
+                      "xcast": payload.decode()}))
+    agent.wait_fin()
+""")
+
+
+class TestCoordinator:
+    def test_multiprocess_modex_barrier_xcast(self, tmp_path):
+        """4 real processes: modex allgather, two barriers, one xcast —
+        the wire-up sequence of SURVEY §3.2 over localhost."""
+        n = 4
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER_SCRIPT)
+        hnp = HnpCoordinator(n)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(r), str(hnp.port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            for r in range(1, n)
+        ]
+        try:
+            cards = hnp.run_modex({"host": "hnp", "devices": 0})
+            assert [c["devices"] for c in cards] == [0, 1, 2, 3]
+            hnp.barrier()
+            hnp.xcast(b"job-config-v1")
+            hnp.barrier()
+        finally:
+            hnp.shutdown()
+        for p in procs:
+            out, err = p.communicate(timeout=30)
+            assert p.returncode == 0, err
+            rec = json.loads(out.strip().splitlines()[-1])
+            assert rec["n_cards"] == n and rec["xcast"] == "job-config-v1"
