@@ -512,7 +512,121 @@ class SelfCollComponent(mca_component.Component):
         return None
 
 
+# ---------------------------------------------------------------------------
+# ml component — hierarchical two-level collectives (ml/bcol/sbgp)
+# ---------------------------------------------------------------------------
+
+def _discover_hierarchy(comm) -> Optional[tuple]:
+    """sbgp-style subgroup discovery: split the comm's ranks into fast
+    domains (same host process / slice — ``ompi/mca/sbgp`` socket/UMA
+    grouping). Returns (inter, intra) when ranks form equal-size
+    contiguous groups, else None. The ``coll_ml_local_size`` variable
+    overrides discovery (for CI, where every virtual device shares one
+    process)."""
+    forced = int(mca_var.get("coll_ml_local_size", 0))
+    n = comm.size
+    if forced > 1:
+        return (n // forced, forced) if n % forced == 0 else None
+    eps = {e.rank: e for e in comm.runtime.endpoints}
+    keys = []
+    for i in range(n):
+        e = eps.get(comm.group.world_rank(i))
+        if e is None:
+            return None
+        keys.append((e.process_index, e.slice_index))
+    groups: Dict[tuple, list] = {}
+    for i, k in enumerate(keys):
+        groups.setdefault(k, []).append(i)
+    sizes = {len(v) for v in groups.values()}
+    if len(groups) < 2 or len(sizes) != 1:
+        return None
+    intra = sizes.pop()
+    if intra < 2:
+        return None
+    # groups must be contiguous rank blocks for the 2-D factorization
+    for members in groups.values():
+        if members != list(range(members[0], members[0] + intra)):
+            return None
+    return (len(groups), intra)
+
+
+class _MlModule:
+    """Two-level algorithms over the (node, local) decomposition."""
+
+    def __init__(self, comm, inter: int, intra: int) -> None:
+        self.comm = comm
+        self.inter = inter
+        self.intra = intra
+
+    def fns(self) -> Dict[str, Callable]:
+        return {
+            "allreduce": self.allreduce,
+            "bcast": self.bcast,
+            "barrier": self.barrier,
+        }
+
+    def allreduce(self, comm, x, op: Op):
+        if op.is_pair_op or op.identity is None or not op.commutative:
+            return None  # defer to lower-priority providers
+        from .driver import run_sharded2d
+
+        body = lambda xb: spmd.allreduce_two_level(
+            xb, op, "local", "node", self.intra
+        )
+        return run_sharded2d(
+            comm, ("ml", "allreduce", op.name, self.inter, self.intra),
+            body, x, inter=self.inter, intra=self.intra,
+        )
+
+    def bcast(self, comm, x, root: int):
+        from .driver import run_sharded2d
+
+        body = lambda xb: spmd.bcast_two_level(
+            xb, "local", "node", root, self.intra
+        )
+        return run_sharded2d(
+            comm, ("ml", "bcast", root, self.inter, self.intra),
+            body, x, inter=self.inter, intra=self.intra,
+        )
+
+    def barrier(self, comm):
+        from .driver import run_sharded2d
+
+        out = run_sharded2d(
+            comm, ("ml", "barrier", self.inter, self.intra),
+            lambda xb: spmd.barrier_psum("local")
+            + spmd.barrier_psum("node") + xb,
+            jnp.zeros((comm.size,), jnp.int32),
+            inter=self.inter, intra=self.intra,
+        )
+        jax.block_until_ready(out)
+
+
+class MlCollComponent(mca_component.Component):
+    """Hierarchical collectives; wins only when selected (coll=ml) or
+    its priority is raised, and declines comms with no hierarchy."""
+
+    NAME = "ml"
+    PRIORITY = 40
+
+    def register_vars(self) -> None:
+        mca_var.register(
+            "coll_ml_local_size", "int", 0,
+            "Force the fast-domain (intra) size for hierarchical "
+            "collectives; 0 = discover from endpoint process/slice ids",
+        )
+
+    def query(self, ctx=None):
+        if ctx is None:
+            return (self.priority, self)
+        h = _discover_hierarchy(ctx)
+        if h is None:
+            return None
+        return (self.priority, _MlModule(ctx, *h))
+
+
 COLL_FRAMEWORK.register(XlaCollComponent())
 COLL_FRAMEWORK.register(TunedCollComponent())
+COLL_FRAMEWORK.register(MlCollComponent())
 COLL_FRAMEWORK.register(BasicCollComponent())
 COLL_FRAMEWORK.register(SelfCollComponent())

@@ -34,6 +34,46 @@ def _program_cache(comm) -> Dict[Tuple, Callable]:
     return cache
 
 
+def run_sharded2d(comm, key: Tuple, body: Callable, x, *,
+                  inter: int, intra: int) -> Any:
+    """Like run_sharded but over a 2-D (node, local) factorization of
+    the comm's ranks: rank r = node r//intra, local r%intra (the sbgp
+    subgrouping). Used by hierarchical (ml) algorithms."""
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    _invoke_count.add()
+    if x.shape[0] != comm.size or inter * intra != comm.size:
+        from ..utils.errors import ErrorCode, MPIError
+
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"2-D driver needs leading axis == size ({comm.size}) and "
+            f"inter*intra == size (got {inter}x{intra})",
+        )
+    cache = _program_cache(comm)
+    prog = cache.get(key)
+    if prog is None:
+        _compile_count.add()
+        devs = _np.asarray(
+            list(comm.submesh.devices.reshape(-1)), dtype=object
+        ).reshape(inter, intra)
+        mesh2d = Mesh(devs, ("node", "local"))
+
+        def wrapper(xb):
+            return body(xb[0])[None]
+
+        prog = jax.jit(
+            jax.shard_map(
+                wrapper, mesh=mesh2d,
+                in_specs=P(("node", "local")),
+                out_specs=P(("node", "local")),
+            )
+        )
+        cache[key] = prog
+    return prog(jnp.asarray(x))
+
+
 def run_sharded(comm, key: Tuple, body: Callable, x, *,
                 extra_arrays: Tuple = ()) -> Any:
     """Run ``body(block, *extra_blocks)`` under shard_map over the comm's

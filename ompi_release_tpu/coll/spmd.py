@@ -439,6 +439,47 @@ def scan_recursive_doubling(x: jax.Array, op: Op, axis_name: str,
     return jnp.where(rank == 0, jnp.zeros_like(acc), shifted)
 
 
+def allreduce_two_level(x: jax.Array, op: Op, intra_axis: str,
+                        inter_axis: str, intra_n: int) -> jax.Array:
+    """Hierarchical allreduce (coll/ml + bcol + sbgp analogue,
+    SURVEY §2.3): reduce-scatter inside the fast domain (ICI slice /
+    shared-memory node), allreduce the owned chunk across the slow
+    domain (DCN / inter-node), allgather back inside.
+
+    Inter-domain traffic drops to 1/intra_n of the payload — exactly
+    why the reference builds ml on top of per-level bcol primitives.
+    """
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    total = flat.shape[0]
+    chunk = -(-total // intra_n)
+    ident = op.identity_for(dtype)
+    padded = _pad_to(flat, chunk * intra_n, ident)
+
+    # level 1: reduce-scatter within the fast domain (takes the flat
+    # buffer and blocks it internally)
+    mine = reduce_scatter_ring(padded, op, intra_axis, intra_n)
+    # level 2: allreduce owned chunks across the slow domain
+    mine = allreduce_lax(mine, op, inter_axis)
+    # level 3: allgather within the fast domain
+    out = lax.all_gather(mine, intra_axis, axis=0, tiled=True)
+    return out[:total].reshape(shape).astype(dtype)
+
+
+def bcast_two_level(x: jax.Array, intra_axis: str, inter_axis: str,
+                    root: int, intra_n: int) -> jax.Array:
+    """Hierarchical bcast: root -> its inter peers (one per fast
+    domain) -> everyone inside each fast domain."""
+    root_node, root_local = divmod(root, intra_n)
+    # select root's value, then one fused two-level masked reduction
+    rank_local = lax.axis_index(intra_axis)
+    rank_node = lax.axis_index(inter_axis)
+    is_root = (rank_node == root_node) & (rank_local == root_local)
+    contrib = jnp.where(is_root, x, jnp.zeros_like(x))
+    # one fused reduction over both axes delivers the bcast
+    return lax.psum(lax.psum(contrib, intra_axis), inter_axis)
+
+
 def barrier_psum(axis_name: str) -> jax.Array:
     """Barrier = 0-byte allreduce; completion of the program is the sync."""
     return lax.psum(jnp.zeros((), jnp.int32), axis_name)

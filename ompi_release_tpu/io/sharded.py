@@ -13,9 +13,12 @@ compute overlaps file I/O (async checkpoint requirement of §5).
 
 from __future__ import annotations
 
+import gzip
+import io as _io
 import json
 import os
 import threading
+import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
@@ -40,6 +43,16 @@ def register_vars() -> None:
     mca_var.register(
         "io_num_aggregators", "int", 8,
         "Concurrent shard writers (fcoll two_phase aggregator count)",
+    )
+    mca_var.register(
+        "io_compress", "enum", "none",
+        "Shard compression (opal/mca/compress analogue)",
+        choices=("none", "gzip"),
+    )
+    mca_var.register(
+        "io_checksum", "bool", True,
+        "CRC32 per shard, verified on load (opal datatype-checksum "
+        "analogue: catches storage corruption)",
     )
 
 
@@ -67,33 +80,60 @@ def save_sharded(path: str, x, *, name: str = "array",
     """
     os.makedirs(path, exist_ok=True)
     n = int(x.shape[0])
+    compress = str(mca_var.get("io_compress", "none"))
+    checksum = bool(mca_var.get("io_checksum", True))
     manifest = {
         "name": name,
         "dtype": str(np.dtype(x.dtype) if str(x.dtype) != "bfloat16"
                      else "bfloat16"),
         "shape": list(x.shape),
         "num_shards": n,
-        "version": 1,
+        "compress": compress,
+        "version": 2,
     }
+    crcs: List[Optional[int]] = [None] * n
 
     def write_one(i: int) -> int:
         block = np.asarray(
             x[i] if str(x.dtype) != "bfloat16" else x[i].astype("float32")
         )
+        buf = _io.BytesIO()
+        np.save(buf, block)
+        raw = buf.getvalue()
+        if checksum:
+            crcs[i] = zlib.crc32(raw)
         fn = os.path.join(path, f"{name}.shard{i:05d}.npy")
-        with open(fn, "wb") as f:
-            np.save(f, block)
+        opener = gzip.open if compress == "gzip" else open
+        with opener(fn, "wb") as f:
+            f.write(raw)
         _bytes_written.add(block.nbytes)
         return block.nbytes
 
-    with open(os.path.join(path, f"{name}.manifest.json"), "w") as f:
-        json.dump(manifest, f)
     ex = _executor()
     futs = [ex.submit(write_one, i) for i in range(n)]
+
+    def finish() -> None:
+        if checksum:
+            manifest["crc32"] = crcs
+        with open(os.path.join(path, f"{name}.manifest.json"), "w") as f:
+            json.dump(manifest, f)
+
     if async_:
+        writers = list(futs)
+
+        def wait_then_finish() -> int:
+            # FIFO pool: writers were submitted first, so this task
+            # only runs after a worker frees up — no self-deadlock
+            for f in writers:
+                f.result()
+            finish()
+            return 0
+
+        futs.append(ex.submit(wait_then_finish))
         return futs
     for f in futs:
         f.result()
+    finish()
     return None
 
 
@@ -105,10 +145,23 @@ def load_sharded(path: str, *, name: str = "array"):
     with open(mf) as f:
         manifest = json.load(f)
     n = manifest["num_shards"]
+    compress = manifest.get("compress", "none")
+    crcs = manifest.get("crc32")
 
     def read_one(i: int) -> np.ndarray:
         fn = os.path.join(path, f"{manifest['name']}.shard{i:05d}.npy")
-        block = np.load(fn)
+        opener = gzip.open if compress == "gzip" else open
+        with opener(fn, "rb") as f:
+            raw = f.read()
+        if crcs is not None and crcs[i] is not None:
+            got = zlib.crc32(raw)
+            if got != crcs[i]:
+                raise MPIError(
+                    ErrorCode.ERR_IO,
+                    f"checksum mismatch on {fn}: stored {crcs[i]:#x}, "
+                    f"read {got:#x} (corrupt shard)",
+                )
+        block = np.load(_io.BytesIO(raw))
         _bytes_read.add(block.nbytes)
         return block
 

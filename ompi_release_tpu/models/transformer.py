@@ -49,6 +49,10 @@ class ModelConfig:
     microbatches: int = 1  # per-rank microbatch count for the pp schedule
     dtype: Any = jnp.bfloat16
     rope_base: float = 10000.0
+    # attention implementation: "auto" = Pallas flash kernel on TPU when
+    # the sequence is unsharded, ring attention otherwise; "ring" /
+    # "flash" force one path (flash runs interpreted off-TPU)
+    attn_impl: str = "auto"
 
     def validate(self, mesh: Mesh) -> None:
         ax = dict(mesh.shape)
@@ -177,13 +181,28 @@ def _layer(cfg: ModelConfig, lp: Dict, x: jax.Array) -> jax.Array:
     k = _rope(qkv(lp["wk"]), pos, cfg.rope_base)
     v = qkv(lp["wv"])
 
-    # ring attention over sp: (mb, S, H, Dh) -> per-sample (H, S, Dh)
-    attn = jax.vmap(
-        lambda q1, k1, v1: cp.ring_attention(
+    # attention: Pallas flash kernel when the sequence is local to one
+    # device; exact ring attention over the sp axis otherwise
+    if cfg.attn_impl == "flash" and sp_n > 1:
+        raise ValueError(
+            "attn_impl='flash' is single-shard attention; with sp>1 "
+            "use 'ring' (or 'auto', which picks ring for sharded seq)"
+        )
+    use_flash = cfg.attn_impl == "flash" or (
+        cfg.attn_impl == "auto" and sp_n == 1
+        and jax.default_backend() == "tpu"
+    )
+    if use_flash:
+        from ..ops.pallas_attention import flash_attention
+
+        attn_fn = lambda q1, k1, v1: flash_attention(q1, k1, v1, True)
+    else:
+        attn_fn = lambda q1, k1, v1: cp.ring_attention(
             q1, k1, v1, axis_name="sp", causal=True
         )
-    )(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-      v.transpose(0, 2, 1, 3))
+    attn = jax.vmap(attn_fn)(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3))
     attn = attn.transpose(0, 2, 1, 3).reshape(mb, s_loc, hl * cfg.head_dim)
     x = x + tp_mod.row_parallel(attn, lp["wo"], axis_name="tp")
 

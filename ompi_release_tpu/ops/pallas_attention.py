@@ -1,0 +1,161 @@
+"""Pallas flash-attention kernel — the hand-scheduled hot op.
+
+The one place XLA's automatic fusion loses to hand scheduling in this
+framework's model stack is attention: materializing (S, S) scores is
+HBM-bound, while a blocked kernel keeps the working set in VMEM and
+streams K/V blocks through the MXU with an online softmax. This is the
+``op`` framework's accelerated-component story (SURVEY §2.3: "op MCA
+framework exists for accelerated overrides") applied where it matters.
+
+Layout: q/k/v are (H, S, D). Grid = (H, S/block_q); each program owns
+one query block, loops over key blocks with running (max, sumexp)
+statistics in f32. Backward is a custom VJP that recomputes with the
+pure-jnp reference (flash recompute strategy: no (S, S) residuals).
+
+``interpret=True`` runs the same kernel on CPU for CI (the simulator
+backend strategy of SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_k: int,
+                 causal: bool, block_q: int):
+    """One (head, q-block) program: stream K/V blocks, online softmax."""
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    d = q.shape[-1]
+    scale = jax.lax.rsqrt(jnp.float32(d))
+    q = q * scale
+
+    nk = pl.cdiv(seq_k, block_k)
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(jk, carry):
+        acc, row_m, row_l = carry
+        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(
+            jnp.float32
+        )
+        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(
+            jnp.float32
+        )
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < seq_k  # tail padding
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        m = jnp.maximum(row_m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m[:, None])
+        alpha = jnp.exp(row_m - m)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        row_l = row_l * alpha + jnp.sum(p, axis=-1)
+        return acc, m, row_l
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, _, row_l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    out = acc / jnp.maximum(row_l, 1e-30)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    h, s, d = q.shape
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    nq = pl.cdiv(s, bq)
+    nk = pl.cdiv(s, bk)
+    # pad both sequence axes to whole blocks: a dynamic slice whose
+    # start exceeds the buffer gets CLAMPED, which would silently read
+    # the wrong K/V rows on the final partial block
+    pad_q = nq * bq - s
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0)))
+    pad_k = nk * bk - s
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+    sk = s + pad_k
+
+    kernel = functools.partial(
+        _attn_kernel, block_k=bk, seq_k=s, causal=causal, block_q=bq,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(h, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda ih, iq: (ih, iq, 0)),
+            pl.BlockSpec((1, sk, d), lambda ih, iq: (ih, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda ih, iq: (ih, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda ih, iq: (ih, iq, 0)),
+        # under shard_map's replication tracking the kernel output
+        # varies over the same manual axes as its inputs
+        out_shape=jax.ShapeDtypeStruct(
+            (h, nq * bq, d), q.dtype,
+            vma=getattr(jax.typeof(q), "vma", frozenset()),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :s, :]
+
+
+def _reference(q, k, v, causal: bool):
+    d = q.shape[-1]
+    s = jnp.einsum("hqd,hkd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * jax.lax.rsqrt(jnp.float32(d))
+    if causal:
+        n = q.shape[1]
+        i = jnp.arange(n)
+        s = jnp.where(i[:, None] >= i[None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,hkd->hqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Blocked attention. q/k/v: (H, S, D); returns (H, S, D).
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter
+    elsewhere (CI parity runs).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    # flash recompute strategy: the backward re-derives the softmax from
+    # q/k/v (no (S,S) residuals stored); jnp reference keeps it exact
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -113,6 +113,7 @@ class PmlEngine:
         )
         flat = list(comm.submesh.devices.reshape(-1))
         self._devices = flat  # rank -> device
+        self._logger = None  # vprotocol message log, when attached
 
     # -- helpers -----------------------------------------------------------
     def _purge_cancelled(self, dst: int) -> None:
@@ -171,6 +172,12 @@ class PmlEngine:
         data = jnp.asarray(data)
         req = Request()
         entry = _SendEntry(src, dst, tag, data, req, sync)
+        from . import peruse
+
+        peruse.fire(self.comm, peruse.REQ_ACTIVATE, kind="send",
+                    src=src, dst=dst, tag=tag)
+        if self._logger is not None:
+            self._logger.record(src, dst, tag, data, sync)
         with self._lock:
             self._purge_cancelled(dst)
             posted = self._posted[dst]
@@ -204,6 +211,8 @@ class PmlEngine:
                 _rndv_count.add()
             _unexpected_count.add()
             self._unexpected[dst].append(entry)
+        peruse.fire(self.comm, peruse.MSG_UNEX_INSERT, src=src, dst=dst,
+                    tag=tag)
         return req
 
     def send(self, data, dst: int, tag: int = 0, *, src: int,
@@ -228,6 +237,10 @@ class PmlEngine:
             self._check_rank(source, "source")
         req = Request()
         entry = _RecvEntry(dst, source, tag, req)
+        from . import peruse
+
+        peruse.fire(self.comm, peruse.REQ_ACTIVATE, kind="recv",
+                    src=source, dst=dst, tag=tag)
         with self._lock:
             self._purge_cancelled(dst)
             unex = self._unexpected[dst]
@@ -239,6 +252,8 @@ class PmlEngine:
             )
             if match is not None:
                 unex.remove(match)
+                peruse.fire(self.comm, peruse.REQ_MATCH_UNEX,
+                            src=match.src, dst=dst, tag=match.tag)
                 self._deliver(match, entry)
             else:
                 self._posted[dst].append(entry)
@@ -262,6 +277,52 @@ class PmlEngine:
                                   count=int(s.data.size))
         return None
 
+    # -- matched probe (MPI_Mprobe / MPI_Mrecv) ----------------------------
+    def improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG, *,
+                dst: int):
+        """Nonblocking matched probe: removes the matched message from
+        the unexpected queue and returns a message handle (so a later
+        wildcard recv cannot steal it); None when nothing matches."""
+        with self._lock:
+            self._purge_cancelled(dst)
+            unex = self._unexpected[dst]
+            match = next(
+                (s for s in unex
+                 if (source in (ANY_SOURCE, s.src))
+                 and _tag_match(tag, s.tag)),
+                None,
+            )
+            if match is None:
+                return None
+            unex.remove(match)
+            return match  # the message handle
+
+    def mrecv(self, message: "_SendEntry", *, dst: int):
+        """Receive a message handle returned by improbe."""
+        entry = _RecvEntry(dst, message.src, message.tag, Request())
+        self._deliver(message, entry)
+        return entry.request.value, entry.request.status
+
+    def dump_queues(self) -> Dict[str, list]:
+        """Debugger message-queue dump (the TotalView DLL contract,
+        ``ompi/debuggers``): every pending send/recv with its
+        match envelope."""
+        with self._lock:
+            for dst in set(self._unexpected) | set(self._posted):
+                self._purge_cancelled(dst)
+            return {
+                "unexpected": [
+                    {"src": s.src, "dst": s.dst, "tag": s.tag,
+                     "bytes": self._nbytes(s.data),
+                     "protocol": "eager" if s.transferred else "rndv"}
+                    for q in self._unexpected.values() for s in q
+                ],
+                "posted": [
+                    {"dst": r.dst, "source": r.source, "tag": r.tag}
+                    for q in self._posted.values() for r in q
+                ],
+            }
+
     # -- persistent --------------------------------------------------------
     def send_init(self, data, dst: int, tag: int = 0, *, src: int) -> Request:
         def start(req):
@@ -284,12 +345,20 @@ class PmlEngine:
 
     # -- delivery ----------------------------------------------------------
     def _deliver(self, send: _SendEntry, recv: _RecvEntry) -> None:
+        from . import peruse
+
         data = send.data
         if not send.transferred:
+            peruse.fire(self.comm, peruse.REQ_XFER_BEGIN, src=send.src,
+                        dst=recv.dst, tag=send.tag)
             data = self._move(data, recv.dst)  # rendezvous pull
         st = Status(source=send.src, tag=send.tag, count=int(data.size))
         recv.request.complete(value=data, status=st)
         send.request.complete(status=Status(source=send.src, tag=send.tag))
+        peruse.fire(self.comm, peruse.REQ_XFER_END, src=send.src,
+                    dst=recv.dst, tag=send.tag, count=int(data.size))
+        peruse.fire(self.comm, peruse.REQ_COMPLETE, src=send.src,
+                    dst=recv.dst, tag=send.tag)
         _log.verbose(
             3,
             f"{self.comm.name}: delivered src={send.src} dst={send.dst} "

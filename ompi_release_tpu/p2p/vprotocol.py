@@ -1,0 +1,67 @@
+"""Sender-based message logging — the vprotocol/pessimist analogue.
+
+The reference's pessimistic message-logging FT
+(``ompi/mca/vprotocol/pessimist/vprotocol_pessimist.h:19-35``) keeps a
+sender-side payload log + event order so a restarted process can be
+fed exactly the messages it saw. Driver-mode recast: attach a logger
+to a communicator's PML and every send is recorded (payload handles
+are immutable jax arrays — the log IS the sender-based payload log);
+``replay`` re-issues them in order against a fresh engine, and the
+deterministic matching engine reproduces the original delivery order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+from ..mca import pvar
+from ..utils import output
+
+_log = output.stream("vprotocol")
+_logged = pvar.counter("vprotocol_logged_sends", "sends captured in the log")
+
+
+@dataclasses.dataclass
+class LoggedSend:
+    seq: int
+    src: int
+    dst: int
+    tag: int
+    data: Any
+    sync: bool
+
+
+class MessageLog:
+    def __init__(self) -> None:
+        self.events: List[LoggedSend] = []
+
+    def record(self, src: int, dst: int, tag: int, data, sync: bool
+               ) -> None:
+        _logged.add()
+        self.events.append(
+            LoggedSend(len(self.events), src, dst, tag, data, sync)
+        )
+
+    def replay(self, pml) -> int:
+        """Re-issue every logged send in order on ``pml``; the
+        deterministic matching engine reproduces delivery order."""
+        for ev in self.events:
+            pml.isend(ev.data, ev.dst, ev.tag, src=ev.src, sync=False)
+        return len(self.events)
+
+    def clear(self) -> None:
+        self.events.clear()
+
+
+def attach(comm) -> MessageLog:
+    """Enable pessimistic send logging on this communicator's PML."""
+    log = MessageLog()
+    comm.pml._logger = log
+    return log
+
+
+def detach(comm) -> None:
+    pml = getattr(comm, "_pml", None)
+    if pml is not None:
+        pml._logger = None

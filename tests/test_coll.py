@@ -293,3 +293,64 @@ def test_bitwise_parity_ring_vs_linear(tuned):
         for k in tuned._coll_programs
     )
     np.testing.assert_array_equal(a, b)  # bitwise
+
+
+class TestHierarchicalMl:
+    """coll/ml two-level algorithms (forced hierarchy: 2 nodes x 4)."""
+
+    @pytest.fixture()
+    def ml(self, world):
+        mca_var.set_value("coll_ml_local_size", 4)
+        mca_var.set_value("coll", "ml,basic")  # basic backfills the rest
+        try:
+            c = world.dup(name="ml_dup")
+        finally:
+            mca_var.VARS.unset("coll")
+        yield c
+        mca_var.VARS.unset("coll_ml_local_size")
+        c.free()
+
+    def test_ml_selected_for_allreduce(self, ml):
+        assert ml._coll_providers["allreduce"][0] == "ml"
+
+    def test_two_level_allreduce_parity(self, ml):
+        x = _per_rank(ml, 1000, seed=51)
+        out = ml.allreduce(x, ops.SUM)
+        assert any(k[0] == "ml" for k in ml._coll_programs)
+        for r in range(ml.size):
+            np.testing.assert_allclose(
+                np.asarray(out[r]), x.sum(axis=0), rtol=2e-5, atol=1e-4
+            )
+
+    def test_two_level_allreduce_nondivisible(self, ml):
+        x = _per_rank(ml, 37, seed=52)  # 37 % 4 != 0: padding path
+        out = ml.allreduce(x, ops.MAX)
+        np.testing.assert_array_equal(
+            np.asarray(out[0]), x.max(axis=0)
+        )
+
+    def test_two_level_bcast(self, ml):
+        x = _per_rank(ml, 64, seed=53)
+        out = ml.bcast(x, root=5)
+        for r in range(ml.size):
+            np.testing.assert_array_equal(np.asarray(out[r]), x[5])
+
+    def test_ml_declines_noncommutative(self, ml):
+        left = ops.user_op("left", lambda a, b: a, commute=False)
+        x = _per_rank(ml, 16, seed=54)
+        out = ml.allreduce(x, left)  # falls through to basic
+        np.testing.assert_allclose(np.asarray(out[0]), x[0], rtol=1e-6)
+
+    def test_ml_declines_without_hierarchy(self, world):
+        # no forced local size, all endpoints share one process: ml
+        # must not claim the comm
+        mca_var.set_value("coll", "ml,basic")
+        try:
+            c = world.dup(name="no_ml")
+        finally:
+            mca_var.VARS.unset("coll")
+        assert c._coll_providers["allreduce"] == ["basic"]
+        c.free()
+
+    def test_ml_barrier(self, ml):
+        ml.barrier()

@@ -135,3 +135,18 @@ class TestMoE:
         l0, l1 = run_step(cfg, mesh, params, tokens, targets)
         assert np.isfinite(l0) and np.isfinite(l1)
         assert l1 < l0
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="interpret-mode pallas under shard_map hits a jax vma bug "
+           "(dynamic_slice varying-axes mismatch); the compiled path is "
+           "verified on TPU, and the kernel itself is covered by "
+           "tests/test_pallas.py",
+)
+def test_flash_attention_path_matches_ring(setup):
+    """Forcing the Pallas flash path must agree with ring attention."""
+    cfg_ring, params, tokens, targets, mesh1, ref = setup
+    cfg_flash = tfm.ModelConfig(**{**CFG, "attn_impl": "flash"})
+    got = run_loss(cfg_flash, mesh1, params, tokens, targets)
+    assert got == pytest.approx(ref, rel=1e-4, abs=1e-5)
