@@ -24,8 +24,6 @@ import collections
 import threading
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
-import jax
-
 from ..mca import component as mca_component
 from ..mca import pvar
 from ..mca import var as mca_var
@@ -54,13 +52,15 @@ PML_FRAMEWORK = mca_component.framework(
 
 def register_vars() -> None:
     mca_var.register(
-        "pml_eager_limit", "size", 64 * 1024,
-        "Messages up to this many bytes move at send time "
-        "(btl_tcp_component.c:268 eager limit)",
+        "pml_eager_limit", "size", 0,
+        "Override: messages up to this many bytes move at send time; "
+        "0 = use the selected btl endpoint's eager_limit "
+        "(btl_tcp_component.c:268 analogue)",
     )
     mca_var.register(
-        "pml_max_send_size", "size", 16 * 1024 * 1024,
-        "Messages beyond this many bytes move as overlapping segments "
+        "pml_max_send_size", "size", 0,
+        "Override: messages beyond this many bytes move as overlapping "
+        "segments; 0 = use the btl endpoint's max_send_size "
         "(btl.h:802 rdma pipeline)",
     )
 
@@ -114,6 +114,10 @@ class PmlEngine:
         flat = list(comm.submesh.devices.reshape(-1))
         self._devices = flat  # rank -> device
         self._logger = None  # vprotocol message log, when attached
+        # per-peer transfer plans through the btl framework (bml/r2)
+        from ..btl import BmlR2
+
+        self._bml = BmlR2(comm)
 
     # -- helpers -----------------------------------------------------------
     def _purge_cancelled(self, dst: int) -> None:
@@ -137,25 +141,22 @@ class PmlEngine:
     def _nbytes(self, data) -> int:
         return int(data.size * data.dtype.itemsize)
 
-    def _move(self, data, dst_rank: int):
-        """The btl/tpu transfer: device-to-device put (ICI/DCN chosen by
-        the runtime), segmented beyond max_send_size so segments
-        overlap in flight."""
-        import jax.numpy as jnp
+    def _eager_limit(self, src_rank: int, dst_rank: int) -> int:
+        """Per-peer eager threshold: pml override, else the btl
+        endpoint's (ob1 reads the btl's eager size the same way)."""
+        override = mca_var.get("pml_eager_limit", 0)
+        if override:
+            return int(override)
+        return self._bml.endpoint(src_rank, dst_rank).eager_limit
 
-        dev = self._devices[dst_rank]
-        max_send = mca_var.get("pml_max_send_size", 16 * 1024 * 1024)
-        nbytes = self._nbytes(data)
-        if nbytes <= max_send or data.ndim == 0:
-            return jax.device_put(data, dev)
-        _pipeline_count.add()
-        flat = data.reshape(-1)
-        seg_elems = max(1, max_send // data.dtype.itemsize)
-        segs = [
-            jax.device_put(flat[off:off + seg_elems], dev)
-            for off in range(0, flat.shape[0], seg_elems)
-        ]
-        return jnp.concatenate(segs).reshape(data.shape)
+    def _move(self, data, src_rank: int, dst_rank: int):
+        """Transfer through the per-peer BML endpoint: the btl
+        framework picks the fabric (self/ici/dcn/host) and segments
+        beyond max_send_size so segments overlap in flight."""
+        ep = self._bml.endpoint(src_rank, dst_rank)
+        max_send = int(mca_var.get("pml_max_send_size", 0)) or None
+        return ep.move(data, max_send=max_send,
+                       on_pipeline=_pipeline_count.add)
 
     # -- send --------------------------------------------------------------
     def isend(self, data, dst: int, tag: int = 0, *, src: int,
@@ -197,11 +198,10 @@ class PmlEngine:
                     f"rsend with no posted recv (src={src} dst={dst} "
                     f"tag={tag})",
                 )
-            eager_limit = mca_var.get("pml_eager_limit", 64 * 1024)
-            if self._nbytes(data) <= eager_limit:
+            if self._nbytes(data) <= self._eager_limit(src, dst):
                 # eager: move now; sender side is complete immediately
                 _eager_count.add()
-                entry.data = self._move(data, dst)
+                entry.data = self._move(data, src, dst)
                 entry.transferred = True
                 if not sync:
                     req.complete(status=Status(source=src, tag=tag))
@@ -351,7 +351,7 @@ class PmlEngine:
         if not send.transferred:
             peruse.fire(self.comm, peruse.REQ_XFER_BEGIN, src=send.src,
                         dst=recv.dst, tag=send.tag)
-            data = self._move(data, recv.dst)  # rendezvous pull
+            data = self._move(data, send.src, recv.dst)  # rendezvous pull
         st = Status(source=send.src, tag=send.tag, count=int(data.size))
         recv.request.complete(value=data, status=st)
         send.request.complete(status=Status(source=send.src, tag=send.tag))

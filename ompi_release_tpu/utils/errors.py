@@ -44,6 +44,7 @@ class ErrorCode(enum.IntEnum):
     ERR_FILE = 27
     ERR_NO_MEM = 34
     ERR_NOT_AVAILABLE = 100
+    ERR_UNREACH = 101  # OMPI_ERR_UNREACH: no transport reaches the peer
 
 
 class MPIError(RuntimeError):

@@ -1,0 +1,210 @@
+"""btl/bml transport framework tests.
+
+The reference's per-peer transfer plan: add_procs-style reachability,
+exclusivity tiers, latency/bandwidth-sorted eager/send/rdma lists and
+weighted rail striping (``ompi/mca/btl/btl.h:795-838``,
+``ompi/mca/bml/bml.h:71,229``).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu import btl as btl_mod
+from ompi_release_tpu.btl import base as btl_base
+from ompi_release_tpu.btl import components as btl_comps
+from ompi_release_tpu.mca import var as mca_var
+from ompi_release_tpu.runtime.mesh import Endpoint
+from ompi_release_tpu.utils.errors import MPIError
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+def _ep(rank, slice_index=0, process_index=0, platform="cpu"):
+    return Endpoint(
+        rank=rank, device_id=rank, process_index=process_index,
+        platform=platform, device_kind="test", coords=(rank,),
+        slice_index=slice_index,
+    )
+
+
+class TestReachability:
+    def test_self_owns_loopback(self):
+        m = btl_comps.SelfBtl()
+        assert m.reachable(_ep(3), _ep(3))
+        assert not m.reachable(_ep(3), _ep(4))
+
+    def test_ici_same_slice_only(self):
+        m = btl_comps.IciBtl()
+        assert m.reachable(_ep(0), _ep(1))
+        assert not m.reachable(_ep(0), _ep(1, slice_index=1))
+        assert not m.reachable(_ep(0), _ep(0))  # loopback is self's
+
+    def test_dcn_cross_slice_or_process(self):
+        m = btl_comps.DcnBtl()
+        assert m.reachable(_ep(0), _ep(1, slice_index=1))
+        assert m.reachable(_ep(0), _ep(1, process_index=1))
+        assert not m.reachable(_ep(0), _ep(1))
+
+    def test_host_reaches_everything(self):
+        m = btl_comps.HostBtl()
+        assert m.reachable(_ep(0), _ep(1, slice_index=9, process_index=9))
+
+
+class TestEndpointLists:
+    def _modules(self):
+        return [btl_comps.SelfBtl(), btl_comps.IciBtl(),
+                btl_comps.DcnBtl(), btl_comps.HostBtl()]
+
+    def test_exclusivity_tiers(self):
+        """Loopback pairs keep only self; same-slice pairs keep only
+        ici (host drops: lower exclusivity) — btl.h:797 semantics."""
+        dev = None
+        ep = btl_base.BmlEndpoint(_ep(0), _ep(0), dev, self._modules())
+        assert [m.NAME for m in ep.btl_eager] == ["self"]
+        ep = btl_base.BmlEndpoint(_ep(0), _ep(1), dev, self._modules())
+        assert [m.NAME for m in ep.btl_eager] == ["ici"]
+        ep = btl_base.BmlEndpoint(
+            _ep(0), _ep(1, slice_index=1), dev, self._modules()
+        )
+        assert [m.NAME for m in ep.btl_eager] == ["dcn"]
+
+    def test_unreachable_raises(self):
+        with pytest.raises(MPIError):
+            btl_base.BmlEndpoint(
+                _ep(0), _ep(1), None, [btl_comps.SelfBtl()]
+            )
+
+    def test_rdma_sorted_by_bandwidth_eager_by_latency(self):
+        class A(btl_comps.IciBtl):
+            NAME = "railA"
+            LATENCY = 5
+            BANDWIDTH = 100
+            EXCLUSIVITY = 7
+
+        class B(btl_comps.IciBtl):
+            NAME = "railB"
+            LATENCY = 1
+            BANDWIDTH = 50
+            EXCLUSIVITY = 7
+
+        ep = btl_base.BmlEndpoint(_ep(0), _ep(1), None, [A(), B()])
+        assert [m.NAME for m in ep.btl_eager] == ["railB", "railA"]
+        assert [m.NAME for m in ep.btl_rdma] == ["railA", "railB"]
+
+
+class TestStriping:
+    def test_rail_schedule_weighted_by_bandwidth(self):
+        class A(btl_comps.IciBtl):
+            NAME = "rail3x"
+            BANDWIDTH = 300
+            EXCLUSIVITY = 7
+
+        class B(btl_comps.IciBtl):
+            NAME = "rail1x"
+            BANDWIDTH = 100
+            EXCLUSIVITY = 7
+
+        ep = btl_base.BmlEndpoint(_ep(0), _ep(1), None, [A(), B()])
+        sched = ep._rail_schedule(8)
+        assert len(sched) == 8
+        # 3:1 bandwidth ratio -> 6 segments on rail0, 2 on rail1
+        assert sched.count(0) == 6 and sched.count(1) == 2
+        # interleaved, not blocked: the first two segments use both rails
+        assert set(sched[:2]) == {0, 1}
+
+    def test_striped_move_correct_and_counted(self, world):
+        """A pipelined transfer across 2 rails reassembles exactly and
+        bumps the striping pvar."""
+        from ompi_release_tpu.mca import pvar
+
+        class A(btl_comps.IciBtl):
+            NAME = "ici"
+            EXCLUSIVITY = 7
+
+        class B(btl_comps.IciBtl):
+            NAME = "host"  # reuse registered var names
+            BANDWIDTH = 15_000
+            EXCLUSIVITY = 7
+
+        devs = list(world.submesh.devices.reshape(-1))
+        ep = btl_base.BmlEndpoint(_ep(0), _ep(1), devs[1], [A(), B()])
+        x = jnp.arange(5000, dtype=jnp.float32)
+        before = btl_base._striped_moves.read()
+        out = ep.move(x, max_send=4096)  # 1024 f32 per segment -> 5 segs
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+        assert out.device == devs[1]
+        assert btl_base._striped_moves.read() == before + 1
+
+
+class TestSelection:
+    def test_framework_selection_var(self, world):
+        """--mca btl host,self forces the host-staged path (the
+        'force tcp,self on a verbs cluster' debugging move)."""
+        mca_var.set_value("btl", "host,self")
+        try:
+            bml = btl_mod.BmlR2(world)
+            ep = bml.endpoint(0, 1)
+            assert [m.NAME for m in ep.btl_eager] == ["host"]
+            devs = list(world.submesh.devices.reshape(-1))
+            x = jnp.arange(64, dtype=jnp.int32)
+            out = ep.move(x)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+            assert out.device == devs[1]
+        finally:
+            mca_var.VARS.unset("btl")
+
+    def test_default_world_endpoints(self, world):
+        bml = btl_mod.BmlR2(world)
+        assert [m.NAME for m in bml.endpoint(0, 0).btl_eager] == ["self"]
+        assert [m.NAME for m in bml.endpoint(0, 1).btl_eager] == ["ici"]
+
+    def test_attribute_vars_override(self, world):
+        """btl_<name>_<attr> MCA variables steer the live module."""
+        mca_var.set_value("btl_ici_eager_limit", 128)
+        try:
+            bml = btl_mod.BmlR2(world)
+            assert bml.endpoint(0, 1).eager_limit == 128
+        finally:
+            mca_var.VARS.unset("btl_ici_eager_limit")
+
+
+class TestPmlIntegration:
+    def test_send_goes_through_btl_accounting(self, world):
+        """A send's bytes land on the selected btl's byte counter."""
+        sub = world.dup(name="btl_acct")
+        eng = sub.pml
+        ici = eng._bml.endpoint(0, 1).btl_eager[0]
+        assert ici.NAME == "ici"
+        before = ici.bytes_pvar.read()
+        sub.send(jnp.arange(100, dtype=jnp.float32), dest=1, tag=5, rank=0)
+        v, st = sub.recv(source=0, tag=5, rank=1)
+        np.testing.assert_array_equal(np.asarray(v), np.arange(100))
+        assert ici.bytes_pvar.read() == before + 400
+        sub.free()
+
+    def test_per_peer_eager_limit_drives_protocol(self, world):
+        """Shrinking the ici eager limit flips sends to rendezvous."""
+        from ompi_release_tpu.p2p.pml import _rndv_count
+
+        sub = world.dup(name="btl_proto")
+        mca_var.set_value("btl_ici_eager_limit", 4)
+        try:
+            before = _rndv_count.read()
+            r = sub.isend(jnp.arange(64, dtype=jnp.float32), 1, 7, rank=0)
+            assert _rndv_count.read() == before + 1
+            v, _ = sub.recv(source=0, tag=7, rank=1)
+            np.testing.assert_array_equal(
+                np.asarray(v), np.arange(64, dtype=np.float32)
+            )
+            r.wait()
+        finally:
+            mca_var.VARS.unset("btl_ici_eager_limit")
+            sub.free()
