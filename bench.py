@@ -4,19 +4,30 @@ Mirrors BASELINE.json config #2 (OSU-style MPI_Allreduce sweep; the
 north-star size is 256 MiB f32). With n >= 2 devices this times the
 framework's psum allreduce over a 1-D mesh and reports ring bus
 bandwidth 2(n-1)/n * bytes / t. On a single chip (the driver's bench
-environment) it times the on-device SUM op kernel (out = acc + a, the
-``ompi/op`` hot loop of BASELINE's north star): 3x bytes through HBM
-per iteration.
+environment) it times the on-device SUM op hot loop (out = acc*c + a,
+the ``ompi/op`` kernel of BASELINE's north star, read acc + read a +
+write = 3x bytes through HBM per iteration) using the Pallas streaming
+kernel from ``ompi_release_tpu/ops/pallas_op.py``.
+
+Both the measured kernel and the ceiling are Pallas calls on purpose:
+a pallas_call is opaque to XLA, so the timing loop cannot be
+algebraically folded across iterations (an XLA-level axpy loop CAN be:
+acc*c+a twice = acc*c^2 + (ac+a) — which silently inflates the
+number). Round-1's 0.707 ratio came from exactly that instability in
+the ceiling kernel plus short-loop noise.
 
 Timing method: the tunneled single-chip backend has ~100 ms fixed
 per-call round-trip latency, so each measurement jits a fori_loop of K
 kernel iterations and takes the slope between K_lo and K_hi — pure
-device time, latency cancelled. Completion is forced by fetching an
+device time, latency cancelled. K_hi = 258 keeps the slope well above
+the tunnel's ms-scale jitter (sub-ms kernels at K_hi = 66 measured an
+impossible > HBM-peak ceiling). Completion is forced by fetching an
 8-byte checksum (block_until_ready alone can return early through the
 tunnel).
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so
-the baseline is the measured HBM copy ceiling of the same chip — the
+the baseline is the measured HBM copy ceiling of the same chip (the
+2-stream Pallas scale kernel, ~818 GB/s on v5e = its spec sheet) — the
 ratio is "fraction of achievable memory bandwidth", target >= 0.8 per
 the north star.
 
@@ -30,27 +41,41 @@ from functools import partial
 
 import numpy as np
 
-K_LO, K_HI = 2, 66
+K_LO, K_HI = 2, 258
 
 
-def _median_call(fn, *args, iters=5):
-    def sync(r):
-        np.asarray(r)  # tiny checksum fetch forces remote completion
+def _sync(r):
+    np.asarray(r)  # tiny checksum fetch forces remote completion
 
-    sync(fn(*args))  # compile + warm
-    ts = []
+
+def _timed(fn, *args):
+    t0 = time.perf_counter()
+    _sync(fn(*args))
+    return time.perf_counter() - t0
+
+
+def _per_iter_times(measurements, iters=5):
+    """Interleaved slope timing for several loops at once.
+
+    measurements: list of (loop_fn, args). Interleaving the K_lo/K_hi
+    samples of all loops round-robin cancels slow clock/thermal drift
+    between measurement phases (a sequential A-then-B measurement puts
+    all of B's samples minutes after A's and skews any A/B ratio).
+    """
+    for fn, args in measurements:  # compile + warm both K values
+        _sync(fn(*args, K_LO))
+        _sync(fn(*args, K_HI))
+    lo = [[] for _ in measurements]
+    hi = [[] for _ in measurements]
     for _ in range(iters):
-        t0 = time.perf_counter()
-        sync(fn(*args))
-        ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
-
-
-def _per_iter_time(loop_fn, *args):
-    """Seconds per kernel iteration via the K_hi/K_lo slope."""
-    t_lo = _median_call(loop_fn, *args, K_LO)
-    t_hi = _median_call(loop_fn, *args, K_HI)
-    return max((t_hi - t_lo) / (K_HI - K_LO), 1e-12)
+        for i, (fn, args) in enumerate(measurements):
+            lo[i].append(_timed(fn, *args, K_LO))
+            hi[i].append(_timed(fn, *args, K_HI))
+    out = []
+    for i in range(len(measurements)):
+        slope = (np.median(hi[i]) - np.median(lo[i])) / (K_HI - K_LO)
+        out.append(max(float(slope), 1e-12))
+    return out
 
 
 def main():
@@ -58,6 +83,8 @@ def main():
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ompi_release_tpu.ops import pallas_op
 
     devices = jax.devices()
     n = len(devices)
@@ -85,41 +112,35 @@ def main():
                               out_specs=P("rank"))(x)
             return s[0]
 
-        per = _per_iter_time(allreduce_loop, x)
-        # each rank holds `elems` f32; the ring moves 2(n-1)/n of the
-        # full payload per allreduce
-        value = (2 * (n - 1) / n) * size_bytes / per / 1e9
+        metric_loop, metric_args = allreduce_loop, (x,)
+        streams = None  # bus-bandwidth formula below
         metric = f"allreduce_256MiB_f32_busbw_{n}dev"
     else:
-        a = jax.device_put(jnp.ones((elems,), jnp.float32), devices[0])
-
-        @partial(jax.jit, static_argnums=1)
-        def op_loop(a, k):
-            def body(i, acc):
-                return acc * np.float32(0.999) + a  # read acc,a; write
-
-            acc = lax.fori_loop(0, k, body, jnp.zeros_like(a))
-            return acc[0] + acc[-1]
-
-        per = _per_iter_time(op_loop, a)
-        value = 3 * size_bytes / per / 1e9
+        cols = pallas_op.AXPY_BLOCK[1]
+        rows = elems // cols
+        a = jax.device_put(
+            jnp.ones((rows, cols), jnp.float32), devices[0]
+        )
+        metric_loop = pallas_op.make_axpy_loop(rows, cols)
+        metric_args = (a,)
+        streams = 3
         metric = "op_sum_256MiB_f32_hbm_bw"
 
     # HBM copy ceiling on device 0: read + write = 2x bytes per iter
-    c = jax.device_put(jnp.ones((elems,), jnp.float32), devices[0])
+    c_cols = pallas_op.SCALE_BLOCK[1]
+    c_rows = elems // c_cols
+    c = jax.device_put(
+        jnp.ones((c_rows, c_cols), jnp.float32), devices[0]
+    )
+    copy_loop = pallas_op.make_scale_loop(c_rows, c_cols)
 
-    @partial(jax.jit, static_argnums=1)
-    def copy_loop(c, k):
-        def body(i, acc):
-            # add the (varying) loop counter: a streaming read+write
-            # XLA cannot algebraically collapse across iterations (a
-            # constant multiply/add chain gets folded to one op)
-            return acc + lax.convert_element_type(i, jnp.float32)
-
-        acc = lax.fori_loop(0, k, body, c)
-        return acc[0] + acc[-1]
-
-    per_copy = _per_iter_time(copy_loop, c)
+    per, per_copy = _per_iter_times(
+        [(metric_loop, metric_args), (copy_loop, (c,))]
+    )
+    if streams is None:
+        value = (2 * (n - 1) / n) * size_bytes / per / 1e9
+    else:
+        value = streams * size_bytes / per / 1e9
     ceiling = 2 * size_bytes / per_copy / 1e9
 
     print(json.dumps({

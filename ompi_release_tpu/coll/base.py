@@ -22,6 +22,8 @@ _log = output.stream("coll")
 OP_NAMES = (
     "allreduce", "reduce", "bcast", "allgather", "gather", "scatter",
     "reduce_scatter_block", "alltoall", "scan", "exscan", "barrier",
+    # v-variants (per-rank counts; coll_tuned_alltoallv.c etc.)
+    "alltoallv", "allgatherv", "gatherv", "scatterv", "reduce_scatter",
 )
 
 COLL_FRAMEWORK = mca_component.framework(

@@ -59,6 +59,11 @@ class _XlaModule:
             "scan": self.scan,
             "exscan": self.exscan,
             "barrier": self.barrier,
+            "alltoallv": self.alltoallv,
+            "allgatherv": self.allgatherv,
+            "gatherv": self.gatherv,
+            "scatterv": self.scatterv,
+            "reduce_scatter": self.reduce_scatter,
         }
 
     # each driver fn: key identifies the compiled program; all static
@@ -166,6 +171,32 @@ class _XlaModule:
         )
         jax.block_until_ready(out)
 
+    # -- v-variants (padded lax kernels, counts at the driver edge) --------
+    def alltoallv(self, comm, sendbufs, sendcounts):
+        from . import vcoll
+
+        return vcoll.alltoallv(comm, sendbufs, sendcounts, kernel="lax")
+
+    def allgatherv(self, comm, sendbufs):
+        from . import vcoll
+
+        return vcoll.allgatherv(comm, sendbufs, kernel="lax")
+
+    def gatherv(self, comm, sendbufs, root: int):
+        from . import vcoll
+
+        return vcoll.gatherv(comm, sendbufs, root, kernel="lax")
+
+    def scatterv(self, comm, sendbuf, counts, root: int):
+        from . import vcoll
+
+        return vcoll.scatterv(comm, sendbuf, counts, root)
+
+    def reduce_scatter(self, comm, x, recvcounts, op: Op):
+        from . import vcoll
+
+        return vcoll.reduce_scatter(comm, x, recvcounts, op, kernel="lax")
+
 
 class XlaCollComponent(mca_component.Component):
     NAME = "xla"
@@ -214,6 +245,11 @@ class _TunedModule:
             "scan": self.scan,
             "exscan": self.exscan,
             "barrier": self.barrier,
+            "alltoallv": self.alltoallv,
+            "allgatherv": self.allgatherv,
+            "gatherv": self.gatherv,
+            "scatterv": self.scatterv,
+            "reduce_scatter": self.reduce_scatter,
         }
 
     # -- allreduce --------------------------------------------------------
@@ -358,6 +394,35 @@ class _TunedModule:
         )
         jax.block_until_ready(out)
 
+    # -- v-variants: tuned's hand schedules on the padded kernels ----------
+    def alltoallv(self, comm, sendbufs, sendcounts):
+        from . import vcoll
+
+        return vcoll.alltoallv(comm, sendbufs, sendcounts,
+                               kernel="pairwise")
+
+    def allgatherv(self, comm, sendbufs):
+        from . import vcoll
+
+        return vcoll.allgatherv(comm, sendbufs, kernel="ring")
+
+    def gatherv(self, comm, sendbufs, root: int):
+        from . import vcoll
+
+        return vcoll.gatherv(comm, sendbufs, root, kernel="ring")
+
+    def scatterv(self, comm, sendbuf, counts, root: int):
+        from . import vcoll
+
+        return vcoll.scatterv(comm, sendbuf, counts, root)
+
+    def reduce_scatter(self, comm, x, recvcounts, op: Op):
+        if not op.commutative or op.identity is None:
+            return None  # xla's allreduce+slice path handles these
+        from . import vcoll
+
+        return vcoll.reduce_scatter(comm, x, recvcounts, op, kernel="ring")
+
 
 class TunedCollComponent(mca_component.Component):
     NAME = "tuned"
@@ -497,7 +562,70 @@ class _SelfModule:
             "scan": lambda comm, x, op: jnp.asarray(x),
             "exscan": lambda comm, x, op: jnp.zeros_like(jnp.asarray(x)),
             "barrier": lambda comm: None,
+            # v-variants on one rank: local identities, but with the
+            # SAME validation + 1-D flattening contract as the vcoll
+            # path so callers see identical shapes on any comm size
+            "alltoallv": self._alltoallv,
+            "allgatherv": self._allgatherv,
+            "gatherv": lambda comm, bufs, root: self._allgatherv(comm, bufs),
+            "scatterv": self._scatterv,
+            "reduce_scatter": self._reduce_scatter,
         }
+
+    @staticmethod
+    def _alltoallv(comm, bufs, counts):
+        from . import vcoll
+
+        b = vcoll._as_1d_arrays(bufs, 1, "alltoallv")
+        c = vcoll._counts_matrix(counts, 1)
+        if b[0].shape[0] != int(c[0, 0]):
+            from ..utils.errors import ErrorCode, MPIError
+
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"alltoallv buffer has {b[0].shape[0]} elements, count "
+                f"is {int(c[0, 0])}",
+            )
+        return [jnp.asarray(b[0])]
+
+    @staticmethod
+    def _allgatherv(comm, bufs):
+        from . import vcoll
+
+        return jnp.asarray(vcoll._as_1d_arrays(bufs, 1, "allgatherv")[0])
+
+    @staticmethod
+    def _scatterv(comm, buf, counts, root):
+        import numpy as _np
+
+        from ..utils.errors import ErrorCode, MPIError
+
+        if root != 0:
+            raise MPIError(ErrorCode.ERR_ROOT, f"bad root {root}")
+        flat = _np.asarray(buf).reshape(-1)
+        counts = [int(k) for k in counts]
+        if len(counts) != 1 or flat.shape[0] != counts[0]:
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"scatterv needs 1 count matching the buffer length",
+            )
+        return [jnp.asarray(flat)]
+
+    @staticmethod
+    def _reduce_scatter(comm, x, counts, op):
+        import numpy as _np
+
+        from ..utils.errors import ErrorCode, MPIError
+
+        flat = _np.asarray(x).reshape(-1)
+        counts = [int(k) for k in counts]
+        if len(counts) != 1 or flat.shape[0] != counts[0]:
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                "reduce_scatter on a self comm needs x of shape "
+                "(1, recvcounts[0])",
+            )
+        return [jnp.asarray(flat)]
 
 
 class SelfCollComponent(mca_component.Component):

@@ -1,0 +1,259 @@
+"""v-variant collectives: per-rank counts with static-shape kernels.
+
+The reference implements MPI_Alltoallv/Allgatherv/Gatherv/Scatterv and
+general MPI_Reduce_scatter as count/displacement-driven send/recv loops
+(``ompi/mca/coll/tuned/coll_tuned_alltoallv.c``, ``coll_base``
+linear variants). XLA needs static shapes, so the TPU-native design
+splits each v-collective in two:
+
+  driver edge (here, host numpy)   ragged per-rank buffers <-> one
+                                   padded rectangular array (pad to the
+                                   max count; op identity as filler)
+  compiled kernel (coll/spmd.py)   the equal-block collective on the
+                                   padded array — one persistent
+                                   program per (n, cmax, dtype), counts
+                                   NOT baked in
+
+so arbitrary count matrices reuse one compiled program per padded
+shape: changing counts changes only the edge slicing, never triggers a
+retrace (the "no per-call retrace" north-star requirement applies to
+varying ragged workloads too — this is why counts live at the edge).
+
+Driver-mode conventions (matching ``comm/communicator.py``):
+rank-dependent inputs/outputs are Python lists indexed by rank (ragged
+lengths make a leading-axis array impossible); results identical on
+every rank are returned once.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.op import Op
+from ..utils.errors import ErrorCode, MPIError
+from . import spmd
+from .driver import run_sharded
+
+AXIS = "rank"
+
+
+def _as_1d_arrays(bufs, n: int, what: str) -> List[np.ndarray]:
+    if len(bufs) != n:
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"{what} needs one buffer per rank ({n}), got {len(bufs)}",
+        )
+    out = [np.asarray(b).reshape(-1) for b in bufs]
+    dtypes = {a.dtype for a in out}
+    if len(dtypes) != 1:
+        raise MPIError(
+            ErrorCode.ERR_TYPE,
+            f"{what} buffers must share one dtype, got {sorted(map(str, dtypes))}",
+        )
+    return out
+
+
+def _counts_matrix(counts, n: int) -> np.ndarray:
+    c = np.asarray(counts, dtype=np.int64)
+    if c.shape != (n, n) or (c < 0).any():
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"need a non-negative ({n},{n}) count matrix, got {c.shape}",
+        )
+    return c
+
+
+# ---------------------------------------------------------------------------
+# alltoallv
+# ---------------------------------------------------------------------------
+
+def alltoallv(comm, sendbufs: Sequence, sendcounts, *,
+              kernel: str = "lax") -> List:
+    """Every rank sends ``sendcounts[i][j]`` elements to rank j.
+
+    ``sendbufs[i]`` = rank i's send buffer: the chunks for ranks
+    0..n-1 back to back (MPI's sdispls are implicit/contiguous; pass
+    pre-sliced data for the general displacement case). Returns
+    ``recv[i]`` = concatenation of chunks from ranks 0..n-1 in source
+    order — exactly MPI_Alltoallv's receive layout.
+    """
+    n = comm.size
+    bufs = _as_1d_arrays(sendbufs, n, "alltoallv")
+    c = _counts_matrix(sendcounts, n)
+    for i in range(n):
+        if bufs[i].shape[0] != int(c[i].sum()):
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"alltoallv rank {i}: buffer has {bufs[i].shape[0]} "
+                f"elements, counts sum to {int(c[i].sum())}",
+            )
+    cmax = max(1, int(c.max()))
+    dtype = bufs[0].dtype
+    padded = np.zeros((n, n, cmax), dtype=dtype)
+    offs = np.concatenate(
+        [np.zeros((n, 1), np.int64), np.cumsum(c, axis=1)], axis=1
+    )
+    for i in range(n):
+        for j in range(n):
+            k = int(c[i, j])
+            if k:
+                padded[i, j, :k] = bufs[i][offs[i, j]:offs[i, j] + k]
+
+    body = (spmd.alltoall_lax if kernel == "lax"
+            else spmd.alltoall_pairwise)
+    out = run_sharded(
+        comm, (kernel, "alltoallv", n, cmax, str(dtype)),
+        lambda xb: body(xb, AXIS, n), jnp.asarray(padded),
+    )
+    out = np.asarray(out)  # (n, n, cmax); out[i, j] = chunk j -> i
+    recv = []
+    for i in range(n):
+        parts = [out[i, j, : int(c[j, i])] for j in range(n)]
+        recv.append(jnp.asarray(np.concatenate(parts) if parts
+                                else np.zeros((0,), dtype)))
+    return recv
+
+
+# ---------------------------------------------------------------------------
+# allgatherv / gatherv
+# ---------------------------------------------------------------------------
+
+def allgatherv(comm, sendbufs: Sequence, *, kernel: str = "lax"):
+    """Concatenate every rank's (ragged) buffer in rank order; the
+    result is identical on all ranks, returned once."""
+    n = comm.size
+    bufs = _as_1d_arrays(sendbufs, n, "allgatherv")
+    counts = [b.shape[0] for b in bufs]
+    cmax = max(1, max(counts))
+    dtype = bufs[0].dtype
+    padded = np.zeros((n, cmax), dtype=dtype)
+    for i, b in enumerate(bufs):
+        padded[i, : counts[i]] = b
+
+    if kernel == "ring":
+        body = lambda xb: spmd.allgather_ring(xb, AXIS, n)
+    else:
+        body = lambda xb: lax.all_gather(xb, AXIS, axis=0)
+    out = run_sharded(
+        comm, (kernel, "allgatherv", n, cmax, str(dtype)), body,
+        jnp.asarray(padded),
+    )
+    # (n, n, cmax): row r is rank r's gathered copy; all rows identical
+    # — fetch only rank 0's shard, not n replicated copies
+    g = np.asarray(out[0])
+    return jnp.asarray(
+        np.concatenate([g[i, : counts[i]] for i in range(n)])
+    )
+
+
+def gatherv(comm, sendbufs: Sequence, root: int, *, kernel: str = "lax"):
+    """Root receives the rank-order concatenation (other ranks' recv
+    buffers are undefined in MPI; driver mode returns the root view)."""
+    if not 0 <= root < comm.size:
+        raise MPIError(ErrorCode.ERR_ROOT, f"bad root {root}")
+    return allgatherv(comm, sendbufs, kernel=kernel)
+
+
+# ---------------------------------------------------------------------------
+# scatterv
+# ---------------------------------------------------------------------------
+
+def scatterv(comm, sendbuf, counts: Sequence[int], root: int) -> List:
+    """Root's buffer split into ``counts[i]`` elements for rank i."""
+    n = comm.size
+    if not 0 <= root < n:
+        raise MPIError(ErrorCode.ERR_ROOT, f"bad root {root}")
+    counts = [int(k) for k in counts]
+    if len(counts) != n or any(k < 0 for k in counts):
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"scatterv needs {n} non-negative counts, got {counts}",
+        )
+    buf = np.asarray(sendbuf).reshape(-1)
+    if buf.shape[0] != sum(counts):
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"scatterv root buffer has {buf.shape[0]} elements, counts "
+            f"sum to {sum(counts)}",
+        )
+    cmax = max(1, max(counts) if counts else 1)
+    dtype = buf.dtype
+    # only root's slice carries data (bcast-masked under the hood)
+    padded = np.zeros((n, n, cmax), dtype=dtype)
+    off = 0
+    for j, k in enumerate(counts):
+        padded[root, j, :k] = buf[off:off + k]
+        off += k
+
+    def body(xb):
+        full = spmd.bcast_masked_psum(xb, xb.dtype, AXIS, root)
+        rank = lax.axis_index(AXIS)
+        return jnp.take(full, rank, axis=0)
+
+    out = run_sharded(
+        comm, ("xla", "scatterv", n, cmax, str(dtype), root), body,
+        jnp.asarray(padded),
+    )
+    out = np.asarray(out)  # (n, cmax)
+    return [jnp.asarray(out[i, : counts[i]]) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter (general, per-rank counts)
+# ---------------------------------------------------------------------------
+
+def reduce_scatter(comm, x, recvcounts: Sequence[int], op: Op, *,
+                   kernel: str = "lax") -> List:
+    """General MPI_Reduce_scatter: reduce the full buffer, rank i keeps
+    the segment of length ``recvcounts[i]``.
+
+    ``x``: (size, total) — per-rank contribution rows,
+    total = sum(recvcounts). Returns one array per rank.
+    """
+    n = comm.size
+    recvcounts = [int(k) for k in recvcounts]
+    if len(recvcounts) != n or any(k < 0 for k in recvcounts):
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"reduce_scatter needs {n} non-negative counts",
+        )
+    x = np.asarray(x)
+    total = sum(recvcounts)
+    if x.shape[0] != n or x.reshape(n, -1).shape[1] != total:
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"reduce_scatter needs x shaped (size, {total}), got {x.shape}",
+        )
+    x = x.reshape(n, total)
+    cmax = max(1, max(recvcounts) if recvcounts else 1)
+    dtype = x.dtype
+    ident = op.identity_for(dtype) if op.identity is not None else 0
+    padded = np.full((n, n, cmax), ident, dtype=dtype)
+    offs = np.concatenate([[0], np.cumsum(recvcounts)])
+    for r in range(n):
+        for j, k in enumerate(recvcounts):
+            if k:
+                padded[r, j, :k] = x[r, offs[j]:offs[j] + k]
+
+    if kernel == "ring" and op.commutative and op.identity is not None:
+        def body(xb):
+            return spmd.reduce_scatter_ring(
+                xb.reshape(-1), op, AXIS, n
+            )
+    else:
+        def body(xb):
+            red = spmd.allreduce_lax(xb, op, AXIS)
+            rank = lax.axis_index(AXIS)
+            return jnp.take(red, rank, axis=0)
+
+    out = run_sharded(
+        comm, (kernel, "reduce_scatter", op.name, n, cmax, str(dtype)),
+        body, jnp.asarray(padded),
+    )
+    out = np.asarray(out).reshape(n, cmax)
+    return [jnp.asarray(out[i, : recvcounts[i]]) for i in range(n)]

@@ -360,6 +360,35 @@ class Communicator:
     def barrier(self) -> None:
         self._coll("barrier")(self)
 
+    # -- v-variant collectives (per-rank counts; ragged driver edge) -------
+    def alltoallv(self, sendbufs, sendcounts):
+        """MPI_Alltoallv: ``sendbufs[i]`` holds rank i's chunks for
+        ranks 0..n-1 back to back, ``sendcounts[i][j]`` elements for
+        rank j. Returns ``recv[i]`` = chunks from each source, in
+        source order."""
+        return self._coll("alltoallv")(self, sendbufs, sendcounts)
+
+    def allgatherv(self, sendbufs):
+        """MPI_Allgatherv: ragged per-rank buffers, concatenated in
+        rank order (identical on all ranks — returned once)."""
+        return self._coll("allgatherv")(self, sendbufs)
+
+    def gatherv(self, sendbufs, root: int = 0):
+        return self._coll("gatherv")(self, sendbufs, root)
+
+    def scatterv(self, sendbuf, counts, root: int = 0):
+        """MPI_Scatterv: root's buffer split into counts[i] elements
+        per rank; returns one array per rank."""
+        return self._coll("scatterv")(self, sendbuf, counts, root)
+
+    def reduce_scatter(self, x, recvcounts, op=None):
+        """General MPI_Reduce_scatter with per-rank recv counts."""
+        from .. import ops as ops_mod
+
+        return self._coll("reduce_scatter")(
+            self, x, recvcounts, op or ops_mod.SUM
+        )
+
     # -- nonblocking collectives (libnbc analogue) -------------------------
     # XLA dispatch is already asynchronous: invoking the compiled
     # collective returns immediately with arrays that are futures, so a

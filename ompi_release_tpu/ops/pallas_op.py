@@ -1,0 +1,153 @@
+"""Pallas streaming reduction kernels — the accelerated op component.
+
+The reference's reduction hot loop is a C elementwise loop per
+(op x dtype) (``ompi/mca/op/base/op_base_functions.c``); its ``op`` MCA
+framework exists so accelerated components can override those kernels
+(``ompi/mca/op``). This is that component for TPU: hand-tiled Pallas
+kernels for the HBM-bound streaming shapes where explicit VMEM blocking
+reaches the memory ceiling.
+
+Why Pallas here at all (SURVEY §7 step 5, "where XLA's built-ins
+lose"): measured on a v5e chip, the XLA fori_loop axpy reaches the same
+~780 GB/s as the Pallas kernel — but XLA is free to algebraically fold
+repeated affine updates across loop iterations (acc*c+a twice =
+acc*c^2 + (ac+a)), which silently turns a bandwidth benchmark into a
+flops one. A ``pallas_call`` is opaque to XLA, so a timing loop over it
+measures real HBM traffic every iteration. The bench (bench.py) uses
+these kernels for exactly that reason; the op framework exposes them
+for large contiguous f32/bf16 reductions.
+
+Block-shape choice (measured, experiments/perf_probe3.py): the axpy
+(read acc, read a, write acc -> 3 streams) peaks at (256, 2048) f32
+blocks = 2 MiB per buffer, 3 buffers x double-buffering = 12 MiB of
+VMEM; the 2-stream copy/scale kernel peaks at (2048, 512). Both land
+within ~5% of the 819 GB/s v5e HBM ceiling.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: measured-optimal f32 block shapes (rows, cols)
+AXPY_BLOCK: Tuple[int, int] = (256, 2048)
+SCALE_BLOCK: Tuple[int, int] = (2048, 512)
+
+
+def _interpret() -> bool:
+    # CPU (tests, simulator mesh) runs the same kernels interpreted
+    return jax.default_backend() != "tpu"
+
+
+def _blocked_call(kernel, nin: int, rows: int, cols: int, blk_rows: int,
+                  dtype):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if rows % blk_rows:
+        # a truncated grid would silently skip the tail — fatal in a
+        # bandwidth benchmark (unprocessed rows inflate the number)
+        raise ValueError(
+            f"rows ({rows}) must be a multiple of the block height "
+            f"({blk_rows})"
+        )
+    spec = pl.BlockSpec((blk_rows, cols), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, cols), dtype),
+        grid=(rows // blk_rows,),
+        in_specs=[spec] * nin,
+        out_specs=spec,
+        input_output_aliases={nin - 1: 0},
+        interpret=_interpret(),
+    )
+
+
+def axpy(a: jax.Array, acc: jax.Array, c: float = 1.0) -> jax.Array:
+    """acc*c + a as a tiled streaming kernel (the SUM/AXPY hot loop).
+
+    Arrays must be equal-shape f32/bf16; arbitrary shapes are flattened
+    and padded up to a whole number of blocks internally.
+    """
+    def kernel(a_ref, acc_ref, out_ref):
+        out_ref[:] = acc_ref[:] * c + a_ref[:]
+
+    return _apply_blocked(kernel, 2, AXPY_BLOCK, a, acc)
+
+
+def scale(x: jax.Array, c: float) -> jax.Array:
+    """x*c streaming (2-stream read+write: the copy-ceiling kernel)."""
+    def kernel(x_ref, out_ref):
+        out_ref[:] = x_ref[:] * c
+
+    return _apply_blocked(kernel, 1, SCALE_BLOCK, x)
+
+
+def _apply_blocked(kernel, nin: int, block: Tuple[int, int], *arrays):
+    blk_rows, cols = block
+    x0 = arrays[0]
+    shape, dtype = x0.shape, x0.dtype
+    n = x0.size
+    rows = -(-n // cols)
+    rows = -(-rows // blk_rows) * blk_rows  # whole blocks
+    padded_n = rows * cols
+
+    def prep(a):
+        flat = a.reshape(-1)
+        if padded_n != n:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((padded_n - n,), dtype)]
+            )
+        return flat.reshape(rows, cols)
+
+    call = _blocked_call(kernel, nin, rows, cols, blk_rows, dtype)
+    out = call(*[prep(a) for a in arrays])
+    return out.reshape(-1)[:n].reshape(shape)
+
+
+def make_axpy_loop(rows: int, cols: int, c: float = 0.999):
+    """K-iteration benchmark loop over the axpy kernel (bench.py's
+    measurement body: per-iteration traffic = 3 x rows x cols x 4 B)."""
+    blk_rows = AXPY_BLOCK[0]
+
+    def kernel(a_ref, acc_ref, out_ref):
+        out_ref[:] = acc_ref[:] * c + a_ref[:]
+
+    call = _blocked_call(kernel, 2, rows, cols, blk_rows, jnp.float32)
+
+    @partial(jax.jit, static_argnums=1)
+    def loop(a, k):
+        def body(i, acc):
+            return call(a, acc)
+
+        acc = jax.lax.fori_loop(
+            0, k, body, jnp.zeros((rows, cols), jnp.float32)
+        )
+        return acc[0, 0] + acc[-1, -1]  # 8-byte completion checksum
+
+    return loop
+
+
+def make_scale_loop(rows: int, cols: int, c: float = 1.0001):
+    """K-iteration loop over the 2-stream scale kernel (the measured
+    HBM copy ceiling: read + write per iteration)."""
+    blk_rows = SCALE_BLOCK[0]
+
+    def kernel(x_ref, out_ref):
+        out_ref[:] = x_ref[:] * c
+
+    call = _blocked_call(kernel, 1, rows, cols, blk_rows, jnp.float32)
+
+    @partial(jax.jit, static_argnums=1)
+    def loop(a, k):
+        def body(i, acc):
+            return call(acc)
+
+        acc = jax.lax.fori_loop(0, k, body, a)
+        return acc[0, 0] + acc[-1, -1]
+
+    return loop

@@ -87,3 +87,70 @@ def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
     back = back.reshape(e_global, capacity, dmodel)
     out = jnp.einsum("tec,ecd->td", combine, back)
     return out.astype(x.dtype), aux
+
+
+def dropless_moe(comm, tokens, assignments, expert_fn, n_experts: int):
+    """Dropless expert routing over alltoallv — uneven capacities.
+
+    The in-jit :func:`moe_layer` pays for static shapes with token
+    dropping; this driver-mode path is the exact-count alternative: the
+    per-(rank, rank) token counts become an alltoallv count matrix
+    (``coll_tuned_alltoallv.c``'s own use case, SURVEY §2.4 EP row), so
+    no token is ever dropped. (The compiled kernel under alltoallv
+    still pads each chunk to the max count — XLA needs static shapes —
+    so a heavily skewed load pays padding bandwidth; what this path
+    buys over moe_layer is exactness, not wire volume.)
+
+    tokens[i]: (T_i, D) rank i's tokens (ragged T_i); assignments[i]:
+    (T_i,) global expert ids; expert ``e`` lives on rank
+    ``e // (n_experts // n)``. ``expert_fn(e, x)`` applies expert e to
+    (K, D) tokens. Returns per-rank (T_i, D) outputs in original token
+    order.
+    """
+    import numpy as np
+
+    n = comm.size
+    if n_experts % n:
+        raise ValueError(f"{n_experts} experts not divisible by {n} ranks")
+    e_local = n_experts // n
+    toks = [np.asarray(t) for t in tokens]
+    assign = [np.asarray(a).astype(np.int64) for a in assignments]
+    d = toks[0].shape[1] if toks[0].ndim == 2 else 1
+
+    # sort each rank's tokens by destination rank (stable keeps order
+    # within a destination — needed to invert the permutation later)
+    owners = [a // e_local for a in assign]
+    order = [np.argsort(o, kind="stable") for o in owners]
+    counts = np.zeros((n, n), dtype=np.int64)
+    for i in range(n):
+        for j, k in zip(*np.unique(owners[i], return_counts=True)):
+            counts[i, int(j)] = int(k)
+
+    sendbufs = [toks[i][order[i]].reshape(-1) for i in range(n)]
+    recv = comm.alltoallv(sendbufs, counts * d)
+    # forward the expert ids alongside (same counts, 1 elem per token)
+    recv_ids = comm.alltoallv(
+        [assign[i][order[i]] for i in range(n)], counts
+    )
+
+    # each rank runs its local experts on the exact token set
+    processed = []
+    for j in range(n):
+        rt = np.asarray(recv[j]).reshape(-1, d)
+        ids = np.asarray(recv_ids[j])
+        out = np.empty_like(rt)
+        for e in range(j * e_local, (j + 1) * e_local):
+            sel = ids == e
+            if sel.any():
+                out[sel] = np.asarray(expert_fn(e, rt[sel]))
+        processed.append(out.reshape(-1))
+
+    # route back: the return counts matrix is the transpose
+    back = comm.alltoallv(processed, counts.T * d)
+    outputs = []
+    for i in range(n):
+        sorted_out = np.asarray(back[i]).reshape(-1, d)
+        inv = np.empty_like(order[i])
+        inv[order[i]] = np.arange(order[i].shape[0])
+        outputs.append(jnp.asarray(sorted_out[inv]))
+    return outputs
