@@ -25,6 +25,7 @@
 #include <deque>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -32,11 +33,15 @@
 namespace {
 
 constexpr uint32_t kMagic = 0x4f4d5054;  // "OMPT"
+// Hop budget: a mis-set routing table (two default routes pointing at
+// each other) would otherwise relay a frame in a cycle forever.
+constexpr int32_t kMaxTtl = 32;
 
 struct Frame {
   int32_t src;
   int32_t dst;
   int32_t tag;
+  int32_t ttl = kMaxTtl;
   std::vector<uint8_t> payload;
 };
 
@@ -45,6 +50,7 @@ struct Header {
   int32_t src;
   int32_t dst;
   int32_t tag;
+  int32_t ttl;
   uint32_t len;
 } __attribute__((packed));
 
@@ -79,9 +85,12 @@ struct Endpoint {
   std::mutex mu;                     // guards peers/routes/queue
   std::mutex wmu;                    // serializes frame writes
   std::map<int32_t, int> peer_fd;    // directly connected peers
+  std::set<int> open_fds;            // EVERY live connection fd (incl.
+                                     // inbound ones not yet announced)
   std::map<int32_t, int32_t> route;  // dst -> next-hop peer
   std::deque<Frame> queue;
   std::deque<Frame> undeliverable;   // forwards awaiting a peer/route
+  std::atomic<int> ttl_dropped{0};   // frames dropped at ttl 0
   std::condition_variable cv;
   std::vector<std::thread> threads;
   std::thread acceptor;
@@ -95,12 +104,12 @@ struct Endpoint {
       ::close(listen_fd);
     }
     {
+      // shutdown (not close) every connection fd — including inbound
+      // ones whose announce frame never arrived; each reader_loop
+      // unblocks, deregisters, and closes its own fd, so no fd is
+      // closed twice and no reader blocks forever in read()
       std::lock_guard<std::mutex> l(mu);
-      for (auto& kv : peer_fd) {
-        ::shutdown(kv.second, SHUT_RDWR);
-        ::close(kv.second);
-      }
-      peer_fd.clear();
+      for (int fd : open_fds) ::shutdown(fd, SHUT_RDWR);
     }
     cv.notify_all();
     if (acceptor.joinable()) acceptor.join();
@@ -128,7 +137,7 @@ struct Endpoint {
   bool send_frame(const Frame& f) {
     int fd = next_hop_fd(f.dst);
     if (fd < 0) return false;
-    Header h{kMagic, f.src, f.dst, f.tag,
+    Header h{kMagic, f.src, f.dst, f.tag, f.ttl,
              static_cast<uint32_t>(f.payload.size())};
     std::lock_guard<std::mutex> l(wmu);  // serialize frame writes
     if (!write_full(fd, &h, sizeof h)) return false;
@@ -136,12 +145,21 @@ struct Endpoint {
            write_full(fd, f.payload.data(), f.payload.size());
   }
 
-  void deliver_or_forward(Frame&& f) {
+  void deliver_or_forward(Frame&& f, bool spend_ttl = true) {
     if (f.dst == id || f.dst == -1) {
       std::lock_guard<std::mutex> l(mu);
       queue.push_back(std::move(f));
       cv.notify_all();
-    } else if (!send_frame(f)) {
+      return;
+    }
+    // relay hop: spend one ttl unit; at zero the frame dies here
+    // (cycle guard — see kMaxTtl). Retries from the undeliverable
+    // queue already paid for this hop (spend_ttl=false).
+    if (spend_ttl && --f.ttl <= 0) {
+      ttl_dropped.fetch_add(1);
+      return;
+    }
+    if (!send_frame(f)) {
       // tree relay (routed analogue); a frame can arrive before the
       // next hop has announced itself — hold it until a peer registers
       std::lock_guard<std::mutex> l(mu);
@@ -155,7 +173,7 @@ struct Endpoint {
       std::lock_guard<std::mutex> l(mu);
       retry.swap(undeliverable);
     }
-    for (auto& f : retry) deliver_or_forward(std::move(f));
+    for (auto& f : retry) deliver_or_forward(std::move(f), false);
   }
 
   void reader_loop(int fd) {
@@ -166,6 +184,7 @@ struct Endpoint {
       f.src = h.src;
       f.dst = h.dst;
       f.tag = h.tag;
+      f.ttl = h.ttl;
       f.payload.resize(h.len);
       if (h.len && !read_full(fd, f.payload.data(), h.len)) break;
       // first frame on an inbound connection announces the peer id
@@ -179,6 +198,20 @@ struct Endpoint {
       }
       deliver_or_forward(std::move(f));
     }
+    // connection over: deregister and close OUR fd exactly once (a
+    // disconnected peer must not linger in peer_fd, and stop() must
+    // not double-close it)
+    {
+      std::lock_guard<std::mutex> l(mu);
+      open_fds.erase(fd);
+      for (auto it = peer_fd.begin(); it != peer_fd.end();) {
+        if (it->second == fd)
+          it = peer_fd.erase(it);
+        else
+          ++it;
+      }
+    }
+    ::close(fd);
   }
 
   void accept_loop() {
@@ -188,6 +221,13 @@ struct Endpoint {
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
       std::lock_guard<std::mutex> l(mu);
+      if (stopping) {
+        // stop() already swept open_fds; registering now would leave
+        // a reader blocked forever — drop the connection instead
+        ::close(fd);
+        return;
+      }
+      open_fds.insert(fd);
       threads.emplace_back([this, fd] { reader_loop(fd); });
     }
   }
@@ -237,13 +277,14 @@ int oob_connect(void* h, int32_t peer_id, const char* host, int port) {
   }
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-  Header hello{kMagic, ep->id, peer_id, -999, 0};
+  Header hello{kMagic, ep->id, peer_id, -999, kMaxTtl, 0};
   if (!write_full(fd, &hello, sizeof hello)) {
     ::close(fd);
     return -1;
   }
   std::lock_guard<std::mutex> l(ep->mu);
   ep->peer_fd[peer_id] = fd;
+  ep->open_fds.insert(fd);
   ep->threads.emplace_back([ep, fd] { ep->reader_loop(fd); });
   return 0;
 }
@@ -301,6 +342,29 @@ int oob_pending(void* h) {
   auto* ep = static_cast<Endpoint*>(h);
   std::lock_guard<std::mutex> l(ep->mu);
   return static_cast<int>(ep->queue.size());
+}
+
+// Frames dropped by the ttl cycle guard (observability for tests).
+int oob_ttl_dropped(void* h) {
+  return static_cast<Endpoint*>(h)->ttl_dropped.load();
+}
+
+// Wait until a frame matching tag (-1 = any) is queued; return its
+// payload length without consuming it (-1 on timeout). Lets callers
+// size the recv buffer exactly instead of allocating a worst case.
+int oob_next_len(void* h, int32_t tag, int timeout_ms) {
+  auto* ep = static_cast<Endpoint*>(h);
+  std::unique_lock<std::mutex> l(ep->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    for (auto& f : ep->queue)
+      if (tag == -1 || f.tag == tag)
+        return static_cast<int>(f.payload.size());
+    if (ep->stopping ||
+        ep->cv.wait_until(l, deadline) == std::cv_status::timeout)
+      return -1;
+  }
 }
 
 void oob_destroy(void* h) { delete static_cast<Endpoint*>(h); }

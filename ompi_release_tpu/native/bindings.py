@@ -91,6 +91,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.oob_recv.restype = ctypes.c_int
     lib.oob_pending.argtypes = [P]
     lib.oob_pending.restype = ctypes.c_int
+    lib.oob_ttl_dropped.argtypes = [P]
+    lib.oob_ttl_dropped.restype = ctypes.c_int
+    lib.oob_next_len.argtypes = [P, ctypes.c_int32, ctypes.c_int]
+    lib.oob_next_len.restype = ctypes.c_int
     lib.oob_destroy.argtypes = [P]
 
 
@@ -198,7 +202,7 @@ class DssBuffer:
     def tobytes(self) -> bytes:
         n = self._lib.dss_size(self._h)
         p = self._lib.dss_data(self._h)
-        return bytes(bytearray(p[i] for i in range(n)))
+        return ctypes.string_at(p, n)  # one memcpy, not a Python loop
 
     def rewind(self) -> None:
         self._lib.dss_rewind(self._h)
@@ -242,21 +246,41 @@ class OobEndpoint:
                 f"oob send to {dst} failed (no connection or route)",
             )
 
-    def recv(self, tag: int = -1, timeout_ms: int = 10_000,
-             max_len: int = 1 << 26) -> Tuple[int, int, bytes]:
-        """Returns (src, tag, payload); raises on timeout."""
-        src = ctypes.c_int32()
-        tg = ctypes.c_int32(tag)
-        arr = (ctypes.c_uint8 * max_len)()
-        n = self._lib.oob_recv(self._h, ctypes.byref(src),
-                               ctypes.byref(tg), arr, max_len, timeout_ms)
-        if n == -1:
-            raise MPIError(ErrorCode.ERR_PENDING,
-                           f"oob recv timeout (tag {tag})")
-        if n == -2:
-            raise MPIError(ErrorCode.ERR_TRUNCATE,
-                           "oob recv buffer too small")
-        return src.value, tg.value, bytes(arr[:n])
+    def recv(self, tag: int = -1,
+             timeout_ms: int = 10_000) -> Tuple[int, int, bytes]:
+        """Returns (src, tag, payload); raises on timeout.
+
+        The buffer is sized from the queued frame's actual length
+        (oob_next_len) instead of a worst-case allocation. A concurrent
+        consumer of the same tag can race the size query; the -2 retry
+        loop below re-sizes and tries again. One deadline bounds the
+        whole call — retries never extend it past timeout_ms.
+        """
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_ms / 1000
+        while True:
+            left = max(1, int((deadline - _time.monotonic()) * 1000))
+            n = self._lib.oob_next_len(self._h, tag, left)
+            if n < 0:
+                raise MPIError(ErrorCode.ERR_PENDING,
+                               f"oob recv timeout (tag {tag})")
+            src = ctypes.c_int32()
+            tg = ctypes.c_int32(tag)
+            arr = (ctypes.c_uint8 * max(n, 1))()
+            left = max(1, int((deadline - _time.monotonic()) * 1000))
+            got = self._lib.oob_recv(self._h, ctypes.byref(src),
+                                     ctypes.byref(tg), arr, n, left)
+            if got == -2:
+                continue  # raced with another consumer; re-size
+            if got == -1:
+                raise MPIError(ErrorCode.ERR_PENDING,
+                               f"oob recv timeout (tag {tag})")
+            return src.value, tg.value, ctypes.string_at(arr, got)
+
+    def ttl_dropped(self) -> int:
+        """Frames dropped by the routing-cycle ttl guard."""
+        return self._lib.oob_ttl_dropped(self._h)
 
     def pending(self) -> int:
         return self._lib.oob_pending(self._h)

@@ -3,12 +3,27 @@
 The reference's launch wire-up (SURVEY §3.2): daemons report to the
 HNP, the modex allgathers every proc's business card through the
 daemon tree, and a runtime barrier gates MPI_Init completion. Here the
-HNP is the job coordinator process and each host runs a WorkerAgent;
-messages are DSS-packed frames over the native tree-routable OOB
-(``native/oob.cc``). In a real multi-host TPU job this wire-up runs
-BEFORE ``jax.distributed.initialize`` — the modex distributes each
-host's coordinator address/device coords; jax's own runtime then forms
-the ICI/DCN data plane.
+HNP is the job coordinator process (the ``tpurun`` launcher or rank 0)
+and each worker process runs a WorkerAgent; messages are DSS-packed
+frames over the native tree-routable OOB (``native/oob.cc``). In a
+real multi-host TPU job this wire-up runs BEFORE
+``jax.distributed.initialize`` — the modex distributes each host's
+coordinator address/device coords; jax's own runtime then forms the
+ICI/DCN data plane.
+
+Topology: joins/barriers/heartbeats flow directly worker->HNP (every
+worker holds an HNP link — the lifeline, ``errmgr_default_orted.c:252``),
+while **xcast descends a binomial tree** (``grpcomm_bad_module.c:99``
+through ``routed/binomial``): the HNP sends only to its tree children;
+each worker, on receiving an xcast frame, forwards it to its own
+children before delivering locally. Tree links are worker-to-worker
+OOB connections established from the modex cards (each card carries
+the worker's OOB listen port).
+
+Failure detection mirrors ``sensor_heartbeat.c:61,78``: workers beat
+periodically; the HNP-side monitor marks a worker failed after
+``miss_limit`` silent intervals and invokes the registered callback
+(the errmgr hook).
 
 Tags mirror the RML usage pattern (``rml.h:318`` tagged send/recv).
 """
@@ -16,8 +31,10 @@ Tags mirror the RML usage pattern (``rml.h:318`` tagged send/recv).
 from __future__ import annotations
 
 import json
+import os
+import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..native import DssBuffer, OobEndpoint
 from ..utils import output
@@ -34,6 +51,27 @@ TAG_FIN = 6
 TAG_HEARTBEAT = 7
 
 
+# ---------------------------------------------------------------------------
+# binomial tree (routed/binomial analogue)
+# ---------------------------------------------------------------------------
+
+def binomial_parent(v: int) -> int:
+    """Parent of node v in the 0-rooted binomial tree (clear lowest
+    set bit — the classic MPI virtual-rank rule)."""
+    return v & (v - 1)
+
+
+def binomial_children(v: int, n: int) -> List[int]:
+    """Children of node v among nodes 0..n-1."""
+    out = []
+    low = (v & -v) if v else (1 << max(1, n.bit_length()))
+    b = 1
+    while b < low and v + b < n:
+        out.append(v + b)
+        b <<= 1
+    return out
+
+
 def _pack_card(node_id: int, card: Dict[str, Any]) -> bytes:
     b = DssBuffer()
     b.pack_int64(node_id)
@@ -48,7 +86,14 @@ def _unpack_card(raw: bytes):
 
 
 class HnpCoordinator:
-    """Rank-0 side: owns the listener, drives modex/barrier/xcast."""
+    """Node-0 side: owns the root listener, drives modex/barrier/xcast
+    and monitors worker health.
+
+    ``num_nodes`` counts every tree node including the HNP. When the
+    HNP is a launcher (tpurun) rather than a participant, pass
+    ``my_card=None`` to :meth:`run_modex` — the card list then holds
+    only the workers' cards, ordered by node id (index = node_id - 1).
+    """
 
     def __init__(self, num_nodes: int, port: int = 0) -> None:
         if num_nodes < 1:
@@ -56,27 +101,44 @@ class HnpCoordinator:
         self.num_nodes = num_nodes
         self.ep = OobEndpoint(0, port)
         self._barrier_seq = 0
+        self._monitor: Optional[threading.Thread] = None
+        self._monitor_stop = threading.Event()
+        self._finished: set = set()
+        self._failed: set = set()
+        self._hb_lock = threading.Lock()
 
     @property
     def port(self) -> int:
         return self.ep.port
 
-    def run_modex(self, my_card: Dict[str, Any], *,
+    @property
+    def _worker_ids(self) -> List[int]:
+        return list(range(1, self.num_nodes))
+
+    def run_modex(self, my_card: Optional[Dict[str, Any]] = None, *,
                   timeout_ms: int = 30_000) -> List[Dict[str, Any]]:
         """Collect every worker's card, broadcast the full list
-        (grpcomm_base_modex.c:67 allgather-through-daemons)."""
-        cards: Dict[int, Dict[str, Any]] = {0: my_card}
+        (grpcomm_base_modex.c:67 allgather-through-daemons).
+
+        my_card=None = launcher mode: the HNP contributes no card and
+        the returned list is the workers', ordered by node id.
+        """
+        cards: Dict[int, Dict[str, Any]] = {}
+        if my_card is not None:
+            cards[0] = my_card
+        expect = self.num_nodes if my_card is not None else self.num_nodes - 1
+        first = 0 if my_card is not None else 1
         deadline = time.monotonic() + timeout_ms / 1000
-        while len(cards) < self.num_nodes:
+        while len(cards) < expect:
             left = max(1, int((deadline - time.monotonic()) * 1000))
             src, _, raw = self.ep.recv(tag=TAG_JOIN, timeout_ms=left)
             nid, card = _unpack_card(raw)
             cards[nid] = card
             _log.verbose(2, f"modex: node {nid} joined ({len(cards)}/"
-                            f"{self.num_nodes})")
-        ordered = [cards[i] for i in range(self.num_nodes)]
+                            f"{expect})")
+        ordered = [cards[i] for i in range(first, self.num_nodes)]
         payload = DssBuffer().pack_string(json.dumps(ordered)).tobytes()
-        for nid in range(1, self.num_nodes):
+        for nid in self._worker_ids:
             self.ep.send(nid, TAG_MODEX, payload)
         return ordered
 
@@ -92,39 +154,139 @@ class HnpCoordinator:
                                        timeout_ms=left)
             seen.add(src)
         rel = DssBuffer().pack_int64(self._barrier_seq).tobytes()
-        for nid in range(1, self.num_nodes):
+        for nid in self._worker_ids:
             self.ep.send(nid, TAG_BARRIER_RELEASE, rel)
 
     def xcast(self, payload: bytes, tag: int = TAG_XCAST) -> None:
-        """Broadcast through the tree (grpcomm xcast analogue; with a
-        star topology this is direct, with routes it relays)."""
-        for nid in range(1, self.num_nodes):
+        """Broadcast down the binomial tree: send only to our tree
+        children; workers relay to theirs (grpcomm xcast through
+        routed/binomial — NOT a star loop)."""
+        for nid in binomial_children(0, self.num_nodes):
             self.ep.send(nid, tag, payload)
 
-    def shutdown(self) -> None:
+    # -- health (sensor/heartbeat + errmgr hook) ---------------------------
+    def start_heartbeat_monitor(
+        self, on_failure: Callable[[int], None], *,
+        interval_s: float = 1.0, miss_limit: int = 3,
+    ) -> None:
+        """Watch TAG_HEARTBEAT beats; a worker silent for
+        ``miss_limit`` intervals (and not cleanly finished) is reported
+        once via ``on_failure(node_id)``."""
+        last = {nid: time.monotonic() for nid in self._worker_ids}
+
+        def run() -> None:
+            while not self._monitor_stop.is_set():
+                try:
+                    src, _, _ = self.ep.recv(
+                        tag=TAG_HEARTBEAT,
+                        timeout_ms=max(50, int(interval_s * 500)),
+                    )
+                    with self._hb_lock:
+                        last[src] = time.monotonic()
+                except MPIError:
+                    pass  # timeout: fall through to the check
+                now = time.monotonic()
+                newly_failed = []
+                with self._hb_lock:
+                    for nid in self._worker_ids:
+                        if nid in self._finished or nid in self._failed:
+                            continue
+                        if now - last[nid] > interval_s * miss_limit:
+                            self._failed.add(nid)
+                            newly_failed.append(nid)
+                # callback runs OUTSIDE the lock: errmgr policies may
+                # re-enter (note_finished/recv_fin) or take seconds
+                # (teardown) — neither may stall or deadlock the monitor
+                for nid in newly_failed:
+                    _log.verbose(
+                        1, f"worker {nid} heartbeat lost "
+                           f"({now - last[nid]:.1f}s silent)")
+                    on_failure(nid)
+
+        self._monitor = threading.Thread(target=run, daemon=True)
+        self._monitor.start()
+
+    def note_finished(self, nid: int) -> None:
+        """Stop expecting beats from a cleanly-finished worker."""
+        with self._hb_lock:
+            self._finished.add(nid)
+
+    def recv_fin(self, timeout_ms: int = 1000) -> Optional[int]:
+        """Drain one worker-completion report (returns node id)."""
         try:
-            self.xcast(b"", tag=TAG_FIN)
+            src, _, _ = self.ep.recv(tag=TAG_FIN, timeout_ms=timeout_ms)
+        except MPIError:
+            return None
+        self.note_finished(src)
+        return src
+
+    def shutdown(self) -> None:
+        self._monitor_stop.set()
+        try:
+            # teardown release goes to every worker directly: tree
+            # relays may already be gone at shutdown
+            for nid in self._worker_ids:
+                try:
+                    self.ep.send(nid, TAG_FIN, b"")
+                except MPIError:
+                    pass
         finally:
+            if self._monitor is not None:
+                self._monitor.join(timeout=2)
             self.ep.close()
 
 
 class WorkerAgent:
-    """Per-host agent (the orted-equivalent participant)."""
+    """Per-process agent (the orted-equivalent participant)."""
 
-    def __init__(self, node_id: int, hnp_host: str, hnp_port: int) -> None:
+    def __init__(self, node_id: int, hnp_host: str, hnp_port: int,
+                 num_nodes: Optional[int] = None) -> None:
         if node_id < 1:
             raise MPIError(ErrorCode.ERR_ARG,
                            "worker node_id must be >= 1 (0 is the HNP)")
         self.node_id = node_id
+        self.num_nodes = num_nodes  # tree size (incl. HNP); set by modex
         self.ep = OobEndpoint(node_id)
         self.ep.connect(0, hnp_host, hnp_port)
         self.ep.set_default_route(0)  # everything flows toward the root
+        self.cards: List[Dict[str, Any]] = []
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
 
     def run_modex(self, my_card: Dict[str, Any], *,
                   timeout_ms: int = 30_000) -> List[Dict[str, Any]]:
+        """JOIN with our card; receive the ordered card list. The card
+        should carry ``oob_port`` (our listen port) so tree links can
+        be formed afterwards (see :meth:`setup_tree`)."""
+        my_card = dict(my_card)
+        my_card.setdefault("oob_port", self.ep.port)
+        my_card.setdefault("oob_host", "127.0.0.1")
         self.ep.send(0, TAG_JOIN, _pack_card(self.node_id, my_card))
         _, _, raw = self.ep.recv(tag=TAG_MODEX, timeout_ms=timeout_ms)
-        return json.loads(DssBuffer(raw).unpack_string())
+        self.cards = json.loads(DssBuffer(raw).unpack_string())
+        return self.cards
+
+    # -- tree (routed/binomial links for xcast relay) ----------------------
+    def setup_tree(self, num_nodes: int,
+                   worker_cards: List[Dict[str, Any]]) -> None:
+        """Connect to our binomial-tree parent (if it is a worker; the
+        HNP link already exists). ``worker_cards[i]`` MUST be node
+        (i+1)'s card (launcher-mode modex returns exactly this;
+        participant-mode callers pass ``cards[1:]`` to drop the HNP's
+        card). Children connect to us the same way, so after the
+        post-tree barrier every tree edge is live."""
+        self.num_nodes = num_nodes
+        parent = binomial_parent(self.node_id)
+        if parent != 0:
+            card = worker_cards[parent - 1]
+            self.ep.connect(parent, card["oob_host"],
+                            int(card["oob_port"]))
+
+    @property
+    def tree_children(self) -> List[int]:
+        if not self.num_nodes:
+            return []
+        return binomial_children(self.node_id, self.num_nodes)
 
     def barrier(self, *, timeout_ms: int = 30_000) -> None:
         self.ep.send(0, TAG_BARRIER_ENTER, b"")
@@ -132,12 +294,45 @@ class WorkerAgent:
 
     def recv_xcast(self, tag: int = TAG_XCAST, *,
                    timeout_ms: int = 30_000) -> bytes:
+        """Receive a tree broadcast and relay it to our children
+        FIRST (pipelined descent), then deliver locally."""
         _, _, raw = self.ep.recv(tag=tag, timeout_ms=timeout_ms)
+        for child in self.tree_children:
+            try:
+                self.ep.send(child, tag, raw)
+            except MPIError:
+                _log.verbose(1, f"xcast relay to child {child} failed")
         return raw
 
+    # -- health ------------------------------------------------------------
     def heartbeat(self) -> None:
         self.ep.send(0, TAG_HEARTBEAT, b"")
 
+    def start_heartbeats(self, interval_s: float = 1.0) -> None:
+        def run() -> None:
+            while not self._hb_stop.wait(interval_s):
+                try:
+                    self.heartbeat()
+                except MPIError:
+                    return  # lifeline gone; process teardown follows
+
+        self._hb_thread = threading.Thread(target=run, daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeats(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+
+    # -- teardown ----------------------------------------------------------
+    def send_fin(self) -> None:
+        """Report clean completion to the HNP (IOF_COMPLETE analogue)."""
+        self.ep.send(0, TAG_FIN, b"")
+
     def wait_fin(self, *, timeout_ms: int = 60_000) -> None:
         self.ep.recv(tag=TAG_FIN, timeout_ms=timeout_ms)
+        self.close()
+
+    def close(self) -> None:
+        self.stop_heartbeats()
         self.ep.close()

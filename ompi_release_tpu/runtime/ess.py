@@ -92,8 +92,69 @@ class DistributedEss(mca_component.Component):
         }
 
 
+class TpurunEss(mca_component.Component):
+    """Bootstrap for processes launched by ``tpurun`` (the ess/env
+    analogue: mpirun-launched procs detect the daemon's env vars,
+    ``orte/mca/ess/env/ess_env_module.c:87``).
+
+    Runs the FULL coordinator wire-up inside bring-up: JOIN + modex
+    through the HNP, binomial tree link setup, the init barrier, and
+    the heartbeat thread — so ``Runtime.init`` under tpurun flows
+    through the OOB exactly like ``ompi_mpi_init.c:630-642,811`` flows
+    through the daemon tree.
+    """
+
+    NAME = "tpurun"
+    PRIORITY = 60  # above distributed: tpurun's env is more specific
+
+    def register_vars(self) -> None:
+        mca_var.register(
+            "ess_tpurun_heartbeat_interval", "float", 0.5,
+            "Seconds between worker heartbeats to the HNP "
+            "(sensor_heartbeat.c:61 analogue)",
+        )
+
+    def query(self, ctx=None):
+        if not os.environ.get("OMPITPU_HNP"):
+            return None
+        return (self.priority, self)
+
+    def bootstrap(self):
+        import jax
+
+        from . import coordinator as coord
+
+        host, port = os.environ["OMPITPU_HNP"].rsplit(":", 1)
+        node_id = int(os.environ["OMPITPU_NODE_ID"])
+        num_workers = int(os.environ["OMPITPU_NUM_NODES"])
+        agent = coord.WorkerAgent(node_id, host, int(port))
+        card = {
+            "node_id": node_id,
+            "pid": os.getpid(),
+            "local_device_count": jax.local_device_count(),
+        }
+        cards = agent.run_modex(card)  # launcher mode: workers only
+        agent.setup_tree(num_workers + 1, cards)
+        agent.barrier()  # every tree edge live; init gate
+        agent.start_heartbeats(
+            float(mca_var.get("ess_tpurun_heartbeat_interval", 0.5))
+        )
+        _log.verbose(
+            1, f"tpurun bootstrap: node {node_id}/{num_workers} wired"
+        )
+        return {
+            "process_index": node_id - 1,
+            "process_count": num_workers,
+            "devices": jax.devices(),
+            "local_devices": jax.local_devices(),
+            "agent": agent,
+            "peer_cards": cards,
+        }
+
+
 ESS_FRAMEWORK = mca_component.framework(
     "ess", "environment-specific bootstrap (orte/mca/ess analogue)"
 )
 ESS_FRAMEWORK.register(SingletonEss())
 ESS_FRAMEWORK.register(DistributedEss())
+ESS_FRAMEWORK.register(TpurunEss())

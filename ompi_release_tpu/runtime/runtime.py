@@ -42,6 +42,7 @@ class Runtime:
         self.mesh = None
         self.endpoints: List[mesh_mod.Endpoint] = []
         self.bootstrap: Dict[str, Any] = {}
+        self.agent = None  # tpurun WorkerAgent (set by ess/tpurun)
         self.world = None
         self.self_comm = None
         self.initialized = False
@@ -85,9 +86,12 @@ class Runtime:
 
             self.job_state.activate(JobState.INIT)
 
-            # 2. ESS bootstrap (identity + device discovery)
+            # 2. ESS bootstrap (identity + device discovery). Under
+            # tpurun this runs the coordinator wire-up: OOB modex, tree
+            # links, init barrier, heartbeats (ompi_mpi_init.c:630-642)
             ess = ess_mod.ESS_FRAMEWORK.select()
             self.bootstrap = ess.bootstrap()
+            self.agent = self.bootstrap.get("agent")  # tpurun WorkerAgent
             self.job_state.activate(JobState.ALLOCATE, self.bootstrap)
 
             # 3. mesh mapping
@@ -125,6 +129,16 @@ class Runtime:
             from ..comm import communicator as comm_mod
 
             comm_mod.clear_comm_registry()
+            if self.agent is not None:
+                # report clean completion to the HNP (IOF_COMPLETE ->
+                # TERMINATED flow of plm_types.h:113-151) and drop the
+                # lifeline deliberately
+                try:
+                    self.agent.send_fin()
+                except Exception:
+                    pass
+                self.agent.close()
+                self.agent = None
             self.job_state.activate(JobState.TERMINATED)
             self.finalized = True
             self.initialized = False

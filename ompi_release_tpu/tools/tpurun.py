@@ -1,0 +1,250 @@
+"""tpurun — the job launcher (``orterun``/``mpirun`` analogue).
+
+Usage::
+
+    python -m ompi_release_tpu.tools.tpurun -n 4 [--mca VAR VAL]... \
+        [--timeout S] prog [args...]
+
+What the reference's ``orterun`` does (``orte/tools/orterun/orterun.c``:
+build job, register state callbacks, ``orte_plm.spawn`` :1077; daemons
+``orted_main.c:234`` report back; apps launch, register, run, exit;
+stdio forwards through the iof) — re-shaped for one-host-many-process
+and multi-host TPU jobs:
+
+  1. start the HNP coordinator endpoint (node 0)
+  2. fork N worker processes with ``OMPITPU_*`` env (the ess/env
+     detection contract) + ``OMPITPU_MCA_*`` for ``--mca`` pairs
+  3. serve modex + init barrier on a thread (the PLM/grpcomm role)
+  4. forward each worker's stdout/stderr line-tagged ``[rank k]``
+     (the iof role, ``orte/mca/iof``)
+  5. monitor heartbeats (``sensor_heartbeat.c:61,78``) and process
+     exits; on abnormal exit or heartbeat loss, activate the error
+     state and kill the job (errmgr default_hnp policy: clean teardown)
+  6. aggregate exit codes: 0 iff every worker exited 0 after FIN
+
+The job/proc state machines are the real ``runtime/state.py`` ones, so
+tests (and ``ft_tester``-style kills) can assert the exact state path
+the reference defines (``plm_types.h:113-151``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..runtime import coordinator as coord
+from ..runtime.state import JobState, ProcState, StateMachine
+from ..utils import output
+
+_log = output.stream("tpurun")
+
+
+class Job:
+    """One launched job: processes + coordinator + state machines."""
+
+    def __init__(self, num_procs: int, argv: List[str],
+                 mca: List[tuple], *, heartbeat_s: float = 0.5,
+                 miss_limit: int = 4, tag_output: bool = True) -> None:
+        self.n = num_procs
+        self.argv = argv
+        self.mca = mca
+        self.heartbeat_s = heartbeat_s
+        self.miss_limit = miss_limit
+        self.tag_output = tag_output
+        self.job_state = StateMachine("tpurun-job")
+        self.proc_state: Dict[int, int] = {}
+        self.hnp: Optional[coord.HnpCoordinator] = None
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self._iof_threads: List[threading.Thread] = []
+        self._failed = threading.Event()
+        self._fin: set = set()
+        self._fin_lock = threading.Lock()
+
+    # -- launch ------------------------------------------------------------
+    def _env_for(self, node_id: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env["OMPITPU_HNP"] = f"127.0.0.1:{self.hnp.port}"
+        env["OMPITPU_NODE_ID"] = str(node_id)
+        env["OMPITPU_NUM_NODES"] = str(self.n)
+        env["OMPITPU_MCA_ess_tpurun_heartbeat_interval"] = str(
+            self.heartbeat_s
+        )
+        for k, v in self.mca:
+            env[f"OMPITPU_MCA_{k}"] = str(v)
+        return env
+
+    def _iof(self, node_id: int, stream, out) -> None:
+        """Forward one worker stream, line-tagged (iof analogue)."""
+        prefix = f"[rank {node_id - 1}] " if self.tag_output else ""
+        for line in stream:
+            out.write(prefix + line)
+            out.flush()
+
+    def _spawn(self, node_id: int) -> None:
+        p = subprocess.Popen(
+            self.argv, env=self._env_for(node_id),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, bufsize=1,
+        )
+        self.procs[node_id] = p
+        self.proc_state[node_id] = ProcState.RUNNING
+        for stream, out in ((p.stdout, sys.stdout), (p.stderr, sys.stderr)):
+            t = threading.Thread(
+                target=self._iof, args=(node_id, stream, out), daemon=True
+            )
+            t.start()
+            self._iof_threads.append(t)
+
+    # -- failure policy (errmgr default_hnp: teardown) ---------------------
+    def _on_worker_failure(self, node_id: int, state: int) -> None:
+        self.proc_state[node_id] = state
+        if self._failed.is_set():
+            return
+        self._failed.set()
+        self.job_state.activate(JobState.ABORTED, {"node": node_id,
+                                                   "state": int(state)})
+        _log.verbose(1, f"worker {node_id} failed "
+                        f"({ProcState(state).name}); tearing down")
+        self.terminate()
+
+    def terminate(self) -> None:
+        for nid, p in self.procs.items():
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 5
+        for p in self.procs.values():
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # -- run ---------------------------------------------------------------
+    def run(self, timeout_s: float = 300.0) -> int:
+        self.job_state.activate(JobState.INIT)
+        self.hnp = coord.HnpCoordinator(self.n + 1)
+        self.job_state.activate(JobState.LAUNCH_DAEMONS)
+        for nid in range(1, self.n + 1):
+            self._spawn(nid)
+        self.job_state.activate(JobState.LAUNCH_APPS)
+
+        # PLM/grpcomm service thread: modex + init barrier, then
+        # heartbeat monitoring + FIN collection
+        def serve() -> None:
+            try:
+                self.hnp.run_modex(None, timeout_ms=int(timeout_s * 1000))
+                self.job_state.activate(JobState.DAEMONS_REPORTED)
+                self.hnp.barrier(timeout_ms=int(timeout_s * 1000))
+                self.job_state.activate(JobState.RUNNING)
+            except Exception as e:
+                if not self._failed.is_set():
+                    _log.verbose(1, f"wire-up failed: {e}")
+                    self.job_state.activate(JobState.FAILED_TO_START, e)
+                    self._failed.set()
+                    self.terminate()
+                return
+            self.hnp.start_heartbeat_monitor(
+                lambda nid: self._on_worker_failure(
+                    nid, ProcState.HEARTBEAT_FAILED
+                ),
+                interval_s=self.heartbeat_s, miss_limit=self.miss_limit,
+            )
+            while not self._failed.is_set() and len(self._fin) < self.n:
+                nid = self.hnp.recv_fin(timeout_ms=200)
+                if nid is not None:
+                    with self._fin_lock:
+                        self._fin.add(nid)
+                    self.proc_state[nid] = ProcState.IOF_COMPLETE
+
+        server = threading.Thread(target=serve, daemon=True)
+        server.start()
+
+        # waitpid loop (odls wait_local_proc analogue)
+        deadline = time.monotonic() + timeout_s
+        exit_codes: Dict[int, int] = {}
+        pending = set(self.procs)
+        while pending and time.monotonic() < deadline:
+            for nid in list(pending):
+                rc = self.procs[nid].poll()
+                if rc is None:
+                    continue
+                pending.discard(nid)
+                exit_codes[nid] = rc
+                self.hnp.note_finished(nid)  # no more beats expected
+                with self._fin_lock:
+                    clean = nid in self._fin
+                if rc == 0 and clean:
+                    self.proc_state[nid] = ProcState.TERMINATED
+                elif not self._failed.is_set():
+                    # died without FIN or with nonzero code: lifeline
+                    # lost (errmgr_default_orted.c:252 analogue)
+                    self._on_worker_failure(
+                        nid,
+                        ProcState.ABORTED if rc != 0
+                        else ProcState.LIFELINE_LOST,
+                    )
+            time.sleep(0.02)
+
+        if pending:  # timeout
+            self.job_state.activate(JobState.ABORTED, "timeout")
+            self._failed.set()
+            self.terminate()
+            for nid in pending:
+                exit_codes[nid] = self.procs[nid].poll() or 124
+
+        server.join(timeout=5)
+        self.hnp.shutdown()
+        for t in self._iof_threads:
+            t.join(timeout=2)
+
+        if self._failed.is_set():
+            rc = next((c for c in exit_codes.values() if c), 1)
+            return rc
+        self.job_state.activate(JobState.TERMINATED)
+        return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpurun", description="Launch an N-process tpu job "
+        "(orterun analogue)")
+    ap.add_argument("-n", "--np", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("--mca", nargs=2, action="append", default=[],
+                    metavar=("VAR", "VAL"),
+                    help="set an MCA variable for every worker")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="job wall-clock limit in seconds")
+    ap.add_argument("--heartbeat", type=float, default=0.5,
+                    help="worker heartbeat interval in seconds")
+    ap.add_argument("--no-tag-output", action="store_true",
+                    help="do not prefix forwarded stdio with [rank k]")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="program and arguments to launch")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    if args.np < 1:
+        ap.error("-n must be >= 1")
+
+    job = Job(args.np, args.command, [tuple(m) for m in args.mca],
+              heartbeat_s=args.heartbeat,
+              tag_output=not args.no_tag_output)
+
+    def on_signal(signum, frame):
+        job._failed.set()
+        job.terminate()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    return job.run(timeout_s=args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,11 +111,14 @@ WORKER_SCRIPT = textwrap.dedent("""
     from ompi_release_tpu.runtime.coordinator import WorkerAgent
 
     rank, port = int(sys.argv[1]), int(sys.argv[2])
+    n = 4
     agent = WorkerAgent(rank, "127.0.0.1", port)
     cards = agent.run_modex({"host": f"worker{rank}", "devices": rank})
     assert cards[rank]["devices"] == rank, cards
-    agent.barrier()
-    payload = agent.recv_xcast()
+    # tree links (cards[0] is the HNP's card; workers are 1..n-1)
+    agent.setup_tree(n, cards[1:])
+    agent.barrier()   # gates xcast on every tree edge being live
+    payload = agent.recv_xcast()   # relays to tree children
     agent.barrier()
     print(json.dumps({"rank": rank, "n_cards": len(cards),
                       "xcast": payload.decode()}))

@@ -1,0 +1,185 @@
+"""tpurun launcher tests — the orterun/orted system-test analogue.
+
+Real multi-process jobs over localhost: wire-up through the OOB
+coordinator during MPI init, stdio forwarding, exit-code aggregation,
+and failure detection (abnormal exit + heartbeat loss) driving the job
+state machine into the error states (``plm_types.h:113-151``).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ompi_release_tpu.runtime.state import JobState, ProcState
+from ompi_release_tpu.tools.tpurun import Job
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+APP_PRELUDE = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, %r)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import ompi_release_tpu as mpi
+    from ompi_release_tpu.runtime.runtime import Runtime
+""" % REPO)
+
+
+def _write_app(tmp_path, body, name="app.py"):
+    p = tmp_path / name
+    p.write_text(APP_PRELUDE + textwrap.dedent(body))
+    return str(p)
+
+
+class TestEndToEnd:
+    def test_four_process_job(self, tmp_path, capfd):
+        """tpurun -n 4: every worker inits through the coordinator,
+        sees the right identity, and exits 0."""
+        app = _write_app(tmp_path, """
+            world = mpi.init()
+            rt = Runtime.current()
+            pi = rt.bootstrap["process_index"]
+            pc = rt.bootstrap["process_count"]
+            peers = rt.bootstrap["peer_cards"]
+            assert pc == 4 and 0 <= pi < 4
+            assert len(peers) == 4
+            assert peers[pi]["pid"] == os.getpid()
+            print(f"hello from {pi}/{pc}")
+            mpi.finalize()
+        """)
+        job = Job(4, [sys.executable, app], [], heartbeat_s=0.3)
+        rc = job.run(timeout_s=120)
+        out = capfd.readouterr().out
+        assert rc == 0, out
+        for r in range(4):
+            assert f"[rank {r}] hello from {r}/4" in out
+        assert job.job_state.visited(JobState.RUNNING)
+        assert job.job_state.visited(JobState.TERMINATED)
+        assert all(s == ProcState.TERMINATED
+                   for s in job.proc_state.values())
+
+    def test_xcast_reaches_all_workers(self, tmp_path, capfd):
+        """An HNP tree xcast after wire-up reaches every worker via
+        binomial relay (grpcomm xcast, not a star loop)."""
+        app = _write_app(tmp_path, """
+            world = mpi.init()
+            rt = Runtime.current()
+            payload = rt.agent.recv_xcast(timeout_ms=30000)
+            print("got:" + payload.decode())
+            mpi.finalize()
+        """)
+        job = Job(5, [sys.executable, app], [], heartbeat_s=0.3)
+
+        # inject the xcast once the job reports RUNNING
+        import threading
+
+        def cast_when_running():
+            import time
+
+            for _ in range(600):
+                if job.job_state.visited(JobState.RUNNING):
+                    job.hnp.xcast(b"tree-payload")
+                    return
+                time.sleep(0.05)
+
+        t = threading.Thread(target=cast_when_running, daemon=True)
+        t.start()
+        rc = job.run(timeout_s=120)
+        out = capfd.readouterr().out
+        assert rc == 0, out
+        assert out.count("got:tree-payload") == 5
+
+    def test_mca_vars_propagate(self, tmp_path, capfd):
+        app = _write_app(tmp_path, """
+            world = mpi.init()
+            _ = world.pml   # registers the pml vars (env applies then)
+            from ompi_release_tpu.mca import var as mca_var
+            print("val=" + str(mca_var.get("pml_eager_limit", 0)))
+            mpi.finalize()
+        """)
+        job = Job(2, [sys.executable, app],
+                  [("pml_eager_limit", "12345")], heartbeat_s=0.3)
+        rc = job.run(timeout_s=120)
+        out = capfd.readouterr().out
+        assert rc == 0, out
+        assert out.count("val=12345") == 2
+
+
+class TestFailureDetection:
+    def test_abnormal_exit_aborts_job(self, tmp_path, capfd):
+        """One worker exits 3 mid-job: the job reaches ABORTED, the
+        others are torn down, exit code propagates."""
+        app = _write_app(tmp_path, """
+            import time
+            world = mpi.init()
+            pi = Runtime.current().bootstrap["process_index"]
+            if pi == 1:
+                time.sleep(0.5)
+                os._exit(3)
+            time.sleep(600)   # would hang forever without teardown
+        """)
+        job = Job(3, [sys.executable, app], [], heartbeat_s=0.3)
+        rc = job.run(timeout_s=120)
+        assert rc == 3
+        assert job.job_state.visited(JobState.ABORTED)
+        assert job.proc_state[2] == ProcState.ABORTED  # node 2 = rank 1
+
+    def test_heartbeat_loss_detected(self, tmp_path, capfd):
+        """A worker that stops beating (but stays alive) is detected by
+        the HNP monitor: HEARTBEAT_FAILED -> job ABORTED -> teardown
+        (sensor_heartbeat.c:61,78 + errmgr policy)."""
+        app = _write_app(tmp_path, """
+            import time
+            world = mpi.init()
+            rt = Runtime.current()
+            pi = rt.bootstrap["process_index"]
+            if pi == 0:
+                rt.agent.stop_heartbeats()   # go silent, stay alive
+            time.sleep(600)
+        """)
+        job = Job(2, [sys.executable, app], [],
+                  heartbeat_s=0.3, miss_limit=3)
+        rc = job.run(timeout_s=120)
+        assert rc != 0
+        assert job.job_state.visited(JobState.ABORTED)
+        assert job.proc_state[1] == ProcState.HEARTBEAT_FAILED
+
+    def test_worker_crash_before_wireup(self, tmp_path, capfd):
+        """A worker dying before the modex completes fails the start
+        (FAILED_TO_START or ABORTED, never a hang)."""
+        app = _write_app(tmp_path, """
+            pi = int(os.environ["OMPITPU_NODE_ID"])
+            if pi == 2:
+                os._exit(7)
+            world = mpi.init()
+            import time; time.sleep(600)
+        """)
+        job = Job(2, [sys.executable, app], [], heartbeat_s=0.3)
+        rc = job.run(timeout_s=120)
+        assert rc == 7
+        assert (job.job_state.visited(JobState.ABORTED)
+                or job.job_state.visited(JobState.FAILED_TO_START))
+
+
+class TestCli:
+    def test_module_cli(self, tmp_path):
+        """python -m ompi_release_tpu.tools.tpurun -n 2 ... end to end."""
+        app = _write_app(tmp_path, """
+            world = mpi.init()
+            print("cli-ok", Runtime.current().bootstrap["process_index"])
+            mpi.finalize()
+        """)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_release_tpu.tools.tpurun",
+             "-n", "2", "--timeout", "120", sys.executable, app],
+            capture_output=True, text=True, env=env, timeout=180,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "[rank 0] cli-ok 0" in r.stdout
+        assert "[rank 1] cli-ok 1" in r.stdout
