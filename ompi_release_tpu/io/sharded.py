@@ -54,6 +54,15 @@ def register_vars() -> None:
         "CRC32 per shard, verified on load (opal datatype-checksum "
         "analogue: catches storage corruption)",
     )
+    mca_var.register(
+        "io_target_shard_bytes", "size", 64 * 1024 * 1024,
+        "Target bytes per shard for flat-layout saves (pytree leaves): "
+        "a leaf splits into ceil(nbytes/target) contiguous chunks",
+    )
+
+
+register_vars()  # idempotent; io vars must exist before any save/load
+# reads them (an unregistered var silently reads as its default)
 
 
 def _executor() -> ThreadPoolExecutor:
@@ -68,18 +77,38 @@ def _executor() -> ThreadPoolExecutor:
 
 
 def save_sharded(path: str, x, *, name: str = "array",
-                 async_: bool = False):
-    """Write an array as one .npy per leading-axis shard + manifest.
+                 async_: bool = False, layout: str = "axis0",
+                 num_shards: Optional[int] = None):
+    """Write an array as N .npy shards + a manifest.
 
-    ``x``: array with a leading shard axis (driver-mode rank axis), or
-    any jax array (device shards are pulled per-shard so at most one
-    shard is host-resident at a time).
+    layout="axis0": one shard per leading-axis slice (driver-mode rank
+    axis — each rank's block is its own object). layout="flat": the
+    array is flattened and split into ``num_shards`` contiguous chunks
+    (default: ceil(nbytes / io_target_shard_bytes)) — the right layout
+    for model parameters, where axis 0 (e.g. a 32k vocab) would
+    otherwise produce one tiny file per row.
 
-    Returns a Future list when ``async_`` (wait with
-    ``[f.result() for f in futs]``), else writes synchronously.
+    Device shards are pulled per-shard so at most one shard is
+    host-resident at a time. Returns a Future list when ``async_``
+    (wait with ``[f.result() for f in futs]``), else writes
+    synchronously.
     """
     os.makedirs(path, exist_ok=True)
-    n = int(x.shape[0])
+    if layout == "flat":
+        nbytes = int(x.size) * np.dtype(
+            "float32" if str(x.dtype) == "bfloat16" else x.dtype
+        ).itemsize
+        if num_shards is None:
+            target = int(mca_var.get("io_target_shard_bytes",
+                                     64 * 1024 * 1024))
+            num_shards = max(1, -(-nbytes // max(1, target)))
+        n = min(int(num_shards), max(1, int(x.size)))
+        bounds = np.linspace(0, int(x.size), n + 1).astype(np.int64)
+    elif layout == "axis0":
+        n = int(x.shape[0])
+        bounds = None
+    else:
+        raise MPIError(ErrorCode.ERR_ARG, f"unknown layout {layout!r}")
     compress = str(mca_var.get("io_compress", "none"))
     checksum = bool(mca_var.get("io_checksum", True))
     manifest = {
@@ -89,13 +118,18 @@ def save_sharded(path: str, x, *, name: str = "array",
         "shape": list(x.shape),
         "num_shards": n,
         "compress": compress,
-        "version": 2,
+        "layout": layout,
+        "version": 3,
     }
     crcs: List[Optional[int]] = [None] * n
+    if layout == "flat":
+        xflat = x.reshape(-1)
 
     def write_one(i: int) -> int:
+        src = (xflat[bounds[i]:bounds[i + 1]] if layout == "flat"
+               else x[i])
         block = np.asarray(
-            x[i] if str(x.dtype) != "bfloat16" else x[i].astype("float32")
+            src if str(x.dtype) != "bfloat16" else src.astype("float32")
         )
         buf = _io.BytesIO()
         np.save(buf, block)
@@ -167,7 +201,12 @@ def load_sharded(path: str, *, name: str = "array"):
 
     ex = _executor()
     blocks = list(ex.map(read_one, range(n)))
-    out = np.stack(blocks, axis=0)
+    if manifest.get("layout", "axis0") == "flat":
+        out = np.concatenate([b.reshape(-1) for b in blocks]).reshape(
+            manifest["shape"]
+        )
+    else:
+        out = np.stack(blocks, axis=0)
     if manifest["dtype"] == "bfloat16":
         import jax.numpy as jnp
 
@@ -190,7 +229,10 @@ def save_pytree(path: str, tree: Any, *, async_: bool = False):
         arr = jnp.asarray(leaf)
         if arr.ndim == 0:
             arr = arr[None]
-        r = save_sharded(path, arr, name=f"leaf{i:04d}", async_=async_)
+        # flat layout: shard count scales with leaf BYTES, not axis 0 —
+        # a (32000, d) embed table must not become 32000 row files
+        r = save_sharded(path, arr, name=f"leaf{i:04d}", async_=async_,
+                         layout="flat")
         if r:
             futs.extend(r)
     return futs if async_ else None

@@ -38,6 +38,16 @@ _log = output.stream("osc")
 
 _epoch_count = pvar.counter("osc_epochs", "RMA epochs closed")
 _rma_ops = pvar.counter("osc_rma_ops", "RMA operations issued")
+_epoch_programs = pvar.counter(
+    "osc_epoch_programs", "distinct compiled epoch-close programs"
+)
+_epoch_dispatches = pvar.counter(
+    "osc_epoch_dispatches", "epoch-close program invocations"
+)
+
+#: compiled epoch-close programs, keyed by
+#: (n_ops, window shape, dtype, ordered distinct (kind, op) branches)
+_program_cache: Dict[Tuple, object] = {}
 
 LOCK_EXCLUSIVE = 1
 LOCK_SHARED = 2
@@ -242,9 +252,44 @@ class Window:
         return req
 
     # -- application -------------------------------------------------------
+    @staticmethod
+    def _branch_key(p: _PendingOp) -> Tuple[str, str]:
+        if p.kind in ("acc", "get_acc"):
+            return ("acc", p.op.name)
+        return (p.kind, "")
+
+    @staticmethod
+    def _branch_fn(key: Tuple[str, str], op: Optional[Op]):
+        """One lax.switch branch: (cur, payload, compare) ->
+        (new_slice, pre_op_read)."""
+        kind = key[0]
+        if kind == "put":
+            return lambda cur, pay, cmp: (pay, cur)
+        if kind == "get":
+            return lambda cur, pay, cmp: (cur, cur)
+        if kind == "acc":
+            return lambda cur, pay, cmp: (op(cur, pay), cur)
+        # cas: elementwise compare-and-swap
+        return lambda cur, pay, cmp: (
+            jnp.where(cur == cmp, pay, cur), cur
+        )
+
     def _apply_pending(self, only_target: Optional[int] = None) -> None:
         """Apply queued ops in submission order (MPI same-origin
-        ordering); driver mode's single queue is globally ordered."""
+        ordering; driver mode's single queue is globally ordered) as
+        ONE compiled program per epoch.
+
+        The program is a ``lax.scan`` over the op list: step i reads
+        slice ``targets[i]``, dispatches ``codes[i]`` through a
+        ``lax.switch`` over the epoch's distinct (kind, op) branches,
+        writes the new slice back, and emits the pre-op value (what
+        get/get_acc/cas return). Targets/kinds/payloads are runtime
+        DATA, so the compile cache key is only (op count, window
+        shape/dtype, branch set): re-closing an epoch with the same
+        shape never retraces, and dispatch count is 1 per close
+        regardless of how many RMA ops queued (the osc/rdma "aggregate
+        and issue at sync" strategy, done as XLA intends it).
+        """
         if not self._pending:
             return
         _epoch_count.add()
@@ -255,28 +300,74 @@ class Window:
             self._pending = [
                 p for p in self._pending if p.target != only_target
             ]
-        data = self._data
+        if not todo:
+            return
+        from jax import lax
+
+        dtype = self._data.dtype
+        block = self.shape
+        zeros = jnp.zeros(block, dtype)
+
+        branch_keys: List[Tuple[str, str]] = []
+        branch_fns = []
+        codes: List[int] = []
         for p in todo:
-            if p.kind == "put":
-                data = data.at[p.target].set(p.data.astype(data.dtype))
-            elif p.kind == "get":
-                p.request.complete(value=data[p.target],
+            k = self._branch_key(p)
+            if k not in branch_keys:
+                branch_keys.append(k)
+                branch_fns.append(self._branch_fn(k, p.op))
+            codes.append(branch_keys.index(k))
+
+        def pay(p: _PendingOp):
+            if p.data is None:
+                return zeros
+            return jnp.broadcast_to(
+                jnp.asarray(p.data).astype(dtype), block
+            )
+
+        codes_a = jnp.asarray(codes, jnp.int32)
+        targets_a = jnp.asarray([p.target for p in todo], jnp.int32)
+        payloads = jnp.stack([pay(p) for p in todo])
+        compares = jnp.stack([
+            jnp.broadcast_to(jnp.asarray(p.compare).astype(dtype), block)
+            if p.compare is not None else zeros
+            for p in todo
+        ])
+
+        sig = (len(todo), block, str(dtype), tuple(branch_keys))
+        prog = _program_cache.get(sig)
+        if prog is None:
+            _epoch_programs.add()
+
+            def close_epoch(data, codes, targets, payloads, compares):
+                def step(data, xs):
+                    code, tgt, payv, cmpv = xs
+                    cur = lax.dynamic_index_in_dim(
+                        data, tgt, 0, keepdims=False
+                    )
+                    new, read = lax.switch(
+                        code, branch_fns, cur, payv, cmpv
+                    )
+                    data = lax.dynamic_update_index_in_dim(
+                        data, new, tgt, 0
+                    )
+                    return data, read
+
+                return lax.scan(
+                    step, data, (codes, targets, payloads, compares)
+                )
+
+            prog = jax.jit(close_epoch)
+            _program_cache[sig] = prog
+        _epoch_dispatches.add()
+        new_data, reads = prog(
+            self._data, codes_a, targets_a, payloads, compares
+        )
+        for i, p in enumerate(todo):
+            if p.request is not None:
+                p.request.complete(value=reads[i],
                                    status=Status(source=p.target))
-            elif p.kind in ("acc", "get_acc"):
-                cur = data[p.target]
-                if p.kind == "get_acc":
-                    p.request.complete(value=cur,
-                                       status=Status(source=p.target))
-                new = p.op(cur, p.data.astype(data.dtype))
-                data = data.at[p.target].set(new)
-            elif p.kind == "cas":
-                cur = data[p.target]
-                p.request.complete(value=cur,
-                                   status=Status(source=p.target))
-                new = jnp.where(cur == p.compare.astype(data.dtype),
-                                p.data.astype(data.dtype), cur)
-                data = data.at[p.target].set(new)
-        self._data = data
+        self._data = new_data
 
 
 def win_create(comm, base, name: str = "") -> Window:
