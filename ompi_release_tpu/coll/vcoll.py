@@ -153,10 +153,24 @@ def allgatherv(comm, sendbufs: Sequence, *, kernel: str = "lax"):
 
 def gatherv(comm, sendbufs: Sequence, root: int, *, kernel: str = "lax"):
     """Root receives the rank-order concatenation (other ranks' recv
-    buffers are undefined in MPI; driver mode returns the root view)."""
-    if not 0 <= root < comm.size:
+    buffers are undefined in MPI).
+
+    Root-respecting cost model: the reference's gatherv is LINEAR —
+    non-root ranks send exactly their own buffer and only root receives
+    (``coll_base_gatherv`` linear variant); no rank pays an allgather.
+    Driver mode's analogue of "root receives rank i's message" is a
+    host-side read of each rank's (already rank-local) buffer, so the
+    correct implementation is edge concatenation with a completion
+    barrier — NO compiled all-to-all-style collective, and no
+    per-rank O(total) receive buffers. ``kernel`` is accepted for API
+    symmetry with :func:`allgatherv` but unused.
+    """
+    n = comm.size
+    if not 0 <= root < n:
         raise MPIError(ErrorCode.ERR_ROOT, f"bad root {root}")
-    return allgatherv(comm, sendbufs, kernel=kernel)
+    bufs = _as_1d_arrays(sendbufs, n, "gatherv")
+    comm.barrier()
+    return jnp.asarray(np.concatenate(bufs))
 
 
 # ---------------------------------------------------------------------------

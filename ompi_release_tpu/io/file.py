@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
 import numpy as np
@@ -76,16 +77,22 @@ class File:
     def write_at_all(self, offsets, blocks) -> int:
         """Collective write: rank i's block at element offset i
         (driver mode: per-rank lists). Disjoint contiguous extents per
-        rank = the post-aggregation phase of fcoll/two_phase."""
+        rank = the post-aggregation phase of fcoll/two_phase. The
+        per-rank pwrites are issued concurrently (os.pwrite releases
+        the GIL), matching the aggregators-write-in-parallel phase."""
         self._check()
         if len(offsets) != self.comm.size or len(blocks) != self.comm.size:
             raise MPIError(
                 ErrorCode.ERR_ARG,
                 f"need {self.comm.size} offsets/blocks (one per rank)",
             )
-        total = 0
-        for off, blk in zip(offsets, blocks):
-            total += self.write_at(off, blk)
+        with ThreadPoolExecutor(
+            max_workers=min(self.comm.size, 16)
+        ) as pool:
+            total = sum(pool.map(
+                lambda ob: self.write_at(ob[0], ob[1]),
+                zip(offsets, blocks),
+            ))
         self.comm.barrier()
         return total
 
@@ -96,7 +103,13 @@ class File:
                 ErrorCode.ERR_ARG,
                 f"need {self.comm.size} offsets/counts (one per rank)",
             )
-        out = [self.read_at(o, c) for o, c in zip(offsets, counts)]
+        with ThreadPoolExecutor(
+            max_workers=min(self.comm.size, 16)
+        ) as pool:
+            out = list(pool.map(
+                lambda oc: self.read_at(oc[0], oc[1]),
+                zip(offsets, counts),
+            ))
         self.comm.barrier()
         return out
 

@@ -45,8 +45,10 @@ _epoch_dispatches = pvar.counter(
     "osc_epoch_dispatches", "epoch-close program invocations"
 )
 
-#: compiled epoch-close programs, keyed by
-#: (n_ops, window shape, dtype, ordered distinct (kind, op) branches)
+#: compiled epoch-close programs, keyed by (op count padded to a power
+#: of two, window shape, dtype, ordered distinct (kind, op, indexed)
+#: branches, scalar-payload mode) — padding keeps the cache O(log n)
+#: per branch set across varying epoch lengths
 _program_cache: Dict[Tuple, object] = {}
 
 LOCK_EXCLUSIVE = 1
@@ -61,16 +63,20 @@ class _EpochKind(enum.Enum):
 
 
 class _PendingOp:
-    __slots__ = ("kind", "target", "data", "op", "request", "compare")
+    __slots__ = ("kind", "target", "data", "op", "request", "compare",
+                 "index")
 
     def __init__(self, kind, target, data=None, op=None, request=None,
-                 compare=None) -> None:
+                 compare=None, index=None) -> None:
         self.kind = kind
         self.target = target
         self.data = data
         self.op = op
         self.request = request
         self.compare = compare
+        # flat element offset within the target slot (MPI target_disp
+        # for single-element ops); None = whole-slot operation
+        self.index = index
 
 
 class Window:
@@ -218,59 +224,118 @@ class Window:
         if not 0 <= op.target < self.comm.size:
             raise MPIError(ErrorCode.ERR_RANK,
                            f"RMA target {op.target} out of range")
+        if op.index is not None:
+            slot_elems = 1
+            for d in self.shape:
+                slot_elems *= d
+            if not 0 <= op.index < slot_elems:
+                raise MPIError(
+                    ErrorCode.ERR_ARG,
+                    f"RMA element index {op.index} out of range for "
+                    f"slot of {slot_elems} elements",
+                )
         _rma_ops.add()
         self._pending.append(op)
         return op.request
 
-    def put(self, data, target: int) -> None:
-        self._queue(_PendingOp("put", target, jnp.asarray(data), REPLACE))
+    def put(self, data, target: int, index: Optional[int] = None) -> None:
+        """Put a whole slot, or (``index`` given) a single element at a
+        flat offset within the slot (MPI target_disp addressing)."""
+        self._queue(_PendingOp("put", target, jnp.asarray(data), REPLACE,
+                               index=index))
 
     def get(self, target: int) -> Request:
         req = Request()
         self._queue(_PendingOp("get", target, request=req))
         return req
 
-    def accumulate(self, data, target: int, op: Op = SUM) -> None:
-        self._queue(_PendingOp("acc", target, jnp.asarray(data), op))
+    def accumulate(self, data, target: int, op: Op = SUM,
+                   index: Optional[int] = None) -> None:
+        self._queue(_PendingOp("acc", target, jnp.asarray(data), op,
+                               index=index))
 
-    def get_accumulate(self, data, target: int, op: Op = SUM) -> Request:
+    def get_accumulate(self, data, target: int, op: Op = SUM,
+                       index: Optional[int] = None) -> Request:
         req = Request()
         self._queue(
-            _PendingOp("get_acc", target, jnp.asarray(data), op, req)
+            _PendingOp("get_acc", target, jnp.asarray(data), op, req,
+                       index=index)
         )
         return req
 
-    def fetch_and_op(self, value, target: int, op: Op = SUM) -> Request:
-        return self.get_accumulate(value, target, op)
+    def fetch_and_op(self, value, target: int, op: Op = SUM,
+                     index: Optional[int] = None) -> Request:
+        """MPI_Fetch_and_op: single element when ``index`` is given
+        (the MPI call is defined on ONE element at target_disp —
+        ``osc.h:310``); whole-slot elementwise otherwise."""
+        return self.get_accumulate(value, target, op, index=index)
 
-    def compare_and_swap(self, value, compare, target: int) -> Request:
+    def compare_and_swap(self, value, compare, target: int,
+                         index: Optional[int] = None) -> Request:
+        """MPI_Compare_and_swap. With ``index``, true single-element
+        CAS at a flat offset (MPI semantics, ``osc.h:324``); without,
+        an elementwise CAS over the whole slot (a documented
+        whole-block extension)."""
         req = Request()
         self._queue(
             _PendingOp("cas", target, jnp.asarray(value), None, req,
-                       compare=jnp.asarray(compare))
+                       compare=jnp.asarray(compare), index=index)
         )
         return req
 
     # -- application -------------------------------------------------------
     @staticmethod
-    def _branch_key(p: _PendingOp) -> Tuple[str, str]:
+    def _branch_key(p: _PendingOp) -> Tuple[str, str, bool]:
+        indexed = p.index is not None
         if p.kind in ("acc", "get_acc"):
-            return ("acc", p.op.name)
-        return (p.kind, "")
+            return ("acc", p.op.name, indexed)
+        return (p.kind, "", indexed)
 
     @staticmethod
-    def _branch_fn(key: Tuple[str, str], op: Optional[Op]):
-        """One lax.switch branch: (cur, payload, compare) ->
-        (new_slice, pre_op_read)."""
-        kind = key[0]
+    def _branch_fn(key: Tuple[str, str, bool], op: Optional[Op]):
+        """One lax.switch branch: (cur, payload, compare, idx) ->
+        (new_slice, pre_op_read). ``payload``/``compare`` may be
+        scalars (scalar-payload epochs) or full slices; indexed
+        branches operate on the single element at flat offset ``idx``
+        (single-element MPI semantics — the read-back element is
+        extracted host-side from the pre-op slice)."""
+        kind, _, indexed = key
+
+        def elem(pay, idx):
+            # scalar payload, or a slice broadcast from one — any
+            # element of the flattened broadcast is the scalar
+            return (pay if jnp.ndim(pay) == 0
+                    else pay.reshape(-1)[idx])
+
+        if kind == "noop":
+            return lambda cur, pay, cmp, idx: (cur, cur)
         if kind == "put":
-            return lambda cur, pay, cmp: (pay, cur)
+            if indexed:
+                return lambda cur, pay, cmp, idx: (
+                    cur.reshape(-1).at[idx].set(elem(pay, idx))
+                    .reshape(cur.shape), cur)
+            return lambda cur, pay, cmp, idx: (
+                jnp.broadcast_to(pay, cur.shape), cur)
         if kind == "get":
-            return lambda cur, pay, cmp: (cur, cur)
+            return lambda cur, pay, cmp, idx: (cur, cur)
         if kind == "acc":
-            return lambda cur, pay, cmp: (op(cur, pay), cur)
-        # cas: elementwise compare-and-swap
-        return lambda cur, pay, cmp: (
+            if indexed:
+                def acc_elem(cur, pay, cmp, idx):
+                    flat = cur.reshape(-1)
+                    new_e = op(flat[idx], elem(pay, idx))
+                    return flat.at[idx].set(new_e).reshape(cur.shape), cur
+                return acc_elem
+            return lambda cur, pay, cmp, idx: (op(cur, pay), cur)
+        # cas
+        if indexed:
+            def cas_elem(cur, pay, cmp, idx):
+                flat = cur.reshape(-1)
+                old = flat[idx]
+                new_e = jnp.where(old == elem(cmp, idx),
+                                  elem(pay, idx), old)
+                return flat.at[idx].set(new_e).reshape(cur.shape), cur
+            return cas_elem
+        return lambda cur, pay, cmp, idx: (
             jnp.where(cur == cmp, pay, cur), cur
         )
 
@@ -306,9 +371,18 @@ class Window:
 
         dtype = self._data.dtype
         block = self.shape
-        zeros = jnp.zeros(block, dtype)
 
-        branch_keys: List[Tuple[str, str]] = []
+        # Scalar-payload epochs (the common AMO pattern: many scalar
+        # accumulates/CAS on a large window) keep payloads as (n,)
+        # scalars — broadcast happens INSIDE the kernel, so host-side
+        # staging is n scalars, not n x slot bytes.
+        scalar_mode = all(
+            (p.data is None or jnp.ndim(p.data) == 0)
+            and (p.compare is None or jnp.ndim(p.compare) == 0)
+            for p in todo
+        ) and block != ()
+
+        branch_keys: List[Tuple[str, str, bool]] = []
         branch_fns = []
         codes: List[int] = []
         for p in todo:
@@ -318,35 +392,56 @@ class Window:
                 branch_fns.append(self._branch_fn(k, p.op))
             codes.append(branch_keys.index(k))
 
-        def pay(p: _PendingOp):
-            if p.data is None:
+        # Pad the op count to the next power of two with no-op entries
+        # so the program cache holds O(log n) programs per branch set
+        # instead of one per distinct epoch length. The noop branch is
+        # ALWAYS part of the branch set so padded and exact-power-of-two
+        # epochs share one program.
+        n = len(todo)
+        n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
+        noop_key = ("noop", "", False)
+        if noop_key not in branch_keys:
+            branch_keys.append(noop_key)
+            branch_fns.append(self._branch_fn(noop_key, None))
+        codes.extend([branch_keys.index(noop_key)] * (n_pad - n))
+
+        pay_shape = () if scalar_mode else block
+        zeros = jnp.zeros(pay_shape, dtype)  # shared by all pad slots
+
+        def pay(x):
+            if x is None:
                 return zeros
-            return jnp.broadcast_to(
-                jnp.asarray(p.data).astype(dtype), block
-            )
+            return jnp.broadcast_to(jnp.asarray(x).astype(dtype),
+                                    pay_shape)
 
         codes_a = jnp.asarray(codes, jnp.int32)
-        targets_a = jnp.asarray([p.target for p in todo], jnp.int32)
-        payloads = jnp.stack([pay(p) for p in todo])
-        compares = jnp.stack([
-            jnp.broadcast_to(jnp.asarray(p.compare).astype(dtype), block)
-            if p.compare is not None else zeros
-            for p in todo
-        ])
+        targets_a = jnp.asarray(
+            [p.target for p in todo] + [0] * (n_pad - n), jnp.int32
+        )
+        zero_pad = [None] * (n_pad - n)
+        payloads = jnp.stack([pay(p.data) for p in todo]
+                             + [pay(x) for x in zero_pad])
+        compares = jnp.stack([pay(p.compare) for p in todo]
+                             + [pay(x) for x in zero_pad])
+        indices = jnp.asarray(
+            [p.index if p.index is not None else 0 for p in todo]
+            + [0] * (n_pad - n), jnp.int32
+        )
 
-        sig = (len(todo), block, str(dtype), tuple(branch_keys))
+        sig = (n_pad, block, str(dtype), tuple(branch_keys), scalar_mode)
         prog = _program_cache.get(sig)
         if prog is None:
             _epoch_programs.add()
 
-            def close_epoch(data, codes, targets, payloads, compares):
+            def close_epoch(data, codes, targets, payloads, compares,
+                            indices):
                 def step(data, xs):
-                    code, tgt, payv, cmpv = xs
+                    code, tgt, payv, cmpv, idx = xs
                     cur = lax.dynamic_index_in_dim(
                         data, tgt, 0, keepdims=False
                     )
                     new, read = lax.switch(
-                        code, branch_fns, cur, payv, cmpv
+                        code, branch_fns, cur, payv, cmpv, idx
                     )
                     data = lax.dynamic_update_index_in_dim(
                         data, new, tgt, 0
@@ -354,18 +449,23 @@ class Window:
                     return data, read
 
                 return lax.scan(
-                    step, data, (codes, targets, payloads, compares)
+                    step, data,
+                    (codes, targets, payloads, compares, indices)
                 )
 
             prog = jax.jit(close_epoch)
             _program_cache[sig] = prog
         _epoch_dispatches.add()
         new_data, reads = prog(
-            self._data, codes_a, targets_a, payloads, compares
+            self._data, codes_a, targets_a, payloads, compares, indices
         )
         for i, p in enumerate(todo):
             if p.request is not None:
-                p.request.complete(value=reads[i],
+                value = reads[i]
+                if p.index is not None:
+                    # single-element op: hand back the element itself
+                    value = value.reshape(-1)[p.index]
+                p.request.complete(value=value,
                                    status=Status(source=p.target))
         self._data = new_data
 

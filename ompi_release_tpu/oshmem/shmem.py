@@ -106,11 +106,10 @@ class ShmemCtx:
         return req.value
 
     def put_elem(self, sym: SymmetricArray, value, index, pe: int) -> None:
-        """Scalar/sub-array put at a flat index (shmem_p)."""
-        cur_shape = sym.shape
-        data = self.get(sym, pe)
-        flat = data.reshape(-1).at[index].set(value)
-        sym._win.put(flat.reshape(cur_shape), pe)
+        """Scalar put at a flat index (shmem_p): a true single-element
+        posted put — O(1) staged bytes, no read-modify-write of the
+        whole slot."""
+        sym._win.put(jnp.asarray(value), pe, index=int(index))
 
     # -- atomics (oshmem/mca/atomic) ---------------------------------------
     def atomic_add(self, sym: SymmetricArray, value, pe: int) -> None:

@@ -61,9 +61,15 @@ def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
     logits = jnp.matmul(x, router_w, preferred_element_type=jnp.float32)
     dispatch, combine = _one_hot_dispatch(logits, e_global, capacity)
 
-    # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e
+    # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e.
+    # f_e counts router argmax assignments BEFORE capacity dropping —
+    # using the post-drop dispatch would clamp an overloaded expert's
+    # fraction at capacity, weakening the balancing gradient exactly
+    # when that expert overflows.
     probs = jax.nn.softmax(logits, axis=-1)
-    frac_tokens = jnp.mean(dispatch.sum(-1), axis=0)  # (E,)
+    pre_drop = jax.nn.one_hot(jnp.argmax(logits, axis=-1), e_global,
+                              dtype=jnp.float32)
+    frac_tokens = jnp.mean(pre_drop, axis=0)  # (E,)
     frac_probs = jnp.mean(probs, axis=0)
     aux = e_global * jnp.sum(frac_tokens * frac_probs)
     aux = lax.pmean(aux, axis_name)

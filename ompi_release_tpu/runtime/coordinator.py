@@ -49,6 +49,7 @@ TAG_BARRIER_RELEASE = 4
 TAG_XCAST = 5
 TAG_FIN = 6
 TAG_HEARTBEAT = 7
+TAG_XCAST_ORPHAN = 8  # worker->HNP: deliver xcast to unreachable child
 
 
 # ---------------------------------------------------------------------------
@@ -106,6 +107,23 @@ class HnpCoordinator:
         self._finished: set = set()
         self._failed: set = set()
         self._hb_lock = threading.Lock()
+        # Orphaned-subtree xcast fallback is the HNP's OWN duty, not an
+        # optional caller poll: any HnpCoordinator user (tpurun,
+        # participant-mode rank 0, direct tests) gets the drain.
+        self._orphan_stop = threading.Event()
+        self._orphan_thread = threading.Thread(
+            target=self._orphan_loop, daemon=True
+        )
+        self._orphan_thread.start()
+
+    def _orphan_loop(self) -> None:
+        while not self._orphan_stop.is_set():
+            try:
+                self.serve_orphan_relay(timeout_ms=100)
+            except Exception:
+                if self._orphan_stop.is_set():
+                    return
+                time.sleep(0.1)
 
     @property
     def port(self) -> int:
@@ -211,6 +229,27 @@ class HnpCoordinator:
         with self._hb_lock:
             self._finished.add(nid)
 
+    def serve_orphan_relay(self, timeout_ms: int = 50) -> bool:
+        """Drain one orphaned-subtree relay request: a worker whose
+        tree-child link failed asks us to deliver the xcast directly
+        (we hold a lifeline link to every worker). Returns True if a
+        frame was served."""
+        try:
+            _, _, raw = self.ep.recv(tag=TAG_XCAST_ORPHAN,
+                                     timeout_ms=max(1, timeout_ms))
+        except MPIError:
+            return False
+        child = int.from_bytes(raw[:4], "big")
+        tag = int.from_bytes(raw[4:8], "big")
+        try:
+            self.ep.send(child, tag, raw[8:])
+            _log.verbose(1, f"delivered xcast directly to orphaned "
+                            f"node {child}")
+        except MPIError:
+            _log.verbose(1, f"direct delivery to orphaned node "
+                            f"{child} failed")
+        return True
+
     def recv_fin(self, timeout_ms: int = 1000) -> Optional[int]:
         """Drain one worker-completion report (returns node id)."""
         try:
@@ -222,6 +261,7 @@ class HnpCoordinator:
 
     def shutdown(self) -> None:
         self._monitor_stop.set()
+        self._orphan_stop.set()
         try:
             # teardown release goes to every worker directly: tree
             # relays may already be gone at shutdown
@@ -233,6 +273,7 @@ class HnpCoordinator:
         finally:
             if self._monitor is not None:
                 self._monitor.join(timeout=2)
+            self._orphan_thread.join(timeout=2)
             self.ep.close()
 
 
@@ -297,11 +338,40 @@ class WorkerAgent:
         """Receive a tree broadcast and relay it to our children
         FIRST (pipelined descent), then deliver locally."""
         _, _, raw = self.ep.recv(tag=tag, timeout_ms=timeout_ms)
+        # The child's hello frame is processed on our reader thread
+        # with no ordering guarantee against the HNP barrier release,
+        # so the first relay can race peer_fd registration. First pass
+        # attempts every child (keeping the descent pipelined for the
+        # reachable ones), then only the failures are retried with
+        # backoff; a child still unreachable is handed to the HNP,
+        # which holds a lifeline link to every worker.
+        failed = []
         for child in self.tree_children:
             try:
                 self.ep.send(child, tag, raw)
             except MPIError:
-                _log.verbose(1, f"xcast relay to child {child} failed")
+                failed.append(child)
+        for attempt in range(4):
+            if not failed:
+                break
+            time.sleep(0.05 * (attempt + 1))
+            still = []
+            for child in failed:
+                try:
+                    self.ep.send(child, tag, raw)
+                except MPIError:
+                    still.append(child)
+            failed = still
+        for child in failed:
+            _log.verbose(1, f"xcast relay to child {child} failed "
+                            "after retries; deferring to HNP")
+            try:
+                hdr = (int(child).to_bytes(4, "big")
+                       + int(tag).to_bytes(4, "big"))
+                self.ep.send(0, TAG_XCAST_ORPHAN, hdr + raw)
+            except MPIError:
+                _log.verbose(1, "HNP fallback for orphaned "
+                                f"subtree {child} also failed")
         return raw
 
     # -- health ------------------------------------------------------------

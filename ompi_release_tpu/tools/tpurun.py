@@ -169,7 +169,13 @@ class Job:
         deadline = time.monotonic() + timeout_s
         exit_codes: Dict[int, int] = {}
         pending = set(self.procs)
-        while pending and time.monotonic() < deadline:
+        # rc==0 workers whose FIN frame hasn't been drained yet: the
+        # serve thread processes TAG_FIN on a bounded recv granularity,
+        # so a clean exit can be observed by waitpid before its FIN is
+        # seen. Give each such worker one heartbeat interval of grace
+        # before declaring LIFELINE_LOST.
+        grace: Dict[int, float] = {}
+        while (pending or grace) and time.monotonic() < deadline:
             for nid in list(pending):
                 rc = self.procs[nid].poll()
                 if rc is None:
@@ -181,15 +187,31 @@ class Job:
                     clean = nid in self._fin
                 if rc == 0 and clean:
                     self.proc_state[nid] = ProcState.TERMINATED
-                elif not self._failed.is_set():
-                    # died without FIN or with nonzero code: lifeline
-                    # lost (errmgr_default_orted.c:252 analogue)
-                    self._on_worker_failure(
-                        nid,
-                        ProcState.ABORTED if rc != 0
-                        else ProcState.LIFELINE_LOST,
-                    )
+                elif rc != 0:
+                    if not self._failed.is_set():
+                        # died with nonzero code (errmgr_default_orted.c
+                        # :252 analogue)
+                        self._on_worker_failure(nid, ProcState.ABORTED)
+                else:
+                    grace[nid] = (time.monotonic()
+                                  + max(self.heartbeat_s, 0.25))
+            for nid in list(grace):
+                with self._fin_lock:
+                    clean = nid in self._fin
+                if clean:
+                    self.proc_state[nid] = ProcState.TERMINATED
+                    del grace[nid]
+                elif time.monotonic() > grace[nid]:
+                    del grace[nid]
+                    if not self._failed.is_set():
+                        # exited 0 but never sent FIN: lifeline lost
+                        self._on_worker_failure(
+                            nid, ProcState.LIFELINE_LOST)
             time.sleep(0.02)
+
+        for nid in grace:  # deadline hit while still in grace
+            if not self._failed.is_set():
+                self._on_worker_failure(nid, ProcState.LIFELINE_LOST)
 
         if pending:  # timeout
             self.job_state.activate(JobState.ABORTED, "timeout")

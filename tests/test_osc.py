@@ -119,6 +119,98 @@ class TestPassiveTarget:
         )
 
 
+class TestSingleElement:
+    """Single-element RMA (MPI target_disp semantics, osc.h:310,324)."""
+
+    def test_indexed_put(self, world, win):
+        win.fence()
+        win.put(np.float32(5.0), target=2, index=1)
+        win.fence_end()
+        out = np.asarray(win.read())[2]
+        np.testing.assert_array_equal(out, [0.0, 5.0, 0.0, 0.0])
+
+    def test_indexed_cas_swaps_one_element_only(self, world, win):
+        win.lock(3)
+        win.put(np.full(4, 1.0, np.float32), target=3)
+        win.flush(3)
+        c = win.compare_and_swap(
+            np.float32(9.0), compare=np.float32(1.0), target=3, index=2
+        )
+        win.unlock(3)
+        # returned value is the single pre-op element
+        assert np.asarray(c.value).shape == ()
+        assert float(c.value) == 1.0
+        out = np.asarray(win.read())[3]
+        np.testing.assert_array_equal(out, [1.0, 1.0, 9.0, 1.0])
+
+    def test_indexed_cas_mismatch_leaves_element(self, world, win):
+        win.lock(1)
+        win.put(np.full(4, 2.0, np.float32), target=1)
+        win.flush(1)
+        c = win.compare_and_swap(
+            np.float32(9.0), compare=np.float32(7.0), target=1, index=0
+        )
+        win.unlock(1)
+        assert float(c.value) == 2.0
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[1], np.full(4, 2.0)
+        )
+
+    def test_indexed_fetch_add(self, world, win):
+        win.lock(0)
+        f = win.fetch_and_op(np.float32(4.0), target=0, op=ops.SUM, index=3)
+        win.unlock(0)
+        assert float(f.value) == 0.0
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[0], [0.0, 0.0, 0.0, 4.0]
+        )
+
+    def test_mixed_epoch_indexed_and_full(self, world, win):
+        """Indexed and whole-slot ops interleave in one epoch in
+        submission order."""
+        win.fence()
+        win.put(np.full(4, 1.0, np.float32), target=0)
+        win.accumulate(np.float32(10.0), target=0, op=ops.SUM, index=0)
+        g = win.get(target=0)
+        win.fence_end()
+        np.testing.assert_array_equal(
+            np.asarray(g.value), [11.0, 1.0, 1.0, 1.0]
+        )
+
+
+class TestProgramCacheBounded:
+    def test_epoch_lengths_share_bucketed_programs(self, world):
+        """Varying epoch lengths must NOT compile one program each:
+        op counts are padded to powers of two, so lengths 3..8 of the
+        same branch set land in at most two buckets (4 and 8)."""
+        from ompi_release_tpu.osc import window as win_mod
+
+        w = win_allocate(world, (8,), jnp.float32)
+        before = len(win_mod._program_cache)
+        for n_ops in (3, 4, 5, 6, 7, 8):
+            w.fence()
+            for k in range(n_ops):
+                w.accumulate(np.float32(1.0), target=k % world.size,
+                             op=ops.SUM, index=k % 8)
+            w.fence_end()
+        added = len(win_mod._program_cache) - before
+        assert added <= 2, f"expected <=2 bucketed programs, got {added}"
+        w.free()
+
+    def test_scalar_payload_epoch_correct(self, world):
+        """Scalar accumulates on a larger window stay scalar on the
+        host side and still apply correctly."""
+        w = win_allocate(world, (16,), jnp.float32)
+        w.fence()
+        for _ in range(5):
+            w.accumulate(np.float32(2.0), target=1, op=ops.SUM)
+        w.fence_end()
+        np.testing.assert_array_equal(
+            np.asarray(w.read())[1], np.full(16, 10.0)
+        )
+        w.free()
+
+
 class TestPSCW:
     def test_post_start_complete(self, world, win):
         win.post(world.group)
