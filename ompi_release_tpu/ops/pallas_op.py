@@ -17,11 +17,16 @@ measures real HBM traffic every iteration. The bench (bench.py) uses
 these kernels for exactly that reason; the op framework exposes them
 for large contiguous f32/bf16 reductions.
 
-Block-shape choice (measured, experiments/perf_probe3.py): the axpy
-(read acc, read a, write acc -> 3 streams) peaks at (256, 2048) f32
-blocks = 2 MiB per buffer, 3 buffers x double-buffering = 12 MiB of
-VMEM; the 2-stream copy/scale kernel peaks at (2048, 512). Both land
-within ~5% of the 819 GB/s v5e HBM ceiling.
+Block-shape choice (measured on the v5e chip, 2026-07; see also
+experiments/perf_probe3.py): the axpy (read acc, read a, write acc ->
+3 streams) peaks at (256, 2048) f32 blocks (~780 GB/s effective); the
+2-stream copy/scale kernel peaks at SHORT, WIDE blocks — (128, 2048)
+and (32, 8192) both measured 820-840 GB/s against the 819 GB/s v5e
+spec, while the old tall (2048, 512) block plateaued at ~650. Caveat
+that shaped bench.py's design: single-run bandwidth wobbles by +-20%
+between runs on the tunneled chip (contention/thermal), so any
+metric/ceiling ratio must interleave both measurements round-by-round
+and report variance — a ceiling measured minutes apart is fiction.
 """
 
 from __future__ import annotations
@@ -34,7 +39,10 @@ import jax.numpy as jnp
 
 #: measured-optimal f32 block shapes (rows, cols)
 AXPY_BLOCK: Tuple[int, int] = (256, 2048)
-SCALE_BLOCK: Tuple[int, int] = (2048, 512)
+SCALE_BLOCK: Tuple[int, int] = (128, 2048)
+#: second copy-ceiling candidate (also ~820-840 GB/s measured); the
+#: bench measures both and takes the per-round max as the ceiling
+SCALE_BLOCK_ALT: Tuple[int, int] = (32, 8192)
 
 
 def _interpret() -> bool:
@@ -109,15 +117,19 @@ def _apply_blocked(kernel, nin: int, block: Tuple[int, int], *arrays):
     return out.reshape(-1)[:n].reshape(shape)
 
 
-def make_axpy_loop(rows: int, cols: int, c: float = 0.999):
+def make_axpy_loop(rows: int, cols: int, c: float = 0.999,
+                   blk_rows: int = None, dtype=jnp.float32):
     """K-iteration benchmark loop over the axpy kernel (bench.py's
-    measurement body: per-iteration traffic = 3 x rows x cols x 4 B)."""
-    blk_rows = AXPY_BLOCK[0]
+    measurement body: per-iteration traffic = 3 x rows x cols x
+    itemsize). ``blk_rows`` overrides the tuned block height for
+    small-message sweep points whose whole array is below one block."""
+    if blk_rows is None:
+        blk_rows = min(AXPY_BLOCK[0], rows)
 
     def kernel(a_ref, acc_ref, out_ref):
         out_ref[:] = acc_ref[:] * c + a_ref[:]
 
-    call = _blocked_call(kernel, 2, rows, cols, blk_rows, jnp.float32)
+    call = _blocked_call(kernel, 2, rows, cols, blk_rows, dtype)
 
     @partial(jax.jit, static_argnums=1)
     def loop(a, k):
@@ -125,27 +137,99 @@ def make_axpy_loop(rows: int, cols: int, c: float = 0.999):
             return call(a, acc)
 
         acc = jax.lax.fori_loop(
-            0, k, body, jnp.zeros((rows, cols), jnp.float32)
+            0, k, body, jnp.zeros((rows, cols), dtype)
         )
         return acc[0, 0] + acc[-1, -1]  # 8-byte completion checksum
 
     return loop
 
 
-def make_scale_loop(rows: int, cols: int, c: float = 1.0001):
+def make_scale_loop(rows: int, cols: int, c: float = 1.0001,
+                    blk_rows: int = None, dtype=jnp.float32):
     """K-iteration loop over the 2-stream scale kernel (the measured
     HBM copy ceiling: read + write per iteration)."""
-    blk_rows = SCALE_BLOCK[0]
+    if blk_rows is None:
+        blk_rows = min(SCALE_BLOCK[0], rows)
 
     def kernel(x_ref, out_ref):
         out_ref[:] = x_ref[:] * c
 
-    call = _blocked_call(kernel, 1, rows, cols, blk_rows, jnp.float32)
+    call = _blocked_call(kernel, 1, rows, cols, blk_rows, dtype)
 
     @partial(jax.jit, static_argnums=1)
     def loop(a, k):
         def body(i, acc):
             return call(acc)
+
+        acc = jax.lax.fori_loop(0, k, body, a)
+        return acc[0, 0] + acc[-1, -1]
+
+    return loop
+
+
+def make_transpose_loop(n: int, block: int = 256, dtype=jnp.int32):
+    """K-iteration loop over a blocked (n, n) transpose — the
+    single-chip analogue of the 2-D-torus MPI_Alltoall shuffle
+    (BASELINE config 5): every (i, j) block moves to (j, i), all-pairs
+    data movement through HBM, 2 streams. The +1 after each transpose
+    stops XLA from folding T(T(x)) = x across loop iterations (the
+    pallas_call itself is opaque, but its inverse-pairing is not)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if n % block:
+        raise ValueError(f"n ({n}) must be a multiple of block ({block})")
+
+    def kernel(x_ref, out_ref):
+        out_ref[:] = x_ref[:].T
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, n), dtype),
+        grid=(n // block, n // block),
+        in_specs=[pl.BlockSpec((block, block), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((block, block), lambda i, j: (j, i),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )
+
+    @partial(jax.jit, static_argnums=1)
+    def loop(a, k):
+        def body(i, acc):
+            return call(acc) + 1
+
+        acc = jax.lax.fori_loop(0, k, body, a)
+        return acc[0, 0] + acc[-1, -1]
+
+    return loop, call
+
+
+def make_chain_loop(hops: int = 4, dtype=jnp.float32):
+    """K-iteration loop over ``hops`` serially-dependent tiny (8, 128)
+    kernels — the single-chip analogue of examples/ring_c.c's 4-rank
+    token ring (each hop = one kernel dispatch, data-dependent on the
+    previous). Slope / hops = per-hop launch+HBM-roundtrip latency."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    spec = pl.BlockSpec((8, 128), lambda: (0, 0),
+                        memory_space=pltpu.VMEM)
+
+    def kernel(x_ref, out_ref):
+        out_ref[:] = x_ref[:] + 1
+
+    call = pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((8, 128), dtype),
+        in_specs=[spec], out_specs=spec, interpret=_interpret(),
+    )
+
+    @partial(jax.jit, static_argnums=1)
+    def loop(a, k):
+        def body(i, acc):
+            for _ in range(hops):
+                acc = call(acc)
+            return acc
 
         acc = jax.lax.fori_loop(0, k, body, a)
         return acc[0, 0] + acc[-1, -1]
