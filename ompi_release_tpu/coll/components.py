@@ -59,6 +59,7 @@ class _XlaModule:
             "scan": self.scan,
             "exscan": self.exscan,
             "barrier": self.barrier,
+            "ibarrier": self.ibarrier,
             "alltoallv": self.alltoallv,
             "allgatherv": self.allgatherv,
             "gatherv": self.gatherv,
@@ -164,12 +165,19 @@ class _XlaModule:
         return self.scan(comm, x, op, exclusive=True)
 
     def barrier(self, comm):
-        out = run_sharded(
+        jax.block_until_ready(self.ibarrier(comm))
+
+    def ibarrier(self, comm):
+        """Nonblocking barrier: dispatch the compiled barrier program
+        and return its (future) output WITHOUT blocking — the libnbc
+        round schedule (``nbc.c``) is the compiled program itself and
+        XLA's async dispatch is the progress engine. The caller wraps
+        the result in a Request whose readiness is the array's."""
+        return run_sharded(
             comm, ("xla", "barrier"),
             lambda xb: spmd.barrier_psum(AXIS) + xb,
             jnp.zeros((comm.size,), jnp.int32),
         )
-        jax.block_until_ready(out)
 
     # -- v-variants (padded lax kernels, counts at the driver edge) --------
     def alltoallv(self, comm, sendbufs, sendcounts):

@@ -72,6 +72,8 @@ class Keyval:
 
 
 class Communicator:
+    is_inter = False  # Intercommunicator overrides (MPI_Comm_test_inter)
+
     def __init__(self, runtime, group: Group, *, name: str = "",
                  parent: Optional["Communicator"] = None,
                  topo: Optional[Any] = None) -> None:
@@ -439,12 +441,54 @@ class Communicator:
         return self._async(self.exscan(x, op, **kw))
 
     def ibarrier(self):
+        """Nonblocking barrier that really is nonblocking: the
+        compiled barrier program is dispatched asynchronously and the
+        returned request's readiness is the dispatch's readiness (the
+        reference's libnbc round schedule, ``nbc.c``, becomes the
+        compiled program; XLA async dispatch is the progress engine).
+        Providers without an async dispatch path run the blocking
+        barrier on a completion thread instead — either way ibarrier
+        returns before the barrier completes."""
+        self._check_alive()
+        fn = self.c_coll.get("ibarrier")
+        if fn is not None:
+            return self._async(fn(self))
+
+        import threading
+
         from ..request.request import Request
 
-        req = Request(ready_fn=lambda: True, block_fn=lambda: None)
-        self.barrier()
-        req.complete()
-        return req
+        done = threading.Event()
+        errs: list = []
+
+        def run() -> None:
+            try:
+                self.barrier()
+            except Exception as exc:  # surfaced at wait()
+                errs.append(exc)
+            finally:
+                done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+
+        def block() -> None:
+            done.wait()
+            if errs:
+                raise errs[0]
+
+        # a failed barrier must surface through test() as well as
+        # wait(): the progress hook (polled by test) raises the stored
+        # error — the MPI_ERRORS_ARE_FATAL convention this layer uses
+        # — instead of reporting completion or pending forever
+        def progress(req) -> None:
+            if done.is_set() and errs:
+                raise errs[0]
+
+        return Request(
+            progress_fn=progress,
+            ready_fn=lambda: done.is_set() and not errs,
+            block_fn=block,
+        )
 
     def __repr__(self) -> str:
         return (

@@ -1,0 +1,230 @@
+"""MPI-2 dynamics: connect/accept + name publish/lookup (dpm/pubsub).
+
+Reference analogues: ``ompi/mca/dpm/dpm_orte/dpm_orte.c`` (the
+connect/accept handshake over the runtime's OOB) and
+``ompi/mca/pubsub/orte/pubsub_orte.c`` (name service hosted by the
+HNP / orte-server). Here the rendezvous service has two backends:
+
+* **in-process** (singleton/driver mode): a module-level registry with
+  condition variables, so accept/connect work across threads of one
+  controller — the analogue of dpm_orte's same-job shortcut.
+* **OOB-backed** (tpurun jobs): the HNP coordinator serves
+  publish/lookup frames over the native OOB (see
+  ``runtime.coordinator.HnpCoordinator.start_name_server`` /
+  ``WorkerAgent.publish_name/lookup_name``) — the orte-server role.
+
+A *port* (``MPI_Open_port``) is an opaque string naming a pending
+acceptor. ``comm_accept`` registers the port and blocks (with
+timeout) until a connector arrives; ``comm_connect`` completes the
+rendezvous; both sides receive mirrored
+:class:`~.intercomm.Intercommunicator` handles over the two groups —
+exactly the reference flow where both jobs end with an
+intercommunicator whose remote group is the peer job.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+from .communicator import Communicator
+from .intercomm import Intercommunicator
+
+_log = output.stream("dpm")
+
+_port_counter = itertools.count(0)
+_lock = threading.Condition()
+
+# port -> rendezvous slot
+_pending: Dict[str, "_Rendezvous"] = {}
+# published service name -> port (MPI_Publish_name)
+_names: Dict[str, str] = {}
+
+
+class _Rendezvous:
+    """One port's accept/connect meeting point."""
+
+    def __init__(self, port: str) -> None:
+        self.port = port
+        self.acceptor: Optional[Communicator] = None
+        self.connector: Optional[Communicator] = None
+        self.building = False  # one side claimed the construction
+        self.result: Optional[Tuple[Intercommunicator,
+                                    Intercommunicator]] = None
+        self.error: Optional[BaseException] = None
+
+
+def _check_disjoint(a: Communicator, b: Communicator) -> None:
+    if set(a.group.world_ranks) & set(b.group.world_ranks):
+        raise MPIError(ErrorCode.ERR_GROUP,
+                       "connect/accept groups must be disjoint")
+
+
+def _build_intercomm(rv: _Rendezvous, runtime, acceptor: Communicator,
+                     connector: Communicator) -> None:
+    """Construct the mirrored pair OUTSIDE the lock (submesh build +
+    coll selection can be slow — unrelated ports must not stall), then
+    publish result/error under the lock. ``acceptor``/``connector``
+    are snapshots taken under the lock: the parked side may withdraw
+    (timeout) while we build."""
+    try:
+        pair = Intercommunicator.create(
+            runtime, acceptor.group, connector.group,
+            name=f"accept({rv.port})",
+        )
+    except BaseException as exc:
+        with _lock:
+            rv.error = exc
+            rv.acceptor = None
+            rv.connector = None
+            _lock.notify_all()
+        raise
+    with _lock:
+        rv.result = pair
+        _lock.notify_all()
+
+
+def _await_result(rv: _Rendezvous, deadline: float, side: str):
+    """Wait under the lock for result/error; caller holds _lock."""
+    import time
+
+    while rv.result is None and rv.error is None:
+        left = deadline - time.monotonic()
+        if left <= 0 or not _lock.wait(timeout=left):
+            if rv.result is not None or rv.error is not None:
+                break
+            # the rendezvous is DEAD, not just this side: poison the
+            # slot and retire the port, else a build completing after
+            # our withdrawal would publish a result carrying OUR group
+            # into a later retry with a different communicator
+            if side == "accept":
+                rv.acceptor = None
+            else:
+                rv.connector = None
+            err = MPIError(ErrorCode.ERR_PORT,
+                           f"{side} on '{rv.port}' timed out")
+            rv.error = err
+            _pending.pop(rv.port, None)
+            _lock.notify_all()
+            raise err
+    if rv.error is not None:
+        err = rv.error
+        _pending.pop(rv.port, None)
+        raise err
+    return rv.result
+
+
+def open_port() -> str:
+    """``MPI_Open_port``: mint an opaque port name."""
+    port = f"tpu-port:{next(_port_counter)}"
+    with _lock:
+        _pending[port] = _Rendezvous(port)
+    return port
+
+
+def close_port(port: str) -> None:
+    with _lock:
+        _pending.pop(port, None)
+
+
+def publish_name(service: str, port: str) -> None:
+    """``MPI_Publish_name`` (pubsub_orte: HNP-hosted name table)."""
+    with _lock:
+        if service in _names:
+            raise MPIError(ErrorCode.ERR_NAME,
+                           f"service '{service}' already published")
+        _names[service] = port
+        _lock.notify_all()
+
+
+def unpublish_name(service: str) -> None:
+    with _lock:
+        if _names.pop(service, None) is None:
+            raise MPIError(ErrorCode.ERR_NAME,
+                           f"service '{service}' not published")
+
+
+def lookup_name(service: str, *, timeout_s: float = 10.0) -> str:
+    """``MPI_Lookup_name``: blocks until published (the reference's
+    pubsub lookup spins on the server) or times out."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    with _lock:
+        while service not in _names:
+            left = deadline - time.monotonic()
+            if left <= 0 or not _lock.wait(timeout=left):
+                raise MPIError(ErrorCode.ERR_NAME,
+                               f"service '{service}' not found")
+        return _names[service]
+
+
+def comm_accept(comm: Communicator, port: str, *,
+                timeout_s: float = 30.0) -> Intercommunicator:
+    """``MPI_Comm_accept``: block on ``port`` until a connector
+    arrives; returns this (server) side's intercomm handle."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    with _lock:
+        rv = _pending.get(port)
+        if rv is None:
+            raise MPIError(ErrorCode.ERR_PORT, f"unknown port '{port}'")
+        if rv.acceptor is not None:
+            raise MPIError(ErrorCode.ERR_PORT,
+                           f"port '{port}' already has an acceptor")
+        if rv.connector is not None:
+            _check_disjoint(comm, rv.connector)  # before registering
+        rv.acceptor = comm
+        _lock.notify_all()
+        build = rv.connector is not None and not rv.building
+        if build:
+            rv.building = True
+            acceptor, connector = rv.acceptor, rv.connector
+    if build:
+        _build_intercomm(rv, comm.runtime, acceptor, connector)
+    with _lock:
+        result = _await_result(rv, deadline, "accept")
+        server_side, _ = result
+        _pending.pop(port, None)
+        return server_side
+
+
+def comm_connect(comm: Communicator, port: str, *,
+                 timeout_s: float = 30.0) -> Intercommunicator:
+    """``MPI_Comm_connect``: rendezvous with the acceptor on ``port``;
+    returns this (client) side's intercomm handle."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    with _lock:
+        rv = _pending.get(port)
+        if rv is None:
+            raise MPIError(ErrorCode.ERR_PORT, f"unknown port '{port}'")
+        if rv.connector is not None:
+            raise MPIError(ErrorCode.ERR_PORT,
+                           f"port '{port}' already has a connector")
+        if rv.acceptor is not None:
+            _check_disjoint(rv.acceptor, comm)  # before registering
+        rv.connector = comm
+        _lock.notify_all()
+        build = rv.acceptor is not None and not rv.building
+        if build:
+            rv.building = True
+            acceptor, connector = rv.acceptor, rv.connector
+    if build:
+        _build_intercomm(rv, comm.runtime, acceptor, connector)
+    with _lock:
+        result = _await_result(rv, deadline, "connect")
+        _, client_side = result
+        return client_side
+
+
+def clear() -> None:
+    """Finalize-time teardown of ports and names."""
+    with _lock:
+        _pending.clear()
+        _names.clear()

@@ -50,6 +50,10 @@ TAG_XCAST = 5
 TAG_FIN = 6
 TAG_HEARTBEAT = 7
 TAG_XCAST_ORPHAN = 8  # worker->HNP: deliver xcast to unreachable child
+TAG_PUBLISH = 9       # worker->HNP: publish service name (pubsub_orte)
+TAG_LOOKUP = 10       # worker->HNP: lookup service name
+TAG_PUBSUB_REPLY = 11  # HNP->worker: publish/lookup response
+TAG_UNPUBLISH = 12    # worker->HNP: unpublish service name
 
 
 # ---------------------------------------------------------------------------
@@ -250,6 +254,82 @@ class HnpCoordinator:
                             f"{child} failed")
         return True
 
+    # -- name service (pubsub_orte / orte-server analogue) -----------------
+    def start_name_server(self) -> None:
+        """Serve publish/lookup/unpublish frames: the HNP plays the
+        ``orte-server`` role of ``pubsub_orte.c`` — a job-global name
+        table workers reach over their lifeline link. Lookup of an
+        unpublished name parks the requester and is answered the
+        moment the name arrives (the reference's blocking lookup)."""
+        self._names: Dict[str, str] = {}
+        # service -> [(node_id, seq), ...] parked lookups
+        self._name_waiters: Dict[str, List[tuple]] = {}
+        self._ns_stop = threading.Event()
+
+        # every request carries a client-chosen sequence number that
+        # is echoed in the reply, so a client whose earlier lookup
+        # timed out (leaving a parked waiter here) can discard the
+        # stale reply instead of mistaking it for the response to its
+        # next RPC (request/response correlation, rml.h tag+seq style)
+        def _reply(nid: int, seq: int, ok: bool, value: str) -> None:
+            frame = DssBuffer()
+            frame.pack_int64(seq)
+            frame.pack_int64(1 if ok else 0)
+            frame.pack_string(value)
+            try:
+                self.ep.send(nid, TAG_PUBSUB_REPLY, frame.tobytes())
+            except MPIError:
+                _log.verbose(1, f"pubsub reply to node {nid} failed")
+
+        def run() -> None:
+            while not self._ns_stop.is_set():
+                for tag in (TAG_PUBLISH, TAG_LOOKUP, TAG_UNPUBLISH):
+                    try:
+                        src, _, raw = self.ep.recv(tag=tag, timeout_ms=50)
+                    except MPIError:
+                        continue
+                    try:
+                        handle(tag, src, raw)
+                    except Exception as exc:
+                        # one malformed frame must not kill the name
+                        # service for the whole job
+                        _log.verbose(
+                            1, f"dropping bad pubsub frame from "
+                               f"{src}: {exc}")
+
+        def handle(tag: int, src: int, raw: bytes) -> None:
+            b = DssBuffer(raw)
+            (seq,) = b.unpack_int64()
+            service = b.unpack_string()
+            if tag == TAG_PUBLISH:
+                port = b.unpack_string()
+                if service in self._names:
+                    _reply(src, seq, False, "already published")
+                    return
+                self._names[service] = port
+                _reply(src, seq, True, port)
+                for wnid, wseq in self._name_waiters.pop(service, []):
+                    _reply(wnid, wseq, True, port)
+            elif tag == TAG_UNPUBLISH:
+                ok = self._names.pop(service, None) is not None
+                _reply(src, seq, ok, service)
+            else:  # TAG_LOOKUP
+                port = self._names.get(service)
+                if port is not None:
+                    _reply(src, seq, True, port)
+                else:
+                    self._name_waiters.setdefault(
+                        service, []).append((src, seq))
+
+        self._ns_thread = threading.Thread(target=run, daemon=True)
+        self._ns_thread.start()
+
+    def stop_name_server(self) -> None:
+        stop = getattr(self, "_ns_stop", None)
+        if stop is not None:
+            stop.set()
+            self._ns_thread.join(timeout=2)
+
     def recv_fin(self, timeout_ms: int = 1000) -> Optional[int]:
         """Drain one worker-completion report (returns node id)."""
         try:
@@ -262,6 +342,7 @@ class HnpCoordinator:
     def shutdown(self) -> None:
         self._monitor_stop.set()
         self._orphan_stop.set()
+        self.stop_name_server()
         try:
             # teardown release goes to every worker directly: tree
             # relays may already be gone at shutdown
@@ -373,6 +454,55 @@ class WorkerAgent:
                 _log.verbose(1, "HNP fallback for orphaned "
                                 f"subtree {child} also failed")
         return raw
+
+    # -- name service client (MPI_Publish_name over the lifeline) ----------
+    _pubsub_seq = 0
+
+    def _pubsub_rpc(self, tag: int, *fields: str, timeout_ms: int = 10_000):
+        import time as _time
+
+        self._pubsub_seq += 1
+        seq = self._pubsub_seq
+        frame = DssBuffer()
+        frame.pack_int64(seq)
+        for f in fields:
+            frame.pack_string(f)
+        self.ep.send(0, tag, frame.tobytes())
+        deadline = _time.monotonic() + timeout_ms / 1000
+        while True:
+            left = max(1, int((deadline - _time.monotonic()) * 1000))
+            _, _, raw = self.ep.recv(tag=TAG_PUBSUB_REPLY, timeout_ms=left)
+            b = DssBuffer(raw)
+            (got_seq,) = b.unpack_int64()
+            (ok,) = b.unpack_int64()
+            value = b.unpack_string()
+            if got_seq == seq:
+                return bool(ok), value
+            # stale reply from an RPC that timed out earlier: discard
+            _log.verbose(2, f"discarding stale pubsub reply seq={got_seq}")
+
+    def publish_name(self, service: str, port: str) -> None:
+        ok, msg = self._pubsub_rpc(TAG_PUBLISH, service, port)
+        if not ok:
+            raise MPIError(ErrorCode.ERR_NAME,
+                           f"publish '{service}': {msg}")
+
+    def lookup_name(self, service: str, *,
+                    timeout_ms: int = 10_000) -> str:
+        """Blocks until the name is published (HNP parks us) or the
+        recv times out."""
+        ok, value = self._pubsub_rpc(TAG_LOOKUP, service,
+                                     timeout_ms=timeout_ms)
+        if not ok:
+            raise MPIError(ErrorCode.ERR_NAME,
+                           f"lookup '{service}' failed: {value}")
+        return value
+
+    def unpublish_name(self, service: str) -> None:
+        ok, msg = self._pubsub_rpc(TAG_UNPUBLISH, service)
+        if not ok:
+            raise MPIError(ErrorCode.ERR_NAME,
+                           f"unpublish '{service}': not published")
 
     # -- health ------------------------------------------------------------
     def heartbeat(self) -> None:

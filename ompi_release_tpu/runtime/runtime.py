@@ -127,7 +127,9 @@ class Runtime:
             if not self.initialized or self.finalized:
                 return
             from ..comm import communicator as comm_mod
+            from ..comm import dpm as dpm_mod
 
+            dpm_mod.clear()
             comm_mod.clear_comm_registry()
             if self.agent is not None:
                 # report clean completion to the HNP (IOF_COMPLETE ->

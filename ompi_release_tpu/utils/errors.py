@@ -43,6 +43,9 @@ class ErrorCode(enum.IntEnum):
     ERR_IO = 32
     ERR_FILE = 27
     ERR_NO_MEM = 34
+    ERR_NAME = 33  # MPI_ERR_NAME: service name not published
+    ERR_PORT = 38  # MPI_ERR_PORT: invalid port (connect/accept)
+    ERR_SPAWN = 42  # MPI_ERR_SPAWN
     ERR_NOT_AVAILABLE = 100
     ERR_UNREACH = 101  # OMPI_ERR_UNREACH: no transport reaches the peer
 

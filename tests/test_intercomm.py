@@ -1,0 +1,228 @@
+"""Intercommunicator + MPI-2 dynamics tests (8-device CPU mesh).
+
+Covers the reference surface of ``ompi/communicator/comm.c``
+(intercomm create/merge), ``ompi/mca/coll/inter/coll_inter.c``
+(inter collectives), ``ompi/mca/dpm/dpm_orte/dpm_orte.c`` +
+``ompi/mca/pubsub/orte/pubsub_orte.c`` (connect/accept, name
+publish/lookup) — VERDICT r2 task #2's done-criterion: two
+independently-created comms connect, form an intercomm, and run an
+inter-allgather.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.comm import (
+    Group, Intercommunicator, intercomm_create,
+    open_port, close_port, publish_name, unpublish_name, lookup_name,
+    comm_accept, comm_connect,
+)
+from ompi_release_tpu.utils.errors import MPIError
+
+
+@pytest.fixture(scope="module")
+def world():
+    return mpi.init()
+
+
+@pytest.fixture(scope="module")
+def pair(world):
+    """Two disjoint intra-comms: A = ranks 0-2, B = ranks 3-7."""
+    a = world.create(world.group.incl([0, 1, 2]), name="A")
+    b = world.create(world.group.incl([3, 4, 5, 6, 7]), name="B")
+    return a, b
+
+
+def test_intercomm_create_shape(world, pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    assert ia.is_inter and ib.is_inter
+    assert not world.is_inter
+    assert (ia.size, ia.remote_size) == (3, 5)
+    assert (ib.size, ib.remote_size) == (5, 3)
+    assert ia.mirror is ib and ib.mirror is ia
+    assert ia.remote_group.world_ranks == (3, 4, 5, 6, 7)
+
+
+def test_intercomm_groups_must_be_disjoint(world, pair):
+    a, _ = pair
+    overlapping = world.create(world.group.incl([2, 3]), name="overlap")
+    with pytest.raises(MPIError):
+        intercomm_create(a, 0, overlapping, 0)
+
+
+def test_intercomm_leader_validation(pair):
+    a, b = pair
+    with pytest.raises(MPIError):
+        intercomm_create(a, 5, b, 0)  # local leader out of range
+    with pytest.raises(MPIError):
+        intercomm_create(a, 0, b, 9)
+
+
+def test_inter_allgather(world, pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    bufs_a = np.arange(3 * 4, dtype=np.float32).reshape(3, 4)
+    bufs_b = 100 + np.arange(5 * 4, dtype=np.float32).reshape(5, 4)
+    got_a = np.asarray(ia.allgather(bufs_a, bufs_b))
+    got_b = np.asarray(ib.allgather(bufs_b, bufs_a))
+    # A-side ranks receive B's buffers in B rank order, and vice versa
+    np.testing.assert_array_equal(got_a.reshape(5, 4), bufs_b)
+    np.testing.assert_array_equal(got_b.reshape(3, 4), bufs_a)
+
+
+def test_inter_allreduce_and_reduce(pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    bufs_a = np.arange(3 * 2, dtype=np.float32).reshape(3, 2)
+    bufs_b = np.ones((5, 2), np.float32)
+    got_a = np.asarray(ia.allreduce(bufs_a, bufs_b))
+    got_b = np.asarray(ib.allreduce(bufs_b, bufs_a))
+    np.testing.assert_allclose(got_a, bufs_b.sum(0))
+    np.testing.assert_allclose(got_b, bufs_a.sum(0))
+    red = np.asarray(ia.reduce(bufs_b, root=1))
+    np.testing.assert_allclose(red, bufs_b.sum(0))
+    with pytest.raises(MPIError):
+        ia.reduce(bufs_b, root=3)  # root must be in LOCAL group (size 3)
+
+
+def test_inter_bcast_scatter_gather(pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    # bcast: remote root's buffer lands on local ranks
+    x = np.arange(6, dtype=np.float32)
+    got = np.asarray(ia.bcast(x, root=2))  # root = B's rank 2
+    np.testing.assert_array_equal(got, x)
+    with pytest.raises(MPIError):
+        ia.bcast(x, root=7)
+    # gather: local root receives remote group's buffers
+    bufs_b = np.arange(5 * 3, dtype=np.float32).reshape(5, 3)
+    got = np.asarray(ia.gather(bufs_b, root=0)).reshape(5, 3)
+    np.testing.assert_array_equal(got, bufs_b)
+    # scatter: remote root's buffer split across local ranks
+    sendbuf = np.arange(3 * 2, dtype=np.float32).reshape(3, 2)
+    got = np.asarray(ia.scatter(sendbuf, root=0))
+    np.testing.assert_array_equal(got, sendbuf)
+
+
+def test_inter_alltoall(pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    send_a = np.arange(3 * 5, dtype=np.int32).reshape(3, 5)
+    send_b = 100 + np.arange(5 * 3, dtype=np.int32).reshape(5, 3)
+    got_a = np.asarray(ia.alltoall(send_a, send_b))
+    got_b = np.asarray(ib.alltoall(send_b, send_a))
+    np.testing.assert_array_equal(got_a, send_b.T)  # recv[i][j]=send_b[j][i]
+    np.testing.assert_array_equal(got_b, send_a.T)
+    ia.barrier()
+
+
+def test_intra_only_ops_rejected(pair):
+    a, b = pair
+    ia, _ = intercomm_create(a, 0, b, 0)
+    for fn in (ia.scan, ia.exscan, ia.split):
+        with pytest.raises(MPIError):
+            fn(np.zeros(2))
+
+
+def test_intercomm_merge_ordering(pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    low = ia.merge(high=False)  # A first
+    assert not low.is_inter
+    assert low.group.world_ranks == (0, 1, 2, 3, 4, 5, 6, 7)
+    high = ia.merge(high=True)  # A votes high -> B first
+    assert high.group.world_ranks == (3, 4, 5, 6, 7, 0, 1, 2)
+    # the merged comm is a full intracommunicator: run a collective
+    x = np.arange(8 * 2, dtype=np.float32).reshape(8, 2)
+    out = np.asarray(low.allreduce(x))
+    for r in range(8):
+        np.testing.assert_allclose(out[r], x.sum(0))
+
+
+def test_connect_accept_forms_intercomm(world, pair):
+    """The VERDICT done-criterion: two independently-created comms
+    connect via published name and run an inter-allgather."""
+    a, b = pair
+    port = open_port()
+    publish_name("ocean-svc", port)
+    results = {}
+
+    def server():
+        results["server"] = comm_accept(a, port, timeout_s=15)
+
+    t = threading.Thread(target=server)
+    t.start()
+    found = lookup_name("ocean-svc", timeout_s=15)
+    assert found == port
+    client_ic = comm_connect(b, found, timeout_s=15)
+    t.join(timeout=15)
+    server_ic = results["server"]
+    assert server_ic.group.world_ranks == (0, 1, 2)
+    assert server_ic.remote_group.world_ranks == (3, 4, 5, 6, 7)
+    assert client_ic.group.world_ranks == (3, 4, 5, 6, 7)
+    assert client_ic.mirror is server_ic
+    # inter-allgather across the dynamically-formed intercomm
+    bufs_a = np.arange(3, dtype=np.float32).reshape(3, 1)
+    bufs_b = 50 + np.arange(5, dtype=np.float32).reshape(5, 1)
+    got = np.asarray(server_ic.allgather(bufs_a, bufs_b)).ravel()
+    np.testing.assert_array_equal(got, bufs_b.ravel())
+    unpublish_name("ocean-svc")
+    with pytest.raises(MPIError):
+        lookup_name("ocean-svc", timeout_s=0.1)
+
+
+def test_connect_unknown_port_and_timeout(pair):
+    a, _ = pair
+    with pytest.raises(MPIError):
+        comm_connect(a, "tpu-port:99999", timeout_s=0.2)
+    port = open_port()
+    with pytest.raises(MPIError):
+        comm_accept(a, port, timeout_s=0.2)  # nobody connects
+    close_port(port)
+
+
+def test_publish_duplicate_rejected():
+    port = open_port()
+    publish_name("dup-svc", port)
+    with pytest.raises(MPIError):
+        publish_name("dup-svc", port)
+    unpublish_name("dup-svc")
+    with pytest.raises(MPIError):
+        unpublish_name("dup-svc")
+    close_port(port)
+
+
+def test_inter_nonblocking_variants(pair):
+    """i-variants have inter semantics (not the inherited intra
+    signatures) and ibarrier rides the bridge."""
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    bufs_a = np.arange(3, dtype=np.float32).reshape(3, 1)
+    bufs_b = 10 + np.arange(5, dtype=np.float32).reshape(5, 1)
+    req = ia.iallgather(bufs_a, bufs_b)
+    req.wait()
+    np.testing.assert_array_equal(np.asarray(req.value).ravel(),
+                                  bufs_b.ravel())
+    req = ia.iallreduce(bufs_a, bufs_b)
+    req.wait()
+    np.testing.assert_allclose(np.asarray(req.value).ravel(),
+                               [bufs_b.sum()])
+    rb = ia.ibarrier()
+    rb.wait()
+    assert rb.test()[0]
+
+
+def test_inter_unimplemented_ops_raise(pair):
+    """Ops without an inter implementation must raise, not silently
+    run with intra semantics over the local group."""
+    a, b = pair
+    ia, _ = intercomm_create(a, 0, b, 0)
+    x = np.zeros((3, 4), np.float32)
+    for fn in (ia.reduce_scatter_block, ia.allgatherv, ia.alltoallv,
+               ia.gatherv, ia.scatterv, ia.iscan, ia.iexscan):
+        with pytest.raises(MPIError):
+            fn(x)

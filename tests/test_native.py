@@ -154,3 +154,64 @@ class TestCoordinator:
             assert p.returncode == 0, err
             rec = json.loads(out.strip().splitlines()[-1])
             assert rec["n_cards"] == n and rec["xcast"] == "job-config-v1"
+
+
+PUBSUB_SCRIPT = textwrap.dedent("""
+    import sys, json, time
+    sys.path.insert(0, "/root/repo")
+    from ompi_release_tpu.runtime.coordinator import WorkerAgent
+
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    agent = WorkerAgent(rank, "127.0.0.1", port)
+    agent.run_modex({"role": rank})
+    if rank == 1:
+        # the LOOKUP is issued first (the HNP parks it until the
+        # publish arrives — pubsub_orte's blocking lookup)
+        found = agent.lookup_name("ocean-svc", timeout_ms=15000)
+        print(json.dumps({"rank": rank, "found": found}))
+    else:
+        time.sleep(0.5)  # let worker 1's lookup land first
+        agent.publish_name("ocean-svc", "tpu-port:42")
+        found = agent.lookup_name("ocean-svc")
+        try:
+            agent.publish_name("ocean-svc", "tpu-port:43")
+            dup_rejected = False
+        except Exception:
+            dup_rejected = True
+        agent.unpublish_name("ocean-svc")
+        print(json.dumps({"rank": rank, "found": found,
+                          "dup_rejected": dup_rejected}))
+    agent.close()
+""")
+
+
+class TestNameServer:
+    def test_publish_lookup_over_oob(self, tmp_path):
+        """HNP-hosted name service (pubsub_orte/orte-server role):
+        a parked lookup is answered by a later publish from another
+        process; duplicate publish is rejected; unpublish works."""
+        n = 3
+        script = tmp_path / "pubsub_worker.py"
+        script.write_text(PUBSUB_SCRIPT)
+        hnp = HnpCoordinator(n)
+        hnp.start_name_server()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(r), str(hnp.port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            for r in range(1, n)
+        ]
+        try:
+            hnp.run_modex({"role": "hnp"})
+            recs = {}
+            for p in procs:
+                out, err = p.communicate(timeout=30)
+                assert p.returncode == 0, err
+                rec = json.loads(out.strip().splitlines()[-1])
+                recs[rec["rank"]] = rec
+        finally:
+            hnp.shutdown()
+        assert recs[1]["found"] == "tpu-port:42"
+        assert recs[2]["found"] == "tpu-port:42"
+        assert recs[2]["dup_rejected"] is True
