@@ -213,9 +213,17 @@ def allreduce_ring(x: jax.Array, op: Op, axis_name: str, n: int) -> jax.Array:
 def allreduce_segmented_ring(x: jax.Array, op: Op, axis_name: str, n: int,
                              segsize_elems: int) -> jax.Array:
     """Segmented ring (coll_tuned_allreduce.c:636): the ring pipelined
-    over ~1 MiB segments. Element-wise reduction order matches plain
-    ring, so results are bitwise identical; segmentation bounds the
-    per-step working set (VMEM pressure) for very large buffers.
+    over ~1 MiB segments, bounding the per-step working set (VMEM
+    pressure) for very large buffers.
+
+    Reduction-order note: each segment is ring-reduced independently,
+    so an element's summation order is fixed by its chunk index
+    *within its segment*. That order is deterministic and pinned by
+    ``tests/test_bitwise_parity.py`` — but it is NOT bitwise-identical
+    to plain ring (whose chunk index derives from the whole buffer)
+    except when the whole buffer fits one segment; a ring chunk's
+    accumulation order inherently depends on its chunk index, so no
+    segmentation can preserve plain-ring bit patterns.
     """
     if n == 1:
         return x

@@ -107,12 +107,12 @@ def _await_result(rv: _Rendezvous, deadline: float, side: str):
             err = MPIError(ErrorCode.ERR_PORT,
                            f"{side} on '{rv.port}' timed out")
             rv.error = err
-            _pending.pop(rv.port, None)
+            _reset_slot(rv)  # port stays valid for later attempts
             _lock.notify_all()
             raise err
     if rv.error is not None:
         err = rv.error
-        _pending.pop(rv.port, None)
+        _reset_slot(rv)
         raise err
     return rv.result
 
@@ -162,69 +162,75 @@ def lookup_name(service: str, *, timeout_s: float = 10.0) -> str:
         return _names[service]
 
 
-def comm_accept(comm: Communicator, port: str, *,
-                timeout_s: float = 30.0) -> Intercommunicator:
-    """``MPI_Comm_accept``: block on ``port`` until a connector
-    arrives; returns this (server) side's intercomm handle."""
+def _reset_slot(rv: _Rendezvous) -> None:
+    """Replace a consumed/dead rendezvous with a fresh slot so the
+    PORT stays valid (MPI keeps a port open until MPI_Close_port — a
+    server loops accept on one published port). Only replaces if the
+    port still maps to ``rv`` (close_port may have retired it)."""
+    if _pending.get(rv.port) is rv:
+        _pending[rv.port] = _Rendezvous(rv.port)
+
+
+def _rendezvous(comm: Communicator, port: str, side: str,
+                timeout_s: float) -> Intercommunicator:
+    """The shared accept/connect protocol; ``side`` picks which slot
+    this caller fills and which handle of the pair it receives."""
     import time
 
+    mine, theirs = (
+        ("acceptor", "connector") if side == "accept"
+        else ("connector", "acceptor")
+    )
     deadline = time.monotonic() + timeout_s
     with _lock:
         rv = _pending.get(port)
         if rv is None:
             raise MPIError(ErrorCode.ERR_PORT, f"unknown port '{port}'")
-        if rv.acceptor is not None:
+        if getattr(rv, mine) is not None:
             raise MPIError(ErrorCode.ERR_PORT,
-                           f"port '{port}' already has an acceptor")
-        if rv.connector is not None:
-            _check_disjoint(comm, rv.connector)  # before registering
-        rv.acceptor = comm
+                           f"port '{port}' already has an {mine}")
+        other = getattr(rv, theirs)
+        if other is not None:
+            _check_disjoint(comm, other)  # before registering
+        setattr(rv, mine, comm)
         _lock.notify_all()
-        build = rv.connector is not None and not rv.building
+        build = other is not None and not rv.building
         if build:
             rv.building = True
             acceptor, connector = rv.acceptor, rv.connector
     if build:
         _build_intercomm(rv, comm.runtime, acceptor, connector)
     with _lock:
-        result = _await_result(rv, deadline, "accept")
-        server_side, _ = result
-        _pending.pop(port, None)
-        return server_side
+        server_side, client_side = _await_result(rv, deadline, side)
+        _reset_slot(rv)  # port stays valid for the next accept
+        return server_side if side == "accept" else client_side
+
+
+def comm_accept(comm: Communicator, port: str, *,
+                timeout_s: float = 30.0) -> Intercommunicator:
+    """``MPI_Comm_accept``: block on ``port`` until a connector
+    arrives; returns this (server) side's intercomm handle. The port
+    remains valid afterwards — a server can loop accept on one
+    published port (dpm_orte server pattern)."""
+    return _rendezvous(comm, port, "accept", timeout_s)
 
 
 def comm_connect(comm: Communicator, port: str, *,
                  timeout_s: float = 30.0) -> Intercommunicator:
     """``MPI_Comm_connect``: rendezvous with the acceptor on ``port``;
     returns this (client) side's intercomm handle."""
-    import time
-
-    deadline = time.monotonic() + timeout_s
-    with _lock:
-        rv = _pending.get(port)
-        if rv is None:
-            raise MPIError(ErrorCode.ERR_PORT, f"unknown port '{port}'")
-        if rv.connector is not None:
-            raise MPIError(ErrorCode.ERR_PORT,
-                           f"port '{port}' already has a connector")
-        if rv.acceptor is not None:
-            _check_disjoint(rv.acceptor, comm)  # before registering
-        rv.connector = comm
-        _lock.notify_all()
-        build = rv.acceptor is not None and not rv.building
-        if build:
-            rv.building = True
-            acceptor, connector = rv.acceptor, rv.connector
-    if build:
-        _build_intercomm(rv, comm.runtime, acceptor, connector)
-    with _lock:
-        result = _await_result(rv, deadline, "connect")
-        _, client_side = result
-        return client_side
+    return _rendezvous(comm, port, "connect", timeout_s)
 
 
 def clear() -> None:
-    """Finalize-time teardown of ports and names."""
+    """Finalize-time teardown: fail parked waiters immediately (they
+    must not sleep out their deadlines against wiped state), then drop
+    ports and names."""
     with _lock:
+        err = MPIError(ErrorCode.ERR_PORT, "dpm torn down (finalize)")
+        for rv in _pending.values():
+            if rv.result is None and rv.error is None:
+                rv.error = err
         _pending.clear()
         _names.clear()
+        _lock.notify_all()

@@ -271,29 +271,97 @@ class Intercommunicator(Communicator):
     def alltoall(self, send_local, send_remote):
         """Inter-alltoall: local rank i sends ``send_local[i][j]`` to
         remote rank j; returns what local ranks receive —
-        ``recv[i][j] = send_remote[j][i]`` (a cross-group transpose,
-        the alltoall the 2-D torus routes directly)."""
+        ``recv[i][j] = send_remote[j][i]``.
+
+        Runs as the BRIDGE's compiled intra-alltoall with the
+        off-diagonal block pattern (local rows only populate remote
+        destinations and vice versa): one program over the union mesh,
+        so the result lands sharded on the union mesh like every other
+        inter op — not as a host-side transpose."""
         self._check_alive()
         send_local = np.asarray(send_local)
         send_remote = np.asarray(send_remote)
-        if send_local.shape[:2] != (self.size, self.remote_size):
+        nl, nr = self.size, self.remote_size
+        if send_local.shape[:2] != (nl, nr):
             raise MPIError(
                 ErrorCode.ERR_ARG,
-                f"send_local must be (local={self.size}, "
-                f"remote={self.remote_size}, ...), got {send_local.shape}",
+                f"send_local must be (local={nl}, remote={nr}, ...), "
+                f"got {send_local.shape}",
             )
-        if send_remote.shape[:2] != (self.remote_size, self.size):
+        if send_remote.shape[:2] != (nr, nl):
             raise MPIError(
                 ErrorCode.ERR_ARG,
-                f"send_remote must be (remote={self.remote_size}, "
-                f"local={self.size}, ...), got {send_remote.shape}",
+                f"send_remote must be (remote={nr}, local={nl}, ...), "
+                f"got {send_remote.shape}",
             )
-        # the remote group's intra-alltoall machinery handles the
-        # transpose when sizes match; the general rectangular case is
-        # the same permutation expressed once on the union mesh
-        import jax.numpy as jnp
+        if send_local.shape[2:] != send_remote.shape[2:]:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                "send_local/send_remote chunk shapes differ: "
+                f"{send_local.shape[2:]} vs {send_remote.shape[2:]}",
+            )
+        n = nl + nr
+        trail = send_local.shape[2:]
+        full = np.zeros((n, n) + trail, send_local.dtype)
+        full[:nl, nl:] = send_local          # local rows -> remote dests
+        full[nl:, :nl] = send_remote         # remote rows -> local dests
+        # bridge alltoall convention: per-rank slice holds n chunks
+        # back to back along the leading axis
+        out = self._bridge.alltoall(full.reshape((n, -1) + trail[1:])
+                                    if trail else full.reshape(n, n))
+        out = np.asarray(out).reshape((n, n) + trail)
+        # local rank i's received remote chunks: out[i][nl:]
+        return out[:nl, nl:]
 
-        return jnp.swapaxes(jnp.asarray(send_remote), 0, 1)
+    # -- point-to-point (MPI intercomm addressing) -------------------------
+    # On an intercommunicator, dest/source are ranks in the REMOTE
+    # group (MPI-2 semantics). The inherited Communicator p2p would
+    # silently deliver within the local group — wrong recipient, no
+    # error — so every p2p op translates through the bridge comm's
+    # PML: local rank -> bridge rank [0, nl), remote rank -> bridge
+    # rank [nl, nl+nr).
+    def _bridge_local(self, r: int) -> int:
+        if not 0 <= r < self.size:
+            raise MPIError(ErrorCode.ERR_RANK,
+                           f"local rank {r} out of range")
+        return self._bridge.group.rank_of(self.group.world_rank(r))
+
+    def _bridge_remote(self, r: int) -> int:
+        if not 0 <= r < self.remote_size:
+            raise MPIError(ErrorCode.ERR_RANK,
+                           f"remote rank {r} out of range")
+        return self._bridge.group.rank_of(self.remote_group.world_rank(r))
+
+    def isend(self, data, dest: int, tag: int = 0, *, rank: int, **kw):
+        return self._bridge.isend(
+            data, self._bridge_remote(dest), tag,
+            rank=self._bridge_local(rank), **kw,
+        )
+
+    def send(self, data, dest: int, tag: int = 0, *, rank: int, **kw):
+        return self._bridge.send(
+            data, self._bridge_remote(dest), tag,
+            rank=self._bridge_local(rank), **kw,
+        )
+
+    def irecv(self, source: int = -1, tag: int = -1, *, rank: int):
+        src = -1 if source == -1 else self._bridge_remote(source)
+        return self._bridge.irecv(src, tag, rank=self._bridge_local(rank))
+
+    def recv(self, source: int = -1, tag: int = -1, *, rank: int):
+        src = -1 if source == -1 else self._bridge_remote(source)
+        return self._bridge.recv(src, tag, rank=self._bridge_local(rank))
+
+    def iprobe(self, source: int = -1, tag: int = -1, *, rank: int):
+        src = -1 if source == -1 else self._bridge_remote(source)
+        return self._bridge.iprobe(src, tag, rank=self._bridge_local(rank))
+
+    def sendrecv(self, *a, **kw):
+        raise MPIError(
+            ErrorCode.ERR_COMM,
+            "sendrecv has no inter-communicator implementation here "
+            "(use isend/recv with remote-rank addressing)",
+        )
 
     # intra-only operations are ERR_COMM on an intercommunicator,
     # matching MPI (scan/exscan/split et al. require an intracomm);

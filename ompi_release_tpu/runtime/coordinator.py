@@ -281,8 +281,23 @@ class HnpCoordinator:
             except MPIError:
                 _log.verbose(1, f"pubsub reply to node {nid} failed")
 
+        def _prune_waiters() -> None:
+            """Drop parked lookups whose client gave up: the lookup
+            frame carries the client's own deadline, so dead waiters
+            cannot accumulate (a retry loop would otherwise leave one
+            stale entry per attempt, forever)."""
+            now = time.monotonic()
+            for service in list(self._name_waiters):
+                alive = [w for w in self._name_waiters[service]
+                         if w[2] > now]
+                if alive:
+                    self._name_waiters[service] = alive
+                else:
+                    del self._name_waiters[service]
+
         def run() -> None:
             while not self._ns_stop.is_set():
+                _prune_waiters()
                 for tag in (TAG_PUBLISH, TAG_LOOKUP, TAG_UNPUBLISH):
                     try:
                         src, _, raw = self.ep.recv(tag=tag, timeout_ms=50)
@@ -308,18 +323,21 @@ class HnpCoordinator:
                     return
                 self._names[service] = port
                 _reply(src, seq, True, port)
-                for wnid, wseq in self._name_waiters.pop(service, []):
+                for wnid, wseq, _exp in self._name_waiters.pop(
+                        service, []):
                     _reply(wnid, wseq, True, port)
             elif tag == TAG_UNPUBLISH:
                 ok = self._names.pop(service, None) is not None
                 _reply(src, seq, ok, service)
             else:  # TAG_LOOKUP
+                ttl_ms = int(b.unpack_string())
                 port = self._names.get(service)
                 if port is not None:
                     _reply(src, seq, True, port)
                 else:
+                    expire = time.monotonic() + ttl_ms / 1000
                     self._name_waiters.setdefault(
-                        service, []).append((src, seq))
+                        service, []).append((src, seq, expire))
 
         self._ns_thread = threading.Thread(target=run, daemon=True)
         self._ns_thread.start()
@@ -456,30 +474,39 @@ class WorkerAgent:
         return raw
 
     # -- name service client (MPI_Publish_name over the lifeline) ----------
-    _pubsub_seq = 0
-
     def _pubsub_rpc(self, tag: int, *fields: str, timeout_ms: int = 10_000):
         import time as _time
 
-        self._pubsub_seq += 1
-        seq = self._pubsub_seq
-        frame = DssBuffer()
-        frame.pack_int64(seq)
-        for f in fields:
-            frame.pack_string(f)
-        self.ep.send(0, tag, frame.tobytes())
-        deadline = _time.monotonic() + timeout_ms / 1000
-        while True:
-            left = max(1, int((deadline - _time.monotonic()) * 1000))
-            _, _, raw = self.ep.recv(tag=TAG_PUBSUB_REPLY, timeout_ms=left)
-            b = DssBuffer(raw)
-            (got_seq,) = b.unpack_int64()
-            (ok,) = b.unpack_int64()
-            value = b.unpack_string()
-            if got_seq == seq:
-                return bool(ok), value
-            # stale reply from an RPC that timed out earlier: discard
-            _log.verbose(2, f"discarding stale pubsub reply seq={got_seq}")
+        # one RPC in flight per agent: concurrent threads would steal
+        # each other's TAG_PUBSUB_REPLY frames off the shared endpoint
+        # (the seq filter DISCARDS foreign replies, it cannot requeue
+        # them), and seq += 1 is not atomic
+        lock = getattr(self, "_pubsub_lock", None)
+        if lock is None:
+            lock = self._pubsub_lock = threading.Lock()
+        with lock:
+            seq = getattr(self, "_pubsub_seq", 0) + 1
+            self._pubsub_seq = seq
+            frame = DssBuffer()
+            frame.pack_int64(seq)
+            for f in fields:
+                frame.pack_string(f)
+            self.ep.send(0, tag, frame.tobytes())
+            deadline = _time.monotonic() + timeout_ms / 1000
+            while True:
+                left = max(1, int((deadline - _time.monotonic()) * 1000))
+                _, _, raw = self.ep.recv(tag=TAG_PUBSUB_REPLY,
+                                         timeout_ms=left)
+                b = DssBuffer(raw)
+                (got_seq,) = b.unpack_int64()
+                (ok,) = b.unpack_int64()
+                value = b.unpack_string()
+                if got_seq == seq:
+                    return bool(ok), value
+                # reply to an earlier timed-out RPC of OURS (serialized
+                # by the lock, it can't be another thread's): discard
+                _log.verbose(
+                    2, f"discarding stale pubsub reply seq={got_seq}")
 
     def publish_name(self, service: str, port: str) -> None:
         ok, msg = self._pubsub_rpc(TAG_PUBLISH, service, port)
@@ -489,9 +516,10 @@ class WorkerAgent:
 
     def lookup_name(self, service: str, *,
                     timeout_ms: int = 10_000) -> str:
-        """Blocks until the name is published (HNP parks us) or the
-        recv times out."""
-        ok, value = self._pubsub_rpc(TAG_LOOKUP, service,
+        """Blocks until the name is published (HNP parks us with our
+        deadline, so abandoned lookups expire server-side) or the recv
+        times out."""
+        ok, value = self._pubsub_rpc(TAG_LOOKUP, service, str(timeout_ms),
                                      timeout_ms=timeout_ms)
         if not ok:
             raise MPIError(ErrorCode.ERR_NAME,

@@ -278,9 +278,12 @@ def test_decision_rules(world):
     assert m._pick_allreduce(mid, noncommut) == "nonoverlapping"
 
 
-def test_bitwise_parity_ring_vs_linear(tuned):
-    """SURVEY §6 hard part: fixed per-algorithm reduction order means
-    the same algorithm must be bitwise-reproducible run to run."""
+def test_same_algorithm_bitwise_reproducible(tuned):
+    """Fixed per-algorithm reduction order means the same algorithm is
+    bitwise-reproducible run to run. (CROSS-algorithm order pinning —
+    each algorithm vs its own numpy-order reference — lives in
+    tests/test_bitwise_parity.py; this test's old name claimed a
+    ring-vs-linear comparison it never made.)"""
     x = _per_rank(tuned, 4096, seed=43)
     mca_var.set_value("coll_tuned_allreduce_algorithm", "ring")
     try:

@@ -226,3 +226,52 @@ def test_inter_unimplemented_ops_raise(pair):
                ia.gatherv, ia.scatterv, ia.iscan, ia.iexscan):
         with pytest.raises(MPIError):
             fn(x)
+
+
+def test_inter_p2p_remote_addressing(pair):
+    """MPI-2 intercomm p2p: dest/source are ranks in the REMOTE
+    group. A message from A's rank 0 to remote rank 1 must arrive at
+    B's local rank 1 (world rank 4) — not local rank 1."""
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    payload = np.arange(5, dtype=np.float32)
+    req = ia.isend(payload, dest=1, tag=7, rank=0)
+    got, st = ib.recv(source=0, tag=7, rank=1)
+    req.wait()
+    np.testing.assert_array_equal(np.asarray(got), payload)
+    # reply flows back remote->local
+    ib.send(payload * 2, dest=0, tag=8, rank=1)
+    got2, _ = ia.recv(source=1, tag=8, rank=0)
+    np.testing.assert_array_equal(np.asarray(got2), payload * 2)
+    with pytest.raises(MPIError):
+        ia.isend(payload, dest=5, rank=0)  # remote group has 5 ranks 0-4
+    with pytest.raises(MPIError):
+        ia.sendrecv([payload], [0])
+
+
+def test_port_reusable_across_accepts(world):
+    """MPI keeps a port valid until close_port: a server loops accept
+    on one published port, serving multiple clients."""
+    srv = world.create(world.group.incl([0, 1]), name="srv")
+    c1 = world.create(world.group.incl([2, 3]), name="c1")
+    c2 = world.create(world.group.incl([4, 5]), name="c2")
+    port = open_port()
+    results = []
+
+    def serve():
+        for _ in range(2):
+            results.append(comm_accept(srv, port, timeout_s=15))
+
+    t = threading.Thread(target=serve)
+    t.start()
+    ic1 = comm_connect(c1, port, timeout_s=15)
+    ic2 = comm_connect(c2, port, timeout_s=15)
+    t.join(timeout=20)
+    assert len(results) == 2
+    assert results[0].remote_group.world_ranks == (2, 3)
+    assert results[1].remote_group.world_ranks == (4, 5)
+    assert ic1.remote_group.world_ranks == (0, 1)
+    assert ic2.remote_group.world_ranks == (0, 1)
+    close_port(port)
+    with pytest.raises(MPIError):
+        comm_connect(c1, port, timeout_s=0.2)
