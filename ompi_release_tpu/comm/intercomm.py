@@ -261,12 +261,15 @@ class Intercommunicator(Communicator):
             )
         # the rooted delivery runs as the local sub-mesh's compiled
         # scatter (coll_inter's bcast-then-intra pattern; the remote
-        # root's buffer is host-visible under one controller)
+        # root's buffer is host-visible under one controller). The
+        # result stays a device array so iscatter keeps real overlap.
+        import jax.numpy as jnp
+
         n = self.size
         flat = sendbuf.reshape(n, -1)
         arr = np.broadcast_to(flat.reshape(-1), (n, flat.size))
-        out = np.asarray(self._local_comm().scatter(arr, root=0))
-        return out.reshape(sendbuf.shape)
+        out = self._local_comm().scatter(arr, root=0)
+        return jnp.reshape(out, sendbuf.shape)
 
     def alltoall(self, send_local, send_remote):
         """Inter-alltoall: local rank i sends ``send_local[i][j]`` to
@@ -306,10 +309,13 @@ class Intercommunicator(Communicator):
         full[:nl, nl:] = send_local          # local rows -> remote dests
         full[nl:, :nl] = send_remote         # remote rows -> local dests
         # bridge alltoall convention: per-rank slice holds n chunks
-        # back to back along the leading axis
+        # back to back along the leading axis. Reshape/slice stay jnp
+        # (device-side, async dispatch) so ialltoall keeps overlap.
+        import jax.numpy as jnp
+
         out = self._bridge.alltoall(full.reshape((n, -1) + trail[1:])
                                     if trail else full.reshape(n, n))
-        out = np.asarray(out).reshape((n, n) + trail)
+        out = jnp.reshape(out, (n, n) + trail)
         # local rank i's received remote chunks: out[i][nl:]
         return out[:nl, nl:]
 
@@ -344,17 +350,35 @@ class Intercommunicator(Communicator):
             rank=self._bridge_local(rank), **kw,
         )
 
+    def _status_to_remote(self, status):
+        """Translate a Status carrying a bridge source rank into the
+        REMOTE-group rank MPI intercomm semantics report (a server
+        replying to status.source would otherwise address the wrong
+        process — or a nonexistent one)."""
+        if status is not None and status.source >= 0:
+            world = self._bridge.group.world_rank(status.source)
+            status.source = self.remote_group.rank_of(world)
+        return status
+
     def irecv(self, source: int = -1, tag: int = -1, *, rank: int):
         src = -1 if source == -1 else self._bridge_remote(source)
-        return self._bridge.irecv(src, tag, rank=self._bridge_local(rank))
+        req = self._bridge.irecv(src, tag, rank=self._bridge_local(rank))
+        req.on_complete(lambda r: self._status_to_remote(r.status))
+        return req
 
     def recv(self, source: int = -1, tag: int = -1, *, rank: int):
         src = -1 if source == -1 else self._bridge_remote(source)
-        return self._bridge.recv(src, tag, rank=self._bridge_local(rank))
+        value, status = self._bridge.recv(
+            src, tag, rank=self._bridge_local(rank)
+        )
+        return value, self._status_to_remote(status)
 
     def iprobe(self, source: int = -1, tag: int = -1, *, rank: int):
         src = -1 if source == -1 else self._bridge_remote(source)
-        return self._bridge.iprobe(src, tag, rank=self._bridge_local(rank))
+        status = self._bridge.iprobe(
+            src, tag, rank=self._bridge_local(rank)
+        )
+        return self._status_to_remote(status)
 
     def sendrecv(self, *a, **kw):
         raise MPIError(

@@ -284,11 +284,22 @@ def forward_loss(cfg: ModelConfig, params: Dict, tokens: jax.Array,
 # ---------------------------------------------------------------------------
 
 def _loss_spmd(cfg: ModelConfig, mesh: Mesh):
+    # interpret-mode pallas (flash off-TPU, the CI simulator) trips
+    # jax's vma checker inside the HLO interpreter (dynamic_slice
+    # "varying manual axes must match", jax-ml/jax — the checker, not
+    # the math: the compiled TPU path type-checks and the kernel is
+    # verified against the dense reference both directions in
+    # tests/test_pallas.py). Disable the check exactly there, keeping
+    # it live for every other configuration.
+    check_vma = not (
+        cfg.attn_impl == "flash" and jax.default_backend() != "tpu"
+    )
     return jax.shard_map(
         partial(forward_loss, cfg),
         mesh=mesh,
         in_specs=(param_specs(cfg), batch_spec(), batch_spec()),
         out_specs=P(),
+        check_vma=check_vma,
     )
 
 

@@ -155,6 +155,9 @@ class Job:
                 ),
                 interval_s=self.heartbeat_s, miss_limit=self.miss_limit,
             )
+            # pubsub name service (MPI_Publish_name/Lookup_name over
+            # the lifeline — the orte-server role lives in the HNP)
+            self.hnp.start_name_server()
             while not self._failed.is_set() and len(self._fin) < self.n:
                 nid = self.hnp.recv_fin(timeout_ms=200)
                 if nid is not None:

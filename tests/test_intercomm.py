@@ -239,6 +239,13 @@ def test_inter_p2p_remote_addressing(pair):
     got, st = ib.recv(source=0, tag=7, rank=1)
     req.wait()
     np.testing.assert_array_equal(np.asarray(got), payload)
+    # status.source is the REMOTE-group rank, not a bridge rank: B's
+    # handle received from A's rank 0 (bridge rank 0 happens to match
+    # here, so also check the reverse direction below)
+    assert st.source == 0
+    ib.send(payload, dest=2, tag=9, rank=3)  # B rank 3 -> A rank 2
+    got3, st3 = ia.recv(source=-1, tag=9, rank=2)
+    assert st3.source == 3  # remote (B-group) rank, not bridge rank 6
     # reply flows back remote->local
     ib.send(payload * 2, dest=0, tag=8, rank=1)
     got2, _ = ia.recv(source=1, tag=8, rank=0)

@@ -109,6 +109,33 @@ class TestEndToEnd:
         assert out.count("val=12345") == 2
 
 
+class TestPubsub:
+    def test_publish_lookup_inside_job(self, tmp_path, capfd):
+        """MPI_Publish_name/Lookup_name inside a live tpurun job: the
+        launcher's HNP serves the name table (orte-server role), so
+        one worker's publish is visible to the others' lookups —
+        including a lookup issued BEFORE the publish (parked)."""
+        app = _write_app(tmp_path, """
+            world = mpi.init()
+            rt = Runtime.current()
+            pi = rt.bootstrap["process_index"]
+            if pi == 0:
+                import time
+                time.sleep(0.4)  # let the others' lookups park first
+                rt.agent.publish_name("job-svc", "tpu-port:7")
+                port = rt.agent.lookup_name("job-svc")
+            else:
+                port = rt.agent.lookup_name("job-svc", timeout_ms=20000)
+            print("found:" + port)
+            mpi.finalize()
+        """)
+        job = Job(3, [sys.executable, app], [], heartbeat_s=0.3)
+        rc = job.run(timeout_s=120)
+        out = capfd.readouterr().out
+        assert rc == 0, out
+        assert out.count("found:tpu-port:7") == 3
+
+
 class TestFailureDetection:
     def test_abnormal_exit_aborts_job(self, tmp_path, capfd):
         """One worker exits 3 mid-job: the job reaches ABORTED, the
