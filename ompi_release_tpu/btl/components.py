@@ -86,11 +86,21 @@ class IciBtl(base.BtlModule):
 class DcnBtl(base.BtlModule):
     """Inter-slice / inter-host transfers over the data-center network.
 
-    Same entry point (the runtime routes device_put over DCN when the
-    peers are in different slices/processes) but its own component so
-    the size constants, ranking, and byte accounting are DCN's —
-    mirroring how btl/tcp and btl/sm coexist with different protocol
-    switch points (btl_tcp_component.c:268 vs btl_sm_component.c:244).
+    TWO genuinely distinct paths, selected by a capability check:
+
+    * **intra-controller** (the destination device is addressable by
+      this process — cross-slice in a single-controller job):
+      ``device_put``, which the runtime routes over DCN between
+      slices. This is the only case where a direct device move is
+      even expressible.
+    * **cross-process** (multi-controller: the peer's devices are NOT
+      addressable here — ``device_put`` would be a silent lie):
+      :meth:`send_staged`/:meth:`recv_staged` — a chunked host-staged
+      transfer over the native OOB (the btl/tcp role played
+      honestly), with its own chunk/byte accounting, segmented at
+      ``max_send_size`` exactly like the reference's pipelined
+      protocol (``btl.h:802``). ``move_segment`` on an unaddressable
+      device raises ERR_UNREACH loudly instead of claiming the route.
     """
 
     NAME = "dcn"
@@ -106,10 +116,119 @@ class DcnBtl(base.BtlModule):
             or src_ep.process_index != dst_ep.process_index
         )
 
+    @property
+    def staged_chunks_pvar(self):
+        from ..mca import pvar
+
+        # the registry dedups by name: repeat registration returns the
+        # existing counter
+        return pvar.counter("btl_dcn_staged_chunks",
+                            "OOB-staged DCN chunks transferred")
+
+    @property
+    def staged_bytes_pvar(self):
+        from ..mca import pvar
+
+        return pvar.counter("btl_dcn_staged_bytes",
+                            "OOB-staged DCN bytes transferred")
+
     def move_segment(self, data, dst_device):
         import jax
 
+        # the actual multi-controller condition: a peer process's
+        # device is never addressable here (device_put would lie)
+        if int(getattr(dst_device, "process_index", 0)) != \
+                jax.process_index():
+            from ..utils.errors import ErrorCode, MPIError
+
+            raise MPIError(
+                ErrorCode.ERR_UNREACH,
+                f"device {dst_device} belongs to another process; a "
+                "multi-controller DCN transfer must go through "
+                "DcnBtl.send_staged/recv_staged over the OOB "
+                "(device_put across controllers is not a real route)",
+            )
         return jax.device_put(data, dst_device)
+
+    # -- cross-process staged path (the honest multi-controller route) ----
+    @staticmethod
+    def _recv_from(oob_ep, want_src, tag: int, deadline: float):
+        """Next (src, payload) for ``tag``, matched by source: frames
+        from other senders interleaved on the same tag are stashed on
+        the endpoint (the OOB recv filters by tag only) and served to
+        their own transfer later — two concurrent staged transfers
+        must not corrupt each other. ``want_src=None`` takes the
+        oldest stashed source, else the next live frame."""
+        import time as _time
+
+        stash = getattr(oob_ep, "_dcn_stash", None)
+        if stash is None:
+            stash = oob_ep._dcn_stash = {}
+        if want_src is None:
+            for (s, t), q in stash.items():
+                if t == tag and q:
+                    return s, q.pop(0)
+        else:
+            q = stash.get((want_src, tag))
+            if q:
+                return want_src, q.pop(0)
+        while True:
+            left = max(1, int((deadline - _time.monotonic()) * 1000))
+            src, _, raw = oob_ep.recv(tag=tag, timeout_ms=left)
+            if want_src is None or src == want_src:
+                return src, raw
+            stash.setdefault((src, tag), []).append(raw)
+
+    def send_staged(self, oob_ep, peer_nid: int, tag: int, data) -> int:
+        """Stream ``data`` to ``peer_nid`` over the OOB in
+        max_send_size chunks. Returns the number of chunks sent."""
+        from ..native import DssBuffer
+
+        arr = np.ascontiguousarray(np.asarray(data))
+        raw = arr.tobytes()
+        chunk = max(1, self.max_send_size)
+        nchunks = max(1, -(-len(raw) // chunk))
+        hdr = DssBuffer()
+        hdr.pack_string(str(arr.dtype))
+        hdr.pack_string(",".join(str(d) for d in arr.shape))
+        hdr.pack_int64(nchunks)
+        oob_ep.send(peer_nid, tag, hdr.tobytes())
+        for i in range(nchunks):
+            oob_ep.send(peer_nid, tag, raw[i * chunk:(i + 1) * chunk])
+            self.staged_chunks_pvar.add()
+        self.staged_bytes_pvar.add(len(raw))
+        return nchunks
+
+    def recv_staged(self, oob_ep, tag: int, *, src=None,
+                    dst_device=None, timeout_ms: int = 30_000):
+        """Reassemble one staged transfer; places the result on
+        ``dst_device`` (default: this process's first device). All
+        chunk frames are matched to the header's source, so transfers
+        from different peers on one tag cannot interleave."""
+        import time as _time
+
+        import jax
+
+        from ..native import DssBuffer
+
+        deadline = _time.monotonic() + timeout_ms / 1000
+        src, hraw = self._recv_from(oob_ep, src, tag, deadline)
+        hdr = DssBuffer(hraw)
+        dtype = np.dtype(hdr.unpack_string())
+        shape_s = hdr.unpack_string()
+        shape = tuple(int(d) for d in shape_s.split(",")) if shape_s \
+            else ()
+        (nchunks,) = hdr.unpack_int64()
+        parts = []
+        for _ in range(int(nchunks)):
+            _, praw = self._recv_from(oob_ep, src, tag, deadline)
+            parts.append(praw)
+            self.staged_chunks_pvar.add()
+        arr = np.frombuffer(b"".join(parts), dtype=dtype).reshape(shape)
+        self.staged_bytes_pvar.add(arr.nbytes)
+        if dst_device is None:
+            dst_device = jax.local_devices()[0]
+        return jax.device_put(arr, dst_device)
 
 
 class HostBtl(base.BtlModule):

@@ -203,8 +203,173 @@ class CartTopo:
         )
 
 
+# ---------------------------------------------------------------------------
+# ragged neighborhoods (graph / dist-graph): static ppermute rounds
+# ---------------------------------------------------------------------------
+#
+# A cart topology's neighbor slots are uniform, so each slot is one
+# ppermute. Graph/dist-graph adjacency is ragged: the edge set is
+# greedily edge-colored into ROUNDS where every rank sends at most one
+# block and receives at most one block — each round is then a legal
+# (partial) permutation, and the whole collective compiles to one
+# program of len(rounds) static ppermutes with constant slot tables
+# (the libnbc round-schedule idea, nbc_ineighbor_allgather.c, with the
+# schedule baked into the XLA program instead of replayed by a
+# progress engine).
+
+class _NeighborSchedule:
+    """Edge-colored schedule for one (in_neighbors, out_neighbors)."""
+
+    def __init__(self, in_neighbors: List[List[int]],
+                 out_neighbors: List[List[int]]) -> None:
+        n = len(in_neighbors)
+        self.n = n
+        self.in_neighbors = [list(x) for x in in_neighbors]
+        self.out_neighbors = [list(x) for x in out_neighbors]
+        self.max_in = max((len(x) for x in in_neighbors), default=0)
+        self.max_out = max((len(x) for x in out_neighbors), default=0)
+
+        # edge list with slot indices matched by occurrence order
+        # (duplicate edges pair up first-to-first, MPI buffer order)
+        out_cursor: Dict[Tuple[int, int], int] = {}
+        edges = []  # (src, dst, send_slot, recv_slot)
+        for dst in range(n):
+            for recv_slot, src in enumerate(self.in_neighbors[dst]):
+                k = out_cursor.get((src, dst), 0)
+                # find the (k+1)-th occurrence of dst in src's out list
+                seen = -1
+                send_slot = -1
+                for j, d in enumerate(self.out_neighbors[src]):
+                    if d == dst:
+                        seen += 1
+                        if seen == k:
+                            send_slot = j
+                            break
+                if send_slot < 0:
+                    raise MPIError(
+                        ErrorCode.ERR_TOPOLOGY,
+                        f"edge {src}->{dst} in rank {dst}'s sources has "
+                        f"no matching entry in rank {src}'s destinations",
+                    )
+                out_cursor[(src, dst)] = k + 1
+                edges.append((src, dst, send_slot, recv_slot))
+        for src in range(n):
+            for dst in self.out_neighbors[src]:
+                if out_cursor.get((src, dst), 0) != \
+                        self.out_neighbors[src].count(dst) or \
+                        self.in_neighbors[dst].count(src) != \
+                        self.out_neighbors[src].count(dst):
+                    raise MPIError(
+                        ErrorCode.ERR_TOPOLOGY,
+                        f"edge {src}->{dst} in destinations has no "
+                        "matching entry in the target's sources",
+                    )
+
+        # greedy edge coloring: each round is a partial permutation
+        self.rounds: List[List[Tuple[int, int]]] = []
+        self.send_slots: List[List[int]] = []  # per round: [n] (-1 none)
+        self.recv_slots: List[List[int]] = []
+        remaining = edges
+        while remaining:
+            used_src, used_dst = set(), set()
+            this, rest = [], []
+            for e in remaining:
+                if e[0] not in used_src and e[1] not in used_dst:
+                    this.append(e)
+                    used_src.add(e[0])
+                    used_dst.add(e[1])
+                else:
+                    rest.append(e)
+            self.rounds.append([(e[0], e[1]) for e in this])
+            ss = [-1] * n
+            rs = [-1] * n
+            for src, dst, send_slot, recv_slot in this:
+                ss[src] = send_slot
+                rs[dst] = recv_slot
+            self.send_slots.append(ss)
+            self.recv_slots.append(rs)
+            remaining = rest
+
+    def key(self) -> Tuple:
+        return (
+            tuple(tuple(x) for x in self.in_neighbors),
+            tuple(tuple(x) for x in self.out_neighbors),
+        )
+
+
+def _neighbor_allgather_ragged(comm, sched: _NeighborSchedule, x):
+    """Each rank's single block delivered to all its out-neighbors;
+    rank r receives into slot i the block from in_neighbors[r][i].
+    Returns (size, max_in, ...) with zeros in unused slots."""
+    from jax import lax
+
+    from ..coll.driver import run_sharded
+
+    max_in = max(sched.max_in, 1)
+    recv_tables = np.asarray(sched.recv_slots, np.int32)  # (rounds, n)
+    rounds = sched.rounds
+
+    def body(xb):
+        rank = lax.axis_index("rank")
+        out = jnp.zeros((max_in,) + xb.shape, xb.dtype)
+        for i, perm in enumerate(rounds):
+            recv = lax.ppermute(xb, "rank", perm)
+            slot = jnp.asarray(recv_tables[i])[rank]
+            onehot = (
+                jnp.arange(max_in) == slot
+            ).reshape((max_in,) + (1,) * xb.ndim)
+            out = jnp.where(onehot, recv[None], out)
+        return out
+
+    return run_sharded(
+        comm, ("topo", "graph_neighbor_allgather", sched.key()), body, x
+    )
+
+
+def _neighbor_alltoall_ragged(comm, sched: _NeighborSchedule, x):
+    """x: (size, max_out, ...) — rank r's block j goes to
+    out_neighbors[r][j]. Returns (size, max_in, ...)."""
+    from jax import lax
+
+    from ..coll.driver import run_sharded
+
+    max_in = max(sched.max_in, 1)
+    max_out = max(sched.max_out, 1)
+    if getattr(x, "ndim", 0) < 2 or x.shape[1] != max_out:
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"neighbor_alltoall needs (size, {max_out}, ...) — "
+            f"{max_out} send blocks per rank (max out-degree), got "
+            f"shape {getattr(x, 'shape', None)}",
+        )
+    send_tables = np.asarray(sched.send_slots, np.int32)
+    recv_tables = np.asarray(sched.recv_slots, np.int32)
+    rounds = sched.rounds
+
+    def body(xb):  # xb: (max_out, ...)
+        rank = lax.axis_index("rank")
+        out = jnp.zeros((max_in,) + xb.shape[1:], xb.dtype)
+        for i, perm in enumerate(rounds):
+            sslot = jnp.asarray(send_tables[i])[rank]
+            send = jnp.take(xb, jnp.maximum(sslot, 0), axis=0)
+            recv = lax.ppermute(send, "rank", perm)
+            rslot = jnp.asarray(recv_tables[i])[rank]
+            onehot = (
+                jnp.arange(max_in) == rslot
+            ).reshape((max_in,) + (1,) * (xb.ndim - 1))
+            out = jnp.where(onehot, recv[None], out)
+        return out
+
+    return run_sharded(
+        comm, ("topo", "graph_neighbor_alltoall", sched.key()), body, x
+    )
+
+
 class GraphTopo:
-    """MPI_Graph_create analogue (index/edges arrays)."""
+    """MPI_Graph_create analogue (index/edges arrays) WITH neighborhood
+    collectives over the ragged adjacency (the reference supports
+    neighborhood collectives on all three topology kinds,
+    ``nbc_ineighbor_allgather.c``)."""
 
     def __init__(self, comm, index: Sequence[int],
                  edges: Sequence[int]) -> None:
@@ -216,20 +381,85 @@ class GraphTopo:
                 ErrorCode.ERR_TOPOLOGY,
                 f"graph index length {len(index)} != comm size",
             )
+        self._sched: Optional[_NeighborSchedule] = None
 
     def neighbors(self, rank: int) -> List[int]:
         lo = self.index[rank - 1] if rank else 0
         return list(self.edges[lo:self.index[rank]])
 
+    @property
+    def max_degree(self) -> int:
+        return self._schedule().max_in
+
+    def _schedule(self) -> _NeighborSchedule:
+        # MPI graph neighborhoods send to and receive from the same
+        # neighbor list (the graph must be symmetric for the
+        # collectives to be well-defined — validated by the schedule's
+        # edge matching)
+        if self._sched is None:
+            adj = [self.neighbors(r) for r in range(self.comm.size)]
+            self._sched = _NeighborSchedule(adj, adj)
+        return self._sched
+
+    def neighbor_allgather(self, x):
+        """Driver mode: x (size, ...) -> (size, max_degree, ...);
+        rank r's slot i holds the block from neighbors(r)[i]."""
+        return _neighbor_allgather_ragged(self.comm, self._schedule(), x)
+
+    def neighbor_alltoall(self, x):
+        """x (size, max_degree, ...): rank r's block j goes to
+        neighbors(r)[j]; slot i of the result came from
+        neighbors(r)[i]."""
+        return _neighbor_alltoall_ragged(self.comm, self._schedule(), x)
+
 
 class DistGraphTopo:
-    """MPI_Dist_graph_create_adjacent analogue."""
+    """MPI_Dist_graph_create_adjacent analogue (driver mode: per-rank
+    adjacency, one controller playing every rank) with neighborhood
+    collectives over the directed ragged edge set."""
 
-    def __init__(self, comm, sources: Sequence[int],
-                 destinations: Sequence[int]) -> None:
+    def __init__(self, comm, sources: Sequence[Sequence[int]],
+                 destinations: Sequence[Sequence[int]]) -> None:
         self.comm = comm
-        self.sources = tuple(sources)
-        self.destinations = tuple(destinations)
+        if len(sources) != comm.size or len(destinations) != comm.size:
+            raise MPIError(
+                ErrorCode.ERR_TOPOLOGY,
+                "dist graph needs per-rank sources/destinations lists "
+                f"of length {comm.size}",
+            )
+        self.sources = tuple(tuple(int(s) for s in x) for x in sources)
+        self.destinations = tuple(
+            tuple(int(d) for d in x) for x in destinations
+        )
+        # validates that every source entry has a matching destination
+        self._sched = _NeighborSchedule(
+            [list(x) for x in self.sources],
+            [list(x) for x in self.destinations],
+        )
+
+    def in_neighbors(self, rank: int) -> List[int]:
+        return list(self.sources[rank])
+
+    def out_neighbors(self, rank: int) -> List[int]:
+        return list(self.destinations[rank])
+
+    @property
+    def max_in_degree(self) -> int:
+        return self._sched.max_in
+
+    @property
+    def max_out_degree(self) -> int:
+        return self._sched.max_out
+
+    def neighbor_allgather(self, x):
+        """x (size, ...) -> (size, max_in_degree, ...): rank r's slot
+        i holds the block from sources[r][i]."""
+        return _neighbor_allgather_ragged(self.comm, self._sched, x)
+
+    def neighbor_alltoall(self, x):
+        """x (size, max_out_degree, ...): rank r's block j goes to
+        destinations[r][j]; result slot i came from sources[r][i]."""
+        return _neighbor_alltoall_ragged(self.comm, self._sched, x)
 
 
 def cart_create(comm, dims: Sequence[int],
@@ -257,8 +487,8 @@ def graph_create(comm, index: Sequence[int], edges: Sequence[int]):
     return c, topo
 
 
-def dist_graph_create_adjacent(comm, sources: Sequence[int],
-                               destinations: Sequence[int]):
+def dist_graph_create_adjacent(comm, sources: Sequence[Sequence[int]],
+                               destinations: Sequence[Sequence[int]]):
     c = comm.dup(name="dist_graph")
     topo = DistGraphTopo(c, sources, destinations)
     c.topo = topo

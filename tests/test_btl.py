@@ -208,3 +208,133 @@ class TestPmlIntegration:
         finally:
             mca_var.VARS.unset("btl_ici_eager_limit")
             sub.free()
+
+
+class TestHonestDcn:
+    """VERDICT r2 #9: DCN's two real paths. device_put across
+    controllers is not a route — move_segment capability-checks and
+    the cross-process path is a chunked OOB-staged transfer with its
+    own accounting."""
+
+    def test_move_segment_unaddressable_raises(self):
+        from ompi_release_tpu.btl.components import DcnBtl
+
+        class FakeDevice:  # a peer process's device
+            process_index = 1
+
+            def __repr__(self):
+                return "FakeRemoteDevice(process=1)"
+
+        m = DcnBtl()
+        x = jnp.ones((4,), jnp.float32)
+        with pytest.raises(MPIError) as ei:
+            m.move_segment(x, FakeDevice())
+        assert "send_staged" in str(ei.value)
+
+    def test_staged_transfer_in_process_sockets(self):
+        """Chunked OOB transfer over real sockets: 3 MiB at 1 MiB
+        max_send -> 3 chunks, bitwise-identical reassembly, pvar
+        accounting."""
+        from ompi_release_tpu.btl.components import DcnBtl
+        from ompi_release_tpu.mca import var as mca_var
+        from ompi_release_tpu.native import OobEndpoint
+
+        a, b = OobEndpoint(0), OobEndpoint(1)
+        try:
+            b.connect(0, "127.0.0.1", a.port)
+            m = DcnBtl()
+            mca_var.set_value("btl_dcn_max_send_size", str(1 << 20))
+            try:
+                rng = np.random.RandomState(0)
+                x = rng.randn(3 << 18).astype(np.float32)  # 3 MiB
+                before = int(m.staged_chunks_pvar.read())
+                sent = m.send_staged(b, 0, 21, x)
+                assert sent == 3
+                got = m.recv_staged(a, 21)
+                np.testing.assert_array_equal(np.asarray(got), x)
+                # sender + receiver both account their chunks
+                assert int(m.staged_chunks_pvar.read()) - before == 6
+            finally:
+                mca_var.VARS.unset("btl_dcn_max_send_size")
+        finally:
+            a.close()
+            b.close()
+
+    def test_staged_transfer_cross_process(self, tmp_path):
+        """The real multi-controller shape: a second PROCESS streams
+        an array to us over the OOB; no device handle ever crosses
+        the process boundary."""
+        import subprocess
+        import sys
+        import textwrap
+
+        from ompi_release_tpu.btl.components import DcnBtl
+        from ompi_release_tpu.native import OobEndpoint
+
+        script = textwrap.dedent("""
+            import sys
+            sys.path.insert(0, "/root/repo")
+            import numpy as np
+            from ompi_release_tpu.btl.components import DcnBtl
+            from ompi_release_tpu.native import OobEndpoint
+
+            port = int(sys.argv[1])
+            ep = OobEndpoint(1)
+            ep.connect(0, "127.0.0.1", port)
+            x = np.arange(200_000, dtype=np.float32)
+            DcnBtl().send_staged(ep, 0, 33, x)
+            ep.recv(tag=34, timeout_ms=30000)  # ack gates teardown
+            ep.close()
+        """)
+        p = tmp_path / "dcn_sender.py"
+        p.write_text(script)
+        ep = OobEndpoint(0)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, str(p), str(ep.port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+            got = DcnBtl().recv_staged(ep, 33)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.arange(200_000, dtype=np.float32)
+            )
+            ep.send(1, 34, b"ok")
+            _, err = proc.communicate(timeout=30)
+            assert proc.returncode == 0, err
+        finally:
+            ep.close()
+
+    def test_concurrent_staged_transfers_do_not_interleave(self):
+        """Two senders on ONE tag: chunk frames are matched to each
+        transfer's header source (stash), not consumed blindly."""
+        from ompi_release_tpu.btl.components import DcnBtl
+        from ompi_release_tpu.mca import var as mca_var
+        from ompi_release_tpu.native import OobEndpoint
+        import threading
+
+        root = OobEndpoint(0)
+        s1, s2 = OobEndpoint(1), OobEndpoint(2)
+        try:
+            s1.connect(0, "127.0.0.1", root.port)
+            s2.connect(0, "127.0.0.1", root.port)
+            m = DcnBtl()
+            mca_var.set_value("btl_dcn_max_send_size", str(64 * 1024))
+            try:
+                x1 = np.full(100_000, 1.0, np.float32)
+                x2 = np.full(120_000, 2.0, np.float32)
+                t1 = threading.Thread(
+                    target=lambda: m.send_staged(s1, 0, 9, x1))
+                t2 = threading.Thread(
+                    target=lambda: m.send_staged(s2, 0, 9, x2))
+                t1.start(); t2.start()
+                a = np.asarray(m.recv_staged(root, 9))
+                b = np.asarray(m.recv_staged(root, 9))
+                t1.join(); t2.join()
+                got = {arr.shape[0]: arr for arr in (a, b)}
+                np.testing.assert_array_equal(got[100_000], x1)
+                np.testing.assert_array_equal(got[120_000], x2)
+            finally:
+                mca_var.VARS.unset("btl_dcn_max_send_size")
+        finally:
+            for e in (root, s1, s2):
+                e.close()
