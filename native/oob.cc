@@ -237,8 +237,11 @@ struct Endpoint {
 
 extern "C" {
 
-// Create an endpoint with a listener on 127.0.0.1:port (0 = ephemeral).
-void* oob_create(int32_t id, int port) {
+// Create an endpoint listening on bind_addr:port (0 = ephemeral).
+// bind_addr "0.0.0.0" listens on every interface — required for the
+// multi-host PLM (plm_rsh analogue) where tree peers connect across
+// machines; the default remains loopback for single-host jobs.
+void* oob_create_bound(int32_t id, int port, const char* bind_addr) {
   auto* ep = new Endpoint();
   ep->id = id;
   ep->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -246,7 +249,16 @@ void* oob_create(int32_t id, int port) {
   setsockopt(ep->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (bind_addr == nullptr || *bind_addr == '\0') {
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  } else if (inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1) {
+    // an unparseable address must fail loudly, not silently bind
+    // loopback and leave remote peers' connects refused far from
+    // the cause
+    ::close(ep->listen_fd);
+    delete ep;
+    return nullptr;
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (bind(ep->listen_fd, reinterpret_cast<sockaddr*>(&addr),
            sizeof addr) != 0 ||
@@ -259,6 +271,11 @@ void* oob_create(int32_t id, int port) {
   ep->port = ntohs(addr.sin_port);
   ep->acceptor = std::thread([ep] { ep->accept_loop(); });
   return ep;
+}
+
+// Back-compat loopback-only entry point.
+void* oob_create(int32_t id, int port) {
+  return oob_create_bound(id, port, "127.0.0.1");
 }
 
 int oob_port(void* h) { return static_cast<Endpoint*>(h)->port; }

@@ -118,19 +118,25 @@ class DcnBtl(base.BtlModule):
 
     @property
     def staged_chunks_pvar(self):
-        from ..mca import pvar
+        c = getattr(self, "_staged_chunks_pvar", None)
+        if c is None:  # cached: .add() runs once per chunk
+            from ..mca import pvar
 
-        # the registry dedups by name: repeat registration returns the
-        # existing counter
-        return pvar.counter("btl_dcn_staged_chunks",
-                            "OOB-staged DCN chunks transferred")
+            c = self._staged_chunks_pvar = pvar.counter(
+                "btl_dcn_staged_chunks",
+                "OOB-staged DCN chunks transferred")
+        return c
 
     @property
     def staged_bytes_pvar(self):
-        from ..mca import pvar
+        c = getattr(self, "_staged_bytes_pvar", None)
+        if c is None:
+            from ..mca import pvar
 
-        return pvar.counter("btl_dcn_staged_bytes",
-                            "OOB-staged DCN bytes transferred")
+            c = self._staged_bytes_pvar = pvar.counter(
+                "btl_dcn_staged_bytes",
+                "OOB-staged DCN bytes transferred")
+        return c
 
     def move_segment(self, data, dst_device):
         import jax

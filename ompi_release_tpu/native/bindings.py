@@ -77,6 +77,9 @@ def _declare(lib: ctypes.CDLL) -> None:
 
     lib.oob_create.argtypes = [ctypes.c_int32, ctypes.c_int]
     lib.oob_create.restype = P
+    lib.oob_create_bound.argtypes = [ctypes.c_int32, ctypes.c_int,
+                                     ctypes.c_char_p]
+    lib.oob_create_bound.restype = P
     lib.oob_port.argtypes = [P]
     lib.oob_port.restype = ctypes.c_int
     lib.oob_connect.argtypes = [P, ctypes.c_int32, ctypes.c_char_p,
@@ -212,12 +215,14 @@ class OobEndpoint:
     """Tagged TCP messaging endpoint with tree routing (oob/rml/routed
     analogue)."""
 
-    def __init__(self, node_id: int, port: int = 0) -> None:
+    def __init__(self, node_id: int, port: int = 0,
+                 bind_addr: str = "127.0.0.1") -> None:
         self._lib = load_library()
-        self._h = self._lib.oob_create(node_id, port)
+        self._h = self._lib.oob_create_bound(node_id, port,
+                                             bind_addr.encode())
         if not self._h:
             raise MPIError(ErrorCode.ERR_OTHER,
-                           f"oob_create failed (port {port})")
+                           f"oob_create failed ({bind_addr}:{port})")
         self.node_id = node_id
 
     @property

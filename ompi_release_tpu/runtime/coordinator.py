@@ -77,6 +77,25 @@ def binomial_children(v: int, n: int) -> List[int]:
     return out
 
 
+def local_addr_toward(host: str, port: int = 9) -> str:
+    """The local interface address a connection to ``host`` leaves
+    from (UDP connect trick — no packet is sent). This is the REAL
+    address to advertise in a modex card: tree peers on other machines
+    must be able to dial it, so the 127.0.0.1 placeholder only
+    survives when the HNP itself is on loopback."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((host, port or 9))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
 def _pack_card(node_id: int, card: Dict[str, Any]) -> bytes:
     b = DssBuffer()
     b.pack_int64(node_id)
@@ -100,11 +119,12 @@ class HnpCoordinator:
     only the workers' cards, ordered by node id (index = node_id - 1).
     """
 
-    def __init__(self, num_nodes: int, port: int = 0) -> None:
+    def __init__(self, num_nodes: int, port: int = 0,
+                 bind_addr: str = "127.0.0.1") -> None:
         if num_nodes < 1:
             raise MPIError(ErrorCode.ERR_ARG, "num_nodes must be >= 1")
         self.num_nodes = num_nodes
-        self.ep = OobEndpoint(0, port)
+        self.ep = OobEndpoint(0, port, bind_addr)
         self._barrier_seq = 0
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
@@ -386,7 +406,13 @@ class WorkerAgent:
                            "worker node_id must be >= 1 (0 is the HNP)")
         self.node_id = node_id
         self.num_nodes = num_nodes  # tree size (incl. HNP); set by modex
-        self.ep = OobEndpoint(node_id)
+        # advertise the interface that actually faces the HNP; when
+        # the HNP is off-host our listener must accept from other
+        # machines too (tree links are worker-to-worker)
+        self.local_addr = local_addr_toward(hnp_host, hnp_port)
+        bind = ("127.0.0.1" if self.local_addr.startswith("127.")
+                else "0.0.0.0")
+        self.ep = OobEndpoint(node_id, 0, bind)
         self.ep.connect(0, hnp_host, hnp_port)
         self.ep.set_default_route(0)  # everything flows toward the root
         self.cards: List[Dict[str, Any]] = []
@@ -400,7 +426,7 @@ class WorkerAgent:
         be formed afterwards (see :meth:`setup_tree`)."""
         my_card = dict(my_card)
         my_card.setdefault("oob_port", self.ep.port)
-        my_card.setdefault("oob_host", "127.0.0.1")
+        my_card.setdefault("oob_host", self.local_addr)
         self.ep.send(0, TAG_JOIN, _pack_card(self.node_id, my_card))
         _, _, raw = self.ep.recv(tag=TAG_MODEX, timeout_ms=timeout_ms)
         self.cards = json.loads(DssBuffer(raw).unpack_string())

@@ -41,8 +41,122 @@ from typing import Dict, List, Optional
 from ..runtime import coordinator as coord
 from ..runtime.state import JobState, ProcState, StateMachine
 from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
 
 _log = output.stream("tpurun")
+
+_LOCAL_NAMES = ("localhost", "127.0.0.1")
+
+
+# ---------------------------------------------------------------------------
+# rmaps-lite: hostfile + rank->host mapping (orte/mca/rmaps analogue)
+# ---------------------------------------------------------------------------
+
+class HostSpec:
+    """One allocation line: hostname + slot count (ras analogue)."""
+
+    def __init__(self, name: str, slots: int = 1) -> None:
+        if slots < 1:
+            raise MPIError(ErrorCode.ERR_ARG,
+                           f"host {name}: slots must be >= 1")
+        self.name = name
+        self.slots = slots
+
+    @property
+    def is_local(self) -> bool:
+        return self.name in _LOCAL_NAMES
+
+    def __repr__(self) -> str:
+        return f"HostSpec({self.name}, slots={self.slots})"
+
+
+def parse_hostfile(path: str) -> List[HostSpec]:
+    """Hostfile lines: ``hostname [slots=N]`` (# comments allowed) —
+    the mpirun hostfile format's core."""
+    hosts: List[HostSpec] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    try:
+                        slots = int(p.split("=", 1)[1])
+                    except ValueError:
+                        raise MPIError(
+                            ErrorCode.ERR_ARG,
+                            f"hostfile {path}: bad slot count in "
+                            f"'{line}'",
+                        )
+            hosts.append(HostSpec(parts[0], slots))
+    if not hosts:
+        raise MPIError(ErrorCode.ERR_ARG, f"hostfile {path} has no hosts")
+    return hosts
+
+
+def parse_host_list(spec: str) -> List[HostSpec]:
+    """``--host a:2,b,c:4`` (name[:slots] comma list)."""
+    hosts = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if ":" in item:
+            name, slots = item.rsplit(":", 1)
+            try:
+                hosts.append(HostSpec(name, int(slots)))
+            except ValueError:
+                raise MPIError(ErrorCode.ERR_ARG,
+                               f"bad slot count in '{item}'")
+        else:
+            hosts.append(HostSpec(item))
+    if not hosts:
+        raise MPIError(ErrorCode.ERR_ARG, f"empty host list '{spec}'")
+    return hosts
+
+
+def map_ranks(hosts: List[HostSpec], n: int,
+              policy: str = "slot") -> List[HostSpec]:
+    """Rank->host mapping (rmaps round_robin analogue).
+
+    ``slot``: fill each host's slots before moving on (rmaps_rr
+    by-slot). ``node``: round-robin one rank per host per pass
+    (by-node). Oversubscription (n > total slots) is an error, like
+    the reference without ``--oversubscribe``.
+    """
+    total = sum(h.slots for h in hosts)
+    if n > total:
+        raise MPIError(
+            ErrorCode.ERR_ARG,
+            f"{n} ranks > {total} slots on {len(hosts)} hosts "
+            "(no oversubscription)",
+        )
+    out: List[HostSpec] = []
+    if policy == "slot":
+        for h in hosts:
+            for _ in range(h.slots):
+                if len(out) < n:
+                    out.append(h)
+    elif policy == "node":
+        used = {id(h): 0 for h in hosts}
+        while len(out) < n:
+            progressed = False
+            for h in hosts:
+                if len(out) >= n:
+                    break
+                if used[id(h)] < h.slots:
+                    out.append(h)
+                    used[id(h)] += 1
+                    progressed = True
+            if not progressed:  # all slots consumed (can't happen: n<=total)
+                break
+    else:
+        raise MPIError(ErrorCode.ERR_ARG,
+                       f"unknown map-by policy '{policy}'")
+    return out
 
 
 class Job:
@@ -50,16 +164,26 @@ class Job:
 
     def __init__(self, num_procs: int, argv: List[str],
                  mca: List[tuple], *, heartbeat_s: float = 0.5,
-                 miss_limit: int = 4, tag_output: bool = True) -> None:
+                 miss_limit: int = 4, tag_output: bool = True,
+                 hosts: Optional[List[HostSpec]] = None,
+                 map_by: str = "slot",
+                 launch_agent: str = "ssh") -> None:
         self.n = num_procs
         self.argv = argv
         self.mca = mca
         self.heartbeat_s = heartbeat_s
         self.miss_limit = miss_limit
         self.tag_output = tag_output
+        # rmaps: rank r runs on rank_hosts[r] (default: all-local,
+        # the single-host fork path)
+        self.hosts = hosts or [HostSpec("localhost", num_procs)]
+        self.rank_hosts = map_ranks(self.hosts, num_procs, map_by)
+        self.remote = any(not h.is_local for h in self.rank_hosts)
+        self.launch_agent = launch_agent
         self.job_state = StateMachine("tpurun-job")
         self.proc_state: Dict[int, int] = {}
         self.hnp: Optional[coord.HnpCoordinator] = None
+        self.hnp_host = "127.0.0.1"
         self.procs: Dict[int, subprocess.Popen] = {}
         self._iof_threads: List[threading.Thread] = []
         self._failed = threading.Event()
@@ -69,12 +193,23 @@ class Job:
     # -- launch ------------------------------------------------------------
     def _env_for(self, node_id: int) -> Dict[str, str]:
         env = dict(os.environ)
-        env["OMPITPU_HNP"] = f"127.0.0.1:{self.hnp.port}"
-        env["OMPITPU_NODE_ID"] = str(node_id)
-        env["OMPITPU_NUM_NODES"] = str(self.n)
-        env["OMPITPU_MCA_ess_tpurun_heartbeat_interval"] = str(
-            self.heartbeat_s
-        )
+        env.update(self._ompitpu_env(node_id))
+        return env
+
+    def _ompitpu_env(self, node_id: int) -> Dict[str, str]:
+        """The contract env vars alone — what an rsh launch must carry
+        across the wire (ssh does not forward the environment; the
+        reference builds them into the orted command line,
+        plm_rsh_module.c:872)."""
+        env = {
+            "OMPITPU_HNP": f"{self.hnp_host}:{self.hnp.port}",
+            "OMPITPU_NODE_ID": str(node_id),
+            "OMPITPU_NUM_NODES": str(self.n),
+            "OMPITPU_HOST": self.rank_hosts[node_id - 1].name,
+            "OMPITPU_MCA_ess_tpurun_heartbeat_interval": str(
+                self.heartbeat_s
+            ),
+        }
         for k, v in self.mca:
             env[f"OMPITPU_MCA_{k}"] = str(v)
         return env
@@ -87,8 +222,27 @@ class Job:
             out.flush()
 
     def _spawn(self, node_id: int) -> None:
+        host = self.rank_hosts[node_id - 1]
+        if host.is_local:
+            cmd = self.argv
+            env = self._env_for(node_id)
+        else:
+            # rsh launch (plm_rsh_module.c:929): agent + host + env
+            # assignments + program. ssh joins the args and hands ONE
+            # string to the remote shell, so every word is quoted
+            # (the reference's plm_rsh quotes its orted cmdline too)
+            import shlex
+
+            cmd = (
+                self.launch_agent.split()
+                + [host.name, "env"]
+                + [shlex.quote(f"{k}={v}") for k, v in
+                   sorted(self._ompitpu_env(node_id).items())]
+                + [shlex.quote(a) for a in self.argv]
+            )
+            env = dict(os.environ)
         p = subprocess.Popen(
-            self.argv, env=self._env_for(node_id),
+            cmd, env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, bufsize=1,
         )
@@ -128,7 +282,29 @@ class Job:
     # -- run ---------------------------------------------------------------
     def run(self, timeout_s: float = 300.0) -> int:
         self.job_state.activate(JobState.INIT)
-        self.hnp = coord.HnpCoordinator(self.n + 1)
+        if self.remote:
+            # remote workers must dial back: listen on every
+            # interface and advertise the outbound address toward the
+            # first remote host (the reference's HNP URI)
+            first_remote = next(
+                h for h in self.rank_hosts if not h.is_local
+            )
+            self.hnp_host = coord.local_addr_toward(first_remote.name)
+            if self.hnp_host.startswith("127."):
+                # loopback is only correct when the "remote" host IS
+                # this machine (fake-agent tests); a genuinely remote
+                # worker handed 127.0.0.1 would dial itself and the
+                # job would hang to the timeout with no clue — warn
+                # loudly now, while the cause is still visible
+                _log.verbose(
+                    0, f"WARNING: no route toward {first_remote.name}; "
+                       f"advertising loopback HNP address — remote "
+                       f"workers will not reach it unless "
+                       f"{first_remote.name} resolves to this machine")
+            self.hnp = coord.HnpCoordinator(self.n + 1,
+                                            bind_addr="0.0.0.0")
+        else:
+            self.hnp = coord.HnpCoordinator(self.n + 1)
         self.job_state.activate(JobState.LAUNCH_DAEMONS)
         for nid in range(1, self.n + 1):
             self._spawn(nid)
@@ -250,6 +426,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="worker heartbeat interval in seconds")
     ap.add_argument("--no-tag-output", action="store_true",
                     help="do not prefix forwarded stdio with [rank k]")
+    ap.add_argument("--hostfile", default=None,
+                    help="allocation file: 'hostname [slots=N]' lines")
+    ap.add_argument("--host", default=None,
+                    help="comma host list 'a:2,b,c:4' (name[:slots])")
+    ap.add_argument("--map-by", choices=("slot", "node"), default="slot",
+                    help="rank->host policy (rmaps round_robin analogue)")
+    ap.add_argument("--launch-agent", default="ssh",
+                    help="remote launch command (plm_rsh agent)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="program and arguments to launch")
     args = ap.parse_args(argv)
@@ -257,10 +441,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("no command given")
     if args.np < 1:
         ap.error("-n must be >= 1")
+    if args.hostfile and args.host:
+        ap.error("--hostfile and --host are mutually exclusive")
+    hosts = None
+    if args.hostfile:
+        hosts = parse_hostfile(args.hostfile)
+    elif args.host:
+        hosts = parse_host_list(args.host)
 
     job = Job(args.np, args.command, [tuple(m) for m in args.mca],
               heartbeat_s=args.heartbeat,
-              tag_output=not args.no_tag_output)
+              tag_output=not args.no_tag_output,
+              hosts=hosts, map_by=args.map_by,
+              launch_agent=args.launch_agent)
 
     def on_signal(signum, frame):
         job._failed.set()
