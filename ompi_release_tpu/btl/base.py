@@ -67,6 +67,11 @@ class BtlModule:
     LATENCY = 50
     BANDWIDTH = 1000
     EXCLUSIVITY = 0
+    #: False for out-of-band transports (shm handoff) whose transfer
+    #: entry points are not move_segment — the BML keeps them out of
+    #: its in-band move lists so selection cannot route a device move
+    #: onto a module that cannot perform one
+    SUPPORTS_MOVE = True
 
     def _var(self, attr: str, default: int) -> int:
         return int(mca_var.get(f"btl_{self.NAME}_{attr}", default))
@@ -102,16 +107,21 @@ class BtlModule:
         raise NotImplementedError
 
     # -- accounting --------------------------------------------------------
+    def _cached_counter(self, attr: str, name: str, doc: str):
+        """Lazily-registered, instance-cached pvar (hot paths call
+        .add() per chunk — no registry lookup per call)."""
+        c = getattr(self, attr, None)
+        if c is None:
+            c = pvar.counter(name, doc)
+            setattr(self, attr, c)
+        return c
+
     @property
     def bytes_pvar(self):
-        c = getattr(self, "_bytes_pvar", None)
-        if c is None:
-            c = pvar.counter(
-                f"btl_{self.NAME}_bytes",
-                f"bytes moved through the {self.NAME} btl",
-            )
-            self._bytes_pvar = c
-        return c
+        return self._cached_counter(
+            "_bytes_pvar", f"btl_{self.NAME}_bytes",
+            f"bytes moved through the {self.NAME} btl",
+        )
 
     def move(self, data, dst_device):
         self.bytes_pvar.add(int(data.size * data.dtype.itemsize))
@@ -158,15 +168,18 @@ class BmlEndpoint:
         self.dst_ep = dst_ep
         self.dst_device = dst_device
         reach = [m for m in modules if m.reachable(src_ep, dst_ep)]
-        if not reach:
+        # out-of-band transports (shm handoff) are reachable but have
+        # no in-band move entry point: the move lists hold movers only
+        movers = [m for m in reach if m.SUPPORTS_MOVE]
+        if not movers:
             raise MPIError(
                 ErrorCode.ERR_UNREACH,
                 f"no btl reaches rank {dst_ep.rank} from {src_ep.rank}",
             )
         # exclusivity: keep only the highest tier (btl.h:797 — e.g. the
         # loopback btl owns self-sends outright, as btl/self does)
-        top = max(m.exclusivity for m in reach)
-        tier = [m for m in reach if m.exclusivity == top]
+        top = max(m.exclusivity for m in movers)
+        tier = [m for m in movers if m.exclusivity == top]
         self.btl_eager = sorted(tier, key=lambda m: (m.latency, m.NAME))
         self.btl_send = list(self.btl_eager)
         self.btl_rdma = sorted(

@@ -118,25 +118,15 @@ class DcnBtl(base.BtlModule):
 
     @property
     def staged_chunks_pvar(self):
-        c = getattr(self, "_staged_chunks_pvar", None)
-        if c is None:  # cached: .add() runs once per chunk
-            from ..mca import pvar
-
-            c = self._staged_chunks_pvar = pvar.counter(
-                "btl_dcn_staged_chunks",
-                "OOB-staged DCN chunks transferred")
-        return c
+        return self._cached_counter(
+            "_staged_chunks_pvar", "btl_dcn_staged_chunks",
+            "OOB-staged DCN chunks transferred")
 
     @property
     def staged_bytes_pvar(self):
-        c = getattr(self, "_staged_bytes_pvar", None)
-        if c is None:
-            from ..mca import pvar
-
-            c = self._staged_bytes_pvar = pvar.counter(
-                "btl_dcn_staged_bytes",
-                "OOB-staged DCN bytes transferred")
-        return c
+        return self._cached_counter(
+            "_staged_bytes_pvar", "btl_dcn_staged_bytes",
+            "OOB-staged DCN bytes transferred")
 
     def move_segment(self, data, dst_device):
         import jax
@@ -237,6 +227,158 @@ class DcnBtl(base.BtlModule):
         return jax.device_put(arr, dst_device)
 
 
+class ShmBtl(base.BtlModule):
+    """Intra-host CROSS-PROCESS device-buffer handoff through POSIX
+    shared memory — the btl/vader single-copy role (SURVEY §2.4 item
+    9). The payload crosses the process boundary through one mmap'd
+    segment (no socket streaming, no per-chunk copies): the sender
+    writes device bytes into a named segment and posts a control
+    frame (name, dtype, shape) over the OOB — the vader "fast box" —
+    and the receiver maps the segment, device_puts straight out of
+    it, and unlinks (ownership transfers with the frame).
+    """
+
+    NAME = "shm"
+    EAGER_LIMIT = 32 * 1024
+    MAX_SEND_SIZE = 256 * 1024 * 1024
+    LATENCY = 3
+    BANDWIDTH = 25_000  # host memory fabric
+    EXCLUSIVITY = 768   # beats dcn for same-host peers
+    SUPPORTS_MOVE = False  # out-of-band: send_shm/recv_shm, never the
+    #                        BML move lists (which hold movers only)
+
+    def reachable(self, src_ep, dst_ep) -> bool:
+        # same machine, different controller process: the only pair
+        # shape where shm is both possible and needed (same process
+        # uses ici/self; cross-host cannot map the segment)
+        return (
+            src_ep.process_index != dst_ep.process_index
+            and bool(getattr(src_ep, "host", ""))
+            and getattr(src_ep, "host", "") == getattr(dst_ep, "host", "")
+        )
+
+    def move_segment(self, data, dst_device):
+        from ..utils.errors import ErrorCode, MPIError
+
+        raise MPIError(
+            ErrorCode.ERR_UNREACH,
+            "shm is a cross-process transport: use "
+            "send_shm/recv_shm with the peer's OOB endpoint",
+        )
+
+    @property
+    def handoffs_pvar(self):
+        return self._cached_counter(
+            "_handoffs_pvar", "btl_shm_handoffs",
+            "shared-memory segment handoffs")
+
+    @property
+    def shm_bytes_pvar(self):
+        return self._cached_counter(
+            "_shm_bytes_pvar", "btl_shm_bytes",
+            "bytes handed off through shm")
+
+    #: segments posted but (maybe) never consumed: (name, deadline).
+    #: A receiver that times out or dies never learns the name, so the
+    #: sender reaps expired segments on its next send — without this a
+    #: retry loop leaks /dev/shm until the host runs out. The TTL is
+    #: generous (4x the recv default) so a slow-but-live receiver is
+    #: never pulled out from under.
+    _pending_segments: list = []
+    SEGMENT_TTL_S = 120.0
+
+    @classmethod
+    def _reap_orphaned_segments(cls) -> None:
+        import time as _time
+
+        from multiprocessing import shared_memory
+
+        now = _time.monotonic()
+        keep = []
+        for name, deadline in cls._pending_segments:
+            if now < deadline:
+                keep.append((name, deadline))
+                continue
+            try:  # consumed segments are already unlinked: ignore
+                seg = shared_memory.SharedMemory(name=name)
+                seg.close()
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+        cls._pending_segments[:] = keep
+
+    def send_shm(self, oob_ep, peer_nid: int, tag: int, data) -> str:
+        """Write ``data`` into a fresh shm segment and post the
+        control frame; returns the segment name. Ownership of the
+        segment passes to the receiver (it unlinks); segments whose
+        receiver never consumed the frame are reaped after
+        SEGMENT_TTL_S on a later send."""
+        import time as _time
+
+        from multiprocessing import shared_memory
+
+        from ..native import DssBuffer
+
+        self._reap_orphaned_segments()
+        arr = np.ascontiguousarray(np.asarray(data))
+        seg = shared_memory.SharedMemory(create=True,
+                                         size=max(1, arr.nbytes))
+        try:
+            # single copy: write straight into the mapping (tobytes()
+            # would materialize a second full-size host buffer)
+            if arr.size:
+                np.frombuffer(seg.buf, dtype=arr.dtype,
+                              count=arr.size)[:] = arr.ravel()
+            frame = DssBuffer()
+            frame.pack_string(seg.name)
+            frame.pack_string(str(arr.dtype))
+            frame.pack_string(",".join(str(d) for d in arr.shape))
+            oob_ep.send(peer_nid, tag, frame.tobytes())
+        except BaseException:
+            seg.close()
+            seg.unlink()
+            raise
+        self.handoffs_pvar.add()
+        self.shm_bytes_pvar.add(arr.nbytes)
+        name = seg.name
+        seg.close()  # receiver owns the segment now
+        self._pending_segments.append(
+            (name, _time.monotonic() + self.SEGMENT_TTL_S)
+        )
+        return name
+
+    def recv_shm(self, oob_ep, tag: int, *, dst_device=None,
+                 timeout_ms: int = 30_000):
+        """Map the announced segment, device_put out of it (the single
+        copy), unlink."""
+        from multiprocessing import shared_memory
+
+        import jax
+
+        from ..native import DssBuffer
+
+        _, _, raw = oob_ep.recv(tag=tag, timeout_ms=timeout_ms)
+        frame = DssBuffer(raw)
+        name = frame.unpack_string()
+        dtype = np.dtype(frame.unpack_string())
+        shape_s = frame.unpack_string()
+        shape = tuple(int(d) for d in shape_s.split(",")) if shape_s \
+            else ()
+        seg = shared_memory.SharedMemory(name=name)
+        try:
+            nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            arr = np.frombuffer(seg.buf[:nbytes],
+                                dtype=dtype).reshape(shape).copy()
+        finally:
+            seg.close()
+            seg.unlink()
+        self.handoffs_pvar.add()
+        self.shm_bytes_pvar.add(arr.nbytes)
+        if dst_device is None:
+            dst_device = jax.local_devices()[0]
+        return jax.device_put(arr, dst_device)
+
+
 class HostBtl(base.BtlModule):
     """Explicit host-staged bounce: device → host numpy → device.
 
@@ -287,6 +429,12 @@ class IciComponent(_BtlComponent):
     MODULE_CLS = IciBtl
 
 
+class ShmComponent(_BtlComponent):
+    NAME = "shm"
+    PRIORITY = 50
+    MODULE_CLS = ShmBtl
+
+
 class DcnComponent(_BtlComponent):
     NAME = "dcn"
     PRIORITY = 40
@@ -301,5 +449,6 @@ class HostComponent(_BtlComponent):
 
 base.BTL_FRAMEWORK.register(SelfComponent())
 base.BTL_FRAMEWORK.register(IciComponent())
+base.BTL_FRAMEWORK.register(ShmComponent())
 base.BTL_FRAMEWORK.register(DcnComponent())
 base.BTL_FRAMEWORK.register(HostComponent())

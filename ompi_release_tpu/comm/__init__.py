@@ -4,6 +4,7 @@ from .group import EMPTY, IDENT, SIMILAR, UNDEFINED, UNEQUAL, Group
 from .communicator import (
     Communicator, Keyval, clear_comm_registry, create_keyval, free_keyval,
 )
+from .info import INFO_ENV, INFO_NULL, Info
 from .intercomm import Intercommunicator, intercomm_create
 from .dpm import (
     open_port, close_port, publish_name, unpublish_name, lookup_name,
@@ -16,6 +17,7 @@ __all__ = [
     "Communicator", "Keyval", "create_keyval", "free_keyval",
     "clear_comm_registry", "create_world",
     "Intercommunicator", "intercomm_create",
+    "Info", "INFO_ENV", "INFO_NULL",
     "open_port", "close_port", "publish_name", "unpublish_name",
     "lookup_name", "comm_accept", "comm_connect",
 ]

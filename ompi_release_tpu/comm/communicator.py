@@ -86,7 +86,11 @@ class Communicator:
         self.errhandler: Errhandler = (
             parent.errhandler if parent else ERRORS_ARE_FATAL
         )
-        self.info: Dict[str, str] = dict(getattr(parent, "info", {}) or {})
+        from .info import Info
+
+        parent_info = getattr(parent, "info", None)
+        self.info: Info = (parent_info.dup() if isinstance(parent_info, Info)
+                           else Info())  # MPI_Comm_set/get_info object
         self.topo = topo  # topology module (cart/graph), if any
         self._attrs: Dict[int, Any] = {}
         self._freed = False

@@ -242,6 +242,14 @@ class PmlEngine:
         peruse.fire(self.comm, peruse.REQ_ACTIVATE, kind="recv",
                     src=source, dst=dst, tag=tag)
         with self._lock:
+            if self._logger is not None:
+                # pessimist determinant: logged UNDER the matching
+                # lock so the event order equals the match order
+                # (concurrent posters would otherwise log in a
+                # different order than they match — replay would
+                # swap their deliveries); the matched (src, tag) is
+                # filled in at completion
+                self._logger.record_recv_post(dst, source, tag, req)
             self._purge_cancelled(dst)
             unex = self._unexpected[dst]
             match = next(
@@ -295,6 +303,14 @@ class PmlEngine:
             if match is None:
                 return None
             unex.remove(match)
+            if self._logger is not None:
+                # improbe IS the nondeterministic match decision the
+                # pessimist log exists to capture; without this the
+                # restarted consumer would silently be delivered one
+                # message fewer
+                self._logger.record_matched_recv(
+                    dst, source, tag, match.src, match.tag
+                )
             return match  # the message handle
 
     def mrecv(self, message: "_SendEntry", *, dst: int):

