@@ -30,6 +30,23 @@ from ..mca import component as mca_component
 from . import base
 
 
+def _pack_array_header(buf, arr: np.ndarray, *extra_front) -> None:
+    """Array-metadata wire format shared by the staged (DCN) and shm
+    transports: [*extra_front,] dtype, comma-joined shape."""
+    for f in extra_front:
+        buf.pack_string(f)
+    buf.pack_string(str(arr.dtype))
+    buf.pack_string(",".join(str(d) for d in arr.shape))
+
+
+def _unpack_array_header(buf):
+    """Returns (dtype, shape) from the shared wire format."""
+    dtype = np.dtype(buf.unpack_string())
+    shape_s = buf.unpack_string()
+    shape = tuple(int(d) for d in shape_s.split(",")) if shape_s else ()
+    return dtype, shape
+
+
 class SelfBtl(base.BtlModule):
     """Loopback: src == dst. Arrays are immutable; a self-send needs no
     copy at all (the reference's btl/self memcpys because its buffers
@@ -185,8 +202,7 @@ class DcnBtl(base.BtlModule):
         chunk = max(1, self.max_send_size)
         nchunks = max(1, -(-len(raw) // chunk))
         hdr = DssBuffer()
-        hdr.pack_string(str(arr.dtype))
-        hdr.pack_string(",".join(str(d) for d in arr.shape))
+        _pack_array_header(hdr, arr)
         hdr.pack_int64(nchunks)
         oob_ep.send(peer_nid, tag, hdr.tobytes())
         for i in range(nchunks):
@@ -210,10 +226,7 @@ class DcnBtl(base.BtlModule):
         deadline = _time.monotonic() + timeout_ms / 1000
         src, hraw = self._recv_from(oob_ep, src, tag, deadline)
         hdr = DssBuffer(hraw)
-        dtype = np.dtype(hdr.unpack_string())
-        shape_s = hdr.unpack_string()
-        shape = tuple(int(d) for d in shape_s.split(",")) if shape_s \
-            else ()
+        dtype, shape = _unpack_array_header(hdr)
         (nchunks,) = hdr.unpack_int64()
         parts = []
         for _ in range(int(nchunks)):
@@ -229,13 +242,15 @@ class DcnBtl(base.BtlModule):
 
 class ShmBtl(base.BtlModule):
     """Intra-host CROSS-PROCESS device-buffer handoff through POSIX
-    shared memory — the btl/vader single-copy role (SURVEY §2.4 item
-    9). The payload crosses the process boundary through one mmap'd
-    segment (no socket streaming, no per-chunk copies): the sender
-    writes device bytes into a named segment and posts a control
-    frame (name, dtype, shape) over the OOB — the vader "fast box" —
-    and the receiver maps the segment, device_puts straight out of
-    it, and unlinks (ownership transfers with the frame).
+    shared memory — the btl/vader role (SURVEY §2.4 item 9). The
+    payload crosses the process boundary through one mmap'd segment
+    (no socket streaming, no per-chunk copies): the sender writes
+    device bytes straight into a named segment (one write, no
+    intermediate buffer) and posts a control frame (name, dtype,
+    shape) over the OOB — the vader "fast box". The receiver maps the
+    segment, copies out (jax retains/aliases host buffers handed to
+    device_put, so the mapping cannot be unlinked under a live view),
+    device_puts, and unlinks — ownership transfers with the frame.
     """
 
     NAME = "shm"
@@ -331,8 +346,7 @@ class ShmBtl(base.BtlModule):
                               count=arr.size)[:] = arr.ravel()
             frame = DssBuffer()
             frame.pack_string(seg.name)
-            frame.pack_string(str(arr.dtype))
-            frame.pack_string(",".join(str(d) for d in arr.shape))
+            _pack_array_header(frame, arr)
             oob_ep.send(peer_nid, tag, frame.tobytes())
         except BaseException:
             seg.close()
@@ -360,23 +374,30 @@ class ShmBtl(base.BtlModule):
         _, _, raw = oob_ep.recv(tag=tag, timeout_ms=timeout_ms)
         frame = DssBuffer(raw)
         name = frame.unpack_string()
-        dtype = np.dtype(frame.unpack_string())
-        shape_s = frame.unpack_string()
-        shape = tuple(int(d) for d in shape_s.split(",")) if shape_s \
-            else ()
+        dtype, shape = _unpack_array_header(frame)
         seg = shared_memory.SharedMemory(name=name)
         try:
             nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
-            arr = np.frombuffer(seg.buf[:nbytes],
-                                dtype=dtype).reshape(shape).copy()
+            view = np.frombuffer(seg.buf[:nbytes],
+                                 dtype=dtype).reshape(shape)
+            if dst_device is None:
+                dst_device = jax.local_devices()[0]
+            # copy OUT of the mapping before unmapping: jax retains a
+            # reference to host buffers passed to device_put (and on
+            # CPU may alias them zero-copy), so handing it the mapped
+            # pages directly would make unlink a use-after-free. The
+            # receive is therefore segment -> host array -> device:
+            # one host memcpy more than the send side's single write,
+            # still no per-chunk socket streaming
+            staged = np.array(view)
+            del view
+            out = jax.device_put(staged, dst_device)
         finally:
             seg.close()
             seg.unlink()
         self.handoffs_pvar.add()
-        self.shm_bytes_pvar.add(arr.nbytes)
-        if dst_device is None:
-            dst_device = jax.local_devices()[0]
-        return jax.device_put(arr, dst_device)
+        self.shm_bytes_pvar.add(nbytes)
+        return out
 
 
 class HostBtl(base.BtlModule):

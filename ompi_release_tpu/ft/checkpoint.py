@@ -63,8 +63,14 @@ class Checkpointer:
     def save(self, step: int, state: Any, *, async_: bool = True,
              extra_meta: Optional[Dict] = None) -> None:
         """Snapshot ``state`` (pytree) for ``step``."""
+        from ..utils import memchecker
+
         self.wait()  # one checkpoint in flight at a time
         self.quiesce()
+        # a snapshot must not contain donated/consumed buffers — the
+        # memchecker liveness walk catches use-after-donation HERE,
+        # with provenance, instead of deep inside serialization
+        memchecker.assert_all_alive(state, what="checkpoint state")
         d = self._step_dir(step)
         tmp = d + ".tmp"
         if os.path.exists(tmp):

@@ -43,6 +43,12 @@ SCALE_BLOCK: Tuple[int, int] = (128, 2048)
 #: second copy-ceiling candidate (also ~820-840 GB/s measured); the
 #: bench measures both and takes the per-round max as the ceiling
 SCALE_BLOCK_ALT: Tuple[int, int] = (32, 8192)
+#: third candidate: a 2026-07 re-sweep measured the shortest/widest
+#: block winning the copy kernel under that session's conditions
+#: (679 vs 657/653 GB/s for the other two) — candidates exist so the
+#: ceiling is the best the chip demonstrably does TODAY, whichever
+#: shape that takes
+SCALE_BLOCK_ALT2: Tuple[int, int] = (16, 16384)
 
 
 def _interpret() -> bool:

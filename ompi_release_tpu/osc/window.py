@@ -94,6 +94,13 @@ class Window:
         self._epoch = _EpochKind.NONE
         self._locked: Dict[int, int] = {}  # target -> lock type
         self._pending: List[_PendingOp] = []
+        # one controller, possibly many threads (a producer thread
+        # posting AMOs while a waiter polls with get/flush): the
+        # pending queue and its apply/commit must be atomic or
+        # concurrent flushes lose ops
+        import threading as _threading
+
+        self._op_lock = _threading.RLock()
         self._group_exposed = None  # PSCW exposure group
         self._freed = False
 
@@ -235,7 +242,8 @@ class Window:
                     f"slot of {slot_elems} elements",
                 )
         _rma_ops.add()
-        self._pending.append(op)
+        with self._op_lock:
+            self._pending.append(op)
         return op.request
 
     def put(self, data, target: int, index: Optional[int] = None) -> None:
@@ -355,6 +363,11 @@ class Window:
         regardless of how many RMA ops queued (the osc/rdma "aggregate
         and issue at sync" strategy, done as XLA intends it).
         """
+        with self._op_lock:
+            self._apply_pending_locked(only_target)
+
+    def _apply_pending_locked(self, only_target: Optional[int] = None
+                              ) -> None:
         if not self._pending:
             return
         _epoch_count.add()

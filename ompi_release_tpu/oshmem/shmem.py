@@ -134,6 +134,73 @@ class ShmemCtx:
         sym._win.flush(pe)
         return req.value
 
+    def atomic_inc(self, sym: SymmetricArray, pe: int) -> None:
+        """shmem_inc: add 1 (the counter idiom)."""
+        self.atomic_add(sym, jnp.ones(sym.shape, sym.dtype), pe)
+
+    def atomic_fetch_inc(self, sym: SymmetricArray, pe: int) -> jax.Array:
+        return self.atomic_fetch_add(
+            sym, jnp.ones(sym.shape, sym.dtype), pe
+        )
+
+    def atomic_set(self, sym: SymmetricArray, value, pe: int) -> None:
+        """shmem_atomic_set: unconditional replace (no fetch)."""
+        sym._win.accumulate(jnp.asarray(value), pe, op=ops_mod.REPLACE)
+
+    def atomic_fetch(self, sym: SymmetricArray, pe: int) -> jax.Array:
+        """shmem_atomic_fetch: an atomic read = fetch_add(0)."""
+        return self.atomic_fetch_add(
+            sym, jnp.zeros(sym.shape, sym.dtype), pe
+        )
+
+    # -- point-to-point synchronization (shmem_wait_until) -----------------
+    def wait_until(self, sym: SymmetricArray, cmp: str, value, *,
+                   pe: int, timeout_s: float = 30.0,
+                   poll_s: float = 0.001) -> jax.Array:
+        """Block until pe's symmetric variable satisfies the
+        comparison — the SHMEM p2p synchronization primitive
+        (``shmem_wait_until``; cmp in eq/ne/gt/ge/lt/le). ``pe`` is
+        explicit because one controller plays every PE in driver mode
+        (in a per-process deployment it would default to the caller's
+        own PE). Progress comes from other ranks' posted puts/AMOs
+        being flushed (the poll flushes so posted ops land)."""
+        import time as _time
+
+        import numpy as _np
+
+        cmps = {
+            "eq": _np.equal, "ne": _np.not_equal,
+            "gt": _np.greater, "ge": _np.greater_equal,
+            "lt": _np.less, "le": _np.less_equal,
+        }
+        if cmp not in cmps:
+            raise MPIError(ErrorCode.ERR_ARG,
+                           f"wait_until cmp must be one of {list(cmps)}")
+        target_pe = pe
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            cur = _np.asarray(self.get(sym, target_pe))
+            if bool(_np.all(cmps[cmp](cur, value))):
+                return jnp.asarray(cur)
+            if _time.monotonic() > deadline:
+                raise MPIError(
+                    ErrorCode.ERR_PENDING,
+                    f"wait_until({cmp}, {value}) timed out; last "
+                    f"value {cur!r}",
+                )
+            _time.sleep(poll_s)
+
+    def test(self, sym: SymmetricArray, cmp: str, value, *,
+             pe: int) -> bool:
+        """Nonblocking wait_until (shmem_test)."""
+        try:
+            self.wait_until(sym, cmp, value, pe=pe, timeout_s=0.0)
+            return True
+        except MPIError as e:
+            if e.code is ErrorCode.ERR_PENDING:  # just not yet
+                return False
+            raise  # real failures (freed window, bad pe) must surface
+
     # -- ordering (shmem_quiet / shmem_fence) ------------------------------
     def quiet(self) -> None:
         """Complete all outstanding puts/AMOs (shmem_quiet)."""
@@ -160,14 +227,32 @@ class ShmemCtx:
     def alltoall(self, x):
         return self.comm.alltoall(x)
 
+    def collect(self, bufs):
+        """shmem_collect: ragged per-PE blocks concatenated in PE
+        order (fcollect's equal-size constraint lifted) — rides the
+        v-variant allgatherv kernel."""
+        return self.comm.allgatherv(bufs)
+
     def sum_to_all(self, x):
         return self.comm.allreduce(x, ops_mod.SUM)
+
+    def prod_to_all(self, x):
+        return self.comm.allreduce(x, ops_mod.PROD)
 
     def max_to_all(self, x):
         return self.comm.allreduce(x, ops_mod.MAX)
 
     def min_to_all(self, x):
         return self.comm.allreduce(x, ops_mod.MIN)
+
+    def and_to_all(self, x):
+        return self.comm.allreduce(x, ops_mod.BAND)
+
+    def or_to_all(self, x):
+        return self.comm.allreduce(x, ops_mod.BOR)
+
+    def xor_to_all(self, x):
+        return self.comm.allreduce(x, ops_mod.BXOR)
 
     def finalize(self) -> None:
         for a in list(self._allocs):

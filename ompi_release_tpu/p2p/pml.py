@@ -177,9 +177,12 @@ class PmlEngine:
 
         peruse.fire(self.comm, peruse.REQ_ACTIVATE, kind="send",
                     src=src, dst=dst, tag=tag)
-        if self._logger is not None:
-            self._logger.record(src, dst, tag, data, sync)
         with self._lock:
+            if self._logger is not None:
+                # logged UNDER the matching lock like recv postings:
+                # the log's event order must equal the queue order or
+                # replay swaps same-(src, tag) deliveries
+                self._logger.record(src, dst, tag, data, sync)
             self._purge_cancelled(dst)
             posted = self._posted[dst]
             match = next(

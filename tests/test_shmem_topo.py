@@ -251,3 +251,76 @@ class TestRaggedNeighborhoods:
         destinations[0] = [1]  # 0 sends to 1, but 1 lists no source
         with pytest.raises(Exception):
             dist_graph_create_adjacent(world, sources, destinations)
+
+
+class TestShmemExtendedApi:
+    """shmem breadth: inc/set/fetch AMOs, wait_until/test sync,
+    collect + logical/prod reductions (oshmem/include/shmem.h.in)."""
+
+    def test_inc_set_fetch(self, world):
+        from ompi_release_tpu.oshmem import shmem
+
+        ctx = shmem.shmem_init(world)
+        s = ctx.malloc((2,), jnp.float32)
+        ctx.atomic_set(s, np.array([5.0, 7.0], np.float32), pe=1)
+        ctx.atomic_inc(s, pe=1)
+        got = np.asarray(ctx.atomic_fetch(s, pe=1))
+        np.testing.assert_array_equal(got, [6.0, 8.0])
+        prev = np.asarray(ctx.atomic_fetch_inc(s, pe=1))
+        np.testing.assert_array_equal(prev, [6.0, 8.0])
+        np.testing.assert_array_equal(
+            np.asarray(ctx.get(s, pe=1)), [7.0, 9.0])
+        ctx.finalize()
+        shmem._ctx = None
+
+    def test_wait_until_and_test(self, world):
+        import threading
+
+        from ompi_release_tpu.oshmem import shmem
+
+        ctx = shmem.shmem_init(world)
+        flag = ctx.malloc((1,), jnp.float32)
+        assert ctx.test(flag, "ge", 1.0, pe=2) is False
+
+        def producer():
+            import time
+            time.sleep(0.2)
+            ctx.atomic_add(flag, np.ones(1, np.float32), pe=2)
+            ctx.quiet()
+
+        t = threading.Thread(target=producer)
+        t.start()
+        got = np.asarray(ctx.wait_until(flag, "ge", 1.0, pe=2,
+                                        timeout_s=10))
+        t.join()
+        assert got[0] >= 1.0
+        with pytest.raises(Exception):
+            ctx.wait_until(flag, "lt", 0.0, pe=2, timeout_s=0.2)
+        with pytest.raises(Exception):
+            ctx.wait_until(flag, "approximately", 1.0, pe=2)
+        ctx.finalize()
+        shmem._ctx = None
+
+    def test_collect_and_reductions(self, world):
+        from ompi_release_tpu.oshmem import shmem
+
+        ctx = shmem.shmem_init(world)
+        n = world.size
+        ragged = [np.arange(i + 1, dtype=np.float32) for i in range(n)]
+        got = np.asarray(ctx.collect(ragged))
+        np.testing.assert_array_equal(got, np.concatenate(ragged))
+        x = np.full((n, 4), 2.0, np.float32)
+        np.testing.assert_allclose(
+            np.asarray(ctx.prod_to_all(x))[0], 2.0 ** n)
+        xi = np.arange(n * 2, dtype=np.int32).reshape(n, 2)
+        import functools
+        np.testing.assert_array_equal(
+            np.asarray(ctx.xor_to_all(xi))[0],
+            functools.reduce(np.bitwise_xor, [xi[r] for r in range(n)]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ctx.or_to_all(xi))[3],
+            functools.reduce(np.bitwise_or, [xi[r] for r in range(n)]),
+        )
+        ctx.finalize()
+        shmem._ctx = None
