@@ -276,3 +276,99 @@ def create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
         index_map=offs,
         extent=int(np.prod(sizes)) * base.get_extent(),
     )
+
+
+# MPI_Type_create_darray distribution constants
+DIST_BLOCK = "block"
+DIST_CYCLIC = "cyclic"
+DIST_NONE = "none"
+DARG_DEFAULT = -1  # MPI_DISTRIBUTE_DFLT_DARG
+
+
+def _dim_indices(gsize: int, dist: str, darg: int, nprocs: int,
+                 coord: int) -> np.ndarray:
+    """Global indices along one dim owned by process ``coord``."""
+    if dist == DIST_NONE:
+        if nprocs != 1:
+            raise ValueError(
+                "DIST_NONE requires 1 process on that dimension"
+            )
+        return np.arange(gsize)
+    if dist == DIST_BLOCK:
+        # MPI: default block size = ceil(gsize / nprocs); an explicit
+        # darg must cover the array (darg * nprocs >= gsize)
+        bsize = -(-gsize // nprocs) if darg == DARG_DEFAULT else darg
+        if bsize * nprocs < gsize:
+            raise ValueError(
+                f"block darg {bsize} too small: {bsize}*{nprocs} < "
+                f"{gsize}"
+            )
+        lo = coord * bsize
+        return np.arange(lo, min(lo + bsize, gsize))
+    if dist == DIST_CYCLIC:
+        bsize = 1 if darg == DARG_DEFAULT else darg
+        if bsize < 1:
+            # symmetric with the block check: a non-positive block
+            # size would silently select NOTHING (empty range) — an
+            # MPI-IO write with that type is silent data loss
+            raise ValueError(
+                f"cyclic darg must be >= 1, got {bsize}"
+            )
+        idx = []
+        start = coord * bsize
+        stride = nprocs * bsize
+        for base_i in range(start, gsize, stride):
+            idx.extend(range(base_i, min(base_i + bsize, gsize)))
+        return np.asarray(idx, dtype=np.int64)
+    raise ValueError(f"unknown distribution '{dist}'")
+
+
+def create_darray(size: int, rank: int, gsizes: Sequence[int],
+                  distribs: Sequence[str], dargs: Sequence[int],
+                  psizes: Sequence[int], base: Datatype) -> Datatype:
+    """MPI_Type_create_darray (C order): the datatype selecting rank's
+    portion of a block/cyclic-distributed global array — the HPF-style
+    decomposition MPI-IO uses for parallel array files
+    (``ompi/datatype/ompi_datatype_create_darray.c`` role).
+
+    ``size``/``rank``: process grid population and this process's
+    rank (row-major over ``psizes``). Each dim: distribution
+    ``block``/``cyclic``/``none`` with ``dargs[i]`` (DARG_DEFAULT for
+    the MPI default block size).
+    """
+    ndims = len(gsizes)
+    if not (len(distribs) == len(dargs) == len(psizes) == ndims):
+        raise ValueError("darray argument lengths differ")
+    if int(np.prod(psizes)) != size:
+        raise ValueError(
+            f"process grid {list(psizes)} does not cover {size} procs"
+        )
+    if not 0 <= rank < size:
+        raise ValueError(f"rank {rank} outside process grid of {size}")
+    # rank -> process-grid coordinates, row-major (MPI order)
+    coords = []
+    r = rank
+    for p in reversed(psizes):
+        coords.append(r % p)
+        r //= p
+    coords = list(reversed(coords))
+
+    per_dim = [
+        _dim_indices(g, d, a, p, c)
+        for g, d, a, p, c in zip(gsizes, distribs, dargs, psizes, coords)
+    ]
+    grids = np.meshgrid(*per_dim, indexing="ij")
+    flat = np.ravel_multi_index(
+        [g.reshape(-1) for g in grids], dims=gsizes
+    )
+    offs = np.sort(flat).astype(np.int32)
+    if base.count != 1:
+        offs = (offs[:, None] * base.get_extent()
+                + base.offsets(1)[None, :]).reshape(-1)
+    return Datatype(
+        name=f"darray(r{rank}/{size},{list(gsizes)})",
+        base_dtype=base.base_dtype,
+        count=len(offs),
+        index_map=offs,
+        extent=int(np.prod(gsizes)) * base.get_extent(),
+    )

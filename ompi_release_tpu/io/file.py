@@ -125,6 +125,17 @@ class File:
                           self._byte_offset(self._shared_ptr))
                 self._shared_ptr += buf.size
 
+    def write_shared(self, data) -> int:
+        """Append one buffer at the shared pointer (sharedfp
+        non-ordered write: first-come placement, pointer advances)."""
+        self._check()
+        with self._lock:
+            buf = np.ascontiguousarray(np.asarray(data, self._etype))
+            os.pwrite(self._fd, buf.tobytes(),
+                      self._byte_offset(self._shared_ptr))
+            self._shared_ptr += buf.size
+            return int(buf.size)
+
     def read_shared(self, count: int) -> np.ndarray:
         self._check()
         with self._lock:

@@ -50,10 +50,11 @@ TAG_XCAST = 5
 TAG_FIN = 6
 TAG_HEARTBEAT = 7
 TAG_XCAST_ORPHAN = 8  # worker->HNP: deliver xcast to unreachable child
-TAG_PUBLISH = 9       # worker->HNP: publish service name (pubsub_orte)
-TAG_LOOKUP = 10       # worker->HNP: lookup service name
-TAG_PUBSUB_REPLY = 11  # HNP->worker: publish/lookup response
-TAG_UNPUBLISH = 12    # worker->HNP: unpublish service name
+# pubsub tags + protocol live in runtime/pubsub.py (shared with the
+# standalone tpu-server); re-exported here for the worker-facing API
+from .pubsub import (  # noqa: E402
+    TAG_LOOKUP, TAG_PUBLISH, TAG_PUBSUB_REPLY, TAG_UNPUBLISH,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -277,89 +278,19 @@ class HnpCoordinator:
     # -- name service (pubsub_orte / orte-server analogue) -----------------
     def start_name_server(self) -> None:
         """Serve publish/lookup/unpublish frames: the HNP plays the
-        ``orte-server`` role of ``pubsub_orte.c`` — a job-global name
-        table workers reach over their lifeline link. Lookup of an
-        unpublished name parks the requester and is answered the
-        moment the name arrives (the reference's blocking lookup)."""
-        self._names: Dict[str, str] = {}
-        # service -> [(node_id, seq), ...] parked lookups
-        self._name_waiters: Dict[str, List[tuple]] = {}
+        ``orte-server`` role for its own job's workers. The protocol
+        (seq correlation, parked lookups with client TTLs, malformed-
+        frame tolerance) is the shared runtime/pubsub.py
+        implementation — the standalone cross-job tpu-server runs the
+        same table."""
+        from .pubsub import PubsubTable
+
+        self._ns_table = PubsubTable(self.ep)
         self._ns_stop = threading.Event()
-
-        # every request carries a client-chosen sequence number that
-        # is echoed in the reply, so a client whose earlier lookup
-        # timed out (leaving a parked waiter here) can discard the
-        # stale reply instead of mistaking it for the response to its
-        # next RPC (request/response correlation, rml.h tag+seq style)
-        def _reply(nid: int, seq: int, ok: bool, value: str) -> None:
-            frame = DssBuffer()
-            frame.pack_int64(seq)
-            frame.pack_int64(1 if ok else 0)
-            frame.pack_string(value)
-            try:
-                self.ep.send(nid, TAG_PUBSUB_REPLY, frame.tobytes())
-            except MPIError:
-                _log.verbose(1, f"pubsub reply to node {nid} failed")
-
-        def _prune_waiters() -> None:
-            """Drop parked lookups whose client gave up: the lookup
-            frame carries the client's own deadline, so dead waiters
-            cannot accumulate (a retry loop would otherwise leave one
-            stale entry per attempt, forever)."""
-            now = time.monotonic()
-            for service in list(self._name_waiters):
-                alive = [w for w in self._name_waiters[service]
-                         if w[2] > now]
-                if alive:
-                    self._name_waiters[service] = alive
-                else:
-                    del self._name_waiters[service]
-
-        def run() -> None:
-            while not self._ns_stop.is_set():
-                _prune_waiters()
-                for tag in (TAG_PUBLISH, TAG_LOOKUP, TAG_UNPUBLISH):
-                    try:
-                        src, _, raw = self.ep.recv(tag=tag, timeout_ms=50)
-                    except MPIError:
-                        continue
-                    try:
-                        handle(tag, src, raw)
-                    except Exception as exc:
-                        # one malformed frame must not kill the name
-                        # service for the whole job
-                        _log.verbose(
-                            1, f"dropping bad pubsub frame from "
-                               f"{src}: {exc}")
-
-        def handle(tag: int, src: int, raw: bytes) -> None:
-            b = DssBuffer(raw)
-            (seq,) = b.unpack_int64()
-            service = b.unpack_string()
-            if tag == TAG_PUBLISH:
-                port = b.unpack_string()
-                if service in self._names:
-                    _reply(src, seq, False, "already published")
-                    return
-                self._names[service] = port
-                _reply(src, seq, True, port)
-                for wnid, wseq, _exp in self._name_waiters.pop(
-                        service, []):
-                    _reply(wnid, wseq, True, port)
-            elif tag == TAG_UNPUBLISH:
-                ok = self._names.pop(service, None) is not None
-                _reply(src, seq, ok, service)
-            else:  # TAG_LOOKUP
-                ttl_ms = int(b.unpack_string())
-                port = self._names.get(service)
-                if port is not None:
-                    _reply(src, seq, True, port)
-                else:
-                    expire = time.monotonic() + ttl_ms / 1000
-                    self._name_waiters.setdefault(
-                        service, []).append((src, seq, expire))
-
-        self._ns_thread = threading.Thread(target=run, daemon=True)
+        self._ns_thread = threading.Thread(
+            target=self._ns_table.serve_loop, args=(self._ns_stop,),
+            daemon=True,
+        )
         self._ns_thread.start()
 
     def stop_name_server(self) -> None:
@@ -501,38 +432,13 @@ class WorkerAgent:
 
     # -- name service client (MPI_Publish_name over the lifeline) ----------
     def _pubsub_rpc(self, tag: int, *fields: str, timeout_ms: int = 10_000):
-        import time as _time
+        from .pubsub import pubsub_rpc
 
-        # one RPC in flight per agent: concurrent threads would steal
-        # each other's TAG_PUBSUB_REPLY frames off the shared endpoint
-        # (the seq filter DISCARDS foreign replies, it cannot requeue
-        # them), and seq += 1 is not atomic
         lock = getattr(self, "_pubsub_lock", None)
         if lock is None:
             lock = self._pubsub_lock = threading.Lock()
-        with lock:
-            seq = getattr(self, "_pubsub_seq", 0) + 1
-            self._pubsub_seq = seq
-            frame = DssBuffer()
-            frame.pack_int64(seq)
-            for f in fields:
-                frame.pack_string(f)
-            self.ep.send(0, tag, frame.tobytes())
-            deadline = _time.monotonic() + timeout_ms / 1000
-            while True:
-                left = max(1, int((deadline - _time.monotonic()) * 1000))
-                _, _, raw = self.ep.recv(tag=TAG_PUBSUB_REPLY,
-                                         timeout_ms=left)
-                b = DssBuffer(raw)
-                (got_seq,) = b.unpack_int64()
-                (ok,) = b.unpack_int64()
-                value = b.unpack_string()
-                if got_seq == seq:
-                    return bool(ok), value
-                # reply to an earlier timed-out RPC of OURS (serialized
-                # by the lock, it can't be another thread's): discard
-                _log.verbose(
-                    2, f"discarding stale pubsub reply seq={got_seq}")
+        return pubsub_rpc(self.ep, lock, self, tag, *fields,
+                          timeout_ms=timeout_ms)
 
     def publish_name(self, service: str, port: str) -> None:
         ok, msg = self._pubsub_rpc(TAG_PUBLISH, service, port)
@@ -542,9 +448,9 @@ class WorkerAgent:
 
     def lookup_name(self, service: str, *,
                     timeout_ms: int = 10_000) -> str:
-        """Blocks until the name is published (HNP parks us with our
-        deadline, so abandoned lookups expire server-side) or the recv
-        times out."""
+        """Blocks until the name is published (the server parks us
+        with our deadline, so abandoned lookups expire server-side)
+        or the recv times out."""
         ok, value = self._pubsub_rpc(TAG_LOOKUP, service, str(timeout_ms),
                                      timeout_ms=timeout_ms)
         if not ok:

@@ -62,12 +62,18 @@ def mark_donated(arr, owner: str) -> None:
     """Record that ``owner`` (an operation name/site) took ownership
     of ``arr``'s buffer. Later :func:`check` failures name it."""
     _donations.add()
+    key = id(arr)
     try:
-        ref = weakref.ref(arr)
+        # the weakref's callback removes the entry when the array is
+        # garbage-collected — without it the registry grows one entry
+        # per donated buffer for the life of the process
+        ref = weakref.ref(
+            arr, lambda _r, _k=key: _owners.pop(_k, None)
+        )
     except TypeError:
         ref = None
     with _lock:
-        _owners[id(arr)] = (owner, ref)
+        _owners[key] = (owner, ref)
 
 
 def owner_of(arr) -> Optional[str]:
@@ -121,10 +127,14 @@ def donating_jit(fn, donate_argnums: Sequence[int], owner: str, **jit_kw):
 
     def call(*args, **kw):
         # reject already-consumed inputs BEFORE dispatch (clearer than
-        # the runtime's use-after-delete at lowering time)
+        # the runtime's use-after-delete at lowering time); walk the
+        # LEAVES — the argument may be a pytree whose container has no
+        # liveness of its own
         for i in donate_argnums:
             if i < len(args):
-                check(args[i], what=f"{owner} argument {i}")
+                for leaf in jax.tree.leaves(args[i]):
+                    if hasattr(leaf, "dtype"):
+                        check(leaf, what=f"{owner} argument {i}")
         out = jitted(*args, **kw)
         for i in donate_argnums:
             if i < len(args):

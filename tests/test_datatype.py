@@ -153,3 +153,59 @@ def test_partial_pack_truncate_guard():
         c.pack_partial(small, 0, 2)
     with pytest.raises(Exception):
         c.unpack_partial(jnp.zeros(2, jnp.float32), small, 0)
+
+
+class TestDarray:
+    """MPI_Type_create_darray: block/cyclic HPF-style decomposition
+    (ompi_datatype_create_darray.c role)."""
+
+    def test_block_block_2d(self):
+        from ompi_release_tpu.datatype import (
+            DARG_DEFAULT, DIST_BLOCK, create_darray, FLOAT,
+        )
+
+        # 4x6 global array over a 2x2 process grid, block x block
+        seen = np.zeros(24, np.int32)
+        for r in range(4):
+            dt = create_darray(4, r, [4, 6], [DIST_BLOCK, DIST_BLOCK],
+                               [DARG_DEFAULT, DARG_DEFAULT], [2, 2],
+                               FLOAT)
+            offs = dt.offsets(1)
+            seen[offs] += 1
+            # rank 0 owns the top-left 2x3 block
+            if r == 0:
+                np.testing.assert_array_equal(offs, [0, 1, 2, 6, 7, 8])
+        np.testing.assert_array_equal(seen, np.ones(24))  # exact cover
+
+    def test_cyclic_1d(self):
+        from ompi_release_tpu.datatype import (
+            DARG_DEFAULT, DIST_CYCLIC, create_darray, FLOAT,
+        )
+
+        dt = create_darray(3, 1, [10], [DIST_CYCLIC], [DARG_DEFAULT],
+                           [3], FLOAT)
+        np.testing.assert_array_equal(dt.offsets(1), [1, 4, 7])
+        # block-cyclic with darg=2
+        dt = create_darray(2, 0, [10], [DIST_CYCLIC], [2], [2], FLOAT)
+        np.testing.assert_array_equal(dt.offsets(1), [0, 1, 4, 5, 8, 9])
+
+    def test_validation(self):
+        from ompi_release_tpu.datatype import (
+            DARG_DEFAULT, DIST_BLOCK, DIST_NONE, create_darray, FLOAT,
+        )
+
+        with pytest.raises(Exception):
+            create_darray(4, 0, [8], [DIST_BLOCK], [1], [4], FLOAT)  # 1*4<8
+        with pytest.raises(Exception):
+            create_darray(2, 0, [8], [DIST_NONE], [DARG_DEFAULT], [2],
+                          FLOAT)  # NONE needs 1 proc on the dim
+        with pytest.raises(Exception):
+            create_darray(4, 5, [8], [DIST_BLOCK], [DARG_DEFAULT], [4],
+                          FLOAT)  # rank outside grid
+
+    def test_cyclic_bad_darg_rejected(self):
+        from ompi_release_tpu.datatype import DIST_CYCLIC, create_darray, FLOAT
+
+        for bad in (0, -2):
+            with pytest.raises(Exception):
+                create_darray(2, 0, [10], [DIST_CYCLIC], [bad], [2], FLOAT)

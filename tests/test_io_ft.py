@@ -319,3 +319,39 @@ class TestMemchecker:
         with pytest.raises(MPIError) as ei:
             ck.save(1, {"params": x}, async_=False)
         assert "train_step" in str(ei.value)
+
+
+def test_write_shared_pointer_advances(tmp_path, world):
+    """sharedfp non-ordered append: each write lands at the current
+    shared pointer and advances it."""
+    from ompi_release_tpu.io.file import File
+
+    path = str(tmp_path / "sharedfp.bin")
+    with File(world, path) as f:
+        f.set_view(0, np.float32)
+        assert f.write_shared(np.arange(3, dtype=np.float32)) == 3
+        assert f.write_shared(np.full(2, 9.0, np.float32)) == 2
+        got = f.read_at(0, 5)
+        np.testing.assert_array_equal(got, [0, 1, 2, 9, 9])
+
+
+def test_donating_jit_pytree_arg_provenance():
+    """Pytree donated args: the pre-dispatch liveness check walks the
+    LEAVES, so reuse of a consumed state dict raises with provenance."""
+    import jax.numpy as jnp
+
+    from ompi_release_tpu.utils import memchecker
+    from ompi_release_tpu.utils.errors import MPIError
+
+    step = memchecker.donating_jit(
+        lambda st, g: {"w": st["w"] + g}, donate_argnums=(0,),
+        owner="tree_step",
+    )
+    st = {"w": jnp.ones((64, 64), jnp.float32)}
+    g = jnp.ones((64, 64), jnp.float32)
+    out = step(st, g)
+    if not st["w"].is_deleted():
+        pytest.skip("backend did not donate")
+    with pytest.raises(MPIError) as ei:
+        step(st, g)  # consumed pytree caught BEFORE dispatch
+    assert "tree_step" in str(ei.value)

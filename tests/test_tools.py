@@ -10,6 +10,7 @@ import pytest
 import ompi_release_tpu as mpi
 from ompi_release_tpu import ops
 from ompi_release_tpu.tools import tpu_info, trace
+from ompi_release_tpu.utils.errors import MPIError
 
 
 @pytest.fixture(scope="module")
@@ -71,3 +72,60 @@ class TestTracing:
         assert tc.size == world.size  # attribute passthrough
         sub = tc.dup("traced_dup")  # untraced method passthrough
         sub.free()
+
+
+class TestTpuServer:
+    """Standalone orte-server analogue: name exchange between
+    INDEPENDENT jobs (no shared HNP)."""
+
+    def test_cross_job_publish_lookup(self):
+        from ompi_release_tpu.tools.tpu_server import (
+            NameClient, NameServer,
+        )
+
+        srv = NameServer()
+        a = NameClient("127.0.0.1", srv.port)  # "job A"
+        b = NameClient("127.0.0.1", srv.port)  # "job B"
+        try:
+            assert a.client_id != b.client_id
+            a.publish("cross-job-svc", "tpu-port:99")
+            assert b.lookup("cross-job-svc") == "tpu-port:99"
+            # parked lookup answered by a later publish
+            import threading
+
+            got = {}
+            t = threading.Thread(
+                target=lambda: got.update(
+                    v=b.lookup("late-svc", timeout_ms=15000))
+            )
+            t.start()
+            import time
+            time.sleep(0.3)
+            a.publish("late-svc", "tpu-port:7")
+            t.join(timeout=15)
+            assert got["v"] == "tpu-port:7"
+            a.unpublish("cross-job-svc")
+            with pytest.raises(MPIError):
+                b.lookup("cross-job-svc", timeout_ms=300)
+        finally:
+            a.close()
+            b.close()
+            srv.shutdown()
+
+    def test_cli_prints_uri(self):
+        import subprocess
+        import sys
+
+        p = subprocess.Popen(
+            [sys.executable, "-m", "ompi_release_tpu.tools.tpu_server"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            line = p.stdout.readline()
+            assert line.startswith("tpu-server URI: ")
+            host_port = line.split(": ", 1)[1].strip()
+            host, port = host_port.rsplit(":", 1)
+            assert int(port) > 0
+        finally:
+            p.terminate()
+            p.wait(timeout=10)
