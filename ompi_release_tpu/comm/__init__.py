@@ -10,6 +10,7 @@ from .dpm import (
     open_port, close_port, publish_name, unpublish_name, lookup_name,
     comm_accept, comm_connect,
 )
+from .spawn import SpawnedJob, comm_spawn
 from .world import create_world
 
 __all__ = [
@@ -18,6 +19,7 @@ __all__ = [
     "clear_comm_registry", "create_world",
     "Intercommunicator", "intercomm_create",
     "Info", "INFO_ENV", "INFO_NULL",
+    "SpawnedJob", "comm_spawn",
     "open_port", "close_port", "publish_name", "unpublish_name",
     "lookup_name", "comm_accept", "comm_connect",
 ]

@@ -127,14 +127,11 @@ class File:
 
     def write_shared(self, data) -> int:
         """Append one buffer at the shared pointer (sharedfp
-        non-ordered write: first-come placement, pointer advances)."""
-        self._check()
-        with self._lock:
-            buf = np.ascontiguousarray(np.asarray(data, self._etype))
-            os.pwrite(self._fd, buf.tobytes(),
-                      self._byte_offset(self._shared_ptr))
-            self._shared_ptr += buf.size
-            return int(buf.size)
+        non-ordered write: first-come placement) — one rank's
+        write_ordered, sharing the placement logic."""
+        before = self._shared_ptr
+        self.write_ordered([data])
+        return int(self._shared_ptr - before)
 
     def read_shared(self, count: int) -> np.ndarray:
         self._check()

@@ -14,11 +14,11 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from ..native import DssBuffer
 from ..utils import output
-from ..utils.errors import ErrorCode, MPIError
+from ..utils.errors import MPIError
 
 _log = output.stream("pubsub")
 

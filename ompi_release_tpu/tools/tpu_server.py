@@ -25,7 +25,7 @@ import argparse
 import random
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from ..native import OobEndpoint
 from ..runtime.coordinator import local_addr_toward
@@ -119,7 +119,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="listen address (default: all interfaces)")
     args = ap.parse_args(argv)
     srv = NameServer(args.port, args.bind)
-    host = local_addr_toward("192.0.2.1")
+    # advertise an address clients can actually dial: the outward
+    # interface only when listening on all interfaces, else the bound
+    # address itself
+    host = (local_addr_toward("192.0.2.1") if args.bind == "0.0.0.0"
+            else args.bind)
     print(f"tpu-server URI: {host}:{srv.port}", flush=True)
     try:
         while True:

@@ -267,6 +267,14 @@ class Job:
                         f"({ProcState(state).name}); tearing down")
         self.terminate()
 
+    def abort(self, reason: str = "aborted") -> None:
+        """Public abort: the errmgr teardown path with state-machine
+        bookkeeping (external callers must not poke _failed)."""
+        if not self._failed.is_set():
+            self._failed.set()
+            self.job_state.activate(JobState.ABORTED, reason)
+        self.terminate()
+
     def terminate(self) -> None:
         for nid, p in self.procs.items():
             if p.poll() is None:

@@ -318,3 +318,44 @@ class TestMultiHost:
             if agent is not None:
                 agent.close()
             hnp.shutdown()
+
+
+class TestCommSpawn:
+    def test_spawn_exchange_and_wait(self, tmp_path, capfd):
+        """MPI_Comm_spawn analogue: parent launches 2 children, sends
+        each a tagged frame over the job OOB, receives replies, and
+        joins a clean exit."""
+        from ompi_release_tpu.comm import comm_spawn
+        from ompi_release_tpu.utils.errors import MPIError
+
+        app = _write_app(tmp_path, """
+            world = mpi.init()
+            rt = Runtime.current()
+            pi = rt.bootstrap["process_index"]
+            src, tag, payload = rt.agent.ep.recv(tag=101,
+                                                 timeout_ms=30000)
+            rt.agent.ep.send(0, 102,
+                             payload + f"+child{pi}".encode())
+            mpi.finalize()
+        """)
+        job = comm_spawn([sys.executable, app], 2, timeout_s=120)
+        assert job.remote_size == 2
+        # wait for wire-up before messaging (children recv after init)
+        from ompi_release_tpu.runtime.state import JobState as JS
+        import time
+        for _ in range(600):
+            if job.job.job_state.visited(JS.RUNNING):
+                break
+            time.sleep(0.05)
+        job.send(0, 101, b"hello")
+        job.send(1, 101, b"hello")
+        replies = {}
+        for _ in range(2):
+            rank, payload = job.recv(102, timeout_ms=30000)
+            replies[rank] = payload
+        assert replies == {0: b"hello+child0", 1: b"hello+child1"}
+        assert job.wait(timeout_s=60) == 0
+        with pytest.raises(MPIError):
+            job.send(5, 101, b"x")
+        with pytest.raises(MPIError):
+            job.send(0, 3, b"x")  # control-plane tags protected
