@@ -55,3 +55,48 @@ def finalize():
     from .runtime import finalize as _rt_finalize
 
     return _rt_finalize()
+
+
+def initialized() -> bool:
+    """MPI_Initialized."""
+    from .runtime.runtime import Runtime
+
+    return Runtime.is_initialized()
+
+
+def finalized() -> bool:
+    """MPI_Finalized."""
+    from .runtime.runtime import Runtime
+
+    rt = Runtime._instance
+    return bool(rt is not None and rt.finalized)
+
+
+def wtime() -> float:
+    """MPI_Wtime: monotonic wall-clock seconds."""
+    import time
+
+    return time.monotonic()
+
+
+def wtick() -> float:
+    """MPI_Wtick: the wtime clock's resolution."""
+    import time
+
+    return time.get_clock_info("monotonic").resolution
+
+
+def get_version():
+    """MPI_Get_version analogue: (framework version, reference level).
+
+    The capability level mirrors the reference's MPI-3.0-era surface
+    (the subset re-designed TPU-native; see README's inventory)."""
+    return __version__, "ompi-1.8.5-capability"
+
+
+def error_string(code) -> str:
+    """MPI_Error_string: human text for an error class."""
+    try:
+        return ErrorCode(code).name
+    except ValueError:
+        return f"unknown error code {code}"

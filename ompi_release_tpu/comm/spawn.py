@@ -47,6 +47,7 @@ class SpawnedJob:
                        heartbeat_s=0.5)
         self._rc: Optional[int] = None
         self._error: Optional[BaseException] = None
+        self._timeout_s = timeout_s
         self._thread = threading.Thread(
             target=self._run, args=(timeout_s,), daemon=True
         )
@@ -58,13 +59,15 @@ class SpawnedJob:
         except BaseException as exc:  # surfaced by wait()/messaging
             self._error = exc
 
-    def wait_running(self, timeout_s: float = 60.0) -> None:
+    def wait_running(self, timeout_s: Optional[float] = None) -> None:
         """Block until the children completed wire-up (job RUNNING) —
         the point from which send/recv are valid."""
         import time
 
         from ..runtime.state import JobState
 
+        if timeout_s is None:
+            timeout_s = self._timeout_s  # the job's own launch budget
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             if self._error is not None:
@@ -81,6 +84,17 @@ class SpawnedJob:
             time.sleep(0.02)
         raise MPIError(ErrorCode.ERR_SPAWN,
                        "spawned job did not reach RUNNING in time")
+
+    def _check_live(self) -> None:
+        """Messaging a finished job is an error, not a segfault: the
+        run thread shuts the HNP endpoint down at job end (the native
+        guard also raises on a closed endpoint, belt and braces)."""
+        if not self._thread.is_alive():
+            raise MPIError(
+                ErrorCode.ERR_SPAWN,
+                f"spawned job already finished (rc={self._rc}); "
+                "late send/recv has no peer",
+            )
 
     # -- the intercomm-ish surface -----------------------------------------
     @property
@@ -99,6 +113,7 @@ class SpawnedJob:
                 "(below is the coordinator control plane)",
             )
         self.wait_running()  # hnp exists only after launch starts
+        self._check_live()
         self.job.hnp.ep.send(child_rank + 1, tag, payload)
 
     def recv(self, tag: int, *, timeout_ms: int = 30_000
@@ -108,6 +123,7 @@ class SpawnedJob:
             raise MPIError(ErrorCode.ERR_TAG,
                            f"spawn message tags start at {TAG_USER_BASE}")
         self.wait_running()
+        self._check_live()
         src, _, raw = self.job.hnp.ep.recv(tag=tag, timeout_ms=timeout_ms)
         return src - 1, raw
 

@@ -129,9 +129,10 @@ class File:
         """Append one buffer at the shared pointer (sharedfp
         non-ordered write: first-come placement) — one rank's
         write_ordered, sharing the placement logic."""
-        before = self._shared_ptr
-        self.write_ordered([data])
-        return int(self._shared_ptr - before)
+        buf = np.asarray(data, self._etype)
+        self.write_ordered([buf])
+        return int(buf.size)  # not a pointer diff: races with other
+        #                       shared-pointer writers would misreport
 
     def read_shared(self, count: int) -> np.ndarray:
         self._check()

@@ -225,12 +225,22 @@ class OobEndpoint:
                            f"oob_create failed ({bind_addr}:{port})")
         self.node_id = node_id
 
+    def _handle(self):
+        """The live native handle; a closed endpoint raises a clean
+        MPIError instead of handing NULL to the C layer (which
+        segfaults — observed via use-after-close in spawn teardown)."""
+        h = self._h
+        if not h:
+            raise MPIError(ErrorCode.ERR_OTHER,
+                           "oob endpoint is closed")
+        return h
+
     @property
     def port(self) -> int:
-        return self._lib.oob_port(self._h)
+        return self._lib.oob_port(self._handle())
 
     def connect(self, peer_id: int, host: str, port: int) -> None:
-        if self._lib.oob_connect(self._h, peer_id, host.encode(),
+        if self._lib.oob_connect(self._handle(), peer_id, host.encode(),
                                  port) != 0:
             raise MPIError(
                 ErrorCode.ERR_OTHER,
@@ -238,13 +248,13 @@ class OobEndpoint:
             )
 
     def add_route(self, dst: int, via: int) -> None:
-        self._lib.oob_add_route(self._h, dst, via)
+        self._lib.oob_add_route(self._handle(), dst, via)
 
     def set_default_route(self, via: int) -> None:
-        self._lib.oob_add_route(self._h, -1, via)
+        self._lib.oob_add_route(self._handle(), -1, via)
 
     def send(self, dst: int, tag: int, payload: bytes) -> None:
-        if self._lib.oob_send(self._h, dst, tag, _u8(payload),
+        if self._lib.oob_send(self._handle(), dst, tag, _u8(payload),
                               len(payload)) != 0:
             raise MPIError(
                 ErrorCode.ERR_OTHER,
@@ -266,7 +276,7 @@ class OobEndpoint:
         deadline = _time.monotonic() + timeout_ms / 1000
         while True:
             left = max(1, int((deadline - _time.monotonic()) * 1000))
-            n = self._lib.oob_next_len(self._h, tag, left)
+            n = self._lib.oob_next_len(self._handle(), tag, left)
             if n < 0:
                 raise MPIError(ErrorCode.ERR_PENDING,
                                f"oob recv timeout (tag {tag})")
@@ -274,7 +284,7 @@ class OobEndpoint:
             tg = ctypes.c_int32(tag)
             arr = (ctypes.c_uint8 * max(n, 1))()
             left = max(1, int((deadline - _time.monotonic()) * 1000))
-            got = self._lib.oob_recv(self._h, ctypes.byref(src),
+            got = self._lib.oob_recv(self._handle(), ctypes.byref(src),
                                      ctypes.byref(tg), arr, n, left)
             if got == -2:
                 continue  # raced with another consumer; re-size
@@ -285,10 +295,10 @@ class OobEndpoint:
 
     def ttl_dropped(self) -> int:
         """Frames dropped by the routing-cycle ttl guard."""
-        return self._lib.oob_ttl_dropped(self._h)
+        return self._lib.oob_ttl_dropped(self._handle())
 
     def pending(self) -> int:
-        return self._lib.oob_pending(self._h)
+        return self._lib.oob_pending(self._handle())
 
     def close(self) -> None:
         if self._h:

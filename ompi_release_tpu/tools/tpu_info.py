@@ -24,11 +24,14 @@ def _import_everything() -> None:
     """Import every subsystem so all frameworks/vars self-register
     (ompi_info opens every framework the same way)."""
     from .. import coll, comm, datatype, ops, runtime  # noqa: F401
+    from ..btl import components as _b  # noqa: F401
     from ..coll import components as _c  # noqa: F401
+    from ..ops import pallas_op as _po  # noqa: F401
     from ..p2p import pml as _p  # noqa: F401
     from ..io import sharded as _s  # noqa: F401
     from ..ft import sensor as _f  # noqa: F401
     from ..parallel import dp as _dp  # noqa: F401
+    from ..runtime import ess as _e  # noqa: F401
     from ..runtime import mesh as _m
 
     _m.register_vars()

@@ -215,3 +215,20 @@ class TestNameServer:
         assert recs[1]["found"] == "tpu-port:42"
         assert recs[2]["found"] == "tpu-port:42"
         assert recs[2]["dup_rejected"] is True
+
+
+def test_closed_endpoint_raises_not_segfaults():
+    """Every OobEndpoint entry point on a closed endpoint raises a
+    clean MPIError instead of handing NULL to the C layer."""
+    ep = OobEndpoint(0)
+    port = ep.port
+    ep.close()
+    ep.close()  # idempotent
+    with pytest.raises(MPIError):
+        _ = ep.port
+    with pytest.raises(MPIError):
+        ep.send(1, 5, b"x")
+    with pytest.raises(MPIError):
+        ep.recv(tag=5, timeout_ms=50)
+    with pytest.raises(MPIError):
+        ep.connect(1, "127.0.0.1", port)

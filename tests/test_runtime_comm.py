@@ -185,3 +185,18 @@ class TestInfo:
         assert c.info.get("io_hint") == "collective"  # deep copy
         d.free()
         c.free()
+
+
+def test_env_utility_surface(world):
+    """MPI_Initialized/Wtime/Wtick/Get_version/Error_string."""
+    assert mpi.initialized() is True
+    assert mpi.finalized() is False
+    t0 = mpi.wtime()
+    assert mpi.wtime() >= t0
+    assert 0 < mpi.wtick() < 1
+    ver, level = mpi.get_version()
+    assert ver and "1.8.5" in level
+    from ompi_release_tpu.utils.errors import ErrorCode
+    assert mpi.error_string(ErrorCode.ERR_RANK) == "ERR_RANK"
+    assert mpi.error_string(6) == "ERR_RANK"
+    assert "unknown" in mpi.error_string(99999)

@@ -359,3 +359,21 @@ class TestCommSpawn:
             job.send(5, 101, b"x")
         with pytest.raises(MPIError):
             job.send(0, 3, b"x")  # control-plane tags protected
+
+    def test_messaging_after_job_end_errors_cleanly(self, tmp_path,
+                                                    capfd):
+        """Late send/recv on a finished spawn must raise ERR_SPAWN —
+        this used to SEGFAULT (NULL native handle after shutdown)."""
+        from ompi_release_tpu.comm import comm_spawn
+        from ompi_release_tpu.utils.errors import MPIError
+
+        app = _write_app(tmp_path, """
+            world = mpi.init()
+            mpi.finalize()
+        """)
+        job = comm_spawn([sys.executable, app], 1, timeout_s=120)
+        assert job.wait(timeout_s=60) == 0
+        with pytest.raises(MPIError):
+            job.send(0, 101, b"late")
+        with pytest.raises(MPIError):
+            job.recv(102, timeout_ms=100)
