@@ -47,6 +47,8 @@ class ModelConfig:
     n_experts: int = 0  # 0 = dense FFN; >0 = switch-MoE every layer
     capacity_factor: float = 1.25
     microbatches: int = 1  # per-rank microbatch count for the pp schedule
+    remat: bool = False  # jax.checkpoint the pipelined trunk (trade
+    #                      recompute for activation memory)
     dtype: Any = jnp.bfloat16
     rope_base: float = 10000.0
     # attention implementation: "auto" = Pallas flash kernel on TPU when
@@ -259,7 +261,8 @@ def forward_loss(cfg: ModelConfig, params: Dict, tokens: jax.Array,
     x_mb = emb.reshape(m, mb, s_loc, cfg.d_model)
 
     y = pp_mod.pipeline(
-        partial(_trunk, cfg), params["layers"], x_mb, axis_name="pp"
+        partial(_trunk, cfg), params["layers"], x_mb, axis_name="pp",
+        remat=cfg.remat,
     )  # (m, mb, S_loc, D), meaningful on the last stage
 
     h = _rmsnorm(y.reshape(b_loc, s_loc, cfg.d_model), params["ln_f"])

@@ -23,7 +23,7 @@ from jax import lax
 
 
 def pipeline(stage_fn: Callable, stage_params, x_microbatches: jax.Array, *,
-             axis_name: str = "pp") -> jax.Array:
+             axis_name: str = "pp", remat: bool = False) -> jax.Array:
     """Run microbatches through the stage pipeline.
 
     stage_fn(params, x) -> y with y.shape == x.shape (transformer blocks
@@ -34,7 +34,20 @@ def pipeline(stage_fn: Callable, stage_params, x_microbatches: jax.Array, *,
     stage 0 (other stages may pass anything of the same shape, e.g. the
     same array; only stage 0's values are consumed).
     Returns (M, ...) — meaningful on the last stage.
+
+    ``remat=True`` wraps the stage body in ``jax.checkpoint``: the
+    backward pass recomputes each tick's activations instead of
+    keeping all M x S of them live — the TPU-idiomatic answer to the
+    activation-memory problem 1F1B schedules solve by hand elsewhere
+    (the schedule stays the compiled scan; XLA plans the recompute).
+    Gradients are bitwise-equivalent math, just cheaper to hold.
     """
+    if remat:
+        # prevent_cse=False is the documented form for checkpoint
+        # under scan: the CSE hazard the default guards against cannot
+        # occur here, and its barriers would block XLA fusion across
+        # the remat boundary
+        stage_fn = jax.checkpoint(stage_fn, prevent_cse=False)
     n = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
     m = x_microbatches.shape[0]
@@ -71,7 +84,7 @@ def pipeline(stage_fn: Callable, stage_params, x_microbatches: jax.Array, *,
 
 def pipeline_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
                   x_microbatches: jax.Array, target_microbatches, *,
-                  axis_name: str = "pp") -> jax.Array:
+                  axis_name: str = "pp", remat: bool = False) -> jax.Array:
     """Forward pipeline + last-stage loss, broadcast to all stages.
 
     ``loss_fn(y, targets) -> scalar`` runs on the last stage's outputs;
@@ -82,7 +95,8 @@ def pipeline_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
     """
     n = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
-    y = pipeline(stage_fn, stage_params, x_microbatches, axis_name=axis_name)
+    y = pipeline(stage_fn, stage_params, x_microbatches,
+                 axis_name=axis_name, remat=remat)
     local = loss_fn(y, target_microbatches)
     # Only the last stage's loss is real. The value is broadcast with a
     # psum of the masked term, but the psum must be OUTSIDE the grad

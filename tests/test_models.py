@@ -146,3 +146,19 @@ def test_flash_attention_path_matches_ring(setup):
     cfg_flash = tfm.ModelConfig(**{**CFG, "attn_impl": "flash"})
     got = run_loss(cfg_flash, mesh1, params, tokens, targets)
     assert got == pytest.approx(ref, rel=1e-4, abs=1e-5)
+
+
+def test_remat_train_step_matches_plain():
+    """cfg.remat=True must not change the training math (loss parity
+    with the plain config on one step)."""
+    cfg_a = tfm.ModelConfig(**{**CFG, "microbatches": 2})
+    cfg_b = tfm.ModelConfig(**{**CFG, "microbatches": 2, "remat": True})
+    params = jax.device_get(tfm.init_params(jax.random.PRNGKey(3), cfg_a))
+    rng = np.random.RandomState(3)
+    tokens, targets = make_batch(rng, 8, 16, cfg_a.vocab)
+    mesh = build_parallel_mesh(devices=jax.devices()[:4], pp=2, tp=2)
+    la = run_loss(cfg_a, mesh, params, tokens, targets)
+    lb = run_loss(cfg_b, mesh, params, tokens, targets)
+    assert la == pytest.approx(lb, rel=1e-5)
+    l0, l1 = run_step(cfg_b, mesh, params, tokens, targets)
+    assert np.isfinite(l0) and l1 < l0
