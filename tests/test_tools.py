@@ -129,3 +129,38 @@ class TestTpuServer:
         finally:
             p.terminate()
             p.wait(timeout=10)
+
+
+class TestExamples:
+    """The reference's examples/ are its acceptance programs
+    (SURVEY §4 item 4); ours must run the same way."""
+
+    @pytest.mark.parametrize("name", [
+        "ring_tpu.py", "connectivity_tpu.py", "allreduce_tpu.py",
+    ])
+    def test_example_runs_driver_mode(self, name):
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8")
+        r = subprocess.run(
+            [sys.executable, f"examples/{name}"], cwd="/root/repo",
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "OK" in r.stdout or "complete" in r.stdout
+
+    def test_hello_under_tpurun(self):
+        import subprocess
+
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_release_tpu.tools.tpurun",
+             "-n", "3", sys.executable, "examples/hello_tpu.py"],
+            cwd="/root/repo", capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stderr + r.stdout
+        for rank in range(3):
+            assert f"I am process {rank} of 3" in r.stdout
