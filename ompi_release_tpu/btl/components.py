@@ -24,10 +24,33 @@ moves HBM arrays, so its limits are MiB-scale.
 
 from __future__ import annotations
 
+import itertools
+import threading
+
 import numpy as np
 
 from ..mca import component as mca_component
+from ..native import USER_TAG_BASE
+from ..utils.errors import ErrorCode, MPIError
 from . import base
+
+#: frame magics: every staged frame self-identifies, so a receiver that
+#: timed out mid-transfer (leaving orphan chunks queued/stashed) can
+#: resynchronize — unknown or stale frames are discarded, never parsed
+#: as a header or delivered to the wrong transfer
+_HDR_MAGIC = "SGH1"
+_CHUNK_MAGIC = b"SGC1"
+_xfer_ids = itertools.count(1)
+
+
+def _check_user_tag(tag: int) -> None:
+    if tag < USER_TAG_BASE:
+        raise MPIError(
+            ErrorCode.ERR_TAG,
+            f"transport payload tags start at {USER_TAG_BASE} (below "
+            "is the coordinator/pubsub control plane — a staged frame "
+            "there would be consumed as a control frame)",
+        )
 
 
 def _pack_array_header(buf, arr: np.ndarray, *extra_front) -> None:
@@ -194,19 +217,28 @@ class DcnBtl(base.BtlModule):
 
     def send_staged(self, oob_ep, peer_nid: int, tag: int, data) -> int:
         """Stream ``data`` to ``peer_nid`` over the OOB in
-        max_send_size chunks. Returns the number of chunks sent."""
+        max_send_size chunks. Returns the number of chunks sent. Every
+        frame carries a transfer id so a receiver that abandoned an
+        earlier transfer resynchronizes instead of parsing orphan
+        chunks as headers."""
         from ..native import DssBuffer
 
+        _check_user_tag(tag)
+        xfer = next(_xfer_ids)
         arr = np.ascontiguousarray(np.asarray(data))
         raw = arr.tobytes()
         chunk = max(1, self.max_send_size)
         nchunks = max(1, -(-len(raw) // chunk))
         hdr = DssBuffer()
+        hdr.pack_string(_HDR_MAGIC)
+        hdr.pack_int64(xfer)
         _pack_array_header(hdr, arr)
         hdr.pack_int64(nchunks)
         oob_ep.send(peer_nid, tag, hdr.tobytes())
+        xb = _CHUNK_MAGIC + int(xfer).to_bytes(8, "big")
         for i in range(nchunks):
-            oob_ep.send(peer_nid, tag, raw[i * chunk:(i + 1) * chunk])
+            oob_ep.send(peer_nid, tag,
+                        xb + raw[i * chunk:(i + 1) * chunk])
             self.staged_chunks_pvar.add()
         self.staged_bytes_pvar.add(len(raw))
         return nchunks
@@ -223,15 +255,30 @@ class DcnBtl(base.BtlModule):
 
         from ..native import DssBuffer
 
+        _check_user_tag(tag)
         deadline = _time.monotonic() + timeout_ms / 1000
-        src, hraw = self._recv_from(oob_ep, src, tag, deadline)
-        hdr = DssBuffer(hraw)
-        dtype, shape = _unpack_array_header(hdr)
-        (nchunks,) = hdr.unpack_int64()
+        # resync: discard frames until a valid header (orphan chunks
+        # from an abandoned transfer must not be parsed as headers)
+        while True:
+            src_got, hraw = self._recv_from(oob_ep, src, tag, deadline)
+            try:
+                hdr = DssBuffer(hraw)
+                if hdr.unpack_string() != _HDR_MAGIC:
+                    continue
+                (xfer,) = hdr.unpack_int64()
+                dtype, shape = _unpack_array_header(hdr)
+                (nchunks,) = hdr.unpack_int64()
+            except MPIError:
+                continue  # a chunk frame: skip to the next header
+            src = src_got
+            break
+        want = _CHUNK_MAGIC + int(xfer).to_bytes(8, "big")
         parts = []
-        for _ in range(int(nchunks)):
+        while len(parts) < int(nchunks):
             _, praw = self._recv_from(oob_ep, src, tag, deadline)
-            parts.append(praw)
+            if not praw.startswith(want):
+                continue  # stale chunk from an abandoned transfer
+            parts.append(praw[len(want):])
             self.staged_chunks_pvar.add()
         arr = np.frombuffer(b"".join(parts), dtype=dtype).reshape(shape)
         self.staged_bytes_pvar.add(arr.nbytes)
@@ -256,11 +303,12 @@ class ShmBtl(base.BtlModule):
     NAME = "shm"
     EAGER_LIMIT = 32 * 1024
     MAX_SEND_SIZE = 256 * 1024 * 1024
-    LATENCY = 3
-    BANDWIDTH = 25_000  # host memory fabric
-    EXCLUSIVITY = 768   # beats dcn for same-host peers
     SUPPORTS_MOVE = False  # out-of-band: send_shm/recv_shm, never the
-    #                        BML move lists (which hold movers only)
+    #                        BML move lists (which hold movers only) —
+    #                        so the latency/bandwidth/exclusivity
+    #                        ranking attributes are deliberately left
+    #                        at base defaults: selection happens via
+    #                        reachable() alone, not move-list ranking
 
     def reachable(self, src_ep, dst_ep) -> bool:
         # same machine, different controller process: the only pair
@@ -300,6 +348,7 @@ class ShmBtl(base.BtlModule):
     #: generous (4x the recv default) so a slow-but-live receiver is
     #: never pulled out from under.
     _pending_segments: list = []
+    _pending_lock = threading.Lock()
     SEGMENT_TTL_S = 120.0
 
     @classmethod
@@ -309,18 +358,19 @@ class ShmBtl(base.BtlModule):
         from multiprocessing import shared_memory
 
         now = _time.monotonic()
-        keep = []
-        for name, deadline in cls._pending_segments:
-            if now < deadline:
-                keep.append((name, deadline))
-                continue
+        with cls._pending_lock:  # concurrent senders append in here
+            expired = [nd for nd in cls._pending_segments
+                       if now >= nd[1]]
+            cls._pending_segments[:] = [
+                nd for nd in cls._pending_segments if now < nd[1]
+            ]
+        for name, _deadline in expired:
             try:  # consumed segments are already unlinked: ignore
                 seg = shared_memory.SharedMemory(name=name)
                 seg.close()
                 seg.unlink()
             except FileNotFoundError:
                 pass
-        cls._pending_segments[:] = keep
 
     def send_shm(self, oob_ep, peer_nid: int, tag: int, data) -> str:
         """Write ``data`` into a fresh shm segment and post the
@@ -334,6 +384,7 @@ class ShmBtl(base.BtlModule):
 
         from ..native import DssBuffer
 
+        _check_user_tag(tag)
         self._reap_orphaned_segments()
         arr = np.ascontiguousarray(np.asarray(data))
         seg = shared_memory.SharedMemory(create=True,
@@ -356,9 +407,10 @@ class ShmBtl(base.BtlModule):
         self.shm_bytes_pvar.add(arr.nbytes)
         name = seg.name
         seg.close()  # receiver owns the segment now
-        self._pending_segments.append(
-            (name, _time.monotonic() + self.SEGMENT_TTL_S)
-        )
+        with self._pending_lock:
+            self._pending_segments.append(
+                (name, _time.monotonic() + self.SEGMENT_TTL_S)
+            )
         return name
 
     def recv_shm(self, oob_ep, tag: int, *, dst_device=None,
@@ -371,11 +423,21 @@ class ShmBtl(base.BtlModule):
 
         from ..native import DssBuffer
 
+        _check_user_tag(tag)
         _, _, raw = oob_ep.recv(tag=tag, timeout_ms=timeout_ms)
         frame = DssBuffer(raw)
         name = frame.unpack_string()
         dtype, shape = _unpack_array_header(frame)
-        seg = shared_memory.SharedMemory(name=name)
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+        except FileNotFoundError:
+            from ..utils.errors import ErrorCode as _EC, MPIError as _ME
+
+            raise _ME(
+                _EC.ERR_OTHER,
+                f"shm segment '{name}' no longer exists (reaped after "
+                f"TTL or sender died) — the handoff frame is stale",
+            )
         try:
             nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
             view = np.frombuffer(seg.buf[:nbytes],

@@ -130,8 +130,26 @@ def close_port(port: str) -> None:
         _pending.pop(port, None)
 
 
+def _job_agent():
+    """The tpurun WorkerAgent when this process is part of a job —
+    the public pubsub API must reach the JOB-global name table (the
+    HNP server) there, not this process's local dict (which no other
+    worker can see)."""
+    from ..runtime.runtime import Runtime
+
+    rt = Runtime._instance
+    return getattr(rt, "agent", None) if rt is not None else None
+
+
 def publish_name(service: str, port: str) -> None:
-    """``MPI_Publish_name`` (pubsub_orte: HNP-hosted name table)."""
+    """``MPI_Publish_name`` (pubsub_orte: HNP-hosted name table).
+
+    Under tpurun this routes to the HNP's OOB name server so every
+    worker sees it; in singleton/driver mode the table is local."""
+    agent = _job_agent()
+    if agent is not None:
+        agent.publish_name(service, port)
+        return
     with _lock:
         if service in _names:
             raise MPIError(ErrorCode.ERR_NAME,
@@ -141,6 +159,10 @@ def publish_name(service: str, port: str) -> None:
 
 
 def unpublish_name(service: str) -> None:
+    agent = _job_agent()
+    if agent is not None:
+        agent.unpublish_name(service)
+        return
     with _lock:
         if _names.pop(service, None) is None:
             raise MPIError(ErrorCode.ERR_NAME,
@@ -152,11 +174,17 @@ def lookup_name(service: str, *, timeout_s: float = 10.0) -> str:
     pubsub lookup spins on the server) or times out."""
     import time
 
+    agent = _job_agent()
+    if agent is not None:
+        return agent.lookup_name(service,
+                                 timeout_ms=int(timeout_s * 1000))
     deadline = time.monotonic() + timeout_s
     with _lock:
         while service not in _names:
             left = deadline - time.monotonic()
             if left <= 0 or not _lock.wait(timeout=left):
+                if service in _names:  # published at the deadline edge
+                    break
                 raise MPIError(ErrorCode.ERR_NAME,
                                f"service '{service}' not found")
         return _names[service]

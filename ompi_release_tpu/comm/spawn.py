@@ -27,8 +27,9 @@ from ..utils.errors import ErrorCode, MPIError
 
 _log = output.stream("dpm")
 
+from ..native import USER_TAG_BASE as TAG_USER_BASE  # noqa: E402
 #: user payload tags must stay clear of the coordinator's control tags
-TAG_USER_BASE = 100
+#: (shared OOB tag-space constant)
 
 
 class SpawnedJob:

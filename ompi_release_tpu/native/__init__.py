@@ -4,4 +4,6 @@ Builds ``native/libompitpu_native.so`` on demand (g++ is in the image;
 pybind11 is not, so the C ABI + ctypes is the binding layer).
 """
 
-from .bindings import DssBuffer, OobEndpoint, load_library  # noqa: F401
+from .bindings import (  # noqa: F401
+    USER_TAG_BASE, DssBuffer, OobEndpoint, load_library,
+)

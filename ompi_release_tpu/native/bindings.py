@@ -13,6 +13,11 @@ from ..utils.errors import ErrorCode, MPIError
 
 _log = output.stream("native")
 
+#: OOB tag space: tags below this are reserved for the control plane
+#: (coordinator wire-up 1-8, pubsub 9-12); user payload transports
+#: (staged DCN, shm handoff, spawn messaging) must use tags >= this
+USER_TAG_BASE = 100
+
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )

@@ -349,6 +349,10 @@ class WorkerAgent:
         self.cards: List[Dict[str, Any]] = []
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
+        # created HERE, not lazily: two threads' first RPCs racing a
+        # lazy check-then-set would mint two locks and defeat the
+        # reply serialization pubsub_rpc requires
+        self._pubsub_lock = threading.Lock()
 
     def run_modex(self, my_card: Dict[str, Any], *,
                   timeout_ms: int = 30_000) -> List[Dict[str, Any]]:
@@ -434,11 +438,8 @@ class WorkerAgent:
     def _pubsub_rpc(self, tag: int, *fields: str, timeout_ms: int = 10_000):
         from .pubsub import pubsub_rpc
 
-        lock = getattr(self, "_pubsub_lock", None)
-        if lock is None:
-            lock = self._pubsub_lock = threading.Lock()
-        return pubsub_rpc(self.ep, lock, self, tag, *fields,
-                          timeout_ms=timeout_ms)
+        return pubsub_rpc(self.ep, self._pubsub_lock, self, tag,
+                          *fields, timeout_ms=timeout_ms)
 
     def publish_name(self, service: str, port: str) -> None:
         ok, msg = self._pubsub_rpc(TAG_PUBLISH, service, port)

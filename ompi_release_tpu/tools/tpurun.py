@@ -81,16 +81,25 @@ def parse_hostfile(path: str) -> List[HostSpec]:
                 continue
             parts = line.split()
             slots = 1
-            for p in parts[1:]:
-                if p.startswith("slots="):
+            for tok in parts[1:]:
+                if tok.startswith("slots="):
                     try:
-                        slots = int(p.split("=", 1)[1])
+                        slots = int(tok.split("=", 1)[1])
                     except ValueError:
                         raise MPIError(
                             ErrorCode.ERR_ARG,
                             f"hostfile {path}: bad slot count in "
                             f"'{line}'",
                         )
+                else:
+                    # 'slot=8' silently parsing as slots=1 would map
+                    # ranks onto machines the user meant to keep free
+                    raise MPIError(
+                        ErrorCode.ERR_ARG,
+                        f"hostfile {path}: unrecognized token "
+                        f"'{tok}' in '{line}' (only 'slots=N' is "
+                        "supported)",
+                    )
             hosts.append(HostSpec(parts[0], slots))
     if not hosts:
         raise MPIError(ErrorCode.ERR_ARG, f"hostfile {path} has no hosts")

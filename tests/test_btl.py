@@ -264,9 +264,9 @@ class TestHonestDcn:
                 rng = np.random.RandomState(0)
                 x = rng.randn(3 << 18).astype(np.float32)  # 3 MiB
                 before = int(m.staged_chunks_pvar.read())
-                sent = m.send_staged(b, 0, 21, x)
+                sent = m.send_staged(b, 0, 121, x)
                 assert sent == 3
-                got = m.recv_staged(a, 21)
+                got = m.recv_staged(a, 121)
                 np.testing.assert_array_equal(np.asarray(got), x)
                 # sender + receiver both account their chunks
                 assert int(m.staged_chunks_pvar.read()) - before == 6
@@ -298,8 +298,8 @@ class TestHonestDcn:
             ep = OobEndpoint(1)
             ep.connect(0, "127.0.0.1", port)
             x = np.arange(200_000, dtype=np.float32)
-            DcnBtl().send_staged(ep, 0, 33, x)
-            ep.recv(tag=34, timeout_ms=30000)  # ack gates teardown
+            DcnBtl().send_staged(ep, 0, 133, x)
+            ep.recv(tag=134, timeout_ms=30000)  # ack gates teardown
             ep.close()
         """)
         p = tmp_path / "dcn_sender.py"
@@ -310,11 +310,11 @@ class TestHonestDcn:
                 [sys.executable, str(p), str(ep.port)],
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             )
-            got = DcnBtl().recv_staged(ep, 33)
+            got = DcnBtl().recv_staged(ep, 133)
             np.testing.assert_array_equal(
                 np.asarray(got), np.arange(200_000, dtype=np.float32)
             )
-            ep.send(1, 34, b"ok")
+            ep.send(1, 134, b"ok")
             _, err = proc.communicate(timeout=30)
             assert proc.returncode == 0, err
         finally:
@@ -339,12 +339,12 @@ class TestHonestDcn:
                 x1 = np.full(100_000, 1.0, np.float32)
                 x2 = np.full(120_000, 2.0, np.float32)
                 t1 = threading.Thread(
-                    target=lambda: m.send_staged(s1, 0, 9, x1))
+                    target=lambda: m.send_staged(s1, 0, 109, x1))
                 t2 = threading.Thread(
-                    target=lambda: m.send_staged(s2, 0, 9, x2))
+                    target=lambda: m.send_staged(s2, 0, 109, x2))
                 t1.start(); t2.start()
-                a = np.asarray(m.recv_staged(root, 9))
-                b = np.asarray(m.recv_staged(root, 9))
+                a = np.asarray(m.recv_staged(root, 109))
+                b = np.asarray(m.recv_staged(root, 109))
                 t1.join(); t2.join()
                 got = {arr.shape[0]: arr for arr in (a, b)}
                 np.testing.assert_array_equal(got[100_000], x1)
@@ -396,8 +396,8 @@ class TestShmHandoff:
             ep = OobEndpoint(1)
             ep.connect(0, "127.0.0.1", port)
             x = np.arange(200_000, dtype=np.float32) * 0.5
-            ShmBtl().send_shm(ep, 0, 44, x)
-            ep.recv(tag=45, timeout_ms=30000)  # ack gates teardown
+            ShmBtl().send_shm(ep, 0, 144, x)
+            ep.recv(tag=145, timeout_ms=30000)  # ack gates teardown
             ep.close()
         """)
         p = tmp_path / "shm_sender.py"
@@ -408,12 +408,12 @@ class TestShmHandoff:
                 [sys.executable, str(p), str(ep.port)],
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             )
-            got = ShmBtl().recv_shm(ep, 44)
+            got = ShmBtl().recv_shm(ep, 144)
             np.testing.assert_array_equal(
                 np.asarray(got),
                 np.arange(200_000, dtype=np.float32) * 0.5,
             )
-            ep.send(1, 45, b"ok")
+            ep.send(1, 145, b"ok")
             _, err = proc.communicate(timeout=30)
             assert proc.returncode == 0, err
         finally:
@@ -437,7 +437,7 @@ class TestShmHandoff:
         try:
             b.connect(0, "127.0.0.1", a.port)
             m = ShmBtl()
-            name = m.send_shm(b, 0, 77, np.ones(16, np.float32))
+            name = m.send_shm(b, 0, 177, np.ones(16, np.float32))
             # segment exists while pending
             seg = shared_memory.SharedMemory(name=name)
             seg.close()
@@ -445,11 +445,42 @@ class TestShmHandoff:
             ShmBtl._pending_segments[:] = [
                 (n, 0.0) for n, _ in ShmBtl._pending_segments
             ]
-            m.send_shm(b, 0, 78, np.ones(4, np.float32))
+            m.send_shm(b, 0, 178, np.ones(4, np.float32))
             with pytest.raises(FileNotFoundError):
                 shared_memory.SharedMemory(name=name)
             # drain the two frames + consume the second segment
-            m.recv_shm(a, 78)
+            m.recv_shm(a, 178)
         finally:
             a.close()
             b.close()
+
+    def test_recv_staged_resyncs_past_orphan_frames(self):
+        """Orphan chunks from an abandoned transfer must be skipped —
+        not parsed as headers — and stale chunks must not leak into
+        the next transfer's data."""
+        from ompi_release_tpu.btl.components import DcnBtl, _CHUNK_MAGIC
+        from ompi_release_tpu.native import OobEndpoint
+
+        a, b = OobEndpoint(0), OobEndpoint(1)
+        try:
+            b.connect(0, "127.0.0.1", a.port)
+            m = DcnBtl()
+            # orphan chunk frames (an abandoned transfer's leftovers)
+            stale = _CHUNK_MAGIC + (424242).to_bytes(8, "big") + b"junk"
+            b.send(0, 151, stale)
+            b.send(0, 151, stale)
+            x = np.arange(1000, dtype=np.float32)
+            m.send_staged(b, 0, 151, x)
+            got = m.recv_staged(a, 151)
+            np.testing.assert_array_equal(np.asarray(got), x)
+        finally:
+            a.close()
+            b.close()
+
+    def test_control_plane_tags_rejected(self):
+        from ompi_release_tpu.btl.components import DcnBtl, ShmBtl
+
+        with pytest.raises(MPIError):
+            DcnBtl().send_staged(None, 0, 9, np.ones(2))  # TAG_PUBLISH
+        with pytest.raises(MPIError):
+            ShmBtl().send_shm(None, 0, 5, np.ones(2))  # TAG_XCAST

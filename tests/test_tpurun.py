@@ -136,6 +136,29 @@ class TestPubsub:
         assert out.count("found:tpu-port:7") == 3
 
 
+class TestPubsubPublicApi:
+    def test_comm_publish_lookup_bridges_to_hnp(self, tmp_path, capfd):
+        """The PUBLIC comm.publish_name/lookup_name API must reach the
+        JOB-global name table under tpurun (not each process's local
+        dict, which no other worker can see)."""
+        app = _write_app(tmp_path, """
+            from ompi_release_tpu.comm import publish_name, lookup_name
+            world = mpi.init()
+            rt = Runtime.current()
+            pi = rt.bootstrap["process_index"]
+            if pi == 0:
+                publish_name("pub-api-svc", "tpu-port:5")
+            port = lookup_name("pub-api-svc", timeout_s=20)
+            print("found:" + port)
+            mpi.finalize()
+        """)
+        job = Job(2, [sys.executable, app], [], heartbeat_s=0.3)
+        rc = job.run(timeout_s=120)
+        out = capfd.readouterr().out
+        assert rc == 0, out
+        assert out.count("found:tpu-port:5") == 2
+
+
 class TestFailureDetection:
     def test_abnormal_exit_aborts_job(self, tmp_path, capfd):
         """One worker exits 3 mid-job: the job reaches ABORTED, the
