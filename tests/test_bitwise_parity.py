@@ -204,3 +204,39 @@ def test_segmented_ring_fixed_order(tuned, forced_alg):
     seg1 = np.asarray(tuned.allreduce(small, ops.SUM))
     np.testing.assert_array_equal(seg1, ring)
     np.testing.assert_array_equal(ring, np_ring(small))
+
+
+def np_reduce_scatter_ring(x):
+    """Exact step order of ``reduce_scatter_ring`` (the tuned
+    reduce_scatter_block path): n-1 ring steps; chunk c completes at
+    rank c."""
+    n, total = x.shape
+    chunk = total // n
+    chunks = np.stack([x[r].reshape(n, chunk) for r in range(n)])
+    for k in range(n - 1):
+        snap = chunks.copy()
+        for r in range(n):
+            src = (r - 1) % n
+            recv = snap[src][(src - k - 1) % n]
+            idx = (r - k - 2) % n
+            chunks[r][idx] = (chunks[r][idx] + recv).astype(np.float32)
+    return np.stack([chunks[r][r] for r in range(n)])
+
+
+def test_reduce_scatter_ring_fixed_order(tuned):
+    """tuned's ring reduce_scatter_block ≡ its exact numpy order,
+    bitwise — and each rank's shard sums all ranks' chunk r."""
+    n = tuned.size
+    x = _inputs(n, n * 512, seed=23)
+    out = np.asarray(tuned.reduce_scatter_block(x, ops.SUM))
+    assert any(
+        k[:2] == ("tuned", "reduce_scatter_block")
+        for k in tuned._coll_programs
+    )
+    np.testing.assert_array_equal(out, np_reduce_scatter_ring(x))
+    # numeric sanity vs the mathematical result
+    for r in range(n):
+        np.testing.assert_allclose(
+            out[r], x[:, r * 512:(r + 1) * 512].sum(0),
+            rtol=2e-5, atol=1e-4,
+        )
