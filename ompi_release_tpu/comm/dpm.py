@@ -12,6 +12,20 @@ HNP / orte-server). Here the rendezvous service has two backends:
   publish/lookup frames over the native OOB (see
   ``runtime.coordinator.HnpCoordinator.start_name_server`` /
   ``WorkerAgent.publish_name/lookup_name``) — the orte-server role.
+  The module-level publish/lookup/unpublish below route there
+  automatically when this process is part of a job; the standalone
+  ``tools.tpu_server`` covers names ACROSS jobs.
+
+Scope note (design honesty): the NAME service spans processes and
+jobs; the ``comm_accept``/``comm_connect`` RENDEZVOUS below forms an
+:class:`~.intercomm.Intercommunicator`, which is a single-controller
+object — so accept/connect pair up threads/comms of one controller.
+Cross-controller pairing exchanges addresses through the name service
+and then talks via the transports built for that boundary
+(``DcnBtl.send_staged`` / ``ShmBtl.send_shm`` /
+``comm.spawn.SpawnedJob`` messaging); a cross-controller device-data
+intercommunicator would be a lie in this runtime (see
+``comm/spawn.py``'s scope note).
 
 A *port* (``MPI_Open_port``) is an opaque string naming a pending
 acceptor. ``comm_accept`` registers the port and blocks (with
