@@ -108,3 +108,34 @@ class TestPallasOpKernels:
         loop = pallas_op.make_scale_loop(rows, cols)
         v = loop(jnp.ones((rows, cols), jnp.float32), 3)
         assert np.isfinite(float(v))
+
+
+def test_bench_end_to_end_on_simulator_mesh():
+    """bench.py's full multi-device path (the scoreboard the driver
+    runs) must execute on the 8-device simulator mesh and emit valid
+    JSON metric lines with the headline LAST — a crash here would
+    silence the round's BENCH file."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, "bench.py"], cwd="/root/repo", env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(ln) for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) >= 5, lines
+    for ln in lines:
+        assert "metric" in ln and "value" in ln and "unit" in ln
+        if ln.get("vs_baseline") is not None:
+            assert ln["vs_baseline"] <= 1.0 + 1e-9  # by construction
+    headline = lines[-1]
+    assert "allreduce" in headline["metric"] or "op_sum" in \
+        headline["metric"]
