@@ -234,6 +234,13 @@ class DcnBtl(base.BtlModule):
         hdr.pack_int64(xfer)
         _pack_array_header(hdr, arr)
         hdr.pack_int64(nchunks)
+        # end-to-end payload CRC (the opal_datatype_checksum role for
+        # the cross-process wire): the receiver verifies the
+        # reassembled bytes, catching corruption anywhere between the
+        # sender's buffer and reassembly
+        import zlib
+
+        hdr.pack_int64(zlib.crc32(raw))
         oob_ep.send(peer_nid, tag, hdr.tobytes())
         xb = _CHUNK_MAGIC + int(xfer).to_bytes(8, "big")
         for i in range(nchunks):
@@ -268,6 +275,7 @@ class DcnBtl(base.BtlModule):
                 (xfer,) = hdr.unpack_int64()
                 dtype, shape = _unpack_array_header(hdr)
                 (nchunks,) = hdr.unpack_int64()
+                (crc,) = hdr.unpack_int64()
             except MPIError:
                 continue  # a chunk frame: skip to the next header
             src = src_got
@@ -280,7 +288,16 @@ class DcnBtl(base.BtlModule):
                 continue  # stale chunk from an abandoned transfer
             parts.append(praw[len(want):])
             self.staged_chunks_pvar.add()
-        arr = np.frombuffer(b"".join(parts), dtype=dtype).reshape(shape)
+        import zlib
+
+        raw = b"".join(parts)
+        if zlib.crc32(raw) != int(crc):
+            raise MPIError(
+                ErrorCode.ERR_TRUNCATE,
+                f"staged transfer {xfer} failed its payload CRC — "
+                "wire corruption or interleaved frames",
+            )
+        arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
         self.staged_bytes_pvar.add(arr.nbytes)
         if dst_device is None:
             dst_device = jax.local_devices()[0]

@@ -484,3 +484,37 @@ class TestShmHandoff:
             DcnBtl().send_staged(None, 0, 9, np.ones(2))  # TAG_PUBLISH
         with pytest.raises(MPIError):
             ShmBtl().send_shm(None, 0, 5, np.ones(2))  # TAG_XCAST
+
+    def test_staged_transfer_crc_catches_corruption(self):
+        """A hand-crafted transfer whose chunk bytes don't match the
+        header CRC must be rejected (wire-corruption detection, the
+        datatype-checksum role for the cross-process path)."""
+        import zlib
+
+        from ompi_release_tpu.btl.components import (
+            DcnBtl, _CHUNK_MAGIC, _HDR_MAGIC,
+        )
+        from ompi_release_tpu.native import DssBuffer, OobEndpoint
+
+        a, b = OobEndpoint(0), OobEndpoint(1)
+        try:
+            b.connect(0, "127.0.0.1", a.port)
+            good = np.arange(64, dtype=np.float32).tobytes()
+            hdr = DssBuffer()
+            hdr.pack_string(_HDR_MAGIC)
+            hdr.pack_int64(7)
+            hdr.pack_string("float32")
+            hdr.pack_string("64")
+            hdr.pack_int64(1)
+            hdr.pack_int64(zlib.crc32(good))
+            b.send(0, 161, hdr.tobytes())
+            corrupted = bytearray(good)
+            corrupted[12] ^= 0xFF  # one flipped byte
+            b.send(0, 161,
+                   _CHUNK_MAGIC + (7).to_bytes(8, "big") + bytes(corrupted))
+            with pytest.raises(MPIError) as ei:
+                DcnBtl().recv_staged(a, 161)
+            assert "CRC" in str(ei.value)
+        finally:
+            a.close()
+            b.close()
