@@ -86,3 +86,14 @@ def main() -> None:
 
 if __name__ == "__main__":
     main()
+
+
+# Addendum (same session): is the 8192^2 transpose VPU-bound or
+# HBM-bound? A COPY kernel at the identical (1024,1024)-blocked 2-D
+# grid measured ~357-390 GB/s vs the transpose's ~300-385 — i.e. the
+# blocked 2-D data movement itself (4 KB bursts with tile-to-tile
+# jumps) is the ceiling, not the in-VMEM transpose. The 1-D scale
+# kernel reaches ~660 GB/s only because its blocks are full rows
+# (pure sequential streams). Conclusion: alltoall_i32_torus at ~0.5 of
+# the sequential-copy ceiling is the strided-access reality of this
+# geometry, not kernel inefficiency.
