@@ -479,7 +479,18 @@ def main():
     cand = np.stack([bw[nm] for nm in ceiling_names])
     ceil_r = cand.max(axis=0)
     ceil_med = float(np.median(ceil_r))
-    ceil_cv = float(np.std(ceil_r) / max(ceil_med, 1e-12))
+    # the CV must be robust to a contaminated round: a tunnel hiccup
+    # (or a concurrent job on the chip) can drive one round's slope to
+    # the 1e-12 clamp, producing an absurd per-round bandwidth that
+    # explodes a plain std while the median stays sane — compute
+    # variability over rounds within a sane band of the median and
+    # surface how many rounds were discarded
+    sane = ceil_r[(ceil_r > 0.2 * ceil_med) & (ceil_r < 5 * ceil_med)]
+    dropped_rounds = int(ceil_r.size - sane.size)
+    if sane.size:
+        ceil_cv = float(np.std(sane) / max(float(np.median(sane)), 1e-12))
+    else:
+        ceil_cv = float("nan")
 
     lines = []
     headline = None
@@ -525,6 +536,8 @@ def main():
             "ceiling_gbps": round(ceil_med, 1),
             "ceiling_cv": round(ceil_cv, 4),
         }
+        if dropped_rounds:
+            entry["ceiling_rounds_dropped"] = dropped_rounds
         if nm == "allreduce_256MiB" and n < 2:
             headline = {
                 "metric": "op_sum_256MiB_f32_hbm_bw",
