@@ -576,6 +576,8 @@ def main():
             "ceiling_cv": round(ceil_cv, 4),
             "parity": True,
         }
+        if dropped_rounds:
+            headline["ceiling_rounds_dropped"] = dropped_rounds
 
     for ln in lines:
         print(json.dumps(ln))

@@ -26,7 +26,7 @@ sub-mesh; ``mirror`` is the remote side's handle.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 

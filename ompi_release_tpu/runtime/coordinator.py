@@ -31,7 +31,6 @@ Tags mirror the RML usage pattern (``rml.h:318`` tagged send/recv).
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
