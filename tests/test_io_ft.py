@@ -355,3 +355,58 @@ def test_donating_jit_pytree_arg_provenance():
     with pytest.raises(MPIError) as ei:
         step(st, g)  # consumed pytree caught BEFORE dispatch
     assert "tree_step" in str(ei.value)
+
+
+class TestCheckpointCli:
+    """tpu-checkpoint CLI (orte-checkpoint/orte-restart tool role)."""
+
+    def _make(self, tmp_path, steps=(3, 7)):
+        import jax.numpy as jnp
+
+        from ompi_release_tpu.ft.checkpoint import Checkpointer
+
+        ck = Checkpointer(str(tmp_path), keep=0)
+        state = {"w": jnp.arange(1000, dtype=jnp.float32),
+                 "b": jnp.ones((4,), jnp.float32)}
+        for s in steps:
+            ck.save(s, state, async_=False, extra_meta={"loss": 1.0 / s})
+        return ck
+
+    def test_list_show_verify_gc(self, tmp_path, capsys):
+        from ompi_release_tpu.tools import tpu_checkpoint as cli
+
+        self._make(tmp_path)
+        assert cli.main(["list", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "step        3" in out and "step        7" in out
+        assert cli.main(["show", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert '"step": 7' in out
+        assert cli.main(["verify", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "verified OK" in out
+        assert cli.main(["gc", str(tmp_path), "--keep", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "removed step 3" in out
+        assert cli.main(["list", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "step        3" not in out
+
+    def test_verify_detects_corruption(self, tmp_path, capsys):
+        import glob
+        import os
+
+        from ompi_release_tpu.tools import tpu_checkpoint as cli
+
+        self._make(tmp_path, steps=(1,))
+        shards = glob.glob(str(tmp_path / "step_*" / "leaf0000*"))
+        data_files = [p for p in shards if not p.endswith(".json")]
+        assert data_files
+        with open(data_files[0], "r+b") as f:
+            f.seek(16)
+            byte = f.read(1)
+            f.seek(16)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        assert cli.main(["verify", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "FAILED" in out or "corrupt" in out
