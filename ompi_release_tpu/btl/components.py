@@ -70,6 +70,38 @@ def _unpack_array_header(buf):
     return dtype, shape
 
 
+def stashed_recv(oob_ep, want_src, tag: int, deadline: float):
+    """Next (src, payload) for ``tag``, matched by source: frames from
+    other senders interleaved on the same tag are stashed on the
+    endpoint (the OOB recv filters by tag only) and served to their own
+    consumer later — two concurrent transfers on one tag must not
+    corrupt each other. ``want_src=None`` takes the oldest stashed
+    frame from any source, else the next live frame from ``want_src``.
+
+    Shared by every consumer that multiplexes one OOB endpoint and tag
+    across multiple senders (the staged DCN path and the shm handoff).
+    """
+    import time as _time
+
+    stash = getattr(oob_ep, "_dcn_stash", None)
+    if stash is None:
+        stash = oob_ep._dcn_stash = {}
+    if want_src is None:
+        for (s, t), q in stash.items():
+            if t == tag and q:
+                return s, q.pop(0)
+    else:
+        q = stash.get((want_src, tag))
+        if q:
+            return want_src, q.pop(0)
+    while True:
+        left = max(1, int((deadline - _time.monotonic()) * 1000))
+        src, _, raw = oob_ep.recv(tag=tag, timeout_ms=left)
+        if want_src is None or src == want_src:
+            return src, raw
+        stash.setdefault((src, tag), []).append(raw)
+
+
 class SelfBtl(base.BtlModule):
     """Loopback: src == dst. Arrays are immutable; a self-send needs no
     copy at all (the reference's btl/self memcpys because its buffers
@@ -111,10 +143,15 @@ class IciBtl(base.BtlModule):
     EXCLUSIVITY = 1024
 
     def reachable(self, src_ep, dst_ep) -> bool:
+        # same controller process only: a peer PROCESS's devices are
+        # not addressable here even on the same slice — those pairs
+        # belong to shm/dcn (under a jax.distributed global runtime the
+        # SPMD collective path, not per-pair moves, crosses processes)
         return (
             src_ep.rank != dst_ep.rank
             and src_ep.platform == dst_ep.platform
             and src_ep.slice_index == dst_ep.slice_index
+            and src_ep.process_index == dst_ep.process_index
         )
 
     def move_segment(self, data, dst_device):
@@ -187,33 +224,7 @@ class DcnBtl(base.BtlModule):
         return jax.device_put(data, dst_device)
 
     # -- cross-process staged path (the honest multi-controller route) ----
-    @staticmethod
-    def _recv_from(oob_ep, want_src, tag: int, deadline: float):
-        """Next (src, payload) for ``tag``, matched by source: frames
-        from other senders interleaved on the same tag are stashed on
-        the endpoint (the OOB recv filters by tag only) and served to
-        their own transfer later — two concurrent staged transfers
-        must not corrupt each other. ``want_src=None`` takes the
-        oldest stashed source, else the next live frame."""
-        import time as _time
-
-        stash = getattr(oob_ep, "_dcn_stash", None)
-        if stash is None:
-            stash = oob_ep._dcn_stash = {}
-        if want_src is None:
-            for (s, t), q in stash.items():
-                if t == tag and q:
-                    return s, q.pop(0)
-        else:
-            q = stash.get((want_src, tag))
-            if q:
-                return want_src, q.pop(0)
-        while True:
-            left = max(1, int((deadline - _time.monotonic()) * 1000))
-            src, _, raw = oob_ep.recv(tag=tag, timeout_ms=left)
-            if want_src is None or src == want_src:
-                return src, raw
-            stash.setdefault((src, tag), []).append(raw)
+    _recv_from = staticmethod(stashed_recv)  # kept as the historical name
 
     def send_staged(self, oob_ep, peer_nid: int, tag: int, data) -> int:
         """Stream ``data`` to ``peer_nid`` over the OOB in
@@ -358,28 +369,121 @@ class ShmBtl(base.BtlModule):
             "_shm_bytes_pvar", "btl_shm_bytes",
             "bytes handed off through shm")
 
-    #: segments posted but (maybe) never consumed: (name, deadline).
-    #: A receiver that times out or dies never learns the name, so the
-    #: sender reaps expired segments on its next send — without this a
-    #: retry loop leaks /dev/shm until the host runs out. The TTL is
-    #: generous (4x the recv default) so a slow-but-live receiver is
-    #: never pulled out from under.
-    _pending_segments: list = []
-    _pending_lock = threading.Lock()
+    #: default TTL for posted-but-unconsumed segments; per-instance
+    #: (set ``module.SEGMENT_TTL_S`` to tune one module without
+    #: affecting other jobs' modules in the same process). Generous
+    #: (4x the recv default) so a slow-but-live receiver is never
+    #: pulled out from under.
     SEGMENT_TTL_S = 120.0
 
+    #: module-level reaper thread: wakes periodically and reaps every
+    #: live ShmBtl instance's expired segments, so a sender that STOPS
+    #: sending no longer leaks /dev/shm until process exit (reaping
+    #: used to happen only on the next send). Instances register in a
+    #: weak set — pending segments are per-instance state, so two jobs'
+    #: modules in one process never reap each other's segments early.
+    _reaper_lock = threading.Lock()
+    _reaper_thread = None
+    _instances = None  # weakref.WeakSet, created with the reaper
+
+    def __init__(self) -> None:
+        import weakref
+
+        #: segments posted but (maybe) never consumed: (name, deadline).
+        #: A receiver that times out or dies never learns the name, so
+        #: expired segments are reaped (on the next send and by the
+        #: timer thread) — without this a retry loop leaks /dev/shm
+        #: until the host runs out.
+        self._pending_segments: list = []
+        self._pending_lock = threading.Lock()
+        ShmBtl._register_for_reaping(self)
+        # a GC'd module must not take its pending records to the grave
+        # (per-comm modules die with their communicator; a one-shot
+        # `ShmBtl().send_shm(...)` dies immediately): at collection the
+        # records move — deadlines intact — to a class-level orphan
+        # list the timer thread keeps reaping. NOT unlinked eagerly:
+        # ownership already passed to the receiver, who may be about
+        # to map the segment; the TTL grace still applies.
+        weakref.finalize(
+            self, ShmBtl._adopt_orphans,
+            self._pending_segments, self._pending_lock,
+        )
+
+    #: (name, deadline) records inherited from GC'd modules; reaped by
+    #: the timer thread on the normal TTL schedule
+    _orphaned: list = []
+
     @classmethod
-    def _reap_orphaned_segments(cls) -> None:
+    def _adopt_orphans(cls, pending: list, lock) -> None:
+        with lock:
+            records = list(pending)
+            pending.clear()
+        with cls._reaper_lock:
+            cls._orphaned.extend(records)
+
+    @classmethod
+    def _reap_orphan_list(cls) -> None:
         import time as _time
 
         from multiprocessing import shared_memory
 
         now = _time.monotonic()
-        with cls._pending_lock:  # concurrent senders append in here
-            expired = [nd for nd in cls._pending_segments
+        with cls._reaper_lock:
+            expired = [nd for nd in cls._orphaned if now >= nd[1]]
+            cls._orphaned[:] = [nd for nd in cls._orphaned if now < nd[1]]
+        for name, _deadline in expired:
+            try:
+                seg = shared_memory.SharedMemory(name=name)
+                seg.close()
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+
+    @classmethod
+    def _register_for_reaping(cls, instance) -> None:
+        import weakref
+
+        with cls._reaper_lock:
+            if cls._instances is None:
+                cls._instances = weakref.WeakSet()
+            cls._instances.add(instance)
+            if cls._reaper_thread is None:
+                t = threading.Thread(
+                    target=cls._reaper_loop, daemon=True,
+                    name="shm-segment-reaper",
+                )
+                cls._reaper_thread = t
+                t.start()
+
+    @classmethod
+    def _reaper_loop(cls) -> None:
+        import time as _time
+
+        while True:
+            _time.sleep(5.0)
+            with cls._reaper_lock:
+                live = list(cls._instances) if cls._instances else []
+            for mod in live:
+                try:
+                    mod._reap_orphaned_segments()
+                except Exception:
+                    pass  # a reap failure must never kill the timer
+            try:
+                cls._reap_orphan_list()
+            except Exception:
+                pass
+
+    def _reap_orphaned_segments(self) -> None:
+        import time as _time
+
+        from multiprocessing import shared_memory
+
+        now = _time.monotonic()
+        with self._pending_lock:  # concurrent senders append in here
+            expired = [nd for nd in self._pending_segments
                        if now >= nd[1]]
-            cls._pending_segments[:] = [
-                nd for nd in cls._pending_segments if now < nd[1]
+            self._pending_segments[:] = [
+                nd for nd in self._pending_segments if now < nd[1]
             ]
         for name, _deadline in expired:
             try:  # consumed segments are already unlinked: ignore
@@ -430,10 +534,14 @@ class ShmBtl(base.BtlModule):
             )
         return name
 
-    def recv_shm(self, oob_ep, tag: int, *, dst_device=None,
+    def recv_shm(self, oob_ep, tag: int, *, src=None, dst_device=None,
                  timeout_ms: int = 30_000):
         """Map the announced segment, device_put out of it (the single
-        copy), unlink."""
+        copy), unlink. ``src`` filters control frames by sender node id
+        (frames from other senders on the same tag are stashed for
+        their own consumer — same discipline as the staged path)."""
+        import time as _time
+
         from multiprocessing import shared_memory
 
         import jax
@@ -441,7 +549,8 @@ class ShmBtl(base.BtlModule):
         from ..native import DssBuffer
 
         _check_user_tag(tag)
-        _, _, raw = oob_ep.recv(tag=tag, timeout_ms=timeout_ms)
+        deadline = _time.monotonic() + timeout_ms / 1000
+        _, raw = stashed_recv(oob_ep, src, tag, deadline)
         frame = DssBuffer(raw)
         name = frame.unpack_string()
         dtype, shape = _unpack_array_header(frame)
@@ -455,8 +564,19 @@ class ShmBtl(base.BtlModule):
                 f"shm segment '{name}' no longer exists (reaped after "
                 f"TTL or sender died) — the handoff frame is stale",
             )
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if any(d < 0 for d in shape) or nbytes < 0 or nbytes > seg.size:
+            # malformed/hostile control frame: do NOT unlink — the
+            # segment stays for the sender's TTL reaper, and the error
+            # is an MPI truncation, not a raw numpy ValueError
+            seg.close()
+            raise MPIError(
+                ErrorCode.ERR_TRUNCATE,
+                f"shm control frame claims {nbytes} bytes but segment "
+                f"'{name}' holds only {seg.size} — frame rejected, "
+                "segment left for the sender's TTL reaper",
+            )
         try:
-            nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
             view = np.frombuffer(seg.buf[:nbytes],
                                  dtype=dtype).reshape(shape)
             if dst_device is None:

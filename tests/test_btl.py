@@ -441,9 +441,11 @@ class TestShmHandoff:
             # segment exists while pending
             seg = shared_memory.SharedMemory(name=name)
             seg.close()
-            # force expiry, then any send reaps it
-            ShmBtl._pending_segments[:] = [
-                (n, 0.0) for n, _ in ShmBtl._pending_segments
+            # force expiry, then any send reaps it (pending segments
+            # are per-module-instance state: another job's module in
+            # this process could not reap ours early)
+            m._pending_segments[:] = [
+                (n, 0.0) for n, _ in m._pending_segments
             ]
             m.send_shm(b, 0, 178, np.ones(4, np.float32))
             with pytest.raises(FileNotFoundError):
