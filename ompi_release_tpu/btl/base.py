@@ -272,8 +272,15 @@ class BmlR2:
 
     def __init__(self, comm) -> None:
         self.comm = comm
+        # comm rank -> LOCAL device; under a unified multi-controller
+        # world only this process's members have devices here — cross-
+        # process pairs never get a BML endpoint (the wire pml routes
+        # them through the shm/dcn staged transports instead)
         flat = list(comm.submesh.devices.reshape(-1))
-        self._devices = flat
+        local = getattr(comm, "local_comm_ranks", None)
+        if local is None:
+            local = range(comm.size)
+        self._devices = {r: flat[i] for i, r in enumerate(local)}
         eps = {e.rank: e for e in comm.runtime.endpoints}
         self._eps = [
             eps[comm.group.world_rank(i)] for i in range(comm.size)
@@ -296,9 +303,17 @@ class BmlR2:
         key = (src_rank, dst_rank)
         ep = self._endpoints.get(key)
         if ep is None:
+            dst_device = self._devices.get(dst_rank)
+            if dst_device is None:
+                raise MPIError(
+                    ErrorCode.ERR_UNREACH,
+                    f"rank {dst_rank} belongs to another controller "
+                    "process — in-band BML moves cannot reach it; "
+                    "cross-process pairs route through the wire pml",
+                )
             ep = BmlEndpoint(
                 self._eps[src_rank], self._eps[dst_rank],
-                self._devices[dst_rank], self._modules,
+                dst_device, self._modules,
             )
             self._endpoints[key] = ep
         return ep

@@ -528,6 +528,19 @@ class ShmBtl(base.BtlModule):
         self.shm_bytes_pvar.add(arr.nbytes)
         name = seg.name
         seg.close()  # receiver owns the segment now
+        # ownership transferred: drop OUR resource_tracker registration
+        # or the tracker warns at exit about every segment the receiver
+        # unlinked (and would double-unlink ones it didn't). The
+        # receiver's attach registers in ITS tracker; our TTL reap
+        # re-attaches (re-registering) before unlinking — every path
+        # stays tracker-consistent. Cost: a segment orphaned by our
+        # death inside the TTL window outlives us in /dev/shm.
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(f"/{name}", "shared_memory")
+        except Exception:
+            pass  # tracker API is CPython-internal; never fail a send
         with self._pending_lock:
             self._pending_segments.append(
                 (name, _time.monotonic() + self.SEGMENT_TTL_S)

@@ -213,6 +213,8 @@ class XlaCollComponent(mca_component.Component):
     def query(self, ctx=None):
         if ctx is None:
             return (self.priority, self)
+        if getattr(ctx, "spans_processes", False):
+            return None  # cross-process comms belong to coll/hier
         return (self.priority, _XlaModule(ctx))
 
 
@@ -469,6 +471,8 @@ class TunedCollComponent(mca_component.Component):
     def query(self, ctx=None):
         if ctx is None:
             return (self.priority, self)
+        if getattr(ctx, "spans_processes", False):
+            return None  # cross-process comms belong to coll/hier
         return (self.priority, _TunedModule(ctx))
 
 
@@ -538,6 +542,8 @@ class BasicCollComponent(mca_component.Component):
     def query(self, ctx=None):
         if ctx is None:
             return (self.priority, self)
+        if getattr(ctx, "spans_processes", False):
+            return None  # cross-process comms belong to coll/hier
         return (self.priority, _BasicModule(ctx))
 
 
@@ -643,6 +649,8 @@ class SelfCollComponent(mca_component.Component):
     def query(self, ctx=None):
         if ctx is None:
             return (self.priority, self)
+        if getattr(ctx, "spans_processes", False):
+            return None  # a size-1 spanning comm has no local member
         if ctx.size == 1:
             return (1000, _SelfModule(ctx))  # claim size-1 comms outright
         return None
@@ -755,14 +763,19 @@ class MlCollComponent(mca_component.Component):
     def query(self, ctx=None):
         if ctx is None:
             return (self.priority, self)
+        if getattr(ctx, "spans_processes", False):
+            return None  # cross-process comms belong to coll/hier
         h = _discover_hierarchy(ctx)
         if h is None:
             return None
         return (self.priority, _MlModule(ctx, *h))
 
 
+from .hier import HierCollComponent  # noqa: E402  (registration order)
+
 COLL_FRAMEWORK.register(XlaCollComponent())
 COLL_FRAMEWORK.register(TunedCollComponent())
 COLL_FRAMEWORK.register(MlCollComponent())
 COLL_FRAMEWORK.register(BasicCollComponent())
 COLL_FRAMEWORK.register(SelfCollComponent())
+COLL_FRAMEWORK.register(HierCollComponent())

@@ -36,15 +36,22 @@ from .group import Group, UNDEFINED
 
 _log = output.stream("comm")
 _cid_counter = itertools.count(0)
+#: internal (runtime-private) communicators — e.g. the hier module's
+#: process-local shadow — draw NEGATIVE cids from a separate counter:
+#: their creation is conditional on local membership, so letting them
+#: consume the global counter would desynchronize cid allocation
+#: across controller processes (cids must agree SPMD-wide because the
+#: wire router addresses communicators by cid)
+_internal_cid_counter = itertools.count(-1, -1)
 _cid_lock = threading.Lock()
 _comm_registry: Dict[int, "Communicator"] = {}
 
 _comm_count = pvar.counter("comm_active_count", "live communicators")
 
 
-def _next_cid() -> int:
+def _next_cid(internal: bool = False) -> int:
     with _cid_lock:
-        return next(_cid_counter)
+        return next(_internal_cid_counter if internal else _cid_counter)
 
 
 def clear_comm_registry() -> None:
@@ -76,12 +83,13 @@ class Communicator:
 
     def __init__(self, runtime, group: Group, *, name: str = "",
                  parent: Optional["Communicator"] = None,
-                 topo: Optional[Any] = None) -> None:
+                 topo: Optional[Any] = None,
+                 internal: bool = False) -> None:
         from ..runtime.mesh import build_submesh  # local: avoid cycle
 
         self.runtime = runtime
         self.group = group
-        self.cid = _next_cid()
+        self.cid = _next_cid(internal)
         self.name = name or f"comm{self.cid}"
         self.errhandler: Errhandler = (
             parent.errhandler if parent else ERRORS_ARE_FATAL
@@ -95,15 +103,44 @@ class Communicator:
         self._attrs: Dict[int, Any] = {}
         self._freed = False
 
-        # sub-mesh over this group's devices, 1-D "rank" axis: collectives
-        # ride ICI in world-mesh order regardless of group order
-        self.submesh = build_submesh(runtime.mesh, group.world_ranks)
+        # Local membership: under a unified multi-controller world this
+        # process owns only a span of world ranks; the submesh (and
+        # every compiled collective) covers the LOCAL members, while
+        # cross-process traffic rides the wire (hier coll + wire pml).
+        # Single-controller: every member is local and nothing changes.
+        if getattr(runtime, "unified", False):
+            off = runtime.local_rank_offset
+            cnt = runtime.local_size
+            self.local_comm_ranks = [
+                i for i, wr in enumerate(group.world_ranks)
+                if off <= wr < off + cnt
+            ]
+            self.spans_processes = len(self.local_comm_ranks) < group.size
+            local_positions = [
+                group.world_rank(i) - off for i in self.local_comm_ranks
+            ]
+        else:
+            self.local_comm_ranks = list(range(group.size))
+            self.spans_processes = False
+            local_positions = list(group.world_ranks)
+
+        # sub-mesh over this group's LOCAL devices, 1-D "rank" axis:
+        # collectives ride ICI in world-mesh order regardless of group
+        # order (a comm with no local members carries no submesh and
+        # installs no engines — its operations are never invoked here)
+        if local_positions:
+            self.submesh = build_submesh(runtime.mesh, local_positions)
+        else:
+            self.submesh = None
 
         # per-comm collective table (c_coll analogue), installed at
         # creation time exactly like coll_base_comm_select
         from ..coll import base as coll_base
 
-        self.c_coll = coll_base.comm_select(self)
+        if self.submesh is not None:
+            self.c_coll = coll_base.comm_select(self)
+        else:
+            self.c_coll = {}
 
         _comm_registry[self.cid] = self
         _comm_count.add()
@@ -248,6 +285,13 @@ class Communicator:
         eng = getattr(self, "_pml", None)
         if eng is None:
             self._check_alive()
+            if self.submesh is None:
+                raise MPIError(
+                    ErrorCode.ERR_COMM,
+                    f"{self.name} has no members on this controller "
+                    "process — its operations can only be invoked on "
+                    "the processes that own its ranks",
+                )
             from ..p2p import pml as pml_mod
 
             eng = pml_mod.comm_select(self)
@@ -288,6 +332,14 @@ class Communicator:
         ``size``. Returns (values, statuses) lists.
         """
         self._check_alive()
+        if self.spans_processes:
+            raise MPIError(
+                ErrorCode.ERR_NOT_AVAILABLE,
+                "driver-mode sendrecv acts as every rank at once; on a "
+                "communicator spanning controller processes use "
+                "per-rank isend/recv (each process acts only as its "
+                "local ranks)",
+            )
         n = self.size
         if (len(sendbufs) != n or len(dests) != n
                 or (sources is not None and len(sources) != n)):

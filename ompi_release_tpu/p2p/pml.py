@@ -111,8 +111,6 @@ class PmlEngine:
         self._posted: Dict[int, Deque[_RecvEntry]] = (
             collections.defaultdict(collections.deque)
         )
-        flat = list(comm.submesh.devices.reshape(-1))
-        self._devices = flat  # rank -> device
         self._logger = None  # vprotocol message log, when attached
         # per-peer transfer plans through the btl framework (bml/r2)
         from ..btl import BmlR2
@@ -395,6 +393,190 @@ class PmlEngine:
             )
 
 
+class WirePmlEngine(PmlEngine):
+    """PML for communicators spanning controller processes: local pairs
+    use the in-process matching machinery unchanged; pairs crossing a
+    process boundary ride the runtime's wire router (shm handoff on one
+    host, DCN staging across hosts) — the ``btl/tcp``-under-ob1 role,
+    with no caller-visible API difference (``btl_tcp_component.c:883``).
+
+    Driver-mode contract: each process acts only as its LOCAL ranks —
+    an isend must name a local ``src``, a recv a local ``dst``. Wire
+    arrivals are pumped into the normal unexpected queues during
+    recv/probe progress, so ordering, ANY_SOURCE/ANY_TAG and matched
+    probes keep their MPI semantics across the boundary.
+    """
+
+    def __init__(self, comm) -> None:
+        super().__init__(comm)
+        self._router = comm.runtime.wire
+        self._local_set = set(comm.local_comm_ranks)
+
+    def _require_local(self, rank: int, what: str) -> None:
+        if rank not in self._local_set:
+            owner = self._router.owner_of(self.comm.group.world_rank(rank))
+            raise MPIError(
+                ErrorCode.ERR_RANK,
+                f"{what} rank {rank} on {self.comm.name} is owned by "
+                f"process {owner}; each process acts only as its local "
+                "ranks (the acting-rank driver convention)",
+            )
+
+    # -- send --------------------------------------------------------------
+    def isend(self, data, dst: int, tag: int = 0, *, src: int,
+              sync: bool = False, ready: bool = False) -> Request:
+        self._check_rank(dst, "destination")
+        self._check_rank(src, "source")
+        self._require_local(src, "acting source")
+        if dst in self._local_set:
+            return super().isend(data, dst, tag, src=src, sync=sync,
+                                 ready=ready)
+        # cross-process: rsend legally degrades to a standard send (an
+        # implementation MAY treat ready mode as standard; verifying
+        # the remote posted-recv would cost a round trip)
+        import jax.numpy as jnp
+
+        data = jnp.asarray(data)
+        from . import peruse
+
+        peruse.fire(self.comm, peruse.REQ_ACTIVATE, kind="send",
+                    src=src, dst=dst, tag=tag)
+        if self._logger is not None:
+            with self._lock:
+                self._logger.record(src, dst, tag, data, sync)
+        import numpy as _np
+
+        seq = self._router.send_p2p(self.comm, src, dst, tag,
+                                    _np.asarray(data), sync)
+        if not sync:
+            req = Request()
+            req.complete(status=Status(source=src, tag=tag))
+            return req
+        # ssend: completes when the receiver's match acks back
+        router, cid = self._router, self.comm.cid
+        src_world = self.comm.group.world_rank(src)
+
+        def progress(r) -> None:
+            router.poll_acks(src_world)
+            if router.has_ack(cid, seq):
+                router.take_ack(cid, seq)
+                r.complete(status=Status(source=src, tag=tag))
+
+        def block() -> None:
+            import time as _time
+
+            deadline = _time.monotonic() + 30.0
+            while _time.monotonic() < deadline:
+                router.poll_acks(src_world, timeout_ms=100)
+                if router.take_ack(cid, seq):
+                    return
+            raise MPIError(
+                ErrorCode.ERR_PENDING,
+                f"ssend to rank {dst} never matched (no ack within "
+                "30s)",
+            )
+
+        req = Request(progress_fn=progress, block_fn=block)
+        # the block() completion path reaches Request.wait()'s bare
+        # complete(): pre-set the status so both completion paths
+        # report the same (source, tag)
+        req.status = Status(source=src, tag=tag)
+        return req
+
+    # -- recv --------------------------------------------------------------
+    def _drain(self, dst: int, timeout_ms: int = 0) -> bool:
+        return self._router.drain_p2p(
+            self.comm.group.world_rank(dst), timeout_ms=max(1, timeout_ms)
+        )
+
+    def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG, *,
+              dst: int) -> Request:
+        self._check_rank(dst, "destination")
+        self._require_local(dst, "receiving")
+        may_cross = source == ANY_SOURCE or source not in self._local_set
+        if may_cross:
+            # pump anything already queued before posting, so an
+            # earlier wire arrival matches in order
+            while self._drain(dst):
+                pass
+        req = super().irecv(source, tag, dst=dst)
+        if may_cross and not req.is_complete:
+            engine = self
+
+            def progress(r) -> None:
+                engine._drain(dst)
+
+            def block() -> None:
+                import time as _time
+
+                deadline = _time.monotonic() + 30.0
+                while (not req.is_complete
+                       and _time.monotonic() < deadline):
+                    engine._drain(dst, timeout_ms=100)
+                if not req.is_complete:
+                    raise MPIError(
+                        ErrorCode.ERR_PENDING,
+                        f"recv(source={source}, tag={tag}) at rank "
+                        f"{dst}: no matching message within 30s",
+                    )
+
+            req._progress_fn = progress
+            req._block_fn = block
+        return req
+
+    def iprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG, *,
+               dst: int):
+        self._require_local(dst, "probing")
+        while self._drain(dst):
+            pass
+        return super().iprobe(source, tag, dst=dst)
+
+    def improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG, *,
+                dst: int):
+        self._require_local(dst, "probing")
+        while self._drain(dst):
+            pass
+        return super().improbe(source, tag, dst=dst)
+
+    # -- wire delivery (called by the router's drain) ----------------------
+    def _enqueue_wire(self, src_rank: int, dst_rank: int, user_tag: int,
+                      data, on_matched=None) -> None:
+        """Insert one wire arrival into the matching machinery exactly
+        where a local eager send would land (payload already moved, so
+        the entry is 'transferred')."""
+        from . import peruse
+
+        req = Request()
+        if on_matched is not None:
+            req.on_complete(on_matched)
+        entry = _SendEntry(src_rank, dst_rank, user_tag, data, req, False)
+        entry.transferred = True
+        with self._lock:
+            if self._logger is not None:
+                # a wire arrival IS a send landing in this process's
+                # queues: log it under the matching lock exactly like a
+                # local isend, or pessimist-log replay would deliver
+                # fewer messages than the original run
+                self._logger.record(src_rank, dst_rank, user_tag, data,
+                                    False)
+            self._purge_cancelled(dst_rank)
+            posted = self._posted[dst_rank]
+            match = next(
+                (r for r in posted
+                 if (r.source in (ANY_SOURCE, src_rank))
+                 and _tag_match(r.tag, user_tag)),
+                None,
+            )
+            if match is not None:
+                posted.remove(match)
+                self._deliver(entry, match)
+                return
+            _unexpected_count.add()
+            self._unexpected[dst_rank].append(entry)
+        peruse.fire(self.comm, peruse.MSG_UNEX_INSERT, src=src_rank,
+                    dst=dst_rank, tag=user_tag)
+
+
 class Ob1TpuComponent(mca_component.Component):
     """Default PML component ("ob1" kept as the name users know)."""
 
@@ -407,6 +589,8 @@ class Ob1TpuComponent(mca_component.Component):
     def query(self, ctx=None):
         if ctx is None:
             return (self.priority, self)
+        if getattr(ctx, "spans_processes", False):
+            return (self.priority, WirePmlEngine(ctx))
         return (self.priority, PmlEngine(ctx))
 
 

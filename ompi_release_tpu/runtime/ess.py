@@ -135,10 +135,23 @@ class TpurunEss(mca_component.Component):
             "pid": os.getpid(),
             "host": socket.gethostname(),  # shm-reachability identity
             "local_device_count": jax.local_device_count(),
+            "platform": jax.local_devices()[0].platform,
         }
         cards = agent.run_modex(card)  # launcher mode: workers only
         agent.setup_tree(num_workers + 1, cards)
-        agent.barrier()  # every tree edge live; init gate
+        # FULL wire-up (superset of the tree edges): connect to every
+        # lower-id peer so ANY worker pair holds a live OOB link — the
+        # data plane the unified COMM_WORLD's cross-process transports
+        # (runtime/wire.py) ride. Lower id dials, higher id sends over
+        # the accepted fd (the same asymmetry tree links use), and the
+        # init barrier below gates until every link is live.
+        parent = coord.binomial_parent(node_id)
+        for nid in range(1, node_id):
+            if nid == parent:
+                continue  # tree link already exists
+            peer = cards[nid - 1]
+            agent.ep.connect(nid, peer["oob_host"], int(peer["oob_port"]))
+        agent.barrier()  # every tree+wire edge live; init gate
         agent.start_heartbeats(
             float(mca_var.get("ess_tpurun_heartbeat_interval", 0.5))
         )

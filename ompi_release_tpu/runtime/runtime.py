@@ -47,6 +47,14 @@ class Runtime:
         self.self_comm = None
         self.initialized = False
         self.finalized = False
+        # unified multi-controller world (tpurun): this process owns
+        # world ranks [local_rank_offset, local_rank_offset+local_size)
+        # and reaches every other process's ranks through the wire
+        self.unified = False
+        self.local_rank_offset = 0
+        self.local_size = 0
+        self.proc_spans: List[tuple] = []
+        self.wire = None  # WireRouter when unified
 
     # -- lifecycle ---------------------------------------------------------
     @classmethod
@@ -79,6 +87,13 @@ class Runtime:
                 "runtime_abort_on_error", "bool", True,
                 "Abort the process on unhandled MPI errors "
                 "(MPI_ERRORS_ARE_FATAL default)",
+            )
+            mca_var.register(
+                "runtime_unified_world", "bool", True,
+                "Under tpurun, form ONE COMM_WORLD spanning every "
+                "worker process (cross-process ranks reachable through "
+                "the wire router); false = each process's world spans "
+                "only its local devices (pre-unification behavior)",
             )
             if cli_args:
                 pairs = _parse_mca_cli(cli_args)
@@ -119,7 +134,16 @@ class Runtime:
             peer_cards = self.bootstrap.get("peer_cards") or []
             import jax as _jax
 
-            if (peer_cards and _jax.process_count() > 1
+            unified = (
+                self.agent is not None
+                and len(peer_cards) > 1
+                and bool(mca_var.get("runtime_unified_world", True))
+                and _jax.process_count() == 1  # separate controllers
+                and all("local_device_count" in c for c in peer_cards)
+            )
+            if unified:
+                self._build_unified_world(peer_cards)
+            elif (peer_cards and _jax.process_count() > 1
                     and len(peer_cards) == _jax.process_count()
                     and any("host" in c for c in peer_cards)):
                 import dataclasses as _dc
@@ -145,6 +169,69 @@ class Runtime:
                 f"{self.mesh.devices.shape} mesh",
             )
             return self.world
+
+    def _build_unified_world(self, peer_cards: List[Dict]) -> None:
+        """Form the union world: every process's devices become world
+        ranks (process p owns a contiguous span), with peer-process
+        ranks represented by endpoints synthesized from their modex
+        cards — the ``add_procs``-over-all-peers step of
+        ``ompi_mpi_init.c:759-786``. Cross-process pairs are reached
+        through the wire router (shm handoff on one host, DCN staging
+        across hosts), never by a fake ``device_put``."""
+        import dataclasses as _dc
+
+        from .wire import WireRouter
+
+        my_pidx = int(self.bootstrap["process_index"])
+        counts = [int(c["local_device_count"]) for c in peer_cards]
+        local_eps = self.endpoints
+        if counts[my_pidx] != len(local_eps):
+            raise MPIError(
+                ErrorCode.ERR_OTHER,
+                f"unified world needs the full local device set: modex "
+                f"card advertised {counts[my_pidx]} devices but the "
+                f"mesh holds {len(local_eps)} (explicit device subsets "
+                "are incompatible with runtime_unified_world)",
+            )
+        offsets = [0] * len(counts)
+        for p in range(1, len(counts)):
+            offsets[p] = offsets[p - 1] + counts[p - 1]
+        endpoints: List[mesh_mod.Endpoint] = []
+        for p, card in enumerate(peer_cards):
+            if p == my_pidx:
+                endpoints.extend(
+                    _dc.replace(ep, rank=offsets[p] + ep.rank,
+                                process_index=p)
+                    for ep in local_eps
+                )
+            else:
+                endpoints.extend(
+                    mesh_mod.Endpoint(
+                        rank=offsets[p] + li,
+                        device_id=li,
+                        process_index=p,
+                        platform=str(card.get("platform", "unknown")),
+                        device_kind="peer-process",
+                        coords=(li,),
+                        slice_index=0,
+                        host=str(card.get("host", "")),
+                    )
+                    for li in range(counts[p])
+                )
+        self.endpoints = endpoints
+        self.unified = True
+        self.local_rank_offset = offsets[my_pidx]
+        self.local_size = counts[my_pidx]
+        self.proc_spans = [(offsets[p], counts[p])
+                           for p in range(len(counts))]
+        self.wire = WireRouter(self)
+        _log.verbose(
+            1,
+            f"unified world: {sum(counts)} ranks over "
+            f"{len(counts)} processes; local span "
+            f"[{self.local_rank_offset}, "
+            f"{self.local_rank_offset + self.local_size})",
+        )
 
     def finalize(self) -> None:
         with _lock:

@@ -1,0 +1,311 @@
+"""Cross-process data plane — the unified-COMM_WORLD wire router.
+
+The reference's core runtime promise is that after launch every rank
+reaches every rank through one API: ``ompi_mpi_init.c:759-786`` calls
+``add_procs`` over *all* peers, and an ``MPI_Send`` crosses nodes
+through ``btl/tcp`` (``btl_tcp_component.c:883-893``) with no
+caller-visible difference from shared memory. Under ``tpurun`` each
+worker process owns only its local jax devices, so cross-process
+traffic cannot be a ``device_put`` — it rides the honest transports:
+:class:`~..btl.components.ShmBtl` single-segment handoffs on the same
+host, :class:`~..btl.components.DcnBtl` chunked OOB staging across
+hosts. This router is the glue that lets the PML and the hierarchical
+collectives use those transports *through the public API*:
+
+- every worker holds a live OOB link to every peer (full wire-up runs
+  during the ESS bootstrap, gated by the init barrier);
+- p2p messages are an envelope frame (cid, src/dst comm ranks, user
+  tag, sync flag, seq) followed by the btl payload on a per-destination
+  channel tag — the receiving process drains its channels into the
+  normal PML matching queues, so ordering and wildcards keep MPI
+  semantics;
+- collectives get per-communicator payload and control channels used
+  by the ``hier`` coll component for the inter-process combine step.
+
+Channel tags live far above ``USER_TAG_BASE`` so they can never shadow
+the coordinator/pubsub control plane or hand-rolled staged transfers.
+
+Thread model: driver-mode processes issue wire operations from the
+main thread (plus completion threads polling acks); the ack set and
+sequence counter are lock-protected, payload channels rely on the
+per-(src, tag) FIFO the OOB provides plus the shared stash in
+``btl.components.stashed_recv``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..native import DssBuffer
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+
+_log = output.stream("wire")
+
+#: p2p envelope+payload channel: + destination WORLD rank
+WIRE_P2P_BASE = 1 << 20
+#: ssend acknowledgements: + the original sender's WORLD rank
+WIRE_ACK_BASE = 2 << 20
+#: per-communicator collective payload channel: + cid
+WIRE_COLL_BASE = 3 << 20
+#: per-communicator collective control channel (barrier tokens): + cid
+WIRE_CTL_BASE = 4 << 20
+
+_ENV_MAGIC = "WPM1"
+
+
+class WireRouter:
+    """Per-runtime cross-process router over the worker's OOB endpoint."""
+
+    def __init__(self, runtime) -> None:
+        from ..btl.components import DcnBtl, ShmBtl
+
+        self.rt = runtime
+        self.agent = runtime.agent
+        self.ep = self.agent.ep
+        self.cards: List[Dict[str, Any]] = runtime.bootstrap["peer_cards"]
+        self.my_pidx: int = runtime.bootstrap["process_index"]
+        # rank spans: process p owns world ranks [offset, offset+count)
+        self.spans: List[Tuple[int, int]] = runtime.proc_spans
+        self._shm = ShmBtl()
+        self._dcn = DcnBtl()
+        self._seq = itertools.count(1)
+        self._acks: set = set()
+        self._ack_lock = threading.Lock()
+
+    # -- identity ----------------------------------------------------------
+    @staticmethod
+    def _nid(pidx: int) -> int:
+        return pidx + 1  # worker node ids are 1-based (0 is the HNP)
+
+    def owner_of(self, world_rank: int) -> int:
+        for p, (off, cnt) in enumerate(self.spans):
+            if off <= world_rank < off + cnt:
+                return p
+        raise MPIError(ErrorCode.ERR_RANK,
+                       f"world rank {world_rank} outside every span")
+
+    def _btl_for(self, peer_pidx: int):
+        """Transport choice, deterministic on BOTH sides: same machine
+        (modex card host identity) -> shm handoff, else DCN staging —
+        exactly the per-peer eligibility add_procs computes from
+        business cards (``btl.h:810-816``)."""
+        same_host = (
+            self.cards[self.my_pidx].get("host")
+            and self.cards[self.my_pidx].get("host")
+            == self.cards[peer_pidx].get("host")
+        )
+        return self._shm if same_host else self._dcn
+
+    # -- payload channel ---------------------------------------------------
+    def _retry(self, fn, what: str):
+        """First contact over an accepted fd can race the peer's
+        announce processing on our reader thread (the same window
+        recv_xcast retries around) — back off briefly before treating
+        the link as dead."""
+        last = None
+        for attempt in range(5):
+            try:
+                return fn()
+            except MPIError as e:
+                last = e
+                time.sleep(0.05 * (attempt + 1))
+        raise MPIError(ErrorCode.ERR_UNREACH,
+                       f"{what} failed after retries: {last}")
+
+    def _send_payload(self, peer_pidx: int, tag: int, arr) -> None:
+        btl = self._btl_for(peer_pidx)
+        arr = np.asarray(arr)
+        if btl is self._shm:
+            self._retry(
+                lambda: btl.send_shm(self.ep, self._nid(peer_pidx), tag,
+                                     arr),
+                f"shm handoff to process {peer_pidx}",
+            )
+        else:
+            self._retry(
+                lambda: btl.send_staged(self.ep, self._nid(peer_pidx),
+                                        tag, arr),
+                f"staged transfer to process {peer_pidx}",
+            )
+
+    def _recv_payload(self, tag: int, src_pidx: int,
+                      timeout_ms: int = 30_000):
+        btl = self._btl_for(src_pidx)
+        if btl is self._shm:
+            return btl.recv_shm(self.ep, tag, src=self._nid(src_pidx),
+                                timeout_ms=timeout_ms)
+        return btl.recv_staged(self.ep, tag, src=self._nid(src_pidx),
+                               timeout_ms=timeout_ms)
+
+    # -- p2p (the PML's cross-process route) -------------------------------
+    def send_p2p(self, comm, src_rank: int, dst_rank: int, user_tag: int,
+                 data, sync: bool) -> int:
+        """Envelope + payload to the process owning ``dst_rank``.
+        Ranks in the envelope are COMM-local (matching happens against
+        the destination comm's queues); the channel is keyed by the
+        destination's WORLD rank so every comm shares one ordered
+        stream per destination."""
+        dst_world = comm.group.world_rank(dst_rank)
+        peer = self.owner_of(dst_world)
+        seq = next(self._seq)
+        tag = WIRE_P2P_BASE + dst_world
+        env = DssBuffer()
+        env.pack_string(_ENV_MAGIC)
+        env.pack_int64([comm.cid, src_rank, dst_rank, int(user_tag),
+                        1 if sync else 0, seq])
+        self._retry(
+            lambda: self.ep.send(self._nid(peer), tag, env.tobytes()),
+            f"p2p envelope to process {peer}",
+        )
+        self._send_payload(peer, tag, np.asarray(data))
+        return seq
+
+    def drain_p2p(self, dst_world_rank: int, timeout_ms: int = 50) -> bool:
+        """Receive at most ONE wire message destined to
+        ``dst_world_rank`` and push it into the owning communicator's
+        PML matching queues. Returns True if a message was delivered.
+
+        ``timeout_ms`` bounds only the wait for an ENVELOPE; once one
+        is popped, its payload is consumed to completion — the sender
+        wrote it immediately behind the envelope on the same FIFO, so
+        the stall is bounded by the in-flight transfer, not by user
+        behavior (head-of-line blocking per destination channel; a
+        nonblocking probe can stall for the tail of a large in-flight
+        message). A sender dying between envelope and payload surfaces
+        as a loud ERR_TRUNCATE here, never a silently dropped message.
+        """
+        from ..btl.components import stashed_recv
+        from ..comm.communicator import _comm_registry
+
+        tag = WIRE_P2P_BASE + dst_world_rank
+        deadline = time.monotonic() + timeout_ms / 1000
+        try:
+            src_nid, raw = stashed_recv(self.ep, None, tag, deadline)
+        except MPIError:
+            return False  # nothing pending within the timeout
+        env = DssBuffer(raw)
+        if env.unpack_string() != _ENV_MAGIC:
+            _log.verbose(1, f"dropping non-envelope frame on p2p "
+                            f"channel {tag}")
+            return False
+        cid, src_rank, dst_rank, user_tag, sync, seq = env.unpack_int64(6)
+        src_pidx = src_nid - 1
+        try:
+            data = self._recv_payload(tag, src_pidx)
+        except MPIError as e:
+            raise MPIError(
+                ErrorCode.ERR_TRUNCATE,
+                f"wire message from process {src_pidx} (comm cid {cid}, "
+                f"src rank {src_rank}, tag {user_tag}) announced by its "
+                f"envelope but the payload never completed — peer died "
+                f"mid-transfer? ({e})",
+            )
+        comm = _comm_registry.get(int(cid))
+        if comm is None:
+            raise MPIError(
+                ErrorCode.ERR_COMM,
+                f"wire message for unknown cid {cid} (communicator "
+                "creation order diverged across processes?)",
+            )
+        on_matched = None
+        if sync:
+            src_world = comm.group.world_rank(int(src_rank))
+
+            def on_matched(_req, _p=src_pidx, _c=int(cid), _s=int(seq),
+                           _w=src_world):
+                self.send_ack(_p, _c, _s, _w)
+
+        comm.pml._enqueue_wire(int(src_rank), int(dst_rank),
+                               int(user_tag), data, on_matched=on_matched)
+        return True
+
+    # -- ssend acknowledgements --------------------------------------------
+    def send_ack(self, peer_pidx: int, cid: int, seq: int,
+                 sender_world_rank: int) -> None:
+        b = DssBuffer()
+        b.pack_int64([cid, seq])
+        self._retry(
+            lambda: self.ep.send(self._nid(peer_pidx),
+                                 WIRE_ACK_BASE + sender_world_rank,
+                                 b.tobytes()),
+            f"ssend ack to process {peer_pidx}",
+        )
+
+    def poll_acks(self, sender_world_rank: int,
+                  timeout_ms: int = 0) -> None:
+        """Drain every available ack addressed to ``sender_world_rank``
+        into the ack set (nonblocking when timeout_ms=0)."""
+        tag = WIRE_ACK_BASE + sender_world_rank
+        while True:
+            try:
+                _, _, raw = self.ep.recv(tag=tag,
+                                         timeout_ms=max(1, timeout_ms))
+            except MPIError:
+                return
+            cid, seq = DssBuffer(raw).unpack_int64(2)
+            with self._ack_lock:
+                self._acks.add((int(cid), int(seq)))
+            timeout_ms = 0  # only the first recv may wait
+
+    def has_ack(self, cid: int, seq: int) -> bool:
+        with self._ack_lock:
+            return (cid, seq) in self._acks
+
+    def take_ack(self, cid: int, seq: int) -> bool:
+        with self._ack_lock:
+            if (cid, seq) in self._acks:
+                self._acks.discard((cid, seq))
+                return True
+            return False
+
+    # -- collective channels (used by the hier coll component) -------------
+    @staticmethod
+    def _coll_tag(comm) -> int:
+        if comm.cid >= (1 << 20):
+            raise MPIError(ErrorCode.ERR_INTERN,
+                           f"cid {comm.cid} exceeds the wire tag space")
+        return WIRE_COLL_BASE + comm.cid
+
+    def coll_send(self, comm, peer_pidx: int, arr) -> None:
+        self._send_payload(peer_pidx, self._coll_tag(comm), arr)
+
+    def coll_recv(self, comm, src_pidx: int, timeout_ms: int = 60_000):
+        return self._recv_payload(self._coll_tag(comm), src_pidx,
+                                  timeout_ms=timeout_ms)
+
+    def ctl_send(self, comm, peer_pidx: int, payload: bytes = b"") -> None:
+        self._retry(
+            lambda: self.ep.send(self._nid(peer_pidx),
+                                 WIRE_CTL_BASE + comm.cid, payload),
+            f"ctl token to process {peer_pidx}",
+        )
+
+    def ctl_recv(self, comm, src_pidx: int,
+                 timeout_ms: int = 60_000) -> bytes:
+        from ..btl.components import stashed_recv
+
+        deadline = time.monotonic() + timeout_ms / 1000
+        _, raw = stashed_recv(self.ep, self._nid(src_pidx),
+                              WIRE_CTL_BASE + comm.cid, deadline)
+        return raw
+
+    def proc_barrier(self, comm, procs: List[int],
+                     timeout_ms: int = 60_000) -> None:
+        """Dissemination barrier among the participating processes
+        (log2 rounds of token exchange on the comm's control channel)."""
+        p = len(procs)
+        if p <= 1:
+            return
+        me = procs.index(self.my_pidx)
+        k = 1
+        while k < p:
+            self.ctl_send(comm, procs[(me + k) % p])
+            self.ctl_recv(comm, procs[(me - k) % p],
+                          timeout_ms=timeout_ms)
+            k <<= 1
