@@ -35,6 +35,20 @@ def _per_rank_bytes(x) -> int:
     return int(per_rank.size * per_rank.dtype.itemsize)
 
 
+def _resolve_op(op: Op, x) -> Op:
+    """Accelerated-kernel resolution for the local-reduction step of a
+    hand-scheduled algorithm (the ``ompi/mca/op`` select): the pallas
+    component claims large contiguous f32/bf16 SUMs, everything else
+    stays on the XLA combiner. Resolved op names differ (``sum`` vs
+    ``sum[pallas]``), so the compiled-program cache keys — which embed
+    the op name — never mix the two kernels."""
+    from ..ops import op as op_mod
+
+    if op.is_pair_op or not hasattr(x, "dtype"):
+        return op
+    return op_mod.resolve(op, x.dtype, _per_rank_bytes(x))
+
+
 # ---------------------------------------------------------------------------
 # xla component — lower straight to XLA collectives
 # ---------------------------------------------------------------------------
@@ -283,6 +297,7 @@ class _TunedModule:
         if op.is_pair_op:
             return None  # pair ops stay with xla's gather path
         alg = self._pick_allreduce(x, op)
+        op = _resolve_op(op, x)  # accelerated local-reduction kernel
         n = comm.size
         segsize = mca_var.get("coll_tuned_segment_size", 1 << 20)
         seg_elems = max(1, segsize // x.dtype.itemsize)
@@ -324,6 +339,7 @@ class _TunedModule:
         n = comm.size
         if not op.commutative:
             return None  # defer to a lower-priority linear implementation
+        op = _resolve_op(op, x)
 
         def body(xb):
             red = spmd.reduce_binomial(xb, op, AXIS, n, root)
@@ -350,6 +366,7 @@ class _TunedModule:
         n = comm.size
         if not op.commutative:
             return None
+        op = _resolve_op(op, x)
 
         # reduce_scatter_ring blocks the flat per-rank buffer itself
         def body(xb):
@@ -499,6 +516,7 @@ class _BasicModule:
         if op.is_pair_op:
             return None
         n = comm.size
+        op = _resolve_op(op, x)
         return run_sharded(
             comm, ("basic", "allreduce", op.name),
             lambda xb: spmd.allreduce_basic_linear(xb, op, AXIS, n), x,
@@ -506,6 +524,7 @@ class _BasicModule:
 
     def reduce(self, comm, x, op: Op, root: int):
         n = comm.size
+        op = _resolve_op(op, x)
 
         def body(xb):
             red = spmd.allreduce_basic_linear(xb, op, AXIS, n)
@@ -705,20 +724,89 @@ class _MlModule:
     def fns(self) -> Dict[str, Callable]:
         return {
             "allreduce": self.allreduce,
+            "reduce": self.reduce,
             "bcast": self.bcast,
+            "allgather": self.allgather,
+            "reduce_scatter_block": self.reduce_scatter_block,
+            "alltoall": self.alltoall,
             "barrier": self.barrier,
         }
 
+    def _reducible(self, op: Op) -> bool:
+        return not (op.is_pair_op or op.identity is None
+                    or not op.commutative)
+
     def allreduce(self, comm, x, op: Op):
-        if op.is_pair_op or op.identity is None or not op.commutative:
+        if not self._reducible(op):
             return None  # defer to lower-priority providers
         from .driver import run_sharded2d
 
+        op = _resolve_op(op, x)
         body = lambda xb: spmd.allreduce_two_level(
             xb, op, "local", "node", self.intra
         )
         return run_sharded2d(
             comm, ("ml", "allreduce", op.name, self.inter, self.intra),
+            body, x, inter=self.inter, intra=self.intra,
+        )
+
+    def reduce(self, comm, x, op: Op, root: int):
+        if not self._reducible(op):
+            return None
+        from .driver import run_sharded2d
+
+        op = _resolve_op(op, x)
+        body = lambda xb: spmd.reduce_two_level(
+            xb, op, "local", "node", root, self.intra
+        )
+        return run_sharded2d(
+            comm, ("ml", "reduce", op.name, root, self.inter, self.intra),
+            body, x, inter=self.inter, intra=self.intra,
+        )
+
+    def allgather(self, comm, x):
+        from .driver import run_sharded2d
+
+        def body(xb):
+            g = spmd.allgather_two_level(xb, "local", "node")
+            return g.reshape((-1,) + g.shape[2:])
+
+        return run_sharded2d(
+            comm, ("ml", "allgather", self.inter, self.intra),
+            body, x, inter=self.inter, intra=self.intra,
+        )
+
+    def reduce_scatter_block(self, comm, x, op: Op):
+        if not self._reducible(op):
+            return None
+        from .driver import run_sharded2d
+
+        op = _resolve_op(op, x)
+        n = comm.size
+        body = lambda xb: spmd.reduce_scatter_two_level(
+            xb, op, "local", "node", self.intra, n
+        )
+        return run_sharded2d(
+            comm,
+            ("ml", "reduce_scatter_block", op.name, self.inter,
+             self.intra),
+            body, x, inter=self.inter, intra=self.intra,
+        )
+
+    def alltoall(self, comm, x):
+        from .driver import run_sharded2d
+
+        n = comm.size
+
+        def body(xb):
+            blocks = xb.reshape((n, -1) + xb.shape[1:])
+            out = spmd.alltoall_two_level(
+                blocks, "local", "node", self.intra, self.inter
+            )
+            return out.reshape(xb.shape)
+
+        return run_sharded2d(
+            comm, ("ml", "alltoall", self.inter, self.intra),
             body, x, inter=self.inter, intra=self.intra,
         )
 

@@ -96,6 +96,11 @@ class _HierModule:
             rt, Group([comm.group.world_rank(i) for i in self.local_ranks]),
             name=f"{comm.name}.local", internal=True,
         )
+        # the shadow lives exactly as long as its owner: freeing the
+        # spanning comm frees it (no registry leak per create/free)
+        comm._on_free = tuple(getattr(comm, "_on_free", ())) + (
+            self.shadow.free,
+        )
 
     # -- plumbing ----------------------------------------------------------
     @property

@@ -488,6 +488,65 @@ def bcast_two_level(x: jax.Array, intra_axis: str, inter_axis: str,
     return lax.psum(lax.psum(contrib, intra_axis), inter_axis)
 
 
+def reduce_two_level(x: jax.Array, op: Op, intra_axis: str,
+                     inter_axis: str, root: int, intra_n: int
+                     ) -> jax.Array:
+    """Hierarchical rooted reduce: the two-level allreduce (which
+    already cuts inter-domain traffic to 1/intra_n) masked to the
+    root's position — the ml compose of bcol reduce primitives."""
+    red = allreduce_two_level(x, op, intra_axis, inter_axis, intra_n)
+    root_node, root_local = divmod(root, intra_n)
+    is_root = ((lax.axis_index(inter_axis) == root_node)
+               & (lax.axis_index(intra_axis) == root_local))
+    return jnp.where(is_root, red, jnp.zeros_like(red))
+
+
+def allgather_two_level(x: jax.Array, intra_axis: str, inter_axis: str
+                        ) -> jax.Array:
+    """Hierarchical allgather: gather inside the fast domain first,
+    then exchange the per-domain aggregates across the slow domain —
+    inter-domain messages carry whole-domain blocks (intra_n ranks per
+    message instead of one), the recursive-doubling-on-aggregates
+    shape of ml's allgather. Returns (n, chunk...) in rank order
+    (rank = node * intra_n + local, node-major like run_sharded2d)."""
+    g_local = lax.all_gather(x, intra_axis, axis=0)   # (intra_n, ...)
+    g = lax.all_gather(g_local, inter_axis, axis=0)   # (inter_n, intra_n, ...)
+    return g.reshape((-1,) + g.shape[2:])
+
+
+def reduce_scatter_two_level(x: jax.Array, op: Op, intra_axis: str,
+                             inter_axis: str, intra_n: int, n: int
+                             ) -> jax.Array:
+    """Hierarchical reduce_scatter_block: two-level allreduce, then
+    each rank keeps its own chunk. Inter traffic = the allreduce's
+    1/intra_n-reduced volume."""
+    red = allreduce_two_level(x, op, intra_axis, inter_axis, intra_n)
+    rank = (lax.axis_index(inter_axis) * intra_n
+            + lax.axis_index(intra_axis))
+    chunks = red.reshape((n, -1) + red.shape[1:])
+    return jnp.take(chunks, rank, axis=0)
+
+
+def alltoall_two_level(blocks: jax.Array, intra_axis: str,
+                       inter_axis: str, intra_n: int, inter_n: int
+                       ) -> jax.Array:
+    """Hierarchical alltoall: factor the all-pairs exchange into an
+    inter-domain alltoall of whole-domain super-blocks followed by an
+    intra-domain alltoall — each slow-domain message aggregates
+    intra_n**2 rank-pair blocks (the xhc/ml aggregation idea).
+
+    ``blocks``: (n, chunk...) — row j is this rank's block for comm
+    rank j (node-major rank order). Returns (n, chunk...) with row i =
+    the block rank i sent to this rank.
+    """
+    b = blocks.reshape((inter_n, intra_n) + blocks.shape[1:])
+    # exchange super-blocks across nodes: dim0 becomes SOURCE node
+    b = lax.all_to_all(b, inter_axis, split_axis=0, concat_axis=0)
+    # exchange within the fast domain: dim1 becomes SOURCE local rank
+    b = lax.all_to_all(b, intra_axis, split_axis=1, concat_axis=1)
+    return b.reshape(blocks.shape)
+
+
 def barrier_psum(axis_name: str) -> jax.Array:
     """Barrier = 0-byte allreduce; completion of the program is the sync."""
     return lax.psum(jnp.zeros((), jnp.int32), axis_name)

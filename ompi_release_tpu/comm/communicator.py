@@ -244,6 +244,14 @@ class Communicator:
             if kv and kv.delete_fn:
                 kv.delete_fn(self, kv, value, kv.extra_state)
         self._attrs.clear()
+        # runtime-private dependents (e.g. the hier module's shadow
+        # comm) registered teardown hooks: free them with their owner
+        # or they leak registry entries for the owner's lifetime
+        for cb in getattr(self, "_on_free", ()):
+            try:
+                cb()
+            except MPIError:
+                pass  # already freed
         _comm_registry.pop(self.cid, None)
         self._freed = True
         _comm_count.add(-1)

@@ -125,18 +125,38 @@ class XlaOpComponent(mca_component.Component):
     """Default op component: XLA elementwise combiners (always available).
 
     The ``op`` framework mirrors ``ompi/mca/op``: accelerated components
-    (e.g. a Pallas fused reduce) can register with higher priority and
-    override ``lookup``.
+    (the Pallas streaming-reduce component in ``pallas_op.py``) register
+    with higher priority and claim the (op, dtype, size) shapes their
+    kernels beat the compiler on — ``resolve`` walks the components in
+    priority order exactly like ``ompi_op_base_op_select``.
     """
 
     NAME = "xla"
     PRIORITY = 10
 
-    def lookup(self, name: str) -> Op:
-        return PREDEFINED_OPS[name]
+    def lookup(self, name: str, dtype=None, nbytes: int = 0
+               ) -> Optional[Op]:
+        return PREDEFINED_OPS.get(name)
 
 
 OP_FRAMEWORK = mca_component.framework(
     "op", "reduction operator kernels (ompi/mca/op analogue)"
 )
 OP_FRAMEWORK.register(XlaOpComponent())
+
+
+def resolve(op: Op, dtype=None, nbytes: int = 0) -> Op:
+    """Accelerated-kernel resolution (``ompi/mca/op`` select): query
+    components highest-priority first with the reduction's shape
+    context; the first claim wins. Ops no component knows (user ops)
+    pass through unchanged. Callers that bake the combiner into a
+    compiled program must include the resolved op's name in their
+    program cache key — accelerated ops carry distinct names
+    (e.g. ``sum[pallas]``) precisely so those keys differ. The
+    framework include/exclude variable applies (``--mca op ^pallas``
+    turns the accelerated component off job-wide)."""
+    for _prio, _comp, module in OP_FRAMEWORK.available():
+        found = module.lookup(op.name, dtype=dtype, nbytes=int(nbytes))
+        if found is not None:
+            return found
+    return op

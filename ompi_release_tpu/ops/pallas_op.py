@@ -37,6 +37,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..mca import component as mca_component
+
 #: measured-optimal f32 block shapes (rows, cols)
 AXPY_BLOCK: Tuple[int, int] = (256, 2048)
 SCALE_BLOCK: Tuple[int, int] = (128, 2048)
@@ -57,7 +59,7 @@ def _interpret() -> bool:
 
 
 def _blocked_call(kernel, nin: int, rows: int, cols: int, blk_rows: int,
-                  dtype):
+                  dtype, vma=frozenset()):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -72,7 +74,10 @@ def _blocked_call(kernel, nin: int, rows: int, cols: int, blk_rows: int,
                         memory_space=pltpu.VMEM)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((rows, cols), dtype),
+        # vma: inside shard_map the output varies across the mesh axes
+        # its inputs vary over — propagated from the caller's tracers
+        # (replication typing would otherwise reject the call)
+        out_shape=jax.ShapeDtypeStruct((rows, cols), dtype, vma=vma),
         grid=(rows // blk_rows,),
         in_specs=[spec] * nin,
         out_specs=spec,
@@ -107,20 +112,92 @@ def _apply_blocked(kernel, nin: int, block: Tuple[int, int], *arrays):
     shape, dtype = x0.shape, x0.dtype
     n = x0.size
     rows = -(-n // cols)
+    # never pad a short input up to the full tuned block height — cap
+    # the block at the data, but not below Mosaic's minimum sublane
+    # tile (8 for 4-byte types, 16 for bf16's packed (16, 128) tile)
+    min_rows = 16 if jnp.dtype(dtype) == jnp.bfloat16 else 8
+    blk_rows = max(min_rows, min(blk_rows, rows))
     rows = -(-rows // blk_rows) * blk_rows  # whole blocks
     padded_n = rows * cols
 
     def prep(a):
         flat = a.reshape(-1)
         if padded_n != n:
+            from ..parallel.mesh_axes import vary_like
+
+            # pad zeros must carry the data's varying-axis type or the
+            # concat (and the kernel) fail shard_map's vma check
             flat = jnp.concatenate(
-                [flat, jnp.zeros((padded_n - n,), dtype)]
+                [flat, vary_like(jnp.zeros((padded_n - n,), dtype),
+                                 flat)]
             )
         return flat.reshape(rows, cols)
 
-    call = _blocked_call(kernel, nin, rows, cols, blk_rows, dtype)
-    out = call(*[prep(a) for a in arrays])
+    prepped = [prep(a) for a in arrays]
+    vma = frozenset()
+    for p in prepped:  # union: any varying input makes the out vary
+        vma = vma | getattr(jax.typeof(p), "vma", frozenset())
+    call = _blocked_call(kernel, nin, rows, cols, blk_rows, dtype,
+                         vma=vma)
+    out = call(*prepped)
     return out.reshape(-1)[:n].reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# op-framework component: the accelerated override the framework exists
+# for (``ompi/mca/op`` — accelerated components outrank the base C
+# loops and claim the shapes they beat them on)
+# ---------------------------------------------------------------------------
+
+def _pallas_sum_fn(a, b):
+    """a + b as the tiled 3-stream streaming kernel: explicit VMEM
+    blocking at the measured-optimal axpy block shape. Equal shapes
+    only — exactly what collective local-reduction steps pass. No
+    scalar constant in the kernel body (a literal's empty varying-axis
+    type would clash with ref reads under shard_map's vma tracking)."""
+    def kernel(a_ref, b_ref, out_ref):
+        out_ref[:] = b_ref[:] + a_ref[:]
+
+    return _apply_blocked(kernel, 2, AXPY_BLOCK, a, b)
+
+
+def make_pallas_sum():
+    from .op import Op
+
+    return Op("sum[pallas]", _pallas_sum_fn, commutative=True,
+              identity=lambda d: 0, lax_collective=None)
+
+
+class PallasOpComponent(mca_component.Component):
+    """Claims large contiguous f32/bf16 SUM reductions; everything else
+    falls through to the xla component. The threshold is the measured
+    crossover where explicit blocking stops being noise against the
+    compiler's fusion (small arrays are latency-bound; the kernel's
+    padding to whole blocks would dominate)."""
+
+    NAME = "pallas"
+    PRIORITY = 20  # outranks xla (10): queried first, claims narrowly
+
+    def register_vars(self) -> None:
+        from ..mca import var as mca_var
+
+        mca_var.register(
+            "op_pallas_threshold", "size", 4 * 1024 * 1024,
+            "Minimum reduction size in bytes for the pallas streaming "
+            "SUM kernel to claim the op (below it, XLA fusion wins)",
+        )
+
+    def lookup(self, name: str, dtype=None, nbytes: int = 0):
+        from ..mca import var as mca_var
+
+        if name != "sum" or dtype is None:
+            return None
+        if str(jnp.dtype(dtype)) not in ("float32", "bfloat16"):
+            return None
+        if nbytes < int(mca_var.get("op_pallas_threshold",
+                                    4 * 1024 * 1024)):
+            return None
+        return make_pallas_sum()
 
 
 def make_axpy_loop(rows: int, cols: int, c: float = 0.999,

@@ -63,6 +63,12 @@ def register_vars() -> None:
         "segments; 0 = use the btl endpoint's max_send_size "
         "(btl.h:802 rdma pipeline)",
     )
+    mca_var.register(
+        "pml_wire_timeout", "float", 30.0,
+        "Seconds a blocking cross-process recv/ssend waits for its "
+        "match over the wire before raising ERR_PENDING (raise it for "
+        "jobs with long compute phases between communication)",
+    )
 
 
 class _SendEntry:
@@ -465,7 +471,8 @@ class WirePmlEngine(PmlEngine):
         def block() -> None:
             import time as _time
 
-            deadline = _time.monotonic() + 30.0
+            limit = float(mca_var.get("pml_wire_timeout", 30.0))
+            deadline = _time.monotonic() + limit
             while _time.monotonic() < deadline:
                 router.poll_acks(src_world, timeout_ms=100)
                 if router.take_ack(cid, seq):
@@ -473,7 +480,7 @@ class WirePmlEngine(PmlEngine):
             raise MPIError(
                 ErrorCode.ERR_PENDING,
                 f"ssend to rank {dst} never matched (no ack within "
-                "30s)",
+                f"{limit}s; pml_wire_timeout raises the limit)",
             )
 
         req = Request(progress_fn=progress, block_fn=block)
@@ -509,7 +516,8 @@ class WirePmlEngine(PmlEngine):
             def block() -> None:
                 import time as _time
 
-                deadline = _time.monotonic() + 30.0
+                limit = float(mca_var.get("pml_wire_timeout", 30.0))
+                deadline = _time.monotonic() + limit
                 while (not req.is_complete
                        and _time.monotonic() < deadline):
                     engine._drain(dst, timeout_ms=100)
@@ -517,7 +525,8 @@ class WirePmlEngine(PmlEngine):
                     raise MPIError(
                         ErrorCode.ERR_PENDING,
                         f"recv(source={source}, tag={tag}) at rank "
-                        f"{dst}: no matching message within 30s",
+                        f"{dst}: no matching message within {limit}s "
+                        "(pml_wire_timeout raises the limit)",
                     )
 
             req._progress_fn = progress

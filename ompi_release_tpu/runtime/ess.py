@@ -142,9 +142,10 @@ class TpurunEss(mca_component.Component):
         # FULL wire-up (superset of the tree edges): connect to every
         # lower-id peer so ANY worker pair holds a live OOB link — the
         # data plane the unified COMM_WORLD's cross-process transports
-        # (runtime/wire.py) ride. Lower id dials, higher id sends over
-        # the accepted fd (the same asymmetry tree links use), and the
-        # init barrier below gates until every link is live.
+        # (runtime/wire.py) ride. The HIGHER id dials (same asymmetry
+        # as tree links, where the child dials its parent); the lower
+        # side's sends ride the accepted fd. The init barrier below
+        # gates until every link is live.
         parent = coord.binomial_parent(node_id)
         for nid in range(1, node_id):
             if nid == parent:

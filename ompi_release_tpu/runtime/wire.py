@@ -77,6 +77,19 @@ class WireRouter:
         self._seq = itertools.count(1)
         self._acks: set = set()
         self._ack_lock = threading.Lock()
+        # per-destination-channel locks: an envelope and its payload
+        # must land back-to-back on the channel FIFO (send side) and
+        # be popped as a unit (drain side) — concurrent threads on one
+        # channel would interleave frames and corrupt the stream
+        self._chan_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._chan_guard = threading.Lock()
+
+    def _chan_lock(self, kind: str, key: int) -> threading.Lock:
+        with self._chan_guard:
+            lk = self._chan_locks.get((kind, key))
+            if lk is None:
+                lk = self._chan_locks[(kind, key)] = threading.Lock()
+            return lk
 
     # -- identity ----------------------------------------------------------
     @staticmethod
@@ -159,11 +172,12 @@ class WireRouter:
         env.pack_string(_ENV_MAGIC)
         env.pack_int64([comm.cid, src_rank, dst_rank, int(user_tag),
                         1 if sync else 0, seq])
-        self._retry(
-            lambda: self.ep.send(self._nid(peer), tag, env.tobytes()),
-            f"p2p envelope to process {peer}",
-        )
-        self._send_payload(peer, tag, np.asarray(data))
+        with self._chan_lock("send", dst_world):
+            self._retry(
+                lambda: self.ep.send(self._nid(peer), tag, env.tobytes()),
+                f"p2p envelope to process {peer}",
+            )
+            self._send_payload(peer, tag, np.asarray(data))
         return seq
 
     def drain_p2p(self, dst_world_rank: int, timeout_ms: int = 50) -> bool:
@@ -184,28 +198,35 @@ class WireRouter:
         from ..comm.communicator import _comm_registry
 
         tag = WIRE_P2P_BASE + dst_world_rank
-        deadline = time.monotonic() + timeout_ms / 1000
-        try:
-            src_nid, raw = stashed_recv(self.ep, None, tag, deadline)
-        except MPIError:
-            return False  # nothing pending within the timeout
-        env = DssBuffer(raw)
-        if env.unpack_string() != _ENV_MAGIC:
-            _log.verbose(1, f"dropping non-envelope frame on p2p "
-                            f"channel {tag}")
+        # cheap empty-channel fast path for nonblocking progress
+        # (imprecise: pending() counts frames on every tag, so other
+        # traffic forces the short recv below — never misses a frame)
+        if timeout_ms <= 1 and self.ep.pending() == 0:
             return False
-        cid, src_rank, dst_rank, user_tag, sync, seq = env.unpack_int64(6)
-        src_pidx = src_nid - 1
-        try:
-            data = self._recv_payload(tag, src_pidx)
-        except MPIError as e:
-            raise MPIError(
-                ErrorCode.ERR_TRUNCATE,
-                f"wire message from process {src_pidx} (comm cid {cid}, "
-                f"src rank {src_rank}, tag {user_tag}) announced by its "
-                f"envelope but the payload never completed — peer died "
-                f"mid-transfer? ({e})",
-            )
+        deadline = time.monotonic() + timeout_ms / 1000
+        with self._chan_lock("drain", dst_world_rank):
+            try:
+                src_nid, raw = stashed_recv(self.ep, None, tag, deadline)
+            except MPIError:
+                return False  # nothing pending within the timeout
+            env = DssBuffer(raw)
+            if env.unpack_string() != _ENV_MAGIC:
+                _log.verbose(1, f"dropping non-envelope frame on p2p "
+                                f"channel {tag}")
+                return False
+            cid, src_rank, dst_rank, user_tag, sync, seq = \
+                env.unpack_int64(6)
+            src_pidx = src_nid - 1
+            try:
+                data = self._recv_payload(tag, src_pidx)
+            except MPIError as e:
+                raise MPIError(
+                    ErrorCode.ERR_TRUNCATE,
+                    f"wire message from process {src_pidx} (comm cid "
+                    f"{cid}, src rank {src_rank}, tag {user_tag}) "
+                    "announced by its envelope but the payload never "
+                    f"completed — peer died mid-transfer? ({e})",
+                )
         comm = _comm_registry.get(int(cid))
         if comm is None:
             raise MPIError(
@@ -240,8 +261,12 @@ class WireRouter:
     def poll_acks(self, sender_world_rank: int,
                   timeout_ms: int = 0) -> None:
         """Drain every available ack addressed to ``sender_world_rank``
-        into the ack set (nonblocking when timeout_ms=0)."""
+        into the ack set (timeout_ms=0: near-nonblocking — an empty
+        endpoint returns immediately via the pending() fast path; with
+        unrelated frames queued the probe costs ~1 ms)."""
         tag = WIRE_ACK_BASE + sender_world_rank
+        if timeout_ms <= 0 and self.ep.pending() == 0:
+            return
         while True:
             try:
                 _, _, raw = self.ep.recv(tag=tag,

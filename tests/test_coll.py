@@ -338,6 +338,49 @@ class TestHierarchicalMl:
         for r in range(ml.size):
             np.testing.assert_array_equal(np.asarray(out[r]), x[5])
 
+    def test_two_level_reduce(self, ml):
+        x = _per_rank(ml, 48, seed=55)
+        out = np.asarray(ml.reduce(x, ops.SUM, root=3))
+        np.testing.assert_allclose(out[3], x.sum(axis=0), rtol=2e-5,
+                                   atol=1e-4)
+        mask = np.ones(ml.size, bool)
+        mask[3] = False
+        assert (out[mask] == 0).all()
+        assert any(k[:2] == ("ml", "reduce") for k in ml._coll_programs)
+
+    def test_two_level_allgather(self, ml):
+        x = _per_rank(ml, 24, seed=56)
+        out = np.asarray(ml.allgather(x))
+        for r in range(ml.size):
+            np.testing.assert_array_equal(out[r], x.reshape(-1))
+        assert any(k[:2] == ("ml", "allgather")
+                   for k in ml._coll_programs)
+
+    def test_two_level_reduce_scatter_block(self, ml):
+        n = ml.size
+        x = _per_rank(ml, n * 6, seed=57)
+        out = np.asarray(ml.reduce_scatter_block(x, ops.SUM))
+        tot = x.sum(axis=0)
+        for r in range(n):
+            np.testing.assert_allclose(out[r], tot[r * 6:(r + 1) * 6],
+                                       rtol=2e-5, atol=1e-4)
+        assert any(k[:2] == ("ml", "reduce_scatter_block")
+                   for k in ml._coll_programs)
+
+    def test_two_level_alltoall(self, ml):
+        n = ml.size
+        x = np.stack([
+            np.asarray([i * 100 + j for j in range(n)], np.int32)
+            for i in range(n)
+        ])
+        out = np.asarray(ml.alltoall(x))
+        for i in range(n):
+            np.testing.assert_array_equal(
+                out[i], np.asarray([s * 100 + i for s in range(n)],
+                                   np.int32))
+        assert any(k[:2] == ("ml", "alltoall")
+                   for k in ml._coll_programs)
+
     def test_ml_declines_noncommutative(self, ml):
         left = ops.user_op("left", lambda a, b: a, commute=False)
         x = _per_rank(ml, 16, seed=54)

@@ -261,6 +261,29 @@ class TestUnifiedWorld:
         """)
         assert "SPLIT-OK 0" in out and "SPLIT-OK 4" in out
 
+    def test_hier_inter_domain_byte_reduction(self, tmp_path, capfd):
+        """The two-level compose must cross the process boundary with
+        PARTIALS, not per-rank buffers: for an allreduce of local_n=4
+        slices of B bytes each, inter traffic per process = (P-1) * B
+        sent (one combined partial per peer), a 4x reduction vs
+        shipping every rank's slice — the ml/bcol aggregation the
+        reference builds its hierarchy for."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.mca import pvar
+            world = mpi.init()
+            rt = Runtime.current()
+            x = np.ones((4, 1024), np.float32)  # B = 4096 bytes/slice
+            before = pvar.PVARS.read_all().get("hier_inter_bytes", 0)
+            world.allreduce(x)
+            sent = (pvar.PVARS.read_all()["hier_inter_bytes"] - before)
+            # P=2: exactly one 4096-byte partial sent to the one peer
+            assert sent == 4096, sent
+            print("BYTES-OK", sent)
+            world.barrier()
+            mpi.finalize()
+        """)
+        assert out.count("BYTES-OK 4096") == 2
+
     def test_three_process_cid_sync_after_partial_split(self, tmp_path,
                                                         capfd):
         """A split whose sub-comm has NO members on one process must
