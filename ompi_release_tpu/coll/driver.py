@@ -74,11 +74,73 @@ def run_sharded2d(comm, key: Tuple, body: Callable, x, *,
     return prog(jnp.asarray(x))
 
 
+def _local_rank_count(comm) -> int:
+    """Ranks of this comm whose device is addressable by THIS
+    controller (jax.distributed multi-controller SPMD mode)."""
+    pidx = jax.process_index()
+    return sum(
+        1 for d in comm.submesh.devices.reshape(-1)
+        if int(getattr(d, "process_index", 0)) == pidx
+    )
+
+
+def run_sharded_spmd(comm, key: Tuple, body: Callable, local_x) -> Any:
+    """Multi-controller SPMD mode (``jax.distributed``): every
+    controller passes only ITS ranks' leading-axis slices; the global
+    array is assembled from the per-process shards, ONE compiled
+    program runs SPMD across all controllers (XLA's cross-host
+    collectives ride ICI/DCN), and each controller receives its local
+    shard of the result back. This is the collective path the
+    single-controller driver cannot provide under ``jax.distributed``
+    — the leading-rank-axis array never materializes on one host."""
+    import numpy as _np
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as _P
+
+    _invoke_count.add()
+    mesh = comm.submesh
+    sharding = NamedSharding(mesh, _P("rank"))
+    local_x = _np.asarray(local_x)
+    global_shape = (comm.size,) + local_x.shape[1:]
+    garr = jax.make_array_from_process_local_data(
+        sharding, local_x, global_shape
+    )
+    cache = _program_cache(comm)
+    prog = cache.get(key)
+    if prog is None:
+        _compile_count.add()
+
+        def wrapper(xb):
+            out = body(xb[0])
+            return jax.tree.map(lambda a: a[None], out)
+
+        prog = jax.jit(
+            jax.shard_map(wrapper, mesh=mesh, in_specs=P("rank"),
+                          out_specs=P("rank"))
+        )
+        cache[key] = prog
+    out = prog(garr)
+
+    def to_local(a):
+        shards = sorted(a.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return _np.concatenate([_np.asarray(s.data) for s in shards],
+                               axis=0)
+
+    return jax.tree.map(to_local, out)
+
+
 def run_sharded(comm, key: Tuple, body: Callable, x, *,
                 extra_arrays: Tuple = ()) -> Any:
     """Run ``body(block, *extra_blocks)`` under shard_map over the comm's
     1-D ``rank`` axis. ``x`` has leading axis == comm.size; every extra
     array is sharded the same way. Result keeps the leading rank axis.
+
+    Under a ``jax.distributed`` multi-controller runtime, a buffer
+    whose leading axis matches this controller's LOCAL rank count is
+    dispatched through :func:`run_sharded_spmd` (per-process shards in,
+    per-process shards out) — the single-controller convention cannot
+    apply there because no controller holds every rank's slice.
     """
     _invoke_count.add()
     if not hasattr(x, "shape"):
@@ -93,6 +155,9 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
     if x.shape[0] != comm.size:
         from ..utils.errors import ErrorCode, MPIError
 
+        if (jax.process_count() > 1 and not extra_arrays
+                and x.shape[0] == _local_rank_count(comm)):
+            return run_sharded_spmd(comm, key, body, x)
         raise MPIError(
             ErrorCode.ERR_COUNT,
             f"driver-mode buffer leading axis {x.shape[0]} != comm size "

@@ -8,23 +8,39 @@ collective write_all/read_all where every rank's block lands at its
 view offset (the two-phase exchange is unnecessary when each "rank"
 writes a disjoint contiguous extent — the driver already holds the
 aggregated blocks), sharedfp = an ordered shared file pointer.
+
+Views: ``set_view(disp, etype, filetype)`` accepts a full
+:class:`~..datatype.datatype.Datatype` filetype WITH holes
+(``io/romio`` file views; the fcoll/two_phase case exists because
+interleaved views from different ranks tile the same extents — here
+each rank's strided runs are written/read directly per contiguous
+run). Nonblocking ops (``iwrite_at``/``iread_at``/``iwrite_at_all``/
+``iread_at_all``) run on a per-file thread pool and return Requests
+(``MPI_File_iwrite_at`` family; ompio drives these through libnbc's
+progress — here the pool thread is the progress engine and the
+Request's completion is the future's).
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional, Tuple
 
 import numpy as np
 
+from ..request.request import Request, Status
 from ..utils.errors import ErrorCode, MPIError
 
 MODE_RDONLY = os.O_RDONLY
 MODE_WRONLY = os.O_WRONLY
 MODE_RDWR = os.O_RDWR
 MODE_CREATE = os.O_CREAT
+
+
+def _raise(exc) -> None:
+    raise exc
 
 
 class File:
@@ -40,18 +56,85 @@ class File:
             raise MPIError(ErrorCode.ERR_FILE, f"open {path}: {e}")
         self._lock = threading.Lock()
         self._shared_ptr = 0  # sharedfp analogue
-        # view: (displacement bytes, elementary dtype)
+        # view: (displacement bytes, elementary dtype, filetype)
         self._disp = 0
         self._etype = np.dtype(np.uint8)
+        self._filetype = None
+        self._ft_runs: Optional[np.ndarray] = None  # (start, len) pairs
+        self._ft_size = 0    # visible elements per tile
+        self._ft_extent = 0  # tile extent in etype elements
         self._closed = False
+        self._pool: Optional[ThreadPoolExecutor] = None
 
     # -- view (MPI_File_set_view) -----------------------------------------
-    def set_view(self, disp: int = 0, etype=np.uint8) -> None:
+    def set_view(self, disp: int = 0, etype=np.uint8,
+                 filetype=None) -> None:
+        """Install the view: from ``disp`` bytes on, the file is a
+        tiling of ``filetype`` (a :class:`Datatype`, possibly with
+        holes); only the filetype's data regions are addressable and
+        element offsets count VISIBLE etype elements (the ROMIO view
+        contract). ``filetype=None`` = contiguous etype stream."""
         self._disp = int(disp)
         self._etype = np.dtype(etype)
+        self._filetype = filetype
+        if filetype is None:
+            self._ft_runs = None
+            return
+        offs = np.asarray(filetype.offsets(1), dtype=np.int64)
+        if offs.size == 0:
+            raise MPIError(ErrorCode.ERR_TYPE,
+                           "filetype has no data elements")
+        base_size = getattr(filetype, "base_dtype", None)
+        if base_size is not None and \
+                np.dtype(base_size).itemsize != self._etype.itemsize:
+            raise MPIError(
+                ErrorCode.ERR_TYPE,
+                f"filetype base ({np.dtype(base_size)}) and etype "
+                f"({self._etype}) sizes differ — MPI requires the "
+                "filetype be constructed from the etype",
+            )
+        # contiguous runs within one tile: (start_elem, run_len)
+        runs = []
+        start = prev = int(offs[0])
+        for o in offs[1:]:
+            o = int(o)
+            if o == prev + 1:
+                prev = o
+                continue
+            runs.append((start, prev - start + 1))
+            start = prev = o
+        runs.append((start, prev - start + 1))
+        self._ft_runs = np.asarray(runs, dtype=np.int64)
+        self._ft_size = int(offs.size)
+        self._ft_extent = int(filetype.get_extent())
 
     def _byte_offset(self, offset_elems: int) -> int:
         return self._disp + offset_elems * self._etype.itemsize
+
+    def _view_ranges(self, start_elem: int, count: int):
+        """Yield (byte_offset, elem_count) contiguous file runs for
+        ``count`` visible elements from view position ``start_elem``
+        (identity when no filetype is installed)."""
+        if self._ft_runs is None:
+            yield self._byte_offset(start_elem), count
+            return
+        pos = start_elem
+        remaining = count
+        while remaining > 0:
+            tile, idx = divmod(pos, self._ft_size)
+            # find the run containing visible index idx
+            seen = 0
+            for rstart, rlen in self._ft_runs:
+                if idx < seen + rlen:
+                    within = idx - seen
+                    take = min(int(rlen) - within, remaining)
+                    file_elem = (tile * self._ft_extent + int(rstart)
+                                 + within)
+                    yield self._byte_offset(file_elem), take
+                    pos += take
+                    remaining -= take
+                    break
+                seen += int(rlen)
 
     def _check(self) -> None:
         if self._closed:
@@ -59,19 +142,36 @@ class File:
 
     # -- individual (fbtl) -------------------------------------------------
     def write_at(self, offset: int, data) -> int:
-        """pwrite at an element offset in the current view."""
+        """pwrite at a visible-element offset in the current view
+        (with a holey filetype this scatters per contiguous run)."""
         self._check()
-        buf = np.ascontiguousarray(np.asarray(data, self._etype))
-        n = os.pwrite(self._fd, buf.tobytes(), self._byte_offset(offset))
-        return n // self._etype.itemsize
+        buf = np.ascontiguousarray(np.asarray(data, self._etype)
+                                   ).reshape(-1)
+        isz = self._etype.itemsize
+        raw = buf.tobytes()
+        done = 0
+        written = 0
+        for byte_off, n_elems in self._view_ranges(offset, buf.size):
+            written += os.pwrite(
+                self._fd, raw[done * isz:(done + n_elems) * isz],
+                byte_off,
+            )
+            done += n_elems
+        return written // isz
 
     def read_at(self, offset: int, count: int) -> np.ndarray:
         self._check()
-        raw = os.pread(
-            self._fd, count * self._etype.itemsize,
-            self._byte_offset(offset),
-        )
-        return np.frombuffer(raw, self._etype).copy()
+        isz = self._etype.itemsize
+        parts = []
+        for byte_off, n_elems in self._view_ranges(offset, count):
+            raw = os.pread(self._fd, n_elems * isz, byte_off)
+            parts.append(np.frombuffer(raw, self._etype))
+            if len(raw) < n_elems * isz:
+                break  # EOF inside a run: later runs are past it too
+        if not parts:
+            return np.empty(0, self._etype)
+        return (parts[0].copy() if len(parts) == 1
+                else np.concatenate(parts))
 
     # -- collective (fcoll) ------------------------------------------------
     def write_at_all(self, offsets, blocks) -> int:
@@ -112,6 +212,82 @@ class File:
             ))
         self.comm.barrier()
         return out
+
+    # -- nonblocking (MPI_File_iwrite_at family) ---------------------------
+    def _io_pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix=f"io-{os.path.basename(self.path)}"
+            )
+        return self._pool
+
+    @staticmethod
+    def _future_request(fut: Future) -> Request:
+        """Wrap a pool future as a Request: success completes with the
+        value (and an element-count Status); failure surfaces the
+        exception at test()/wait() — the libnbc error-on-progress
+        contract."""
+        completed = threading.Event()
+
+        def block() -> None:
+            fut.result()      # raises the worker's exception
+            # Future.set_result wakes result() BEFORE running done
+            # callbacks: wait until the callback has completed the
+            # request, or wait()'s bare complete() would win the race
+            # and report value=None/count=0 for a successful op
+            completed.wait()
+
+        req = Request(
+            progress_fn=lambda r: (_raise(fut.exception())
+                                   if fut.done() and fut.exception()
+                                   else None),
+            block_fn=block,
+        )
+
+        def _done(f: Future) -> None:
+            if f.exception() is None:
+                v = f.result()
+                cnt = (int(v) if isinstance(v, int)
+                       else int(getattr(v, "size", 0)))
+                req.complete(value=v, status=Status(count=cnt))
+            completed.set()
+
+        fut.add_done_callback(_done)
+        return req
+
+    def iwrite_at(self, offset: int, data) -> Request:
+        """Nonblocking write_at: returns a Request whose value is the
+        element count written."""
+        self._check()
+        buf = np.ascontiguousarray(np.asarray(data, self._etype))
+        return self._future_request(
+            self._io_pool().submit(self.write_at, offset, buf)
+        )
+
+    def iread_at(self, offset: int, count: int) -> Request:
+        """Nonblocking read_at: the Request's value is the array."""
+        self._check()
+        return self._future_request(
+            self._io_pool().submit(self.read_at, offset, count)
+        )
+
+    def iwrite_at_all(self, offsets, blocks) -> Request:
+        """Nonblocking collective write (MPI_File_iwrite_at_all): the
+        whole fcoll exchange runs on the pool thread; collective
+        ordering across the communicator is the caller's duty, as in
+        MPI."""
+        self._check()
+        blocks = [np.ascontiguousarray(np.asarray(b, self._etype))
+                  for b in blocks]
+        return self._future_request(
+            self._io_pool().submit(self.write_at_all, offsets, blocks)
+        )
+
+    def iread_at_all(self, offsets, counts) -> Request:
+        self._check()
+        return self._future_request(
+            self._io_pool().submit(self.read_at_all, offsets, counts)
+        )
 
     # -- shared file pointer (sharedfp) ------------------------------------
     def write_ordered(self, blocks) -> None:
@@ -156,6 +332,10 @@ class File:
 
     def close(self) -> None:
         if not self._closed:
+            if self._pool is not None:
+                # MPI_File_close completes outstanding nonblocking ops
+                self._pool.shutdown(wait=True)
+                self._pool = None
             os.close(self._fd)
             self._closed = True
 

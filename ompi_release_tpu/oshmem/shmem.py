@@ -254,6 +254,68 @@ class ShmemCtx:
     def xor_to_all(self, x):
         return self.comm.allreduce(x, ops_mod.BXOR)
 
+    # -- distributed locks (shmem_set_lock/clear_lock/test_lock) -----------
+    def lock_create(self) -> SymmetricArray:
+        """A SHMEM lock: a symmetric word, 0 = free, pe+1 = held by pe
+        (``shmem.h.in:167`` lock surface; the reference's
+        ``oshmem/mca/atomic`` backs its locks with the same AMOs).
+        The lock word lives on its home PE (0), as in the reference's
+        home-PE queue discipline — contenders CAS the home copy."""
+        lk = self.malloc((1,), jnp.int32)
+        return lk
+
+    def set_lock(self, lock: SymmetricArray, *, pe: int,
+                 timeout_s: float = 30.0) -> None:
+        """Acquire: spin CAS(0 -> pe+1) on the home PE with backoff.
+        Deadlock-by-self (re-acquiring a held lock) raises instead of
+        hanging — driver mode can detect it, so it does."""
+        import time as _time
+
+        me = int(pe) + 1
+        deadline = _time.monotonic() + timeout_s
+        delay = 0.0005
+        while True:
+            old = int(np.asarray(
+                self.atomic_compare_swap(lock, 0, me, pe=0)
+            ).reshape(-1)[0])
+            if old == 0:
+                return
+            if old == me:
+                raise MPIError(
+                    ErrorCode.ERR_OTHER,
+                    f"PE {pe} already holds this lock (shmem locks are "
+                    "not recursive)",
+                )
+            if _time.monotonic() > deadline:
+                raise MPIError(
+                    ErrorCode.ERR_PENDING,
+                    f"set_lock: PE {old - 1} held the lock for "
+                    f">{timeout_s}s",
+                )
+            _time.sleep(delay)
+            delay = min(delay * 2, 0.01)
+
+    def test_lock(self, lock: SymmetricArray, *, pe: int) -> bool:
+        """One CAS attempt; True = acquired (shmem_test_lock's 0)."""
+        old = int(np.asarray(
+            self.atomic_compare_swap(lock, 0, int(pe) + 1, pe=0)
+        ).reshape(-1)[0])
+        return old == 0
+
+    def clear_lock(self, lock: SymmetricArray, *, pe: int) -> None:
+        """Release; only the holder may clear (erroneous otherwise in
+        OpenSHMEM — detected here rather than corrupting the word)."""
+        me = int(pe) + 1
+        old = int(np.asarray(
+            self.atomic_compare_swap(lock, me, 0, pe=0)
+        ).reshape(-1)[0])
+        if old != me:
+            raise MPIError(
+                ErrorCode.ERR_OTHER,
+                f"clear_lock by PE {pe} but the lock is "
+                + ("free" if old == 0 else f"held by PE {old - 1}"),
+            )
+
     def finalize(self) -> None:
         for a in list(self._allocs):
             a.free()

@@ -31,6 +31,7 @@ Tags mirror the RML usage pattern (``rml.h:318`` tagged send/recv).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -49,6 +50,8 @@ TAG_XCAST = 5
 TAG_FIN = 6
 TAG_HEARTBEAT = 7
 TAG_XCAST_ORPHAN = 8  # worker->HNP: deliver xcast to unreachable child
+TAG_PS = 13           # ps/top client->HNP: live job snapshot query
+#                       (9-12 are the pubsub name-service tags)
 # pubsub tags + protocol live in runtime/pubsub.py (shared with the
 # standalone tpu-server); re-exported here for the worker-facing API
 from .pubsub import (  # noqa: E402
@@ -131,6 +134,8 @@ class HnpCoordinator:
         self._finished: set = set()
         self._failed: set = set()
         self._hb_lock = threading.Lock()
+        self._resusage: Dict[int, Dict[str, int]] = {}
+        self._last_beat: Dict[int, float] = {}
         # Orphaned-subtree xcast fallback is the HNP's OWN duty, not an
         # optional caller poll: any HnpCoordinator user (tpurun,
         # participant-mode rank 0, direct tests) gets the drain.
@@ -215,16 +220,22 @@ class HnpCoordinator:
         ``miss_limit`` intervals (and not cleanly finished) is reported
         once via ``on_failure(node_id)``."""
         last = {nid: time.monotonic() for nid in self._worker_ids}
+        self._last_beat = last  # ps snapshot reads beat ages
 
         def run() -> None:
             while not self._monitor_stop.is_set():
                 try:
-                    src, _, _ = self.ep.recv(
+                    src, _, raw = self.ep.recv(
                         tag=TAG_HEARTBEAT,
                         timeout_ms=max(50, int(interval_s * 500)),
                     )
                     with self._hb_lock:
                         last[src] = time.monotonic()
+                        if raw:  # piggybacked resusage sample
+                            try:
+                                self._resusage[src] = json.loads(raw)
+                            except ValueError:
+                                pass  # legacy empty/garbled beat
                 except MPIError:
                     pass  # timeout: fall through to the check
                 now = time.monotonic()
@@ -274,6 +285,127 @@ class HnpCoordinator:
                             f"{child} failed")
         return True
 
+    # -- rejoin service (resilient-restart wire-up) ------------------------
+    def start_rejoin_service(self, cards: List[Dict[str, Any]]) -> None:
+        """After the initial wire-up, keep serving JOIN + init-barrier
+        frames so a RESTARTED worker (rmaps/resilient respawn) can run
+        the normal ESS bootstrap against a live job: its JOIN updates
+        its card in place and gets the current card list back; its
+        barrier ENTER is released immediately (the collective init
+        barrier already happened — a lone rejoiner must not hang on
+        it). Post-init ENTERs only ever come from rejoiners: the
+        in-job data plane barriers ride the wire router, not the HNP.
+        """
+        self._rejoin_cards = cards
+        self._rejoin_stop = threading.Event()
+
+        def run() -> None:
+            while not self._rejoin_stop.is_set():
+                served = False
+                try:
+                    _, _, raw = self.ep.recv(tag=TAG_JOIN,
+                                             timeout_ms=100)
+                    served = True
+                    try:
+                        nid, card = _unpack_card(raw)
+                    except Exception:
+                        # a malformed JOIN must not kill the service:
+                        # every later restart would hang at bootstrap
+                        _log.verbose(1, "rejoin: dropping malformed "
+                                        "JOIN frame")
+                        continue
+                    if not 1 <= nid <= len(self._rejoin_cards):
+                        _log.verbose(1, f"rejoin: JOIN from unknown "
+                                        f"node {nid}; dropped")
+                        continue
+                    self._rejoin_cards[nid - 1] = card
+                    payload = DssBuffer().pack_string(
+                        json.dumps(self._rejoin_cards)).tobytes()
+                    self.ep.send(nid, TAG_MODEX, payload)
+                    _log.verbose(1, f"rejoin: node {nid} re-wired")
+                except MPIError:
+                    pass
+                try:
+                    src, _, _ = self.ep.recv(tag=TAG_BARRIER_ENTER,
+                                             timeout_ms=100)
+                    rel = DssBuffer().pack_int64(-1).tobytes()
+                    self.ep.send(src, TAG_BARRIER_RELEASE, rel)
+                    served = True
+                except MPIError:
+                    pass
+                if not served:
+                    time.sleep(0.02)
+
+        self._rejoin_thread = threading.Thread(target=run, daemon=True)
+        self._rejoin_thread.start()
+
+    def stop_rejoin_service(self) -> None:
+        stop = getattr(self, "_rejoin_stop", None)
+        if stop is not None:
+            stop.set()
+            self._rejoin_thread.join(timeout=2)
+
+    def note_restarted(self, nid: int) -> None:
+        """Forget a worker's failure/finish marks and reset its beat
+        clock: the respawned incarnation is monitored afresh."""
+        with self._hb_lock:
+            self._failed.discard(nid)
+            self._finished.discard(nid)
+            self._resusage.pop(nid, None)
+            if self._last_beat:
+                self._last_beat[nid] = time.monotonic()
+
+    # -- ps/top snapshot service (orte-ps / orte-top HNP side) -------------
+    def start_ps_responder(self, extra_fn: Optional[Callable] = None
+                           ) -> None:
+        """Serve TAG_PS queries: any client that dialed our port gets
+        a JSON snapshot of per-worker health — last-beat age, pid,
+        vmsize/rss from the piggybacked samples — plus whatever the
+        launcher adds via ``extra_fn()`` (proc states, argv). The
+        orte-ps/orte-top query path (``orte-ps.c`` pretty-prints what
+        the HNP's sensor data already holds)."""
+        self._ps_stop = threading.Event()
+
+        def run() -> None:
+            while not self._ps_stop.is_set():
+                try:
+                    src, _, _ = self.ep.recv(tag=TAG_PS, timeout_ms=200)
+                except MPIError:
+                    continue
+                now = time.monotonic()
+                with self._hb_lock:
+                    workers = {
+                        str(nid): {
+                            "beat_age_s": (
+                                round(now - self._last_beat[nid], 3)
+                                if nid in self._last_beat else None),
+                            "finished": nid in self._finished,
+                            "failed": nid in self._failed,
+                            **self._resusage.get(nid, {}),
+                        }
+                        for nid in self._worker_ids
+                    }
+                snap = {"num_workers": self.num_nodes - 1,
+                        "workers": workers}
+                if extra_fn is not None:
+                    try:
+                        snap.update(extra_fn())
+                    except Exception:
+                        pass  # a snapshot must never kill the responder
+                try:
+                    self.ep.send(src, TAG_PS, json.dumps(snap).encode())
+                except MPIError:
+                    pass  # client vanished between query and reply
+
+        self._ps_thread = threading.Thread(target=run, daemon=True)
+        self._ps_thread.start()
+
+    def stop_ps_responder(self) -> None:
+        stop = getattr(self, "_ps_stop", None)
+        if stop is not None:
+            stop.set()
+            self._ps_thread.join(timeout=2)
+
     # -- name service (pubsub_orte / orte-server analogue) -----------------
     def start_name_server(self) -> None:
         """Serve publish/lookup/unpublish frames: the HNP plays the
@@ -311,6 +443,8 @@ class HnpCoordinator:
         self._monitor_stop.set()
         self._orphan_stop.set()
         self.stop_name_server()
+        self.stop_ps_responder()
+        self.stop_rejoin_service()
         try:
             # teardown release goes to every worker directly: tree
             # relays may already be gone at shutdown
@@ -466,7 +600,16 @@ class WorkerAgent:
 
     # -- health ------------------------------------------------------------
     def heartbeat(self) -> None:
-        self.ep.send(0, TAG_HEARTBEAT, b"")
+        """Beat, piggybacking a resource-usage sample (the
+        sensor/resusage data orte-ps/orte-top display,
+        ``sensor_resusage.c`` feeding the HNP): pid + vmsize/rss ride
+        every beat, so the HNP always holds a fresh per-rank sample
+        without a second sampling channel."""
+        from ..ft.sensor import resource_usage
+
+        ru = resource_usage()
+        ru["pid"] = os.getpid()
+        self.ep.send(0, TAG_HEARTBEAT, json.dumps(ru).encode())
 
     def start_heartbeats(self, interval_s: float = 1.0) -> None:
         def run() -> None:

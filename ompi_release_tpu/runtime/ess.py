@@ -147,11 +147,31 @@ class TpurunEss(mca_component.Component):
         # side's sends ride the accepted fd. The init barrier below
         # gates until every link is live.
         parent = coord.binomial_parent(node_id)
+        from ..utils.errors import MPIError as _MPIError
+
+        recovery = os.environ.get("OMPITPU_RECOVERY") == "1"
         for nid in range(1, node_id):
             if nid == parent:
                 continue  # tree link already exists
             peer = cards[nid - 1]
-            agent.ep.connect(nid, peer["oob_host"], int(peer["oob_port"]))
+            try:
+                agent.ep.connect(nid, peer["oob_host"],
+                                 int(peer["oob_port"]))
+            except _MPIError:
+                if not recovery:
+                    # default policy: a dead peer address (typo'd
+                    # hostfile, firewalled port) must fail the launch
+                    # loudly, not surface later as a missing link
+                    raise
+                # resilient policy: the peer may have finished or be
+                # mid-restart — the wire router raises a clear
+                # ERR_UNREACH if this link is ever actually used
+                _log.verbose(
+                    1, f"wire-up: peer {nid} unreachable at "
+                       f"{peer['oob_host']}:{peer['oob_port']} "
+                       "(finished or restarting); continuing without "
+                       "the link",
+                )
         agent.barrier()  # every tree+wire edge live; init gate
         agent.start_heartbeats(
             float(mca_var.get("ess_tpurun_heartbeat_interval", 0.5))

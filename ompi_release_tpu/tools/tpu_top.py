@@ -1,0 +1,12 @@
+"""tpu-top — refresh-loop entry point (``orte-top`` analogue).
+
+``python -m ompi_release_tpu.tools.tpu_top [-d SECS]``; the
+implementation is tpu_ps's snapshot machinery on a loop.
+"""
+
+import sys
+
+from .tpu_ps import main_top
+
+if __name__ == "__main__":
+    sys.exit(main_top())

@@ -47,6 +47,14 @@ _log = output.stream("tpurun")
 
 _LOCAL_NAMES = ("localhost", "127.0.0.1")
 
+#: session contact directory (the orterun session-dir analogue:
+#: orte-ps discovers live jobs by reading the universe contact files
+#: under the session dir — tpu-ps does the same here)
+SESSION_DIR = os.path.join(
+    os.environ.get("TMPDIR", "/tmp"),
+    f"ompitpu-sessions-{os.getuid()}",
+)
+
 
 # ---------------------------------------------------------------------------
 # rmaps-lite: hostfile + rank->host mapping (orte/mca/rmaps analogue)
@@ -176,7 +184,9 @@ class Job:
                  miss_limit: int = 4, tag_output: bool = True,
                  hosts: Optional[List[HostSpec]] = None,
                  map_by: str = "slot",
-                 launch_agent: str = "ssh") -> None:
+                 launch_agent: str = "ssh",
+                 on_failure: str = "abort",
+                 max_restarts: int = 2) -> None:
         self.n = num_procs
         self.argv = argv
         self.mca = mca
@@ -189,6 +199,18 @@ class Job:
         self.rank_hosts = map_ranks(self.hosts, num_procs, map_by)
         self.remote = any(not h.is_local for h in self.rank_hosts)
         self.launch_agent = launch_agent
+        # errmgr policy: 'abort' = default_hnp teardown; 'restart' =
+        # rmaps/resilient respawn of the failed rank on a surviving
+        # slot (the app resumes from its last committed checkpoint)
+        if on_failure not in ("abort", "restart"):
+            raise MPIError(ErrorCode.ERR_ARG,
+                           f"unknown failure policy '{on_failure}'")
+        self.on_failure = on_failure
+        self.max_restarts = max_restarts
+        self._restarts: Dict[int, int] = {}
+        self._respawned: List[int] = []  # drained by the waitpid loop
+        self._restarting: set = set()    # ranks mid-respawn (dedupe)
+        self._respawn_lock = threading.Lock()
         self.job_state = StateMachine("tpurun-job")
         self.proc_state: Dict[int, int] = {}
         self.hnp: Optional[coord.HnpCoordinator] = None
@@ -219,6 +241,10 @@ class Job:
                 self.heartbeat_s
             ),
         }
+        if self.on_failure == "restart":
+            # workers under the resilient policy tolerate unreachable
+            # peers at wire-up (a peer may be mid-restart or finished)
+            env["OMPITPU_RECOVERY"] = "1"
         for k, v in self.mca:
             env[f"OMPITPU_MCA_{k}"] = str(v)
         return env
@@ -264,17 +290,81 @@ class Job:
             t.start()
             self._iof_threads.append(t)
 
-    # -- failure policy (errmgr default_hnp: teardown) ---------------------
+    # -- failure policy (errmgr default_hnp teardown / resilient) ----------
     def _on_worker_failure(self, node_id: int, state: int) -> None:
         self.proc_state[node_id] = state
         if self._failed.is_set():
             return
+        if self.on_failure == "restart" and self.job_state.visited(
+                JobState.RUNNING):
+            # one restart per failure: the heartbeat monitor and the
+            # waitpid loop can BOTH observe the same dead incarnation —
+            # the budget is read-modify-written and deduped under the
+            # lock, and the (slow: terminate+wait+spawn) respawn runs
+            # off-thread so the monitor keeps draining beats
+            with self._respawn_lock:
+                if node_id in self._restarting:
+                    return  # the other observer is already handling it
+                used = self._restarts.get(node_id, 0)
+                granted = used < self.max_restarts
+                if granted:
+                    self._restarts[node_id] = used + 1
+                    self._restarting.add(node_id)
+            if granted:
+                threading.Thread(
+                    target=self._restart_rank, args=(node_id, state),
+                    daemon=True,
+                ).start()
+                return
+            _log.verbose(1, f"worker {node_id}: restart budget "
+                            f"({self.max_restarts}) exhausted")
         self._failed.set()
         self.job_state.activate(JobState.ABORTED, {"node": node_id,
                                                    "state": int(state)})
         _log.verbose(1, f"worker {node_id} failed "
                         f"({ProcState(state).name}); tearing down")
         self.terminate()
+
+    def _remap_rank(self, node_id: int) -> None:
+        """rmaps/resilient remap: move the failed rank to the
+        least-loaded surviving slot, preferring a DIFFERENT host when
+        one exists (``rmaps_resilient.c``'s move-off-the-fault-node
+        policy; on a single-host allocation the same host is the only
+        slot pool)."""
+        failed_host = self.rank_hosts[node_id - 1]
+        load: Dict[int, int] = {id(h): 0 for h in self.hosts}
+        for i, h in enumerate(self.rank_hosts):
+            if i != node_id - 1:
+                load[id(h)] += 1
+        candidates = sorted(
+            (h for h in self.hosts if h.slots - load[id(h)] > 0),
+            key=lambda h: (h.name == failed_host.name, load[id(h)]),
+        )
+        if candidates:
+            self.rank_hosts[node_id - 1] = candidates[0]
+
+    def _restart_rank(self, node_id: int, state: int) -> None:
+        """Respawn the failed rank (same node id = same rank identity;
+        the rejoin service re-runs its wire-up) and hand it back to
+        the waitpid loop. The app's own checkpoint/restore logic
+        (ft.run_with_restart / Checkpointer) resumes its work."""
+        _log.verbose(
+            0, f"worker {node_id} failed ({ProcState(state).name}); "
+               f"restarting (attempt "
+               f"{self._restarts[node_id]}/{self.max_restarts})")
+        old = self.procs.get(node_id)
+        if old is not None and old.poll() is None:
+            old.terminate()
+            try:
+                old.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                old.kill()
+        self._remap_rank(node_id)
+        self.hnp.note_restarted(node_id)
+        self._spawn(node_id)
+        with self._respawn_lock:
+            self._respawned.append(node_id)
+            self._restarting.discard(node_id)
 
     def abort(self, reason: str = "aborted") -> None:
         """Public abort: the errmgr teardown path with state-machine
@@ -295,6 +385,50 @@ class Job:
                 p.wait(timeout=left)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+    # -- ps/top support ----------------------------------------------------
+    def _ps_extra(self) -> Dict:
+        """Launcher-side snapshot fields merged into the HNP's TAG_PS
+        reply: proc states + the job identity."""
+        from ..runtime.state import ProcState as _PS
+
+        return {
+            "pid": os.getpid(),
+            "argv": self.argv,
+            "proc_states": {
+                str(nid): _PS(int(s)).name
+                for nid, s in self.proc_state.items()
+            },
+        }
+
+    def _write_contact_file(self) -> None:
+        import json
+
+        try:
+            os.makedirs(SESSION_DIR, exist_ok=True)
+            self._contact_path = os.path.join(
+                SESSION_DIR, f"{os.getpid()}.json"
+            )
+            with open(self._contact_path, "w") as f:
+                json.dump({
+                    "pid": os.getpid(),
+                    "host": self.hnp_host,
+                    "port": self.hnp.port,
+                    "n": self.n,
+                    "argv": self.argv,
+                    "started": time.time(),
+                }, f)
+        except OSError as e:
+            _log.verbose(1, f"could not write contact file: {e}")
+            self._contact_path = None
+
+    def _remove_contact_file(self) -> None:
+        path = getattr(self, "_contact_path", None)
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     # -- run ---------------------------------------------------------------
     def run(self, timeout_s: float = 300.0) -> int:
@@ -331,7 +465,8 @@ class Job:
         # heartbeat monitoring + FIN collection
         def serve() -> None:
             try:
-                self.hnp.run_modex(None, timeout_ms=int(timeout_s * 1000))
+                cards = self.hnp.run_modex(
+                    None, timeout_ms=int(timeout_s * 1000))
                 self.job_state.activate(JobState.DAEMONS_REPORTED)
                 self.hnp.barrier(timeout_ms=int(timeout_s * 1000))
                 self.job_state.activate(JobState.RUNNING)
@@ -351,6 +486,14 @@ class Job:
             # pubsub name service (MPI_Publish_name/Lookup_name over
             # the lifeline — the orte-server role lives in the HNP)
             self.hnp.start_name_server()
+            # ps/top snapshot service + session contact file so tpu-ps
+            # can discover and query this live job (orte-ps role)
+            self.hnp.start_ps_responder(self._ps_extra)
+            self._write_contact_file()
+            if self.on_failure == "restart":
+                # a respawned worker re-runs its full ESS wire-up
+                # against the live job (JOIN + init barrier)
+                self.hnp.start_rejoin_service(cards)
             while not self._failed.is_set() and len(self._fin) < self.n:
                 nid = self.hnp.recv_fin(timeout_ms=200)
                 if nid is not None:
@@ -371,8 +514,25 @@ class Job:
         # seen. Give each such worker one heartbeat interval of grace
         # before declaring LIFELINE_LOST.
         grace: Dict[int, float] = {}
-        while (pending or grace) and time.monotonic() < deadline:
+        def respawn_pending() -> bool:
+            with self._respawn_lock:
+                return bool(self._respawned or self._restarting)
+
+        while ((pending or grace or respawn_pending())
+               and time.monotonic() < deadline):
+            # respawned ranks re-enter the waitpid loop (their failed
+            # incarnation's exit code no longer counts)
+            with self._respawn_lock:
+                respawned, self._respawned = self._respawned, []
+            for nid in respawned:
+                pending.add(nid)
+                exit_codes.pop(nid, None)
+                grace.pop(nid, None)
+            with self._respawn_lock:
+                restarting = set(self._restarting)
             for nid in list(pending):
+                if nid in restarting:
+                    continue  # mid-respawn: the new proc is coming
                 rc = self.procs[nid].poll()
                 if rc is None:
                     continue
@@ -417,6 +577,7 @@ class Job:
                 exit_codes[nid] = self.procs[nid].poll() or 124
 
         server.join(timeout=5)
+        self._remove_contact_file()
         self.hnp.shutdown()
         for t in self._iof_threads:
             t.join(timeout=2)
@@ -424,6 +585,13 @@ class Job:
         if self._failed.is_set():
             rc = next((c for c in exit_codes.values() if c), 1)
             return rc
+        # a nonzero code can linger without _failed when a restart was
+        # granted but its respawn never cleanly completed — that is a
+        # failure, not success
+        leftover = next((c for c in exit_codes.values() if c), 0)
+        if leftover:
+            self.job_state.activate(JobState.ABORTED, "restart failed")
+            return leftover
         self.job_state.activate(JobState.TERMINATED)
         return 0
 
@@ -451,6 +619,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="rank->host policy (rmaps round_robin analogue)")
     ap.add_argument("--launch-agent", default="ssh",
                     help="remote launch command (plm_rsh agent)")
+    ap.add_argument("--enable-recovery", action="store_true",
+                    help="restart a failed rank on a surviving slot "
+                         "instead of aborting the job "
+                         "(rmaps/resilient + errmgr recovery)")
+    ap.add_argument("--max-restarts", type=int, default=2,
+                    help="per-rank restart budget with "
+                         "--enable-recovery")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="program and arguments to launch")
     args = ap.parse_args(argv)
@@ -470,7 +645,9 @@ def main(argv: Optional[List[str]] = None) -> int:
               heartbeat_s=args.heartbeat,
               tag_output=not args.no_tag_output,
               hosts=hosts, map_by=args.map_by,
-              launch_agent=args.launch_agent)
+              launch_agent=args.launch_agent,
+              on_failure="restart" if args.enable_recovery else "abort",
+              max_restarts=args.max_restarts)
 
     def on_signal(signum, frame):
         job._failed.set()

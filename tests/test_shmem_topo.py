@@ -87,6 +87,69 @@ class TestShmem:
         assert np.asarray(f).shape == (world.size, world.size * 2)
 
 
+class TestShmemLocks:
+    """shmem_set_lock/clear_lock/test_lock (shmem.h.in:167) over the
+    AMO-backed home-PE lock word."""
+
+    def test_acquire_release_cycle(self, shmem):
+        lk = shmem.lock_create()
+        shmem.set_lock(lk, pe=1)
+        assert not shmem.test_lock(lk, pe=2)  # held: attempt fails
+        shmem.clear_lock(lk, pe=1)
+        assert shmem.test_lock(lk, pe=2)      # free: attempt acquires
+        shmem.clear_lock(lk, pe=2)
+
+    def test_wrong_holder_clear_raises(self, shmem):
+        from ompi_release_tpu.utils.errors import MPIError
+
+        lk = shmem.lock_create()
+        shmem.set_lock(lk, pe=0)
+        with pytest.raises(MPIError):
+            shmem.clear_lock(lk, pe=3)
+        with pytest.raises(MPIError):
+            shmem.set_lock(lk, pe=0)  # non-recursive
+        shmem.clear_lock(lk, pe=0)
+        with pytest.raises(MPIError):
+            shmem.clear_lock(lk, pe=0)  # already free
+
+    def test_contention_mutual_exclusion(self, shmem):
+        """N contending PEs (threads) do lost-update-prone
+        read-modify-writes on a shared word under the lock: the final
+        count proves mutual exclusion (without the lock this test
+        reliably loses updates)."""
+        import threading
+
+        lk = shmem.lock_create()
+        counter = shmem.malloc((1,), jnp.int32)
+        n_pes, iters = 4, 25
+        errs = []
+
+        def contender(pe):
+            try:
+                for _ in range(iters):
+                    shmem.set_lock(lk, pe=pe)
+                    try:
+                        v = int(np.asarray(
+                            shmem.atomic_fetch(counter, pe=0)
+                        ).reshape(-1)[0])
+                        shmem.atomic_set(counter, v + 1, pe=0)
+                    finally:
+                        shmem.clear_lock(lk, pe=pe)
+            except Exception as e:  # surfaced after join
+                errs.append(e)
+
+        threads = [threading.Thread(target=contender, args=(pe,))
+                   for pe in range(n_pes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
+        final = int(np.asarray(
+            shmem.atomic_fetch(counter, pe=0)).reshape(-1)[0])
+        assert final == n_pes * iters, final
+
+
 class TestDims:
     def test_dims_create_balanced(self):
         assert dims_create(8, 3) == (2, 2, 2)

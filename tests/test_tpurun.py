@@ -160,6 +160,127 @@ class TestPubsubPublicApi:
 
 
 class TestFailureDetection:
+    def test_tpu_ps_snapshots_live_job(self, tmp_path, capfd):
+        """tpu-ps against a LIVE job: session-dir discovery finds the
+        contact file, the HNP's TAG_PS responder returns per-rank
+        pid/state/rss/vmsize piggybacked from heartbeats, and the
+        rendered table carries them (orte-ps + sensor_resusage)."""
+        import threading
+        import time as _time
+
+        from ompi_release_tpu.tools import tpu_ps
+
+        app = _write_app(tmp_path, """
+            import time
+            world = mpi.init()
+            time.sleep(2.5)   # stay alive across several beats
+            mpi.finalize()
+        """)
+        job = Job(2, [sys.executable, app], [], heartbeat_s=0.3)
+        results = {}
+
+        def probe_when_running():
+            for _ in range(600):
+                if job.job_state.visited(JobState.RUNNING):
+                    break
+                _time.sleep(0.05)
+            _time.sleep(1.0)  # let a resusage-bearing beat land
+            jobs = tpu_ps.discover_jobs()
+            results["discovered"] = [
+                j for j in jobs if j["pid"] == os.getpid()
+            ]
+            client = tpu_ps.PsClient("127.0.0.1", job.hnp.port)
+            try:
+                results["snap"] = client.query()
+            finally:
+                client.close()
+
+        t = threading.Thread(target=probe_when_running, daemon=True)
+        t.start()
+        rc = job.run(timeout_s=120)
+        t.join(timeout=10)
+        assert rc == 0
+        # discovery: this launcher's contact file was found and live
+        assert results.get("discovered"), results
+        assert results["discovered"][0]["n"] == 2
+        snap = results.get("snap")
+        assert snap and snap["num_workers"] == 2, snap
+        for nid in ("1", "2"):
+            w = snap["workers"][nid]
+            assert w["pid"] > 0          # piggybacked sample arrived
+            assert w["rss"] > 0 and w["vmsize"] > 0
+            assert w["beat_age_s"] is not None
+            assert snap["proc_states"][nid] == "RUNNING"
+        # rendering includes rank rows with byte-formatted columns
+        text = tpu_ps.render_job(results["discovered"][0], snap)
+        assert "rank" in text and "RUNNING" in text
+        # contact file removed after the job ends
+        assert not [j for j in tpu_ps.discover_jobs()
+                    if j["pid"] == os.getpid()]
+
+    def test_resilient_restart_resumes_from_checkpoint(self, tmp_path,
+                                                       capfd):
+        """rmaps/resilient + errmgr recovery: a worker KILLED mid-job
+        is respawned on a surviving slot (same rank identity, fresh
+        wire-up through the rejoin service) and resumes from its last
+        committed checkpoint; the job completes rc=0."""
+        ckdir = tmp_path / "ck"
+        ckdir.mkdir()
+        app = _write_app(tmp_path, """
+            import os, signal
+            from ompi_release_tpu.ft import Checkpointer
+            world = mpi.init()
+            rt = Runtime.current()
+            pi = rt.bootstrap["process_index"]
+            ck = Checkpointer(%r + f"/rank{pi}", comm=world)
+            state = {"step": jax.numpy.zeros((), jax.numpy.int32)}
+            latest = ck.latest_step()
+            restored = latest is not None
+            start = 0
+            if restored:
+                state = ck.restore(state, step=latest)
+                start = int(state["step"])
+                print(f"RESUMED {pi} from {start}")
+            for step in range(start, 10):
+                state["step"] = jax.numpy.asarray(step + 1)
+                if step == 4 and not restored:
+                    ck.save(step + 1, state)
+                    ck.wait()
+                    if pi == 1:
+                        os.kill(os.getpid(), signal.SIGKILL)
+            print(f"DONE {pi} step=10")
+            mpi.finalize()
+        """ % str(ckdir))
+        job = Job(2, [sys.executable, app], [], heartbeat_s=0.3,
+                  on_failure="restart", max_restarts=2)
+        rc = job.run(timeout_s=120)
+        out = capfd.readouterr().out
+        assert rc == 0, out
+        assert "RESUMED 1 from 5" in out
+        assert "DONE 0 step=10" in out and "DONE 1 step=10" in out
+        assert job._restarts.get(2) == 1  # exactly one respawn, rank 1
+        assert not job.job_state.visited(JobState.ABORTED)
+        assert job.job_state.visited(JobState.TERMINATED)
+
+    def test_restart_budget_exhaustion_aborts(self, tmp_path, capfd):
+        """A rank that keeps dying exhausts max_restarts and the job
+        aborts (the resilient policy never loops forever)."""
+        app = _write_app(tmp_path, """
+            import os, signal
+            world = mpi.init()
+            rt = Runtime.current()
+            if rt.bootstrap["process_index"] == 0:
+                os.kill(os.getpid(), signal.SIGKILL)
+            import time
+            time.sleep(30)
+        """)
+        job = Job(2, [sys.executable, app], [], heartbeat_s=0.3,
+                  on_failure="restart", max_restarts=1)
+        rc = job.run(timeout_s=60)
+        assert rc != 0
+        assert job._restarts.get(1) == 1
+        assert job.job_state.visited(JobState.ABORTED)
+
     def test_abnormal_exit_aborts_job(self, tmp_path, capfd):
         """One worker exits 3 mid-job: the job reaches ABORTED, the
         others are torn down, exit code propagates."""
