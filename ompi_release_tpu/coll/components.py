@@ -157,6 +157,13 @@ class _XlaModule:
 
     def scan(self, comm, x, op: Op, *, exclusive: bool = False):
         n = comm.size
+        # the gather-based scan stages the WHOLE comm's buffers on
+        # every rank (O(n * size) memory): past the limit, decline so
+        # the chain falls to tuned's recursive-doubling scan, which
+        # keeps per-rank memory O(size)
+        if _per_rank_bytes(x) > int(mca_var.get(
+                "coll_xla_scan_gather_limit", 1 << 20)):
+            return None
 
         def body(xb):
             g = lax.all_gather(xb, AXIS, axis=0)  # (n, ...)
@@ -223,6 +230,14 @@ class _XlaModule:
 class XlaCollComponent(mca_component.Component):
     NAME = "xla"
     PRIORITY = 100
+
+    def register_vars(self) -> None:
+        mca_var.register(
+            "coll_xla_scan_gather_limit", "size", 1 << 20,
+            "Per-rank bytes above which the xla scan/exscan (all_gather"
+            " + associative_scan, O(n*size) staged per rank) defers to "
+            "tuned's recursive-doubling scan",
+        )
 
     def query(self, ctx=None):
         if ctx is None:

@@ -157,6 +157,7 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
 
         if (jax.process_count() > 1 and not extra_arrays
                 and x.shape[0] == _local_rank_count(comm)):
+            _invoke_count.add(-1)  # the spmd entry counts this call
             return run_sharded_spmd(comm, key, body, x)
         raise MPIError(
             ErrorCode.ERR_COUNT,

@@ -49,7 +49,8 @@ _padded_elems = _pvar.counter(
 )
 _overflow_elems = _pvar.counter(
     "vcoll_alltoallv_overflow_elems",
-    "hot-pair tail elements moved pairwise (skew mitigation)",
+    "hot-pair tail elements delivered host-side at the driver edge "
+    "(skew mitigation; these bypass the kernel)",
 )
 
 
@@ -119,7 +120,9 @@ def alltoallv(comm, sendbufs: Sequence, sendcounts, *,
 
     Skewed count matrices are mitigated (see :func:`_skew_cap`): the
     padded kernel's cap is bounded at a count quantile and hot pairs'
-    overflow tails move pairwise, accounted in the
+    overflow tails are delivered host-side at the driver edge
+    (numpy slices concatenated into the receive buffers — they never
+    traverse a kernel or transport), accounted in the
     ``vcoll_alltoallv_overflow_elems`` pvar.
     """
     n = comm.size

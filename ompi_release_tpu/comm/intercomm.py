@@ -196,10 +196,15 @@ class Intercommunicator(Communicator):
 
     def allreduce(self, send_local, send_remote, op=None):
         """Local ranks receive the reduction of the REMOTE group's
-        contributions (MPI inter-allreduce semantics)."""
+        contributions (MPI inter-allreduce semantics). ``send_local``
+        is what OUR ranks contribute to the remote side's result; it
+        is validated here (both handles must be well-formed on either
+        side of the intercomm) and consumed by the remote group's own
+        call."""
         self._check_alive()
         from .. import ops as ops_mod
 
+        self._check_counts(send_local, self.size, "allreduce local")
         self._check_counts(send_remote, self.remote_size, "allreduce remote")
         return self._remote_comm().allreduce(
             np.asarray(send_remote), op or ops_mod.SUM
@@ -223,7 +228,15 @@ class Intercommunicator(Communicator):
 
     def reduce(self, send_remote, op=None, root: int = 0):
         """Reduce the REMOTE group's contributions to local rank
-        ``root`` (this side is the root group)."""
+        ``root`` (this side is the root group).
+
+        Driver convention — root-agnostic result: with one controller
+        playing every local rank there is no per-rank delivery, so the
+        reduction is computed once (as a remote-group allreduce — the
+        reduction order is that allreduce's order, not a rooted-tree
+        order) and returned to the caller, who IS every local rank
+        including the root. ``root`` is range-validated so erroneous
+        programs fail identically to the reference."""
         self._check_alive()
         from .. import ops as ops_mod
 
@@ -237,7 +250,10 @@ class Intercommunicator(Communicator):
 
     def gather(self, send_remote, root: int = 0):
         """Local rank ``root`` receives the remote group's buffers in
-        remote rank order (root-group perspective)."""
+        remote rank order (root-group perspective). Root-agnostic
+        driver convention as in :meth:`reduce`: the gathered buffer is
+        returned once to the caller (who plays every local rank);
+        ``root`` is range-validated only."""
         self._check_alive()
         if not 0 <= root < self.size:
             raise MPIError(ErrorCode.ERR_ROOT,

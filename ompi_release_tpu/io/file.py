@@ -215,11 +215,13 @@ class File:
 
     # -- nonblocking (MPI_File_iwrite_at family) ---------------------------
     def _io_pool(self) -> ThreadPoolExecutor:
-        if self._pool is None:
-            self._pool = ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix=f"io-{os.path.basename(self.path)}"
-            )
-        return self._pool
+        with self._lock:  # two first-op threads must share ONE pool
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=4,
+                    thread_name_prefix=f"io-{os.path.basename(self.path)}",
+                )
+            return self._pool
 
     @staticmethod
     def _future_request(fut: Future) -> Request:

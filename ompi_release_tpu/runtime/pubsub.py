@@ -18,7 +18,7 @@ from typing import Dict, List, Tuple
 
 from ..native import DssBuffer
 from ..utils import output
-from ..utils.errors import MPIError
+from ..utils.errors import ErrorCode, MPIError
 
 _log = output.stream("pubsub")
 
@@ -114,11 +114,28 @@ def pubsub_rpc(ep, lock: threading.Lock, seq_holder, tag: int,
                timeout_ms: int = 10_000) -> Tuple[bool, str]:
     """Client side: send one request, wait for OUR seq's reply.
 
-    ``lock`` serializes RPCs per endpoint (concurrent threads would
-    steal each other's TAG_PUBSUB_REPLY frames — the seq filter
-    discards foreign replies, it cannot requeue them). ``seq_holder``
-    is any object with a mutable ``pubsub_seq`` int attribute."""
+    Concurrent RPCs on one endpoint do NOT serialize behind each
+    other: replies are demultiplexed by seq through a shared stash —
+    one thread at a time plays receiver (condition-variable handoff),
+    parks replies that belong to other outstanding RPCs, and wakes
+    their owners. A publish issued while another thread's lookup is
+    parked server-side therefore completes immediately (and typically
+    unparks that very lookup) instead of waiting out its timeout.
+
+    ``lock`` protects only seq allocation + the request send (frame
+    ordering); ``seq_holder`` is any object with a mutable
+    ``pubsub_seq`` int attribute."""
     with lock:
+        # mux creation under the lock: two first-RPC threads racing an
+        # unsynchronized check-then-set would mint two muxes and strand
+        # one thread's replies in the orphaned stash
+        state = getattr(ep, "_pubsub_mux", None)
+        if state is None:
+            state = ep._pubsub_mux = {
+                "cond": threading.Condition(),
+                "replies": {},      # seq -> (ok, value)
+                "receiving": False,  # one thread owns the recv at a time
+            }
         seq_holder.pubsub_seq = getattr(seq_holder, "pubsub_seq", 0) + 1
         seq = seq_holder.pubsub_seq
         frame = DssBuffer()
@@ -126,16 +143,59 @@ def pubsub_rpc(ep, lock: threading.Lock, seq_holder, tag: int,
         for f in fields:
             frame.pack_string(f)
         ep.send(server_id, tag, frame.tobytes())
-        deadline = time.monotonic() + timeout_ms / 1000
-        while True:
-            left = max(1, int((deadline - time.monotonic()) * 1000))
-            _, _, raw = ep.recv(tag=TAG_PUBSUB_REPLY, timeout_ms=left)
-            b = DssBuffer(raw)
-            (got_seq,) = b.unpack_int64()
-            (ok,) = b.unpack_int64()
-            value = b.unpack_string()
-            if got_seq == seq:
+    cond = state["cond"]
+    deadline = time.monotonic() + timeout_ms / 1000
+    while True:
+        with cond:
+            if seq in state["replies"]:
+                ok, value = state["replies"].pop(seq)
                 return bool(ok), value
-            # reply to an earlier timed-out RPC of ours: discard
-            _log.verbose(2, f"discarding stale pubsub reply "
-                            f"seq={got_seq}")
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise MPIError(
+                    ErrorCode.ERR_PENDING,
+                    f"pubsub rpc seq={seq} timed out",
+                )
+            if state["receiving"]:
+                # another thread is on the wire; it will park our
+                # reply and wake us
+                cond.wait(timeout=min(left, 0.5))
+                continue
+            state["receiving"] = True
+        got_seq = None
+        try:
+            left_ms = max(1, int((deadline - time.monotonic()) * 1000))
+            _, _, raw = ep.recv(tag=TAG_PUBSUB_REPLY,
+                                timeout_ms=min(left_ms, 500))
+            try:
+                b = DssBuffer(raw)
+                (got_seq,) = b.unpack_int64()
+                (ok,) = b.unpack_int64()
+                value = b.unpack_string()
+            except Exception:
+                # one garbled reply frame must cost only that frame —
+                # never wedge the receiver handoff for the process
+                _log.verbose(1, "dropping malformed pubsub reply")
+                got_seq = None
+        except MPIError:
+            if time.monotonic() >= deadline:
+                with cond:
+                    state["receiving"] = False
+                    cond.notify_all()
+                raise MPIError(
+                    ErrorCode.ERR_PENDING,
+                    f"pubsub rpc seq={seq} timed out",
+                )
+        with cond:
+            state["receiving"] = False
+            if got_seq is not None:
+                if got_seq == seq:
+                    cond.notify_all()
+                    return bool(ok), value
+                # another outstanding RPC's reply: park it and wake
+                # its owner; cap the stash so replies to long-dead
+                # RPCs cannot accumulate
+                state["replies"][int(got_seq)] = (int(ok), value)
+                if len(state["replies"]) > 64:
+                    state["replies"].pop(next(iter(state["replies"])))
+            cond.notify_all()

@@ -28,9 +28,8 @@ import time
 from typing import Dict, List, Optional
 
 from ..native import OobEndpoint
+from ..runtime.coordinator import TAG_PS
 from ..utils.errors import ErrorCode, MPIError
-
-TAG_PS = 13  # runtime/coordinator.py contract
 
 
 class PsClient:

@@ -352,16 +352,27 @@ class Job:
             0, f"worker {node_id} failed ({ProcState(state).name}); "
                f"restarting (attempt "
                f"{self._restarts[node_id]}/{self.max_restarts})")
-        old = self.procs.get(node_id)
-        if old is not None and old.poll() is None:
-            old.terminate()
-            try:
-                old.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                old.kill()
-        self._remap_rank(node_id)
-        self.hnp.note_restarted(node_id)
-        self._spawn(node_id)
+        try:
+            old = self.procs.get(node_id)
+            if old is not None and old.poll() is None:
+                old.terminate()
+                try:
+                    old.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    old.kill()
+            self._remap_rank(node_id)
+            self.hnp.note_restarted(node_id)
+            self._spawn(node_id)
+        except Exception as exc:
+            # a failed respawn (Popen error, dead launch agent) must
+            # abort the job promptly, not spin the waitpid loop until
+            # the wall-clock timeout with the rank parked mid-respawn
+            with self._respawn_lock:
+                self._restarting.discard(node_id)
+            _log.verbose(0, f"respawn of worker {node_id} failed: "
+                            f"{exc}; aborting job")
+            self.abort(f"respawn of worker {node_id} failed")
+            return
         with self._respawn_lock:
             self._respawned.append(node_id)
             self._restarting.discard(node_id)

@@ -4,10 +4,11 @@ The north star demands bitwise parity vs a FIXED reduction order per
 algorithm (``coll_tuned_decision_fixed.c:43-81`` — each named
 algorithm fixes its own f32 summation order). This harness pins each
 compiled algorithm to an exact numpy float32 simulation of its own
-reduction order, step for step, and asserts BITWISE equality — and
-asserts the one cross-algorithm identity the design claims:
-segmented_ring is the ring pipelined per segment, so it must be
-bitwise identical to ring (``coll/spmd.py`` docstring).
+reduction order, step for step, and asserts BITWISE equality. It
+also FALSIFIED an early design claim: segmented_ring is NOT bitwise
+identical to ring (its chunk boundaries depend on the segment index —
+see the corrected analysis in ``coll/spmd.py``), so each algorithm is
+pinned to its OWN order, never to another's.
 
 (The round-2 test named ``test_bitwise_parity_ring_vs_linear`` only
 checked run-to-run reproducibility of one algorithm; it is renamed in

@@ -381,6 +381,21 @@ class TestHierarchicalMl:
         assert any(k[:2] == ("ml", "alltoall")
                    for k in ml._coll_programs)
 
+    def test_xla_scan_defers_to_tuned_past_gather_limit(self, ml):
+        # not an ml test per se, but keeps the decision-rule checks
+        # together: a scan whose per-rank payload exceeds the gather
+        # limit must compile tuned's recursive doubling, not xla's
+        # all_gather+associative_scan
+        import ompi_release_tpu as mpi
+
+        world = mpi.init()
+        big = np.ones((world.size, 300_000), np.float32)  # 1.2 MB/rank
+        out = np.asarray(world.scan(big))
+        np.testing.assert_allclose(out[3], 4 * big[0], rtol=1e-6)
+        assert any(k[:2] == ("tuned", "scan")
+                   for k in world._coll_programs), \
+            [k for k in world._coll_programs if "scan" in str(k)]
+
     def test_ml_declines_noncommutative(self, ml):
         left = ops.user_op("left", lambda a, b: a, commute=False)
         x = _per_rank(ml, 16, seed=54)

@@ -112,6 +112,46 @@ class TestTpuServer:
             b.close()
             srv.shutdown()
 
+    def test_concurrent_rpcs_do_not_serialize(self):
+        """A publish issued from another thread of the SAME client
+        endpoint while a lookup is parked server-side completes
+        immediately and unparks that lookup — the reply demultiplexer
+        means concurrent RPCs never wait out each other's timeouts."""
+        import threading
+        import time as _time
+
+        from ompi_release_tpu.tools.tpu_server import (
+            NameClient, NameServer,
+        )
+
+        srv = NameServer()
+        client = NameClient("127.0.0.1", srv.port)
+        try:
+            got = {}
+
+            def looker():
+                t0 = _time.monotonic()
+                got["value"] = client.lookup("late-svc",
+                                             timeout_ms=20_000)
+                got["elapsed"] = _time.monotonic() - t0
+
+            t = threading.Thread(target=looker, daemon=True)
+            t.start()
+            _time.sleep(0.3)  # lookup is parked server-side now
+            t0 = _time.monotonic()
+            client.publish("late-svc", "9191")  # same endpoint!
+            publish_took = _time.monotonic() - t0
+            t.join(timeout=10)
+            assert not t.is_alive()
+            assert got["value"] == "9191"
+            # the publish must not have waited behind the parked
+            # lookup's 20s budget, and the lookup unparked promptly
+            assert publish_took < 5, publish_took
+            assert got["elapsed"] < 10, got["elapsed"]
+        finally:
+            client.close()
+            srv.shutdown()
+
     def test_cli_prints_uri(self):
         import subprocess
         import sys
