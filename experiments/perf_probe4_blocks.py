@@ -1,5 +1,13 @@
 """Block-shape re-sweep on the tunneled v5e chip (round 3, 2026-07-30).
 
+HISTORICAL RECORD — r03.  The transpose rows below were measured
+against the r03 make_transpose_loop (body `call(acc) + 1`, 2N bytes
+counted); r04 changed that function to a double-apply body moving 4N
+bytes per iteration (see probes 5-7 and ops/pallas_op.py), so
+re-running this sweep today would report ~half the true transpose
+bandwidth under this file's 2N accounting.  Keep for the tuning
+trail; do not re-run for new numbers.
+
 Dev scratch (like perf_probe*.py): measures axpy/scale/transpose Pallas
 block candidates with interleaved long-window slope timing. Findings
 baked into the shipped constants:

@@ -254,9 +254,26 @@ def make_transpose_loop(n: int, block: int = 256, dtype=jnp.int32):
     """K-iteration loop over a blocked (n, n) transpose — the
     single-chip analogue of the 2-D-torus MPI_Alltoall shuffle
     (BASELINE config 5): every (i, j) block moves to (j, i), all-pairs
-    data movement through HBM, 2 streams. The +1 after each transpose
-    stops XLA from folding T(T(x)) = x across loop iterations (the
-    pallas_call itself is opaque, but its inverse-pairing is not)."""
+    data movement through HBM.
+
+    The loop body applies the transpose TWICE, 4 streams (2 reads + 2
+    writes of the full array) per iteration, and callers must count
+    ``4 * n * n * itemsize`` bytes.  Why: a ``fori_loop`` carry lives
+    in a FIXED buffer across iterations (XLA while-loop buffer
+    assignment), so a single non-aliased kernel per iteration forces
+    XLA to copy its fresh output back into the carry buffer — 2N
+    uncounted extra bytes that halved the reported bandwidth for three
+    rounds (the r03 "alltoall at 0.49 of ceiling" gap was exactly
+    this, probes 5-7: square blocks, run length, 1-D vs 2-D grids all
+    measured identical; only aliasing moved the number).  With two
+    calls per body, call #1's input buffer is dead when call #2 runs,
+    XLA reuses it for #2's output, the carry address is stable and no
+    copy is inserted — measured at copy-ceiling parity.  A same-buffer
+    blocked transpose cannot use ``input_output_aliases`` directly
+    (block (j, i) would be clobbered before grid step (j, i) reads
+    it), which is why the scale/axpy kernels alias and this one
+    double-applies instead.  XLA cannot fold T(T(x)) = x across the
+    two calls: a pallas_call is opaque."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -280,7 +297,7 @@ def make_transpose_loop(n: int, block: int = 256, dtype=jnp.int32):
     @partial(jax.jit, static_argnums=1)
     def loop(a, k):
         def body(i, acc):
-            return call(acc) + 1
+            return call(call(acc))
 
         acc = jax.lax.fori_loop(0, k, body, a)
         return acc[0, 0] + acc[-1, -1]

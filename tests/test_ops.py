@@ -206,6 +206,24 @@ class TestPallasOpKernels:
         v = loop(jnp.ones((rows, cols), jnp.float32), 3)
         assert np.isfinite(float(v))
 
+    def test_transpose_loop_semantics(self):
+        """The bench's alltoall analogue: call is a real blocked
+        transpose, and the loop body applies it TWICE (4 counted
+        streams/iter — the carry-copy fix, see make_transpose_loop),
+        so the carry after any k equals the input."""
+        from ompi_release_tpu.ops import pallas_op
+
+        n, block = 16, 8
+        loop, call = pallas_op.make_transpose_loop(n, block=block)
+        x = jnp.arange(n * n, dtype=jnp.int32).reshape(n, n)
+        np.testing.assert_array_equal(np.asarray(call(x)),
+                                      np.asarray(x).T)
+        # loop returns corner-sum of the carry; double-apply => carry
+        # is x itself for every k
+        expect = int(x[0, 0] + x[-1, -1])
+        for k in (0, 1, 3):
+            assert int(loop(x, k)) == expect
+
 
 def test_bench_end_to_end_on_simulator_mesh():
     """bench.py's full multi-device path (the scoreboard the driver
