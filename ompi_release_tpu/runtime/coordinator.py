@@ -51,6 +51,8 @@ TAG_FIN = 6
 TAG_HEARTBEAT = 7
 TAG_XCAST_ORPHAN = 8  # worker->HNP: deliver xcast to unreachable child
 TAG_PS = 13           # ps/top client->HNP: live job snapshot query
+TAG_MIGRATE = 14      # migrate client->HNP: move ranks off a host
+TAG_DIE = 15          # HNP->worker: exit immediately (odls kill)
 #                       (9-12 are the pubsub name-service tags)
 # pubsub tags + protocol live in runtime/pubsub.py (shared with the
 # standalone tpu-server); re-exported here for the worker-facing API
@@ -131,6 +133,9 @@ class HnpCoordinator:
         self._barrier_seq = 0
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
+        # shared stop for the ps AND migrate responders: created here
+        # so either can be started standalone, in any order
+        self._ps_stop = threading.Event()
         self._finished: set = set()
         self._failed: set = set()
         self._hb_lock = threading.Lock()
@@ -364,7 +369,6 @@ class HnpCoordinator:
         launcher adds via ``extra_fn()`` (proc states, argv). The
         orte-ps/orte-top query path (``orte-ps.c`` pretty-prints what
         the HNP's sensor data already holds)."""
-        self._ps_stop = threading.Event()
 
         def run() -> None:
             while not self._ps_stop.is_set():
@@ -400,11 +404,47 @@ class HnpCoordinator:
         self._ps_thread = threading.Thread(target=run, daemon=True)
         self._ps_thread.start()
 
+    def kill_worker(self, node_id: int, code: int = 143) -> None:
+        """Order a worker to exit via its die watcher (the odls kill
+        path — reaches THE WORKER ITSELF even when it was launched
+        through an ssh conduit whose local client process is all the
+        launcher could otherwise signal)."""
+        self.ep.send(node_id, TAG_DIE, str(code).encode())
+
+    def start_migrate_responder(self, migrate_fn: Callable) -> None:
+        """Serve TAG_MIGRATE requests (the ``orte-migrate`` command
+        path): payload is JSON ``{"off": host}``; ``migrate_fn`` is
+        the launcher's policy hook and its dict return is the reply.
+        Runs on its own thread; shares the ps responder's stop event
+        (created in __init__, so start order does not matter) and is
+        stopped by the same stop_ps_responder call."""
+
+        def run() -> None:
+            while not self._ps_stop.is_set():
+                try:
+                    src, _, raw = self.ep.recv(tag=TAG_MIGRATE,
+                                               timeout_ms=200)
+                except MPIError:
+                    continue
+                try:
+                    req = json.loads(raw or b"{}")
+                    reply = migrate_fn(req)
+                except Exception as exc:  # never kill the responder
+                    reply = {"ok": False, "error": str(exc)}
+                try:
+                    self.ep.send(src, TAG_MIGRATE,
+                                 json.dumps(reply).encode())
+                except MPIError:
+                    pass
+
+        threading.Thread(target=run, daemon=True,
+                         name="hnp-migrate").start()
+
     def stop_ps_responder(self) -> None:
-        stop = getattr(self, "_ps_stop", None)
-        if stop is not None:
-            stop.set()
-            self._ps_thread.join(timeout=2)
+        self._ps_stop.set()
+        t = getattr(self, "_ps_thread", None)
+        if t is not None:
+            t.join(timeout=2)
 
     # -- name service (pubsub_orte / orte-server analogue) -----------------
     def start_name_server(self) -> None:
@@ -621,6 +661,34 @@ class WorkerAgent:
 
         self._hb_thread = threading.Thread(target=run, daemon=True)
         self._hb_thread.start()
+        self._start_die_watcher()
+
+    def _start_die_watcher(self) -> None:
+        """Obey TAG_DIE from the HNP with ``os._exit`` (the odls
+        kill_local_procs analogue, ``orte/mca/odls/base``): when the
+        launcher reached the worker over ssh, terminating the LOCAL
+        ssh client merely orphans the remote process — the reference
+        kills through the remote orted, and this control-plane kill
+        is that path here. Runs whenever heartbeats run (both are the
+        process-management channel)."""
+
+        def run() -> None:
+            from ..utils.errors import ErrorCode as _EC
+
+            while not self._hb_stop.is_set():
+                try:
+                    _, _, raw = self.ep.recv(tag=TAG_DIE,
+                                             timeout_ms=500)
+                except MPIError as e:
+                    if e.code == _EC.ERR_PENDING:
+                        continue  # plain timeout: keep watching
+                    return        # endpoint closed/torn down
+                except Exception:
+                    return
+                os._exit(int(raw or b"143"))
+
+        threading.Thread(target=run, daemon=True,
+                         name="die-watcher").start()
 
     def stop_heartbeats(self) -> None:
         self._hb_stop.set()

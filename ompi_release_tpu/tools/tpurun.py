@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -137,13 +138,63 @@ def parse_host_list(spec: str) -> List[HostSpec]:
 
 def map_ranks(hosts: List[HostSpec], n: int,
               policy: str = "slot") -> List[HostSpec]:
-    """Rank->host mapping (rmaps round_robin analogue).
+    """Rank->host mapping (the rmaps framework's mapper menu).
 
     ``slot``: fill each host's slots before moving on (rmaps_rr
     by-slot). ``node``: round-robin one rank per host per pass
-    (by-node). Oversubscription (n > total slots) is an error, like
-    the reference without ``--oversubscribe``.
+    (by-node). ``ppr:N:node``: exactly N processes per node in
+    allocation order (``orte/mca/rmaps/ppr``). ``seq``: rank i runs on
+    the i-th allocation LINE, slots ignored — list a host on several
+    lines to stack ranks on it (``orte/mca/rmaps/seq``).
+    Oversubscription (n > total slots, or ppr N > a host's slots) is
+    an error, like the reference without ``--oversubscribe``.
+    rank_file mapping is a separate entry point (:func:`parse_rankfile`)
+    since it carries its own placement list. mindist (NUMA/NIC
+    distance) has no TPU meaning — a worker owns its chips by
+    construction — and is deliberately absent.
     """
+    out: List[HostSpec] = []
+    if policy == "seq":
+        # one rank per allocation line, in file order
+        if n > len(hosts):
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"seq mapper: {n} ranks but only {len(hosts)} "
+                "allocation lines (list a host once per rank)",
+            )
+        return list(hosts[:n])
+    if policy.startswith("ppr:"):
+        parts = policy.split(":")
+        if len(parts) != 3 or parts[2] != "node":
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"bad ppr spec '{policy}' (expected ppr:N:node)",
+            )
+        try:
+            per = int(parts[1])
+        except ValueError:
+            per = 0
+        if per < 1:
+            raise MPIError(ErrorCode.ERR_ARG,
+                           f"bad ppr count in '{policy}'")
+        for h in hosts:
+            if per > h.slots:
+                raise MPIError(
+                    ErrorCode.ERR_ARG,
+                    f"ppr {per}/node exceeds {h.slots} slot(s) on "
+                    f"{h.name} (no oversubscription)",
+                )
+            for _ in range(per):
+                if len(out) < n:
+                    out.append(h)
+        if len(out) < n:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"ppr {per}/node places only "
+                f"{per * len(hosts)} ranks on {len(hosts)} hosts "
+                f"but {n} were requested",
+            )
+        return out
     total = sum(h.slots for h in hosts)
     if n > total:
         raise MPIError(
@@ -151,7 +202,6 @@ def map_ranks(hosts: List[HostSpec], n: int,
             f"{n} ranks > {total} slots on {len(hosts)} hosts "
             "(no oversubscription)",
         )
-    out: List[HostSpec] = []
     if policy == "slot":
         for h in hosts:
             for _ in range(h.slots):
@@ -176,6 +226,82 @@ def map_ranks(hosts: List[HostSpec], n: int,
     return out
 
 
+def parse_rankfile(path: str, n: int,
+                   hosts: Optional[List[HostSpec]] = None
+                   ) -> List[HostSpec]:
+    """Explicit per-rank placement (``orte/mca/rmaps/rank_file``).
+
+    Syntax, one line per rank (comments ``#``)::
+
+        rank 3=hostB slot=1
+
+    ``slot=`` is accepted and validated for range but carries no
+    binding semantics (a TPU worker owns whole chips, not cores).
+    Every rank 0..n-1 must appear exactly once. When an allocation is
+    given (--hostfile/--host) every named host must be in it and its
+    per-host rank count must fit its slots; without one, named hosts
+    form their own allocation (one slot per placed rank)."""
+    alloc = {h.name: h for h in (hosts or [])}
+    placed: Dict[int, str] = {}
+    counts: Dict[str, int] = {}
+    try:
+        lines = open(path).read().splitlines()
+    except OSError as e:
+        raise MPIError(ErrorCode.ERR_FILE,
+                       f"cannot read rankfile {path}: {e}")
+    for lineno, line in enumerate(lines, 1):
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        m = re.match(r"rank\s+(\d+)\s*=\s*(\S+?)"
+                     r"(?:\s+slot\s*=\s*(\d+))?\s*$", line)
+        if not m:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"rankfile {path}:{lineno}: unparseable line "
+                f"'{line}' (expected 'rank N=host [slot=S]')",
+            )
+        r, host, slot = int(m.group(1)), m.group(2), m.group(3)
+        if r in placed:
+            raise MPIError(ErrorCode.ERR_ARG,
+                           f"rankfile {path}:{lineno}: rank {r} "
+                           "placed twice")
+        if r >= n:
+            raise MPIError(ErrorCode.ERR_ARG,
+                           f"rankfile {path}:{lineno}: rank {r} out "
+                           f"of range for -n {n}")
+        if alloc and host not in alloc:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"rankfile {path}:{lineno}: host '{host}' not in "
+                f"the allocation ({', '.join(sorted(alloc))})",
+            )
+        if slot is not None and alloc and int(slot) >= alloc[host].slots:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"rankfile {path}:{lineno}: slot {slot} out of range "
+                f"on {host} ({alloc[host].slots} slots)",
+            )
+        placed[r] = host
+        counts[host] = counts.get(host, 0) + 1
+    missing = [r for r in range(n) if r not in placed]
+    if missing:
+        raise MPIError(
+            ErrorCode.ERR_ARG,
+            f"rankfile {path} leaves rank(s) "
+            f"{', '.join(map(str, missing))} unmapped for -n {n}",
+        )
+    for host, c in counts.items():
+        if alloc and c > alloc[host].slots:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"rankfile {path}: {c} ranks on {host} exceed its "
+                f"{alloc[host].slots} slot(s) (no oversubscription)",
+            )
+    by_name = alloc or {h: HostSpec(h, counts[h]) for h in counts}
+    return [by_name[placed[r]] for r in range(n)]
+
+
 class Job:
     """One launched job: processes + coordinator + state machines."""
 
@@ -184,6 +310,7 @@ class Job:
                  miss_limit: int = 4, tag_output: bool = True,
                  hosts: Optional[List[HostSpec]] = None,
                  map_by: str = "slot",
+                 rankfile: Optional[str] = None,
                  launch_agent: str = "ssh",
                  on_failure: str = "abort",
                  max_restarts: int = 2) -> None:
@@ -194,9 +321,24 @@ class Job:
         self.miss_limit = miss_limit
         self.tag_output = tag_output
         # rmaps: rank r runs on rank_hosts[r] (default: all-local,
-        # the single-host fork path)
+        # the single-host fork path); an explicit rankfile overrides
+        # the policy mapper (rank_file has top rmaps priority in the
+        # reference too)
         self.hosts = hosts or [HostSpec("localhost", num_procs)]
-        self.rank_hosts = map_ranks(self.hosts, num_procs, map_by)
+        if rankfile is not None:
+            self.rank_hosts = parse_rankfile(rankfile, num_procs, hosts)
+            if hosts is None:
+                # the rankfile's named hosts ARE the allocation: the
+                # remapper/migrator key host load by identity over
+                # self.hosts, so the phantom localhost spec must not
+                # survive (parse_rankfile reuses one HostSpec per
+                # name, so dedup by id works)
+                seen: Dict[int, HostSpec] = {}
+                for h in self.rank_hosts:
+                    seen.setdefault(id(h), h)
+                self.hosts = list(seen.values())
+        else:
+            self.rank_hosts = map_ranks(self.hosts, num_procs, map_by)
         self.remote = any(not h.is_local for h in self.rank_hosts)
         self.launch_agent = launch_agent
         # errmgr policy: 'abort' = default_hnp teardown; 'restart' =
@@ -220,6 +362,14 @@ class Job:
         self._failed = threading.Event()
         self._fin: set = set()
         self._fin_lock = threading.Lock()
+        # hosts evacuated by tpu-migrate: the remapper never places a
+        # rank (migrated OR failure-respawned) back on one of these
+        self._excluded_hosts: set = set()
+        # serializes rank_hosts read-modify-write: concurrent moves
+        # (multi-rank migration, or migration racing a failure
+        # restart) must each see the other's placement or two ranks
+        # can double-book one free slot
+        self._map_lock = threading.Lock()
 
     # -- launch ------------------------------------------------------------
     def _env_for(self, node_id: int) -> Dict[str, str]:
@@ -331,17 +481,28 @@ class Job:
         one exists (``rmaps_resilient.c``'s move-off-the-fault-node
         policy; on a single-host allocation the same host is the only
         slot pool)."""
-        failed_host = self.rank_hosts[node_id - 1]
-        load: Dict[int, int] = {id(h): 0 for h in self.hosts}
-        for i, h in enumerate(self.rank_hosts):
-            if i != node_id - 1:
-                load[id(h)] += 1
-        candidates = sorted(
-            (h for h in self.hosts if h.slots - load[id(h)] > 0),
-            key=lambda h: (h.name == failed_host.name, load[id(h)]),
-        )
-        if candidates:
-            self.rank_hosts[node_id - 1] = candidates[0]
+        with self._map_lock:
+            failed_host = self.rank_hosts[node_id - 1]
+            load: Dict[int, int] = {id(h): 0 for h in self.hosts}
+            for i, h in enumerate(self.rank_hosts):
+                if i != node_id - 1:
+                    load[id(h)] += 1
+            candidates = sorted(
+                (h for h in self.hosts
+                 if h.slots - load[id(h)] > 0
+                 and h.name not in self._excluded_hosts),
+                key=lambda h: (h.name == failed_host.name, load[id(h)]),
+            )
+            if candidates:
+                self.rank_hosts[node_id - 1] = candidates[0]
+            elif failed_host.name in self._excluded_hosts:
+                # nowhere to put an evacuated rank: surface rather
+                # than silently respawning on the host being drained
+                raise MPIError(
+                    ErrorCode.ERR_UNREACH,
+                    f"no surviving slot for rank {node_id - 1} off "
+                    f"evacuated host {failed_host.name}",
+                )
 
     def _restart_rank(self, node_id: int, state: int) -> None:
         """Respawn the failed rank (same node id = same rank identity;
@@ -352,8 +513,29 @@ class Job:
             0, f"worker {node_id} failed ({ProcState(state).name}); "
                f"restarting (attempt "
                f"{self._restarts[node_id]}/{self.max_restarts})")
+        self._move_rank(node_id, f"respawn of worker {node_id}")
+
+    def _move_rank(self, node_id: int, what: str) -> None:
+        """Terminate the rank's current incarnation, remap it to a
+        surviving slot, respawn it. Caller must already hold the
+        rank in ``_restarting`` (that flag is what stops the waitpid
+        loop and heartbeat monitor from treating the deliberate
+        terminate as a new failure)."""
         try:
             old = self.procs.get(node_id)
+            if old is not None and old.poll() is None:
+                # kill through the control plane FIRST: under an ssh
+                # launch, procs[nid] is the LOCAL ssh client —
+                # terminating it orphans the remote worker, which
+                # then runs to completion on the host being drained.
+                # TAG_DIE reaches the worker itself (odls kill); the
+                # signal path below stays as the fallback for workers
+                # that died before wiring up their die watcher.
+                try:
+                    self.hnp.kill_worker(node_id)
+                    old.wait(timeout=3)
+                except (MPIError, subprocess.TimeoutExpired):
+                    pass
             if old is not None and old.poll() is None:
                 old.terminate()
                 try:
@@ -361,6 +543,11 @@ class Job:
                 except subprocess.TimeoutExpired:
                     old.kill()
             self._remap_rank(node_id)
+            if self.rank_hosts[node_id - 1].name in self._excluded_hosts:
+                # this move's placement raced a concurrent evacuation
+                # (its remap ran before the exclusion landed): place
+                # again now that the exclusion is visible
+                self._remap_rank(node_id)
             self.hnp.note_restarted(node_id)
             self._spawn(node_id)
         except Exception as exc:
@@ -369,13 +556,89 @@ class Job:
             # the wall-clock timeout with the rank parked mid-respawn
             with self._respawn_lock:
                 self._restarting.discard(node_id)
-            _log.verbose(0, f"respawn of worker {node_id} failed: "
-                            f"{exc}; aborting job")
-            self.abort(f"respawn of worker {node_id} failed")
+            _log.verbose(0, f"{what} failed: {exc}; aborting job")
+            self.abort(f"{what} failed")
             return
         with self._respawn_lock:
             self._respawned.append(node_id)
             self._restarting.discard(node_id)
+
+    # -- proactive migration (orte-migrate analogue) -----------------------
+    def migrate_off(self, req: Dict) -> Dict:
+        """Evacuate every rank currently mapped to ``req['off']``:
+        mark the host excluded, then move each rank through the same
+        terminate->remap->respawn path the resilient errmgr uses (the
+        ``orte-migrate`` + ``rmaps/resilient`` composition; reference
+        ``orte/tools/orte-migrate/orte-migrate.c``). Each moved app
+        resumes from its last COMMITTED checkpoint — the same
+        restart-from-checkpoint contract as failure recovery; there is
+        no pre-migration snapshot barrier, so work since the last
+        commit is recomputed (documented, not hidden).
+
+        Does not touch the per-rank failure-restart budget: an
+        operator-requested move is not a failure."""
+        off = req.get("off")
+        if not off:
+            return {"ok": False, "error": "missing 'off' host"}
+        if self.on_failure != "restart":
+            # without the recovery machinery (rejoin service,
+            # OMPITPU_RECOVERY env) a respawned incarnation can never
+            # rejoin — accepting would kill a rank and hang the job
+            return {"ok": False,
+                    "error": "job launched without --enable-recovery; "
+                             "migration needs the rejoin service"}
+        if self.job_state.current != int(JobState.RUNNING) or \
+                self._failed.is_set():
+            # CURRENT state, not visited(): a request landing after
+            # completion must not spawn an unreaped stray worker
+            return {"ok": False, "error": "job is not running"}
+        with self._map_lock:  # consistent placement snapshot
+            targets = [i + 1 for i, h in enumerate(self.rank_hosts)
+                       if h.name == off]
+            if not targets:
+                return {"ok": False,
+                        "error": f"no ranks mapped to host '{off}'"}
+            # capacity check BEFORE evacuating: surviving slots must
+            # absorb every moved rank or the request is refused whole
+            self._excluded_hosts.add(off)
+            free = sum(h.slots for h in self.hosts
+                       if h.name not in self._excluded_hosts)
+            staying = sum(1 for h in self.rank_hosts
+                          if h.name not in self._excluded_hosts)
+            if free - staying < len(targets):
+                self._excluded_hosts.discard(off)
+                return {"ok": False,
+                        "error": f"cannot evacuate {off}: "
+                                 f"{len(targets)} rank(s) need slots "
+                                 f"but only {free - staying} remain "
+                                 "free"}
+        moved = []
+        skipped = []
+        for nid in targets:
+            with self._respawn_lock:
+                if nid in self._restarting:
+                    # already mid-move (failure respawn in flight) —
+                    # its placement may predate the exclusion, so the
+                    # mover rechecks before spawning; still REPORT it
+                    # so the operator knows this rank was not handled
+                    # by this request
+                    skipped.append(nid - 1)
+                    continue
+                self._restarting.add(nid)
+            threading.Thread(
+                target=self._move_rank,
+                args=(nid, f"migration of worker {nid} off {off}"),
+                daemon=True,
+            ).start()
+            moved.append(nid - 1)
+        _log.verbose(0, f"migrating rank(s) "
+                        f"{', '.join(map(str, moved))} off {off}")
+        reply = {"ok": True, "off": off, "ranks": moved}
+        if skipped:
+            reply["skipped"] = skipped
+            reply["note"] = ("skipped rank(s) were mid-respawn; "
+                             "verify placement with tpu-ps")
+        return reply
 
     def abort(self, reason: str = "aborted") -> None:
         """Public abort: the errmgr teardown path with state-machine
@@ -386,6 +649,25 @@ class Job:
         self.terminate()
 
     def terminate(self) -> None:
+        # control-plane kill first (odls kill): under ssh launches the
+        # Popen handles are local ssh clients and signaling them would
+        # orphan the remote workers (they'd run on after the job died)
+        if self.hnp is not None:
+            for nid, p in self.procs.items():
+                if p.poll() is None:
+                    try:
+                        self.hnp.kill_worker(nid)
+                    except MPIError:
+                        pass  # never wired up / link gone: signal path
+            deadline = time.monotonic() + 2
+            for p in self.procs.values():
+                left = deadline - time.monotonic()
+                if left <= 0 or p.poll() is not None:
+                    continue
+                try:
+                    p.wait(timeout=left)
+                except subprocess.TimeoutExpired:
+                    pass
         for nid, p in self.procs.items():
             if p.poll() is None:
                 p.terminate()
@@ -500,6 +782,7 @@ class Job:
             # ps/top snapshot service + session contact file so tpu-ps
             # can discover and query this live job (orte-ps role)
             self.hnp.start_ps_responder(self._ps_extra)
+            self.hnp.start_migrate_responder(self.migrate_off)
             self._write_contact_file()
             if self.on_failure == "restart":
                 # a respawned worker re-runs its full ESS wire-up
@@ -626,8 +909,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="allocation file: 'hostname [slots=N]' lines")
     ap.add_argument("--host", default=None,
                     help="comma host list 'a:2,b,c:4' (name[:slots])")
-    ap.add_argument("--map-by", choices=("slot", "node"), default="slot",
-                    help="rank->host policy (rmaps round_robin analogue)")
+    ap.add_argument("--map-by", default="slot",
+                    help="rank->host policy: slot | node | seq | "
+                         "ppr:N:node (rmaps round_robin/seq/ppr "
+                         "analogues)")
+    ap.add_argument("--rankfile", default=None,
+                    help="explicit per-rank placement file "
+                         "('rank N=host [slot=S]' lines; overrides "
+                         "--map-by, rmaps rank_file analogue)")
     ap.add_argument("--launch-agent", default="ssh",
                     help="remote launch command (plm_rsh agent)")
     ap.add_argument("--enable-recovery", action="store_true",
@@ -655,7 +944,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     job = Job(args.np, args.command, [tuple(m) for m in args.mca],
               heartbeat_s=args.heartbeat,
               tag_output=not args.no_tag_output,
-              hosts=hosts, map_by=args.map_by,
+              hosts=hosts, map_by=args.map_by, rankfile=args.rankfile,
               launch_agent=args.launch_agent,
               on_failure="restart" if args.enable_recovery else "abort",
               max_restarts=args.max_restarts)

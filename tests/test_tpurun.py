@@ -388,6 +388,91 @@ class TestMultiHost:
         with _pytest.raises(MPIError):
             map_ranks(hosts, 6, "slot")  # oversubscription rejected
 
+    def test_ppr_and_seq_mappers(self, tmp_path):
+        """rmaps/ppr and rmaps/seq analogues: exact N per node in
+        allocation order; one rank per allocation LINE."""
+        import pytest as _pytest
+
+        from ompi_release_tpu.tools.tpurun import map_ranks, parse_hostfile
+        from ompi_release_tpu.utils.errors import MPIError
+
+        hf = tmp_path / "hosts"
+        hf.write_text("nodeA slots=4\nnodeB slots=4\nnodeC slots=4\n")
+        hosts = parse_hostfile(str(hf))
+        names = [h.name for h in map_ranks(hosts, 5, "ppr:2:node")]
+        assert names == ["nodeA", "nodeA", "nodeB", "nodeB", "nodeC"]
+        with _pytest.raises(MPIError, match="places only"):
+            map_ranks(hosts, 7, "ppr:2:node")  # 2*3 hosts < 7
+        with _pytest.raises(MPIError, match="exceeds"):
+            map_ranks(hosts, 4, "ppr:5:node")  # > slots, no oversub
+        with _pytest.raises(MPIError, match="ppr"):
+            map_ranks(hosts, 2, "ppr:2:socket")  # only :node exists
+
+        # seq: file ORDER, duplicates allowed, slots ignored
+        sf = tmp_path / "seqhosts"
+        sf.write_text("nodeB\nnodeA\nnodeB\n")
+        seq_hosts = parse_hostfile(str(sf))
+        names = [h.name for h in map_ranks(seq_hosts, 3, "seq")]
+        assert names == ["nodeB", "nodeA", "nodeB"]
+        with _pytest.raises(MPIError, match="allocation lines"):
+            map_ranks(seq_hosts, 4, "seq")
+
+    def test_rankfile_mapping(self, tmp_path):
+        """rmaps/rank_file analogue: explicit placement wins over the
+        policy mapper, with full-coverage and allocation checks."""
+        import pytest as _pytest
+
+        from ompi_release_tpu.tools.tpurun import (
+            HostSpec, Job, parse_rankfile,
+        )
+        from ompi_release_tpu.utils.errors import MPIError
+
+        alloc = [HostSpec("nodeA", 2), HostSpec("nodeB", 2)]
+        rf = tmp_path / "ranks"
+        rf.write_text(
+            "# explicit placement\n"
+            "rank 0=nodeB slot=0\n"
+            "rank 2=nodeA\n"
+            "rank 1=nodeB slot=1\n"
+        )
+        names = [h.name for h in parse_rankfile(str(rf), 3, alloc)]
+        assert names == ["nodeB", "nodeB", "nodeA"]
+
+        # Job honors the rankfile over --map-by
+        job = Job(3, ["true"], [], hosts=alloc, map_by="slot",
+                  rankfile=str(rf))
+        assert [h.name for h in job.rank_hosts] == \
+            ["nodeB", "nodeB", "nodeA"]
+
+        rf.write_text("rank 0=nodeA\n")  # rank 1 unmapped
+        with _pytest.raises(MPIError, match="unmapped"):
+            parse_rankfile(str(rf), 2, alloc)
+        rf.write_text("rank 0=nodeA\nrank 0=nodeB\nrank 1=nodeA\n")
+        with _pytest.raises(MPIError, match="twice"):
+            parse_rankfile(str(rf), 2, alloc)
+        rf.write_text("rank 0=nodeZ\nrank 1=nodeA\n")
+        with _pytest.raises(MPIError, match="not in"):
+            parse_rankfile(str(rf), 2, alloc)
+        rf.write_text("rank 0=nodeA\nrank 1=nodeA\nrank 2=nodeA\n")
+        with _pytest.raises(MPIError, match="exceed"):
+            parse_rankfile(str(rf), 3, alloc)  # 3 ranks, 2 slots
+        rf.write_text("rank 0=nodeA slot=7\nrank 1=nodeB\n")
+        with _pytest.raises(MPIError, match="slot 7"):
+            parse_rankfile(str(rf), 2, alloc)
+        rf.write_text("banana\n")
+        with _pytest.raises(MPIError, match="unparseable"):
+            parse_rankfile(str(rf), 1, alloc)
+        # no allocation: named hosts form their own — and the Job's
+        # allocation (self.hosts) must be rebuilt from them so the
+        # remapper/migrator host-load bookkeeping (keyed by identity
+        # over self.hosts) covers every placed rank
+        rf.write_text("rank 0=alpha\nrank 1=alpha\n")
+        names = [h.name for h in parse_rankfile(str(rf), 2, None)]
+        assert names == ["alpha", "alpha"]
+        job2 = Job(2, ["true"], [], rankfile=str(rf))
+        assert [(h.name, h.slots) for h in job2.hosts] == [("alpha", 2)]
+        assert all(h is job2.hosts[0] for h in job2.rank_hosts)
+
     def test_fake_ssh_two_host_job(self, tmp_path, capfd):
         """End-to-end 2-'host' job through the rsh launch path: a fake
         ssh agent records each target host then execs locally (the
@@ -462,6 +547,141 @@ class TestMultiHost:
             if agent is not None:
                 agent.close()
             hnp.shutdown()
+
+
+class TestMigration:
+    """tpu-migrate (orte-migrate analogue): proactively evacuate a
+    host of a live job through the HNP's TAG_MIGRATE responder."""
+
+    def test_migrate_off_host_resumes_elsewhere(self, tmp_path, capfd):
+        """A 2-'host' fake-ssh job is asked to evacuate nodeB: the
+        rank there is terminated, remapped to nodeA (which stays
+        excluded for later respawns), respawned, and resumes from its
+        last committed checkpoint; the job completes rc=0 and the
+        failure-restart budget is untouched."""
+        import threading
+        import time as _time
+
+        from ompi_release_tpu.tools.tpu_migrate import request_migration
+        from ompi_release_tpu.tools.tpurun import HostSpec
+
+        log = tmp_path / "ssh_targets.log"
+        agent = tmp_path / "fakessh"
+        agent.write_text(
+            "#!/bin/sh\n"
+            f'echo "$1" >> {log}\n'
+            "shift\n"
+            'exec sh -c "$*"\n'
+        )
+        agent.chmod(0o755)
+        ckdir = tmp_path / "ck"
+        ckdir.mkdir()
+        app = _write_app(tmp_path, """
+            import time
+            from ompi_release_tpu.ft import Checkpointer
+            world = mpi.init()
+            rt = Runtime.current()
+            pi = rt.bootstrap["process_index"]
+            ck = Checkpointer(%r + f"/rank{pi}", comm=world)
+            state = {"step": jax.numpy.zeros((), jax.numpy.int32)}
+            latest = ck.latest_step()
+            start = 0
+            if latest is not None:
+                state = ck.restore(state, step=latest)
+                start = int(state["step"])
+                print(f"RESUMED {pi} from {start}", flush=True)
+            for step in range(start, 16):
+                state["step"] = jax.numpy.asarray(step + 1)
+                ck.save(step + 1, state)
+                ck.wait()
+                time.sleep(0.25)
+            print(f"DONE {pi}", flush=True)
+            mpi.finalize()
+        """ % str(ckdir))
+        job = Job(2, [sys.executable, app], [], heartbeat_s=0.3,
+                  hosts=[HostSpec("nodeA", 2), HostSpec("nodeB", 2)],
+                  map_by="node", launch_agent=str(agent),
+                  on_failure="restart", max_restarts=2)
+        results = {}
+
+        def migrate_when_running():
+            for _ in range(600):
+                if job.job_state.visited(JobState.RUNNING):
+                    break
+                _time.sleep(0.05)
+            _time.sleep(1.2)  # let the app commit a few checkpoints
+            results["reply"] = request_migration(
+                "127.0.0.1", job.hnp.port, "nodeB")
+
+        t = threading.Thread(target=migrate_when_running, daemon=True)
+        t.start()
+        rc = job.run(timeout_s=120)
+        t.join(timeout=10)
+        out = capfd.readouterr().out
+        assert rc == 0, out
+        reply = results.get("reply")
+        assert reply and reply.get("ok"), (reply, out)
+        assert reply["ranks"] == [1]
+        # rank 1 now lives on nodeA; nodeB stays excluded
+        assert job.rank_hosts[1].name == "nodeA"
+        assert "nodeB" in job._excluded_hosts
+        # the moved app resumed from a committed step and finished —
+        # and the OLD incarnation actually died (TAG_DIE through the
+        # control plane: killing only the local fake-ssh client would
+        # orphan it to run to completion, printing DONE 1 twice)
+        assert "RESUMED 1 from" in out
+        assert "DONE 0" in out and "DONE 1" in out
+        assert out.count("DONE 1") == 1, out
+        assert out.count("RESUMED 1") == 1, out
+        # an operator move is not a failure: budget untouched
+        assert not job._restarts.get(2)
+        assert not job.job_state.visited(JobState.ABORTED)
+        assert job.job_state.visited(JobState.TERMINATED)
+        # the respawn actually went through the launch agent to nodeA
+        targets = log.read_text().split()
+        assert targets.count("nodeA") == 2 and targets.count("nodeB") == 1
+
+    def test_migrate_refused_without_capacity(self, tmp_path, capfd):
+        """Evacuating the only host with free slots is refused whole —
+        no rank is killed on a request that cannot complete."""
+        import threading
+        import time as _time
+
+        from ompi_release_tpu.tools.tpu_migrate import request_migration
+
+        app = _write_app(tmp_path, """
+            import time
+            world = mpi.init()
+            time.sleep(3.0)
+            mpi.finalize()
+        """)
+        # default single-host allocation: localhost with exactly n slots
+        job = Job(2, [sys.executable, app], [], heartbeat_s=0.3,
+                  on_failure="restart")
+        results = {}
+
+        def probe():
+            for _ in range(600):
+                if job.job_state.visited(JobState.RUNNING):
+                    break
+                _time.sleep(0.05)
+            results["reply"] = request_migration(
+                "127.0.0.1", job.hnp.port, "localhost")
+            results["bogus"] = request_migration(
+                "127.0.0.1", job.hnp.port, "no-such-host")
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        rc = job.run(timeout_s=60)
+        t.join(timeout=10)
+        assert rc == 0
+        reply = results.get("reply")
+        assert reply and not reply.get("ok")
+        assert "cannot evacuate" in reply["error"]
+        assert "localhost" not in job._excluded_hosts  # rolled back
+        bogus = results.get("bogus")
+        assert bogus and not bogus.get("ok")
+        assert "no ranks mapped" in bogus["error"]
 
 
 class TestCommSpawn:
