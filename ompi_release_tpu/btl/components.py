@@ -25,7 +25,9 @@ moves HBM arrays, so its limits are MiB-scale.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
+import uuid
 
 import numpy as np
 
@@ -508,8 +510,13 @@ class ShmBtl(base.BtlModule):
         _check_user_tag(tag)
         self._reap_orphaned_segments()
         arr = np.ascontiguousarray(np.asarray(data))
-        seg = shared_memory.SharedMemory(create=True,
-                                         size=max(1, arr.nbytes))
+        # name carries the creator pid so tpu-clean can reap segments
+        # whose owner died without unlinking (orte-clean's leftover-
+        # session duty); uuid tail avoids same-pid collisions
+        seg = shared_memory.SharedMemory(
+            create=True, size=max(1, arr.nbytes),
+            name=f"ompitpu-{os.getpid()}-{uuid.uuid4().hex[:12]}",
+        )
         try:
             # single copy: write straight into the mapping (tobytes()
             # would materialize a second full-size host buffer)

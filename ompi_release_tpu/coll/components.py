@@ -21,7 +21,7 @@ from ..mca import component as mca_component
 from ..mca import var as mca_var
 from ..ops.op import Op
 from ..utils import output
-from . import spmd
+from . import dynamic_rules, spmd
 from .base import COLL_FRAMEWORK
 from .driver import run_sharded
 
@@ -260,6 +260,15 @@ BCAST_ALGORITHMS = ("auto", "binomial", "masked_psum")
 ALLGATHER_ALGORITHMS = ("auto", "ring", "lax")
 ALLTOALL_ALGORITHMS = ("auto", "pairwise", "lax")
 
+# the collectives a dynamic rule file may target, with their legal
+# algorithm names (consumed by coll/dynamic_rules.py at load time)
+dynamic_rules.RULE_COLLECTIVES.update({
+    "allreduce": ALLREDUCE_ALGORITHMS,
+    "bcast": BCAST_ALGORITHMS,
+    "allgather": ALLGATHER_ALGORITHMS,
+    "alltoall": ALLTOALL_ALGORITHMS,
+})
+
 
 class _TunedModule:
     """Hand-written ppermute schedules with tuned's decision rules.
@@ -299,6 +308,9 @@ class _TunedModule:
         n = self.comm.size
         count = x[0].size
         block_dsize = _per_rank_bytes(x)
+        dyn = dynamic_rules.lookup("allreduce", n, block_dsize)
+        if dyn is not None:
+            return dyn
         if block_dsize < mca_var.get("coll_tuned_small_message", 10000):
             return "recursive_doubling"
         if op.commutative and count > n and op.identity is not None:
@@ -343,6 +355,9 @@ class _TunedModule:
     # -- others -----------------------------------------------------------
     def bcast(self, comm, x, root: int):
         alg = mca_var.get("coll_tuned_bcast_algorithm", "auto")
+        if alg == "auto":
+            alg = dynamic_rules.lookup(
+                "bcast", comm.size, _per_rank_bytes(x)) or "auto"
         if alg in ("auto", "binomial"):
             body = lambda xb: spmd.bcast_binomial(xb, AXIS, comm.size, root)
             alg = "binomial"
@@ -365,6 +380,9 @@ class _TunedModule:
 
     def allgather(self, comm, x):
         alg = mca_var.get("coll_tuned_allgather_algorithm", "auto")
+        if alg == "auto":
+            alg = dynamic_rules.lookup(
+                "allgather", comm.size, _per_rank_bytes(x)) or "auto"
         n = comm.size
         if alg in ("auto", "ring"):
             def body(xb):
@@ -394,7 +412,8 @@ class _TunedModule:
     def alltoall(self, comm, x):
         alg = mca_var.get("coll_tuned_alltoall_algorithm", "auto")
         if alg == "auto":
-            alg = "pairwise"
+            alg = dynamic_rules.lookup(
+                "alltoall", comm.size, _per_rank_bytes(x)) or "pairwise"
         if alg not in ALLTOALL_ALGORITHMS:
             from ..utils.errors import ErrorCode, MPIError
 
@@ -498,6 +517,18 @@ class TunedCollComponent(mca_component.Component):
         mca_var.register(
             "coll_tuned_segment_size", "size", 1 << 20,
             "Ring segment size (coll_tuned_decision_fixed.c:71)",
+        )
+        mca_var.register(
+            "coll_tuned_use_dynamic_rules", "bool", False,
+            "Consult the dynamic rule file between operator forcing "
+            "and the fixed decision constants "
+            "(coll_tuned_dynamic_file.c)",
+        )
+        mca_var.register(
+            "coll_tuned_dynamic_rules_filename", "str", "",
+            "Rule file: 'collective min_comm_size min_msg_bytes "
+            "algorithm' lines, last match wins (see "
+            "coll/dynamic_rules.py)",
         )
 
     def query(self, ctx=None):

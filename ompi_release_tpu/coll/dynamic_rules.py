@@ -1,0 +1,126 @@
+"""tuned dynamic rule files — operator-supplied decision tables
+(``ompi/mca/coll/tuned/coll_tuned_dynamic_file.c`` +
+``coll_tuned_dynamic_rules.c`` analogue).
+
+The reference lets an operator replace tuned's compiled-in decision
+constants with a rule file mapping (collective, communicator size,
+message size) to an algorithm, selected with
+``--mca coll_tuned_use_dynamic_rules 1 --mca
+coll_tuned_dynamic_rules_filename FILE``.  Same feature here, with a
+readable line format instead of the reference's positional numeric
+one::
+
+    # collective  min_comm_size  min_msg_bytes  algorithm
+    allreduce     0              0              recursive_doubling
+    allreduce     0              1048576        ring
+    alltoall      8              0              pairwise
+
+The LAST line whose ``min_comm_size <= comm.size`` and
+``min_msg_bytes <= message bytes`` wins (file order = increasing
+specificity, mirroring the reference's nested size tables).  An
+algorithm of ``auto`` falls through to the fixed decision constants.
+
+Precedence inside the tuned component: operator forcing
+(``coll_tuned_<op>_algorithm``) > dynamic rules > fixed constants —
+the reference's order (forcing checked first in
+``coll_tuned_<op>_intra_dec_dynamic``, falling back to the rule
+table, then to the fixed decisions).
+
+Unknown collectives or algorithms fail at LOAD time with the file and
+line number: a typo'd rule silently reverting to defaults would defeat
+the operator's tuning run.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..mca import var as mca_var
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+
+_log = output.Stream("coll")
+
+#: collective name -> algorithms a rule may name (filled by
+#: components.py at import; kept here to avoid a cycle)
+RULE_COLLECTIVES: Dict[str, Tuple[str, ...]] = {}
+
+# (path, mtime) -> parsed rules; a rewritten file is re-parsed, an
+# unchanged one costs a stat per lookup
+_cache: Dict[Tuple[str, float], Dict[str, List[Tuple[int, int, str]]]] = {}
+
+
+def load_rules(path: str) -> Dict[str, List[Tuple[int, int, str]]]:
+    """Parse a rule file into {collective: [(min_n, min_bytes, alg)]}
+    preserving file order."""
+    try:
+        lines = open(path).read().splitlines()
+    except OSError as e:
+        raise MPIError(ErrorCode.ERR_FILE,
+                       f"cannot read dynamic rules file {path}: {e}")
+    rules: Dict[str, List[Tuple[int, int, str]]] = {}
+    for lineno, line in enumerate(lines, 1):
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        if len(parts) != 4:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"{path}:{lineno}: expected 'collective min_comm_size "
+                f"min_msg_bytes algorithm', got '{line}'",
+            )
+        coll, n_s, bytes_s, alg = parts
+        if coll not in RULE_COLLECTIVES:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"{path}:{lineno}: unknown collective '{coll}' "
+                f"(rule-capable: {', '.join(sorted(RULE_COLLECTIVES))})",
+            )
+        try:
+            min_n, min_bytes = int(n_s), int(bytes_s)
+        except ValueError:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"{path}:{lineno}: sizes must be integers in '{line}'",
+            )
+        if min_n < 0 or min_bytes < 0:
+            raise MPIError(ErrorCode.ERR_ARG,
+                           f"{path}:{lineno}: sizes must be >= 0")
+        if alg not in RULE_COLLECTIVES[coll]:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"{path}:{lineno}: unknown {coll} algorithm '{alg}' "
+                f"(choices: {', '.join(RULE_COLLECTIVES[coll])})",
+            )
+        rules.setdefault(coll, []).append((min_n, min_bytes, alg))
+    return rules
+
+
+def lookup(coll: str, comm_size: int, msg_bytes: int) -> Optional[str]:
+    """The algorithm the operator's rule file picks for this call, or
+    None (no file configured / no matching rule / rule says auto)."""
+    if not mca_var.get("coll_tuned_use_dynamic_rules", False):
+        return None
+    path = mca_var.get("coll_tuned_dynamic_rules_filename", "")
+    if not path:
+        return None
+    try:
+        key = (path, os.stat(path).st_mtime)
+    except OSError as e:
+        raise MPIError(ErrorCode.ERR_FILE,
+                       f"dynamic rules file {path} unreadable: {e}")
+    if key not in _cache:
+        _cache.clear()  # at most one live file; drop stale mtimes
+        _cache[key] = load_rules(path)
+    picked: Optional[str] = None
+    for min_n, min_bytes, alg in _cache[key].get(coll, ()):
+        if comm_size >= min_n and msg_bytes >= min_bytes:
+            picked = alg
+    if picked == "auto":
+        return None
+    if picked is not None:
+        _log.verbose(3, f"dynamic rule: {coll} n={comm_size} "
+                        f"bytes={msg_bytes} -> {picked}")
+    return picked

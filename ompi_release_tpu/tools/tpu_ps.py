@@ -30,6 +30,7 @@ from typing import Dict, List, Optional
 from ..native import OobEndpoint
 from ..runtime.coordinator import TAG_PS
 from ..utils.errors import ErrorCode, MPIError
+from ..utils.procutil import pid_alive as _pid_alive
 
 
 class PsClient:
@@ -50,16 +51,6 @@ class PsClient:
         self.ep.close()
 
 
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-        return True
-    except (ProcessLookupError, PermissionError):
-        return False
-    except OSError:
-        return False
-
-
 def discover_jobs() -> List[Dict]:
     """Live jobs from the session contact files (stale files — dead
     launcher pids — are reaped here, the orte-clean-lite duty)."""
@@ -77,7 +68,8 @@ def discover_jobs() -> List[Dict]:
                 info = json.load(f)
         except (OSError, ValueError):
             continue
-        if not _pid_alive(int(info.get("pid", -1))):
+        pid = info.get("pid") if isinstance(info, dict) else None
+        if not isinstance(pid, int) or not _pid_alive(pid):
             try:
                 os.unlink(path)  # stale: launcher is gone
             except OSError:

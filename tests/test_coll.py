@@ -5,6 +5,8 @@ algorithm runs multi-"device" with parity checked against numpy.
 BASELINE.json configs #2-#5 in miniature.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -276,6 +278,86 @@ def test_decision_rules(world):
     assert m._pick_allreduce(huge, ops.SUM) == "segmented_ring"
     noncommut = ops.user_op("left", lambda a, b: a, commute=False)
     assert m._pick_allreduce(mid, noncommut) == "nonoverlapping"
+
+
+def test_dynamic_rules_file(world, tmp_path):
+    """Operator rule file (coll_tuned_dynamic_file.c analogue): last
+    matching (comm_size, msg_bytes) line wins; precedence is forcing >
+    rules > fixed constants; bad files fail at load with line info."""
+    from ompi_release_tpu.coll import dynamic_rules
+    from ompi_release_tpu.coll.components import _TunedModule
+    from ompi_release_tpu.utils.errors import MPIError
+
+    m = _TunedModule(world)
+    mid = np.zeros((8, 300_000), np.float32)  # fixed rules say ring
+    rf = tmp_path / "rules"
+    rf.write_text(
+        "# operator tuning run of 2026-07\n"
+        "allreduce 0 0 recursive_doubling\n"
+        "allreduce 0 1048576 nonoverlapping\n"
+        "allreduce 16 0 ring\n"          # comm too small: never matches
+        "alltoall 0 0 lax\n"
+    )
+    mca_var.set_value("coll_tuned_dynamic_rules_filename", str(rf))
+    try:
+        # not consulted until use_dynamic_rules is on (reference gate)
+        assert m._pick_allreduce(mid, ops.SUM) == "ring"
+        mca_var.set_value("coll_tuned_use_dynamic_rules", True)
+        # 1.2 MB >= 1 MiB: LAST matching line (nonoverlapping) wins
+        assert m._pick_allreduce(mid, ops.SUM) == "nonoverlapping"
+        small = np.zeros((8, 100), np.float32)
+        assert m._pick_allreduce(small, ops.SUM) == "recursive_doubling"
+        # operator forcing still outranks the rule file
+        mca_var.set_value("coll_tuned_allreduce_algorithm", "ring")
+        try:
+            assert m._pick_allreduce(mid, ops.SUM) == "ring"
+        finally:
+            mca_var.VARS.unset("coll_tuned_allreduce_algorithm")
+        # a rewritten file is re-read (mtime cache key)
+        rf.write_text("allreduce 0 0 basic_linear\n")
+        os.utime(rf, (1, 1))  # force a distinct mtime
+        assert m._pick_allreduce(mid, ops.SUM) == "basic_linear"
+        # 'auto' in a rule falls through to the fixed constants
+        rf.write_text("allreduce 0 0 auto\n")
+        os.utime(rf, (2, 2))
+        assert m._pick_allreduce(mid, ops.SUM) == "ring"
+        # load-time validation names the file and line
+        rf.write_text("allreduce 0 0 warp_drive\n")
+        os.utime(rf, (3, 3))
+        with pytest.raises(MPIError, match=r"rules:1.*warp_drive"):
+            m._pick_allreduce(mid, ops.SUM)
+        rf.write_text("gatherv 0 0 ring\n")
+        os.utime(rf, (4, 4))
+        with pytest.raises(MPIError, match="unknown collective"):
+            m._pick_allreduce(mid, ops.SUM)
+        rf.write_text("allreduce 0 ring\n")
+        os.utime(rf, (5, 5))
+        with pytest.raises(MPIError, match="expected"):
+            m._pick_allreduce(mid, ops.SUM)
+    finally:
+        mca_var.VARS.unset("coll_tuned_use_dynamic_rules")
+        mca_var.VARS.unset("coll_tuned_dynamic_rules_filename")
+        dynamic_rules._cache.clear()
+
+
+def test_dynamic_rules_drive_real_collective(tuned, tmp_path):
+    """A rule-selected algorithm actually runs: the compiled-program
+    cache key records the algorithm the rule file picked, and the
+    result keeps parity."""
+    rf = tmp_path / "rules"
+    rf.write_text("allgather 0 0 lax\n")
+    mca_var.set_value("coll_tuned_use_dynamic_rules", True)
+    mca_var.set_value("coll_tuned_dynamic_rules_filename", str(rf))
+    try:
+        x = _per_rank(tuned, 6, seed=23)
+        out = tuned.allgather(x)
+        assert ("tuned", "allgather", "lax") in tuned._coll_programs
+        for r in range(tuned.size):
+            np.testing.assert_array_equal(np.asarray(out[r]),
+                                          x.reshape(-1))
+    finally:
+        mca_var.VARS.unset("coll_tuned_use_dynamic_rules")
+        mca_var.VARS.unset("coll_tuned_dynamic_rules_filename")
 
 
 def test_same_algorithm_bitwise_reproducible(tuned):

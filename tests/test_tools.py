@@ -1,6 +1,7 @@
 """tpu_info CLI + tracing interposition tests."""
 
 import json
+import os
 import subprocess
 import sys
 
@@ -204,3 +205,80 @@ class TestExamples:
         assert r.returncode == 0, r.stderr + r.stdout
         for rank in range(3):
             assert f"I am process {rank} of 3" in r.stdout
+
+
+class TestTpuClean:
+    """tpu-clean (orte-clean analogue): stale sessions + orphaned shm
+    segments of dead jobs are removed; live ones are never touched."""
+
+    def test_clean_reaps_only_dead_owners(self, tmp_path, monkeypatch):
+        import io
+        import json
+        from multiprocessing import shared_memory
+
+        from ompi_release_tpu.tools import tpu_clean, tpurun
+
+        sess = tmp_path / "sessions"
+        sess.mkdir()
+        monkeypatch.setattr(tpurun, "SESSION_DIR", str(sess))
+        # dead-pid file, live file, malformed-but-valid-JSON debris
+        # ({"pid": null} and a JSON list both count), non-JSON debris
+        (sess / "111.json").write_text(json.dumps({"pid": 2 ** 22 + 17}))
+        (sess / "live.json").write_text(json.dumps({"pid": os.getpid()}))
+        (sess / "junk.json").write_text("{not json")
+        (sess / "nullpid.json").write_text('{"pid": null}')
+        (sess / "list.json").write_text("[1, 2]")
+
+        # a per-test prefix isolates the scan from any real ompitpu-*
+        # debris on this machine (and keeps the real clean() pass from
+        # touching segments the test did not create)
+        prefix = f"omtst{os.getpid()}-"
+        dead_seg = shared_memory.SharedMemory(
+            create=True, size=64, name=f"{prefix}{2 ** 22 + 19}-dead")
+        live_seg = shared_memory.SharedMemory(
+            create=True, size=64, name=f"{prefix}{os.getpid()}-live")
+        fresh_dead = shared_memory.SharedMemory(
+            create=True, size=64, name=f"{prefix}{2 ** 22 + 23}-fresh")
+        try:
+            kw = dict(min_age_s=0.0, shm_prefix=prefix)
+            # dry run removes nothing
+            buf = io.StringIO()
+            ns, ng = tpu_clean.clean(dry_run=True, verbose=True,
+                                     out=buf, **kw)
+            assert ns == 4 and ng == 2, buf.getvalue()
+            assert (sess / "111.json").exists()
+            # the min-age gate protects in-flight ownership handoffs
+            # (sender exited, receiver about to map)
+            _, ng_aged = tpu_clean.clean(
+                dry_run=True, min_age_s=3600.0, shm_prefix=prefix,
+                out=buf)
+            assert ng_aged == 0
+            ns, ng = tpu_clean.clean(verbose=True, out=buf, **kw)
+            assert ns == 4 and ng == 2, buf.getvalue()
+            for gone in ("111.json", "junk.json", "nullpid.json",
+                         "list.json"):
+                assert not (sess / gone).exists(), gone
+            assert (sess / "live.json").exists()
+            # dead-creator segments are gone, the live one intact
+            for seg in (dead_seg, fresh_dead):
+                with pytest.raises(FileNotFoundError):
+                    shared_memory.SharedMemory(name=seg.name)
+            shared_memory.SharedMemory(name=live_seg.name).close()
+        finally:
+            for seg in (live_seg, dead_seg, fresh_dead):
+                seg.close()
+                try:
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+
+    def test_cli_reports_counts(self, tmp_path, monkeypatch):
+        import subprocess
+
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_release_tpu.tools.tpu_clean",
+             "--dry-run"],
+            cwd="/root/repo", capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "tpu-clean: would remove" in r.stdout
