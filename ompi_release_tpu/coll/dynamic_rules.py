@@ -109,13 +109,27 @@ def lookup(coll: str, comm_size: int, msg_bytes: int) -> Optional[str]:
     try:
         key = (path, os.stat(path).st_mtime)
     except OSError as e:
-        raise MPIError(ErrorCode.ERR_FILE,
-                       f"dynamic rules file {path} unreadable: {e}")
-    if key not in _cache:
-        _cache.clear()  # at most one live file; drop stale mtimes
-        _cache[key] = load_rules(path)
+        # the file vanished MID-RUN (scratch-dir cleanup): keep
+        # serving the last successfully parsed copy rather than
+        # turning a config deletion into a crash inside the
+        # collective hot path; only a file that never parsed is fatal
+        for (p, _), rules in _cache.items():
+            if p == path:
+                key = None
+                break
+        else:
+            raise MPIError(ErrorCode.ERR_FILE,
+                           f"dynamic rules file {path} unreadable: {e}")
+        _log.verbose(1, f"dynamic rules file {path} vanished; "
+                        "keeping the last parsed rules")
+        rules_for_path = rules
+    if key is not None:
+        if key not in _cache:
+            _cache.clear()  # at most one live file; drop stale mtimes
+            _cache[key] = load_rules(path)
+        rules_for_path = _cache[key]
     picked: Optional[str] = None
-    for min_n, min_bytes, alg in _cache[key].get(coll, ()):
+    for min_n, min_bytes, alg in rules_for_path.get(coll, ()):
         if comm_size >= min_n and msg_bytes >= min_bytes:
             picked = alg
     if picked == "auto":

@@ -10,6 +10,9 @@ and orphaned daemons of dead jobs — maps here to:
   ``ompitpu-<creator pid>-<uuid>`` precisely so this tool can unlink
   segments whose creator died without the receiver ever mapping them
   (the sender-side TTL reaper only runs while the sender lives).
+  Only regular files matching that exact name pattern are candidates;
+  anything else under /dev/shm — including the session directory
+  itself when ``TMPDIR=/dev/shm`` — is never touched.
 
 Segment reaping is double-gated: creator dead AND segment older than
 ``--min-age`` (default 60 s). The age gate exists because ShmBtl
@@ -33,6 +36,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import stat as stat_mod
 import sys
 import time
 from typing import List, Optional, Tuple
@@ -68,9 +72,16 @@ def stale_sessions() -> List[str]:
 
 def orphaned_segments(min_age_s: float = 60.0,
                       shm_prefix: Optional[str] = None
-                      ) -> List[Tuple[str, Optional[int]]]:
-    """(segment name, creator pid) for shm segments with a dead (or
-    unparseable) creator that are at least ``min_age_s`` old."""
+                      ) -> List[Tuple[str, int]]:
+    """(segment name, creator pid) for shm segments with a dead
+    creator that are at least ``min_age_s`` old.
+
+    Only names matching the exact ShmBtl pattern
+    ``<prefix><digits>-...`` on REGULAR files are candidates —
+    anything else under /dev/shm is skipped, never reaped. (The
+    per-user session dir itself lands in /dev/shm when
+    ``TMPDIR=/dev/shm``, and its ``ompitpu-sessions-<uid>`` name
+    would otherwise read as 'unparseable debris'.)"""
     prefix = SHM_PREFIX if shm_prefix is None else shm_prefix
     out = []
     if not os.path.isdir(SHM_DIR):
@@ -80,17 +91,18 @@ def orphaned_segments(min_age_s: float = 60.0,
         if not name.startswith(prefix):
             continue
         try:
-            if now - os.stat(os.path.join(SHM_DIR, name)).st_mtime \
-                    < min_age_s:
-                continue
+            st = os.stat(os.path.join(SHM_DIR, name))
         except OSError:
             continue  # vanished mid-scan
-        rest = name[len(prefix):]
-        try:
-            pid = int(rest.split("-", 1)[0])
-        except ValueError:
-            out.append((name, None))
+        if not stat_mod.S_ISREG(st.st_mode):
             continue
+        if now - st.st_mtime < min_age_s:
+            continue
+        rest = name[len(prefix):]
+        pid_s = rest.split("-", 1)[0]
+        if not pid_s.isdigit():
+            continue  # not a ShmBtl segment: not ours to touch
+        pid = int(pid_s)
         if not pid_alive(pid):
             out.append((name, pid))
     return out
@@ -119,9 +131,9 @@ def clean(dry_run: bool = False, verbose: bool = False,
     n_segs = 0
     for name, pid in orphaned_segments(min_age_s, shm_prefix):
         if verbose or dry_run:
-            who = f"pid {pid} dead" if pid else "unparseable name"
             print(f"{'would remove' if dry_run else 'removing'} "
-                  f"orphaned shm segment {name} ({who})", file=out)
+                  f"orphaned shm segment {name} (pid {pid} dead)",
+                  file=out)
         if not dry_run:
             try:
                 seg = shared_memory.SharedMemory(name=name)

@@ -69,6 +69,7 @@ def discover_jobs() -> List[Dict]:
         except (OSError, ValueError):
             continue
         pid = info.get("pid") if isinstance(info, dict) else None
+        # bool rejection lives in pid_alive (JSON true is an int)
         if not isinstance(pid, int) or not _pid_alive(pid):
             try:
                 os.unlink(path)  # stale: launcher is gone

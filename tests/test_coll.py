@@ -334,6 +334,17 @@ def test_dynamic_rules_file(world, tmp_path):
         os.utime(rf, (5, 5))
         with pytest.raises(MPIError, match="expected"):
             m._pick_allreduce(mid, ops.SUM)
+        # a parsed file that VANISHES mid-run keeps serving its last
+        # good copy (scratch cleanup must not crash the hot path)...
+        rf.write_text("allreduce 0 0 basic_linear\n")
+        os.utime(rf, (6, 6))
+        assert m._pick_allreduce(mid, ops.SUM) == "basic_linear"
+        rf.unlink()
+        assert m._pick_allreduce(mid, ops.SUM) == "basic_linear"
+        # ...but a file that never parsed is a loud failure
+        dynamic_rules._cache.clear()
+        with pytest.raises(MPIError, match="unreadable"):
+            m._pick_allreduce(mid, ops.SUM)
     finally:
         mca_var.VARS.unset("coll_tuned_use_dynamic_rules")
         mca_var.VARS.unset("coll_tuned_dynamic_rules_filename")

@@ -178,6 +178,8 @@ class TestExamples:
 
     @pytest.mark.parametrize("name", [
         "ring_tpu.py", "connectivity_tpu.py", "allreduce_tpu.py",
+        "hello_oshmem_tpu.py", "ring_oshmem_tpu.py",
+        "oshmem_reduction_tpu.py",
     ])
     def test_example_runs_driver_mode(self, name):
         import os
@@ -187,6 +189,12 @@ class TestExamples:
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                             + " --xla_force_host_platform_device_count=8")
+        # the axon environment's sitecustomize (on PYTHONPATH)
+        # preloads jax with the TPU platform pinned, overriding
+        # JAX_PLATFORMS — without stripping it the examples silently
+        # ran single-device on the real chip instead of the 8-device
+        # mesh this test advertises
+        env["PYTHONPATH"] = ""
         r = subprocess.run(
             [sys.executable, f"examples/{name}"], cwd="/root/repo",
             env=env, capture_output=True, text=True, timeout=300,
