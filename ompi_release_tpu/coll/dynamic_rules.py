@@ -125,8 +125,13 @@ def lookup(coll: str, comm_size: int, msg_bytes: int) -> Optional[str]:
         rules_for_path = rules
     if key is not None:
         if key not in _cache:
+            # parse BEFORE dropping the old copy: a mid-run rewrite
+            # with a syntax error must raise while the last-good rules
+            # stay cached (so deleting the broken file falls back to
+            # them instead of becoming fatal)
+            parsed = load_rules(path)
             _cache.clear()  # at most one live file; drop stale mtimes
-            _cache[key] = load_rules(path)
+            _cache[key] = parsed
         rules_for_path = _cache[key]
     picked: Optional[str] = None
     for min_n, min_bytes, alg in rules_for_path.get(coll, ()):

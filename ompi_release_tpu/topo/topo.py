@@ -51,7 +51,22 @@ def dims_create(nnodes: int, ndims: int,
     return tuple(dims)
 
 
-class CartTopo:
+
+class _NonblockingNeighborsMixin:
+    """ineighbor_* (libnbc's nbc_ineighbor_* analogue): XLA dispatch
+    is asynchronous, so the compiled schedule's results are futures
+    wrapped in a Request — the same contract as comm.iallreduce.
+    Mixed into every topology class (each provides the blocking
+    neighbor_* pair and a ``comm``)."""
+
+    def ineighbor_allgather(self, x):
+        return self.comm._async(self.neighbor_allgather(x))
+
+    def ineighbor_alltoall(self, x):
+        return self.comm._async(self.neighbor_alltoall(x))
+
+
+class CartTopo(_NonblockingNeighborsMixin):
     """Cartesian topology attached to a communicator."""
 
     def __init__(self, comm, dims: Sequence[int],
@@ -217,6 +232,7 @@ class CartTopo:
 # schedule baked into the XLA program instead of replayed by a
 # progress engine).
 
+
 class _NeighborSchedule:
     """Edge-colored schedule for one (in_neighbors, out_neighbors)."""
 
@@ -365,7 +381,7 @@ def _neighbor_alltoall_ragged(comm, sched: _NeighborSchedule, x):
     )
 
 
-class GraphTopo:
+class GraphTopo(_NonblockingNeighborsMixin):
     """MPI_Graph_create analogue (index/edges arrays) WITH neighborhood
     collectives over the ragged adjacency (the reference supports
     neighborhood collectives on all three topology kinds,
@@ -413,7 +429,8 @@ class GraphTopo:
         return _neighbor_alltoall_ragged(self.comm, self._schedule(), x)
 
 
-class DistGraphTopo:
+
+class DistGraphTopo(_NonblockingNeighborsMixin):
     """MPI_Dist_graph_create_adjacent analogue (driver mode: per-rank
     adjacency, one controller playing every rank) with neighborhood
     collectives over the directed ragged edge set."""
@@ -460,6 +477,7 @@ class DistGraphTopo:
         """x (size, max_out_degree, ...): rank r's block j goes to
         destinations[r][j]; result slot i came from sources[r][i]."""
         return _neighbor_alltoall_ragged(self.comm, self._sched, x)
+
 
 
 def cart_create(comm, dims: Sequence[int],

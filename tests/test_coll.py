@@ -335,10 +335,16 @@ def test_dynamic_rules_file(world, tmp_path):
         with pytest.raises(MPIError, match="expected"):
             m._pick_allreduce(mid, ops.SUM)
         # a parsed file that VANISHES mid-run keeps serving its last
-        # good copy (scratch cleanup must not crash the hot path)...
+        # good copy (scratch cleanup must not crash the hot path);
+        # a mid-run REWRITE with a syntax error raises but preserves
+        # that copy too (parse-before-clear)
         rf.write_text("allreduce 0 0 basic_linear\n")
         os.utime(rf, (6, 6))
         assert m._pick_allreduce(mid, ops.SUM) == "basic_linear"
+        rf.write_text("allreduce broken\n")
+        os.utime(rf, (7, 7))
+        with pytest.raises(MPIError, match="expected"):
+            m._pick_allreduce(mid, ops.SUM)
         rf.unlink()
         assert m._pick_allreduce(mid, ops.SUM) == "basic_linear"
         # ...but a file that never parsed is a loud failure

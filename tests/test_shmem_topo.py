@@ -387,3 +387,38 @@ class TestShmemExtendedApi:
         )
         ctx.finalize()
         shmem._ctx = None
+
+
+class TestNonblockingNeighborhoods:
+    """ineighbor_* (libnbc nbc_ineighbor_*): the compiled schedule is
+    dispatched asynchronously; the Request completes to the same
+    result the blocking call returns."""
+
+    def test_cart_ineighbor_allgather(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[True, True])
+        x = np.arange(world.size, dtype=np.float32)[:, None]
+        req = topo.ineighbor_allgather(x)
+        req.wait()
+        out = np.asarray(req.value)
+        np.testing.assert_array_equal(
+            out, np.asarray(topo.neighbor_allgather(x)))
+        c.free()
+
+    def test_graph_ineighbor_alltoall_matches_blocking(self, world):
+        index, edges = [], []
+        acc = 0
+        for r in range(world.size):
+            nbrs = [(r - 1) % world.size, (r + 1) % world.size]
+            acc += len(nbrs)
+            index.append(acc)
+            edges.extend(nbrs)
+        g, topo = graph_create(world, index, edges)
+        n = world.size
+        x = np.random.RandomState(3).randn(n, 2, 3).astype(np.float32)
+        req = topo.ineighbor_alltoall(x)
+        assert hasattr(req, "test") or hasattr(req, "wait")
+        req.wait()
+        np.testing.assert_array_equal(
+            np.asarray(req.value),
+            np.asarray(topo.neighbor_alltoall(x)))
+        g.free()

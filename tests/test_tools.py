@@ -191,10 +191,14 @@ class TestExamples:
                             + " --xla_force_host_platform_device_count=8")
         # the axon environment's sitecustomize (on PYTHONPATH)
         # preloads jax with the TPU platform pinned, overriding
-        # JAX_PLATFORMS — without stripping it the examples silently
+        # JAX_PLATFORMS — without filtering it the examples silently
         # ran single-device on the real chip instead of the 8-device
-        # mesh this test advertises
-        env["PYTHONPATH"] = ""
+        # mesh this test advertises (surgical: other PYTHONPATH
+        # entries a dev setup relies on stay)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in os.path.basename(p)
+        )
         r = subprocess.run(
             [sys.executable, f"examples/{name}"], cwd="/root/repo",
             env=env, capture_output=True, text=True, timeout=300,
