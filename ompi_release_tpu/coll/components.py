@@ -257,8 +257,20 @@ ALLREDUCE_ALGORITHMS = (
     "ring", "segmented_ring",
 )
 BCAST_ALGORITHMS = ("auto", "binomial", "masked_psum")
-ALLGATHER_ALGORITHMS = ("auto", "ring", "lax")
-ALLTOALL_ALGORITHMS = ("auto", "pairwise", "lax")
+ALLGATHER_ALGORITHMS = (
+    # mirror of coll_tuned_allgather.c's menu (two_procs is subsumed
+    # by bruck at n=2 — one round, identical exchange; the
+    # even-n neighbor_exchange large-message case maps to ring, whose
+    # structure IS the neighbor pass — substitutions documented in
+    # the decision fn)
+    "auto", "ring", "bruck", "recursive_doubling", "lax",
+)
+ALLTOALL_ALGORITHMS = (
+    # coll_tuned_alltoall.c menu: basic_linear (all exchanges posted
+    # at once = the one-shot fused lax.all_to_all here; two_procs is
+    # its n=2 case), bruck (log-phase store-and-forward), pairwise
+    "auto", "pairwise", "bruck", "basic_linear", "lax",
+)
 
 # the collectives a dynamic rule file may target, with their legal
 # algorithm names (consumed by coll/dynamic_rules.py at load time)
@@ -378,22 +390,61 @@ class _TunedModule:
 
         return run_sharded(comm, ("tuned", "reduce", op.name, root), body, x)
 
+    def _pick_allgather(self, x) -> str:
+        """coll_tuned_decision_fixed.c:537-567: total < 50 kB ->
+        recursive doubling (power-of-two n) else bruck; larger ->
+        ring. (The reference's large/even-n pick, neighbor_exchange,
+        maps to ring here — ring's step IS the neighbor pass; its
+        n==2 special case, two_procs, is bruck's one round.)"""
+        forced = mca_var.get("coll_tuned_allgather_algorithm", "auto")
+        if forced != "auto":
+            return forced
+        n = self.comm.size
+        total = _per_rank_bytes(x) * n
+        dyn = dynamic_rules.lookup("allgather", n, total)
+        if dyn is not None:
+            return dyn
+        if total < mca_var.get("coll_tuned_allgather_small_total",
+                               50_000):
+            return "recursive_doubling" if n & (n - 1) == 0 else "bruck"
+        return "ring"
+
     def allgather(self, comm, x):
-        alg = mca_var.get("coll_tuned_allgather_algorithm", "auto")
-        if alg == "auto":
-            alg = dynamic_rules.lookup(
-                "allgather", comm.size, _per_rank_bytes(x)) or "auto"
+        alg = self._pick_allgather(x)
         n = comm.size
-        if alg in ("auto", "ring"):
+        if alg not in ALLGATHER_ALGORITHMS or alg == "auto":
+            from ..utils.errors import ErrorCode, MPIError
+
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"unknown allgather algorithm '{alg}' "
+                f"(choices: {ALLGATHER_ALGORITHMS})",
+            )
+        if alg == "recursive_doubling" and n & (n - 1):
+            from ..utils.errors import ErrorCode, MPIError
+
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"recursive_doubling allgather needs power-of-two "
+                f"ranks (got {n}); use bruck",
+            )
+
+        def flat(fn):
             def body(xb):
-                g = spmd.allgather_ring(xb, AXIS, n)
+                g = fn(xb)
                 return g.reshape((-1,) + g.shape[2:])
-            alg = "ring"
-        else:
-            def body(xb):
-                g = spmd.allgather_lax(xb, AXIS)
-                return g.reshape((-1,) + g.shape[2:])
-        return run_sharded(comm, ("tuned", "allgather", alg), body, x)
+            return body
+
+        bodies = {
+            "ring": flat(lambda xb: spmd.allgather_ring(xb, AXIS, n)),
+            "bruck": flat(lambda xb: spmd.allgather_bruck(xb, AXIS, n)),
+            "recursive_doubling": flat(
+                lambda xb: spmd.allgather_recursive_doubling(xb, AXIS, n)
+            ),
+            "lax": flat(lambda xb: spmd.allgather_lax(xb, AXIS)),
+        }
+        return run_sharded(comm, ("tuned", "allgather", alg),
+                           bodies[alg], x)
 
     def reduce_scatter_block(self, comm, x, op: Op):
         n = comm.size
@@ -409,11 +460,26 @@ class _TunedModule:
             comm, ("tuned", "reduce_scatter_block", op.name), body, x
         )
 
+    def _pick_alltoall(self, x) -> str:
+        """coll_tuned_decision_fixed.c:124-133: per-destination block
+        < 200 B at n > 12 -> bruck; block < 3000 B -> basic_linear;
+        else pairwise."""
+        forced = mca_var.get("coll_tuned_alltoall_algorithm", "auto")
+        if forced != "auto":
+            return forced
+        n = self.comm.size
+        block = _per_rank_bytes(x) // max(1, n)
+        dyn = dynamic_rules.lookup("alltoall", n, block)
+        if dyn is not None:
+            return dyn
+        if block < 200 and n > 12:
+            return "bruck"
+        if block < 3000:
+            return "basic_linear"
+        return "pairwise"
+
     def alltoall(self, comm, x):
-        alg = mca_var.get("coll_tuned_alltoall_algorithm", "auto")
-        if alg == "auto":
-            alg = dynamic_rules.lookup(
-                "alltoall", comm.size, _per_rank_bytes(x)) or "pairwise"
+        alg = self._pick_alltoall(x)
         if alg not in ALLTOALL_ALGORITHMS:
             from ..utils.errors import ErrorCode, MPIError
 
@@ -423,7 +489,12 @@ class _TunedModule:
                 f"(choices: {ALLTOALL_ALGORITHMS})",
             )
         n = comm.size
-        fn = spmd.alltoall_lax if alg == "lax" else spmd.alltoall_pairwise
+        fn = {
+            "lax": spmd.alltoall_lax,
+            "basic_linear": spmd.alltoall_lax,  # one-shot posted set
+            "bruck": spmd.alltoall_bruck,
+            "pairwise": spmd.alltoall_pairwise,
+        }[alg]
 
         def body(xb):
             blocks = xb.reshape((n, -1) + xb.shape[1:])
@@ -517,6 +588,12 @@ class TunedCollComponent(mca_component.Component):
         mca_var.register(
             "coll_tuned_segment_size", "size", 1 << 20,
             "Ring segment size (coll_tuned_decision_fixed.c:71)",
+        )
+        mca_var.register(
+            "coll_tuned_allgather_small_total", "size", 50_000,
+            "Below this many TOTAL bytes, allgather uses recursive "
+            "doubling (power-of-two ranks) or bruck "
+            "(coll_tuned_decision_fixed.c:544-559)",
         )
         mca_var.register(
             "coll_tuned_use_dynamic_rules", "bool", False,

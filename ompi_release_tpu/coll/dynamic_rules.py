@@ -20,6 +20,20 @@ The LAST line whose ``min_comm_size <= comm.size`` and
 specificity, mirroring the reference's nested size tables).  An
 algorithm of ``auto`` falls through to the fixed decision constants.
 
+``min_msg_bytes`` is measured in each collective's OWN decision
+unit — the same size its fixed decision rule tests, exactly like the
+reference (each ``*_intra_dec_fixed`` computes its own
+dsize/block_dsize/total_dsize):
+
+======== =================================================
+allreduce  bytes per rank (``block_dsize``)
+bcast      bytes per rank
+allgather  TOTAL bytes across the comm (``total_dsize``,
+           coll_tuned_decision_fixed.c:535)
+alltoall   bytes per DESTINATION BLOCK (``block_dsize``,
+           coll_tuned_decision_fixed.c:122 — per-rank / n)
+======== =================================================
+
 Precedence inside the tuned component: operator forcing
 (``coll_tuned_<op>_algorithm``) > dynamic rules > fixed constants —
 the reference's order (forcing checked first in

@@ -336,6 +336,61 @@ def allgather_lax(x: jax.Array, axis_name: str) -> jax.Array:
     return lax.all_gather(x, axis_name, axis=0)
 
 
+def allgather_bruck(x: jax.Array, axis_name: str, n: int) -> jax.Array:
+    """Bruck allgather (``coll_tuned_allgather.c``
+    ``allgather_intra_bruck``): ceil(log2 n) doubling rounds for ANY
+    n, then a final rotation.
+
+    Local position i holds block (rank + i) mod n throughout; round k
+    appends ``min(cnt, n - cnt)`` blocks received from rank + cnt, so
+    every round's slice sizes are STATIC (the python loop unrolls into
+    the compiled program) while the final re-index by rank is the only
+    traced-value gather."""
+    rank = lax.axis_index(axis_name)
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, 0, 0)
+    cnt = 1
+    while cnt < n:
+        send_cnt = min(cnt, n - cnt)
+        # data flows r -> r - cnt (mod n): each rank receives the
+        # leading send_cnt blocks of rank + cnt, which are that
+        # rank's blocks (rank + cnt + j) = our blocks cnt + j
+        perm = [(i, (i - cnt) % n) for i in range(n)]
+        recv = lax.ppermute(out[:send_cnt], axis_name, perm)
+        out = lax.dynamic_update_slice_in_dim(out, recv, cnt, axis=0)
+        cnt += send_cnt
+    # local order is (rank, rank+1, ...): rotate to index order
+    idx = (jnp.arange(n) - rank) % n
+    return jnp.take(out, idx, axis=0)
+
+
+def allgather_recursive_doubling(x: jax.Array, axis_name: str,
+                                 n: int) -> jax.Array:
+    """Recursive-doubling allgather (``coll_tuned_allgather.c``
+    ``allgather_intra_recursivedoubling``): power-of-two n only, like
+    the reference (callers decline otherwise). After round k every
+    rank holds its 2^(k+1)-aligned group's blocks at their NATURAL
+    indices, so no final rotation is needed; the per-round exchanged
+    region has static size 2^k at a traced (rank-aligned) base."""
+    if n & (n - 1):
+        raise ValueError(f"recursive-doubling allgather needs "
+                         f"power-of-two ranks, got {n}")
+    rank = lax.axis_index(axis_name)
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, rank, 0)
+    k = 1
+    while k < n:
+        base = (rank // k) * k  # start of our filled k-block group
+        mine = lax.dynamic_slice_in_dim(out, base, k, axis=0)
+        perm = [(i, i ^ k) for i in range(n)]
+        recv = lax.ppermute(mine, axis_name, perm)
+        # partner's group sits at the bit-k mirrored base
+        out = lax.dynamic_update_slice_in_dim(out, recv, base ^ k,
+                                              axis=0)
+        k *= 2
+    return out
+
+
 def allgather_ring(x: jax.Array, axis_name: str, n: int) -> jax.Array:
     """Neighbor-exchange ring allgather (coll_tuned_allgather.c ring)."""
     rank = lax.axis_index(axis_name)
@@ -402,6 +457,35 @@ def alltoall_lax(x: jax.Array, axis_name: str, n: int) -> jax.Array:
     """x: (n, chunk...) per rank; out[j] = what rank j sent me."""
     return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
                           tiled=False)
+
+
+def alltoall_bruck(blocks: jax.Array, axis_name: str, n: int) -> jax.Array:
+    """Bruck alltoall (``coll_tuned_alltoall.c``
+    ``alltoall_intra_bruck``): log2(n) store-and-forward phases moving
+    n/2 blocks each — latency-optimal for small blocks at large n,
+    at the cost of forwarding.
+
+    Invariant: after the initial rotation, position j at rank r holds
+    a block destined to rank r + j; phase k moves every position
+    whose index has bit k set FORWARD by k ranks (stored at the same
+    position), so a block starting at offset j arrives after its
+    set-bit hops exactly at its destination, at position j.  The
+    phase masks are STATIC (python loop, static index lists); only
+    the first/last rotations index by the traced rank."""
+    rank = lax.axis_index(axis_name)
+    idx = (rank + jnp.arange(n)) % n
+    local = jnp.take(blocks, idx, axis=0)  # local[j] -> dest rank+j
+    k = 1
+    while k < n:
+        idxs = [j for j in range(n) if j & k]
+        sel = local[jnp.array(idxs)]
+        perm = [(i, (i + k) % n) for i in range(n)]
+        recv = lax.ppermute(sel, axis_name, perm)
+        local = local.at[jnp.array(idxs)].set(recv)
+        k *= 2
+    # position j now holds the block FROM rank - j (destined here)
+    out_idx = (rank - jnp.arange(n)) % n
+    return jnp.take(local, out_idx, axis=0)
 
 
 def alltoall_pairwise(x: jax.Array, axis_name: str, n: int) -> jax.Array:

@@ -95,6 +95,11 @@ class Runtime:
                 "the wire router); false = each process's world spans "
                 "only its local devices (pre-unification behavior)",
             )
+            mca_var.register(
+                "runtime_timing", "bool", False,
+                "Report per-stage init timing after bring-up (the "
+                "ompi_timing var, ompi_mpi_init.c:366-371,617-625)",
+            )
             if cli_args:
                 pairs = _parse_mca_cli(cli_args)
                 mca_var.VARS.apply_cli(pairs)
@@ -168,7 +173,23 @@ class Runtime:
                 f"initialized: {len(self.endpoints)} ranks on "
                 f"{self.mesh.devices.shape} mesh",
             )
+            if mca_var.get("runtime_timing", False):
+                self._report_init_timing()
             return self.world
+
+    def _report_init_timing(self) -> None:
+        """The ``ompi_timing`` report: per-stage durations from the
+        job state machine's timestamped history (the reference prints
+        coarse init-phase timings when the var is set,
+        ``ompi_mpi_init.c:435-437,617-625``)."""
+        hist = self.job_state.history()
+        if len(hist) < 2:
+            return
+        total = (hist[-1][0] - hist[0][0]) * 1e3
+        _log.info(f"init timing (total {total:.1f} ms):")
+        for (t0, s0, _), (t1, _, _) in zip(hist, hist[1:]):
+            name = self.job_state._fmt(s0)
+            _log.info(f"  {name:<14} {(t1 - t0) * 1e3:8.1f} ms")
 
     def _build_unified_world(self, peer_cards: List[Dict]) -> None:
         """Form the union world: every process's devices become world

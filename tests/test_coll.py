@@ -39,10 +39,14 @@ def tuned(world):
 
 
 def _per_rank(world, n, dtype=np.float32, seed=0):
+    return _per_rank_n(world.size, n, dtype, seed)
+
+
+def _per_rank_n(size, n, dtype=np.float32, seed=0):
     rng = np.random.RandomState(seed)
     if np.issubdtype(np.dtype(dtype), np.floating):
-        return rng.randn(world.size, n).astype(dtype)
-    return rng.randint(0, 100, size=(world.size, n)).astype(dtype)
+        return rng.randn(size, n).astype(dtype)
+    return rng.randint(0, 100, size=(size, n)).astype(dtype)
 
 
 ALGS = ["basic_linear", "nonoverlapping", "recursive_doubling", "ring",
@@ -137,10 +141,84 @@ def test_allgather(world):
 
 def test_allgather_ring(tuned):
     x = _per_rank(tuned, 10, seed=18)
-    out = tuned.allgather(x)
+    mca_var.set_value("coll_tuned_allgather_algorithm", "ring")
+    try:
+        out = tuned.allgather(x)
+    finally:
+        mca_var.VARS.unset("coll_tuned_allgather_algorithm")
     assert ("tuned", "allgather", "ring") in tuned._coll_programs
     for r in range(tuned.size):
         np.testing.assert_array_equal(np.asarray(out[r]), x.reshape(-1))
+
+
+@pytest.mark.parametrize("alg", ["ring", "bruck", "recursive_doubling",
+                                 "lax"])
+def test_allgather_algorithms_parity(tuned, alg):
+    """Every named allgather algorithm (coll_tuned_allgather.c menu)
+    agrees bitwise with the input blocks."""
+    x = _per_rank(tuned, 13, seed=41)
+    mca_var.set_value("coll_tuned_allgather_algorithm", alg)
+    try:
+        out = tuned.allgather(x)
+    finally:
+        mca_var.VARS.unset("coll_tuned_allgather_algorithm")
+    assert ("tuned", "allgather", alg) in tuned._coll_programs
+    for r in range(tuned.size):
+        np.testing.assert_array_equal(np.asarray(out[r]), x.reshape(-1))
+
+
+def test_allgather_bruck_non_power_of_two(world):
+    """Bruck handles ANY n (its point over recursive doubling): run it
+    on a 5-rank subcommunicator; forced recursive doubling there is a
+    loud error, mirroring the reference's pow2-only implementation."""
+    from ompi_release_tpu.utils.errors import MPIError
+
+    mca_var.set_value("coll", "tuned")
+    try:
+        sub = world.create(world.group.incl([0, 1, 2, 3, 4]),
+                           name="tuned5")
+    finally:
+        mca_var.VARS.unset("coll")
+    try:
+        x = _per_rank_n(5, 7, seed=42)
+        mca_var.set_value("coll_tuned_allgather_algorithm", "bruck")
+        try:
+            out = sub.allgather(x)
+        finally:
+            mca_var.VARS.unset("coll_tuned_allgather_algorithm")
+        assert ("tuned", "allgather", "bruck") in sub._coll_programs
+        for r in range(5):
+            np.testing.assert_array_equal(np.asarray(out[r]),
+                                          x.reshape(-1))
+        mca_var.set_value("coll_tuned_allgather_algorithm",
+                          "recursive_doubling")
+        try:
+            with pytest.raises(MPIError, match="power-of-two"):
+                sub.allgather(x)
+        finally:
+            mca_var.VARS.unset("coll_tuned_allgather_algorithm")
+    finally:
+        sub.free()
+
+
+def test_allgather_bad_algorithm_rejected(tuned):
+    """A typo'd forced algorithm is rejected at CONFIG time by the
+    enum variable (listing the choices), before any collective runs;
+    the in-function menu check stays as defense-in-depth."""
+    with pytest.raises(ValueError, match="ringg.*not in enum"):
+        mca_var.set_value("coll_tuned_allgather_algorithm", "ringg")
+
+
+def test_allgather_decision_rule(tuned):
+    """coll_tuned_decision_fixed.c:537-567: small total -> recursive
+    doubling at power-of-two n; large -> ring."""
+    from ompi_release_tpu.coll.components import _TunedModule
+
+    m = _TunedModule(tuned)
+    small = np.zeros((8, 100), np.float32)    # 3.2 kB total < 50 kB
+    assert m._pick_allgather(small) == "recursive_doubling"
+    big = np.zeros((8, 30_000), np.float32)   # 960 kB total
+    assert m._pick_allgather(big) == "ring"
 
 
 def test_gather_scatter(world):
@@ -199,10 +277,51 @@ def test_alltoall(world):
 def test_alltoall_pairwise(tuned):
     n = tuned.size
     x = _per_rank(tuned, n * 4, dtype=np.int32, seed=31)
-    out = tuned.alltoall(x)
+    mca_var.set_value("coll_tuned_alltoall_algorithm", "pairwise")
+    try:
+        out = tuned.alltoall(x)
+    finally:
+        mca_var.VARS.unset("coll_tuned_alltoall_algorithm")
     assert ("tuned", "alltoall", "pairwise") in tuned._coll_programs
     expect = x.reshape(n, n, 4).transpose(1, 0, 2).reshape(n, -1)
     np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+@pytest.mark.parametrize("alg", ["pairwise", "bruck", "basic_linear",
+                                 "lax"])
+def test_alltoall_algorithms_parity(tuned, alg):
+    """Every named alltoall algorithm (coll_tuned_alltoall.c menu,
+    incl. bruck's log-phase store-and-forward) produces the block
+    transpose bitwise."""
+    n = tuned.size
+    x = _per_rank(tuned, n * 5, dtype=np.int32, seed=33)
+    mca_var.set_value("coll_tuned_alltoall_algorithm", alg)
+    try:
+        out = tuned.alltoall(x)
+    finally:
+        mca_var.VARS.unset("coll_tuned_alltoall_algorithm")
+    assert ("tuned", "alltoall", alg) in tuned._coll_programs
+    expect = x.reshape(n, n, 5).transpose(1, 0, 2).reshape(n, -1)
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_alltoall_decision_rule(tuned):
+    """coll_tuned_decision_fixed.c:124-133: tiny blocks at n > 12 ->
+    bruck; blocks < 3000 B -> basic_linear; else pairwise."""
+    from types import SimpleNamespace
+
+    from ompi_release_tpu.coll.components import _TunedModule
+
+    m = _TunedModule(tuned)  # n = 8
+    tiny = np.zeros((8, 8 * 4), np.int8)      # 4 B blocks, n <= 12
+    assert m._pick_alltoall(tiny) == "basic_linear"
+    mid = np.zeros((8, 8 * 500), np.float32)  # 2 kB blocks
+    assert m._pick_alltoall(mid) == "basic_linear"
+    big = np.zeros((8, 8 * 1000), np.float32)  # 4 kB blocks
+    assert m._pick_alltoall(big) == "pairwise"
+    m16 = _TunedModule(SimpleNamespace(size=16))
+    tiny16 = np.zeros((16, 16 * 4), np.int8)  # 4 B blocks, n > 12
+    assert m16._pick_alltoall(tiny16) == "bruck"
 
 
 def test_alltoall_lax_forced(tuned):

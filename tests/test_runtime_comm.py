@@ -200,3 +200,33 @@ def test_env_utility_surface(world):
     assert mpi.error_string(ErrorCode.ERR_RANK) == "ERR_RANK"
     assert mpi.error_string(6) == "ERR_RANK"
     assert "unknown" in mpi.error_string(99999)
+
+
+def test_init_timing_report():
+    """The ompi_timing analogue: with runtime_timing set, init prints
+    per-stage durations from the job state machine's timestamped
+    history (ompi_mpi_init.c:366-371,617-625)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["OMPITPU_MCA_runtime_timing"] = "1"
+    # filter only the axon sitecustomize (it pins the TPU platform,
+    # overriding JAX_PLATFORMS); other PYTHONPATH entries stay
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in os.path.basename(p)
+    )
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import ompi_release_tpu as mpi; mpi.init(); mpi.finalize()"],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    err = r.stderr
+    assert "init timing (total" in err, err
+    for stage in ("INIT", "ALLOCATE", "MAP", "VM_READY", "RUNNING"):
+        assert stage in err, err
