@@ -118,25 +118,17 @@ class _XlaModule:
         return run_sharded(comm, ("xla", "allgather"), body, x)
 
     def gather(self, comm, x, root: int):
-        def body(xb):
-            g = lax.all_gather(xb, AXIS, axis=0)
-            g = g.reshape((-1,) + g.shape[2:])
-            rank = lax.axis_index(AXIS)
-            return jnp.where(rank == root, g, jnp.zeros_like(g))
-
-        return run_sharded(comm, ("xla", "gather", root), body, x)
+        return run_sharded(
+            comm, ("xla", "gather", root),
+            lambda xb: spmd.gather_linear(xb, AXIS, comm.size, root), x,
+        )
 
     def scatter(self, comm, x, root: int):
-        n = comm.size
-
-        def body(xb):
-            # xb: root's slice holds n chunks back-to-back
-            full = spmd.bcast_masked_psum(xb, xb.dtype, AXIS, root)
-            chunks = full.reshape((n, -1) + full.shape[1:])
-            rank = lax.axis_index(AXIS)
-            return jnp.take(chunks, rank, axis=0)
-
-        return run_sharded(comm, ("xla", "scatter", root), body, x)
+        # x: root's slice holds n chunks back-to-back
+        return run_sharded(
+            comm, ("xla", "scatter", root),
+            lambda xb: spmd.scatter_linear(xb, AXIS, comm.size, root), x,
+        )
 
     def reduce_scatter_block(self, comm, x, op: Op):
         n = comm.size
@@ -271,6 +263,11 @@ ALLTOALL_ALGORITHMS = (
     # its n=2 case), bruck (log-phase store-and-forward), pairwise
     "auto", "pairwise", "bruck", "basic_linear", "lax",
 )
+# coll_tuned_{gather,scatter}.c menus; both linear_sync branches map
+# to linear (the sync round-trip protects an eager receiver from
+# overrun — no analogue in a compiled SPMD exchange)
+GATHER_ALGORITHMS = ("auto", "binomial", "linear")
+SCATTER_ALGORITHMS = ("auto", "binomial", "linear")
 
 # the collectives a dynamic rule file may target, with their legal
 # algorithm names (consumed by coll/dynamic_rules.py at load time)
@@ -300,6 +297,8 @@ class _TunedModule:
             "bcast": self.bcast,
             "reduce": self.reduce,
             "allgather": self.allgather,
+            "gather": self.gather,
+            "scatter": self.scatter,
             "reduce_scatter_block": self.reduce_scatter_block,
             "alltoall": self.alltoall,
             "scan": self.scan,
@@ -460,6 +459,55 @@ class _TunedModule:
             comm, ("tuned", "reduce_scatter_block", op.name), body, x
         )
 
+    # -- gather / scatter (coll_tuned_{gather,scatter}.c) -----------------
+    def _pick_gather(self, x) -> str:
+        """coll_tuned_decision_fixed.c:677-734: block > 6000 B ->
+        linear (the reference's two linear_SYNC branches — the sync
+        round-trip protects an eager receiver from overrun, which a
+        compiled SPMD exchange has no analogue of, so both map to
+        linear here, documented); n > 60, or n > 10 with block
+        < 1024 B -> binomial; else basic linear."""
+        forced = mca_var.get("coll_tuned_gather_algorithm", "auto")
+        if forced != "auto":
+            return forced
+        n = self.comm.size
+        block = _per_rank_bytes(x)
+        if block > 6000:
+            return "linear"
+        if n > 60 or (n > 10 and block < 1024):
+            return "binomial"
+        return "linear"
+
+    def gather(self, comm, x, root: int):
+        alg = self._pick_gather(x)
+        n = comm.size
+        if alg == "binomial":
+            body = lambda xb: spmd.gather_binomial(xb, AXIS, n, root)
+        else:
+            body = lambda xb: spmd.gather_linear(xb, AXIS, n, root)
+        return run_sharded(comm, ("tuned", "gather", alg, root), body, x)
+
+    def _pick_scatter(self, x) -> str:
+        """coll_tuned_decision_fixed.c:744-770: n > 10 with block
+        < 300 B -> binomial; else basic linear. Block size is the
+        per-destination chunk of root's buffer."""
+        forced = mca_var.get("coll_tuned_scatter_algorithm", "auto")
+        if forced != "auto":
+            return forced
+        n = self.comm.size
+        block = _per_rank_bytes(x) // max(1, n)
+        return "binomial" if (n > 10 and block < 300) else "linear"
+
+    def scatter(self, comm, x, root: int):
+        n = comm.size
+        alg = self._pick_scatter(x)
+        if alg == "binomial":
+            body = lambda xb: spmd.scatter_binomial(xb, AXIS, n, root)
+        else:
+            body = lambda xb: spmd.scatter_linear(xb, AXIS, n, root)
+        return run_sharded(comm, ("tuned", "scatter", alg, root),
+                           body, x)
+
     def _pick_alltoall(self, x) -> str:
         """coll_tuned_decision_fixed.c:124-133: per-destination block
         < 200 B at n > 12 -> bruck; block < 3000 B -> basic_linear;
@@ -588,6 +636,16 @@ class TunedCollComponent(mca_component.Component):
         mca_var.register(
             "coll_tuned_segment_size", "size", 1 << 20,
             "Ring segment size (coll_tuned_decision_fixed.c:71)",
+        )
+        mca_var.register(
+            "coll_tuned_gather_algorithm", "enum", "auto",
+            "Force a specific gather algorithm",
+            choices=GATHER_ALGORITHMS,
+        )
+        mca_var.register(
+            "coll_tuned_scatter_algorithm", "enum", "auto",
+            "Force a specific scatter algorithm",
+            choices=SCATTER_ALGORITHMS,
         )
         mca_var.register(
             "coll_tuned_allgather_small_total", "size", 50_000,

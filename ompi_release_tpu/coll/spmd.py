@@ -332,6 +332,105 @@ def reduce_binomial(x: jax.Array, op: Op, axis_name: str, n: int,
 # allgather / gather / scatter
 # ---------------------------------------------------------------------------
 
+def gather_linear(x: jax.Array, axis_name: str, n: int,
+                  root: int = 0) -> jax.Array:
+    """Linear gather (``coll_tuned_gather.c`` basic_linear; also the
+    xla component's body): one fused allgather, root keeps it."""
+    g = lax.all_gather(x, axis_name, axis=0)
+    g = g.reshape((-1,) + g.shape[2:])
+    rank = lax.axis_index(axis_name)
+    return jnp.where(rank == root, g, jnp.zeros_like(g))
+
+
+def scatter_linear(x: jax.Array, axis_name: str, n: int,
+                   root: int = 0) -> jax.Array:
+    """Linear scatter (basic_linear; also the xla component's body):
+    bcast root's buffer, take the own chunk."""
+    full = bcast_masked_psum(x, x.dtype, axis_name, root)
+    chunks = full.reshape((n, -1) + full.shape[1:])
+    rank = lax.axis_index(axis_name)
+    return jnp.take(chunks, rank, axis=0)
+
+
+def gather_binomial(x: jax.Array, axis_name: str, n: int,
+                    root: int = 0) -> jax.Array:
+    """Binomial-tree gather (``coll_tuned_gather.c``
+    ``gather_intra_binomial``): log2(n) rounds; at round k the ranks
+    whose root-relative vrank has LOWEST set bit k forward their
+    accumulated k-block range to vrank - k.  Each round moves exactly
+    k blocks (STATIC slice size at a traced, clamped base — true
+    binomial volume, not a full-buffer echo); clamped window entries
+    outside the sender's own range are masked to zero and receivers
+    merge additively into a read-modify-write of the same window, so
+    non-power-of-two edge ranks stay correct.  Non-root ranks end
+    masked to zeros (MPI leaves them undefined).  Returns (n*block,)
+    on root's slice, rank order."""
+    rank = lax.axis_index(axis_name)
+    v = (rank - root) % n
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, v, 0)
+    k = 1
+    while k < n:
+        is_sender = (v & (2 * k - 1)) == k  # lowest set bit == k
+        s_send = jnp.minimum(v, n - k)      # clamped own-range base
+        window = lax.dynamic_slice_in_dim(out, s_send, k, 0)
+        valid = ((s_send + jnp.arange(k)) >= v).reshape(
+            (k,) + (1,) * (out.ndim - 1))
+        contrib = jnp.where(is_sender & valid, window,
+                            jnp.zeros_like(window))
+        perm = [(i, (i - k) % n) for i in range(n)]
+        recv = lax.ppermute(contrib, axis_name, perm)
+        # the child's base min(v_child, n-k) = min(v + k, n - k)
+        s_recv = jnp.minimum(v + k, n - k)
+        cur = lax.dynamic_slice_in_dim(out, s_recv, k, 0)
+        out = lax.dynamic_update_slice_in_dim(out, cur + recv,
+                                              s_recv, 0)
+        k *= 2
+    # vrank-space -> rank order: result[i] = out[(i - root) % n];
+    # root is STATIC, so this is a static roll
+    out = jnp.roll(out, shift=root, axis=0)
+    flat = out.reshape((-1,) + x.shape[1:])
+    return jnp.where(rank == root, flat, jnp.zeros_like(flat))
+
+
+def scatter_binomial(x: jax.Array, axis_name: str, n: int,
+                     root: int = 0) -> jax.Array:
+    """Binomial-tree scatter (``coll_tuned_scatter.c``
+    ``scatter_intra_binomial``): the mirror of binomial gather —
+    root starts with all n blocks; at round k (descending) every
+    range holder passes its upper-half k blocks to vrank + k, again
+    as a STATIC-size slice at a clamped traced base with masked
+    overlap and additive merge (k blocks per round, true binomial
+    volume).  ``x`` is the root's (n*block,) buffer; returns own
+    block."""
+    rank = lax.axis_index(axis_name)
+    v = (rank - root) % n
+    blocks = x.reshape((n,) + (x.shape[0] // n,) + x.shape[1:])
+    # vrank-index the buffer (static roll by -root) and zero non-root
+    buf = jnp.where(rank == root,
+                    jnp.roll(blocks, shift=-root, axis=0),
+                    jnp.zeros_like(blocks))
+    k = 1 << max(0, (n - 1).bit_length() - 1)
+    while k >= 1:
+        # the child vrank v + k must exist (non-power-of-two n)
+        is_sender = ((v % (2 * k)) == 0) & (v + k < n)
+        s_send = jnp.minimum(v + k, n - k)  # upper-half base, clamped
+        window = lax.dynamic_slice_in_dim(buf, s_send, k, 0)
+        valid = ((s_send + jnp.arange(k)) >= v + k).reshape(
+            (k,) + (1,) * (buf.ndim - 1))
+        contrib = jnp.where(is_sender & valid, window,
+                            jnp.zeros_like(window))
+        perm = [(i, (i + k) % n) for i in range(n)]
+        recv = lax.ppermute(contrib, axis_name, perm)
+        # own-range base: the parent's upper half IS [v, v + k)
+        s_recv = jnp.minimum(v, n - k)
+        cur = lax.dynamic_slice_in_dim(buf, s_recv, k, 0)
+        buf = lax.dynamic_update_slice_in_dim(buf, cur + recv,
+                                              s_recv, 0)
+        k //= 2
+    return jnp.take(buf, v, axis=0)
+
+
 def allgather_lax(x: jax.Array, axis_name: str) -> jax.Array:
     return lax.all_gather(x, axis_name, axis=0)
 
