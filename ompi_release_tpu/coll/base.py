@@ -37,8 +37,9 @@ def comm_select(comm) -> Dict[str, Callable]:
     from . import components as _components  # noqa: F401
 
     # chain per op: highest priority first; a provider may decline at
-    # call time by returning None (e.g. tuned's reduce declines
-    # non-commutative ops), and the next provider takes over — the
+    # call time by returning None (e.g. tuned's reduce_scatter_block
+    # declines non-commutative ops; xla's scan declines past its
+    # gather-size limit), and the next provider takes over — the
     # runtime analogue of the reference re-querying on NOT_AVAILABLE
     chains: Dict[str, list] = {}
     providers: Dict[str, list] = {}

@@ -248,7 +248,14 @@ ALLREDUCE_ALGORITHMS = (
     "auto", "basic_linear", "nonoverlapping", "recursive_doubling",
     "ring", "segmented_ring",
 )
-BCAST_ALGORITHMS = ("auto", "binomial", "masked_psum")
+BCAST_ALGORITHMS = (
+    # coll_tuned_bcast.c menu; split_bintree maps to binary_tree (the
+    # split-halves+exchange trick optimizes bidirectional link use,
+    # which the XLA scheduler owns on a compiled program); basic_linear
+    # is masked_psum's one-shot
+    "auto", "binomial", "binary_tree", "chain", "pipeline",
+    "masked_psum",
+)
 ALLGATHER_ALGORITHMS = (
     # mirror of coll_tuned_allgather.c's menu (two_procs is subsumed
     # by bruck at n=2 — one round, identical exchange; the
@@ -268,6 +275,12 @@ ALLTOALL_ALGORITHMS = (
 # overrun — no analogue in a compiled SPMD exchange)
 GATHER_ALGORITHMS = ("auto", "binomial", "linear")
 SCATTER_ALGORITHMS = ("auto", "binomial", "linear")
+# coll_tuned_reduce.c menu: binomial (commutative; the segmented
+# binomial/pipeline picks keep its structure — segmentation is the
+# compiler's domain in a compiled program), in_order_binary
+# (noncommutative-safe contiguous-rank-range tree), linear (strict
+# left fold)
+REDUCE_ALGORITHMS = ("auto", "binomial", "in_order_binary", "linear")
 
 # the collectives a dynamic rule file may target, with their legal
 # algorithm names (consumed by coll/dynamic_rules.py at load time)
@@ -364,30 +377,108 @@ class _TunedModule:
         return run_sharded(comm, key, bodies[alg], x)
 
     # -- others -----------------------------------------------------------
+    def _pick_bcast(self, x) -> tuple:
+        """coll_tuned_decision_fixed.c bcast_intra_dec_fixed: < 2048 B
+        -> binomial; < 370728 B -> split_bintree@1k (binary_tree
+        here); larger -> pipeline with the segment size chosen by the
+        reference's regression lines (128/64/16/8 KiB as the comm
+        grows relative to a_pXX * msg + b_pXX). Returns
+        (algorithm, segment_bytes)."""
+        forced = mca_var.get("coll_tuned_bcast_algorithm", "auto")
+        if forced != "auto":
+            return forced, int(mca_var.get(
+                "coll_tuned_bcast_segment_size", 128 << 10))
+        n = self.comm.size
+        msg = _per_rank_bytes(x)
+        dyn = dynamic_rules.lookup("bcast", n, msg)
+        if dyn is not None:
+            return dyn, int(mca_var.get(
+                "coll_tuned_bcast_segment_size", 128 << 10))
+        if msg < 2048:
+            return "binomial", 0
+        if msg < 370728:
+            return "binary_tree", 1 << 10
+        if n < 1.6134e-6 * msg + 2.1102:   # a_p128/b_p128
+            return "pipeline", 128 << 10
+        if n < 13:
+            return "binary_tree", 8 << 10
+        if n < 2.3679e-6 * msg + 1.1787:   # a_p64/b_p64
+            return "pipeline", 64 << 10
+        if n < 3.2118e-6 * msg + 8.7936:   # a_p16/b_p16
+            return "pipeline", 16 << 10
+        return "pipeline", 8 << 10
+
     def bcast(self, comm, x, root: int):
-        alg = mca_var.get("coll_tuned_bcast_algorithm", "auto")
-        if alg == "auto":
-            alg = dynamic_rules.lookup(
-                "bcast", comm.size, _per_rank_bytes(x)) or "auto"
-        if alg in ("auto", "binomial"):
-            body = lambda xb: spmd.bcast_binomial(xb, AXIS, comm.size, root)
-            alg = "binomial"
-        else:
-            body = lambda xb: spmd.bcast_masked_psum(xb, xb.dtype, AXIS, root)
-        return run_sharded(comm, ("tuned", "bcast", alg, root), body, x)
+        alg, segbytes = self._pick_bcast(x)
+        n = comm.size
+        # floor at one element: a misconfigured segment size of 0
+        # must degrade to per-element streaming, not a negative-pad
+        # reshape crash inside the kernel
+        seg_elems = max(1, segbytes // x.dtype.itemsize) \
+            if hasattr(x, "dtype") else 1
+        bodies = {
+            "binomial": lambda xb: spmd.bcast_binomial(xb, AXIS, n, root),
+            "binary_tree": lambda xb: spmd.bcast_binary_tree(
+                xb, AXIS, n, root),
+            "chain": lambda xb: spmd.bcast_chain(xb, AXIS, n, root),
+            "pipeline": lambda xb: spmd.bcast_pipeline(
+                xb, AXIS, n, root, seg_elems),
+            "masked_psum": lambda xb: spmd.bcast_masked_psum(
+                xb, xb.dtype, AXIS, root),
+        }
+        # the segment size is baked into the compiled pipeline
+        key = ("tuned", "bcast", alg, root) + (
+            (seg_elems,) if alg == "pipeline" else ()
+        )
+        return run_sharded(comm, key, bodies[alg], x)
+
+    def _pick_reduce(self, x, op: Op) -> str:
+        """coll_tuned_decision_fixed.c reduce_intra_dec_fixed:
+        noncommutative -> linear when small (< 12 ranks and < 2 kB)
+        else in_order_binary; commutative -> linear for tiny
+        (< 8 ranks, < 512 B), binomial otherwise (the reference's
+        segmented binomial/pipeline picks keep binomial's structure —
+        segmentation is the compiler's scheduling domain here)."""
+        forced = mca_var.get("coll_tuned_reduce_algorithm", "auto")
+        if forced != "auto":
+            return forced
+        n = self.comm.size
+        msg = _per_rank_bytes(x)
+        if not op.commutative:
+            if n < 12 and msg < 2048:
+                return "linear"
+            return "in_order_binary"
+        if n < 8 and msg < 512:
+            return "linear"
+        return "binomial"
 
     def reduce(self, comm, x, op: Op, root: int):
         n = comm.size
-        if not op.commutative:
-            return None  # defer to a lower-priority linear implementation
+        alg = self._pick_reduce(x, op)
+        if alg == "binomial" and not op.commutative:
+            from ..utils.errors import ErrorCode, MPIError
+
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                "binomial reduce rotates operand order by root; use "
+                "in_order_binary or linear for a noncommutative op",
+            )
         op = _resolve_op(op, x)
 
-        def body(xb):
+        def binom(xb):
             red = spmd.reduce_binomial(xb, op, AXIS, n, root)
             rank = lax.axis_index(AXIS)
             return jnp.where(rank == root, red, jnp.zeros_like(red))
 
-        return run_sharded(comm, ("tuned", "reduce", op.name, root), body, x)
+        bodies = {
+            "binomial": binom,
+            "in_order_binary": lambda xb: spmd.reduce_in_order_binary(
+                xb, op, AXIS, n, root),
+            "linear": lambda xb: spmd.reduce_linear(
+                xb, op, AXIS, n, root),
+        }
+        return run_sharded(comm, ("tuned", "reduce", alg, op.name, root),
+                           bodies[alg], x)
 
     def _pick_allgather(self, x) -> str:
         """coll_tuned_decision_fixed.c:537-567: total < 50 kB ->
@@ -638,6 +729,16 @@ class TunedCollComponent(mca_component.Component):
             "Ring segment size (coll_tuned_decision_fixed.c:71)",
         )
         mca_var.register(
+            "coll_tuned_reduce_algorithm", "enum", "auto",
+            "Force a specific reduce algorithm",
+            choices=REDUCE_ALGORITHMS,
+        )
+        mca_var.register(
+            "coll_tuned_bcast_segment_size", "size", 128 << 10,
+            "Segment size for a FORCED pipeline bcast (auto mode uses "
+            "the reference's regression-picked 8-128 KiB)",
+        )
+        mca_var.register(
             "coll_tuned_gather_algorithm", "enum", "auto",
             "Force a specific gather algorithm",
             choices=GATHER_ALGORITHMS,
@@ -680,7 +781,9 @@ class TunedCollComponent(mca_component.Component):
 
 class _BasicModule:
     """Linear algorithms (``ompi/mca/coll/basic``): the correctness
-    yardstick; also the only non-commutative-safe reduce path."""
+    yardstick. (tuned's reduce also handles non-commutative ops now,
+    via in_order_binary/linear — this module remains the
+    always-correct fallback, not the only safe path.)"""
 
     def __init__(self, comm) -> None:
         self.comm = comm

@@ -287,6 +287,101 @@ def bcast_binomial(x: jax.Array, axis_name: str, n: int,
     return x
 
 
+def bcast_binary_tree(x: jax.Array, axis_name: str, n: int,
+                      root: int = 0) -> jax.Array:
+    """Balanced-binary-tree broadcast (``coll_tuned_bcast.c``
+    ``bcast_intra_bintree``; stands in for the intermediate-size
+    split_bintree pick too — the split-halves+exchange trick
+    optimizes bidirectional link use, which the XLA scheduler already
+    owns on a compiled torus program, so the plain binary tree is the
+    faithful structure here).  Depth ceil(log2(n+1)) levels; each
+    level is two static ppermutes (left edges, right edges — one
+    parent feeds two children, which a single permutation cannot
+    express)."""
+    if n == 1:
+        return x
+    rank = lax.axis_index(axis_name)
+    rank_of = lambda vv: (vv + root) % n
+    v = (rank - root) % n
+    depth = n.bit_length()  # heap levels 0..depth-1
+    for lvl in range(depth):
+        for side in (1, 2):  # left child 2v+1, right child 2v+2
+            perm = [
+                (rank_of(vs), rank_of(2 * vs + side))
+                for vs in range(n)
+                if (vs + 1).bit_length() - 1 == lvl
+                and 2 * vs + side < n
+            ]
+            if not perm:
+                continue
+            recv = lax.ppermute(x, axis_name, perm)
+            # receivers: children of this level's parents — parity
+            # identifies the side (left children odd, right even>0),
+            # the static level bounds identify the depth
+            child_par = (v % 2 == 1) if side == 1 else \
+                (v % 2 == 0) & (v > 0)
+            child_lvl = (v + 1 >= (1 << (lvl + 1))) & \
+                (v + 1 < (1 << (lvl + 2)))
+            x = jnp.where(child_par & child_lvl, recv, x)
+    return x
+
+
+def bcast_chain(x: jax.Array, axis_name: str, n: int,
+                root: int = 0) -> jax.Array:
+    """Chain broadcast (``coll_tuned_bcast.c`` chain fanout=1): the
+    value forwards rank-to-rank, n-1 hops."""
+    if n == 1:
+        return x
+    rank = lax.axis_index(axis_name)
+    rank_of = lambda v: (v + root) % n
+    v = (rank - root) % n
+    for hop in range(n - 1):
+        perm = [(rank_of(hop), rank_of(hop + 1))]
+        recv = lax.ppermute(x, axis_name, perm)
+        x = jnp.where(v == hop + 1, recv, x)
+    return x
+
+
+def bcast_pipeline(x: jax.Array, axis_name: str, n: int, root: int,
+                   seg_elems: int) -> jax.Array:
+    """Pipelined (segmented chain) broadcast (``coll_tuned_bcast.c``
+    ``bcast_intra_pipeline``): the flat buffer splits into S segments
+    that stream down the rank chain, one hop per tick — S + n - 2
+    ticks total, the GPipe schedule shape (parallel/pp.py uses the
+    same loop).  Segment s reaches vrank v at tick s + v; every tick
+    is ONE static ppermute of a segment-sized buffer plus traced
+    dynamic slicing."""
+    if n == 1:
+        return x
+    flat = x.reshape(-1)
+    total = flat.shape[0]
+    S = max(1, -(-total // max(1, seg_elems)))
+    pad = S * seg_elems - total
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    segs = flat.reshape(S, seg_elems)
+    rank = lax.axis_index(axis_name)
+    v = (rank - root) % n
+    perm = [((i + root) % n, (i + 1 + root) % n) for i in range(n - 1)]
+
+    def tick(t, buf):
+        # each rank forwards the segment it received at tick t-1:
+        # rank v sends segment t - v (if it holds it)
+        sidx = jnp.clip(t - v, 0, S - 1)
+        outgoing = jnp.take(buf, sidx, axis=0)
+        recv = lax.ppermute(outgoing, axis_name, perm)
+        # receiver v stores segment t - (v - 1) at that index
+        ridx = jnp.clip(t - (v - 1), 0, S - 1)
+        valid = (t - (v - 1) >= 0) & (t - (v - 1) < S) & (v > 0)
+        cur = jnp.take(buf, ridx, axis=0)
+        new = jnp.where(valid, recv, cur)
+        return lax.dynamic_update_index_in_dim(buf, new, ridx, 0)
+
+    segs = lax.fori_loop(0, S + n - 2, tick, segs)
+    out = segs.reshape(-1)[:total]
+    return out.reshape(x.shape)
+
+
 def bcast_masked_psum(x: jax.Array, op_dtype, axis_name: str,
                       root: int = 0) -> jax.Array:
     """One-collective bcast: zero all non-root contributions and psum.
@@ -326,6 +421,47 @@ def reduce_binomial(x: jax.Array, op: Op, axis_name: str, n: int,
         is_receiver = (v % (2 * d) == 0) & (v + d < n)
         x = jnp.where(is_receiver, op(x, recv), x)
     return x
+
+
+def reduce_in_order_binary(x: jax.Array, op: Op, axis_name: str,
+                           n: int, root: int = 0) -> jax.Array:
+    """In-order binary-tree reduce (``coll_tuned_reduce.c``
+    ``reduce_intra_in_order_binary``): the noncommutative-safe rooted
+    reduce.  Unlike :func:`reduce_binomial` (whose root-relative
+    vranks ROTATE the operand order when root != 0), this tree merges
+    contiguous TRUE-rank ranges — every combine is
+    ``op(lower range, upper range)``, so operands keep strict rank
+    order 0..n-1; only the grouping is balanced (allowed: MPI requires
+    associativity, never commutation).  The result lands on rank 0
+    and takes one final hop to a non-zero root."""
+    if n == 1:
+        return x
+    rank = lax.axis_index(axis_name)
+    k = 1
+    while k < n:
+        perm = [(rs, rs - k) for rs in range(k, n, 2 * k)]
+        recv = lax.ppermute(x, axis_name, perm)
+        is_recv = (rank % (2 * k) == 0) & (rank + k < n)
+        x = jnp.where(is_recv, op(x, recv), x)
+        k *= 2
+    if root != 0:
+        moved = lax.ppermute(x, axis_name, [(0, root)])
+        x = jnp.where(rank == root, moved, x)
+    rankv = lax.axis_index(axis_name)
+    return jnp.where(rankv == root, x, jnp.zeros_like(x))
+
+
+def reduce_linear(x: jax.Array, op: Op, axis_name: str, n: int,
+                  root: int = 0) -> jax.Array:
+    """Linear reduce (``reduce_intra_basic_linear``): gather all
+    blocks to every rank, fold LEFT-TO-RIGHT in rank order at root —
+    the strict sequential order, noncommutative-safe."""
+    g = lax.all_gather(x, axis_name, axis=0)  # (n, ...)
+    acc = g[0]
+    for i in range(1, n):
+        acc = op(acc, g[i])
+    rank = lax.axis_index(axis_name)
+    return jnp.where(rank == root, acc, jnp.zeros_like(acc))
 
 
 # ---------------------------------------------------------------------------
@@ -378,7 +514,13 @@ def gather_binomial(x: jax.Array, axis_name: str, n: int,
             (k,) + (1,) * (out.ndim - 1))
         contrib = jnp.where(is_sender & valid, window,
                             jnp.zeros_like(window))
-        perm = [(i, (i - k) % n) for i in range(n)]
+        # only the true sender set is on the wire (the sender set is
+        # static in vrank space): non-listed ranks ship NOTHING and
+        # non-targets receive zeros — k blocks per edge, (n/2k) edges,
+        # the real binomial volume
+        rank_of = lambda vv: (vv + root) % n
+        perm = [(rank_of(vs), rank_of(vs - k))
+                for vs in range(n) if (vs & (2 * k - 1)) == k]
         recv = lax.ppermute(contrib, axis_name, perm)
         # the child's base min(v_child, n-k) = min(v + k, n - k)
         s_recv = jnp.minimum(v + k, n - k)
@@ -420,7 +562,12 @@ def scatter_binomial(x: jax.Array, axis_name: str, n: int,
             (k,) + (1,) * (buf.ndim - 1))
         contrib = jnp.where(is_sender & valid, window,
                             jnp.zeros_like(window))
-        perm = [(i, (i + k) % n) for i in range(n)]
+        # static sender set only (see gather_binomial): true binomial
+        # wire volume
+        rank_of = lambda vv: (vv + root) % n
+        perm = [(rank_of(vs), rank_of(vs + k))
+                for vs in range(n)
+                if vs % (2 * k) == 0 and vs + k < n]
         recv = lax.ppermute(contrib, axis_name, perm)
         # own-range base: the parent's upper half IS [v, v + k)
         s_recv = jnp.minimum(v, n - k)

@@ -124,10 +124,90 @@ def test_bcast_binomial(tuned):
         np.testing.assert_array_equal(np.asarray(out[r]), x[5])
 
 
+@pytest.mark.parametrize("alg", ["binomial", "binary_tree", "chain",
+                                 "pipeline", "masked_psum"])
+def test_bcast_algorithms_parity(tuned, alg):
+    """Every named bcast algorithm (coll_tuned_bcast.c menu incl. the
+    segmented pipeline chain) delivers root's buffer bitwise."""
+    x = _per_rank(tuned, 700, seed=61)  # pipeline: several segments
+    mca_var.set_value("coll_tuned_bcast_algorithm", alg)
+    if alg == "pipeline":
+        mca_var.set_value("coll_tuned_bcast_segment_size", 512)
+    try:
+        out = tuned.bcast(x, root=5)
+    finally:
+        mca_var.VARS.unset("coll_tuned_bcast_algorithm")
+        if alg == "pipeline":
+            mca_var.VARS.unset("coll_tuned_bcast_segment_size")
+    assert any(k[:3] == ("tuned", "bcast", alg)
+               for k in tuned._coll_programs)
+    for r in range(tuned.size):
+        np.testing.assert_array_equal(np.asarray(out[r]), x[5])
+
+
+def test_bcast_decision_rule(tuned):
+    """bcast_intra_dec_fixed: <2 kB binomial; <362 kB binary tree
+    (split_bintree substitute); large -> pipeline with regression-
+    picked segments."""
+    from ompi_release_tpu.coll.components import _TunedModule
+
+    m = _TunedModule(tuned)
+    small = np.zeros((8, 100), np.float32)
+    assert m._pick_bcast(small) == ("binomial", 0)
+    mid = np.zeros((8, 50_000), np.float32)
+    assert m._pick_bcast(mid) == ("binary_tree", 1 << 10)
+    big = np.zeros((8, 3_000_000), np.float32)  # 12 MB: n=8 << a*msg+b
+    alg, seg = m._pick_bcast(big)
+    assert alg == "pipeline" and seg == 128 << 10
+
+
 def test_reduce(world):
     x = _per_rank(world, 100, seed=13)
     out = world.reduce(x, ops.SUM, root=2)
     np.testing.assert_allclose(np.asarray(out[2]), x.sum(axis=0), rtol=2e-5)
+
+
+@pytest.mark.parametrize("alg", ["binomial", "in_order_binary",
+                                 "linear"])
+def test_reduce_algorithms_parity(tuned, alg):
+    """Every named rooted-reduce algorithm agrees with numpy."""
+    x = _per_rank(tuned, 64, seed=63)
+    mca_var.set_value("coll_tuned_reduce_algorithm", alg)
+    try:
+        out = tuned.reduce(x, ops.SUM, root=3)
+    finally:
+        mca_var.VARS.unset("coll_tuned_reduce_algorithm")
+    assert any(k[:3] == ("tuned", "reduce", alg)
+               for k in tuned._coll_programs)
+    np.testing.assert_allclose(np.asarray(out[3]), x.sum(axis=0),
+                               rtol=2e-5, atol=1e-4)
+
+
+def test_reduce_noncommutative_in_order(tuned):
+    """A noncommutative op is served by in_order_binary (strict rank
+    order, no root rotation): op(a, b) = a + 2b distinguishes operand
+    ORDER; expected value computed by numpy with the same balanced
+    contiguous-range grouping."""
+    n = tuned.size
+    f = lambda a, b: a + 2 * b
+    noncommut = ops.user_op("affine", f, commute=False)
+    # > 2 kB so the decision picks in_order_binary (small
+    # noncommutative goes to the strict linear fold)
+    x = _per_rank(tuned, 1024, seed=64)
+    out = tuned.reduce(x, noncommut, root=2)
+    assert any(k[:3] == ("tuned", "reduce", "in_order_binary")
+               for k in tuned._coll_programs)
+
+    # same grouping as the kernel: pairwise merges at stride k
+    blocks = [x[i] for i in range(n)]
+    k = 1
+    while k < n:
+        for i in range(0, n, 2 * k):
+            if i + k < n:
+                blocks[i] = f(blocks[i], blocks[i + k])
+        k *= 2
+    np.testing.assert_allclose(np.asarray(out[2]), blocks[0],
+                               rtol=1e-6)
 
 
 def test_allgather(world):
