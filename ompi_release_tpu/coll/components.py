@@ -289,6 +289,9 @@ dynamic_rules.RULE_COLLECTIVES.update({
     "bcast": BCAST_ALGORITHMS,
     "allgather": ALLGATHER_ALGORITHMS,
     "alltoall": ALLTOALL_ALGORITHMS,
+    "reduce": REDUCE_ALGORITHMS,
+    "gather": GATHER_ALGORITHMS,
+    "scatter": SCATTER_ALGORITHMS,
 })
 
 
@@ -444,6 +447,11 @@ class _TunedModule:
             return forced
         n = self.comm.size
         msg = _per_rank_bytes(x)
+        dyn = dynamic_rules.lookup("reduce", n, msg)
+        if dyn is not None:
+            if not op.commutative and dyn == "binomial":
+                dyn = "in_order_binary"  # rule may not break order
+            return dyn
         if not op.commutative:
             if n < 12 and msg < 2048:
                 return "linear"
@@ -453,6 +461,8 @@ class _TunedModule:
         return "binomial"
 
     def reduce(self, comm, x, op: Op, root: int):
+        if op.is_pair_op:
+            return None  # pair ops stay with xla's gather path
         n = comm.size
         alg = self._pick_reduce(x, op)
         if alg == "binomial" and not op.commutative:
@@ -563,6 +573,9 @@ class _TunedModule:
             return forced
         n = self.comm.size
         block = _per_rank_bytes(x)
+        dyn = dynamic_rules.lookup("gather", n, block)
+        if dyn is not None:
+            return dyn
         if block > 6000:
             return "linear"
         if n > 60 or (n > 10 and block < 1024):
@@ -587,6 +600,9 @@ class _TunedModule:
             return forced
         n = self.comm.size
         block = _per_rank_bytes(x) // max(1, n)
+        dyn = dynamic_rules.lookup("scatter", n, block)
+        if dyn is not None:
+            return dyn
         return "binomial" if (n > 10 and block < 300) else "linear"
 
     def scatter(self, comm, x, root: int):

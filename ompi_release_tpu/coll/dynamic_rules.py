@@ -28,11 +28,18 @@ dsize/block_dsize/total_dsize):
 ======== =================================================
 allreduce  bytes per rank (``block_dsize``)
 bcast      bytes per rank
+reduce     bytes per rank
+gather     bytes per rank (the per-rank block the root collects)
+scatter    bytes per DESTINATION BLOCK (per-rank / n)
 allgather  TOTAL bytes across the comm (``total_dsize``,
            coll_tuned_decision_fixed.c:535)
 alltoall   bytes per DESTINATION BLOCK (``block_dsize``,
            coll_tuned_decision_fixed.c:122 — per-rank / n)
 ======== =================================================
+
+For reduce, a rule naming ``binomial`` on a NONCOMMUTATIVE op is
+upgraded to ``in_order_binary`` (binomial's root-relative vranks
+rotate operand order; a config file cannot waive MPI semantics).
 
 Precedence inside the tuned component: operator forcing
 (``coll_tuned_<op>_algorithm``) > dynamic rules > fixed constants —

@@ -437,29 +437,22 @@ def reduce_in_order_binary(x: jax.Array, op: Op, axis_name: str,
     if n == 1:
         return x
     rank = lax.axis_index(axis_name)
-    k = 1
-    while k < n:
-        perm = [(rs, rs - k) for rs in range(k, n, 2 * k)]
-        recv = lax.ppermute(x, axis_name, perm)
-        is_recv = (rank % (2 * k) == 0) & (rank + k < n)
-        x = jnp.where(is_recv, op(x, recv), x)
-        k *= 2
+    # at root 0, reduce_binomial's vranks ARE true ranks and its
+    # op(lower, upper) combines are already contiguous-range in-order
+    # merges — reuse that schedule, then hop to a non-zero root
+    x = reduce_binomial(x, op, axis_name, n, root=0)
     if root != 0:
         moved = lax.ppermute(x, axis_name, [(0, root)])
         x = jnp.where(rank == root, moved, x)
-    rankv = lax.axis_index(axis_name)
-    return jnp.where(rankv == root, x, jnp.zeros_like(x))
+    return jnp.where(rank == root, x, jnp.zeros_like(x))
 
 
 def reduce_linear(x: jax.Array, op: Op, axis_name: str, n: int,
                   root: int = 0) -> jax.Array:
-    """Linear reduce (``reduce_intra_basic_linear``): gather all
-    blocks to every rank, fold LEFT-TO-RIGHT in rank order at root —
-    the strict sequential order, noncommutative-safe."""
-    g = lax.all_gather(x, axis_name, axis=0)  # (n, ...)
-    acc = g[0]
-    for i in range(1, n):
-        acc = op(acc, g[i])
+    """Linear reduce (``reduce_intra_basic_linear``): the canonical
+    rank-order left fold of :func:`allreduce_basic_linear`, kept at
+    root only — ONE definition of the strict sequential order."""
+    acc = allreduce_basic_linear(x, op, axis_name, n)
     rank = lax.axis_index(axis_name)
     return jnp.where(rank == root, acc, jnp.zeros_like(acc))
 

@@ -264,9 +264,23 @@ class VarRegistry:
                 raise PermissionError(
                     f"variable {name!r} has scope {var.scope.name}"
                 )
+            had_prev = name in self._overrides
+            prev = self._overrides.get(name)
             self._overrides[name] = value
             if var is not None:
-                self._resolve(var)
+                try:
+                    self._resolve(var)
+                except ValueError:
+                    # a REJECTED set must not poison the registry: the
+                    # stored override would make every later get() of
+                    # this variable raise (observed as cross-test
+                    # contamination) — roll back to the prior state
+                    if had_prev:
+                        self._overrides[name] = prev
+                    else:
+                        del self._overrides[name]
+                    self._resolve(var)
+                    raise
 
     def unset(self, name: str) -> None:
         with self._lock:

@@ -612,6 +612,40 @@ def test_dynamic_rules_file(world, tmp_path):
         dynamic_rules._cache.clear()
 
 
+def test_dynamic_rules_cover_rooted_collectives(world, tmp_path):
+    """reduce/gather/scatter consult the rule file too (every tuned
+    decision function is rule-capable, like the reference's tables);
+    a noncommutative op refuses a rule that would break operand
+    order."""
+    from ompi_release_tpu.coll import dynamic_rules
+    from ompi_release_tpu.coll.components import _TunedModule
+
+    m = _TunedModule(world)
+    rf = tmp_path / "rules"
+    rf.write_text(
+        "reduce 0 0 linear\n"
+        "gather 0 0 binomial\n"
+        "scatter 0 0 binomial\n"
+    )
+    mca_var.set_value("coll_tuned_use_dynamic_rules", True)
+    mca_var.set_value("coll_tuned_dynamic_rules_filename", str(rf))
+    try:
+        x = np.zeros((8, 5000), np.float32)
+        assert m._pick_reduce(x, ops.SUM) == "linear"
+        assert m._pick_gather(x) == "binomial"
+        assert m._pick_scatter(x) == "binomial"
+        rf.write_text("reduce 0 0 binomial\n")
+        os.utime(rf, (11, 11))
+        noncommut = ops.user_op("left", lambda a, b: a, commute=False)
+        # the rule says binomial, but binomial rotates operand order:
+        # the noncommutative op is upgraded to in_order_binary
+        assert m._pick_reduce(x, noncommut) == "in_order_binary"
+    finally:
+        mca_var.VARS.unset("coll_tuned_use_dynamic_rules")
+        mca_var.VARS.unset("coll_tuned_dynamic_rules_filename")
+        dynamic_rules._cache.clear()
+
+
 def test_dynamic_rules_drive_real_collective(tuned, tmp_path):
     """A rule-selected algorithm actually runs: the compiled-program
     cache key records the algorithm the rule file picked, and the

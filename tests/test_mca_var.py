@@ -136,3 +136,24 @@ def test_readonly_launch_time_override_applies(fresh_mca):
     assert v.value == 9
     with pytest.raises(PermissionError):
         fresh_mca.set_value("early_ro", 10)
+
+
+def test_rejected_set_value_does_not_poison_registry(fresh_mca):
+    """A set_value rejected by enum validation must roll back: the
+    stored bad override would otherwise make every later get() raise
+    (observed as cross-test contamination before the fix)."""
+    import pytest
+
+    from ompi_release_tpu.mca import var as mca_var
+
+    mca_var.register("poison_probe", "enum", "a",
+                     "rollback probe", choices=("a", "b"))
+    mca_var.set_value("poison_probe", "b")
+    with pytest.raises(ValueError, match="not in enum"):
+        mca_var.set_value("poison_probe", "zz")
+    # prior override survives the rejected set
+    assert mca_var.get("poison_probe") == "b"
+    mca_var.VARS.unset("poison_probe")
+    with pytest.raises(ValueError):
+        mca_var.set_value("poison_probe", "zz")
+    assert mca_var.get("poison_probe") == "a"  # default restored

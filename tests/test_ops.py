@@ -239,6 +239,14 @@ def test_bench_end_to_end_on_simulator_mesh():
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=8")
+    # the axon sitecustomize (on PYTHONPATH) pins the TPU platform,
+    # overriding JAX_PLATFORMS: without filtering it this "simulator
+    # mesh" test silently benched the real tunneled chip — slow, and
+    # hostage to chip contention (same fix as the examples test)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in os.path.basename(p)
+    )
     r = subprocess.run(
         [sys.executable, "bench.py"], cwd="/root/repo", env=env,
         capture_output=True, text=True, timeout=900,

@@ -209,10 +209,21 @@ class TestExamples:
     def test_hello_under_tpurun(self):
         import subprocess
 
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        # filter the axon sitecustomize: it pins the TPU platform, and
+        # 3 workers contending for the one tunneled chip hang whenever
+        # another tenant holds it — this launch test is about tpurun,
+        # not the chip
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in os.path.basename(p)
+        )
         r = subprocess.run(
             [sys.executable, "-m", "ompi_release_tpu.tools.tpurun",
              "-n", "3", sys.executable, "examples/hello_tpu.py"],
-            cwd="/root/repo", capture_output=True, text=True, timeout=300,
+            cwd="/root/repo", env=env, capture_output=True, text=True,
+            timeout=300,
         )
         assert r.returncode == 0, r.stderr + r.stdout
         for rank in range(3):
