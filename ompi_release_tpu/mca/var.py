@@ -270,11 +270,14 @@ class VarRegistry:
             if var is not None:
                 try:
                     self._resolve(var)
-                except ValueError:
+                except (ValueError, TypeError):
                     # a REJECTED set must not poison the registry: the
                     # stored override would make every later get() of
                     # this variable raise (observed as cross-test
-                    # contamination) — roll back to the prior state
+                    # contamination) — roll back to the prior state.
+                    # TypeError included: int([1, 2]) raises it, not
+                    # ValueError, and would slip the same poison past
+                    # a ValueError-only net
                     if had_prev:
                         self._overrides[name] = prev
                     else:

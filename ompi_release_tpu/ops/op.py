@@ -145,6 +145,25 @@ OP_FRAMEWORK = mca_component.framework(
 OP_FRAMEWORK.register(XlaOpComponent())
 
 
+def reduce_local(inbuf, inoutbuf, op: Op):
+    """MPI_Reduce_local (``ompi/mpi/c/reduce_local.c``): combine two
+    local buffers, ``inout = in OP inout`` — no communication.  Pair
+    ops take/return ``(values, indices)`` tuples.  Routed through the
+    op framework, so an accelerated component (pallas) claims the
+    shapes its kernels win on, exactly like the collectives' local
+    reduction steps."""
+    import jax.numpy as jnp
+
+    if op.is_pair_op:
+        (va, ia), (vb, ib) = inbuf, inoutbuf
+        return op((jnp.asarray(va), jnp.asarray(ia)),
+                  (jnp.asarray(vb), jnp.asarray(ib)))
+    a = jnp.asarray(inbuf)
+    b = jnp.asarray(inoutbuf)
+    resolved = resolve(op, a.dtype, a.size * a.dtype.itemsize)
+    return resolved(a, b)
+
+
 def resolve(op: Op, dtype=None, nbytes: int = 0) -> Op:
     """Accelerated-kernel resolution (``ompi/mca/op`` select): query
     components highest-priority first with the reduction's shape

@@ -28,6 +28,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+def subprocess_env(**overrides):
+    """Environment for subprocess tests that must run on the virtual
+    CPU mesh: forces JAX_PLATFORMS=cpu and filters the axon
+    sitecustomize entry from PYTHONPATH (it pins the TPU platform
+    over the env var — subprocesses can't use the config API the way
+    this conftest does). Other PYTHONPATH entries stay."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in os.path.basename(p)
+    )
+    env.update(overrides)
+    return env
+
+
 @pytest.fixture
 def fresh_mca(monkeypatch):
     """Isolated MCA var/pvar state for config-system tests."""

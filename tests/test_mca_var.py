@@ -157,3 +157,8 @@ def test_rejected_set_value_does_not_poison_registry(fresh_mca):
     with pytest.raises(ValueError):
         mca_var.set_value("poison_probe", "zz")
     assert mca_var.get("poison_probe") == "a"  # default restored
+    # TypeError path (int([1,2])) must roll back too
+    mca_var.register("poison_int", "int", 5, "rollback probe 2")
+    with pytest.raises((TypeError, ValueError)):
+        mca_var.set_value("poison_int", [1, 2])
+    assert mca_var.get("poison_int") == 5

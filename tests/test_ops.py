@@ -235,18 +235,13 @@ def test_bench_end_to_end_on_simulator_mesh():
     import subprocess
     import sys
 
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                        + " --xla_force_host_platform_device_count=8")
-    # the axon sitecustomize (on PYTHONPATH) pins the TPU platform,
-    # overriding JAX_PLATFORMS: without filtering it this "simulator
-    # mesh" test silently benched the real tunneled chip — slow, and
-    # hostage to chip contention (same fix as the examples test)
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and "axon" not in os.path.basename(p)
-    )
+    from conftest import subprocess_env
+
+    # subprocess_env: without the axon filter this "simulator mesh"
+    # test silently benched the real tunneled chip — slow, and
+    # hostage to chip contention
+    env = subprocess_env(XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                         + " --xla_force_host_platform_device_count=8"))
     r = subprocess.run(
         [sys.executable, "bench.py"], cwd="/root/repo", env=env,
         capture_output=True, text=True, timeout=900,
@@ -262,3 +257,27 @@ def test_bench_end_to_end_on_simulator_mesh():
     headline = lines[-1]
     assert "allreduce" in headline["metric"] or "op_sum" in \
         headline["metric"]
+
+
+def test_reduce_local():
+    """MPI_Reduce_local: inout = in OP inout, no communication; pair
+    ops take (value, index) tuples; big f32 SUMs resolve through the
+    accelerated op component like the collectives' local steps."""
+    from ompi_release_tpu import ops as ops_mod
+    from ompi_release_tpu.ops.op import reduce_local
+
+    rng = np.random.RandomState(7)
+    a = rng.randn(1000).astype(np.float32)
+    b = rng.randn(1000).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(reduce_local(a, b, ops_mod.SUM)), a + b, rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(reduce_local(a, b, ops_mod.MAX)), np.maximum(a, b))
+    # pair op: elementwise argmin across the two operands
+    ia = np.zeros(1000, np.int32)
+    ib = np.ones(1000, np.int32)
+    mv, mi = reduce_local((a, ia), (b, ib), ops_mod.MINLOC)
+    np.testing.assert_allclose(np.asarray(mv), np.minimum(a, b),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(mi), np.where(a <= b, 0, 1))

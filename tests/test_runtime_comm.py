@@ -210,15 +210,9 @@ def test_init_timing_report():
     import subprocess
     import sys
 
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["OMPITPU_MCA_runtime_timing"] = "1"
-    # filter only the axon sitecustomize (it pins the TPU platform,
-    # overriding JAX_PLATFORMS); other PYTHONPATH entries stay
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and "axon" not in os.path.basename(p)
-    )
+    from conftest import subprocess_env
+
+    env = subprocess_env(OMPITPU_MCA_runtime_timing="1")
     r = subprocess.run(
         [sys.executable, "-c",
          "import ompi_release_tpu as mpi; mpi.init(); mpi.finalize()"],

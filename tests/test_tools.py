@@ -185,20 +185,13 @@ class TestExamples:
         import os
         import subprocess
 
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                            + " --xla_force_host_platform_device_count=8")
-        # the axon environment's sitecustomize (on PYTHONPATH)
-        # preloads jax with the TPU platform pinned, overriding
-        # JAX_PLATFORMS — without filtering it the examples silently
-        # ran single-device on the real chip instead of the 8-device
-        # mesh this test advertises (surgical: other PYTHONPATH
-        # entries a dev setup relies on stay)
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and "axon" not in os.path.basename(p)
-        )
+        from conftest import subprocess_env
+
+        # without the axon filter the examples silently ran
+        # single-device on the real chip instead of the 8-device mesh
+        env = subprocess_env(
+            XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                       + " --xla_force_host_platform_device_count=8"))
         r = subprocess.run(
             [sys.executable, f"examples/{name}"], cwd="/root/repo",
             env=env, capture_output=True, text=True, timeout=300,
@@ -209,16 +202,11 @@ class TestExamples:
     def test_hello_under_tpurun(self):
         import subprocess
 
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        # filter the axon sitecustomize: it pins the TPU platform, and
+        from conftest import subprocess_env
+
         # 3 workers contending for the one tunneled chip hang whenever
-        # another tenant holds it — this launch test is about tpurun,
-        # not the chip
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and "axon" not in os.path.basename(p)
-        )
+        # another tenant holds it — this launch test is about tpurun
+        env = subprocess_env()
         r = subprocess.run(
             [sys.executable, "-m", "ompi_release_tpu.tools.tpurun",
              "-n", "3", sys.executable, "examples/hello_tpu.py"],
