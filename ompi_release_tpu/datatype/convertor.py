@@ -103,6 +103,44 @@ class Convertor:
             out = flat.at[jnp.asarray(self._offsets)].set(payload)
         return out.reshape(buffer.shape)
 
+    # -- external32 (MPI_Pack_external, "external32" representation) -------
+    def pack_external(self, buffer: jax.Array) -> np.ndarray:
+        """MPI_Pack_external: the canonical BIG-ENDIAN byte stream of
+        the described elements (``ompi/mpi/c/pack_external.c`` /
+        ``opal_datatype_external32``). The wire element type is the
+        DATATYPE's base dtype (a float64 buffer through a FLOAT
+        datatype goes out as 4-byte floats — the datatype defines the
+        representation, like the reference's convertor). A
+        serialization API, not a hot path — runs at the host edge,
+        returns uint8 bytes any endianness (or other MPI) can
+        consume."""
+        wire = self.dtype.base_dtype
+        payload = np.asarray(self.pack(buffer)).astype(wire)
+        be = payload.astype(wire.newbyteorder(">"), copy=False)
+        return np.frombuffer(be.tobytes(), dtype=np.uint8)
+
+    def unpack_external(self, raw, buffer: jax.Array) -> jax.Array:
+        """MPI_Unpack_external: decode a big-endian external32 stream
+        (bytes, bytearray, or a uint8 array) back into (a copy of)
+        ``buffer``."""
+        want = self.packed_bytes
+        if isinstance(raw, (bytes, bytearray, memoryview)):
+            raw = np.frombuffer(raw, dtype=np.uint8)
+        else:
+            raw = np.asarray(raw, dtype=np.uint8).reshape(-1)
+        if raw.size != want:
+            from ..utils.errors import ErrorCode, MPIError
+
+            raise MPIError(
+                ErrorCode.ERR_TRUNCATE,
+                f"external32 stream is {raw.size} B, datatype "
+                f"describes {want} B",
+            )
+        wire = self.dtype.base_dtype
+        native = np.frombuffer(raw.tobytes(),
+                               dtype=wire.newbyteorder(">")).astype(wire)
+        return self.unpack(jnp.asarray(native), buffer)
+
     # -- partial (segmented) ----------------------------------------------
     def pack_partial(self, buffer: jax.Array, position: int,
                      max_elements: int) -> Tuple[jax.Array, int]:

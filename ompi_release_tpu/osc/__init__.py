@@ -1,5 +1,6 @@
 """One-sided communication (RMA) — the ``ompi/mca/osc`` analogue."""
 
 from .window import (  # noqa: F401
-    Window, win_create, win_allocate, LOCK_EXCLUSIVE, LOCK_SHARED,
+    Window, win_create, win_allocate, win_allocate_shared,
+    LOCK_EXCLUSIVE, LOCK_SHARED,
 )

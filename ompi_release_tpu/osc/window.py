@@ -118,6 +118,29 @@ class Window:
         or after a flush; driver mode sees every rank's slice)."""
         return self._data
 
+    def shared_query(self, rank: int):
+        """MPI_Win_shared_query (``osc/sm``): (size_bytes, disp_unit,
+        block) for ``rank``'s segment of a shared window.  The block
+        is a SNAPSHOT as of the last epoch close (arrays are
+        immutable; every flush rebinds the window storage), so unlike
+        the reference's baseptr it does not observe later stores —
+        re-query after a flush, same discipline as :meth:`read`.
+        ``rank=-1`` (MPI_PROC_NULL convention) answers for the lowest
+        rank."""
+        if not getattr(self, "_shared", False):
+            raise MPIError(
+                ErrorCode.ERR_RMA_SHARED,
+                f"{self.name} was not created by win_allocate_shared",
+            )
+        if rank == -1:
+            rank = 0
+        if not 0 <= rank < self.comm.size:
+            raise MPIError(ErrorCode.ERR_RANK,
+                           f"shared_query rank {rank} out of range")
+        blk = self._data[rank]
+        return int(blk.size * blk.dtype.itemsize), \
+            int(blk.dtype.itemsize), blk
+
     # -- epoch state machine ----------------------------------------------
     def _require(self, *kinds: _EpochKind) -> None:
         if self._freed:
@@ -496,3 +519,30 @@ def win_allocate(comm, shape: Tuple[int, ...], dtype=jnp.float32,
     return Window(
         comm, jnp.zeros((comm.size,) + tuple(shape), dtype), name
     )
+
+
+def win_allocate_shared(comm, shape: Tuple[int, ...],
+                        dtype=jnp.float32, name: str = "") -> Window:
+    """MPI_Win_allocate_shared (the ``osc/sm`` component's role): a
+    window whose ranks' blocks are one CONTIGUOUS allocation (the
+    default alloc_shared_noncontig=false layout), so neighbors can
+    address each other's memory directly. The window carries
+    :meth:`Window.shared_query`; the comm should come from
+    ``split_type_shared`` (enforced loosely — driver mode has one
+    address space by construction, so every comm qualifies; a real
+    multi-host comm would reject here, and the honest check is the
+    endpoints' host identity)."""
+    eps = getattr(getattr(comm, "runtime", None), "endpoints", [])
+    members = set(getattr(comm.group, "world_ranks", ()))
+    hosts = {getattr(ep, "host", None)
+             for ep in eps if ep.rank in members}
+    if len(hosts) > 1:
+        raise MPIError(
+            ErrorCode.ERR_RMA_SHARED,
+            f"win_allocate_shared needs a single-host comm "
+            f"(got hosts {sorted(h or '?' for h in hosts)}); split "
+            "with split_type_shared first",
+        )
+    win = win_allocate(comm, shape, dtype, name)
+    win._shared = True
+    return win

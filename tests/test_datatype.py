@@ -209,3 +209,43 @@ class TestDarray:
         for bad in (0, -2):
             with pytest.raises(Exception):
                 create_darray(2, 0, [10], [DIST_CYCLIC], [bad], [2], FLOAT)
+
+
+def test_pack_external_big_endian_roundtrip():
+    """MPI_Pack_external ("external32"): the byte stream is canonical
+    BIG-endian regardless of host order, and round-trips through a
+    strided datatype (pack_external.c / opal_datatype_external32)."""
+    import numpy as np
+
+    from ompi_release_tpu.datatype import convertor as cv
+    from ompi_release_tpu.utils.errors import MPIError
+
+    t = dt.create_vector(3, 2, 4, dt.FLOAT)
+    c = cv.Convertor(t)
+    buf = jnp.arange(12, dtype=jnp.float32)
+    raw = c.pack_external(buf)
+    assert raw.dtype == np.uint8
+    assert raw.size == c.packed_bytes
+    # canonical big-endian: first packed element is buf[0] == 0.0,
+    # second is buf[1] == 1.0 whose BE bytes start 0x3f 0x80
+    np.testing.assert_array_equal(
+        raw[4:8],
+        np.frombuffer(np.array(1.0, ">f4").tobytes(), np.uint8))
+    out = c.unpack_external(raw, jnp.zeros(12, jnp.float32))
+    expect = np.zeros(12, np.float32)
+    for i, off in enumerate([0, 1, 4, 5, 8, 9]):
+        expect[off] = float(jnp.arange(12, dtype=jnp.float32)[off])
+    np.testing.assert_array_equal(np.asarray(out), expect)
+    # plain Python bytes — the natural deserialization input — decode
+    out2 = c.unpack_external(raw.tobytes(), jnp.zeros(12, jnp.float32))
+    np.testing.assert_array_equal(np.asarray(out2), expect)
+    # the DATATYPE defines the wire width: a float64 buffer through a
+    # FLOAT (f4) datatype goes out as 4-byte elements and round-trips
+    raw64 = c.pack_external(jnp.arange(12, dtype=jnp.float64))
+    assert raw64.size == c.packed_bytes
+    out3 = c.unpack_external(raw64, jnp.zeros(12, jnp.float32))
+    np.testing.assert_array_equal(np.asarray(out3), expect)
+    # truncated stream is a loud error
+    import pytest as _pytest
+    with _pytest.raises(MPIError, match="external32"):
+        c.unpack_external(raw[:-1], jnp.zeros(12, jnp.float32))

@@ -265,3 +265,72 @@ class TestPSCWWait:
     def test_wait_without_post_raises(self, win):
         with pytest.raises(MPIError):
             win.wait()
+
+
+class TestSharedWindow:
+    """MPI_Win_allocate_shared + shared_query (osc/sm role): one
+    contiguous allocation, per-rank segments directly loadable."""
+
+    def test_allocate_shared_query(self, world):
+        from ompi_release_tpu.osc import win_allocate_shared
+        from ompi_release_tpu.utils.errors import MPIError
+
+        w = win_allocate_shared(world, (6,), jnp.float32)
+        try:
+            # put into rank 3's segment, then load it DIRECTLY via
+            # shared_query — the osc/sm promise
+            w.lock_all()
+            w.put(jnp.arange(6, dtype=jnp.float32), 3)
+            w.flush_all()
+            size, disp, blk = w.shared_query(3)
+            assert size == 24 and disp == 4
+            np.testing.assert_array_equal(np.asarray(blk),
+                                          np.arange(6, dtype=np.float32))
+            # MPI_PROC_NULL convention: -1 answers for the lowest rank
+            _, _, blk0 = w.shared_query(-1)
+            assert blk0.shape == (6,)
+            with pytest.raises(MPIError, match="out of range"):
+                w.shared_query(99)
+            w.unlock_all()
+        finally:
+            w.free()
+
+    def test_multi_host_comm_rejected(self, world):
+        """The single-host gate reads the comm's OWN members' modex
+        host identities — a two-host world is refused."""
+        import dataclasses
+
+        from ompi_release_tpu.osc import win_allocate_shared
+        from ompi_release_tpu.utils.errors import MPIError
+
+        rt = world.runtime
+        old = rt.endpoints
+        try:
+            rt.endpoints = [
+                dataclasses.replace(
+                    ep, host="hostB" if ep.rank >= 4 else "hostA")
+                for ep in old
+            ]
+            with pytest.raises(MPIError, match="single-host"):
+                win_allocate_shared(world, (2,), jnp.float32)
+            # a sub-comm living entirely on one "host" still qualifies
+            sub = world.create(world.group.incl([0, 1, 2]),
+                               name="one_host")
+            try:
+                w = win_allocate_shared(sub, (2,), jnp.float32)
+                w.free()
+            finally:
+                sub.free()
+        finally:
+            rt.endpoints = old
+
+    def test_plain_window_rejects_shared_query(self, world):
+        from ompi_release_tpu.osc import win_allocate
+        from ompi_release_tpu.utils.errors import MPIError
+
+        w = win_allocate(world, (2,), jnp.float32)
+        try:
+            with pytest.raises(MPIError, match="allocate_shared"):
+                w.shared_query(0)
+        finally:
+            w.free()
