@@ -79,6 +79,23 @@ class _PendingOp:
         self.index = index
 
 
+# predefined window attributes (mpi.h MPI_WIN_BASE..MPI_WIN_MODEL)
+WIN_BASE = "win_base"
+WIN_SIZE = "win_size"
+WIN_DISP_UNIT = "win_disp_unit"
+WIN_CREATE_FLAVOR = "win_create_flavor"
+WIN_MODEL = "win_model"
+# create flavors (MPI_WIN_FLAVOR_*)
+FLAVOR_CREATE = 1
+FLAVOR_ALLOCATE = 2
+FLAVOR_DYNAMIC = 3
+FLAVOR_SHARED = 4
+# memory models: driver mode is one address space with epoch-close
+# visibility = MPI_WIN_UNIFIED semantics
+MODEL_SEPARATE = 1
+MODEL_UNIFIED = 2
+
+
 class Window:
     def __init__(self, comm, base: jax.Array, name: str = "") -> None:
         if base.shape[0] != comm.size:
@@ -103,6 +120,7 @@ class Window:
         self._op_lock = _threading.RLock()
         self._group_exposed = None  # PSCW exposure group
         self._freed = False
+        self._flavor = FLAVOR_CREATE  # constructors override
 
     # -- queries -----------------------------------------------------------
     @property
@@ -117,6 +135,30 @@ class Window:
         """Local loads of the whole window (valid outside access epochs
         or after a flush; driver mode sees every rank's slice)."""
         return self._data
+
+    def get_attr(self, key: str):
+        """MPI_Win_get_attr for the predefined attributes
+        (``ompi/win/win.c`` WIN_BASE..WIN_MODEL): returns
+        (found, value).  MPI's view is per-process: WIN_SIZE /
+        WIN_DISP_UNIT describe ONE rank's window (block bytes,
+        element size).  WIN_BASE in driver mode is the whole
+        (comm.size, ...) storage — one controller plays every rank,
+        so "the local base" is ``base[rank]``; sizes are metadata
+        only (no device access)."""
+        import math
+
+        if key == WIN_BASE:
+            return True, self._data
+        if key == WIN_SIZE:
+            n = math.prod(self._data.shape[1:])
+            return True, int(n * self._data.dtype.itemsize)
+        if key == WIN_DISP_UNIT:
+            return True, int(self._data.dtype.itemsize)
+        if key == WIN_CREATE_FLAVOR:
+            return True, self._flavor
+        if key == WIN_MODEL:
+            return True, MODEL_UNIFIED
+        return False, None
 
     def shared_query(self, rank: int):
         """MPI_Win_shared_query (``osc/sm``): (size_bytes, disp_unit,
@@ -516,9 +558,11 @@ def win_allocate(comm, shape: Tuple[int, ...], dtype=jnp.float32,
                  name: str = "") -> Window:
     """MPI_Win_allocate: fresh zeroed window, one ``shape`` block per
     rank."""
-    return Window(
+    win = Window(
         comm, jnp.zeros((comm.size,) + tuple(shape), dtype), name
     )
+    win._flavor = FLAVOR_ALLOCATE
+    return win
 
 
 def win_allocate_shared(comm, shape: Tuple[int, ...],
@@ -532,10 +576,12 @@ def win_allocate_shared(comm, shape: Tuple[int, ...],
     address space by construction, so every comm qualifies; a real
     multi-host comm would reject here, and the honest check is the
     endpoints' host identity)."""
-    eps = getattr(getattr(comm, "runtime", None), "endpoints", [])
-    members = set(getattr(comm.group, "world_ranks", ()))
-    hosts = {getattr(ep, "host", None)
-             for ep in eps if ep.rank in members}
+    # direct attribute access ON PURPOSE: a rename in runtime/group
+    # must surface as an AttributeError here, not silently turn the
+    # multi-host safety gate vacuous
+    members = set(comm.group.world_ranks)
+    hosts = {ep.host for ep in comm.runtime.endpoints
+             if ep.rank in members}
     if len(hosts) > 1:
         raise MPIError(
             ErrorCode.ERR_RMA_SHARED,
@@ -545,4 +591,5 @@ def win_allocate_shared(comm, shape: Tuple[int, ...],
         )
     win = win_allocate(comm, shape, dtype, name)
     win._shared = True
+    win._flavor = FLAVOR_SHARED
     return win

@@ -239,11 +239,15 @@ def test_pack_external_big_endian_roundtrip():
     # plain Python bytes — the natural deserialization input — decode
     out2 = c.unpack_external(raw.tobytes(), jnp.zeros(12, jnp.float32))
     np.testing.assert_array_equal(np.asarray(out2), expect)
-    # the DATATYPE defines the wire width: a float64 buffer through a
-    # FLOAT (f4) datatype goes out as 4-byte elements and round-trips
-    raw64 = c.pack_external(jnp.arange(12, dtype=jnp.float64))
-    assert raw64.size == c.packed_bytes
-    out3 = c.unpack_external(raw64, jnp.zeros(12, jnp.float32))
+    # the DATATYPE defines the wire width: a float32 buffer through a
+    # DOUBLE (f8) datatype travels as 8-byte elements and round-trips
+    # (jax truncates f64 buffers without x64 mode, so widening is the
+    # honestly-testable direction here)
+    t8 = dt.create_vector(3, 2, 4, dt.DOUBLE)
+    c8 = cv.Convertor(t8)
+    raw8 = c8.pack_external(buf)
+    assert raw8.size == c8.packed_bytes == 6 * 8
+    out3 = c8.unpack_external(raw8, jnp.zeros(12, jnp.float32))
     np.testing.assert_array_equal(np.asarray(out3), expect)
     # truncated stream is a loud error
     import pytest as _pytest

@@ -334,3 +334,29 @@ class TestSharedWindow:
                 w.shared_query(0)
         finally:
             w.free()
+
+
+def test_window_predefined_attributes(world):
+    """MPI_Win_get_attr: WIN_BASE/SIZE/DISP_UNIT/CREATE_FLAVOR/MODEL
+    (ompi/win/win.c predefined attribute set)."""
+    from ompi_release_tpu import osc
+    from ompi_release_tpu.osc import window as W
+
+    for ctor, flavor in ((osc.win_allocate, W.FLAVOR_ALLOCATE),
+                         (W.win_allocate_shared, W.FLAVOR_SHARED)):
+        w = ctor(world, (6,), jnp.float32)
+        try:
+            assert w.get_attr(W.WIN_SIZE) == (True, 24)
+            assert w.get_attr(W.WIN_DISP_UNIT) == (True, 4)
+            assert w.get_attr(W.WIN_CREATE_FLAVOR) == (True, flavor)
+            assert w.get_attr(W.WIN_MODEL) == (True, W.MODEL_UNIFIED)
+            found, base = w.get_attr(W.WIN_BASE)
+            assert found and base.shape[0] == world.size
+            assert w.get_attr("nonsense") == (False, None)
+        finally:
+            w.free()
+    w = W.win_create(world, jnp.zeros((world.size, 2), jnp.float32))
+    try:
+        assert w.get_attr(W.WIN_CREATE_FLAVOR) == (True, W.FLAVOR_CREATE)
+    finally:
+        w.free()
