@@ -194,20 +194,24 @@ class Window:
                 f"window is in '{self._epoch.value}'",
             )
 
-    def fence(self) -> None:
+    def fence(self, _barrier: bool = True) -> None:
         """Open/continue a fence epoch; applies queued ops (MPI fence
-        both closes the previous access epoch and opens the next)."""
+        both closes the previous access epoch and opens the next).
+        ``_barrier=False`` is for composite windows (DynamicWindow)
+        that fan one fence over many regions and barrier ONCE."""
         self._require(_EpochKind.NONE, _EpochKind.FENCE)
         self._apply_pending()
         self._epoch = _EpochKind.FENCE
-        self.comm.barrier()
+        if _barrier:
+            self.comm.barrier()
 
-    def fence_end(self) -> None:
+    def fence_end(self, _barrier: bool = True) -> None:
         """Final fence (MPI_MODE_NOSUCCEED): close the epoch."""
         self._require(_EpochKind.FENCE)
         self._apply_pending()
         self._epoch = _EpochKind.NONE
-        self.comm.barrier()
+        if _barrier:
+            self.comm.barrier()
 
     def lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
         self._require(_EpochKind.NONE, _EpochKind.LOCK)
@@ -593,3 +597,178 @@ def win_allocate_shared(comm, shape: Tuple[int, ...],
     win._shared = True
     win._flavor = FLAVOR_SHARED
     return win
+
+
+class DynamicWindow:
+    """MPI_Win_create_dynamic + MPI_Win_attach/detach
+    (``ompi/mca/osc/rdma`` dynamic-flavor support): a window created
+    EMPTY whose memory regions attach and detach while it lives.
+
+    Driver-mode mapping: each :meth:`attach` creates one uniform
+    per-rank region (a fresh :class:`Window`) addressed by the
+    returned region id — the analogue of the reference's
+    absolute-address targeting, with the id playing the attached-base
+    role.  Epoch synchronization spans the WHOLE dynamic window:
+    fence/lock_all/unlock_all/flush_all fan out to every attached
+    region (one comm barrier per fence, not per region) and a region
+    attached MID-EPOCH inherits the open epoch, as MPI_Win_attach
+    requires.  Per-region RMA goes through the owning region's queue
+    (MPI ordering guarantees are per (origin, target) pair).
+    Detaching with queued unsynchronized ops is refused, and free()
+    refuses atomically — it frees nothing unless EVERY region is
+    synchronized.  A lock guards the region table: the documented
+    Window threading pattern (producer thread + waiter) extends to
+    concurrent attach/detach against epoch fan-outs."""
+
+    def __init__(self, comm, name: str = "") -> None:
+        import threading as _threading
+
+        self.comm = comm
+        self.name = name or f"dynwin{id(self):x}"
+        self._regions: Dict[int, Window] = {}
+        self._next_region = 0
+        self._flavor = FLAVOR_DYNAMIC
+        self._freed = False
+        self._open: Optional[str] = None  # None | "fence" | "lock"
+        self._lock = _threading.RLock()
+
+    # -- attach / detach ---------------------------------------------------
+    def attach(self, shape: Tuple[int, ...], dtype=jnp.float32) -> int:
+        """MPI_Win_attach: expose a fresh zeroed per-rank region;
+        returns its region id. Legal mid-epoch — the new region joins
+        the open epoch."""
+        with self._lock:
+            if self._freed:
+                raise MPIError(ErrorCode.ERR_WIN, f"{self.name} freed")
+            rid = self._next_region
+            self._next_region += 1
+            win = win_allocate(self.comm, shape, dtype,
+                               f"{self.name}.r{rid}")
+            win._flavor = FLAVOR_DYNAMIC
+            if self._open == "fence":
+                win.fence(_barrier=False)
+            elif self._open == "lock":
+                win.lock_all()
+            self._regions[rid] = win
+            return rid
+
+    def detach(self, region: int) -> None:
+        """MPI_Win_detach: the region must have no unsynchronized
+        RMA queued (same rule as freeing mid-epoch)."""
+        with self._lock:
+            win = self._region(region)
+            if win._pending:
+                raise MPIError(
+                    ErrorCode.ERR_RMA_SYNC,
+                    f"{self.name}: detach of region {region} with "
+                    "unsynchronized RMA operations",
+                )
+            win._freed = True
+            del self._regions[region]
+
+    def _region(self, region: int) -> Window:
+        with self._lock:
+            if self._freed:
+                raise MPIError(ErrorCode.ERR_WIN, f"{self.name} freed")
+            w = self._regions.get(region)
+            if w is None:
+                raise MPIError(
+                    ErrorCode.ERR_BASE,
+                    f"{self.name}: region {region} is not attached "
+                    f"(attached: {sorted(self._regions)})",
+                )
+            return w
+
+    # -- queries -----------------------------------------------------------
+    def get_attr(self, key: str):
+        if key == WIN_CREATE_FLAVOR:
+            return True, self._flavor
+        if key == WIN_MODEL:
+            return True, MODEL_UNIFIED
+        if key == WIN_BASE:
+            # MPI_BOTTOM for dynamic windows: no single base
+            return True, None
+        if key == WIN_SIZE:
+            return True, 0
+        if key == WIN_DISP_UNIT:
+            return True, 1
+        return False, None
+
+    def read(self, region: int) -> jax.Array:
+        return self._region(region).read()
+
+    # -- epochs fan out to every attached region ---------------------------
+    def fence(self) -> None:
+        with self._lock:
+            for w in self._regions.values():
+                w.fence(_barrier=False)
+            self._open = "fence"
+        self.comm.barrier()  # ONE barrier per fence, not per region
+
+    def fence_end(self) -> None:
+        with self._lock:
+            for w in self._regions.values():
+                w.fence_end(_barrier=False)
+            self._open = None
+        self.comm.barrier()
+
+    def lock_all(self) -> None:
+        with self._lock:
+            for w in self._regions.values():
+                w.lock_all()
+            self._open = "lock"
+
+    def unlock_all(self) -> None:
+        with self._lock:
+            for w in self._regions.values():
+                w.unlock_all()
+            self._open = None
+
+    def flush_all(self) -> None:
+        with self._lock:
+            for w in self._regions.values():
+                w.flush_all()
+
+    # -- RMA: target = (rank, region) --------------------------------------
+    def put(self, data, target: int, *, region: int, **kw):
+        return self._region(region).put(data, target, **kw)
+
+    def get(self, target: int, *, region: int, **kw):
+        return self._region(region).get(target, **kw)
+
+    def accumulate(self, data, target: int, *, region: int, **kw):
+        return self._region(region).accumulate(data, target, **kw)
+
+    def get_accumulate(self, data, target: int, *, region: int, **kw):
+        return self._region(region).get_accumulate(data, target, **kw)
+
+    def fetch_and_op(self, data, target: int, *, region: int, **kw):
+        return self._region(region).fetch_and_op(data, target, **kw)
+
+    def compare_and_swap(self, value, compare, target: int, *,
+                         region: int, **kw):
+        return self._region(region).compare_and_swap(
+            value, compare, target, **kw)
+
+    def free(self) -> None:
+        """Atomic: refuses (freeing NOTHING) unless every region is
+        synchronized — a partial free would strand pending ops on a
+        half-dead window."""
+        with self._lock:
+            bad = [rid for rid, w in self._regions.items() if w._pending]
+            if bad:
+                raise MPIError(
+                    ErrorCode.ERR_RMA_SYNC,
+                    f"{self.name}: free with unsynchronized RMA in "
+                    f"region(s) {bad}",
+                )
+            for w in self._regions.values():
+                w.free()
+            self._regions.clear()
+            self._freed = True
+
+
+def win_create_dynamic(comm, name: str = "") -> DynamicWindow:
+    """MPI_Win_create_dynamic: an empty window; memory attaches
+    later (``ompi/mpi/c/win_create_dynamic.c``)."""
+    return DynamicWindow(comm, name)

@@ -360,3 +360,106 @@ def test_window_predefined_attributes(world):
         assert w.get_attr(W.WIN_CREATE_FLAVOR) == (True, W.FLAVOR_CREATE)
     finally:
         w.free()
+
+
+class TestDynamicWindow:
+    """MPI_Win_create_dynamic + attach/detach (the dynamic flavor):
+    regions come and go on a live window; epochs span all of them."""
+
+    def test_attach_rma_detach(self, world):
+        from ompi_release_tpu.osc import win_create_dynamic
+        from ompi_release_tpu.osc import window as W
+
+        w = win_create_dynamic(world)
+        try:
+            assert w.get_attr(W.WIN_CREATE_FLAVOR) == \
+                (True, W.FLAVOR_DYNAMIC)
+            assert w.get_attr(W.WIN_SIZE) == (True, 0)  # MPI_BOTTOM-ish
+            r1 = w.attach((4,), jnp.float32)
+            r2 = w.attach((2,), jnp.int32)
+            w.fence()
+            w.put(np.full(4, 3.0, np.float32), 1, region=r1)
+            w.accumulate(np.array([5, 7], np.int32), 6, region=r2)
+            g = w.get(1, region=r1)
+            w.fence_end()
+            np.testing.assert_array_equal(np.asarray(g.value),
+                                          np.full(4, 3.0))
+            np.testing.assert_array_equal(
+                np.asarray(w.read(r2))[6], [5, 7])
+            w.detach(r1)
+            with pytest.raises(MPIError, match="not attached"):
+                w.put(np.zeros(4, np.float32), 0, region=r1)
+            # r2 still lives across the detach
+            w.lock_all()
+            f = w.fetch_and_op(np.array([1, 1], np.int32), 6,
+                               region=r2, op=ops.SUM)
+            w.unlock_all()
+            np.testing.assert_array_equal(np.asarray(f.value), [5, 7])
+            np.testing.assert_array_equal(
+                np.asarray(w.read(r2))[6], [6, 8])
+        finally:
+            w.free()
+        with pytest.raises(MPIError, match="freed"):
+            w.attach((2,), jnp.float32)
+
+    def test_detach_with_pending_refused(self, world):
+        from ompi_release_tpu.osc import win_create_dynamic
+
+        w = win_create_dynamic(world)
+        try:
+            r = w.attach((2,), jnp.float32)
+            w.fence()
+            w.put(np.ones(2, np.float32), 0, region=r)
+            with pytest.raises(MPIError, match="unsynchronized"):
+                w.detach(r)
+            w.fence_end()
+            w.detach(r)
+        finally:
+            w.free()
+
+
+def test_dynamic_window_attach_mid_epoch(world):
+    """MPI_Win_attach is legal mid-epoch: a region attached inside an
+    open fence (or lock_all) inherits the epoch and is immediately
+    RMA-addressable; the closing fence drains every region."""
+    from ompi_release_tpu.osc import win_create_dynamic
+
+    w = win_create_dynamic(world)
+    try:
+        r1 = w.attach((2,), jnp.float32)
+        w.fence()
+        w.put(np.ones(2, np.float32), 0, region=r1)
+        r2 = w.attach((3,), jnp.float32)  # joins the open epoch
+        w.put(np.full(3, 4.0, np.float32), 5, region=r2)
+        w.fence_end()
+        np.testing.assert_array_equal(np.asarray(w.read(r2))[5],
+                                      np.full(3, 4.0))
+        w.lock_all()
+        r3 = w.attach((2,), jnp.float32)  # joins the lock epoch
+        w.put(np.full(2, 9.0, np.float32), 1, region=r3)
+        w.flush_all()
+        np.testing.assert_array_equal(np.asarray(w.read(r3))[1],
+                                      np.full(2, 9.0))
+        w.unlock_all()
+    finally:
+        w.free()
+
+
+def test_dynamic_window_free_is_atomic(world):
+    """free() with ANY unsynchronized region frees NOTHING — the
+    window stays fully usable, drains, then frees."""
+    from ompi_release_tpu.osc import win_create_dynamic
+
+    w = win_create_dynamic(world)
+    r1 = w.attach((2,), jnp.float32)
+    r2 = w.attach((2,), jnp.float32)
+    w.fence()
+    w.put(np.ones(2, np.float32), 0, region=r2)
+    with pytest.raises(MPIError, match="unsynchronized"):
+        w.free()
+    # nothing was freed: both regions still serve the epoch
+    w.put(np.ones(2, np.float32), 0, region=r1)
+    w.fence_end()
+    np.testing.assert_array_equal(np.asarray(w.read(r1))[0],
+                                  np.ones(2))
+    w.free()
