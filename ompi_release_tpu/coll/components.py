@@ -337,6 +337,13 @@ class _TunedModule:
         block_dsize = _per_rank_bytes(x)
         dyn = dynamic_rules.lookup("allreduce", n, block_dsize)
         if dyn is not None:
+            if dyn in ("ring", "segmented_ring") and (
+                    not op.commutative or op.identity is None):
+                # a rule file cannot waive MPI semantics (same guard
+                # as reduce below): ring's reduce-scatter folds chunks
+                # in rotating ring order and pads with the identity —
+                # downgrade to the rank-ordered fallback
+                dyn = "nonoverlapping"
             return dyn
         if block_dsize < mca_var.get("coll_tuned_small_message", 10000):
             return "recursive_doubling"

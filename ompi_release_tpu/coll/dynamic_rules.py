@@ -55,6 +55,7 @@ the operator's tuning run.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..mca import var as mca_var
@@ -67,9 +68,14 @@ _log = output.Stream("coll")
 #: components.py at import; kept here to avoid a cycle)
 RULE_COLLECTIVES: Dict[str, Tuple[str, ...]] = {}
 
-# (path, mtime) -> parsed rules; a rewritten file is re-parsed, an
-# unchanged one costs a stat per lookup
-_cache: Dict[Tuple[str, float], Dict[str, List[Tuple[int, int, str]]]] = {}
+# (path, mtime_ns, size) -> parsed rules; a rewritten file is
+# re-parsed, an unchanged one costs a stat per lookup.  mtime_ns +
+# size (not float mtime): some filesystems round mtime to 1 s, so a
+# rewrite landing within the same second as the first parse would
+# otherwise keep serving stale rules.  Collectives may run from
+# multiple threads; _cache_lock guards every _cache access.
+_cache: Dict[Tuple[str, int, int], Dict[str, List[Tuple[int, int, str]]]] = {}
+_cache_lock = threading.Lock()
 
 
 def load_rules(path: str) -> Dict[str, List[Tuple[int, int, str]]]:
@@ -128,32 +134,36 @@ def lookup(coll: str, comm_size: int, msg_bytes: int) -> Optional[str]:
     if not path:
         return None
     try:
-        key = (path, os.stat(path).st_mtime)
+        st = os.stat(path)
+        key = (path, st.st_mtime_ns, st.st_size)
     except OSError as e:
         # the file vanished MID-RUN (scratch-dir cleanup): keep
         # serving the last successfully parsed copy rather than
         # turning a config deletion into a crash inside the
         # collective hot path; only a file that never parsed is fatal
-        for (p, _), rules in _cache.items():
-            if p == path:
-                key = None
-                break
-        else:
+        with _cache_lock:
+            rules_for_path = next(
+                (r for (p, _, _), r in _cache.items() if p == path), None
+            )
+        if rules_for_path is None:
             raise MPIError(ErrorCode.ERR_FILE,
                            f"dynamic rules file {path} unreadable: {e}")
         _log.verbose(1, f"dynamic rules file {path} vanished; "
                         "keeping the last parsed rules")
-        rules_for_path = rules
+        key = None
     if key is not None:
-        if key not in _cache:
-            # parse BEFORE dropping the old copy: a mid-run rewrite
-            # with a syntax error must raise while the last-good rules
-            # stay cached (so deleting the broken file falls back to
-            # them instead of becoming fatal)
+        with _cache_lock:
+            rules_for_path = _cache.get(key)
+        if rules_for_path is None:
+            # parse BEFORE dropping the old copy (and outside the
+            # lock: load_rules may raise on a mid-run rewrite with a
+            # syntax error, and the last-good rules must stay cached
+            # so deleting the broken file falls back to them)
             parsed = load_rules(path)
-            _cache.clear()  # at most one live file; drop stale mtimes
-            _cache[key] = parsed
-        rules_for_path = _cache[key]
+            with _cache_lock:
+                _cache.clear()  # at most one live file; drop stale keys
+                _cache[key] = parsed
+            rules_for_path = parsed
     picked: Optional[str] = None
     for min_n, min_bytes, alg in rules_for_path.get(coll, ()):
         if comm_size >= min_n and msg_bytes >= min_bytes:

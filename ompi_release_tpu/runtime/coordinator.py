@@ -437,14 +437,24 @@ class HnpCoordinator:
                 except MPIError:
                     pass
 
-        threading.Thread(target=run, daemon=True,
-                         name="hnp-migrate").start()
+        self._migrate_thread = threading.Thread(
+            target=run, daemon=True, name="hnp-migrate")
+        self._migrate_thread.start()
 
     def stop_ps_responder(self) -> None:
         self._ps_stop.set()
-        t = getattr(self, "_ps_thread", None)
-        if t is not None:
-            t.join(timeout=2)
+        # join the migrate thread too, and with a much longer budget:
+        # an in-flight migrate_fn kills/respawns ranks (seconds of
+        # process teardown/launch) and mutates Job state — shutdown
+        # must wait for it, not race it with ep.close()
+        for name, budget in (("_ps_thread", 2), ("_migrate_thread", 30)):
+            t = getattr(self, name, None)
+            if t is not None:
+                t.join(timeout=budget)
+                if t.is_alive():
+                    _log.verbose(
+                        1, f"{name} still running after {budget}s join "
+                           "at shutdown; proceeding")
 
     # -- name service (pubsub_orte / orte-server analogue) -----------------
     def start_name_server(self) -> None:
