@@ -30,6 +30,12 @@ COLL_FRAMEWORK = mca_component.framework(
     "coll", "collective operations (ompi/mca/coll analogue)"
 )
 
+#: a provider returns this to mean "handled, result is None" (e.g.
+#: hier gatherv off the root's process: MPI leaves the recv buffer
+#: undefined off-root) — plain None would read as a decline and fall
+#: through to the next provider
+NO_RESULT = object()
+
 
 def comm_select(comm) -> Dict[str, Callable]:
     """Install the per-comm collective table (the ``c_coll`` analogue)."""
@@ -53,7 +59,7 @@ def comm_select(comm) -> Dict[str, Callable]:
             for fn in chain:
                 res = fn(comm_, *args, **kw)
                 if res is not None or op_name == "barrier":
-                    return res
+                    return None if res is NO_RESULT else res
             from ..utils.errors import ErrorCode, MPIError
 
             raise MPIError(

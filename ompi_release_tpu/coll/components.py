@@ -358,6 +358,19 @@ class _TunedModule:
         if op.is_pair_op:
             return None  # pair ops stay with xla's gather path
         alg = self._pick_allreduce(x, op)
+        if alg in ("ring", "segmented_ring") and (
+                not op.commutative or op.identity is None):
+            # mirrors reduce()'s order-invariant enforcement: the fixed
+            # constants never pick ring here and a dynamic rule is
+            # downgraded in the picker, so this catches operator forcing
+            from ..utils.errors import ErrorCode, MPIError
+
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                "ring allreduce folds chunks in rotating ring order and "
+                "pads with the op identity; use nonoverlapping or "
+                "recursive_doubling for this op",
+            )
         op = _resolve_op(op, x)  # accelerated local-reduction kernel
         n = comm.size
         segsize = mca_var.get("coll_tuned_segment_size", 1 << 20)

@@ -197,7 +197,7 @@ class _HierModule:
 
     # -- operation table ---------------------------------------------------
     def fns(self) -> Dict[str, Callable]:
-        table: Dict[str, Callable] = {
+        return {
             "allreduce": self.allreduce,
             "reduce": self.reduce,
             "bcast": self.bcast,
@@ -209,11 +209,12 @@ class _HierModule:
             "scan": self.scan,
             "exscan": self.exscan,
             "barrier": self.barrier,
+            "alltoallv": self.alltoallv,
+            "allgatherv": self.allgatherv,
+            "gatherv": self.gatherv,
+            "scatterv": self.scatterv,
+            "reduce_scatter": self.reduce_scatter,
         }
-        for name in ("alltoallv", "allgatherv", "gatherv", "scatterv",
-                     "reduce_scatter"):
-            table[name] = _not_available(name)
-        return table
 
     # -- reductions --------------------------------------------------------
     def allreduce(self, comm, x, op: Op):
@@ -349,6 +350,211 @@ class _HierModule:
                 for b in range(self.local_n):
                     out[b, i] = r[a, b]
         return jnp.asarray(out.reshape(block.shape))
+
+    # -- v-variant collectives (ragged; lists indexed by LOCAL member) -----
+    # Spanning-comm analogue of coll/vcoll.py's driver-mode convention:
+    # rank-dependent inputs/outputs are Python lists with one entry per
+    # LOCAL member in comm-rank order; identical-everywhere results are
+    # returned once. Counts arguments are GLOBAL (the full matrix /
+    # per-rank vector on every process), matching MPI's requirement
+    # that every caller supplies the complete picture.
+
+    def _ragged_local(self, bufs, what: str) -> List[np.ndarray]:
+        if len(bufs) != self.local_n:
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"{what} on spanning {self.comm.name}: pass one buffer "
+                f"per LOCAL member ({self.local_n}), got {len(bufs)}",
+            )
+        out = [np.asarray(b).reshape(-1) for b in bufs]
+        dtypes = {a.dtype for a in out}
+        if len(dtypes) != 1:
+            raise MPIError(
+                ErrorCode.ERR_TYPE,
+                f"{what} buffers must share one dtype, got "
+                f"{sorted(map(str, dtypes))}",
+            )
+        return out
+
+    def alltoallv(self, comm, sendbufs, sendcounts):
+        """Pairwise exchange, process-aggregated
+        (``coll_tuned_alltoallv.c:148`` sends rank-pairwise over the
+        PML; here every process sends ONE aggregated message per peer
+        process — its members' chunks for that peer's members — since
+        both sides derive the sub-layout from the shared count
+        matrix). ``sendcounts`` is the full (n, n) matrix; returns
+        ``recv[b]`` = source-order concatenation for local member b."""
+        n = comm.size
+        c = np.asarray(sendcounts, dtype=np.int64)
+        if c.shape != (n, n) or (c < 0).any():
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"alltoallv needs a non-negative ({n},{n}) count "
+                f"matrix, got {getattr(c, 'shape', None)}",
+            )
+        bufs = self._ragged_local(sendbufs, "alltoallv")
+        dtype = bufs[0].dtype
+        offs = np.concatenate(
+            [np.zeros((n, 1), np.int64), np.cumsum(c, axis=1)], axis=1
+        )
+        for pos, i in enumerate(self.local_ranks):
+            if bufs[pos].shape[0] != int(c[i].sum()):
+                raise MPIError(
+                    ErrorCode.ERR_COUNT,
+                    f"alltoallv rank {i}: buffer has "
+                    f"{bufs[pos].shape[0]} elements, counts sum to "
+                    f"{int(c[i].sum())}",
+                )
+
+        def chunk(pos: int, i: int, j: int) -> np.ndarray:
+            return bufs[pos][offs[i, j]:offs[i, j] + int(c[i, j])]
+
+        sends = {}
+        for p in self.peers:
+            parts = [chunk(pos, i, j)
+                     for pos, i in enumerate(self.local_ranks)
+                     for j in self.members_of[p]]
+            sends[p] = [np.concatenate(parts) if parts
+                        else np.zeros((0,), dtype)]
+        got = self._exchange(sends)
+        from_peer: Dict[tuple, np.ndarray] = {}
+        for p in self.peers:
+            msg = np.asarray(got[p][0])
+            off = 0
+            for i in self.members_of[p]:
+                for j in self.local_ranks:
+                    k = int(c[i, j])
+                    from_peer[(i, j)] = msg[off:off + k]
+                    off += k
+            if off != msg.shape[0]:
+                raise MPIError(
+                    ErrorCode.ERR_TRUNCATE,
+                    f"alltoallv message from process {p} has "
+                    f"{msg.shape[0]} elements, count matrix implies "
+                    f"{off} — mismatched sendcounts across processes?",
+                )
+        recv = []
+        for pos, j in enumerate(self.local_ranks):
+            parts = [
+                chunk(self.local_ranks.index(i), i, j)
+                if self.owner[i] == self.my_pidx else from_peer[(i, j)]
+                for i in range(n)
+            ]
+            recv.append(jnp.asarray(np.concatenate(parts) if parts
+                                    else np.zeros((0,), dtype)))
+        return recv
+
+    def _gather_rows(self, bufs: List[np.ndarray]) -> Dict[int, np.ndarray]:
+        """Every rank's ragged buffer: send each LOCAL member's buffer
+        as its own message (shapes ride the wire, so no count
+        pre-exchange), receive each peer's members' in comm-rank
+        order."""
+        for p in self.peers:
+            for b in bufs:
+                self._send(p, b)
+        rows: Dict[int, np.ndarray] = {
+            r: bufs[pos] for pos, r in enumerate(self.local_ranks)
+        }
+        for p in self.peers:
+            for r in self.members_of[p]:
+                rows[r] = self._recv(p)
+        return rows
+
+    def allgatherv(self, comm, sendbufs):
+        """Rank-order concatenation of ragged buffers; identical on
+        every rank, returned once (the vcoll convention)."""
+        bufs = self._ragged_local(sendbufs, "allgatherv")
+        rows = self._gather_rows(bufs)
+        return jnp.asarray(
+            np.concatenate([rows[r] for r in range(comm.size)])
+        )
+
+    def gatherv(self, comm, sendbufs, root: int):
+        """Linear gather to the root's owner process
+        (``coll_base_gatherv`` linear variant): non-owner processes
+        send their members' buffers and return None (MPI leaves the
+        recv buffer undefined off-root); the owner returns the
+        rank-order concatenation."""
+        n = comm.size
+        if not 0 <= root < n:
+            raise MPIError(ErrorCode.ERR_ROOT, f"bad root {root}")
+        bufs = self._ragged_local(sendbufs, "gatherv")
+        owner = self.owner[root]
+        if owner != self.my_pidx:
+            for b in bufs:
+                self._send(owner, b)
+            from .base import NO_RESULT
+
+            return NO_RESULT  # recv buffer undefined off-root
+        rows: Dict[int, np.ndarray] = {
+            r: bufs[pos] for pos, r in enumerate(self.local_ranks)
+        }
+        for p in self.peers:
+            for r in self.members_of[p]:
+                rows[r] = self._recv(p)
+        return jnp.asarray(np.concatenate([rows[r] for r in range(n)]))
+
+    def scatterv(self, comm, sendbuf, counts, root: int):
+        """Root's owner splits ``sendbuf`` by ``counts`` and ships each
+        remote rank's chunk to its owner; returns one array per LOCAL
+        member. ``sendbuf`` is read only on the owner process."""
+        n = comm.size
+        if not 0 <= root < n:
+            raise MPIError(ErrorCode.ERR_ROOT, f"bad root {root}")
+        counts = [int(k) for k in counts]
+        if len(counts) != n or any(k < 0 for k in counts):
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"scatterv needs {n} non-negative counts, got {counts}",
+            )
+        owner = self.owner[root]
+        if owner != self.my_pidx:
+            return [jnp.asarray(self._recv(owner))
+                    for _ in self.local_ranks]
+        buf = np.asarray(sendbuf).reshape(-1)
+        if buf.shape[0] != sum(counts):
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"scatterv root buffer has {buf.shape[0]} elements, "
+                f"counts sum to {sum(counts)}",
+            )
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        chunks = [buf[offs[j]:offs[j] + counts[j]] for j in range(n)]
+        for p in self.peers:
+            for j in self.members_of[p]:
+                self._send(p, chunks[j])
+        return [jnp.asarray(chunks[j]) for j in self.local_ranks]
+
+    def reduce_scatter(self, comm, x, recvcounts, op: Op):
+        """General MPI_Reduce_scatter: combine (local partial, then
+        process-index-order inter combine — the allreduce discipline),
+        each rank keeps its ``recvcounts[i]``-length segment. ``x`` is
+        (local_n, total); returns one array per LOCAL member."""
+        if op.is_pair_op:
+            return _not_available("pair-op reduce_scatter")(comm)
+        n = comm.size
+        recvcounts = [int(k) for k in recvcounts]
+        if len(recvcounts) != n or any(k < 0 for k in recvcounts):
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"reduce_scatter needs {n} non-negative counts",
+            )
+        total = sum(recvcounts)
+        x = np.asarray(x)
+        if x.shape[0] != self.local_n \
+                or x.reshape(self.local_n, -1).shape[1] != total:
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"reduce_scatter needs x shaped ({self.local_n}, "
+                f"{total}), got {x.shape}",
+            )
+        x = x.reshape(self.local_n, total)
+        red = np.asarray(self._combine_with_peers(
+            self._local_partial(jnp.asarray(x), op), op
+        ))
+        offs = np.concatenate([[0], np.cumsum(recvcounts)])
+        return [jnp.asarray(red[offs[r]:offs[r] + recvcounts[r]])
+                for r in self.local_ranks]
 
     # -- prefix scans ------------------------------------------------------
     def _full_rows(self, x) -> Dict[int, np.ndarray]:

@@ -112,6 +112,12 @@ def dropless_moe(comm, tokens, assignments, expert_fn, n_experts: int):
     ``e // (n_experts // n)``. ``expert_fn(e, x)`` applies expert e to
     (K, D) tokens. Returns per-rank (T_i, D) outputs in original token
     order.
+
+    On a communicator SPANNING controller processes (the unified
+    ``tpurun`` world) each process acts only as its LOCAL member
+    ranks: pass one tokens/assignments entry per local member (the
+    hier v-collective convention) and the count matrix is completed
+    with an allgather before routing.
     """
     import numpy as np
 
@@ -119,31 +125,48 @@ def dropless_moe(comm, tokens, assignments, expert_fn, n_experts: int):
     if n_experts % n:
         raise ValueError(f"{n_experts} experts not divisible by {n} ranks")
     e_local = n_experts // n
+    acting = (list(comm.local_comm_ranks)
+              if getattr(comm, "spans_processes", False) else list(range(n)))
+    if len(tokens) != len(acting) or len(assignments) != len(acting):
+        raise ValueError(
+            f"dropless_moe: need one tokens and assignments entry per "
+            f"acting rank ({len(acting)}), got {len(tokens)} tokens / "
+            f"{len(assignments)} assignments"
+        )
     toks = [np.asarray(t) for t in tokens]
     assign = [np.asarray(a).astype(np.int64) for a in assignments]
     d = toks[0].shape[1] if toks[0].ndim == 2 else 1
 
-    # sort each rank's tokens by destination rank (stable keeps order
-    # within a destination — needed to invert the permutation later)
+    # sort each acting rank's tokens by destination rank (stable keeps
+    # order within a destination — needed to invert the permutation)
     owners = [a // e_local for a in assign]
     order = [np.argsort(o, kind="stable") for o in owners]
-    counts = np.zeros((n, n), dtype=np.int64)
-    for i in range(n):
-        for j, k in zip(*np.unique(owners[i], return_counts=True)):
-            counts[i, int(j)] = int(k)
+    local_counts = np.zeros((len(acting), n), dtype=np.int64)
+    for pos in range(len(acting)):
+        for j, k in zip(*np.unique(owners[pos], return_counts=True)):
+            local_counts[pos, int(j)] = int(k)
+    if len(acting) == n:
+        counts = local_counts
+    else:
+        # complete the (n, n) matrix: every process contributes its
+        # members' rows in comm-rank order
+        counts = np.asarray(
+            comm.allgather(local_counts)
+        )[0].reshape(n, n).astype(np.int64)
 
-    sendbufs = [toks[i][order[i]].reshape(-1) for i in range(n)]
+    sendbufs = [toks[pos][order[pos]].reshape(-1)
+                for pos in range(len(acting))]
     recv = comm.alltoallv(sendbufs, counts * d)
     # forward the expert ids alongside (same counts, 1 elem per token)
     recv_ids = comm.alltoallv(
-        [assign[i][order[i]] for i in range(n)], counts
+        [assign[pos][order[pos]] for pos in range(len(acting))], counts
     )
 
-    # each rank runs its local experts on the exact token set
+    # each acting rank runs its local experts on the exact token set
     processed = []
-    for j in range(n):
-        rt = np.asarray(recv[j]).reshape(-1, d)
-        ids = np.asarray(recv_ids[j])
+    for pos, j in enumerate(acting):
+        rt = np.asarray(recv[pos]).reshape(-1, d)
+        ids = np.asarray(recv_ids[pos])
         out = np.empty_like(rt)
         for e in range(j * e_local, (j + 1) * e_local):
             sel = ids == e
@@ -154,9 +177,9 @@ def dropless_moe(comm, tokens, assignments, expert_fn, n_experts: int):
     # route back: the return counts matrix is the transpose
     back = comm.alltoallv(processed, counts.T * d)
     outputs = []
-    for i in range(n):
-        sorted_out = np.asarray(back[i]).reshape(-1, d)
-        inv = np.empty_like(order[i])
-        inv[order[i]] = np.arange(order[i].shape[0])
+    for pos in range(len(acting)):
+        sorted_out = np.asarray(back[pos]).reshape(-1, d)
+        inv = np.empty_like(order[pos])
+        inv[order[pos]] = np.arange(order[pos].shape[0])
         outputs.append(jnp.asarray(sorted_out[inv]))
     return outputs

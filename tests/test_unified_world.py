@@ -238,6 +238,107 @@ class TestUnifiedWorld:
         """)
         assert "HIER-OK 0" in out and "HIER-OK 4" in out
 
+    def test_hier_vector_collectives_parity(self, tmp_path, capfd):
+        """The five v-variant collectives across the 8-rank 2-process
+        world: ragged buffers, zero counts included, parity vs the
+        global numpy picture (the round-4 ERR_NOT_AVAILABLE gap)."""
+        out = _run(tmp_path, capfd, """
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            # ragged: rank r holds r+1 elements valued 100*r + k
+            full = [np.asarray([100 * r + k for k in range(r + 1)],
+                               np.int32) for r in range(n)]
+            mine = full[off:off + 4]
+
+            ag = np.asarray(world.allgatherv(mine))
+            np.testing.assert_array_equal(ag, np.concatenate(full))
+
+            gv = world.gatherv(mine, root=5)
+            if off == 4:
+                np.testing.assert_array_equal(np.asarray(gv),
+                                              np.concatenate(full))
+            else:
+                assert gv is None
+
+            counts = [r + 1 for r in range(n)]
+            sendbuf = np.concatenate(full) if off == 0 else None
+            sv = world.scatterv(sendbuf, counts, root=2)
+            assert len(sv) == 4
+            for i in range(4):
+                np.testing.assert_array_equal(np.asarray(sv[i]),
+                                              full[off + i])
+
+            # alltoallv count matrix with zeros: c[i][j] = (i+j) % 3
+            c = np.asarray([[(i + j) % 3 for j in range(n)]
+                            for i in range(n)], np.int64)
+            sb = [np.concatenate([np.full(c[i, j], 10 * i + j, np.int32)
+                                  for j in range(n)])
+                  for i in range(off, off + 4)]
+            rv = world.alltoallv(sb, c)
+            for pos, j in enumerate(range(off, off + 4)):
+                want = np.concatenate([np.full(c[i, j], 10 * i + j,
+                                               np.int32)
+                                       for i in range(n)])
+                np.testing.assert_array_equal(np.asarray(rv[pos]), want)
+
+            # general reduce_scatter, uneven counts
+            rc = [r + 1 for r in range(n)]
+            tot = sum(rc)
+            x = np.stack([np.arange(tot, dtype=np.int32) * (off + i + 1)
+                          for i in range(4)])
+            rs = world.reduce_scatter(x, rc)
+            wantfull = sum(np.arange(tot, dtype=np.int32) * (r + 1)
+                           for r in range(n))
+            offs = np.concatenate([[0], np.cumsum(rc)])
+            for i in range(4):
+                r = off + i
+                np.testing.assert_array_equal(
+                    np.asarray(rs[i]), wantfull[offs[r]:offs[r] + rc[r]])
+
+            world.barrier()
+            print(f"VCOLL-OK {off}")
+            mpi.finalize()
+        """)
+        assert "VCOLL-OK 0" in out and "VCOLL-OK 4" in out
+
+    def test_dropless_moe_on_spanning_world(self, tmp_path, capfd):
+        """The flagship dropless-MoE routing step (parallel/ep.py) on
+        the unified multi-controller world: alltoallv-driven token
+        routing with exact per-token parity — the round-4 blocker
+        ('the flagship MoE cannot run on a unified world')."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.parallel.ep import dropless_moe
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            n_experts = 16
+            d = 4
+            rng = np.random.RandomState(0)  # same stream everywhere
+            all_tokens = [rng.randn(3 + r, d).astype(np.float32)
+                          for r in range(n)]
+            all_assign = [rng.randint(0, n_experts, size=(3 + r,))
+                          for r in range(n)]
+
+            def expert_fn(e, x):
+                return x * (e + 1)
+
+            outs = dropless_moe(world, all_tokens[off:off + 4],
+                                all_assign[off:off + 4], expert_fn,
+                                n_experts)
+            for i in range(4):
+                r = off + i
+                want = all_tokens[r] * (all_assign[r][:, None] + 1)
+                np.testing.assert_allclose(np.asarray(outs[i]), want,
+                                           rtol=1e-6)
+            world.barrier()
+            print(f"MOE-OK {off}")
+            mpi.finalize()
+        """)
+        assert "MOE-OK 0" in out and "MOE-OK 4" in out
+
     def test_split_type_shared_gives_local_comm(self, tmp_path, capfd):
         """split_type(COMM_TYPE_SHARED) on the unified world yields the
         process-local communicator, which runs the normal in-process
