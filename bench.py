@@ -456,44 +456,96 @@ def _mesh_specs(jax, jnp, devices, on_tpu):
     return specs, ("ceiling_copy",)
 
 
-def _init_backend(jax, attempts=4, first_delay=5.0):
-    """jax.devices() with bounded retry-with-backoff.
+def _init_backend(jax, attempts=3, first_delay=5.0,
+                  attempt_timeout_s=180.0):
+    """jax.devices() with bounded retry-with-backoff AND a watchdog.
 
     Round 4's BENCH record was lost to a transient axon outage
-    (UNAVAILABLE at backend setup). Retry a few times; on final failure
-    return None so main() can emit a parseable tpu_unavailable marker
-    instead of a traceback."""
+    (UNAVAILABLE at backend setup); the same outage class can also make
+    ``jax.devices()`` HANG inside the tunnel rather than raise, which
+    no try/except can bound — so each attempt runs on a daemon thread
+    with a deadline. On final failure the caller gets None and main()
+    emits a parseable tpu_unavailable marker; a hung attempt exits via
+    ``os._exit`` after printing it (the stuck C call would otherwise
+    block interpreter teardown past the driver's timeout)."""
+    import os
+    import threading
+
     delay = first_delay
-    last = None
+    last = "unknown"
     for i in range(attempts):
-        try:
-            return jax.devices()
-        except Exception as e:  # jaxlib raises RuntimeError subtypes
-            last = e
+        box = {}
+
+        def probe():
+            try:
+                box["devices"] = jax.devices()
+            except Exception as e:  # jaxlib raises RuntimeError subtypes
+                box["error"] = e
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout=attempt_timeout_s)
+        if "devices" in box:
+            return box["devices"]
+        if t.is_alive():
+            # stuck inside the backend client: no recovery is possible
+            # in-process — record the marker and hard-exit parseably
             print(json.dumps({
-                "event": "backend_init_retry", "attempt": i + 1,
-                "error": str(e)[:200],
-            }), file=sys.stderr)
-            if i + 1 < attempts:
-                time.sleep(delay)
-                delay *= 2
-                try:
-                    import jax._src.api as _api
-                    _api.clear_backends()
-                except Exception:
-                    pass
+                "metric": "bench_error", "value": None, "unit": None,
+                "vs_baseline": None, "error": "tpu_unavailable",
+                "detail": f"backend init hung > {attempt_timeout_s:.0f}s "
+                          f"(attempt {i + 1})",
+            }), flush=True)
+            os._exit(0)
+        last = str(box.get("error", "unknown"))
+        print(json.dumps({
+            "event": "backend_init_retry", "attempt": i + 1,
+            "error": last[:200],
+        }), file=sys.stderr)
+        if i + 1 < attempts:
+            time.sleep(delay)
+            delay *= 2
+            try:
+                import jax._src.api as _api
+                _api.clear_backends()
+            except Exception:
+                pass
     print(json.dumps({
         "metric": "bench_error", "value": None, "unit": None,
         "vs_baseline": None, "error": "tpu_unavailable",
-        "detail": str(last)[:300],
+        "detail": last[:300],
     }))
     return None
+
+
+def _arm_global_watchdog(budget_s=1500.0):
+    """If the whole run exceeds ``budget_s`` (a healthy TPU run takes
+    ~2-4 min; only a mid-sweep tunnel hang gets near this), print the
+    parseable marker and hard-exit so the driver records evidence
+    instead of a timeout."""
+    import os
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "bench_error", "value": None, "unit": None,
+            "vs_baseline": None, "error": "tpu_unavailable",
+            "detail": f"bench exceeded {budget_s:.0f}s wall budget "
+                      "(backend hang mid-sweep?)",
+        }), flush=True)
+        os._exit(0)
+
+    t = threading.Timer(budget_s, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def main():
     import jax
     import jax.numpy as jnp
 
+    watchdog = _arm_global_watchdog()
     devices = _init_backend(jax)
     if devices is None:
         return 0
@@ -639,6 +691,7 @@ def main():
     for ln in lines:
         print(json.dumps(ln))
     print(json.dumps(headline))
+    watchdog.cancel()
 
 
 if __name__ == "__main__":

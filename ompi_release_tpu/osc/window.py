@@ -64,10 +64,10 @@ class _EpochKind(enum.Enum):
 
 class _PendingOp:
     __slots__ = ("kind", "target", "data", "op", "request", "compare",
-                 "index")
+                 "index", "status_rank")
 
     def __init__(self, kind, target, data=None, op=None, request=None,
-                 compare=None, index=None) -> None:
+                 compare=None, index=None, status_rank=None) -> None:
         self.kind = kind
         self.target = target
         self.data = data
@@ -77,6 +77,9 @@ class _PendingOp:
         # flat element offset within the target slot (MPI target_disp
         # for single-element ops); None = whole-slot operation
         self.index = index
+        # the COMM rank to report in the request's Status when target
+        # has been remapped to a storage row (spanning windows)
+        self.status_rank = status_rank
 
 
 # predefined window attributes (mpi.h MPI_WIN_BASE..MPI_WIN_MODEL)
@@ -98,12 +101,30 @@ MODEL_UNIFIED = 2
 
 class Window:
     def __init__(self, comm, base: jax.Array, name: str = "") -> None:
+        if getattr(comm, "spans_processes", False):
+            # guard against silent mis-sharding: comm.submesh covers
+            # only LOCAL members on a spanning comm, so placing
+            # comm.size rows over it would scatter remote ranks' slices
+            # onto local devices — the wire window stores local slices
+            # and ships remote RMA to its home (osc/wire_win.py)
+            raise MPIError(
+                ErrorCode.ERR_WIN,
+                f"{comm.name} spans controller processes; construct "
+                "windows through win_create/win_allocate (wire-window "
+                "path), not Window() directly",
+            )
         if base.shape[0] != comm.size:
             raise MPIError(
                 ErrorCode.ERR_WIN,
                 f"window base leading axis {base.shape[0]} != comm size "
                 f"{comm.size}",
             )
+        self._init_state(comm, base, name)
+
+    def _init_state(self, comm, base, name: str) -> None:
+        """Shared field setup (subclasses with a different leading-axis
+        contract — the spanning-comm wire window — reuse this so new
+        fields cannot silently diverge)."""
         self.comm = comm
         self.name = name or f"win{id(self):x}"
         self._shard = NamedSharding(comm.submesh, P("rank"))
@@ -435,11 +456,9 @@ class Window:
         with self._op_lock:
             self._apply_pending_locked(only_target)
 
-    def _apply_pending_locked(self, only_target: Optional[int] = None
-                              ) -> None:
-        if not self._pending:
-            return
-        _epoch_count.add()
+    def _take_pending(self, only_target: Optional[int] = None
+                      ) -> List[_PendingOp]:
+        """Atomically remove (and return) the ops this close covers."""
         if only_target is None:
             todo, self._pending = self._pending, []
         else:
@@ -447,6 +466,19 @@ class Window:
             self._pending = [
                 p for p in self._pending if p.target != only_target
             ]
+        return todo
+
+    def _apply_pending_locked(self, only_target: Optional[int] = None
+                              ) -> None:
+        if not self._pending:
+            return
+        _epoch_count.add()
+        self._run_epoch_program(self._take_pending(only_target))
+
+    def _run_epoch_program(self, todo: List[_PendingOp]) -> None:
+        """Apply ``todo`` (targets = storage row indices) as one
+        compiled program and complete its read requests. Callers hold
+        ``_op_lock``."""
         if not todo:
             return
         from jax import lax
@@ -547,14 +579,19 @@ class Window:
                 if p.index is not None:
                     # single-element op: hand back the element itself
                     value = value.reshape(-1)[p.index]
-                p.request.complete(value=value,
-                                   status=Status(source=p.target))
+                src = (p.target if p.status_rank is None
+                       else p.status_rank)
+                p.request.complete(value=value, status=Status(source=src))
         self._data = new_data
 
 
 def win_create(comm, base, name: str = "") -> Window:
     """MPI_Win_create: wrap existing per-rank buffers (leading rank
-    axis)."""
+    axis; one slice per LOCAL member on a spanning comm)."""
+    if getattr(comm, "spans_processes", False):
+        from .wire_win import WireWindow
+
+        return WireWindow(comm, jnp.asarray(base), name)
     return Window(comm, jnp.asarray(base), name)
 
 
@@ -562,9 +599,17 @@ def win_allocate(comm, shape: Tuple[int, ...], dtype=jnp.float32,
                  name: str = "") -> Window:
     """MPI_Win_allocate: fresh zeroed window, one ``shape`` block per
     rank."""
-    win = Window(
-        comm, jnp.zeros((comm.size,) + tuple(shape), dtype), name
-    )
+    if getattr(comm, "spans_processes", False):
+        from .wire_win import WireWindow
+
+        local_n = len(comm.local_comm_ranks)
+        win = WireWindow(
+            comm, jnp.zeros((local_n,) + tuple(shape), dtype), name
+        )
+    else:
+        win = Window(
+            comm, jnp.zeros((comm.size,) + tuple(shape), dtype), name
+        )
     win._flavor = FLAVOR_ALLOCATE
     return win
 
@@ -580,6 +625,13 @@ def win_allocate_shared(comm, shape: Tuple[int, ...],
     address space by construction, so every comm qualifies; a real
     multi-host comm would reject here, and the honest check is the
     endpoints' host identity)."""
+    if getattr(comm, "spans_processes", False):
+        raise MPIError(
+            ErrorCode.ERR_RMA_SHARED,
+            "win_allocate_shared needs a process-local comm (device "
+            "buffers cannot be shared across controller processes); "
+            "split with split_type_shared first",
+        )
     # direct attribute access ON PURPOSE: a rename in runtime/group
     # must surface as an AttributeError here, not silently turn the
     # multi-host safety gate vacuous

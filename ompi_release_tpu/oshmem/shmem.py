@@ -58,8 +58,22 @@ class SymmetricArray:
 
     def local(self, pe: int) -> jax.Array:
         """PE ``pe``'s local view (shmem_ptr analogue; driver mode sees
-        every PE)."""
+        every PE). On a unified multi-controller world only
+        same-process PEs are addressable — the reference's shmem_ptr
+        returns NULL for PEs without a load/store path
+        (``oshmem/shmem/c/shmem_ptr.c``); use :meth:`ShmemCtx.get`
+        for remote PEs."""
         self._win.flush_all()
+        comm = self._win.comm
+        if getattr(comm, "spans_processes", False):
+            lr = list(comm.local_comm_ranks)
+            if pe not in lr:
+                raise MPIError(
+                    ErrorCode.ERR_RMA_SHARED,
+                    f"shmem_ptr: PE {pe} lives in another controller "
+                    "process (no load/store path); use get()",
+                )
+            return self._win.read()[lr.index(pe)]
         return self._win.read()[pe]
 
     def free(self) -> None:

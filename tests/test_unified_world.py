@@ -447,6 +447,160 @@ class TestUnifiedWorld:
         assert rc == 0, out.out + out.err
         assert "CID-SYNC-OK" in out.out
 
+    def test_cross_process_rma_fence_parity(self, tmp_path, capfd):
+        """put/get/accumulate/CAS from process 0 into slices owned by
+        process 1 (and back), fence epochs, parity vs the values a
+        single-process window would hold — the round-4 'no
+        cross-process RMA' gap (osc/wire_win.py home-process-applies
+        path vs osc_rdma_data_move.c)."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.osc.window import win_allocate
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+
+            win = win_allocate(world, (4,), np.float32)
+            win.fence()
+            if off == 0:
+                # put into a REMOTE slice (rank 5, process 1)
+                win.put(np.full(4, 7.0, np.float32), 5)
+                # accumulate into remote rank 6
+                win.accumulate(np.full(4, 2.0, np.float32), 6)
+                # and a local put for contrast
+                win.put(np.full(4, 1.5, np.float32), 1)
+            else:
+                # process 1 accumulates into a REMOTE slice (rank 2)
+                win.accumulate(np.full(4, 3.0, np.float32), 2)
+            win.fence_end()
+            local = np.asarray(win.read())
+            if off == 0:
+                np.testing.assert_array_equal(local[1],
+                                              np.full(4, 1.5))
+                np.testing.assert_array_equal(local[2], np.full(4, 3.0))
+            else:
+                np.testing.assert_array_equal(local[5 - 4],
+                                              np.full(4, 7.0))
+                np.testing.assert_array_equal(local[6 - 4],
+                                              np.full(4, 2.0))
+
+            # remote get + fetch_and_op under a passive (lock) epoch
+            if off == 0:
+                win.lock(5)
+                req = win.get(5)
+                win.unlock(5)
+                np.testing.assert_array_equal(np.asarray(req.value),
+                                              np.full(4, 7.0))
+                win.lock(6)
+                req = win.fetch_and_op(np.full(4, 1.0, np.float32), 6)
+                win.flush(6)
+                old = np.asarray(req.value)
+                win.unlock(6)
+                np.testing.assert_array_equal(old, np.full(4, 2.0))
+            world.barrier()
+            if off == 4:
+                got = np.asarray(win.read())[6 - 4]
+                np.testing.assert_array_equal(got, np.full(4, 3.0))
+
+            # single-element CAS into a remote slot
+            if off == 4:
+                win.lock(1)
+                req = win.compare_and_swap(
+                    np.float32(9.0), np.float32(1.5), 1, index=2)
+                win.unlock(1)
+                assert float(np.asarray(req.value)) == 1.5
+            world.barrier()
+            if off == 0:
+                got = np.asarray(win.read())[1]
+                np.testing.assert_array_equal(
+                    got, np.asarray([1.5, 1.5, 9.0, 1.5], np.float32))
+            win.free()
+            print(f"RMA-OK {off}")
+            mpi.finalize()
+        """)
+        assert "RMA-OK 0" in out and "RMA-OK 4" in out
+
+    def test_cross_process_lock_exclusion(self, tmp_path, capfd):
+        """Two processes contending for an exclusive lock on the same
+        target serialize at the target's home: read-modify-write under
+        the lock never loses an update."""
+        out = _run(tmp_path, capfd, """
+            import time
+            from ompi_release_tpu.osc.window import win_allocate
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+
+            win = win_allocate(world, (1,), np.int32)
+            world.barrier()
+            # both processes: 20 exclusive-lock increments of rank 0's
+            # word via fetch_and_op (atomic at the home regardless) AND
+            # a read-modify-write via get + put (needs the lock)
+            for _ in range(10):
+                win.lock(0)
+                req = win.get(0)
+                win.flush(0)
+                cur = int(np.asarray(req.value)[0])
+                win.put(np.int32([cur + 1]), 0)
+                win.unlock(0)
+            world.barrier()
+            if off == 0:
+                total = int(np.asarray(win.read())[0, 0])
+                assert total == 20, total
+                print("LOCK-TOTAL", total)
+            win.free()
+            print(f"LOCK-OK {off}")
+            mpi.finalize()
+        """)
+        assert "LOCK-OK 0" in out and "LOCK-OK 4" in out
+        assert "LOCK-TOTAL 20" in out
+
+    def test_cross_process_shmem(self, tmp_path, capfd):
+        """OSHMEM symmetric heap riding the wire window: put/get/AMOs
+        between PEs in different processes, wait_until across the
+        boundary, and shmem_ptr correctly refusing non-local PEs."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.oshmem import shmem
+            from ompi_release_tpu.utils.errors import MPIError
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+
+            ctx = shmem.shmem_init(world)
+            sym = ctx.malloc((3,), np.float32)
+            world.barrier()
+            if off == 0:
+                ctx.put(sym, np.asarray([1., 2., 3.], np.float32), 6)
+                ctx.quiet()
+                world.barrier()  # put visible
+                world.barrier()  # proc 1 read it
+                # fetch-add on a remote PE
+                old = np.asarray(ctx.atomic_fetch_add(
+                    sym, np.ones(3, np.float32), 6))
+                np.testing.assert_array_equal(
+                    old, np.asarray([1., 2., 3.]))
+                try:
+                    sym.local(6)
+                    raise SystemExit("FAIL: shmem_ptr crossed processes")
+                except MPIError:
+                    pass
+                world.barrier()  # fetch-add done
+            else:
+                world.barrier()  # wait for the put+quiet
+                got = np.asarray(ctx.get(sym, 6))
+                np.testing.assert_array_equal(
+                    got, np.asarray([1., 2., 3.]))
+                world.barrier()  # release proc 0's fetch-add
+                world.barrier()  # fetch-add done
+                got = np.asarray(ctx.get(sym, 6))
+                np.testing.assert_array_equal(
+                    got, np.asarray([2., 3., 4.]))
+            world.barrier()
+            print(f"SHMEM-OK {off}")
+            mpi.finalize()
+        """)
+        assert "SHMEM-OK 0" in out and "SHMEM-OK 4" in out
+
     def test_unified_world_opt_out(self, tmp_path, capfd):
         """--mca runtime_unified_world false restores per-process
         local worlds (the pre-unification behavior)."""
