@@ -48,6 +48,11 @@ _comm_registry: Dict[int, "Communicator"] = {}
 
 _comm_count = pvar.counter("comm_active_count", "live communicators")
 
+#: set on a spanning comm's progress-worker thread so collectives
+#: nested inside a worker-run operation execute directly instead of
+#: re-submitting to (and deadlocking on) the same single worker
+_nbc_tls = threading.local()
+
 
 def _next_cid(internal: bool = False) -> int:
     with _cid_lock:
@@ -141,6 +146,13 @@ class Communicator:
             self.c_coll = coll_base.comm_select(self)
         else:
             self.c_coll = {}
+
+        # nonblocking-progress worker for spanning comms (created on
+        # first i-collective; one worker => posting order preserved)
+        import threading as _threading
+
+        self._nbc_guard = _threading.Lock()
+        self._nbc_exec = None
 
         _comm_registry[self.cid] = self
         _comm_count.add()
@@ -239,6 +251,15 @@ class Communicator:
 
     def free(self) -> None:
         self._check_alive()
+        if self._nbc_exec is not None:
+            # outstanding i-collectives must drain FIRST — before the
+            # _on_free hooks free the hier shadow comm and the cid
+            # leaves the registry, both of which a mid-flight spanning
+            # collective still uses (MPI_Comm_free after pending
+            # nonblocking ops is erroneous; draining turns it into a
+            # late completion, not a crash)
+            self._nbc_exec.shutdown(wait=True)
+            self._nbc_exec = None
         for kv_id, value in list(self._attrs.items()):
             kv = _keyval_table.get(kv_id)
             if kv and kv.delete_fn:
@@ -379,7 +400,37 @@ class Communicator:
                 ErrorCode.ERR_INTERN,
                 f"no {op_name} implementation installed on {self.name}",
             )
-        return fn
+        if not self.spans_processes:
+            return fn
+        # spanning comms: EVERY collective funnels through the one
+        # progress worker so blocking and nonblocking calls execute in
+        # posting order on every process — their wire exchanges share
+        # one per-cid channel, and two concurrently-running collectives
+        # would interleave frames on it
+        return lambda comm_, *a, **k: self._run_serialized(
+            fn, comm_, *a, **k)
+
+    def _on_worker(self, fn, *args, **kw):
+        _nbc_tls.comm = self  # the worker serves exactly this comm
+        return fn(*args, **kw)
+
+    def _run_serialized(self, fn, *args, **kw):
+        """Run a collective through the comm's single progress worker
+        (direct when already on it — nested collectives inside a
+        worker-run op, e.g. the barrier closing a two-phase IO)."""
+        if not self.spans_processes \
+                or getattr(_nbc_tls, "comm", None) is self:
+            return fn(*args, **kw)
+        return self._nbc_pool().submit(
+            self._on_worker, fn, *args, **kw).result()
+
+    def _submit_serialized(self, fn, *args, **kw):
+        """Nonblocking variant of :meth:`_run_serialized`: returns a
+        Request backed by the worker future."""
+        from ..request.request import from_future
+
+        return from_future(self._nbc_pool().submit(
+            self._on_worker, fn, *args, **kw))
 
     def allreduce(self, x, op=None, **kw):
         from .. import ops as ops_mod
@@ -474,35 +525,67 @@ class Communicator:
         req.value = value
         return req
 
+    def _async_call(self, fn, *args, **kw):
+        """Nonblocking collective dispatch. In-process comms: XLA
+        dispatch is already async, so call now and wrap the future
+        arrays (the compiled program IS the libnbc round schedule).
+        SPANNING comms: the hier collective's OOB exchanges block, so
+        run the whole call on the comm's nonblocking-progress worker
+        (the ``NBC_Progress`` thread analogue,
+        ``ompi/mca/coll/libnbc/nbc.c:310``) — the i-call returns
+        immediately and overlaps with user compute. ONE worker per
+        comm: outstanding collectives progress in posting order, which
+        preserves the same-order-on-every-rank collective contract
+        across processes."""
+        if not self.spans_processes:
+            return self._async(fn(*args, **kw))
+        return self._submit_serialized(fn, *args, **kw)
+
+    def _nbc_pool(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._nbc_guard:
+            if self._nbc_exec is None:
+                self._nbc_exec = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix=f"nbc-{self.name}"
+                )
+            return self._nbc_exec
+
     def iallreduce(self, x, op=None, **kw):
-        return self._async(self.allreduce(x, op, **kw))
+        return self._async_call(self.allreduce, x, op, **kw)
 
     def ireduce(self, x, op=None, root: int = 0, **kw):
-        return self._async(self.reduce(x, op, root, **kw))
+        return self._async_call(self.reduce, x, op, root, **kw)
 
     def ibcast(self, x, root: int = 0, **kw):
-        return self._async(self.bcast(x, root, **kw))
+        return self._async_call(self.bcast, x, root, **kw)
 
     def iallgather(self, x, **kw):
-        return self._async(self.allgather(x, **kw))
+        return self._async_call(self.allgather, x, **kw)
 
     def igather(self, x, root: int = 0, **kw):
-        return self._async(self.gather(x, root, **kw))
+        return self._async_call(self.gather, x, root, **kw)
 
     def iscatter(self, x, root: int = 0, **kw):
-        return self._async(self.scatter(x, root, **kw))
+        return self._async_call(self.scatter, x, root, **kw)
 
     def ireduce_scatter_block(self, x, op=None, **kw):
-        return self._async(self.reduce_scatter_block(x, op, **kw))
+        return self._async_call(self.reduce_scatter_block, x, op, **kw)
 
     def ialltoall(self, x, **kw):
-        return self._async(self.alltoall(x, **kw))
+        return self._async_call(self.alltoall, x, **kw)
 
     def iscan(self, x, op=None, **kw):
-        return self._async(self.scan(x, op, **kw))
+        return self._async_call(self.scan, x, op, **kw)
 
     def iexscan(self, x, op=None, **kw):
-        return self._async(self.exscan(x, op, **kw))
+        return self._async_call(self.exscan, x, op, **kw)
+
+    def ialltoallv(self, sendbufs, sendcounts):
+        return self._async_call(self.alltoallv, sendbufs, sendcounts)
+
+    def iallgatherv(self, sendbufs):
+        return self._async_call(self.allgatherv, sendbufs)
 
     def ibarrier(self):
         """Nonblocking barrier that really is nonblocking: the
@@ -517,6 +600,11 @@ class Communicator:
         fn = self.c_coll.get("ibarrier")
         if fn is not None:
             return self._async(fn(self))
+        if self.spans_processes:
+            # same single progress worker as the other i-collectives:
+            # an ibarrier posted between two iallreduces keeps its
+            # posting-order slot across every process
+            return self._submit_serialized(self.barrier)
 
         import threading
 

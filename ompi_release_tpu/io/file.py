@@ -39,10 +39,6 @@ MODE_RDWR = os.O_RDWR
 MODE_CREATE = os.O_CREAT
 
 
-def _raise(exc) -> None:
-    raise exc
-
-
 class File:
     """MPI_File analogue bound to a communicator."""
 
@@ -179,8 +175,20 @@ class File:
         (driver mode: per-rank lists). Disjoint contiguous extents per
         rank = the post-aggregation phase of fcoll/two_phase. The
         per-rank pwrites are issued concurrently (os.pwrite releases
-        the GIL), matching the aggregators-write-in-parallel phase."""
+        the GIL), matching the aggregators-write-in-parallel phase.
+
+        On a communicator spanning controller processes the lists
+        carry one entry per LOCAL member and the real two-phase
+        exchange runs over the wire (io/two_phase.py)."""
         self._check()
+        if getattr(self.comm, "spans_processes", False):
+            from . import two_phase
+
+            # through the comm's one collective worker: the exchange
+            # shares the comm's wire channel with every other
+            # collective, so posting order must be execution order
+            return self.comm._run_serialized(
+                two_phase.write_at_all, self, offsets, blocks)
         if len(offsets) != self.comm.size or len(blocks) != self.comm.size:
             raise MPIError(
                 ErrorCode.ERR_ARG,
@@ -198,6 +206,11 @@ class File:
 
     def read_at_all(self, offsets, counts):
         self._check()
+        if getattr(self.comm, "spans_processes", False):
+            from . import two_phase
+
+            return self.comm._run_serialized(
+                two_phase.read_at_all, self, offsets, counts)
         if len(offsets) != self.comm.size or len(counts) != self.comm.size:
             raise MPIError(
                 ErrorCode.ERR_ARG,
@@ -225,36 +238,18 @@ class File:
 
     @staticmethod
     def _future_request(fut: Future) -> Request:
-        """Wrap a pool future as a Request: success completes with the
-        value (and an element-count Status); failure surfaces the
-        exception at test()/wait() — the libnbc error-on-progress
-        contract."""
-        completed = threading.Event()
+        """The generic future wrapper plus IO's element-count Status
+        (``MPI_Get_count`` on a file request)."""
+        from ..request.request import from_future
 
-        def block() -> None:
-            fut.result()      # raises the worker's exception
-            # Future.set_result wakes result() BEFORE running done
-            # callbacks: wait until the callback has completed the
-            # request, or wait()'s bare complete() would win the race
-            # and report value=None/count=0 for a successful op
-            completed.wait()
+        req = from_future(fut)
 
-        req = Request(
-            progress_fn=lambda r: (_raise(fut.exception())
-                                   if fut.done() and fut.exception()
-                                   else None),
-            block_fn=block,
-        )
+        def _count(r: Request) -> None:
+            v = r.value
+            r.status.count = (int(v) if isinstance(v, int)
+                              else int(getattr(v, "size", 0)))
 
-        def _done(f: Future) -> None:
-            if f.exception() is None:
-                v = f.result()
-                cnt = (int(v) if isinstance(v, int)
-                       else int(getattr(v, "size", 0)))
-                req.complete(value=v, status=Status(count=cnt))
-            completed.set()
-
-        fut.add_done_callback(_done)
+        req.on_complete(_count)
         return req
 
     def iwrite_at(self, offset: int, data) -> Request:
@@ -277,16 +272,28 @@ class File:
         """Nonblocking collective write (MPI_File_iwrite_at_all): the
         whole fcoll exchange runs on the pool thread; collective
         ordering across the communicator is the caller's duty, as in
-        MPI."""
+        MPI. On a spanning comm it submits straight to the comm's ONE
+        collective worker (the 4-worker io pool would reorder two
+        outstanding collectives between posting and execution)."""
         self._check()
         blocks = [np.ascontiguousarray(np.asarray(b, self._etype))
                   for b in blocks]
+        if getattr(self.comm, "spans_processes", False):
+            from . import two_phase
+
+            return self.comm._submit_serialized(
+                two_phase.write_at_all, self, offsets, blocks)
         return self._future_request(
             self._io_pool().submit(self.write_at_all, offsets, blocks)
         )
 
     def iread_at_all(self, offsets, counts) -> Request:
         self._check()
+        if getattr(self.comm, "spans_processes", False):
+            from . import two_phase
+
+            return self.comm._submit_serialized(
+                two_phase.read_at_all, self, offsets, counts)
         return self._future_request(
             self._io_pool().submit(self.read_at_all, offsets, counts)
         )

@@ -169,6 +169,43 @@ class Request:
         self._on_complete.clear()
 
 
+def _raise(exc) -> None:
+    raise exc
+
+
+def from_future(fut) -> Request:
+    """Wrap a ``concurrent.futures.Future`` as a Request: success
+    completes with the future's value; failure surfaces the worker's
+    exception at test()/wait() (the libnbc error-on-progress
+    contract). Shared by the nonblocking-IO pool
+    (``io/file.py:_future_request`` adds its count Status on top) and
+    the spanning-comm nonblocking collectives."""
+    completed = threading.Event()
+
+    def block() -> None:
+        fut.result()  # raises the worker's exception
+        # Future.set_result wakes result() BEFORE running done
+        # callbacks: wait until the callback has completed the
+        # request, or wait()'s bare complete() would win the race and
+        # report value=None for a successful op
+        completed.wait()
+
+    req = Request(
+        progress_fn=lambda r: (_raise(fut.exception())
+                               if fut.done() and fut.exception()
+                               else None),
+        block_fn=block,
+    )
+
+    def _done(f) -> None:
+        if f.exception() is None:
+            req.complete(value=f.result())
+        completed.set()
+
+    fut.add_done_callback(_done)
+    return req
+
+
 class GeneralizedRequest(Request):
     """MPI_Grequest_start analogue: user code completes it."""
 

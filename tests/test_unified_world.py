@@ -601,6 +601,126 @@ class TestUnifiedWorld:
         """)
         assert "SHMEM-OK 0" in out and "SHMEM-OK 4" in out
 
+    def test_cross_process_collective_io_two_phase(self, tmp_path, capfd):
+        """write_at_all/read_at_all on the spanning world do a REAL
+        two-phase exchange over the wire (io/two_phase.py vs
+        fcoll_two_phase_file_write_all.c): interleaved per-rank
+        extents from 2 processes must produce a file bit-identical to
+        the single-process reference file, including through a holey
+        vector view; nonblocking variants included."""
+        ref = tmp_path / "ref.bin"
+        # single-process reference: ranks 0..7 write 5 elements each,
+        # rank r at element offset r*5, value 100*r + k
+        import numpy as np_
+        refdata = np_.concatenate([
+            100 * r + np_.arange(5, dtype=np_.int32) for r in range(8)
+        ])
+        refdata.tofile(str(ref))
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.io.file import File, MODE_RDWR, \\
+                MODE_CREATE
+            from ompi_release_tpu.datatype import datatype as dt
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            path = %r
+
+            f = File(world, path)
+            f.set_view(etype=np.int32)
+            # INTERLEAVED extents: local member i (comm rank off+i)
+            # writes at element (off+i)*5 — pieces of both processes'
+            # blocks land in both aggregators' file domains
+            offs = [(off + i) * 5 for i in range(4)]
+            blocks = [100 * (off + i) + np.arange(5, dtype=np.int32)
+                      for i in range(4)]
+            total = f.write_at_all(offs, blocks)
+            assert total == 40, total
+
+            # collective read back: every member its own extent
+            got = f.read_at_all(offs, [5] * 4)
+            for i in range(4):
+                np.testing.assert_array_equal(got[i], blocks[i])
+
+            # nonblocking collective variants
+            req = f.iwrite_at_all(offs, blocks)
+            req.wait()
+            req = f.iread_at_all(offs, [5] * 4)
+            req.wait()
+            for i in range(4):
+                np.testing.assert_array_equal(
+                    np.asarray(req.value[i]), blocks[i])
+            f.close()
+            world.barrier()
+
+            # holey view: 2-of-4 int32 vector tiles; member slots
+            # interleave across processes
+            path2 = path + ".holey"
+            f2 = File(world, path2)
+            ft = dt.create_vector(2, 2, 4, dt.INT32)
+            f2.set_view(0, np.int32, filetype=ft)
+            offs2 = [(off + i) * 4 for i in range(4)]
+            blocks2 = [1000 * (off + i) + np.arange(4, dtype=np.int32)
+                       for i in range(4)]
+            f2.write_at_all(offs2, blocks2)
+            got2 = f2.read_at_all(offs2, [4] * 4)
+            for i in range(4):
+                np.testing.assert_array_equal(got2[i], blocks2[i])
+            f2.close()
+            world.barrier()
+            print(f"IO-OK {off}")
+            mpi.finalize()
+        """ % str(tmp_path / "out.bin"))
+        assert "IO-OK 0" in out and "IO-OK 4" in out
+        got = np_.fromfile(str(tmp_path / "out.bin"), dtype=np_.int32)
+        np_.testing.assert_array_equal(got, refdata)
+
+    def test_nonblocking_hier_collectives_overlap(self, tmp_path, capfd):
+        """iallreduce on a spanning comm returns BEFORE the collective
+        completes (round 4: the 'nonblocking' wrapper ran the OOB
+        exchange to completion first). Proof of overlap: process 1
+        delays its matching allreduce by 0.5s; process 0 posts
+        iallreduce, executes user compute, and observes the request
+        still incomplete — then wait() delivers the parity result.
+        Posting order across two outstanding collectives is preserved."""
+        out = _run(tmp_path, capfd, """
+            import time
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            x = np.stack([np.arange(4, dtype=np.int32) * (off + i + 1)
+                          for i in range(4)])
+            want = sum(np.arange(4, dtype=np.int32) * (r + 1)
+                       for r in range(n))
+            if off == 0:
+                t0 = time.monotonic()
+                req = world.iallreduce(x)
+                post_t = time.monotonic() - t0
+                assert post_t < 0.25, f"posting blocked {post_t:.2f}s"
+                # user compute between post and wait
+                acc = 0
+                for i in range(1000):
+                    acc += i * i
+                done, _ = req.test()
+                assert not done, "completed before the peer even posted"
+                req2 = world.ibcast(x, root=0)  # second outstanding op
+                st = req.wait()
+                np.testing.assert_array_equal(np.asarray(req.value)[0],
+                                              want)
+                req2.wait()
+                print("OVERLAP-OK", acc > 0)
+            else:
+                time.sleep(0.5)
+                got = np.asarray(world.allreduce(x))
+                np.testing.assert_array_equal(got[0], want)
+                world.bcast(x, root=0)
+            world.barrier()
+            print(f"NBC-OK {off}")
+            mpi.finalize()
+        """)
+        assert "OVERLAP-OK True" in out
+        assert "NBC-OK 0" in out and "NBC-OK 4" in out
+
     def test_unified_world_opt_out(self, tmp_path, capfd):
         """--mca runtime_unified_world false restores per-process
         local worlds (the pre-unification behavior)."""
