@@ -15,6 +15,7 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -25,6 +26,7 @@
 #include <deque>
 #include <map>
 #include <mutex>
+#include <random>
 #include <set>
 #include <string>
 #include <thread>
@@ -36,6 +38,76 @@ constexpr uint32_t kMagic = 0x4f4d5054;  // "OMPT"
 // Hop budget: a mis-set routing table (two default routes pointing at
 // each other) would otherwise relay a frame in a cycle forever.
 constexpr int32_t kMaxTtl = 32;
+
+// Control-plane authentication (the opal/mca/sec credential framework
+// analogue, sec.h:79-91 `authenticate`): when a per-job secret is set,
+// every INBOUND connection must answer a fresh-nonce challenge with
+// SipHash-2-4(secret, nonce) before any frame it sends is accepted —
+// without this, any local user could inject TAG_DIE/TAG_MIGRATE frames
+// into a running job's control plane.
+constexpr int32_t kTagChallenge = -998;
+constexpr int32_t kTagAuth = -997;
+constexpr int kNonceLen = 16;
+
+inline uint64_t rotl64(uint64_t x, int b) {
+  return (x << b) | (x >> (64 - b));
+}
+
+// SipHash-2-4 (Aumasson & Bernstein; public-domain reference
+// algorithm): a keyed PRF designed for exactly this short-input
+// authentication job — no crypto library dependency needed.
+uint64_t siphash24(const uint8_t key[16], const uint8_t* in,
+                   size_t inlen) {
+  uint64_t k0, k1;
+  std::memcpy(&k0, key, 8);
+  std::memcpy(&k1, key + 8, 8);
+  uint64_t v0 = 0x736f6d6570736575ULL ^ k0;
+  uint64_t v1 = 0x646f72616e646f6dULL ^ k1;
+  uint64_t v2 = 0x6c7967656e657261ULL ^ k0;
+  uint64_t v3 = 0x7465646279746573ULL ^ k1;
+  auto sipround = [&] {
+    v0 += v1; v1 = rotl64(v1, 13); v1 ^= v0; v0 = rotl64(v0, 32);
+    v2 += v3; v3 = rotl64(v3, 16); v3 ^= v2;
+    v0 += v3; v3 = rotl64(v3, 21); v3 ^= v0;
+    v2 += v1; v1 = rotl64(v1, 17); v1 ^= v2; v2 = rotl64(v2, 32);
+  };
+  const uint8_t* end = in + (inlen & ~size_t{7});
+  for (; in != end; in += 8) {
+    uint64_t m;
+    std::memcpy(&m, in, 8);
+    v3 ^= m;
+    sipround();
+    sipround();
+    v0 ^= m;
+  }
+  uint64_t b = static_cast<uint64_t>(inlen) << 56;
+  for (size_t i = 0; i < (inlen & 7); ++i)
+    b |= static_cast<uint64_t>(in[i]) << (8 * i);
+  v3 ^= b;
+  sipround();
+  sipround();
+  v0 ^= b;
+  v2 ^= 0xff;
+  sipround();
+  sipround();
+  sipround();
+  sipround();
+  return v0 ^ v1 ^ v2 ^ v3;
+}
+
+bool read_full_timeout(int fd, void* buf, size_t n, int timeout_ms) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    pollfd pfd{fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, timeout_ms);
+    if (pr <= 0) return false;
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
 
 struct Frame {
   int32_t src;
@@ -81,6 +153,9 @@ struct Endpoint {
   int listen_fd = -1;
   int port = 0;
   std::atomic<bool> stopping{false};
+  bool has_secret = false;
+  uint8_t secret[16] = {0};
+  std::atomic<int> auth_rejected{0};  // refused inbound connections
 
   std::mutex mu;                     // guards peers/routes/queue
   std::mutex wmu;                    // serializes frame writes
@@ -176,8 +251,38 @@ struct Endpoint {
     for (auto& f : retry) deliver_or_forward(std::move(f), false);
   }
 
-  void reader_loop(int fd) {
-    for (;;) {
+  // Pre-auth gate for an inbound connection: the FIRST frame must be
+  // the 8-byte SipHash of the challenge nonce. Header and MAC are
+  // read with a deadline and a hard length bound — an attacker must
+  // not be able to park a reader thread forever or make it allocate
+  // an arbitrary h.len before proving knowledge of the secret.
+  bool authenticate_inbound(int fd, const std::vector<uint8_t>& nonce) {
+    Header h;
+    if (!read_full_timeout(fd, &h, sizeof h, 10'000) ||
+        h.magic != kMagic || h.tag != kTagAuth || h.len != 8) {
+      auth_rejected.fetch_add(1);
+      return false;
+    }
+    uint64_t got;
+    if (!read_full_timeout(fd, &got, 8, 10'000)) {
+      auth_rejected.fetch_add(1);
+      return false;
+    }
+    uint64_t want = siphash24(secret, nonce.data(), nonce.size());
+    if (got != want) {
+      auth_rejected.fetch_add(1);
+      return false;
+    }
+    return true;
+  }
+
+  // nonce non-empty = inbound connection that must authenticate
+  // before any frame it sends is processed — a well-formed
+  // announce/data frame from an unauthenticated peer is refused,
+  // never queued.
+  void reader_loop(int fd, std::vector<uint8_t> nonce = {}) {
+    bool authed = nonce.empty() || authenticate_inbound(fd, nonce);
+    while (authed) {
       Header h;
       if (!read_full(fd, &h, sizeof h) || h.magic != kMagic) break;
       Frame f;
@@ -215,11 +320,29 @@ struct Endpoint {
   }
 
   void accept_loop() {
+    std::random_device rd;
     for (;;) {
       int fd = ::accept(listen_fd, nullptr, nullptr);
       if (fd < 0) return;  // listener closed
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      std::vector<uint8_t> nonce;
+      if (has_secret) {
+        // fresh per-connection nonce: replaying a captured response
+        // cannot authenticate a new connection
+        nonce.resize(kNonceLen);
+        for (int i = 0; i < kNonceLen; i += 4) {
+          uint32_t r = rd();
+          std::memcpy(nonce.data() + i, &r, 4);
+        }
+        Header ch{kMagic, id, -1, kTagChallenge, kMaxTtl,
+                  static_cast<uint32_t>(nonce.size())};
+        if (!write_full(fd, &ch, sizeof ch) ||
+            !write_full(fd, nonce.data(), nonce.size())) {
+          ::close(fd);
+          continue;
+        }
+      }
       std::lock_guard<std::mutex> l(mu);
       if (stopping) {
         // stop() already swept open_fds; registering now would leave
@@ -228,7 +351,8 @@ struct Endpoint {
         return;
       }
       open_fds.insert(fd);
-      threads.emplace_back([this, fd] { reader_loop(fd); });
+      threads.emplace_back(
+          [this, fd, nonce] { reader_loop(fd, nonce); });
     }
   }
 };
@@ -237,13 +361,28 @@ struct Endpoint {
 
 extern "C" {
 
+namespace {
+void fold_secret(Endpoint* ep, const uint8_t* key, int32_t len) {
+  std::memset(ep->secret, 0, sizeof ep->secret);
+  for (int32_t i = 0; i < len; ++i)
+    ep->secret[i % 16] ^= key[i];
+  ep->has_secret = len > 0;
+}
+}  // namespace
+
 // Create an endpoint listening on bind_addr:port (0 = ephemeral).
 // bind_addr "0.0.0.0" listens on every interface — required for the
 // multi-host PLM (plm_rsh analogue) where tree peers connect across
 // machines; the default remains loopback for single-host jobs.
-void* oob_create_bound(int32_t id, int port, const char* bind_addr) {
+// The secret (optional; len 0 = auth disabled) is installed BEFORE
+// the listener starts accepting: installing it afterwards would leave
+// a window in which connections are accepted — and trusted forever —
+// without a challenge.
+void* oob_create_auth(int32_t id, int port, const char* bind_addr,
+                      const uint8_t* key, int32_t keylen) {
   auto* ep = new Endpoint();
   ep->id = id;
+  if (key != nullptr && keylen > 0) fold_secret(ep, key, keylen);
   ep->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   setsockopt(ep->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
@@ -273,6 +412,10 @@ void* oob_create_bound(int32_t id, int port, const char* bind_addr) {
   return ep;
 }
 
+void* oob_create_bound(int32_t id, int port, const char* bind_addr) {
+  return oob_create_auth(id, port, bind_addr, nullptr, 0);
+}
+
 // Back-compat loopback-only entry point.
 void* oob_create(int32_t id, int port) {
   return oob_create_bound(id, port, "127.0.0.1");
@@ -280,7 +423,13 @@ void* oob_create(int32_t id, int port) {
 
 int oob_port(void* h) { return static_cast<Endpoint*>(h)->port; }
 
-// Outbound connection to a peer's listener; announces our id.
+// Inbound connections refused by the challenge (observability/tests).
+int oob_auth_rejected(void* h) {
+  return static_cast<Endpoint*>(h)->auth_rejected.load();
+}
+
+// Outbound connection to a peer's listener; answers the listener's
+// auth challenge when a secret is installed, then announces our id.
 int oob_connect(void* h, int32_t peer_id, const char* host, int port) {
   auto* ep = static_cast<Endpoint*>(h);
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -294,6 +443,30 @@ int oob_connect(void* h, int32_t peer_id, const char* host, int port) {
   }
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  if (ep->has_secret) {
+    // the listener speaks first: challenge nonce, bounded wait (a
+    // secretless listener never sends one — mismatched configs fail
+    // here loudly instead of hanging)
+    Header ch;
+    if (!read_full_timeout(fd, &ch, sizeof ch, 10'000) ||
+        ch.magic != kMagic || ch.tag != kTagChallenge ||
+        ch.len != kNonceLen) {
+      ::close(fd);
+      return -1;
+    }
+    uint8_t nonce[kNonceLen];
+    if (!read_full_timeout(fd, nonce, kNonceLen, 10'000)) {
+      ::close(fd);
+      return -1;
+    }
+    uint64_t mac = siphash24(ep->secret, nonce, kNonceLen);
+    Header auth{kMagic, ep->id, peer_id, kTagAuth, kMaxTtl, 8};
+    if (!write_full(fd, &auth, sizeof auth) ||
+        !write_full(fd, &mac, 8)) {
+      ::close(fd);
+      return -1;
+    }
+  }
   Header hello{kMagic, ep->id, peer_id, -999, kMaxTtl, 0};
   if (!write_full(fd, &hello, sizeof hello)) {
     ::close(fd);

@@ -149,9 +149,7 @@ class Communicator:
 
         # nonblocking-progress worker for spanning comms (created on
         # first i-collective; one worker => posting order preserved)
-        import threading as _threading
-
-        self._nbc_guard = _threading.Lock()
+        self._nbc_guard = threading.Lock()
         self._nbc_exec = None
 
         _comm_registry[self.cid] = self

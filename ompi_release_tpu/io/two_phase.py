@@ -213,21 +213,41 @@ def read_at_all(file, offsets, counts) -> List[np.ndarray]:
                     want[p].append((pos, s, e - s))
         return want
 
-    dlo, dhi = doms[topo.my_pidx]
-    domain = (np.asarray(file.read_at(dlo, dhi - dlo))
-              if dhi > dlo else np.empty(0, etype))
-    if domain.size < dhi - dlo:  # EOF inside the domain: pad loudly?
-        raise MPIError(
-            ErrorCode.ERR_FILE,
-            f"read_at_all: file ends inside domain [{dlo}, {dhi}) "
-            f"({domain.size} of {dhi - dlo} elements)",
-        )
+    # read ONLY the wanted extents of my domain (merged where they
+    # overlap/touch): a sparse request pattern must not amplify into
+    # reading the whole contiguous domain span
+    import bisect
+
+    spans = sorted(
+        (s, ln) for p in topo.procs
+        for _, s, ln in wanted(p)[topo.my_pidx]
+    )
+    runs: List[list] = []
+    for s, ln in spans:
+        if runs and s <= runs[-1][0] + runs[-1][1]:
+            runs[-1][1] = max(runs[-1][1], s + ln - runs[-1][0])
+        else:
+            runs.append([s, ln])
+    run_data: Dict[int, np.ndarray] = {}
+    for s, ln in runs:
+        arr = np.asarray(file.read_at(s, ln))
+        if arr.size < ln:
+            raise MPIError(
+                ErrorCode.ERR_FILE,
+                f"read_at_all: file ends inside requested extent "
+                f"[{s}, {s + ln}) ({arr.size} of {ln} elements)",
+            )
+        run_data[s] = arr
+    run_starts = [s for s, _ in runs]
+
+    def piece(s: int, ln: int) -> np.ndarray:
+        rs = run_starts[bisect.bisect_right(run_starts, s) - 1]
+        return run_data[rs][s - rs:s - rs + ln]
 
     # serve every peer's pieces from my domain (deterministic order),
     # then collect my members' pieces from each aggregator
     for p in topo.peers:
-        pieces = [domain[s - dlo:s - dlo + ln]
-                  for _, s, ln in wanted(p)[topo.my_pidx]]
+        pieces = [piece(s, ln) for _, s, ln in wanted(p)[topo.my_pidx]]
         topo.router.coll_send(
             comm, p,
             np.concatenate(pieces) if pieces else np.empty(0, etype),
@@ -236,7 +256,7 @@ def read_at_all(file, offsets, counts) -> List[np.ndarray]:
     out = [np.empty(c, etype) for c in counts]
     for pos, s, ln in my_want[topo.my_pidx]:  # my own domain's pieces
         o = int(table[topo.local_ranks[pos], 0])
-        out[pos][s - o:s - o + ln] = domain[s - dlo:s - dlo + ln]
+        out[pos][s - o:s - o + ln] = piece(s, ln)
     for p in topo.peers:
         d = np.asarray(topo.router.coll_recv(comm, p)).astype(
             etype, copy=False)

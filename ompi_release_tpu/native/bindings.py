@@ -101,6 +101,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.oob_pending.restype = ctypes.c_int
     lib.oob_ttl_dropped.argtypes = [P]
     lib.oob_ttl_dropped.restype = ctypes.c_int
+    lib.oob_create_auth.argtypes = [ctypes.c_int32, ctypes.c_int,
+                                    ctypes.c_char_p, u8p,
+                                    ctypes.c_int32]
+    lib.oob_create_auth.restype = P
+    lib.oob_auth_rejected.argtypes = [P]
+    lib.oob_auth_rejected.restype = ctypes.c_int
     lib.oob_next_len.argtypes = [P, ctypes.c_int32, ctypes.c_int]
     lib.oob_next_len.restype = ctypes.c_int
     lib.oob_destroy.argtypes = [P]
@@ -216,19 +222,47 @@ class DssBuffer:
         self._lib.dss_rewind(self._h)
 
 
+#: env var carrying the per-job control-plane secret (minted by tpurun,
+#: inherited by every worker it launches) — see SECRET_ENV consumers in
+#: tools/tpurun.py and tools/tpu_server.py
+SECRET_ENV = "OMPITPU_JOB_SECRET"
+
+
 class OobEndpoint:
     """Tagged TCP messaging endpoint with tree routing (oob/rml/routed
-    analogue)."""
+    analogue).
+
+    Authentication (``opal/mca/sec`` analogue): when ``secret`` is
+    given — or ``OMPITPU_JOB_SECRET`` is set, which tpurun exports to
+    every worker — inbound connections must answer a fresh-nonce
+    SipHash challenge before any of their frames are accepted, and
+    outbound connects answer the peer's challenge. ``secret=b""``
+    explicitly disables auth regardless of the environment."""
 
     def __init__(self, node_id: int, port: int = 0,
-                 bind_addr: str = "127.0.0.1") -> None:
+                 bind_addr: str = "127.0.0.1",
+                 secret: Optional[bytes] = None) -> None:
+        import os as _os
+
         self._lib = load_library()
-        self._h = self._lib.oob_create_bound(node_id, port,
-                                             bind_addr.encode())
+        if secret is None:
+            env = _os.environ.get(SECRET_ENV, "")
+            secret = env.encode() if env else b""
+        # the secret rides the CREATE call: installed before the
+        # listener accepts its first connection, so there is no window
+        # in which an unauthenticated connection can be admitted
+        self._h = self._lib.oob_create_auth(
+            node_id, port, bind_addr.encode(),
+            _u8(secret) if secret else None, len(secret),
+        )
         if not self._h:
             raise MPIError(ErrorCode.ERR_OTHER,
                            f"oob_create failed ({bind_addr}:{port})")
         self.node_id = node_id
+
+    def auth_rejected(self) -> int:
+        """Inbound connections refused by the auth challenge."""
+        return self._lib.oob_auth_rejected(self._handle())
 
     def _handle(self):
         """The live native handle; a closed endpoint raises a clean

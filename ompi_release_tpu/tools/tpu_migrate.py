@@ -34,10 +34,12 @@ from ..utils.errors import MPIError
 
 
 def request_migration(host: str, port: int, off: str,
-                      timeout_ms: int = 30_000) -> Dict:
+                      timeout_ms: int = 30_000,
+                      secret: Optional[str] = None) -> Dict:
     """One-shot TAG_MIGRATE round trip (high random client id — same
     collision discipline as the ps/name-server clients)."""
-    ep = OobEndpoint(random.randrange(1 << 20, 1 << 30))
+    ep = OobEndpoint(random.randrange(1 << 20, 1 << 30),
+                     secret=secret.encode() if secret else None)
     try:
         ep.connect(0, host, int(port))
         ep.send(0, TAG_MIGRATE, json.dumps({"off": off}).encode())
@@ -62,6 +64,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "discovery)")
     args = ap.parse_args(argv)
 
+    secret = None
     if args.hnp:
         host, port = args.hnp.rsplit(":", 1)
         port = int(port)
@@ -82,9 +85,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                       f"n={j['n']}", file=sys.stderr)
             return 1
         host, port = jobs[0]["host"], int(jobs[0]["port"])
+        secret = jobs[0].get("secret")
 
     try:
-        reply = request_migration(host, port, args.off)
+        reply = request_migration(host, port, args.off, secret=secret)
     except (MPIError, OSError) as e:
         print(f"migration request failed: {e}", file=sys.stderr)
         return 1

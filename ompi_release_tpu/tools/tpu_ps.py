@@ -38,8 +38,12 @@ class PsClient:
     id, like the cross-job NameClient — ps clients from any job must
     not collide with worker ids)."""
 
-    def __init__(self, host: str, port: int) -> None:
-        self.ep = OobEndpoint(random.randrange(1 << 20, 1 << 30))
+    def __init__(self, host: str, port: int,
+                 secret: Optional[str] = None) -> None:
+        self.ep = OobEndpoint(
+            random.randrange(1 << 20, 1 << 30),
+            secret=secret.encode() if secret else None,
+        )
         self.ep.connect(0, host, int(port))
 
     def query(self, timeout_ms: int = 5_000) -> Dict:
@@ -132,7 +136,8 @@ def snapshot_all(hnp: Optional[str] = None) -> List[str]:
         client = None
         snap = None
         try:
-            client = PsClient(info["host"], info["port"])
+            client = PsClient(info["host"], info["port"],
+                              secret=info.get("secret"))
             snap = client.query()
         except (MPIError, OSError):
             snap = None

@@ -370,6 +370,17 @@ class Job:
         # restart) must each see the other's placement or two ranks
         # can double-book one free slot
         self._map_lock = threading.Lock()
+        # per-job control-plane secret (opal/mca/sec analogue): the
+        # HNP endpoint picks it up from the environment, every worker
+        # inherits it (fork env / the rsh env assignments), and the
+        # OOB refuses unauthenticated inbound connections — a foreign
+        # local process can no longer inject TAG_DIE/TAG_MIGRATE
+        import secrets as _secrets
+
+        from ..native.bindings import SECRET_ENV
+
+        self.secret = os.environ.get(SECRET_ENV) or _secrets.token_hex(16)
+        os.environ[SECRET_ENV] = self.secret
 
     # -- launch ------------------------------------------------------------
     def _env_for(self, node_id: int) -> Dict[str, str]:
@@ -383,6 +394,7 @@ class Job:
         reference builds them into the orted command line,
         plm_rsh_module.c:872)."""
         env = {
+            "OMPITPU_JOB_SECRET": self.secret,
             "OMPITPU_HNP": f"{self.hnp_host}:{self.hnp.port}",
             "OMPITPU_NODE_ID": str(node_id),
             "OMPITPU_NUM_NODES": str(self.n),
@@ -698,11 +710,16 @@ class Job:
         import json
 
         try:
-            os.makedirs(SESSION_DIR, exist_ok=True)
+            os.makedirs(SESSION_DIR, mode=0o700, exist_ok=True)
             self._contact_path = os.path.join(
                 SESSION_DIR, f"{os.getpid()}.json"
             )
-            with open(self._contact_path, "w") as f:
+            # the contact file carries the job secret so same-user
+            # tools (tpu-ps/tpu-top/tpu-migrate) can authenticate —
+            # 0600, like the reference's session-dir contact files
+            fd = os.open(self._contact_path,
+                         os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
                 json.dump({
                     "pid": os.getpid(),
                     "host": self.hnp_host,
@@ -710,6 +727,7 @@ class Job:
                     "n": self.n,
                     "argv": self.argv,
                     "started": time.time(),
+                    "secret": self.secret,
                 }, f)
         except OSError as e:
             _log.verbose(1, f"could not write contact file: {e}")

