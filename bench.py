@@ -287,6 +287,117 @@ def _single_chip_specs(jax, jnp, dev, on_tpu):
     return specs, ("bcast_f32", "ceiling_copy_alt", "ceiling_copy_alt2")
 
 
+#: bf16 matmul peak by device kind substring (published chip specs);
+#: unknown kinds report achieved FLOP/s with mfu null rather than a
+#: made-up ratio
+PEAK_FLOPS = (
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v4", 275e12), ("v6", 918e12),
+)
+
+
+def _mfu_metric(jax, jnp, dev, on_tpu, rounds):
+    """Compute-bound line: the flagship transformer's fwd+bwd step on
+    one chip (tiny-but-MXU-shaped dims), slope-timed like every other
+    loop, FLOPs taken from XLA's own cost analysis. Every other bench
+    config is memory-bound, so without this a regression in the
+    compute path (e.g. ops/pallas_attention.py) would be invisible to
+    the round record."""
+    from jax import lax
+
+    from ompi_release_tpu.models import transformer as tfm
+    from ompi_release_tpu.parallel.mesh_axes import build_parallel_mesh
+
+    if on_tpu:
+        cfg = tfm.ModelConfig(
+            vocab=2048, d_model=512, n_layers=4, n_heads=8, head_dim=64,
+            d_ff=2048, max_seq=256, dtype=jnp.bfloat16,
+        )
+        b, s = 8, 256
+    else:  # CI-sized
+        cfg = tfm.ModelConfig(
+            vocab=128, d_model=64, n_layers=2, n_heads=4, head_dim=16,
+            d_ff=128, max_seq=32, dtype=jnp.float32,
+        )
+        b, s = 2, 32
+    mesh = build_parallel_mesh(devices=[dev])
+    params = tfm.shard_params(
+        tfm.init_params(jax.random.PRNGKey(0), cfg), cfg, mesh
+    )
+    fwd = tfm.make_forward(cfg, mesh)
+    rng = np.random.RandomState(0)
+    tok = jax.device_put(
+        jnp.asarray(rng.randint(0, cfg.vocab, size=(b, s), dtype=np.int32)),
+        dev,
+    )
+    tgt = jnp.roll(tok, -1, axis=1)
+    grad_fn = jax.value_and_grad(lambda p: fwd(p, tok, tgt))
+
+    def loop(params, k):
+        def body(_, p):
+            _, g = grad_fn(p)
+            # inline SGD keeps every iteration's bwd live (no folding)
+            return jax.tree.map(
+                lambda a, d: a - jnp.asarray(1e-6, a.dtype)
+                * d.astype(a.dtype), p, g)
+        p = lax.fori_loop(0, k, body, params)
+        return jnp.sum(jax.tree.leaves(p)[0].astype(jnp.float32))
+
+    loop = jax.jit(loop)
+
+    # FLOPs per fwd+bwd step from the compiler, not a hand formula
+    flops_per_step = None
+    try:
+        ca = jax.jit(grad_fn).lower(params).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops_per_step = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    k_lo, k_hi = _calibrate_k(loop, (params,), 258) if on_tpu else (2, 10)
+    # warm both K programs, then slope-time like the bandwidth lines
+    _sync(loop(params, k_lo))
+    _sync(loop(params, k_hi))
+    slopes, lo_t, hi_t = [], [], []
+    for _ in range(rounds):
+        tlo = _timed(loop, (params,), k_lo)
+        thi = _timed(loop, (params,), k_hi)
+        lo_t.append(tlo)
+        hi_t.append(thi)
+        slopes.append(max((thi - tlo) / (k_hi - k_lo), 1e-12))
+    sec_per_step = float(np.median(slopes))
+
+    entry = {
+        "metric": "transformer_fwdbwd_step", "unit": "TFLOP/s",
+        "sec_per_step": round(sec_per_step, 6),
+        "vs_baseline": None,
+    }
+    # same jitter gate as _run_rounds: a K-delta inside the tunnel's
+    # latency band is noise — flag it rather than report a confident
+    # garbage MFU
+    if on_tpu and (np.median(hi_t) - np.median(lo_t)) < 0.05:
+        entry.update(value=None, mfu=None, unstable=True,
+                     note="K-delta inside tunnel jitter; unreliable")
+        return entry
+    if flops_per_step is None:
+        entry["value"] = None
+        entry["note"] = "XLA cost analysis unavailable on this backend"
+        return entry
+    achieved = flops_per_step / sec_per_step
+    entry["value"] = round(achieved / 1e12, 3)
+    entry["flops_per_step"] = flops_per_step
+    kind = dev.device_kind.lower()
+    peak = next((p for sub, p in PEAK_FLOPS if sub in kind), None)
+    if peak is not None and on_tpu:
+        entry["mfu"] = round(achieved / peak, 4)
+        entry["peak_tflops"] = peak / 1e12
+        entry["device_kind"] = dev.device_kind
+    else:
+        entry["mfu"] = None
+    return entry
+
+
 def _mesh_specs(jax, jnp, devices, on_tpu):
     """The 5 configs as real SPMD collectives over the device mesh,
     using the framework's coll/spmd kernels.
@@ -687,6 +798,19 @@ def main():
         }
         if dropped_rounds:
             headline["ceiling_rounds_dropped"] = dropped_rounds
+
+    # compute-bound line (single-chip fwd+bwd MFU): measured after the
+    # bandwidth sweep so its compile time cannot contaminate those
+    # loops' interleaved rounds
+    try:
+        lines.append(_mfu_metric(jax, jnp, devices[0], on_tpu,
+                                 rounds=max(3, rounds)))
+    except Exception as e:
+        lines.append({
+            "metric": "transformer_fwdbwd_step", "value": None,
+            "unit": "TFLOP/s", "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}"[:200],
+        })
 
     for ln in lines:
         print(json.dumps(ln))

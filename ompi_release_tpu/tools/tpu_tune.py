@@ -1,0 +1,221 @@
+"""tpu-tune — measure collective algorithms and emit a dynamic rule
+file.
+
+The reference ships tuned's decision constants baked in and leaves the
+operator to hand-write a dynamic rules file
+(``ompi/mca/coll/tuned/coll_tuned_dynamic_file.c`` reads it; nothing
+generates it). This tool closes that loop: it times EVERY legal
+algorithm of each tunable collective at each sweep size on the actual
+device mesh, picks the winner, and writes a
+``coll/dynamic_rules.py``-format file whose comments carry the
+measurements that justify each rule — load it with::
+
+    --mca coll_tuned_use_dynamic_rules 1 \\
+    --mca coll_tuned_dynamic_rules_filename FILE
+
+Sizes in the emitted rules are each collective's own decision unit
+(per-rank bytes, total bytes for allgather, per-destination block for
+alltoall/scatter — the same units ``dynamic_rules.lookup`` is queried
+with; see that module's table).
+
+Usage::
+
+    python -m ompi_release_tpu.tools.tpu_tune -o rules.conf \\
+        [--sizes 1024,65536,1048576] [--repeats 5] [--ops allreduce,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..mca import var as mca_var
+from ..utils import output
+
+_log = output.stream("tune")
+
+#: op -> (runner(comm, x), decision-unit bytes for per-rank bytes b
+#: and comm size n)
+_OPS: Dict[str, Tuple] = {
+    "allreduce": (lambda c, x: c.allreduce(x), lambda b, n: b),
+    "bcast": (lambda c, x: c.bcast(x, root=0), lambda b, n: b),
+    "reduce": (lambda c, x: c.reduce(x, root=0), lambda b, n: b),
+    "allgather": (lambda c, x: c.allgather(x), lambda b, n: b * n),
+    "alltoall": (lambda c, x: c.alltoall(x), lambda b, n: b // n),
+    "gather": (lambda c, x: c.gather(x, root=0), lambda b, n: b),
+    "scatter": (lambda c, x: c.scatter(x, root=0), lambda b, n: b // n),
+}
+
+
+def _algorithms(op: str) -> List[str]:
+    from ..coll import dynamic_rules
+
+    return [a for a in dynamic_rules.RULE_COLLECTIVES[op]
+            if a != "auto"]
+
+
+def _time_once(fn, comm, x) -> float:
+    import jax
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(comm, x))
+    return time.perf_counter() - t0
+
+
+def measure(comm, ops: Sequence[str], sizes: Sequence[int],
+            repeats: int = 5) -> Dict[str, List[Dict]]:
+    """{op: [{size, unit_bytes, times: {alg: s}, winner}]} — per-rank
+    buffer sizes in bytes; min-of-repeats timing (dispatch latency
+    spikes are one-sided)."""
+    n = comm.size
+    results: Dict[str, List[Dict]] = {}
+    for op in ops:
+        runner, unit_fn = _OPS[op]
+        var = f"coll_tuned_{op}_algorithm"
+        rows = []
+        for size in sizes:
+            elems = max(n, size // 4)
+            elems = -(-elems // n) * n  # alltoall/scatter need % n == 0
+            x = np.ones((n, elems), np.float32)
+            times: Dict[str, float] = {}
+            for alg in _algorithms(op):
+                mca_var.set_value(var, alg)
+                try:
+                    _time_once(runner, comm, x)  # compile + warm
+                    times[alg] = min(
+                        _time_once(runner, comm, x)
+                        for _ in range(repeats)
+                    )
+                except Exception as e:
+                    # an algorithm an op/shape cannot run (e.g. ring
+                    # without identity) is skipped, not fatal
+                    _log.verbose(2, f"{op}/{alg}@{size}: {e}")
+                finally:
+                    mca_var.set_value(var, "auto")
+            if not times:
+                continue
+            winner = min(times, key=times.get)
+            rows.append({
+                "size": size, "unit_bytes": unit_fn(elems * 4, n),
+                "times": times, "winner": winner,
+            })
+        results[op] = rows
+    return results
+
+
+def _fixed_choice(comm, op: str, size: int) -> Optional[str]:
+    """What the baked-in decision constants would pick (for the
+    emitted differs-from-fixed annotations)."""
+    from .. import ops as ops_mod
+    from ..coll import components as coll_components
+
+    n = comm.size
+    elems = max(n, size // 4)
+    elems = -(-elems // n) * n
+    x = np.ones((n, elems), np.float32)
+    mod = coll_components._TunedModule(comm)
+    # the pickers consult dynamic rules BEFORE the fixed constants —
+    # when re-tuning an already-tuned deployment the annotation must
+    # still compare against the constants, not the old rule file
+    prev = mca_var.get("coll_tuned_use_dynamic_rules", False)
+    mca_var.set_value("coll_tuned_use_dynamic_rules", False)
+    try:
+        if op == "allreduce":
+            return mod._pick_allreduce(x, ops_mod.SUM)
+        if op == "bcast":
+            return mod._pick_bcast(x)[0]
+        if op == "reduce":
+            return mod._pick_reduce(x, ops_mod.SUM)
+        if op == "allgather":
+            return mod._pick_allgather(x)
+        if op == "alltoall":
+            return mod._pick_alltoall(x)
+    except Exception:
+        pass
+    finally:
+        mca_var.set_value("coll_tuned_use_dynamic_rules", prev)
+    return None
+
+
+def emit(comm, results: Dict[str, List[Dict]]) -> str:
+    """Render measurements as a dynamic rule file: ascending
+    min_msg_bytes lines per op (LAST match wins, so each line is the
+    threshold where the winner changes), every rule justified by its
+    measurements in a comment."""
+    import jax
+
+    dev = jax.devices()[0]
+    lines = [
+        "# generated by tpu-tune — measured algorithm selection",
+        f"# mesh: {len(jax.devices())} x {dev.device_kind} "
+        f"({jax.default_backend()}), comm size {comm.size}",
+        "# load with: --mca coll_tuned_use_dynamic_rules 1 "
+        "--mca coll_tuned_dynamic_rules_filename <this file>",
+        "#",
+        "# collective  min_comm_size  min_msg_bytes  algorithm",
+    ]
+    for op, rows in results.items():
+        if not rows:
+            continue
+        lines.append("")
+        prev = None
+        for i, row in enumerate(rows):
+            t = ", ".join(f"{a}={s * 1e6:.0f}us"
+                          for a, s in sorted(row["times"].items(),
+                                             key=lambda kv: kv[1]))
+            fixed = _fixed_choice(comm, op, row["size"])
+            note = (f"  [differs from fixed constants: {fixed}]"
+                    if fixed is not None
+                    and fixed != row["winner"] else "")
+            lines.append(f"# {op} @ {row['size']}B/rank: {t}{note}")
+            if row["winner"] != prev:
+                thresh = 0 if i == 0 else row["unit_bytes"]
+                lines.append(
+                    f"{op}  0  {thresh}  {row['winner']}"
+                )
+                prev = row["winner"]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-tune",
+        description="Measure collective algorithms on this mesh and "
+                    "emit a dynamic rules file",
+    )
+    ap.add_argument("-o", "--output", required=True)
+    ap.add_argument("--sizes", default="1024,65536,1048576,16777216",
+                    help="comma-separated per-rank buffer sizes (bytes)")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--ops", default="allreduce,bcast,reduce,"
+                                     "allgather,alltoall")
+    args = ap.parse_args(argv)
+
+    import ompi_release_tpu as mpi
+
+    comm = mpi.init()
+    # ascending is load-bearing: emit() writes threshold lines in row
+    # order and dynamic_rules takes the LAST match
+    sizes = sorted(int(s) for s in args.sizes.split(",") if s)
+    ops = [o.strip() for o in args.ops.split(",") if o.strip()]
+    results = measure(comm, ops, sizes, repeats=args.repeats)
+    text = emit(comm, results)
+    with open(args.output, "w") as f:
+        f.write(text)
+    # validate what we just wrote parses (a typo'd generator must not
+    # hand the operator a file that fails at job start)
+    from ..coll import dynamic_rules
+
+    dynamic_rules.load_rules(args.output)
+    n_rules = sum(1 for ln in text.splitlines()
+                  if ln and not ln.startswith("#"))
+    print(f"tpu-tune: wrote {n_rules} rule(s) to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
