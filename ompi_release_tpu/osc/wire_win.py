@@ -66,6 +66,8 @@ _WIN_MAGIC = "WWIN"
 KIND_BATCH = 1    # arg1 = release_target comm rank (or -1)
 KIND_LOCK = 2     # arg1 = target, arg2 = lock type
 KIND_ABANDON = 3  # arg1 = target: forget this origin's lock interest
+KIND_POST = 4     # one-way: src process posted an exposure epoch
+KIND_COMPLETE = 5  # one-way: src process completed its access epoch
 KIND_ERROR = 99   # home-side failure applying a request
 
 
@@ -154,6 +156,12 @@ class WinService:
         self.windows: Dict[Tuple[int, int], "WireWindow"] = {}
         self._locks: Dict[Tuple[int, int, int], _LockState] = {}
         self._state_lock = threading.Lock()
+        # PSCW notice sets per window key: which processes have posted
+        # an exposure epoch / completed an access epoch (consumed by
+        # start()/wait() respectively)
+        self._posts: Dict[Tuple[int, int], set] = {}
+        self._completes: Dict[Tuple[int, int], set] = {}
+        self._pscw_cv = threading.Condition(self._state_lock)
         #: serializes this process's outbound request+reply pairs so a
         #: reply on the shared reply channel always belongs to the one
         #: outstanding request
@@ -181,8 +189,16 @@ class WinService:
             self.windows[(win.comm.cid, win.win_seq)] = win
 
     def unregister(self, win: "WireWindow") -> None:
+        key = (win.comm.cid, win.win_seq)
         with self._state_lock:
-            self.windows.pop((win.comm.cid, win.win_seq), None)
+            self.windows.pop(key, None)
+            # win_seq is monotone per comm, so a freed window's notice
+            # and lock entries can never be consumed again — drop them
+            # (late frames for the key are refused by _window())
+            self._posts.pop(key, None)
+            self._completes.pop(key, None)
+            for lk in [k for k in self._locks if k[:2] == key]:
+                del self._locks[lk]
 
     def _window(self, cid: int, seq: int) -> "WireWindow":
         with self._state_lock:
@@ -257,6 +273,11 @@ class WinService:
             win = self._window(int(cid), int(seq))
             self.abandon(win, int(arg1), src_pidx)
             self._reply(src_pidx, int(cid), int(seq), KIND_ABANDON, [])
+        elif kind == KIND_POST:
+            self.pscw_record(self._posts, (int(cid), int(seq)), src_pidx)
+        elif kind == KIND_COMPLETE:
+            self.pscw_record(self._completes, (int(cid), int(seq)),
+                             src_pidx)
         else:
             _log.verbose(1, f"win service: unknown kind {kind}")
 
@@ -329,6 +350,52 @@ class WinService:
                                                       owner_pidx)
                     return _unpack_reads(rdata, int(n_reads))
                 return []
+
+    # -- PSCW notices (one-way; no reply awaited) --------------------------
+    def notify(self, dst_pidx: int, win: "WireWindow", kind: int) -> None:
+        env = DssBuffer()
+        env.pack_string(_WIN_MAGIC)
+        env.pack_int64([win.comm.cid, win.win_seq, kind, 0, 0])
+        self.router._retry(
+            lambda: self.ep.send(self.router._nid(dst_pidx),
+                                 WIRE_WIN_SERVICE, env.tobytes()),
+            f"window notice (kind {kind}) to process {dst_pidx}",
+        )
+
+    def pscw_record(self, table: Dict, key: Tuple[int, int],
+                    pidx: int) -> None:
+        with self._pscw_cv:
+            table.setdefault(key, set()).add(pidx)
+            self._pscw_cv.notify_all()
+
+    def pscw_await(self, table: Dict, key: Tuple[int, int],
+                   procs, what: str) -> None:
+        """Block until every process in ``procs`` has recorded its
+        notice, then CONSUME those notices (the next epoch must wait
+        for its own). MPI requires wait() to block as long as it
+        takes (the partner may compute arbitrarily long before
+        complete()), so the default is unbounded; operators can bound
+        it with ``--mca osc_pscw_timeout_s N`` to turn a hung partner
+        into a diagnosable error."""
+        from ..mca import var as mca_var
+
+        want = set(procs)
+        if not want:  # MPI_GROUP_EMPTY epochs are legal no-ops
+            return
+        timeout_s = float(mca_var.get("osc_pscw_timeout_s", 0) or 0)
+        deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        with self._pscw_cv:
+            while not want <= table.get(key, set()):
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise MPIError(
+                            ErrorCode.ERR_RMA_SYNC,
+                            f"PSCW {what} timed out awaiting processes "
+                            f"{sorted(want - table.get(key, set()))}",
+                        )
+                self._pscw_cv.wait(timeout=1.0)
+            table[key] -= want
 
     # -- home-side lock table ----------------------------------------------
     def _lock_key(self, win: "WireWindow", target: int
@@ -626,15 +693,74 @@ class WireWindow(Window):
         self._locked.clear()
         self._epoch = _EpochKind.NONE
 
-    # -- PSCW: not yet available across processes --------------------------
-    def post(self, group) -> None:
-        raise MPIError(
-            ErrorCode.ERR_NOT_AVAILABLE,
-            "PSCW epochs are not yet supported on communicators "
-            "spanning controller processes; use fence or lock epochs",
-        )
+    # -- PSCW (generalized active target) across processes -----------------
+    # post -> a one-way notice to every accessor process; start blocks
+    # for its targets' notices; complete ships+acks the batches THEN
+    # notifies each target (service frames from one src are processed
+    # in order, so a COMPLETE can never pass its own epoch's data);
+    # wait blocks for every accessor process's COMPLETE. This is
+    # osc/rdma's PSCW state machine at process granularity (one
+    # controller acts as all its local ranks).
 
-    start = post
+    def _procs_of_group(self, group) -> List[int]:
+        return sorted({self.router.owner_of(r)
+                       for r in group.world_ranks})
+
+    def _key(self) -> Tuple[int, int]:
+        return (self.comm.cid, self.win_seq)
+
+    def post(self, group) -> None:
+        # PSCW is legal in either order (post-then-start or
+        # start-then-post on a process that is both target and
+        # origin), so an open PSCW access epoch does not forbid
+        # opening the exposure side
+        self._require(_EpochKind.NONE, _EpochKind.PSCW)
+        if self._group_exposed is not None:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           "post() with an exposure epoch already open")
+        self._group_exposed = group
+        self._epoch = _EpochKind.PSCW
+        for p in self._procs_of_group(group):
+            if p == self.my_pidx:
+                self.service.pscw_record(self.service._posts,
+                                         self._key(), self.my_pidx)
+            else:
+                self.service.notify(p, self, KIND_POST)
+
+    def start(self, group) -> None:
+        self._require(_EpochKind.NONE, _EpochKind.PSCW)
+        targets = self._procs_of_group(group)
+        self.service.pscw_await(self.service._posts, self._key(),
+                                targets, "start")
+        self._start_procs = targets
+        self._epoch = _EpochKind.PSCW
+
+    def complete(self) -> None:
+        self._require(_EpochKind.PSCW)
+        self._apply_pending()  # ships + acks every remote batch first
+        for p in getattr(self, "_start_procs", []):
+            if p == self.my_pidx:
+                self.service.pscw_record(self.service._completes,
+                                         self._key(), self.my_pidx)
+            else:
+                self.service.notify(p, self, KIND_COMPLETE)
+        self._start_procs = []
+        # keep the epoch open while the exposure side is: a fence()
+        # slipped between complete() and wait() must still raise
+        self._epoch = (_EpochKind.NONE if self._group_exposed is None
+                       else _EpochKind.PSCW)
+
+    def wait(self) -> None:
+        if self._group_exposed is None:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           "wait() without a matching post()")
+        accessors = self._procs_of_group(self._group_exposed)
+        self.service.pscw_await(self.service._completes, self._key(),
+                                accessors, "wait")
+        if self._epoch is _EpochKind.PSCW:
+            self._apply_pending()
+            self._epoch = _EpochKind.NONE
+        self._group_exposed = None
 
     def free(self) -> None:
         super().free()

@@ -520,6 +520,49 @@ class TestUnifiedWorld:
         """)
         assert "RMA-OK 0" in out and "RMA-OK 4" in out
 
+    def test_cross_process_pscw_epoch(self, tmp_path, capfd):
+        """Generalized active target across processes: process 1 posts
+        an exposure epoch for process 0's ranks; process 0
+        starts/puts/completes; process 1's wait() returns only after
+        the put is applied (osc/rdma's PSCW state machine at process
+        granularity)."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.comm.group import Group
+            from ompi_release_tpu.osc.window import win_allocate
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+
+            win = win_allocate(world, (4,), np.float32)
+            origins = Group([0, 1, 2, 3])   # process 0's ranks
+            targets = Group([4, 5, 6, 7])   # process 1's ranks
+            if off == 0:
+                win.start(targets)
+                win.put(np.full(4, 5.5, np.float32), 5)
+                req = win.get(6)
+                win.complete()
+                # exposure side of OUR window for the reverse epoch
+                win.post(targets)
+                win.wait()
+                got = np.asarray(win.read())[2]
+                np.testing.assert_array_equal(got,
+                                              np.full(4, 8.25))
+            else:
+                win.post(origins)
+                win.wait()   # returns only after proc 0's complete
+                got = np.asarray(win.read())[5 - 4]
+                np.testing.assert_array_equal(got, np.full(4, 5.5))
+                # reverse: proc 1 accesses proc 0's rank 2
+                win.start(origins)
+                win.accumulate(np.full(4, 8.25, np.float32), 2)
+                win.complete()
+            world.barrier()
+            win.free()
+            print(f"PSCW-OK {off}")
+            mpi.finalize()
+        """)
+        assert "PSCW-OK 0" in out and "PSCW-OK 4" in out
+
     def test_cross_process_lock_exclusion(self, tmp_path, capfd):
         """Two processes contending for an exclusive lock on the same
         target serialize at the target's home: read-modify-write under
