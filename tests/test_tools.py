@@ -179,7 +179,7 @@ class TestExamples:
     @pytest.mark.parametrize("name", [
         "ring_tpu.py", "connectivity_tpu.py", "allreduce_tpu.py",
         "hello_oshmem_tpu.py", "ring_oshmem_tpu.py",
-        "oshmem_reduction_tpu.py",
+        "oshmem_reduction_tpu.py", "unified_world_tpu.py",
     ])
     def test_example_runs_driver_mode(self, name):
         import os
@@ -198,6 +198,29 @@ class TestExamples:
         )
         assert r.returncode == 0, r.stderr
         assert "OK" in r.stdout or "complete" in r.stdout
+
+    def test_unified_world_example_under_tpurun(self):
+        """The cross-process acceptance example: 2 processes x 4
+        virtual devices, collectives + p2p + RMA across the boundary
+        through the public API."""
+        import os
+        import subprocess
+
+        from conftest import subprocess_env
+
+        env = subprocess_env(
+            XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                       + " --xla_force_host_platform_device_count=4"))
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_release_tpu.tools.tpurun",
+             "-n", "2", sys.executable,
+             "examples/unified_world_tpu.py"],
+            cwd="/root/repo", env=env, capture_output=True, text=True,
+            timeout=300,
+        )
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "unified world OK (ranks 0..3 of 8)" in r.stdout
+        assert "unified world OK (ranks 4..7 of 8)" in r.stdout
 
     def test_hello_under_tpurun(self):
         import subprocess
