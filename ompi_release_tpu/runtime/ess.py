@@ -133,7 +133,14 @@ class TpurunEss(mca_component.Component):
         card = {
             "node_id": node_id,
             "pid": os.getpid(),
-            "host": socket.gethostname(),  # shm-reachability identity
+            # shm-reachability identity. OMPITPU_HOST_ID overrides the
+            # UTS hostname: two containers can SHARE a hostname while
+            # having separate /dev/shm (shm handoffs between them would
+            # fail), and conversely test rigs use it to exercise the
+            # DCN staging path on one machine — the btl_tcp_if_include
+            # style of deployment knob
+            "host": os.environ.get("OMPITPU_HOST_ID")
+                    or socket.gethostname(),
             "local_device_count": jax.local_device_count(),
             "platform": jax.local_devices()[0].platform,
         }

@@ -764,6 +764,73 @@ class TestUnifiedWorld:
         assert "OVERLAP-OK True" in out
         assert "NBC-OK 0" in out and "NBC-OK 4" in out
 
+    def test_cross_process_surface_over_dcn_staging(self, tmp_path,
+                                                    capfd):
+        """OMPITPU_HOST_ID gives each worker a distinct shm identity,
+        so every cross-process byte rides the DCN chunked-staging
+        transport instead of the shm handoff — collectives, vector
+        collectives, RMA, and two-phase IO all exercised over the
+        multi-host wire path on one machine."""
+        out = _run(tmp_path, capfd, """
+            import os
+            # distinct identity per worker BEFORE bootstrap: forces
+            # the cross-host transport choice
+            os.environ["OMPITPU_HOST_ID"] = (
+                "fakehost-" + os.environ["OMPITPU_NODE_ID"])
+            from ompi_release_tpu.mca import pvar
+            from ompi_release_tpu.osc.window import win_allocate
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            # transport choice is really DCN
+            peer = 1 if rt.bootstrap["process_index"] == 0 else 0
+            assert rt.wire._btl_for(peer).NAME == "dcn", \\
+                rt.wire._btl_for(peer).NAME
+
+            x = np.stack([np.arange(16, dtype=np.int32) * (off + i + 1)
+                          for i in range(4)])
+            got = np.asarray(world.allreduce(x))
+            want = sum(np.arange(16, dtype=np.int32) * (r + 1)
+                       for r in range(n))
+            np.testing.assert_array_equal(got[0], want)
+
+            full = [np.asarray([100 * r + k for k in range(r + 1)],
+                               np.int32) for r in range(n)]
+            ag = np.asarray(world.allgatherv(full[off:off + 4]))
+            np.testing.assert_array_equal(ag, np.concatenate(full))
+
+            win = win_allocate(world, (4,), np.float32)
+            win.fence()
+            if off == 0:
+                win.put(np.full(4, 2.5, np.float32), 6)
+            win.fence_end()
+            if off == 4:
+                np.testing.assert_array_equal(
+                    np.asarray(win.read())[6 - 4], np.full(4, 2.5))
+            world.barrier()
+            win.free()
+
+            from ompi_release_tpu.io.file import File
+            f = File(world, %r)
+            f.set_view(etype=np.int32)
+            offs = [(off + i) * 3 for i in range(4)]
+            blocks = [10 * (off + i) + np.arange(3, dtype=np.int32)
+                      for i in range(4)]
+            f.write_at_all(offs, blocks)
+            back = f.read_at_all(offs, [3] * 4)
+            for i in range(4):
+                np.testing.assert_array_equal(back[i], blocks[i])
+            f.close()
+
+            staged = pvar.PVARS.read_all().get("btl_dcn_staged_bytes", 0)
+            assert staged > 0, "no bytes rode the DCN staging path"
+            world.barrier()
+            print(f"DCN-OK {off} staged={staged > 0}")
+            mpi.finalize()
+        """ % str(tmp_path / "dcn_io.bin"))
+        assert "DCN-OK 0" in out and "DCN-OK 4" in out
+
     def test_unified_world_opt_out(self, tmp_path, capfd):
         """--mca runtime_unified_world false restores per-process
         local worlds (the pre-unification behavior)."""
