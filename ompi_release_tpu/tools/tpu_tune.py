@@ -71,6 +71,16 @@ def measure(comm, ops: Sequence[str], sizes: Sequence[int],
     """{op: [{size, unit_bytes, times: {alg: s}, winner}]} — per-rank
     buffer sizes in bytes; min-of-repeats timing (dispatch latency
     spikes are one-sided)."""
+    if getattr(comm, "spans_processes", False):
+        from ..utils.errors import ErrorCode, MPIError
+
+        raise MPIError(
+            ErrorCode.ERR_NOT_AVAILABLE,
+            "tpu-tune measures the in-process compiled algorithms "
+            "(driver-mode buffers); run it single-process on the "
+            "target mesh shape — the rule file it emits applies to "
+            "any job",
+        )
     n = comm.size
     results: Dict[str, List[Dict]] = {}
     for op in ops:

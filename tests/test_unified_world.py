@@ -831,6 +831,153 @@ class TestUnifiedWorld:
         """ % str(tmp_path / "dcn_io.bin"))
         assert "DCN-OK 0" in out and "DCN-OK 4" in out
 
+    def test_concurrent_cross_process_amo_no_lost_updates(self, tmp_path,
+                                                          capfd):
+        """Both processes shower fetch-adds at ONE remote slot under a
+        standing lock_all epoch, from two threads each, concurrently —
+        the home service must apply every batch atomically (op lock
+        around the compiled epoch program): the final value equals the
+        exact update count, and every fetch returns a distinct
+        pre-value."""
+        out = _run(tmp_path, capfd, """
+            import threading
+            from ompi_release_tpu.oshmem import shmem
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+
+            ctx = shmem.shmem_init(world)
+            counter = ctx.malloc((1,), np.int32)
+            world.barrier()
+            N = 12
+            fetched = []
+            flock = threading.Lock()
+
+            def shower():
+                for _ in range(N):
+                    old = np.asarray(ctx.atomic_fetch_add(
+                        counter, np.ones(1, np.int32), 0))
+                    with flock:
+                        fetched.append(int(old[0]))
+
+            ts = [threading.Thread(target=shower) for _ in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            world.barrier()
+            if off == 0:
+                final = int(np.asarray(ctx.get(counter, 0))[0])
+                assert final == 4 * N, final  # 2 procs x 2 threads x N
+                print("AMO-TOTAL", final)
+            # atomicity: this process's fetches are all distinct
+            assert len(set(fetched)) == len(fetched) == 2 * N
+            world.barrier()
+            print(f"AMO-OK {off}")
+            mpi.finalize()
+        """)
+        assert "AMO-OK 0" in out and "AMO-OK 4" in out
+        assert "AMO-TOTAL 48" in out
+
+    def test_three_process_vcoll_rma_pscw(self, tmp_path, capfd):
+        """P=3 battery for the paths with P>2-specific structure: the
+        vector collectives' per-peer sub-layouts, TWO remote origins
+        contending for one exclusive lock (home waiter queue with
+        remote grants), and a PSCW exposure with two accessor
+        processes."""
+        app = tmp_path / "app3.py"
+        app.write_text(textwrap.dedent("""
+            import os, sys
+            sys.path.insert(0, %r)
+            os.environ["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=2")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import ompi_release_tpu as mpi
+            from ompi_release_tpu.comm.group import Group
+            from ompi_release_tpu.osc.window import win_allocate
+            from ompi_release_tpu.runtime.runtime import Runtime
+
+            world = mpi.init()      # 3 procs x 2 devices = 6 ranks
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            assert n == 6, n
+
+            # alltoallv with zeros: c[i][j] = (i + 2*j) %% 3
+            c = np.asarray([[(i + 2 * j) %% 3 for j in range(n)]
+                            for i in range(n)], np.int64)
+            sb = [np.concatenate([np.full(c[i, j], 10 * i + j, np.int32)
+                                  for j in range(n)])
+                  for i in range(off, off + 2)]
+            rv = world.alltoallv(sb, c)
+            for pos, j in enumerate(range(off, off + 2)):
+                want = np.concatenate([np.full(c[i, j], 10 * i + j,
+                                               np.int32)
+                                       for i in range(n)])
+                np.testing.assert_array_equal(np.asarray(rv[pos]), want)
+
+            # uneven reduce_scatter over 3 processes
+            rc = [r + 1 for r in range(n)]
+            tot = sum(rc)
+            x = np.stack([np.arange(tot, dtype=np.int32) * (off + i + 1)
+                          for i in range(2)])
+            rs = world.reduce_scatter(x, rc)
+            wantfull = sum(np.arange(tot, dtype=np.int32) * (r + 1)
+                           for r in range(n))
+            offs = np.concatenate([[0], np.cumsum(rc)])
+            for i in range(2):
+                r = off + i
+                np.testing.assert_array_equal(
+                    np.asarray(rs[i]), wantfull[offs[r]:offs[r] + rc[r]])
+
+            # two REMOTE origins (procs 1, 2) contend for rank 0's
+            # exclusive lock: read-modify-write, no lost updates
+            win = win_allocate(world, (1,), np.int32)
+            world.barrier()
+            if off != 0:
+                for _ in range(8):
+                    win.lock(0)
+                    req = win.get(0)
+                    win.flush(0)
+                    cur = int(np.asarray(req.value)[0])
+                    win.put(np.int32([cur + 1]), 0)
+                    win.unlock(0)
+            world.barrier()
+            if off == 0:
+                total = int(np.asarray(win.read())[0, 0])
+                assert total == 16, total
+                print("LOCK3-TOTAL", total)
+
+            # PSCW: proc 0 exposes to accessors in procs 1 AND 2;
+            # wait() must collect BOTH completes
+            g_origins = Group([2, 3, 4, 5])   # procs 1, 2
+            g_targets = Group([0, 1])         # proc 0
+            if off == 0:
+                win.post(g_origins)
+                win.wait()
+                got = int(np.asarray(win.read())[1, 0])
+                assert got == 2 + 4, got   # both accumulates landed
+            else:
+                win.start(g_targets)
+                win.accumulate(np.int32([off]), 1)  # +2 and +4
+                win.complete()
+            world.barrier()
+            win.free()
+            print(f"P3-OK {off}")
+            mpi.finalize()
+        """ % REPO))
+        job = Job(3, [sys.executable, str(app)], [], heartbeat_s=0.5,
+                  miss_limit=8)
+        rc = job.run(timeout_s=240)
+        out = capfd.readouterr()
+        assert rc == 0, out.out + out.err
+        for o in (0, 2, 4):
+            assert f"P3-OK {o}" in out.out
+        assert "LOCK3-TOTAL 16" in out.out
+
     def test_unified_world_opt_out(self, tmp_path, capfd):
         """--mca runtime_unified_world false restores per-process
         local worlds (the pre-unification behavior)."""
