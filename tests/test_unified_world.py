@@ -978,6 +978,48 @@ class TestUnifiedWorld:
             assert f"P3-OK {o}" in out.out
         assert "LOCK3-TOTAL 16" in out.out
 
+    def test_intercomm_across_processes(self, tmp_path, capfd):
+        """MPI_Intercomm_create bridging two process-local comms on the
+        unified world: p2p crosses the boundary with remote-rank
+        addressing through the intercomm, and Intercomm_merge yields a
+        spanning intracomm whose collectives run the hier stack."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.comm.intercomm import intercomm_create
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+
+            subs = world.split([0] * 4 + [1] * 4)
+            comm_a, comm_b = subs[0], subs[4]
+            ia, ib = intercomm_create(comm_a, 0, comm_b, 0)
+            inter = ia if off == 0 else ib
+            assert inter.remote_size == 4
+
+            # p2p with REMOTE-group rank addressing across processes
+            if off == 0:
+                inter.send(np.int32([41]), dest=2, tag=3, rank=1)
+                val, st = inter.recv(source=2, tag=4, rank=1)
+                assert int(np.asarray(val)[0]) == 42
+                assert st.source == 2  # remote-group rank, not bridge
+            else:
+                val, st = inter.recv(source=1, tag=3, rank=2)
+                assert int(np.asarray(val)[0]) == 41
+                assert st.source == 1
+                inter.send(np.int32([42]), dest=1, tag=4, rank=2)
+
+            # merge -> ONE spanning intracomm; hier collectives work
+            merged = inter.merge(high=(off == 4))
+            assert merged.size == n and merged.spans_processes
+            x = np.stack([np.int32([off + i]) for i in range(4)])
+            got = np.asarray(merged.allreduce(x))
+            assert (got == sum(range(n))).all(), got
+            world.barrier()
+            print(f"INTER-OK {off}")
+            mpi.finalize()
+        """)
+        assert "INTER-OK 0" in out and "INTER-OK 4" in out
+
     def test_unified_world_opt_out(self, tmp_path, capfd):
         """--mca runtime_unified_world false restores per-process
         local worlds (the pre-unification behavior)."""
