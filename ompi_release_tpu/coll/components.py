@@ -149,6 +149,30 @@ class _XlaModule:
 
     def scan(self, comm, x, op: Op, *, exclusive: bool = False):
         n = comm.size
+        if op.is_pair_op:
+            # MPI_Scan with MINLOC/MAXLOC: associative_scan runs the
+            # pair combiner over the gathered (value, index) pytree;
+            # the rank-0 exscan slice is zeros (MPI leaves it
+            # undefined)
+            vals, idxs = x
+
+            def pair_body(vb, ib):
+                gv = lax.all_gather(vb, AXIS, axis=0)
+                gi = lax.all_gather(ib, AXIS, axis=0)
+                sv, si = lax.associative_scan(op, (gv, gi), axis=0)
+                rank = lax.axis_index(AXIS)
+                if exclusive:
+                    pv = jnp.take(sv, jnp.maximum(rank - 1, 0), axis=0)
+                    pi = jnp.take(si, jnp.maximum(rank - 1, 0), axis=0)
+                    return (jnp.where(rank == 0, jnp.zeros_like(pv), pv),
+                            jnp.where(rank == 0, jnp.zeros_like(pi), pi))
+                return (jnp.take(sv, rank, axis=0),
+                        jnp.take(si, rank, axis=0))
+
+            return run_sharded(
+                comm, ("xla", "scan_pair", op.name, exclusive),
+                pair_body, vals, extra_arrays=(idxs,),
+            )
         # the gather-based scan stages the WHOLE comm's buffers on
         # every rank (O(n * size) memory): past the limit, decline so
         # the chain falls to tuned's recursive-doubling scan, which
@@ -678,6 +702,8 @@ class _TunedModule:
         return run_sharded(comm, ("tuned", "alltoall", alg), body, x)
 
     def scan(self, comm, x, op: Op):
+        if op.is_pair_op:
+            return None  # pair scans stay with xla's gather path
         n = comm.size
         return run_sharded(
             comm, ("tuned", "scan", op.name),
@@ -685,6 +711,8 @@ class _TunedModule:
         )
 
     def exscan(self, comm, x, op: Op):
+        if op.is_pair_op:
+            return None  # pair scans stay with xla's gather path
         n = comm.size
         return run_sharded(
             comm, ("tuned", "exscan", op.name),

@@ -570,7 +570,28 @@ class _HierModule:
 
     def _scan_impl(self, comm, x, op: Op, exclusive: bool):
         if op.is_pair_op:
-            return _not_available("pair-op scan")(comm)
+            # MINLOC/MAXLOC scans: fold the gathered (value, index)
+            # rows with the pair combiner in rank order; the rank-0
+            # exscan slice is zeros (MPI leaves it undefined)
+            vals, idxs = x
+            self._check_local_axis(vals, "scan")
+            vrows = self._full_rows(vals)
+            irows = self._full_rows(idxs)
+            outv, outi = [], []
+            for r in self.local_ranks:
+                end = r if exclusive else r + 1
+                if end == 0:
+                    outv.append(np.zeros_like(vrows[0]))
+                    outi.append(np.zeros_like(irows[0]))
+                    continue
+                acc = (jnp.asarray(vrows[0]), jnp.asarray(irows[0]))
+                for j in range(1, end):
+                    acc = op(acc, (jnp.asarray(vrows[j]),
+                                   jnp.asarray(irows[j])))
+                outv.append(np.asarray(acc[0]))
+                outi.append(np.asarray(acc[1]))
+            return (jnp.asarray(np.stack(outv)),
+                    jnp.asarray(np.stack(outi)))
         self._check_local_axis(x, "scan")
         rows = self._full_rows(x)
         out = []

@@ -486,6 +486,36 @@ def test_scan_exscan(world):
     )
 
 
+def test_scan_exscan_pair_ops(world):
+    """MPI_Scan/Exscan with MINLOC/MAXLOC (pair ops): running
+    argmax/argmin with MPI's lowest-index tie-break; the rank-0 exscan
+    slice is zeros (undefined in MPI)."""
+    vals = np.asarray([3., 1., 7., 2., 9., 0., 7., 4.],
+                      np.float32)[:world.size].reshape(-1, 1)
+    idxs = np.arange(world.size, dtype=np.int32).reshape(-1, 1)
+    sv, si = world.scan((vals, idxs), ops.MAXLOC)
+    best, bi, want_v, want_i = -np.inf, 0, [], []
+    for k, v in enumerate(vals.ravel()):
+        if v > best:  # strict: ties keep the LOWER index
+            best, bi = v, k
+        want_v.append(best)
+        want_i.append(bi)
+    np.testing.assert_array_equal(np.asarray(sv).ravel(), want_v)
+    np.testing.assert_array_equal(np.asarray(si).ravel(), want_i)
+
+    ev, ei = world.exscan((vals, idxs), ops.MAXLOC)
+    assert float(np.asarray(ev)[0, 0]) == 0.0
+    np.testing.assert_array_equal(np.asarray(ev).ravel()[1:],
+                                  want_v[:-1])
+    np.testing.assert_array_equal(np.asarray(ei).ravel()[1:],
+                                  want_i[:-1])
+
+    mv, mi = world.scan((vals, idxs), ops.MINLOC)
+    np.testing.assert_array_equal(
+        np.asarray(mv).ravel(),
+        np.minimum.accumulate(vals.ravel()))
+
+
 def test_scan_tuned(tuned):
     x = _per_rank(tuned, 20, seed=38)
     out = tuned.scan(x, ops.SUM)

@@ -232,6 +232,25 @@ class TestUnifiedWorld:
                 np.testing.assert_array_equal(sc[i],
                                               full[:off+i+1].sum(0))
 
+            # pair-op scan (MAXLOC) across the process boundary
+            from ompi_release_tpu import ops as _ops
+            pv = np.asarray([3., 1., 7., 2., 9., 0., 7., 4.],
+                            np.float32).reshape(n, 1)
+            pi = np.arange(n, dtype=np.int32).reshape(n, 1)
+            sv, si = world.scan(
+                (pv[off:off+4], pi[off:off+4]), _ops.MAXLOC)
+            best, bi = -np.inf, 0
+            want_v, want_i = [], []
+            for k, v in enumerate(pv.ravel()):
+                if v > best:
+                    best, bi = v, k
+                want_v.append(best)
+                want_i.append(bi)
+            np.testing.assert_array_equal(
+                np.asarray(sv).ravel(), want_v[off:off+4])
+            np.testing.assert_array_equal(
+                np.asarray(si).ravel(), want_i[off:off+4])
+
             world.barrier()
             print(f"HIER-OK {off}")
             mpi.finalize()
