@@ -97,6 +97,22 @@ class _XlaModule:
         )
 
     def reduce(self, comm, x, op: Op, root: int):
+        if op.is_pair_op:
+            # MPI_Reduce with MINLOC/MAXLOC — THE canonical pair-op
+            # call (global extremum + its location at the root)
+            vals, idxs = x
+
+            def pair_body(vb, ib):
+                rv, ri = spmd.allreduce_pair_lax(vb, ib, op, AXIS)
+                rank = lax.axis_index(AXIS)
+                return (jnp.where(rank == root, rv, jnp.zeros_like(rv)),
+                        jnp.where(rank == root, ri, jnp.zeros_like(ri)))
+
+            return run_sharded(
+                comm, ("xla", "reduce_pair", op.name, root),
+                pair_body, vals, extra_arrays=(idxs,),
+            )
+
         def body(xb):
             red = spmd.allreduce_lax(xb, op, AXIS)
             rank = lax.axis_index(AXIS)
@@ -132,6 +148,21 @@ class _XlaModule:
 
     def reduce_scatter_block(self, comm, x, op: Op):
         n = comm.size
+        if op.is_pair_op:
+            vals, idxs = x
+
+            def pair_body(vb, ib):
+                rv, ri = spmd.allreduce_pair_lax(vb, ib, op, AXIS)
+                rank = lax.axis_index(AXIS)
+                cv = rv.reshape((n, -1) + rv.shape[1:])
+                ci = ri.reshape((n, -1) + ri.shape[1:])
+                return (jnp.take(cv, rank, axis=0),
+                        jnp.take(ci, rank, axis=0))
+
+            return run_sharded(
+                comm, ("xla", "rsb_pair", op.name),
+                pair_body, vals, extra_arrays=(idxs,),
+            )
         return run_sharded(
             comm, ("xla", "reduce_scatter_block", op.name),
             lambda xb: spmd.reduce_scatter_lax(xb, op, AXIS, n), x,

@@ -149,8 +149,9 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
         raise MPIError(
             ErrorCode.ERR_TYPE,
             "driver-mode collectives take a single array with a leading "
-            "rank axis; pair-op (value, index) tuples are only supported "
-            "by allreduce (MINLOC/MAXLOC)",
+            "rank axis; pair-op (value, index) tuples are supported by "
+            "allreduce/reduce/reduce_scatter_block/scan/exscan "
+            "(MINLOC/MAXLOC)",
         )
     if x.shape[0] != comm.size:
         from ..utils.errors import ErrorCode, MPIError

@@ -242,22 +242,25 @@ class _HierModule:
         return place(total)
 
     def reduce_scatter_block(self, comm, x, op: Op):
-        if op.is_pair_op:
-            return _not_available("pair-op reduce_scatter_block")(comm)
         n = comm.size
-        total = np.asarray(
-            self._combine_with_peers(self._local_partial(x, op), op)
-        )
-        if total.shape[0] % n:
-            raise MPIError(
-                ErrorCode.ERR_COUNT,
-                f"reduce_scatter_block buffer length {total.shape[0]} "
-                f"not divisible by comm size {n}",
-            )
-        chunks = total.reshape((n, -1) + total.shape[1:])
-        out = np.stack([chunks[r] for r in self.local_ranks])
-        return jnp.asarray(out.reshape((self.local_n, -1)
-                                       + total.shape[1:]))
+
+        def chunked(total: np.ndarray) -> np.ndarray:
+            if total.shape[0] % n:
+                raise MPIError(
+                    ErrorCode.ERR_COUNT,
+                    f"reduce_scatter_block buffer length "
+                    f"{total.shape[0]} not divisible by comm size {n}",
+                )
+            chunks = total.reshape((n, -1) + total.shape[1:])
+            out = np.stack([chunks[r] for r in self.local_ranks])
+            return out.reshape((self.local_n, -1) + total.shape[1:])
+
+        total = self._combine_with_peers(self._local_partial(x, op), op)
+        if op.is_pair_op:
+            tv, ti = total
+            return (jnp.asarray(chunked(np.asarray(tv))),
+                    jnp.asarray(chunked(np.asarray(ti))))
+        return jnp.asarray(chunked(np.asarray(total)))
 
     # -- data movement -----------------------------------------------------
     def bcast(self, comm, x, root: int):

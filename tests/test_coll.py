@@ -516,6 +516,32 @@ def test_scan_exscan_pair_ops(world):
         np.minimum.accumulate(vals.ravel()))
 
 
+def test_reduce_and_rsb_pair_ops(world):
+    """Rooted MPI_Reduce with MAXLOC (the canonical pair-op call) and
+    reduce_scatter_block with MINLOC."""
+    n = world.size
+    vals = np.asarray([3., 1., 7., 2., 9., 0., 7., 4.],
+                      np.float32)[:n].reshape(n, 1)
+    idxs = np.arange(n, dtype=np.int32).reshape(n, 1)
+    rv, ri = world.reduce((vals, idxs), ops.MAXLOC, root=2)
+    rv, ri = np.asarray(rv), np.asarray(ri)
+    assert float(rv[2, 0]) == 9.0 and int(ri[2, 0]) == 4
+    assert (rv[[0, 1, 3]] == 0).all()  # zeros off-root
+
+    # rsb: every rank contributes n values; rank r keeps element r of
+    # the elementwise MINLOC across ranks
+    vs = np.stack([np.roll(np.arange(n, dtype=np.float32), r)
+                   for r in range(n)])
+    ix = np.tile(np.arange(n, dtype=np.int32).reshape(n, 1), (1, n))
+    cv, ci = world.reduce_scatter_block((vs, ix), ops.MINLOC)
+    cv, ci = np.asarray(cv), np.asarray(ci)
+    for r in range(n):
+        col = vs[:, r]
+        k = int(np.argmin(col))  # lowest index wins ties via MPI rule
+        assert float(cv[r, 0]) == float(col[k])
+        assert int(ci[r, 0]) == k
+
+
 def test_scan_tuned(tuned):
     x = _per_rank(tuned, 20, seed=38)
     out = tuned.scan(x, ops.SUM)

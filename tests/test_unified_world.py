@@ -232,8 +232,30 @@ class TestUnifiedWorld:
                 np.testing.assert_array_equal(sc[i],
                                               full[:off+i+1].sum(0))
 
-            # pair-op scan (MAXLOC) across the process boundary
+            # pair-op rooted reduce + reduce_scatter_block across the
+            # boundary
             from ompi_release_tpu import ops as _ops
+            apv = np.asarray([3., 1., 7., 2., 9., 0., 7., 4.],
+                             np.float32).reshape(n, 1)
+            api = np.arange(n, dtype=np.int32).reshape(n, 1)
+            rv, ri = world.reduce(
+                (apv[off:off+4], api[off:off+4]), _ops.MAXLOC, root=6)
+            if off == 4:
+                assert float(np.asarray(rv)[6 - 4, 0]) == 9.0
+                assert int(np.asarray(ri)[6 - 4, 0]) == 4
+            bv = np.stack([np.roll(np.arange(n, dtype=np.float32), r)
+                           for r in range(n)])
+            bi = np.tile(np.arange(n, dtype=np.int32).reshape(n, 1),
+                         (1, n))
+            cv, ci = world.reduce_scatter_block(
+                (bv[off:off+4], bi[off:off+4]), _ops.MINLOC)
+            for i in range(4):
+                col = bv[:, off + i]
+                k = int(np.argmin(col))
+                assert float(np.asarray(cv)[i, 0]) == float(col[k])
+                assert int(np.asarray(ci)[i, 0]) == k
+
+            # pair-op scan (MAXLOC) across the process boundary
             pv = np.asarray([3., 1., 7., 2., 9., 0., 7., 4.],
                             np.float32).reshape(n, 1)
             pi = np.arange(n, dtype=np.int32).reshape(n, 1)
