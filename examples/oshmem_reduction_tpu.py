@@ -24,7 +24,7 @@ def main() -> int:
     n = ctx.n_pes
 
     # -- max reduction (oshmem_max_reduction.c) --------------------------
-    per_pe = np.stack([np.arange(4, dtype=np.int64) + pe
+    per_pe = np.stack([np.arange(4, dtype=np.int32) + pe
                        for pe in range(n)])
     mx = np.asarray(ctx.max_to_all(per_pe))
     expect = per_pe.max(axis=0)

@@ -14,6 +14,7 @@ from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..mca import pvar
@@ -130,6 +131,28 @@ def run_sharded_spmd(comm, key: Tuple, body: Callable, local_x) -> Any:
     return jax.tree.map(to_local, out)
 
 
+def _check_no_narrowing(arr) -> None:
+    """MPI_DOUBLE is not MPI_FLOAT: with jax_enable_x64 off (the JAX
+    default), ``jnp.asarray`` silently narrows 64-bit host buffers to
+    32 bits — a reduction over them would return plausible-but-wrong
+    values. Refuse loudly; with x64 enabled the widths pass through
+    and this is a no-op."""
+    dt = getattr(arr, "dtype", None)
+    if dt is None:
+        return
+    jt = jnp.asarray(np.empty(0, dt)).dtype
+    if jt.itemsize < np.dtype(dt).itemsize:
+        from ..utils.errors import ErrorCode, MPIError
+
+        raise MPIError(
+            ErrorCode.ERR_TYPE,
+            f"{np.dtype(dt).name} buffer would be silently narrowed "
+            f"to {jt.name} (jax_enable_x64 is off) — enable x64 "
+            "(jax.config.update('jax_enable_x64', True)) or cast the "
+            "buffer explicitly",
+        )
+
+
 def run_sharded(comm, key: Tuple, body: Callable, x, *,
                 extra_arrays: Tuple = ()) -> Any:
     """Run ``body(block, *extra_blocks)`` under shard_map over the comm's
@@ -165,6 +188,8 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
             f"driver-mode buffer leading axis {x.shape[0]} != comm size "
             f"{comm.size} (one slice per rank)",
         )
+    for arr in (x,) + tuple(extra_arrays):
+        _check_no_narrowing(arr)
     cache = _program_cache(comm)
     prog = cache.get(key)
     if prog is None:

@@ -542,6 +542,20 @@ def test_reduce_and_rsb_pair_ops(world):
         assert int(ci[r, 0]) == k
 
 
+def test_64bit_narrowing_refused(world):
+    """MPI_DOUBLE is not MPI_FLOAT: with jax_enable_x64 off a float64
+    buffer would silently lose precision inside jnp.asarray — the
+    driver edge must refuse loudly, naming the remedy."""
+    from ompi_release_tpu.utils.errors import MPIError
+
+    x = np.arange(world.size * 4, dtype=np.float64).reshape(world.size, 4)
+    with pytest.raises(MPIError, match="narrowed"):
+        world.allreduce(x)
+    with pytest.raises(MPIError, match="narrowed"):
+        world.reduce_scatter_block(
+            np.ones((world.size, world.size), np.int64))
+
+
 def test_scan_tuned(tuned):
     x = _per_rank(tuned, 20, seed=38)
     out = tuned.scan(x, ops.SUM)
