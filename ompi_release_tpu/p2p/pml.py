@@ -50,6 +50,26 @@ PML_FRAMEWORK = mca_component.framework(
 )
 
 
+def _as_device_payload(data):
+    """Convert a send payload to a device array, turning the raw jax
+    TypeError for structured/byte-string data into MPI's own answer:
+    describe it with a Datatype and pack it to a numeric buffer (the
+    reference never sends raw C structs either — ``MPI_Type_struct``
+    + pack/unpack is the contract)."""
+    import jax.numpy as jnp
+
+    try:
+        return jnp.asarray(data)
+    except TypeError as e:
+        raise MPIError(
+            ErrorCode.ERR_TYPE,
+            f"p2p payload of type {type(data).__name__} is not a "
+            "numeric array; describe structured/byte data with a "
+            "datatype and pack it (datatype.pack / Convertor) before "
+            f"sending, then unpack at the receiver ({e})",
+        )
+
+
 def register_vars() -> None:
     mca_var.register(
         "pml_eager_limit", "size", 0,
@@ -174,7 +194,7 @@ class PmlEngine:
 
         self._check_rank(dst, "destination")
         self._check_rank(src, "source")
-        data = jnp.asarray(data)
+        data = _as_device_payload(data)
         req = Request()
         entry = _SendEntry(src, dst, tag, data, req, sync)
         from . import peruse
@@ -440,9 +460,7 @@ class WirePmlEngine(PmlEngine):
         # cross-process: rsend legally degrades to a standard send (an
         # implementation MAY treat ready mode as standard; verifying
         # the remote posted-recv would cost a round trip)
-        import jax.numpy as jnp
-
-        data = jnp.asarray(data)
+        data = _as_device_payload(data)
         from . import peruse
 
         peruse.fire(self.comm, peruse.REQ_ACTIVATE, kind="send",
