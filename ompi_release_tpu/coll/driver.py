@@ -166,6 +166,22 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
     apply there because no controller holds every rank's slice.
     """
     _invoke_count.add()
+    if getattr(comm, "spans_processes", False):
+        from ..utils.errors import ErrorCode, MPIError
+
+        # the submesh covers only LOCAL members on a spanning comm:
+        # compiling over it with comm.size rows would silently place
+        # remote ranks' slices on local devices (wrong results, no
+        # error). Everything with a cross-process implementation
+        # dispatches through coll/hier or the wire — reaching this
+        # compiled in-process path is a capability boundary.
+        raise MPIError(
+            ErrorCode.ERR_NOT_AVAILABLE,
+            f"compiled in-process collective invoked on {comm.name}, "
+            "which spans controller processes — this operation has no "
+            "cross-process implementation; run it on a process-local "
+            "sub-communicator (split_type_shared)",
+        )
     if not hasattr(x, "shape"):
         from ..utils.errors import ErrorCode, MPIError
 
