@@ -72,6 +72,22 @@ def _unpack_array_header(buf):
     return dtype, shape
 
 
+_stash_guard = threading.Lock()
+
+
+def _ep_stash(oob_ep):
+    """The endpoint's frame stash + its lock, created once. Multiple
+    threads poll stashed_recv on one endpoint concurrently (the window
+    service, the nbc worker's coll_recv, the pml drain): iteration and
+    setdefault on the dict must not race."""
+    with _stash_guard:
+        stash = getattr(oob_ep, "_dcn_stash", None)
+        if stash is None:
+            stash = oob_ep._dcn_stash = {}
+            oob_ep._dcn_stash_lock = threading.Lock()
+        return stash, oob_ep._dcn_stash_lock
+
+
 def stashed_recv(oob_ep, want_src, tag: int, deadline: float):
     """Next (src, payload) for ``tag``, matched by source: frames from
     other senders interleaved on the same tag are stashed on the
@@ -85,23 +101,23 @@ def stashed_recv(oob_ep, want_src, tag: int, deadline: float):
     """
     import time as _time
 
-    stash = getattr(oob_ep, "_dcn_stash", None)
-    if stash is None:
-        stash = oob_ep._dcn_stash = {}
-    if want_src is None:
-        for (s, t), q in stash.items():
-            if t == tag and q:
-                return s, q.pop(0)
-    else:
-        q = stash.get((want_src, tag))
-        if q:
-            return want_src, q.pop(0)
+    stash, lock = _ep_stash(oob_ep)
+    with lock:
+        if want_src is None:
+            for (s, t), q in stash.items():
+                if t == tag and q:
+                    return s, q.pop(0)
+        else:
+            q = stash.get((want_src, tag))
+            if q:
+                return want_src, q.pop(0)
     while True:
         left = max(1, int((deadline - _time.monotonic()) * 1000))
         src, _, raw = oob_ep.recv(tag=tag, timeout_ms=left)
         if want_src is None or src == want_src:
             return src, raw
-        stash.setdefault((src, tag), []).append(raw)
+        with lock:
+            stash.setdefault((src, tag), []).append(raw)
 
 
 class SelfBtl(base.BtlModule):

@@ -140,16 +140,19 @@ def _check_no_narrowing(arr) -> None:
     dt = getattr(arr, "dtype", None)
     if dt is None:
         return
-    jt = jnp.asarray(np.empty(0, dt)).dtype
-    if jt.itemsize < np.dtype(dt).itemsize:
+    try:
+        jt = jax.dtypes.canonicalize_dtype(dt)  # pure metadata, no
+    except TypeError:                           # dispatch on the hot path
+        return  # non-canonicalizable dtypes fail later with their own error
+    if np.dtype(jt).itemsize < np.dtype(dt).itemsize:
         from ..utils.errors import ErrorCode, MPIError
 
         raise MPIError(
             ErrorCode.ERR_TYPE,
             f"{np.dtype(dt).name} buffer would be silently narrowed "
-            f"to {jt.name} (jax_enable_x64 is off) — enable x64 "
-            "(jax.config.update('jax_enable_x64', True)) or cast the "
-            "buffer explicitly",
+            f"to {np.dtype(jt).name} (jax_enable_x64 is off) — enable "
+            "x64 (jax.config.update('jax_enable_x64', True)) or cast "
+            "the buffer explicitly",
         )
 
 

@@ -73,20 +73,16 @@ class _HierModule:
 
         self.comm = comm
         rt = comm.runtime
-        self.router = rt.wire
-        self.my_pidx = int(rt.bootstrap["process_index"])
-        n = comm.size
-        self.owner: List[int] = [
-            self.router.owner_of(comm.group.world_rank(i))
-            for i in range(n)
-        ]
-        self.procs: List[int] = sorted(set(self.owner))
-        self.members_of: Dict[int, List[int]] = {
-            p: [i for i in range(n) if self.owner[i] == p]
-            for p in self.procs
-        }
-        self.local_ranks: List[int] = list(comm.local_comm_ranks)
-        self.local_n = len(self.local_ranks)
+        from ..runtime.wire import proc_topology
+
+        t = proc_topology(comm)  # the one shared layout derivation
+        self.router = t.router
+        self.my_pidx = t.my_pidx
+        self.owner = t.owner
+        self.procs = t.procs
+        self.members_of = t.members_of
+        self.local_ranks = t.local_ranks
+        self.local_n = t.local_n
         # shadow communicator over the LOCAL members: the intra level,
         # with the full normal coll stack (the bcol analogue).
         # internal=True: shadow creation happens only on processes with
@@ -141,6 +137,13 @@ class _HierModule:
                 f"one slice per LOCAL member ({self.local_n}), got "
                 f"shape {getattr(x, 'shape', None)}",
             )
+        # same refusal as the compiled driver edge: hier's local
+        # partials and jnp conversions would otherwise silently narrow
+        # 64-bit buffers with x64 off — and behavior would even differ
+        # by process layout (a 1-member process skips the shadow comm)
+        from .driver import _check_no_narrowing
+
+        _check_no_narrowing(x)
 
     def _local_partial(self, x, op: Op):
         """Reduce this process's member slices to one partial."""
@@ -377,6 +380,10 @@ class _HierModule:
                 f"{what} buffers must share one dtype, got "
                 f"{sorted(map(str, dtypes))}",
             )
+        from .driver import _check_no_narrowing
+
+        if out:
+            _check_no_narrowing(out[0])
         return out
 
     def alltoallv(self, comm, sendbufs, sendcounts):
@@ -515,6 +522,9 @@ class _HierModule:
             return [jnp.asarray(self._recv(owner))
                     for _ in self.local_ranks]
         buf = np.asarray(sendbuf).reshape(-1)
+        from .driver import _check_no_narrowing
+
+        _check_no_narrowing(buf)
         if buf.shape[0] != sum(counts):
             raise MPIError(
                 ErrorCode.ERR_COUNT,
@@ -544,6 +554,9 @@ class _HierModule:
             )
         total = sum(recvcounts)
         x = np.asarray(x)
+        from .driver import _check_no_narrowing
+
+        _check_no_narrowing(x)  # BEFORE the jnp conversion below
         if x.shape[0] != self.local_n \
                 or x.reshape(self.local_n, -1).shape[1] != total:
             raise MPIError(

@@ -67,6 +67,13 @@ def _as_1d_arrays(bufs, n: int, what: str) -> List[np.ndarray]:
             ErrorCode.ERR_TYPE,
             f"{what} buffers must share one dtype, got {sorted(map(str, dtypes))}",
         )
+    if out:
+        # check the ORIGINAL dtype here: the padded staging array is
+        # jnp-converted before run_sharded's own narrowing check can
+        # see the user's 64-bit buffer
+        from .driver import _check_no_narrowing
+
+        _check_no_narrowing(out[0])
     return out
 
 

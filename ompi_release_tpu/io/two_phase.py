@@ -36,44 +36,29 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from ..runtime.wire import ProcTopology as _Topology
+from ..runtime.wire import proc_topology as _topology
 from ..utils.errors import ErrorCode, MPIError
 
 
-def _topology(comm) -> "_Topology":
-    """Cached per-comm layout (the derivation is O(size x procs) owner
-    scans — pay it once per communicator, not per collective-IO call)."""
-    topo = getattr(comm, "_io_topology", None)
-    if topo is None:
-        topo = comm._io_topology = _Topology(comm)
-    return topo
-
-
-class _Topology:
-    """Process/member layout of a spanning communicator (the same
-    derivation as coll/hier.py's _HierModule)."""
-
-    def __init__(self, comm) -> None:
-        rt = comm.runtime
-        self.router = rt.wire
-        self.my_pidx = int(rt.bootstrap["process_index"])
-        n = comm.size
-        self.owner = [self.router.owner_of(comm.group.world_rank(i))
-                      for i in range(n)]
-        self.procs = sorted(set(self.owner))
-        self.members_of = {p: [i for i in range(n) if self.owner[i] == p]
-                           for p in self.procs}
-        self.local_ranks = list(comm.local_comm_ranks)
-        self.local_n = len(self.local_ranks)
-        self.peers = [p for p in self.procs if p != self.my_pidx]
-
-
 def _global_table(comm, topo: _Topology, offsets, counts) -> np.ndarray:
-    """(n, 2) int64 rows of (offset, count) per comm rank."""
+    """(n, 2) int64 rows of (offset, count) per comm rank, exchanged as
+    raw numpy over the wire channel (the hier allgather's jnp path
+    cannot carry int64 with x64 off, and file element offsets must not
+    truncate at 2^31)."""
     local = np.asarray(
         [[int(o), int(c)] for o, c in zip(offsets, counts)], np.int64
     ).reshape(topo.local_n, 2)
-    full = np.asarray(comm.allgather(local))[0]
-    return full.reshape(comm.size, 2)
+    for p in topo.peers:
+        topo.router.coll_send(comm, p, local)
+    rows: Dict[int, np.ndarray] = {topo.my_pidx: local}
+    for p in topo.peers:
+        rows[p] = np.asarray(topo.router.coll_recv(comm, p))
+    table = np.zeros((comm.size, 2), np.int64)
+    for p in topo.procs:
+        for pos, r in enumerate(topo.members_of[p]):
+            table[r] = rows[p][pos]
+    return table
 
 
 def _domains(table: np.ndarray, procs: List[int]

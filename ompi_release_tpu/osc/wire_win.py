@@ -32,6 +32,7 @@ eval. Only predefined reduction ops may cross a process boundary
 from __future__ import annotations
 
 import io
+import itertools
 import json
 import threading
 import time
@@ -166,6 +167,10 @@ class WinService:
         #: reply on the shared reply channel always belongs to the one
         #: outstanding request
         self.outbound = threading.Lock()
+        #: per-request token echoed in replies: after a timeout, a
+        #: LATE reply must not be mistaken for the retry's (same cid/
+        #: seq/kind) — tokens make staleness decidable
+        self._token = itertools.count(1)
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._serve, daemon=True, name="win-service"
@@ -243,7 +248,8 @@ class WinService:
         if env.unpack_string() != _WIN_MAGIC:
             _log.verbose(1, "win service: non-window frame dropped")
             return
-        cid, seq, kind, arg1, arg2 = env.unpack_int64(5)
+        cid, seq, kind, arg1, arg2, token = env.unpack_int64(6)
+        token = int(token)
         if kind == KIND_BATCH:
             # payload must be consumed even if applying fails, and the
             # origin must get SOME reply or it stalls for the full
@@ -259,20 +265,24 @@ class WinService:
             except Exception as e:
                 _log.verbose(1, f"win service: batch from process "
                                 f"{src_pidx} failed: {e}")
-                self._reply(src_pidx, int(cid), int(seq), KIND_ERROR, [])
+                self._reply(src_pidx, int(cid), int(seq), KIND_ERROR, [],
+                            token)
                 return
-            self._reply(src_pidx, int(cid), int(seq), KIND_BATCH, reads)
+            self._reply(src_pidx, int(cid), int(seq), KIND_BATCH, reads,
+                        token)
         elif kind == KIND_LOCK:
             win = self._window(int(cid), int(seq))
             granted = self.acquire(win, int(arg1), src_pidx, int(arg2),
-                                   event=None)
+                                   event=None, token=token)
             if granted:
-                self._reply(src_pidx, int(cid), int(seq), KIND_LOCK, [])
+                self._reply(src_pidx, int(cid), int(seq), KIND_LOCK, [],
+                            token)
             # else: deferred — release() sends the grant later
         elif kind == KIND_ABANDON:
             win = self._window(int(cid), int(seq))
             self.abandon(win, int(arg1), src_pidx)
-            self._reply(src_pidx, int(cid), int(seq), KIND_ABANDON, [])
+            self._reply(src_pidx, int(cid), int(seq), KIND_ABANDON, [],
+                        token)
         elif kind == KIND_POST:
             self.pscw_record(self._posts, (int(cid), int(seq)), src_pidx)
         elif kind == KIND_COMPLETE:
@@ -282,10 +292,10 @@ class WinService:
             _log.verbose(1, f"win service: unknown kind {kind}")
 
     def _reply(self, dst_pidx: int, cid: int, seq: int, kind: int,
-               reads: List[np.ndarray]) -> None:
+               reads: List[np.ndarray], token: int = 0) -> None:
         env = DssBuffer()
         env.pack_string(_WIN_MAGIC)
-        env.pack_int64([cid, seq, kind, len(reads), 0])
+        env.pack_int64([cid, seq, kind, len(reads), token])
         self.router._retry(
             lambda: self.ep.send(self.router._nid(dst_pidx),
                                  WIRE_WIN_REPLY, env.tobytes()),
@@ -305,9 +315,11 @@ class WinService:
         generous timeout). Returns the read arrays."""
         from ..btl.components import stashed_recv
 
+        token = next(self._token)
         env = DssBuffer()
         env.pack_string(_WIN_MAGIC)
-        env.pack_int64([win.comm.cid, win.win_seq, kind, arg1, arg2])
+        env.pack_int64([win.comm.cid, win.win_seq, kind, arg1, arg2,
+                        token])
         with self.outbound:
             self.router._retry(
                 lambda: self.ep.send(self.router._nid(owner_pidx),
@@ -326,7 +338,21 @@ class WinService:
                 if renv.unpack_string() != _WIN_MAGIC:
                     raise MPIError(ErrorCode.ERR_INTERN,
                                    "corrupt window reply envelope")
-                rcid, rseq, rkind, n_reads, _ = renv.unpack_int64(5)
+                rcid, rseq, rkind, n_reads, rtoken = renv.unpack_int64(5)
+                if int(rtoken) != token:
+                    # STALE: a reply whose requester timed out/abandoned
+                    # (the token makes this decidable even for a retry
+                    # with identical cid/seq/kind). Its RDATA payload —
+                    # if any — must be drained or the NEXT read-carrying
+                    # reply would unpack the wrong arrays
+                    if int(n_reads) and int(rkind) != KIND_ERROR:
+                        self.router._recv_payload(WIRE_WIN_RDATA,
+                                                  owner_pidx)
+                    _log.verbose(
+                        1, f"discarding stale window reply (cid={rcid}, "
+                           f"seq={rseq}, kind={rkind}, token={rtoken}) "
+                           f"while awaiting token {token}")
+                    continue
                 if int(rkind) == KIND_ERROR:
                     raise MPIError(
                         ErrorCode.ERR_RMA_SYNC,
@@ -336,15 +362,13 @@ class WinService:
                     )
                 if (int(rcid), int(rseq), int(rkind)) != (
                         win.comm.cid, win.win_seq, kind):
-                    # outbound is serialized, so a mismatched frame is
-                    # necessarily STALE (e.g. a lock grant that arrived
-                    # after its requester abandoned) — discard it
-                    _log.verbose(
-                        1, f"discarding stale window reply (cid={rcid}, "
-                           f"seq={rseq}, kind={rkind}) while awaiting "
-                           f"(cid={win.comm.cid}, seq={win.win_seq}, "
-                           f"kind={kind})")
-                    continue
+                    raise MPIError(
+                        ErrorCode.ERR_INTERN,
+                        f"window reply token {token} carries "
+                        f"(cid={rcid}, seq={rseq}, kind={rkind}), "
+                        f"expected (cid={win.comm.cid}, "
+                        f"seq={win.win_seq}, kind={kind})",
+                    )
                 if int(n_reads):
                     rdata = self.router._recv_payload(WIRE_WIN_RDATA,
                                                       owner_pidx)
@@ -355,7 +379,7 @@ class WinService:
     def notify(self, dst_pidx: int, win: "WireWindow", kind: int) -> None:
         env = DssBuffer()
         env.pack_string(_WIN_MAGIC)
-        env.pack_int64([win.comm.cid, win.win_seq, kind, 0, 0])
+        env.pack_int64([win.comm.cid, win.win_seq, kind, 0, 0, 0])
         self.router._retry(
             lambda: self.ep.send(self.router._nid(dst_pidx),
                                  WIRE_WIN_SERVICE, env.tobytes()),
@@ -403,11 +427,12 @@ class WinService:
         return (win.comm.cid, win.win_seq, target)
 
     def acquire(self, win: "WireWindow", target: int, origin: int,
-                lock_type: int, event: Optional[threading.Event]) -> bool:
+                lock_type: int, event: Optional[threading.Event],
+                token: int = 0) -> bool:
         """Try to acquire ``target``'s lock for ``origin``. Returns
         True when granted now; otherwise queues the waiter (remote
-        origins get their grant reply from :meth:`release`; local ones
-        wait on ``event``)."""
+        origins get their grant reply — echoing ``token`` — from
+        :meth:`release`; local ones wait on ``event``)."""
         with self._state_lock:
             st = self._locks.setdefault(self._lock_key(win, target),
                                         _LockState())
@@ -420,11 +445,11 @@ class WinService:
                 st.mode = lock_type
                 st.holders.add(origin)
                 return True
-            st.waiters.append((origin, lock_type, event))
+            st.waiters.append((origin, lock_type, event, token))
             return False
 
     def release(self, win: "WireWindow", target: int, origin: int) -> None:
-        grants: List[int] = []  # remote origins to notify
+        grants: List[Tuple[int, int]] = []  # (remote origin, token)
         with self._state_lock:
             st = self._locks.get(self._lock_key(win, target))
             if st is None or origin not in st.holders:
@@ -437,7 +462,7 @@ class WinService:
             if not st.holders:
                 st.mode = None
                 while st.waiters:
-                    o, t, ev = st.waiters[0]
+                    o, t, ev, tok = st.waiters[0]
                     if st.mode is None:
                         st.mode = t
                     elif not (st.mode == LOCK_SHARED
@@ -451,12 +476,12 @@ class WinService:
                         # distinguish "granted" from "still waiting"
                         ev.set()
                     else:
-                        grants.append(o)
+                        grants.append((o, tok))
                     if t == LOCK_EXCLUSIVE:
                         break
-        for origin_p in grants:
+        for origin_p, tok in grants:
             self._reply(origin_p, win.comm.cid, win.win_seq,
-                        KIND_LOCK, [])
+                        KIND_LOCK, [], tok)
 
     def abandon(self, win: "WireWindow", target: int, origin: int) -> None:
         """Forget ``origin``'s interest in ``target``'s lock: drop its
@@ -512,15 +537,14 @@ class WireWindow(Window):
                 "spanning-comm window needs the wire router "
                 "(runtime_unified_world)",
             )
-        self.router = rt.wire
-        self.my_pidx = int(rt.bootstrap["process_index"])
-        n = comm.size
-        self.owner: List[int] = [
-            self.router.owner_of(comm.group.world_rank(i))
-            for i in range(n)
-        ]
-        self.local_ranks: List[int] = list(comm.local_comm_ranks)
-        self.local_n = len(self.local_ranks)
+        from ..runtime.wire import proc_topology
+
+        t = proc_topology(comm)  # the one shared layout derivation
+        self.router = t.router
+        self.my_pidx = t.my_pidx
+        self.owner = t.owner
+        self.local_ranks = t.local_ranks
+        self.local_n = t.local_n
         if base.shape[0] != self.local_n:
             raise MPIError(
                 ErrorCode.ERR_WIN,

@@ -134,7 +134,9 @@ def dropless_moe(comm, tokens, assignments, expert_fn, n_experts: int):
             f"{len(assignments)} assignments"
         )
     toks = [np.asarray(t) for t in tokens]
-    assign = [np.asarray(a).astype(np.int64) for a in assignments]
+    # int32: expert ids are tiny, and 64-bit buffers do not traverse
+    # the collectives under x64-off (the narrowing refusal)
+    assign = [np.asarray(a).astype(np.int32) for a in assignments]
     d = toks[0].shape[1] if toks[0].ndim == 2 else 1
 
     # sort each acting rank's tokens by destination rank (stable keeps
@@ -149,9 +151,11 @@ def dropless_moe(comm, tokens, assignments, expert_fn, n_experts: int):
         counts = local_counts
     else:
         # complete the (n, n) matrix: every process contributes its
-        # members' rows in comm-rank order
+        # members' rows in comm-rank order (int32 on the wire — token
+        # counts fit comfortably, and the hier path refuses int64
+        # under x64-off rather than narrowing silently)
         counts = np.asarray(
-            comm.allgather(local_counts)
+            comm.allgather(local_counts.astype(np.int32))
         )[0].reshape(n, n).astype(np.int64)
 
     sendbufs = [toks[pos][order[pos]].reshape(-1)

@@ -129,6 +129,15 @@ class TpurunEss(mca_component.Component):
         num_workers = int(os.environ["OMPITPU_NUM_NODES"])
         import socket
 
+        if (os.environ.get("OMPITPU_SECRET_STDIN") == "1"
+                and not os.environ.get("OMPITPU_JOB_SECRET")):
+            # rsh launches ship the job secret on stdin (a command-line
+            # env assignment would be world-readable via /proc); it
+            # must land before the first endpoint is created
+            import sys as _sys
+
+            os.environ["OMPITPU_JOB_SECRET"] = \
+                _sys.stdin.readline().strip()
         agent = coord.WorkerAgent(node_id, host, int(port))
         card = {
             "node_id": node_id,

@@ -59,6 +59,44 @@ WIRE_CTL_BASE = 4 << 20
 _ENV_MAGIC = "WPM1"
 
 
+class ProcTopology:
+    """Process/member layout of a communicator under the unified
+    world — ONE derivation shared by the hier collectives, the wire
+    windows, and two-phase collective IO (each previously re-derived
+    it; a change to ownership mapping must land exactly once)."""
+
+    __slots__ = ("router", "my_pidx", "owner", "procs", "members_of",
+                 "local_ranks", "local_n", "peers")
+
+    def __init__(self, comm) -> None:
+        rt = comm.runtime
+        self.router: "WireRouter" = rt.wire
+        self.my_pidx = int(rt.bootstrap["process_index"])
+        n = comm.size
+        self.owner: List[int] = [
+            self.router.owner_of(comm.group.world_rank(i))
+            for i in range(n)
+        ]
+        self.procs: List[int] = sorted(set(self.owner))
+        self.members_of: Dict[int, List[int]] = {
+            p: [i for i in range(n) if self.owner[i] == p]
+            for p in self.procs
+        }
+        self.local_ranks: List[int] = list(comm.local_comm_ranks)
+        self.local_n = len(self.local_ranks)
+        self.peers: List[int] = [p for p in self.procs
+                                 if p != self.my_pidx]
+
+
+def proc_topology(comm) -> ProcTopology:
+    """Cached per-communicator topology (the derivation is O(size x
+    procs) owner-span scans — pay it once per comm)."""
+    topo = getattr(comm, "_proc_topology", None)
+    if topo is None:
+        topo = comm._proc_topology = ProcTopology(comm)
+    return topo
+
+
 class WireRouter:
     """Per-runtime cross-process router over the worker's OOB endpoint."""
 

@@ -58,7 +58,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="hostname to evacuate (as it appears in the "
                          "job's allocation)")
     ap.add_argument("--hnp", default=None,
-                    help="target job's HNP at host:port")
+                    help="target job's HNP at host:port (supply its "
+                         "control-plane secret via --secret-file or "
+                         "the OMPITPU_JOB_SECRET env var)")
+    ap.add_argument("--secret-file", default=None,
+                    help="file holding the target job's control-plane "
+                         "secret (for --hnp; session-dir discovery "
+                         "reads it from the contact file)")
     ap.add_argument("--pid", type=int, default=None,
                     help="target job by launcher pid (session-dir "
                          "discovery)")
@@ -68,6 +74,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.hnp:
         host, port = args.hnp.rsplit(":", 1)
         port = int(port)
+        if args.secret_file:
+            with open(args.secret_file) as f:
+                secret = f.read().strip()
     else:
         from .tpu_ps import discover_jobs
 

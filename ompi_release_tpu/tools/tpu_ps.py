@@ -123,13 +123,18 @@ def render_job(info: Dict, snap: Optional[Dict]) -> str:
     return "\n".join(lines)
 
 
-def snapshot_all(hnp: Optional[str] = None) -> List[str]:
+def snapshot_all(hnp: Optional[str] = None,
+                 secret_file: Optional[str] = None) -> List[str]:
     """Rendered snapshots of every target job."""
     out = []
     if hnp:
         host, port = hnp.rsplit(":", 1)
-        targets = [{"host": host, "port": int(port), "pid": "?",
-                    "argv": [], "n": "?"}]
+        target = {"host": host, "port": int(port), "pid": "?",
+                  "argv": [], "n": "?"}
+        if secret_file:
+            with open(secret_file) as f:
+                target["secret"] = f.read().strip()
+        targets = [target]
     else:
         targets = discover_jobs()
     for info in targets:
@@ -155,9 +160,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "(orte-ps analogue)")
     ap.add_argument("--hnp", default=None,
                     help="query one job directly at host:port instead "
-                         "of discovering via the session dir")
+                         "of discovering via the session dir (the "
+                         "job's control plane is authenticated: supply "
+                         "its secret via --secret-file or the "
+                         "OMPITPU_JOB_SECRET env var)")
+    ap.add_argument("--secret-file", default=None,
+                    help="file holding the target job's control-plane "
+                         "secret (for --hnp; session-dir discovery "
+                         "reads it from the contact file)")
     args = ap.parse_args(argv)
-    snaps = snapshot_all(args.hnp)
+    snaps = snapshot_all(args.hnp, secret_file=args.secret_file)
     if not snaps:
         print("no live tpurun jobs found")
         return 0

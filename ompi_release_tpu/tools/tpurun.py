@@ -420,6 +420,7 @@ class Job:
 
     def _spawn(self, node_id: int) -> None:
         host = self.rank_hosts[node_id - 1]
+        secret_on_stdin = False
         if host.is_local:
             cmd = self.argv
             env = self._env_for(node_id)
@@ -427,22 +428,37 @@ class Job:
             # rsh launch (plm_rsh_module.c:929): agent + host + env
             # assignments + program. ssh joins the args and hands ONE
             # string to the remote shell, so every word is quoted
-            # (the reference's plm_rsh quotes its orted cmdline too)
+            # (the reference's plm_rsh quotes its orted cmdline too).
+            # The JOB SECRET must NOT ride the command line (visible to
+            # every local user via /proc/*/cmdline on both machines —
+            # defeating the auth it feeds); it travels on the worker's
+            # stdin instead, announced by OMPITPU_SECRET_STDIN
             import shlex
 
+            wire_env = dict(self._ompitpu_env(node_id))
+            wire_env.pop("OMPITPU_JOB_SECRET", None)
+            wire_env["OMPITPU_SECRET_STDIN"] = "1"
             cmd = (
                 self.launch_agent.split()
                 + [host.name, "env"]
                 + [shlex.quote(f"{k}={v}") for k, v in
-                   sorted(self._ompitpu_env(node_id).items())]
+                   sorted(wire_env.items())]
                 + [shlex.quote(a) for a in self.argv]
             )
             env = dict(os.environ)
+            secret_on_stdin = True
         p = subprocess.Popen(
             cmd, env=env,
+            stdin=subprocess.PIPE if secret_on_stdin else None,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, bufsize=1,
         )
+        if secret_on_stdin:
+            try:
+                p.stdin.write(self.secret + "\n")
+                p.stdin.flush()
+            except OSError:
+                pass  # a dead child surfaces through the waitpid loop
         self.procs[node_id] = p
         self.proc_state[node_id] = ProcState.RUNNING
         for stream, out in ((p.stdout, sys.stdout), (p.stderr, sys.stderr)):
