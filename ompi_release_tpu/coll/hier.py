@@ -543,8 +543,6 @@ class _HierModule:
         process-index-order inter combine — the allreduce discipline),
         each rank keeps its ``recvcounts[i]``-length segment. ``x`` is
         (local_n, total); returns one array per LOCAL member."""
-        if op.is_pair_op:
-            return _not_available("pair-op reduce_scatter")(comm)
         n = comm.size
         recvcounts = [int(k) for k in recvcounts]
         if len(recvcounts) != n or any(k < 0 for k in recvcounts):
@@ -553,6 +551,19 @@ class _HierModule:
                 f"reduce_scatter needs {n} non-negative counts",
             )
         total = sum(recvcounts)
+        if op.is_pair_op:
+            vals, idxs = x
+            self._check_local_axis(vals, "reduce_scatter")
+            tv, ti = self._combine_with_peers(
+                self._local_partial((vals, idxs), op), op
+            )
+            tv, ti = np.asarray(tv).reshape(-1), np.asarray(ti).reshape(-1)
+            offs = np.concatenate([[0], np.cumsum(recvcounts)])
+            return [
+                (jnp.asarray(tv[offs[r]:offs[r] + recvcounts[r]]),
+                 jnp.asarray(ti[offs[r]:offs[r] + recvcounts[r]]))
+                for r in self.local_ranks
+            ]
         x = np.asarray(x)
         from .driver import _check_no_narrowing
 

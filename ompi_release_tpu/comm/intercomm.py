@@ -411,13 +411,6 @@ class Intercommunicator(Communicator):
         raise MPIError(ErrorCode.ERR_COMM,
                        f"{what} is intra-communicator only")
 
-    def _not_inter(self, what: str):
-        raise MPIError(
-            ErrorCode.ERR_COMM,
-            f"{what} has no inter-communicator implementation here "
-            "(merge() to an intracommunicator first)",
-        )
-
     def scan(self, *a, **kw):
         self._intra_only("scan")
 
@@ -436,26 +429,152 @@ class Intercommunicator(Communicator):
             "split on intercommunicators is not supported (use merge)",
         )
 
-    def reduce_scatter_block(self, *a, **kw):
-        self._not_inter("reduce_scatter_block")
+    # -- inter v-variants (ragged; results land in the group
+    # complementary to the contributors, MPI inter semantics) -------------
+    def allgatherv(self, send_local, send_remote):
+        """Local ranks receive the REMOTE group's ragged buffers
+        concatenated in remote rank order (returned once — the driver
+        convention for uniform results). ``send_local`` feeds the
+        mirrored call and is validated here."""
+        self._check_alive()
+        self._check_counts(send_local, self.size, "allgatherv local")
+        self._check_counts(send_remote, self.remote_size,
+                           "allgatherv remote")
+        return self._remote_comm().allgatherv(list(send_remote))
 
-    def ireduce_scatter_block(self, *a, **kw):
-        self._not_inter("ireduce_scatter_block")
+    def gatherv(self, send_remote, root: int = 0):
+        """Local rank ``root`` receives the remote group's ragged
+        concatenation (root-agnostic driver convention, see
+        :meth:`reduce`)."""
+        self._check_alive()
+        if not 0 <= root < self.size:
+            raise MPIError(ErrorCode.ERR_ROOT,
+                           f"root {root} not in local group")
+        self._check_counts(send_remote, self.remote_size,
+                           "gatherv remote")
+        return self._remote_comm().allgatherv(list(send_remote))
 
-    def reduce_scatter(self, *a, **kw):
-        self._not_inter("reduce_scatter")
+    def scatterv(self, sendbuf, counts, root: int = 0):
+        """Remote rank ``root`` scatters ``counts[i]`` elements to
+        local rank i (ragged chunks; one array per local rank)."""
+        self._check_alive()
+        if not 0 <= root < self.remote_size:
+            raise MPIError(ErrorCode.ERR_ROOT,
+                           f"root {root} not in remote group")
+        return self._local_comm().scatterv(
+            np.asarray(sendbuf).reshape(-1), counts, root=0
+        )
 
-    def alltoallv(self, *a, **kw):
-        self._not_inter("alltoallv")
+    def reduce_scatter_block(self, send_remote, op=None):
+        """The remote group's contributions reduced elementwise, the
+        result split in equal blocks over the local ranks (leading
+        local axis, like the intra form)."""
+        self._check_alive()
+        import jax.numpy as jnp
 
-    def allgatherv(self, *a, **kw):
-        self._not_inter("allgatherv")
+        from .. import ops as ops_mod
 
-    def gatherv(self, *a, **kw):
-        self._not_inter("gatherv")
+        self._check_counts(send_remote, self.remote_size, "rsb remote")
+        red = np.asarray(self._remote_comm().allreduce(
+            np.asarray(send_remote), op or ops_mod.SUM
+        )[0])
+        n = self.size
+        if red.shape[0] % n:
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"reduce_scatter_block length {red.shape[0]} not "
+                f"divisible by local size {n}",
+            )
+        return jnp.asarray(red.reshape((n, -1) + red.shape[1:]))
 
-    def scatterv(self, *a, **kw):
-        self._not_inter("scatterv")
+    def reduce_scatter(self, send_remote, recvcounts, op=None):
+        """General inter reduce_scatter: local rank i keeps the
+        ``recvcounts[i]``-long segment of the remote group's
+        reduction. Returns one array per local rank."""
+        self._check_alive()
+        import jax.numpy as jnp
+
+        from .. import ops as ops_mod
+
+        recvcounts = [int(c) for c in recvcounts]
+        if len(recvcounts) != self.size or any(c < 0 for c in recvcounts):
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"reduce_scatter needs {self.size} non-negative counts",
+            )
+        self._check_counts(send_remote, self.remote_size, "rs remote")
+        red = np.asarray(self._remote_comm().allreduce(
+            np.asarray(send_remote), op or ops_mod.SUM
+        )[0]).reshape(-1)
+        if red.shape[0] != sum(recvcounts):
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"reduce_scatter buffer length {red.shape[0]} != "
+                f"counts sum {sum(recvcounts)}",
+            )
+        offs = np.concatenate([[0], np.cumsum(recvcounts)])
+        return [jnp.asarray(red[offs[i]:offs[i] + recvcounts[i]])
+                for i in range(self.size)]
+
+    def alltoallv(self, send_local, counts_local, send_remote,
+                  counts_remote):
+        """Inter alltoallv: local rank i sends ``counts_local[i][j]``
+        elements to remote rank j and receives remote rank j's chunk
+        for it. Returns ``recv[i]`` per local rank in remote-rank
+        order. Pure ragged edge slicing under one controller (the
+        compiled equal-block path is :meth:`alltoall`)."""
+        self._check_alive()
+        import jax.numpy as jnp
+
+        nl, nr = self.size, self.remote_size
+        self._check_counts(send_local, nl, "alltoallv local")
+        self._check_counts(send_remote, nr, "alltoallv remote")
+        cl = np.asarray(counts_local, np.int64).reshape(nl, nr)
+        cr = np.asarray(counts_remote, np.int64).reshape(nr, nl)
+        if (cl < 0).any() or (cr < 0).any():
+            raise MPIError(ErrorCode.ERR_COUNT,
+                           "alltoallv counts must be >= 0")
+        bufs_r = [np.asarray(b).reshape(-1) for b in send_remote]
+        for j in range(nr):
+            if bufs_r[j].shape[0] != int(cr[j].sum()):
+                raise MPIError(
+                    ErrorCode.ERR_COUNT,
+                    f"alltoallv remote rank {j}: buffer has "
+                    f"{bufs_r[j].shape[0]} elements, counts sum to "
+                    f"{int(cr[j].sum())}",
+                )
+        offs = np.concatenate(
+            [np.zeros((nr, 1), np.int64), np.cumsum(cr, axis=1)], axis=1
+        )
+        self._bridge.barrier()  # collective completion
+        return [
+            jnp.asarray(np.concatenate(
+                [bufs_r[j][offs[j, i]:offs[j, i] + int(cr[j, i])]
+                 for j in range(nr)]
+            ) if nr else np.zeros(0))
+            for i in range(nl)
+        ]
+
+    def iallgatherv(self, send_local, send_remote):
+        return self._async(self.allgatherv(send_local, send_remote))
+
+    def igatherv(self, send_remote, root: int = 0):
+        return self._async(self.gatherv(send_remote, root))
+
+    def iscatterv(self, sendbuf, counts, root: int = 0):
+        return self._async(self.scatterv(sendbuf, counts, root))
+
+    def ireduce_scatter_block(self, send_remote, op=None):
+        return self._async(self.reduce_scatter_block(send_remote, op))
+
+    def ireduce_scatter(self, send_remote, recvcounts, op=None):
+        return self._async(
+            self.reduce_scatter(send_remote, recvcounts, op))
+
+    def ialltoallv(self, send_local, counts_local, send_remote,
+                   counts_remote):
+        return self._async(self.alltoallv(
+            send_local, counts_local, send_remote, counts_remote))
 
     def __repr__(self) -> str:
         return (

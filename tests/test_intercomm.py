@@ -217,15 +217,74 @@ def test_inter_nonblocking_variants(pair):
 
 
 def test_inter_unimplemented_ops_raise(pair):
-    """Ops without an inter implementation must raise, not silently
+    """Intra-only ops must raise on an intercommunicator, not silently
     run with intra semantics over the local group."""
     a, b = pair
     ia, _ = intercomm_create(a, 0, b, 0)
     x = np.zeros((3, 4), np.float32)
-    for fn in (ia.reduce_scatter_block, ia.allgatherv, ia.alltoallv,
-               ia.gatherv, ia.scatterv, ia.iscan, ia.iexscan):
+    for fn in (ia.iscan, ia.iexscan, ia.scan, ia.exscan):
         with pytest.raises(MPIError):
             fn(x)
+
+
+def test_inter_v_variants(pair):
+    """The ragged inter collectives (MPI-2.2 inter semantics: results
+    land in the group complementary to the contributors)."""
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    nl, nr = ia.size, ia.remote_size  # 3, 5
+
+    send_b = [np.arange(j + 1, dtype=np.float32) + 10 * j
+              for j in range(nr)]
+    send_a = [np.arange(2, dtype=np.float32) for _ in range(nl)]
+    got = np.asarray(ia.allgatherv(send_a, send_b))
+    np.testing.assert_array_equal(got, np.concatenate(send_b))
+    got = np.asarray(ia.gatherv(send_b, root=1))
+    np.testing.assert_array_equal(got, np.concatenate(send_b))
+
+    counts = [2, 1, 3]
+    buf = np.arange(6, dtype=np.float32)
+    out = ia.scatterv(buf, counts, root=2)
+    offs = [0, 2, 3]
+    for i in range(nl):
+        np.testing.assert_array_equal(
+            np.asarray(out[i]), buf[offs[i]:offs[i] + counts[i]])
+
+    xs = np.stack([np.arange(6, dtype=np.float32) * (j + 1)
+                   for j in range(nr)])
+    want = xs.sum(0)
+    rsb = np.asarray(ia.reduce_scatter_block(xs))
+    assert rsb.shape[0] == nl
+    np.testing.assert_allclose(rsb.reshape(-1), want)
+
+    rc = [1, 2, 3]
+    rs = ia.reduce_scatter(xs, rc)
+    o = np.concatenate([[0], np.cumsum(rc)])
+    for i in range(nl):
+        np.testing.assert_allclose(np.asarray(rs[i]),
+                                   want[o[i]:o[i] + rc[i]])
+
+    cl = np.asarray([[(i + j) % 2 for j in range(nr)]
+                     for i in range(nl)])
+    cr = np.asarray([[(j + 2 * i) % 3 for i in range(nl)]
+                     for j in range(nr)])
+    sb_l = [np.full(int(cl[i].sum()), float(i), np.float32)
+            for i in range(nl)]
+    sb_r = [np.concatenate([np.full(int(cr[j, i]), 100 * j + i,
+                                    np.float32) for i in range(nl)])
+            for j in range(nr)]
+    rv = ia.alltoallv(sb_l, cl, sb_r, cr)
+    for i in range(nl):
+        want_i = np.concatenate(
+            [np.full(int(cr[j, i]), 100 * j + i, np.float32)
+             for j in range(nr)])
+        np.testing.assert_array_equal(np.asarray(rv[i]), want_i)
+
+    # nonblocking variant round-trips
+    req = ia.iallgatherv(send_a, send_b)
+    req.wait()
+    np.testing.assert_array_equal(np.asarray(req.value),
+                                  np.concatenate(send_b))
 
 
 def test_inter_p2p_remote_addressing(pair):

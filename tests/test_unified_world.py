@@ -338,6 +338,26 @@ class TestUnifiedWorld:
                 np.testing.assert_array_equal(
                     np.asarray(rs[i]), wantfull[offs[r]:offs[r] + rc[r]])
 
+            # pair-op (MINLOC) general reduce_scatter
+            from ompi_release_tpu import ops as _o
+            pv = np.stack([
+                np.roll(np.arange(tot, dtype=np.float32), off + i)
+                for i in range(4)])
+            pidx = np.full((4, tot), off, np.int32) \
+                + np.arange(4, dtype=np.int32)[:, None]
+            prs = world.reduce_scatter((pv, pidx), rc, _o.MINLOC)
+            fullv = np.stack([np.roll(np.arange(tot, dtype=np.float32),
+                                      r) for r in range(n)])
+            for i in range(4):
+                r = off + i
+                seg = slice(offs[r], offs[r] + rc[r])
+                vwant = fullv[:, seg].min(axis=0)
+                iwant = fullv[:, seg].argmin(axis=0)
+                np.testing.assert_array_equal(
+                    np.asarray(prs[i][0]), vwant)
+                np.testing.assert_array_equal(
+                    np.asarray(prs[i][1]), iwant)
+
             world.barrier()
             print(f"VCOLL-OK {off}")
             mpi.finalize()
