@@ -52,18 +52,6 @@ _inter_msgs = pvar.counter(
 )
 
 
-def _not_available(op_name: str) -> Callable:
-    def raiser(comm, *a, **k):
-        raise MPIError(
-            ErrorCode.ERR_NOT_AVAILABLE,
-            f"{op_name} is not yet supported on communicators spanning "
-            f"controller processes ({comm.name}); run it on a "
-            "process-local sub-communicator (split_type_shared)",
-        )
-
-    return raiser
-
-
 class _HierModule:
     """Two-level collectives over (process, local-member) subgroups."""
 

@@ -293,7 +293,9 @@ def reduce_scatter(comm, x, recvcounts: Sequence[int], op: Op, *,
     the segment of length ``recvcounts[i]``.
 
     ``x``: (size, total) — per-rank contribution rows,
-    total = sum(recvcounts). Returns one array per rank.
+    total = sum(recvcounts). Returns one array per rank. MINLOC/MAXLOC
+    pairs are accepted: ``x = (values, indices)`` and each returned
+    segment is a (values, indices) pair.
     """
     n = comm.size
     recvcounts = [int(k) for k in recvcounts]
@@ -302,6 +304,27 @@ def reduce_scatter(comm, x, recvcounts: Sequence[int], op: Op, *,
             ErrorCode.ERR_COUNT,
             f"reduce_scatter needs {n} non-negative counts",
         )
+    if op.is_pair_op:
+        vals, idxs = x
+        vals = np.asarray(vals)
+        total = sum(recvcounts)
+        if vals.shape[0] != n or vals.reshape(n, -1).shape[1] != total:
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"reduce_scatter needs values shaped ({n}, {total}), "
+                f"got {vals.shape}",
+            )
+        # the pair allreduce kernel does the reduction; segments are
+        # sliced at the driver edge (ragged counts never retrace)
+        rv, ri = comm.allreduce((vals.reshape(n, total),
+                                 np.asarray(idxs).reshape(n, total)), op)
+        rv0, ri0 = np.asarray(rv)[0], np.asarray(ri)[0]
+        offs = np.concatenate([[0], np.cumsum(recvcounts)])
+        return [
+            (jnp.asarray(rv0[offs[i]:offs[i] + recvcounts[i]]),
+             jnp.asarray(ri0[offs[i]:offs[i] + recvcounts[i]]))
+            for i in range(n)
+        ]
     x = np.asarray(x)
     total = sum(recvcounts)
     if x.shape[0] != n or x.reshape(n, -1).shape[1] != total:

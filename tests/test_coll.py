@@ -556,6 +556,26 @@ def test_64bit_narrowing_refused(world):
             np.ones((world.size, world.size), np.int64))
 
 
+def test_general_reduce_scatter_pair_op(world):
+    """General MPI_Reduce_scatter with MINLOC: uneven segments of the
+    elementwise (value, contributing-rank) minimum."""
+    n = world.size
+    vals = np.stack([np.roll(np.arange(10, dtype=np.float32), r)
+                     for r in range(n)])
+    idxs = np.zeros((n, 10), np.int32) \
+        + np.arange(n, dtype=np.int32)[:, None]
+    rc = [1, 2, 1, 2, 1, 1, 1, 1][:n]
+    rc[-1] += 10 - sum(rc)
+    out = world.reduce_scatter((vals, idxs), rc, ops.MINLOC)
+    offs = np.concatenate([[0], np.cumsum(rc)])
+    for i in range(n):
+        seg = slice(offs[i], offs[i] + rc[i])
+        np.testing.assert_array_equal(np.asarray(out[i][0]),
+                                      vals[:, seg].min(0))
+        np.testing.assert_array_equal(np.asarray(out[i][1]),
+                                      vals[:, seg].argmin(0))
+
+
 def test_scan_tuned(tuned):
     x = _per_rank(tuned, 20, seed=38)
     out = tuned.scan(x, ops.SUM)
