@@ -542,6 +542,13 @@ class _HierModule:
         if op.is_pair_op:
             vals, idxs = x
             self._check_local_axis(vals, "reduce_scatter")
+            vals = np.asarray(vals)
+            if vals.reshape(self.local_n, -1).shape[1] != total:
+                raise MPIError(
+                    ErrorCode.ERR_COUNT,
+                    f"reduce_scatter needs values shaped "
+                    f"({self.local_n}, {total}), got {vals.shape}",
+                )
             tv, ti = self._combine_with_peers(
                 self._local_partial((vals, idxs), op), op
             )

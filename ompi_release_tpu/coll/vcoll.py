@@ -306,18 +306,19 @@ def reduce_scatter(comm, x, recvcounts: Sequence[int], op: Op, *,
         )
     if op.is_pair_op:
         vals, idxs = x
-        vals = np.asarray(vals)
+        vals, idxs = np.asarray(vals), np.asarray(idxs)
         total = sum(recvcounts)
-        if vals.shape[0] != n or vals.reshape(n, -1).shape[1] != total:
-            raise MPIError(
-                ErrorCode.ERR_COUNT,
-                f"reduce_scatter needs values shaped ({n}, {total}), "
-                f"got {vals.shape}",
-            )
+        for nm, a in (("values", vals), ("indices", idxs)):
+            if a.shape[0] != n or a.reshape(n, -1).shape[1] != total:
+                raise MPIError(
+                    ErrorCode.ERR_COUNT,
+                    f"reduce_scatter needs {nm} shaped ({n}, {total}), "
+                    f"got {a.shape}",
+                )
         # the pair allreduce kernel does the reduction; segments are
         # sliced at the driver edge (ragged counts never retrace)
         rv, ri = comm.allreduce((vals.reshape(n, total),
-                                 np.asarray(idxs).reshape(n, total)), op)
+                                 idxs.reshape(n, total)), op)
         rv0, ri0 = np.asarray(rv)[0], np.asarray(ri)[0]
         offs = np.concatenate([[0], np.cumsum(recvcounts)])
         return [

@@ -546,7 +546,9 @@ class Intercommunicator(Communicator):
         offs = np.concatenate(
             [np.zeros((nr, 1), np.int64), np.cumsum(cr, axis=1)], axis=1
         )
-        self._bridge.barrier()  # collective completion
+        # no blocking barrier here: the sibling v-variants complete
+        # through their device results, and a barrier inside the
+        # blocking body would make ialltoallv synchronous
         return [
             jnp.asarray(np.concatenate(
                 [bufs_r[j][offs[j, i]:offs[j, i] + int(cr[j, i])]
