@@ -273,6 +273,23 @@ class Window:
         self._require(_EpochKind.LOCK)
         self._apply_pending()
 
+    def flush_local(self, target: int) -> None:
+        """MPI_Win_flush_local: local completion only. Buffers here are
+        immutable arrays (reusable the moment the op is queued), so
+        local completion is implied — but MPI still requires the epoch
+        check, and completing remotely too is allowed (stronger)."""
+        self.flush(target)
+
+    def flush_local_all(self) -> None:
+        self.flush_all()
+
+    def sync(self) -> None:
+        """MPI_Win_sync: synchronize public/private window copies. The
+        driver-mode window is MPI_WIN_UNIFIED with one storage array —
+        there is no second copy to reconcile (get_attr WIN_MODEL)."""
+        self._require(_EpochKind.FENCE, _EpochKind.LOCK,
+                      _EpochKind.PSCW, _EpochKind.NONE)
+
     # PSCW (generalized active target)
     def post(self, group) -> None:
         """Exposure epoch: this window's slices may be targeted by the
@@ -304,6 +321,17 @@ class Window:
             self._apply_pending()
             self._epoch = _EpochKind.NONE
         self._group_exposed = None
+
+    def test(self) -> bool:
+        """MPI_Win_test: nonblocking wait(). Single controller: every
+        origin's complete() has necessarily run by the time test() is
+        reachable, so a posted exposure tests complete (and closes,
+        like wait)."""
+        if self._group_exposed is None:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           "test() without a matching post()")
+        self.wait()
+        return True
 
     def free(self) -> None:
         if self._pending:

@@ -392,6 +392,13 @@ class WinService:
             table.setdefault(key, set()).add(pidx)
             self._pscw_cv.notify_all()
 
+    def pscw_check(self, table: Dict, key: Tuple[int, int],
+                   procs) -> bool:
+        """Non-consuming peek: have all of ``procs`` recorded their
+        notice? (MPI_Win_test's question.)"""
+        with self._pscw_cv:
+            return set(procs) <= table.get(key, set())
+
     def pscw_await(self, table: Dict, key: Tuple[int, int],
                    procs, what: str) -> None:
         """Block until every process in ``procs`` has recorded its
@@ -785,6 +792,20 @@ class WireWindow(Window):
             self._apply_pending()
             self._epoch = _EpochKind.NONE
         self._group_exposed = None
+
+    def test(self) -> bool:
+        """MPI_Win_test: True (and the exposure closes, like wait)
+        exactly when every accessor process's COMPLETE has arrived —
+        a non-consuming peek otherwise."""
+        if self._group_exposed is None:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           "test() without a matching post()")
+        accessors = self._procs_of_group(self._group_exposed)
+        if not self.service.pscw_check(self.service._completes,
+                                       self._key(), accessors):
+            return False
+        self.wait()  # consumes the notices; will not block
+        return True
 
     def free(self) -> None:
         super().free()

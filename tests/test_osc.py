@@ -221,6 +221,31 @@ class TestPSCW:
             np.asarray(win.read())[1], np.full(4, 6.0)
         )
 
+    def test_win_test_and_flush_local_and_sync(self, world, win):
+        """MPI_Win_test / flush_local(_all) / win_sync surface: test()
+        closes a completed exposure; flush_local completes locally
+        (epoch-checked); sync is a no-op under MPI_WIN_UNIFIED."""
+        from ompi_release_tpu.utils.errors import MPIError
+
+        with pytest.raises(MPIError):
+            win.test()  # no exposure posted
+        win.post(world.group)
+        win.start(world.group)
+        win.accumulate(np.float32(1.0), target=2)
+        win.complete()
+        assert win.test() is True
+        with pytest.raises(MPIError):
+            win.test()  # exposure already closed
+
+        win.lock(1)
+        win.put(np.full(4, 3.25, np.float32), 1)
+        win.flush_local(1)
+        win.flush_local_all()
+        win.unlock(1)
+        np.testing.assert_array_equal(
+            np.asarray(win.read())[1], np.full(4, 3.25))
+        win.sync()  # MPI_WIN_UNIFIED: one storage copy
+
 
 class TestCreate:
     def test_win_create_from_existing(self, world):

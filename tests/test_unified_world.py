@@ -610,7 +610,13 @@ class TestUnifiedWorld:
                                               np.full(4, 8.25))
             else:
                 win.post(origins)
-                win.wait()   # returns only after proc 0's complete
+                # MPI_Win_test polls without blocking until proc 0's
+                # COMPLETE notice lands, then closes like wait()
+                import time as _t
+                deadline = _t.monotonic() + 60
+                while not win.test():
+                    assert _t.monotonic() < deadline, "test() never true"
+                    _t.sleep(0.01)
                 got = np.asarray(win.read())[5 - 4]
                 np.testing.assert_array_equal(got, np.full(4, 5.5))
                 # reverse: proc 1 accesses proc 0's rank 2
