@@ -396,6 +396,34 @@ class Window:
         ``osc.h:310``); whole-slot elementwise otherwise."""
         return self.get_accumulate(value, target, op, index=index)
 
+    # -- request-based RMA (MPI-3 MPI_Rput/Rget/Raccumulate) ---------------
+    # Each returns a Request completable INSIDE the epoch (wait =
+    # per-op flush semantics, osc.h:341-366). get/get_accumulate are
+    # already request-based; the R-forms of put/accumulate attach a
+    # request that completes when the op applies (epoch close or
+    # flush), carrying the pre-op slice like the reference's
+    # origin-completion semantics allow.
+    def rput(self, data, target: int,
+             index: Optional[int] = None) -> Request:
+        req = Request()
+        self._queue(_PendingOp("put", target, jnp.asarray(data), REPLACE,
+                               request=req, index=index))
+        return req
+
+    def raccumulate(self, data, target: int, op: Op = SUM,
+                    index: Optional[int] = None) -> Request:
+        req = Request()
+        self._queue(_PendingOp("acc", target, jnp.asarray(data), op,
+                               request=req, index=index))
+        return req
+
+    def rget(self, target: int) -> Request:
+        return self.get(target)
+
+    def rget_accumulate(self, data, target: int, op: Op = SUM,
+                        index: Optional[int] = None) -> Request:
+        return self.get_accumulate(data, target, op, index=index)
+
     def compare_and_swap(self, value, compare, target: int,
                          index: Optional[int] = None) -> Request:
         """MPI_Compare_and_swap. With ``index``, true single-element

@@ -246,6 +246,21 @@ class TestPSCW:
             np.asarray(win.read())[1], np.full(4, 3.25))
         win.sync()  # MPI_WIN_UNIFIED: one storage copy
 
+    def test_request_based_rma(self, world, win):
+        """MPI_Rput/Raccumulate/Rget: requests completable inside the
+        epoch at flush, not only at its close."""
+        win.lock(3)
+        r1 = win.rput(np.full(4, 2.0, np.float32), 3)
+        r2 = win.raccumulate(np.full(4, 0.5, np.float32), 3)
+        assert not r1.is_complete and not r2.is_complete
+        win.flush(3)
+        assert r1.is_complete and r2.is_complete
+        r3 = win.rget(3)
+        win.flush(3)
+        np.testing.assert_array_equal(np.asarray(r3.value),
+                                      np.full(4, 2.5))
+        win.unlock(3)
+
 
 class TestCreate:
     def test_win_create_from_existing(self, world):

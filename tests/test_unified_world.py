@@ -558,6 +558,13 @@ class TestUnifiedWorld:
                 old = np.asarray(req.value)
                 win.unlock(6)
                 np.testing.assert_array_equal(old, np.full(4, 2.0))
+                # request-based RMA completes at flush across the wire
+                win.lock(5)
+                rr = win.rput(np.full(4, 1.25, np.float32), 5)
+                assert not rr.is_complete
+                win.flush(5)
+                assert rr.is_complete
+                win.unlock(5)
             world.barrier()
             if off == 4:
                 got = np.asarray(win.read())[6 - 4]
