@@ -142,6 +142,7 @@ class Window:
         self._group_exposed = None  # PSCW exposure group
         self._freed = False
         self._flavor = FLAVOR_CREATE  # constructors override
+        self._attrs: Dict[int, object] = {}  # user keyvals (win_keyval)
 
     # -- queries -----------------------------------------------------------
     @property
@@ -157,10 +158,26 @@ class Window:
         or after a flush; driver mode sees every rank's slice)."""
         return self._data
 
-    def get_attr(self, key: str):
-        """MPI_Win_get_attr for the predefined attributes
-        (``ompi/win/win.c`` WIN_BASE..WIN_MODEL): returns
-        (found, value).  MPI's view is per-process: WIN_SIZE /
+    def set_attr(self, keyval, value) -> None:
+        """MPI_Win_set_attr with a user keyval (the same Keyval
+        objects ``comm.create_keyval`` mints — ``win.c`` shares one
+        attribute machinery across comm/win/datatype)."""
+        if self._freed:
+            raise MPIError(ErrorCode.ERR_WIN, f"{self.name} freed")
+        self._attrs[keyval.id] = value
+
+    def delete_attr(self, keyval) -> None:
+        from ..comm.communicator import _keyval_table
+
+        kv = _keyval_table.get(keyval.id)
+        value = self._attrs.pop(keyval.id, None)
+        if kv is not None and kv.delete_fn is not None and value is not None:
+            kv.delete_fn(self, kv, value, kv.extra_state)
+
+    def get_attr(self, key):
+        """MPI_Win_get_attr: predefined string attributes
+        (``ompi/win/win.c`` WIN_BASE..WIN_MODEL) or a user Keyval;
+        returns (found, value).  MPI's view is per-process: WIN_SIZE /
         WIN_DISP_UNIT describe ONE rank's window (block bytes,
         element size).  WIN_BASE in driver mode is the whole
         (comm.size, ...) storage — one controller plays every rank,
@@ -168,6 +185,10 @@ class Window:
         only (no device access)."""
         import math
 
+        if not isinstance(key, str):  # user keyval
+            if key.id in self._attrs:
+                return True, self._attrs[key.id]
+            return False, None
         if key == WIN_BASE:
             return True, self._data
         if key == WIN_SIZE:

@@ -246,6 +246,30 @@ class TestPSCW:
             np.asarray(win.read())[1], np.full(4, 3.25))
         win.sync()  # MPI_WIN_UNIFIED: one storage copy
 
+    def test_win_user_keyvals(self, world, win):
+        """User keyvals on windows share the comm keyval machinery
+        (win.c's single attribute system)."""
+        from ompi_release_tpu.comm.communicator import (create_keyval,
+                                                        free_keyval)
+
+        deleted = []
+        kv = create_keyval(
+            delete_fn=lambda w, k, v, es: deleted.append(v))
+        try:
+            found, _ = win.get_attr(kv)
+            assert not found
+            win.set_attr(kv, {"tag": 42})
+            found, v = win.get_attr(kv)
+            assert found and v == {"tag": 42}
+            win.delete_attr(kv)
+            assert deleted == [{"tag": 42}]
+            assert win.get_attr(kv) == (False, None)
+            # predefined string attrs still answer
+            found, model = win.get_attr("win_model")
+            assert found
+        finally:
+            free_keyval(kv)
+
     def test_request_based_rma(self, world, win):
         """MPI_Rput/Raccumulate/Rget: requests completable inside the
         epoch at flush, not only at its close."""
