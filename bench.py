@@ -621,11 +621,12 @@ def _init_backend(jax, attempts=3, first_delay=5.0,
                 _api.clear_backends()
             except Exception:
                 pass
+    # retries exhausted: the caller falls back to the CPU backend and
+    # labels its lines, instead of a bare bench_error (the trajectory
+    # stays non-empty); the marker below is informational only
     print(json.dumps({
-        "metric": "bench_error", "value": None, "unit": None,
-        "vs_baseline": None, "error": "tpu_unavailable",
-        "detail": last[:300],
-    }))
+        "event": "tpu_unavailable", "detail": last[:300],
+    }), file=sys.stderr)
     return None
 
 
@@ -652,16 +653,45 @@ def _arm_global_watchdog(budget_s=1500.0):
     return t
 
 
+def _pvar_snapshot():
+    """Current pvar values, JSON-ready (per-config observability)."""
+    try:
+        import ompi_release_tpu.obs  # noqa: F401  journal pvars exist
+        from ompi_release_tpu.mca import pvar as _pvar_mod
+
+        return _pvar_mod.PVARS.read_all()
+    except Exception:
+        return {}
+
+
 def main():
     import jax
     import jax.numpy as jnp
 
+    from ompi_release_tpu.utils import jaxcompat
+
+    jaxcompat.install()  # jax.shard_map/typeof/pvary on 0.4.x jaxlibs
     watchdog = _arm_global_watchdog()
     devices = _init_backend(jax)
+    backend_label = None
     if devices is None:
-        return 0
+        # tpu_unavailable: emit the CPU-backend numbers, labelled, so
+        # the round record carries data instead of a bare bench_error
+        try:
+            devices = jax.devices("cpu")
+            backend_label = "cpu"
+            print(json.dumps({"event": "tpu_unavailable",
+                              "fallback": "cpu"}), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "metric": "bench_error", "value": None, "unit": None,
+                "vs_baseline": None, "error": "tpu_unavailable",
+                "detail": f"cpu fallback failed: "
+                          f"{type(e).__name__}: {e}"[:300],
+            }))
+            return 0
     n = len(devices)
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = backend_label is None and jax.default_backend() == "tpu"
 
     if n >= 2:
         specs, ceiling_names = _mesh_specs(jax, jnp, devices, on_tpu)
@@ -812,9 +842,20 @@ def main():
             "error": f"{type(e).__name__}: {e}"[:200],
         })
 
+    # ONE cumulative snapshot: the configs run interleaved (see
+    # _run_rounds), so per-config pvar deltas do not exist — emitting
+    # the same blob per line would only masquerade as them
+    snapshot = json.dumps(
+        {"pvars": _pvar_snapshot(), "cumulative": True}, default=str
+    )
     for ln in lines:
+        if backend_label:
+            ln["backend"] = backend_label
         print(json.dumps(ln))
-    print(json.dumps(headline))
+    if backend_label:
+        headline["backend"] = backend_label
+    print(snapshot)
+    print(json.dumps(headline))  # headline stays the LAST line
     watchdog.cancel()
 
 

@@ -17,7 +17,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..utils import jaxcompat as _jaxcompat
+
+_jaxcompat.install()  # jax.shard_map on 0.4.x jaxlibs
+
+from .. import obs as _obs
 from ..mca import pvar
+from ..obs import skew as _skew
 
 _invoke_count = pvar.counter(
     "coll_invocations", "host-driver collective invocations"
@@ -25,6 +31,21 @@ _invoke_count = pvar.counter(
 _compile_count = pvar.counter(
     "coll_programs_compiled", "distinct compiled collective programs"
 )
+
+
+def _op_name(key: Tuple) -> str:
+    """Collective-op label from a program-cache key — keys are
+    (component, op, ...) tuples by convention throughout coll/."""
+    if isinstance(key, tuple) and len(key) > 1 and isinstance(key[1], str):
+        return key[1]
+    return str(key[0]) if isinstance(key, tuple) and key else str(key)
+
+
+def _arr_nbytes(x) -> int:
+    try:
+        return int(x.size) * int(x.dtype.itemsize)
+    except (AttributeError, TypeError):
+        return 0
 
 
 def _program_cache(comm) -> Dict[Tuple, Callable]:
@@ -44,6 +65,8 @@ def run_sharded2d(comm, key: Tuple, body: Callable, x, *,
     from jax.sharding import Mesh
 
     _invoke_count.add()
+    tok = (_skew.begin(_op_name(key), getattr(comm, "cid", -1))
+           if _obs.enabled else None)
     if x.shape[0] != comm.size or inter * intra != comm.size:
         from ..utils.errors import ErrorCode, MPIError
 
@@ -72,7 +95,12 @@ def run_sharded2d(comm, key: Tuple, body: Callable, x, *,
             )
         )
         cache[key] = prog
-    return prog(jnp.asarray(x))
+    if tok is None:
+        return prog(jnp.asarray(x))
+    _skew.body(tok)
+    out = prog(jnp.asarray(x))
+    _skew.end(tok, _arr_nbytes(x))
+    return out
 
 
 def _local_rank_count(comm) -> int:
@@ -99,6 +127,8 @@ def run_sharded_spmd(comm, key: Tuple, body: Callable, local_x) -> Any:
     from jax.sharding import PartitionSpec as _P
 
     _invoke_count.add()
+    tok = (_skew.begin(_op_name(key), getattr(comm, "cid", -1))
+           if _obs.enabled else None)
     mesh = comm.submesh
     sharding = NamedSharding(mesh, _P("rank"))
     local_x = _np.asarray(local_x)
@@ -120,7 +150,11 @@ def run_sharded_spmd(comm, key: Tuple, body: Callable, local_x) -> Any:
                           out_specs=P("rank"))
         )
         cache[key] = prog
+    if tok is not None:
+        _skew.body(tok)
     out = prog(garr)
+    if tok is not None:
+        _skew.end(tok, _arr_nbytes(local_x))
 
     def to_local(a):
         shards = sorted(a.addressable_shards,
@@ -169,6 +203,8 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
     apply there because no controller holds every rank's slice.
     """
     _invoke_count.add()
+    tok = (_skew.begin(_op_name(key), getattr(comm, "cid", -1))
+           if _obs.enabled else None)
     if getattr(comm, "spans_processes", False):
         from ..utils.errors import ErrorCode, MPIError
 
@@ -229,4 +265,11 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
             )
         )
         cache[key] = prog
-    return prog(jnp.asarray(x), *[jnp.asarray(e) for e in extra_arrays])
+    if tok is None:
+        return prog(jnp.asarray(x), *[jnp.asarray(e) for e in extra_arrays])
+    # skew emit point: wait = arrival -> program launch (cache lookup /
+    # compile / validation), body = the dispatch itself
+    _skew.body(tok)
+    out = prog(jnp.asarray(x), *[jnp.asarray(e) for e in extra_arrays])
+    _skew.end(tok, _arr_nbytes(x))
+    return out

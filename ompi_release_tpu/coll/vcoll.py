@@ -27,6 +27,7 @@ every rank are returned once.
 
 from __future__ import annotations
 
+import time as _time
 from typing import List, Sequence
 
 import numpy as np
@@ -41,6 +42,7 @@ from .driver import run_sharded
 
 AXIS = "rank"
 
+from .. import obs as _obs  # noqa: E402
 from ..mca import pvar as _pvar  # noqa: E402
 
 _padded_elems = _pvar.counter(
@@ -132,6 +134,8 @@ def alltoallv(comm, sendbufs: Sequence, sendcounts, *,
     traverse a kernel or transport), accounted in the
     ``vcoll_alltoallv_overflow_elems`` pvar.
     """
+    rec = _obs.enabled  # capture once: flag may flip mid-call
+    t_edge = _time.perf_counter() if rec else 0.0
     n = comm.size
     bufs = _as_1d_arrays(sendbufs, n, "alltoallv")
     c = _counts_matrix(sendcounts, n)
@@ -182,6 +186,14 @@ def alltoallv(comm, sendbufs: Sequence, sendcounts, *,
             parts.append(part)
         recv.append(jnp.asarray(np.concatenate(parts) if parts
                                 else np.zeros((0,), dtype)))
+    if rec:
+        # whole-edge span (pad + kernel + overflow delivery); the
+        # kernel's own coll-layer span nests inside it in the trace
+        _obs.record(
+            "alltoallv", "vcoll", t_edge, _time.perf_counter() - t_edge,
+            nbytes=int((n * n * cap + overflow_elems) * dtype.itemsize),
+            comm_id=comm.cid,
+        )
     return recv
 
 
@@ -192,6 +204,8 @@ def alltoallv(comm, sendbufs: Sequence, sendcounts, *,
 def allgatherv(comm, sendbufs: Sequence, *, kernel: str = "lax"):
     """Concatenate every rank's (ragged) buffer in rank order; the
     result is identical on all ranks, returned once."""
+    rec = _obs.enabled
+    t_edge = _time.perf_counter() if rec else 0.0
     n = comm.size
     bufs = _as_1d_arrays(sendbufs, n, "allgatherv")
     counts = [b.shape[0] for b in bufs]
@@ -212,9 +226,15 @@ def allgatherv(comm, sendbufs: Sequence, *, kernel: str = "lax"):
     # (n, n, cmax): row r is rank r's gathered copy; all rows identical
     # — fetch only rank 0's shard, not n replicated copies
     g = np.asarray(out[0])
-    return jnp.asarray(
+    result = jnp.asarray(
         np.concatenate([g[i, : counts[i]] for i in range(n)])
     )
+    if rec:
+        _obs.record("allgatherv", "vcoll", t_edge,
+                    _time.perf_counter() - t_edge,
+                    nbytes=int(n * cmax * dtype.itemsize),
+                    comm_id=comm.cid)
+    return result
 
 
 def gatherv(comm, sendbufs: Sequence, root: int, *, kernel: str = "lax"):

@@ -18,6 +18,7 @@ import io as _io
 import json
 import os
 import threading
+import time as _time
 import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
@@ -26,6 +27,7 @@ import numpy as np
 
 import jax
 
+from .. import obs as _obs
 from ..mca import pvar
 from ..mca import var as mca_var
 from ..utils import output
@@ -126,6 +128,8 @@ def save_sharded(path: str, x, *, name: str = "array",
         xflat = x.reshape(-1)
 
     def write_one(i: int) -> int:
+        rec = _obs.enabled  # capture once: flag may flip mid-write
+        t0 = _time.perf_counter() if rec else 0.0
         src = (xflat[bounds[i]:bounds[i + 1]] if layout == "flat"
                else x[i])
         block = np.asarray(
@@ -141,6 +145,10 @@ def save_sharded(path: str, x, *, name: str = "array",
         with opener(fn, "wb") as f:
             f.write(raw)
         _bytes_written.add(block.nbytes)
+        if rec:  # per-shard write incl. device pull + disk
+            _obs.record("shard_write", "io", t0,
+                        _time.perf_counter() - t0, nbytes=block.nbytes,
+                        peer=i)
         return block.nbytes
 
     ex = _executor()
@@ -183,6 +191,8 @@ def load_sharded(path: str, *, name: str = "array"):
     crcs = manifest.get("crc32")
 
     def read_one(i: int) -> np.ndarray:
+        rec = _obs.enabled
+        t0 = _time.perf_counter() if rec else 0.0
         fn = os.path.join(path, f"{manifest['name']}.shard{i:05d}.npy")
         opener = gzip.open if compress == "gzip" else open
         with opener(fn, "rb") as f:
@@ -197,6 +207,10 @@ def load_sharded(path: str, *, name: str = "array"):
                 )
         block = np.load(_io.BytesIO(raw))
         _bytes_read.add(block.nbytes)
+        if rec:
+            _obs.record("shard_read", "io", t0,
+                        _time.perf_counter() - t0, nbytes=block.nbytes,
+                        peer=i)
         return block
 
     ex = _executor()

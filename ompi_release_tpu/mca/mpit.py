@@ -37,11 +37,30 @@ class CvarHandle:
         return self._var.describe()
 
 
+def _session_delta(cur: Any, base: Any) -> Any:
+    """Session-relative value since the handle's start snapshot.
+
+    Scalars subtract; structured reads (HISTOGRAM/AGGREGATE) subtract
+    elementwise — counts, sums, and per-bucket counts are cumulative so
+    deltas are meaningful, while extrema ("min"/"max") are not
+    invertible over a window and pass through as current values.
+    """
+    if isinstance(cur, dict):
+        bd = base if isinstance(base, dict) else {}
+        return {
+            k: (v if k in ("min", "max") else _session_delta(v, bd.get(k, 0)))
+            for k, v in cur.items()
+        }
+    if isinstance(cur, (int, float)) and isinstance(base, (int, float)):
+        return float(cur) - float(base)
+    return cur
+
+
 class PvarHandle:
     def __init__(self, session: "PvarSession", pv) -> None:
         self._session = session
         self._pv = pv
-        self._base: float = 0.0
+        self._base: Any = 0.0
         self._started = False
 
     @property
@@ -49,19 +68,22 @@ class PvarHandle:
         return self._pv.name
 
     def start(self) -> None:
-        self._base = float(self._pv.read())
+        self._base = self._pv.read()
         self._started = True
 
     def stop(self) -> None:
         self._started = False
 
-    def read(self) -> float:
-        """Session-relative when started (delta since start)."""
-        v = float(self._pv.read())
-        return v - self._base if self._started else v
+    def read(self) -> Any:
+        """Session-relative when started (delta since start); scalar
+        pvars read as float, HISTOGRAM/AGGREGATE as their dict form."""
+        v = self._pv.read()
+        if self._started:
+            return _session_delta(v, self._base)
+        return float(v) if isinstance(v, (int, float)) else v
 
     def reset(self) -> None:
-        self._base = float(self._pv.read())
+        self._base = self._pv.read()
 
 
 class PvarSession:

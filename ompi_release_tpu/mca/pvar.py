@@ -9,6 +9,7 @@ them without recompiling anything.
 from __future__ import annotations
 
 import enum
+import math
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -20,6 +21,8 @@ class PvarClass(enum.Enum):
     HIGHWATERMARK = "highwatermark"
     TIMER = "timer"            # accumulated seconds
     STATE = "state"            # discrete state value
+    HISTOGRAM = "histogram"    # log2-bucketed distribution
+    AGGREGATE = "aggregate"    # count/sum/min/max summary
 
 
 class Pvar:
@@ -70,6 +73,104 @@ class Pvar:
         return Pvar._TimerCtx(self)
 
 
+class Aggregate(Pvar):
+    """count/sum/min/max summary pvar (the MPI_T aggregate class).
+
+    The ``*_locked`` helpers let :class:`Histogram` extend the summary
+    under ONE lock acquisition (``self._lock`` is not reentrant).
+    """
+
+    def __init__(self, name: str, help: str = "",
+                 pclass: PvarClass = PvarClass.AGGREGATE) -> None:
+        super().__init__(name, pclass, help)
+        self._count = 0
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+
+    def _observe_locked(self, v: float) -> None:
+        self._count += 1
+        self._sum += v
+        self._min = v if self._min is None else min(self._min, v)
+        self._max = v if self._max is None else max(self._max, v)
+
+    def _read_locked(self) -> Dict[str, Any]:
+        return {
+            "count": self._count, "sum": self._sum,
+            "min": 0.0 if self._min is None else self._min,
+            "max": 0.0 if self._max is None else self._max,
+        }
+
+    def _reset_locked(self) -> None:
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._observe_locked(v)
+
+    # generic bump (pvar-agnostic call sites) records an observation
+    def add(self, delta: float = 1) -> None:
+        self.observe(delta)
+
+    def read(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._read_locked()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._reset_locked()
+
+
+class Histogram(Aggregate):
+    """Log2-bucketed distribution pvar (latencies, message sizes).
+
+    ``observe(v)`` files v > 0 under the bucket whose upper bound is
+    the smallest power of two >= v (exponent via ``frexp`` — no float
+    log rounding at the boundaries); v <= 0 counts under the 0-bound
+    bucket. ``read()`` returns the Aggregate summary plus ``buckets``
+    mapping each upper bound to its *per-bucket* (non-cumulative)
+    count; the Prometheus exporter cumulates at exposition time.
+    """
+
+    def __init__(self, name: str, help: str = "") -> None:
+        super().__init__(name, help, PvarClass.HISTOGRAM)
+        self._exp: Dict[int, int] = {}  # e -> count of v in (2^(e-1), 2^e]
+        self._zero = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._observe_locked(v)
+            if v <= 0:
+                self._zero += 1
+                return
+            m, e = math.frexp(v)  # v = m * 2**e with 0.5 <= m < 1
+            if m == 0.5:  # exact power of two belongs to the bucket below
+                e -= 1
+            self._exp[e] = self._exp.get(e, 0) + 1
+
+    def read(self) -> Dict[str, Any]:
+        with self._lock:
+            out = self._read_locked()
+            buckets: Dict[float, int] = {}
+            if self._zero:
+                buckets[0.0] = self._zero
+            for e in sorted(self._exp):
+                buckets[float(2.0 ** e)] = self._exp[e]
+            out["buckets"] = buckets
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._reset_locked()
+            self._exp.clear()
+            self._zero = 0
+
+
 class PvarRegistry:
     def __init__(self) -> None:
         self._pvars: Dict[str, Pvar] = {}
@@ -80,7 +181,12 @@ class PvarRegistry:
         with self._lock:
             if name in self._pvars:
                 return self._pvars[name]
-            pv = Pvar(name, pclass, help, getter)
+            if pclass is PvarClass.HISTOGRAM:
+                pv: Pvar = Histogram(name, help)
+            elif pclass is PvarClass.AGGREGATE:
+                pv = Aggregate(name, help)
+            else:
+                pv = Pvar(name, pclass, help, getter)
             self._pvars[name] = pv
             return pv
 
@@ -123,3 +229,15 @@ def timer(name: str, help: str = "") -> Pvar:
 
 def highwatermark(name: str, help: str = "") -> Pvar:
     return PVARS.register(name, PvarClass.HIGHWATERMARK, help)
+
+
+def histogram(name: str, help: str = "") -> Histogram:
+    pv = PVARS.register(name, PvarClass.HISTOGRAM, help)
+    assert isinstance(pv, Histogram), f"{name} registered as {pv.pclass}"
+    return pv
+
+
+def aggregate(name: str, help: str = "") -> Aggregate:
+    pv = PVARS.register(name, PvarClass.AGGREGATE, help)
+    assert isinstance(pv, Aggregate), f"{name} registered as {pv.pclass}"
+    return pv

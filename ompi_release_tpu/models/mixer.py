@@ -36,6 +36,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils import jaxcompat as _jaxcompat
+
+_jaxcompat.install()  # jax.shard_map/typeof on 0.4.x jaxlibs
+
 from ..parallel import tp as tp_mod
 
 

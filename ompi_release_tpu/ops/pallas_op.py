@@ -39,6 +39,10 @@ import jax.numpy as jnp
 
 from ..mca import component as mca_component
 
+from ..utils import jaxcompat as _jaxcompat
+
+_jaxcompat.install()  # jax.typeof/ShapeDtypeStruct-vma on 0.4.x jaxlibs
+
 #: measured-optimal f32 block shapes (rows, cols)
 AXPY_BLOCK: Tuple[int, int] = (256, 2048)
 SCALE_BLOCK: Tuple[int, int] = (128, 2048)

@@ -9,7 +9,10 @@ per event; the hooks cost one dict lookup when no subscriber exists.
 
 from __future__ import annotations
 
+import time as _time
 from typing import Callable, Dict, List
+
+from .. import obs as _obs
 
 # event names (the PERUSE_COMM_* set that maps onto this engine)
 REQ_ACTIVATE = "req_activate"        # send/recv posted
@@ -37,6 +40,15 @@ def unsubscribe_all(comm) -> None:
 
 
 def fire(comm, event: str, **info) -> None:
+    if _obs.enabled:
+        # PERUSE and the journal are one stream: every fired event is
+        # also an instant span (nbytes carries the event's element
+        # count, as fired)
+        dst = info.get("dst")
+        _obs.record(event, "peruse", _time.perf_counter(), 0.0,
+                    nbytes=int(info.get("count", 0) or 0),
+                    peer=dst if isinstance(dst, int) else -1,
+                    comm_id=comm.cid)
     subs = _subscribers.get(comm.cid)
     if not subs:
         return

@@ -22,8 +22,10 @@ from __future__ import annotations
 
 import collections
 import threading
+import time as _time
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
+from .. import obs as _obs
 from ..mca import component as mca_component
 from ..mca import pvar
 from ..mca import var as mca_var
@@ -195,6 +197,10 @@ class PmlEngine:
         self._check_rank(dst, "destination")
         self._check_rank(src, "source")
         data = _as_device_payload(data)
+        if _obs.enabled:  # instant emit point: the send posting itself
+            _obs.record("isend", "pml", _time.perf_counter(), 0.0,
+                        nbytes=self._nbytes(data), peer=dst,
+                        comm_id=self.comm.cid)
         req = Request()
         entry = _SendEntry(src, dst, tag, data, req, sync)
         from . import peruse
@@ -262,6 +268,9 @@ class PmlEngine:
         self._check_rank(dst, "destination")
         if source != ANY_SOURCE:
             self._check_rank(source, "source")
+        if _obs.enabled:
+            _obs.record("irecv", "pml", _time.perf_counter(), 0.0,
+                        peer=source, comm_id=self.comm.cid)
         req = Request()
         entry = _RecvEntry(dst, source, tag, req)
         from . import peruse
@@ -390,6 +399,8 @@ class PmlEngine:
     def _deliver(self, send: _SendEntry, recv: _RecvEntry) -> None:
         from . import peruse
 
+        rec = _obs.enabled  # capture once: flag may flip mid-delivery
+        t0 = _time.perf_counter() if rec else 0.0
         data = send.data
         if not send.transferred:
             peruse.fire(self.comm, peruse.REQ_XFER_BEGIN, src=send.src,
@@ -402,6 +413,10 @@ class PmlEngine:
                     dst=recv.dst, tag=send.tag, count=int(data.size))
         peruse.fire(self.comm, peruse.REQ_COMPLETE, src=send.src,
                     dst=recv.dst, tag=send.tag)
+        if rec:  # matched delivery incl. any rendezvous pull
+            _obs.record("deliver", "pml", t0, _time.perf_counter() - t0,
+                        nbytes=self._nbytes(data), peer=send.src,
+                        comm_id=self.comm.cid)
         _log.verbose(
             3,
             f"{self.comm.name}: delivered src={send.src} dst={send.dst} "

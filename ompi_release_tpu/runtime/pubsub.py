@@ -38,6 +38,9 @@ class PubsubTable:
         self.names: Dict[str, str] = {}
         # service -> [(client_id, seq, expire_at)]
         self.waiters: Dict[str, List[Tuple[int, int, float]]] = {}
+        # per-instance so subclasses can serve extra RPCs (the
+        # tpu_server metrics page) without widening every host
+        self.serve_tags: List[int] = list(SERVE_TAGS)
 
     def _reply(self, nid: int, seq: int, ok: bool, value: str) -> None:
         frame = DssBuffer()
@@ -92,7 +95,7 @@ class PubsubTable:
         """One serve iteration: prune, then drain one frame per tag.
         One malformed frame must not kill the service."""
         self.prune()
-        for tag in SERVE_TAGS:
+        for tag in self.serve_tags:
             try:
                 src, _, raw = self.ep.recv(tag=tag,
                                            timeout_ms=timeout_ms)

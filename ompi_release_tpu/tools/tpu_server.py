@@ -9,6 +9,12 @@ serves names for its OWN workers; this tool is the job-independent
 server: any process (from any job) connects with a :class:`NameClient`
 and publishes/looks up over the same seq-correlated frame protocol.
 
+Beyond names, the server answers a ``metrics`` RPC (TAG_METRICS): the
+Prometheus text exposition of every pvar registered in the server
+process (``obs/export.py``), so ``tpu_top --metrics host:port`` (or
+any scraper speaking the frame protocol) can watch the observability
+plane live.
+
 Usage::
 
     python -m ompi_release_tpu.tools.tpu_server [--port P] [--bind A]
@@ -17,6 +23,7 @@ Usage::
     client = NameClient("hostA", 45123)
     client.publish("my-service", port_str)
     port = client.lookup("my-service", timeout_ms=20000)
+    page = client.metrics()          # Prometheus text page
 """
 
 from __future__ import annotations
@@ -27,13 +34,35 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
-from ..native import OobEndpoint
+from ..native import DssBuffer, OobEndpoint
 from ..runtime.coordinator import local_addr_toward
-from ..runtime.pubsub import TAG_LOOKUP, TAG_PUBLISH, TAG_UNPUBLISH
+from ..runtime.pubsub import (PubsubTable, TAG_LOOKUP, TAG_PUBLISH,
+                              TAG_UNPUBLISH)
 from ..utils import output
 from ..utils.errors import ErrorCode, MPIError
 
 _log = output.stream("tpu-server")
+
+TAG_METRICS = 13  # client->server: Prometheus pvar exposition request
+
+
+class MetricsPubsubTable(PubsubTable):
+    """Name table + the ``metrics`` RPC: TAG_METRICS frames (seq only)
+    are answered with the Prometheus text page of every pvar registered
+    in this process, over the same seq-correlated reply channel."""
+
+    def __init__(self, ep) -> None:
+        super().__init__(ep)
+        self.serve_tags.append(TAG_METRICS)
+
+    def handle(self, tag: int, src: int, raw: bytes) -> None:
+        if tag != TAG_METRICS:
+            return super().handle(tag, src, raw)
+        b = DssBuffer(raw)
+        (seq,) = b.unpack_int64()
+        from ..obs import export as obs_export
+
+        self._reply(src, seq, True, obs_export.prometheus_text())
 
 
 class NameServer:
@@ -41,10 +70,8 @@ class NameServer:
     protocol on its own endpoint (no job attached)."""
 
     def __init__(self, port: int = 0, bind_addr: str = "127.0.0.1") -> None:
-        from ..runtime.pubsub import PubsubTable
-
         self.ep = OobEndpoint(0, port, bind_addr)
-        self._table = PubsubTable(self.ep)
+        self._table = MetricsPubsubTable(self.ep)
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._table.serve_loop, args=(self._stop,),
@@ -103,6 +130,13 @@ class NameClient:
         if not ok:
             raise MPIError(ErrorCode.ERR_NAME,
                            f"unpublish '{service}': not published")
+
+    def metrics(self, *, timeout_ms: int = 10_000) -> str:
+        """Prometheus text exposition of the server process's pvars."""
+        ok, text = self._rpc(TAG_METRICS, timeout_ms=timeout_ms)
+        if not ok:
+            raise MPIError(ErrorCode.ERR_NAME, f"metrics: {text}")
+        return text
 
     def close(self) -> None:
         self.ep.close()

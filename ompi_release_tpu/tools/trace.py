@@ -17,6 +17,7 @@ import json
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .. import obs as _obs
 from ..mca import pvar
 
 #: communicator methods interposed (the PMPI surface built so far)
@@ -45,9 +46,13 @@ class TraceEvent:
                 "bytes": self.nbytes}
 
 
-def _payload_bytes(args) -> int:
+def _payload_bytes(args, kwargs: Optional[Dict[str, Any]] = None) -> int:
+    """Total bytes across positional AND keyword array arguments —
+    calls made with keyword buffers (``comm.allreduce(x=buf)``) must
+    count the same as positional ones."""
     n = 0
-    for a in args:
+    vals = list(args) + (list(kwargs.values()) if kwargs else [])
+    for a in vals:
         sz = getattr(a, "size", None)
         it = getattr(getattr(a, "dtype", None), "itemsize", None)
         if sz is not None and it is not None:
@@ -85,11 +90,18 @@ class TracingComm:
                 return attr(*args, **kw)
             finally:
                 dt = time.perf_counter() - t0
-                ev = TraceEvent(name, t0, dt, _payload_bytes(args))
+                ev = TraceEvent(name, t0, dt, _payload_bytes(args, kw))
                 self.events.append(ev)
                 self._timer(name).add(dt)
+                if _obs.enabled:
+                    # the PMPI proxy feeds the same journal as the
+                    # in-framework emit points: one stream
+                    _obs.record(name, "pmpi", t0, dt, nbytes=ev.nbytes)
                 if self._sink is not None:
                     self._sink.write(json.dumps(ev.asdict()) + "\n")
+                    # flush per event: a crashed run must not lose
+                    # buffered trace lines
+                    self._sink.flush()
 
         return traced
 

@@ -25,7 +25,17 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from ompi_release_tpu.utils import jaxcompat  # noqa: E402
+
+jaxcompat.install()  # tests use jax.shard_map directly; alias on 0.4.x
+
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 run"
+    )
 
 
 def subprocess_env(**overrides):

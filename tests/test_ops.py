@@ -249,11 +249,14 @@ def test_bench_end_to_end_on_simulator_mesh():
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [json.loads(ln) for ln in r.stdout.strip().splitlines()
              if ln.startswith("{")]
-    assert len(lines) >= 5, lines
-    for ln in lines:
-        assert "metric" in ln and "value" in ln and "unit" in ln
+    metrics = [ln for ln in lines if "metric" in ln]
+    assert len(metrics) >= 5, lines
+    for ln in metrics:
+        assert "value" in ln and "unit" in ln
         if ln.get("vs_baseline") is not None:
             assert ln["vs_baseline"] <= 1.0 + 1e-9  # by construction
+    # every metric line travels with a pvar snapshot (obs plane)
+    assert any("pvars" in ln for ln in lines), lines
     headline = lines[-1]
     assert "allreduce" in headline["metric"] or "op_sum" in \
         headline["metric"]
