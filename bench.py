@@ -664,6 +664,113 @@ def _pvar_snapshot():
         return {}
 
 
+#: pvars the coll micro-suite labels its lines with (segment counts,
+#: fusion savings, plan-cache behaviour — the PR-goal observables)
+_MICRO_PVARS = (
+    "coll_pipeline_segments", "coll_fusion_batched",
+    "coll_fusion_flushes", "coll_fusion_bytes_saved",
+    "coll_programs_compiled", "coll_invocations",
+    "coll_plan_cache_hits",
+)
+
+
+def _micro_pvars():
+    from ompi_release_tpu.mca import pvar as _pvar_mod
+
+    out = {}
+    for name in _MICRO_PVARS:
+        pv = _pvar_mod.PVARS.lookup(name)
+        if pv is not None:
+            out[name] = pv.read()
+    return out
+
+
+def _coll_micro_suite(backend_label):
+    """coll_pipeline / coll_fusion micro-suite through the framework's
+    own driver (not raw meshes): a ≥1 MiB pipelined allreduce + bcast
+    and a 64-small-tensors fusion burst, one JSON line each, every
+    line labelled with the cumulative pvar snapshot so BENCH_* files
+    capture segment counts and fusion savings. The fusion line's
+    device_collectives < tensors_fused check is pvar-based, so it
+    holds on the CPU backend too."""
+    import ompi_release_tpu as mpi
+    from ompi_release_tpu.mca import var as mca_var
+
+    lines = []
+    world = mpi.init()
+
+    # -- pipeline case: 1 MiB/rank allreduce + bcast, 256 KiB segments
+    mca_var.set_value("coll", "tuned")
+    try:
+        tuned = world.dup(name="bench_pipe")
+    finally:
+        mca_var.VARS.unset("coll")
+    elems = MiB // 4
+    x = np.ones((world.size, elems), np.float32)
+    try:
+        mca_var.set_value("coll_tuned_allreduce_algorithm", "ring")
+        mca_var.set_value("coll_tuned_bcast_algorithm", "binomial")
+        mca_var.set_value("coll_pipeline_segsize", 256 * 1024)
+        for name, call in (
+            ("coll_pipeline_allreduce_1MiB",
+             lambda: tuned.allreduce(x)),
+            ("coll_pipeline_bcast_1MiB",
+             lambda: tuned.bcast(x, root=0)),
+        ):
+            _sync(call())  # compile + prime the plan cache
+            t0 = time.perf_counter()
+            reps = 3
+            for _ in range(reps):
+                _sync(call())
+            dt = (time.perf_counter() - t0) / reps
+            lines.append({
+                "metric": name, "value": round(MiB / dt / 1e9, 4),
+                "unit": "GB/s", "vs_baseline": None,
+                "suite": "coll_pipeline", "seconds": round(dt, 6),
+                "pvars": _micro_pvars(), "cumulative": True,
+            })
+    finally:
+        mca_var.VARS.unset("coll_tuned_allreduce_algorithm")
+        mca_var.VARS.unset("coll_tuned_bcast_algorithm")
+        mca_var.VARS.unset("coll_pipeline_segsize")
+        tuned.free()
+
+    # -- fusion case: 64 small tensors through the fusion buffer
+    from ompi_release_tpu.mca import pvar as _pvar_mod
+
+    def _counter(name):
+        pv = _pvar_mod.PVARS.lookup(name)
+        return float(pv.read()) if pv is not None else 0.0
+
+    b0, f0 = _counter("coll_fusion_batched"), _counter("coll_fusion_flushes")
+    fb = world.fusion_buffer()
+    tensors = 64
+    small = [np.full((world.size, 256), i, np.float32)
+             for i in range(tensors)]
+    t0 = time.perf_counter()
+    handles = [fb.allreduce(s) for s in small]
+    fb.flush()
+    vals = [h.result() for h in handles]
+    dt = time.perf_counter() - t0
+    np.testing.assert_allclose(
+        np.asarray(vals[3][0]), np.full(256, 3.0 * world.size), rtol=0
+    )
+    fused = int(_counter("coll_fusion_batched") - b0)
+    issued = int(_counter("coll_fusion_flushes") - f0)
+    lines.append({
+        "metric": "coll_fusion_64x1KiB", "value": issued, "unit":
+        "device_collectives", "vs_baseline": None,
+        "suite": "coll_fusion", "tensors_fused": fused,
+        "fewer_collectives_than_tensors": issued < fused,
+        "seconds": round(dt, 6),
+        "pvars": _micro_pvars(), "cumulative": True,
+    })
+    if backend_label:
+        for ln in lines:
+            ln["backend"] = backend_label
+    return lines
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -840,6 +947,17 @@ def main():
             "metric": "transformer_fwdbwd_step", "value": None,
             "unit": "TFLOP/s", "vs_baseline": None,
             "error": f"{type(e).__name__}: {e}"[:200],
+        })
+
+    # coll pipeline/fusion micro-suite: framework-driver lines with
+    # labelled pvar snapshots (segment counts, fusion savings)
+    try:
+        lines.extend(_coll_micro_suite(backend_label))
+    except Exception as e:
+        lines.append({
+            "metric": "coll_micro_suite", "value": None, "unit": None,
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}"[:300],
         })
 
     # ONE cumulative snapshot: the configs run interleaved (see

@@ -21,7 +21,7 @@ from ..mca import component as mca_component
 from ..mca import var as mca_var
 from ..ops.op import Op
 from ..utils import output
-from . import dynamic_rules, spmd
+from . import dynamic_rules, pipeline, spmd
 from .base import COLL_FRAMEWORK
 from .driver import run_sharded
 
@@ -445,6 +445,23 @@ class _TunedModule:
                 xb, op, AXIS, n, seg_elems
             ),
         }
+        if alg == "ring":
+            # pipelined segmentation (coll/pipeline.py): above the
+            # segsize the ring runs as double-buffered column segments
+            # of the same chunk matrix — bitwise-identical to the
+            # monolithic ring, keyed by segment count in the plan cache
+            block_dsize = _per_rank_bytes(x)
+            nseg = pipeline.segment_count("allreduce", n, block_dsize)
+            if nseg > 1:
+                _log.verbose(3, f"{comm.name}: tuned allreduce -> "
+                                f"ring pipelined x{nseg}")
+                return pipeline.run_pipelined(
+                    comm, ("tuned", "allreduce", "ring", op.name),
+                    lambda xb: pipeline.allreduce_ring_pipelined(
+                        xb, op, AXIS, n, nseg),
+                    x, nseg=nseg, nbytes=block_dsize,
+                    opname="allreduce",
+                )
         _log.verbose(3, f"{comm.name}: tuned allreduce -> {alg}")
         # the segment size is baked into the compiled program, so it
         # must be part of the cache key or later var changes would be
@@ -504,6 +521,19 @@ class _TunedModule:
             "masked_psum": lambda xb: spmd.bcast_masked_psum(
                 xb, xb.dtype, AXIS, root),
         }
+        if alg == "binomial" and hasattr(x, "dtype"):
+            # segmented binomial bcast (coll/pipeline.py): trivially
+            # bitwise-equal (no reduction); segments double-buffer
+            # down the tree
+            msg = _per_rank_bytes(x)
+            nseg = pipeline.segment_count("bcast", n, msg)
+            if nseg > 1:
+                return pipeline.run_pipelined(
+                    comm, ("tuned", "bcast", "binomial", root),
+                    lambda xb: pipeline.bcast_binomial_pipelined(
+                        xb, AXIS, n, root, nseg),
+                    x, nseg=nseg, nbytes=msg, opname="bcast",
+                )
         # the segment size is baked into the compiled pipeline
         key = ("tuned", "bcast", alg, root) + (
             (seg_elems,) if alg == "pipeline" else ()
@@ -562,6 +592,25 @@ class _TunedModule:
             "linear": lambda xb: spmd.reduce_linear(
                 xb, op, AXIS, n, root),
         }
+        if alg == "binomial":
+            # segmented binomial reduce (coll/pipeline.py): the tree's
+            # per-element combine order ignores element position, so
+            # the segmented result is bitwise-identical
+            msg = _per_rank_bytes(x)
+            nseg = pipeline.segment_count("reduce", n, msg)
+            if nseg > 1:
+                def pipe_binom(xb):
+                    red = pipeline.reduce_binomial_pipelined(
+                        xb, op, AXIS, n, root, nseg)
+                    rank = lax.axis_index(AXIS)
+                    return jnp.where(rank == root, red,
+                                     jnp.zeros_like(red))
+
+                return pipeline.run_pipelined(
+                    comm, ("tuned", "reduce", "binomial", op.name, root),
+                    pipe_binom, x, nseg=nseg, nbytes=msg,
+                    opname="reduce",
+                )
         return run_sharded(comm, ("tuned", "reduce", alg, op.name, root),
                            bodies[alg], x)
 

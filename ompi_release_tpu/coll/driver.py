@@ -31,6 +31,14 @@ _invoke_count = pvar.counter(
 _compile_count = pvar.counter(
     "coll_programs_compiled", "distinct compiled collective programs"
 )
+# per-invocation plan-cache outcome: observe(1) on a cache hit,
+# observe(0) on a compile — so sum/count IS the hit ratio
+# (coll_programs_compiled vs coll_invocations, as one AGGREGATE)
+_plan_cache = pvar.aggregate(
+    "coll_plan_cache_hits",
+    "plan-cache outcome per driver invocation (1=hit, 0=compile); "
+    "sum/count = hit ratio",
+)
 
 
 def _op_name(key: Tuple) -> str:
@@ -77,6 +85,7 @@ def run_sharded2d(comm, key: Tuple, body: Callable, x, *,
         )
     cache = _program_cache(comm)
     prog = cache.get(key)
+    _plan_cache.observe(0.0 if prog is None else 1.0)
     if prog is None:
         _compile_count.add()
         devs = _np.asarray(
@@ -138,6 +147,7 @@ def run_sharded_spmd(comm, key: Tuple, body: Callable, local_x) -> Any:
     )
     cache = _program_cache(comm)
     prog = cache.get(key)
+    _plan_cache.observe(0.0 if prog is None else 1.0)
     if prog is None:
         _compile_count.add()
 
@@ -247,6 +257,7 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
         _check_no_narrowing(arr)
     cache = _program_cache(comm)
     prog = cache.get(key)
+    _plan_cache.observe(0.0 if prog is None else 1.0)
     if prog is None:
         _compile_count.add()
         mesh = comm.submesh

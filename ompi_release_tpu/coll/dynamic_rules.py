@@ -10,15 +10,27 @@ coll_tuned_dynamic_rules_filename FILE``.  Same feature here, with a
 readable line format instead of the reference's positional numeric
 one::
 
-    # collective  min_comm_size  min_msg_bytes  algorithm
+    # collective  min_comm_size  min_msg_bytes  algorithm  [segsize]
     allreduce     0              0              recursive_doubling
-    allreduce     0              1048576        ring
+    allreduce     0              1048576        ring       262144
     alltoall      8              0              pairwise
 
 The LAST line whose ``min_comm_size <= comm.size`` and
 ``min_msg_bytes <= message bytes`` wins (file order = increasing
 specificity, mirroring the reference's nested size tables).  An
 algorithm of ``auto`` falls through to the fixed decision constants.
+
+The optional fifth column, ``segsize``, is the pipeline segment size
+in bytes for that rule (``coll_tuned_<op>_segmentsize`` analogue,
+consumed by :mod:`coll.pipeline`): pipeline-capable algorithms (ring
+allreduce, binomial bcast/reduce) split messages into
+``ceil(bytes / segsize)`` double-buffered segments.  ``auto`` (or an
+omitted column) defers to the ``coll_pipeline_segsize`` cvar; ``0``
+disables pipelining for calls matching the rule.  Size suffixes are
+accepted (``256K``, ``1M``).  ``tpu-tune --segsizes`` sweeps this
+column and emits measured values (:mod:`tools.tpu_tune`).
+:func:`lookup_segsize` answers the segsize query with the same
+last-match-wins semantics as :func:`lookup`.
 
 ``min_msg_bytes`` is measured in each collective's OWN decision
 unit — the same size its fixed decision rule tests, exactly like the
@@ -74,31 +86,34 @@ RULE_COLLECTIVES: Dict[str, Tuple[str, ...]] = {}
 # rewrite landing within the same second as the first parse would
 # otherwise keep serving stale rules.  Collectives may run from
 # multiple threads; _cache_lock guards every _cache access.
-_cache: Dict[Tuple[str, int, int], Dict[str, List[Tuple[int, int, str]]]] = {}
+_cache: Dict[Tuple[str, int, int],
+             Dict[str, List[Tuple[int, int, str, Optional[int]]]]] = {}
 _cache_lock = threading.Lock()
 
 
-def load_rules(path: str) -> Dict[str, List[Tuple[int, int, str]]]:
-    """Parse a rule file into {collective: [(min_n, min_bytes, alg)]}
-    preserving file order."""
+def load_rules(path: str) -> Dict[str, List[Tuple[int, int, str,
+                                                  Optional[int]]]]:
+    """Parse a rule file into {collective: [(min_n, min_bytes, alg,
+    segsize)]} preserving file order; ``segsize`` is None when the
+    fifth column is absent or ``auto`` (defer to the cvar)."""
     try:
         lines = open(path).read().splitlines()
     except OSError as e:
         raise MPIError(ErrorCode.ERR_FILE,
                        f"cannot read dynamic rules file {path}: {e}")
-    rules: Dict[str, List[Tuple[int, int, str]]] = {}
+    rules: Dict[str, List[Tuple[int, int, str, Optional[int]]]] = {}
     for lineno, line in enumerate(lines, 1):
         line = line.split("#", 1)[0].strip()
         if not line:
             continue
         parts = line.split()
-        if len(parts) != 4:
+        if len(parts) not in (4, 5):
             raise MPIError(
                 ErrorCode.ERR_ARG,
                 f"{path}:{lineno}: expected 'collective min_comm_size "
-                f"min_msg_bytes algorithm', got '{line}'",
+                f"min_msg_bytes algorithm [segsize]', got '{line}'",
             )
-        coll, n_s, bytes_s, alg = parts
+        coll, n_s, bytes_s, alg = parts[:4]
         if coll not in RULE_COLLECTIVES:
             raise MPIError(
                 ErrorCode.ERR_ARG,
@@ -121,13 +136,25 @@ def load_rules(path: str) -> Dict[str, List[Tuple[int, int, str]]]:
                 f"{path}:{lineno}: unknown {coll} algorithm '{alg}' "
                 f"(choices: {', '.join(RULE_COLLECTIVES[coll])})",
             )
-        rules.setdefault(coll, []).append((min_n, min_bytes, alg))
+        segsize: Optional[int] = None
+        if len(parts) == 5 and parts[4] != "auto":
+            try:
+                segsize = mca_var.parse_size(parts[4])
+            except ValueError:
+                raise MPIError(
+                    ErrorCode.ERR_ARG,
+                    f"{path}:{lineno}: segsize must be bytes (suffixes "
+                    f"K/M/G ok) or 'auto', got '{parts[4]}'",
+                )
+        rules.setdefault(coll, []).append((min_n, min_bytes, alg, segsize))
     return rules
 
 
-def lookup(coll: str, comm_size: int, msg_bytes: int) -> Optional[str]:
-    """The algorithm the operator's rule file picks for this call, or
-    None (no file configured / no matching rule / rule says auto)."""
+def _active_rules() -> Optional[Dict[str, List[Tuple[int, int, str,
+                                                     Optional[int]]]]]:
+    """The currently configured rule table, or None when dynamic rules
+    are off / no file is configured. Handles the stat-based cache and
+    the vanished-mid-run fallback (see the comments inline)."""
     if not mca_var.get("coll_tuned_use_dynamic_rules", False):
         return None
     path = mca_var.get("coll_tuned_dynamic_rules_filename", "")
@@ -164,8 +191,17 @@ def lookup(coll: str, comm_size: int, msg_bytes: int) -> Optional[str]:
                 _cache.clear()  # at most one live file; drop stale keys
                 _cache[key] = parsed
             rules_for_path = parsed
+    return rules_for_path
+
+
+def lookup(coll: str, comm_size: int, msg_bytes: int) -> Optional[str]:
+    """The algorithm the operator's rule file picks for this call, or
+    None (no file configured / no matching rule / rule says auto)."""
+    rules = _active_rules()
+    if rules is None:
+        return None
     picked: Optional[str] = None
-    for min_n, min_bytes, alg in rules_for_path.get(coll, ()):
+    for min_n, min_bytes, alg, _segsize in rules.get(coll, ()):
         if comm_size >= min_n and msg_bytes >= min_bytes:
             picked = alg
     if picked == "auto":
@@ -173,4 +209,23 @@ def lookup(coll: str, comm_size: int, msg_bytes: int) -> Optional[str]:
     if picked is not None:
         _log.verbose(3, f"dynamic rule: {coll} n={comm_size} "
                         f"bytes={msg_bytes} -> {picked}")
+    return picked
+
+
+def lookup_segsize(coll: str, comm_size: int,
+                   msg_bytes: int) -> Optional[int]:
+    """The pipeline segment size the rule file picks for this call, or
+    None (no file / no matching rule / rule says auto) — the caller
+    (``coll/pipeline.py``) falls back to the ``coll_pipeline_segsize``
+    cvar. Last matching rule wins, same as :func:`lookup`."""
+    rules = _active_rules()
+    if rules is None:
+        return None
+    picked: Optional[int] = None
+    for min_n, min_bytes, _alg, segsize in rules.get(coll, ()):
+        if comm_size >= min_n and msg_bytes >= min_bytes:
+            picked = segsize
+    if picked is not None:
+        _log.verbose(3, f"dynamic rule: {coll} n={comm_size} "
+                        f"bytes={msg_bytes} -> segsize={picked}")
     return picked

@@ -168,21 +168,14 @@ def allreduce_recursive_doubling(x: jax.Array, op: Op,
     return xf.reshape(shape).astype(dtype)
 
 
-def allreduce_ring(x: jax.Array, op: Op, axis_name: str, n: int) -> jax.Array:
-    """Ring allreduce: reduce-scatter pass + allgather pass
-    (coll_tuned_allreduce.c:361). Bandwidth-optimal: 2(n-1)/n · size
-    over the ICI ring.
-    """
-    if n == 1:
-        return x
+def _ring_passes(chunks: jax.Array, op: Op, axis_name: str,
+                 n: int) -> jax.Array:
+    """The two ring passes (reduce-scatter + allgather) over a
+    pre-chunked ``(n, ...)`` buffer. A chunk row's accumulation order
+    is fixed by its row index alone — which is what lets the pipelined
+    wrapper (``coll/pipeline.py``) segment WITHIN rows and stay
+    bitwise-identical to the monolithic ring."""
     rank = lax.axis_index(axis_name)
-    shape, dtype = x.shape, x.dtype
-    flat = x.reshape(-1)
-    total = flat.shape[0]
-    chunk = -(-total // n)  # ceil
-    ident = op.identity_for(dtype)
-    chunks = _pad_to(flat, chunk * n, ident).reshape(n, chunk)
-
     perm = _ring_perm(n)
 
     # reduce-scatter: after n-1 steps, chunk (rank+1) mod n is complete
@@ -207,6 +200,23 @@ def allreduce_ring(x: jax.Array, op: Op, axis_name: str, n: int) -> jax.Array:
         return lax.dynamic_update_index_in_dim(chunks, recv, recv_idx, 0), None
 
     chunks, _ = lax.scan(ag_step, chunks, jnp.arange(n - 1))
+    return chunks
+
+
+def allreduce_ring(x: jax.Array, op: Op, axis_name: str, n: int) -> jax.Array:
+    """Ring allreduce: reduce-scatter pass + allgather pass
+    (coll_tuned_allreduce.c:361). Bandwidth-optimal: 2(n-1)/n · size
+    over the ICI ring.
+    """
+    if n == 1:
+        return x
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    total = flat.shape[0]
+    chunk = -(-total // n)  # ceil
+    ident = op.identity_for(dtype)
+    chunks = _pad_to(flat, chunk * n, ident).reshape(n, chunk)
+    chunks = _ring_passes(chunks, op, axis_name, n)
     return chunks.reshape(-1)[:total].reshape(shape).astype(dtype)
 
 

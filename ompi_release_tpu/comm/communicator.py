@@ -53,6 +53,11 @@ _comm_count = pvar.counter("comm_active_count", "live communicators")
 #: re-submitting to (and deadlocking on) the same single worker
 _nbc_tls = threading.local()
 
+#: serializes lazy FusionBuffer creation (comm.fusion_buffer): the
+#: buffer itself is thread-safe, so first use may race — an orphaned
+#: second instance would silently escape free()'s drain
+_fusion_create_lock = threading.Lock()
+
 
 def _next_cid(internal: bool = False) -> int:
     with _cid_lock:
@@ -249,6 +254,13 @@ class Communicator:
 
     def free(self) -> None:
         self._check_alive()
+        fb = getattr(self, "_fusion_buffer", None)
+        if fb is not None:
+            # pending fused tensors drain before the comm dies —
+            # freeing with queued submissions is a late flush, not a
+            # lost handle
+            fb.flush()
+            self._fusion_buffer = None
         if self._nbc_exec is not None:
             # outstanding i-collectives must drain FIRST — before the
             # _on_free hooks free the hier shadow comm and the cid
@@ -474,6 +486,33 @@ class Communicator:
 
     def barrier(self) -> None:
         self._coll("barrier")(self)
+
+    # -- small-message fusion (coll/fusion.py) -----------------------------
+    def fusion_buffer(self):
+        """This communicator's small-message fusion buffer (Horovod
+        fusion-buffer / BTL-coalescing analogue): collectives below
+        ``coll_fusion_threshold`` pack into one fused device
+        collective per (op, dtype). Created lazily, one per comm;
+        FusionBuffer is documented thread-safe, so first use may be
+        concurrent — creation must not orphan a racing instance."""
+        fb = getattr(self, "_fusion_buffer", None)
+        if fb is None:
+            from ..coll.fusion import FusionBuffer
+
+            with _fusion_create_lock:
+                fb = getattr(self, "_fusion_buffer", None)
+                if fb is None:
+                    fb = FusionBuffer(self)
+                    self._fusion_buffer = fb
+        return fb
+
+    def fused_allreduce(self, x, op=None):
+        """Allreduce through the fusion buffer: small tensors coalesce
+        with concurrent submissions (flush with
+        ``comm.fusion_buffer().flush()`` or the handle's ``result()``);
+        large ones dispatch immediately. Returns a
+        :class:`~..coll.fusion.FusedHandle`."""
+        return self.fusion_buffer().allreduce(x, op)
 
     # -- v-variant collectives (per-rank counts; ragged driver edge) -------
     def alltoallv(self, sendbufs, sendcounts):

@@ -99,6 +99,21 @@ def selftest() -> int:
     ):
         assert needle in page, f"{needle!r} missing from exposition"
 
+    # 5. coll driver plan-cache statistics (registered at driver
+    # import; sum = hits, count = invocations → sum/count = hit ratio)
+    from ..coll import driver as _coll_driver  # noqa: F401
+
+    pc = pvar.PVARS.lookup("coll_plan_cache_hits")
+    assert pc is not None, "coll driver must register coll_plan_cache_hits"
+    st = pc.read()
+    hits, total = int(st["sum"]), int(st["count"])
+    ratio = (hits / total) if total else 0.0
+    print(f"plan cache: {hits}/{total} hits "
+          f"(ratio {ratio:.2f}; compiled="
+          f"{pvar.PVARS.lookup('coll_programs_compiled').read():.0f}, "
+          f"invocations="
+          f"{pvar.PVARS.lookup('coll_invocations').read():.0f})")
+
     disable()
     print("obs selftest: ok")
     return 0

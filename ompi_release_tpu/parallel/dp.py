@@ -8,6 +8,11 @@ mirrors the reference's segmentation decision rules
 one flat collective so per-collective latency is amortized, exactly why
 tuned switches algorithms by message size. Under XLA one psum per
 bucket compiles to one fused ICI collective.
+
+The fusion decision itself (greedy in-order same-dtype packing up to a
+byte capacity) is :func:`coll.fusion.plan_buckets` — ONE definition
+shared with the host-driver fusion buffer (``comm.fusion_buffer()``),
+so the SPMD gradient path and the driver path coalesce identically.
 """
 
 from __future__ import annotations
@@ -53,13 +58,16 @@ def allreduce_gradients(grads: Any, axis_name: str, *, mean: bool = True,
         r = lax.psum(leaf, axis_name)
         out[i] = r / n if mean and jnp.issubdtype(leaf.dtype, jnp.inexact) else r
 
-    # pack small leaves into flat buckets, one psum per bucket
-    bucket: list = []
-    bucket_sz = 0
+    # pack small leaves into flat buckets, one psum per bucket — the
+    # bucket plan comes from the shared fusion planner
+    from ..coll.fusion import plan_buckets
 
-    def _flush(bucket):
-        if not bucket:
-            return
+    buckets = plan_buckets(
+        (((i, leaf), leaf.size * leaf.dtype.itemsize, leaf.dtype)
+         for i, leaf in small),
+        bucket_bytes,
+    )
+    for bucket in buckets:
         flat = jnp.concatenate([l.reshape(-1) for _, l in bucket])
         red = lax.psum(flat, axis_name)
         off = 0
@@ -69,15 +77,6 @@ def allreduce_gradients(grads: Any, axis_name: str, *, mean: bool = True,
                 piece = piece / n
             out[i] = piece
             off += l.size
-
-    for i, leaf in small:
-        if bucket and (bucket_sz + leaf.size * leaf.dtype.itemsize
-                       > bucket_bytes or bucket[0][1].dtype != leaf.dtype):
-            _flush(bucket)
-            bucket, bucket_sz = [], 0
-        bucket.append((i, leaf))
-        bucket_sz += leaf.size * leaf.dtype.itemsize
-    _flush(bucket)
 
     return jax.tree.unflatten(treedef, out)
 

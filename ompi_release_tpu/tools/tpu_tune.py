@@ -18,10 +18,25 @@ Sizes in the emitted rules are each collective's own decision unit
 alltoall/scatter — the same units ``dynamic_rules.lookup`` is queried
 with; see that module's table).
 
+Timing protocol: the first call of every (algorithm, size) compiles
+the program AND primes the driver's plan cache; the measured repeats
+that follow therefore never include compile time. The compile cost is
+still reported — as a separate ``compile:`` field in the emitted
+rule-file comments — because an operator choosing between algorithms
+with similar steady-state times may care which one stalls the first
+iteration longer.
+
+``--segsizes`` additionally sweeps the pipeline segment size
+(``coll/pipeline.py``) for rows whose winner is pipeline-capable (ring
+allreduce, binomial bcast/reduce) and emits the winning value as the
+rule file's fifth ``segsize`` column (0 pins pipelining off when
+monolithic won), with the per-segsize measurements in a comment.
+
 Usage::
 
     python -m ompi_release_tpu.tools.tpu_tune -o rules.conf \\
-        [--sizes 1024,65536,1048576] [--repeats 5] [--ops allreduce,...]
+        [--sizes 1024,65536,1048576] [--repeats 5] [--ops allreduce,...] \\
+        [--segsizes 65536,262144,1048576]
 """
 
 from __future__ import annotations
@@ -66,11 +81,73 @@ def _time_once(fn, comm, x) -> float:
     return time.perf_counter() - t0
 
 
+def _tuned_dup(comm):
+    """A dup whose c_coll table is served by the tuned component:
+    ``coll_tuned_<op>_algorithm`` forcing and rule files only act
+    through the tuned pickers, while a default comm's chain is led by
+    xla (priority 100) — measuring there would time xla's one program
+    under every forced name and crown a noise winner."""
+    mca_var.set_value("coll", "tuned")
+    try:
+        return comm.dup(name="tune_tuned")
+    finally:
+        mca_var.VARS.unset("coll")
+
+
+def sweep_segsizes(comm, op: str, alg: str, x,
+                   segsizes: Sequence[int], repeats: int = 5
+                   ) -> Dict[int, float]:
+    """Time ``alg`` under each pipeline segment size (plus 0 = the
+    monolithic baseline); returns {segsize: best_seconds}. The cvar
+    under sweep is ``coll_pipeline_segsize`` — exactly what the
+    emitted rule's ``segsize`` column will set per matching call.
+
+    Dynamic rules are pinned OFF for the sweep: a live rules file's
+    segsize column outranks the swept cvar (pick_segsize: rules >
+    cvar), which would make every sweep point measure the same
+    configuration when re-tuning an already-tuned deployment.
+    Segment sizes >= the per-rank message are skipped — they compile
+    the identical monolithic program as 0 and would only let timer
+    noise crown a never-exercised value."""
+    runner, _ = _OPS[op]
+    var = f"coll_tuned_{op}_algorithm"
+    msg_bytes = int(x[0].size) * int(x.dtype.itemsize)
+    out: Dict[int, float] = {}
+    prev_rules = mca_var.get("coll_tuned_use_dynamic_rules", False)
+    prev_seg = mca_var.get("coll_pipeline_segsize", 1 << 20)
+    mca_var.set_value("coll_tuned_use_dynamic_rules", False)
+    mca_var.set_value(var, alg)
+    try:
+        for seg in [0] + [s for s in segsizes if 0 < s < msg_bytes]:
+            mca_var.set_value("coll_pipeline_segsize", seg)
+            try:
+                _time_once(runner, comm, x)  # compile + prime plan cache
+                out[seg] = min(
+                    _time_once(runner, comm, x) for _ in range(repeats)
+                )
+            except Exception as e:
+                _log.verbose(2, f"{op}/{alg} segsize {seg}: {e}")
+    finally:
+        # restore (not unset): the caller may have pinned its own
+        # segsize — measure() pins 0 for monolithic alg-phase timings
+        mca_var.set_value("coll_pipeline_segsize", prev_seg)
+        mca_var.set_value(var, "auto")
+        mca_var.set_value("coll_tuned_use_dynamic_rules", prev_rules)
+    return out
+
+
 def measure(comm, ops: Sequence[str], sizes: Sequence[int],
-            repeats: int = 5) -> Dict[str, List[Dict]]:
-    """{op: [{size, unit_bytes, times: {alg: s}, winner}]} — per-rank
-    buffer sizes in bytes; min-of-repeats timing (dispatch latency
-    spikes are one-sided)."""
+            repeats: int = 5, *, segsizes: Optional[Sequence[int]] = None,
+            algs: Optional[Sequence[str]] = None) -> Dict[str, List[Dict]]:
+    """{op: [{size, unit_bytes, times: {alg: s}, compile: {alg: s},
+    winner[, segsize, segsize_times]}]} — per-rank buffer sizes in
+    bytes; min-of-repeats timing (dispatch latency spikes are
+    one-sided). The first call per algorithm compiles AND primes the
+    driver plan cache, so the measured repeats exclude compile time;
+    the compile cost is reported separately in ``compile``. With
+    ``segsizes``, pipeline-capable winners get a segment-size sweep
+    (``segsize`` = best, 0 = monolithic won). ``algs`` restricts the
+    algorithm menu (default: every legal algorithm of the op)."""
     if getattr(comm, "spans_processes", False):
         from ..utils.errors import ErrorCode, MPIError
 
@@ -81,40 +158,75 @@ def measure(comm, ops: Sequence[str], sizes: Sequence[int],
             "target mesh shape — the rule file it emits applies to "
             "any job",
         )
+    from ..coll import pipeline
+
     n = comm.size
-    results: Dict[str, List[Dict]] = {}
-    for op in ops:
-        runner, unit_fn = _OPS[op]
-        var = f"coll_tuned_{op}_algorithm"
-        rows = []
-        for size in sizes:
-            elems = max(n, size // 4)
-            elems = -(-elems // n) * n  # alltoall/scatter need % n == 0
-            x = np.ones((n, elems), np.float32)
-            times: Dict[str, float] = {}
-            for alg in _algorithms(op):
-                mca_var.set_value(var, alg)
-                try:
-                    _time_once(runner, comm, x)  # compile + warm
-                    times[alg] = min(
-                        _time_once(runner, comm, x)
-                        for _ in range(repeats)
+    tuned = _tuned_dup(comm)
+    # measure from scratch: an active rules file (a previous tuning
+    # run) must not steer this one — the algorithm is pinned by the
+    # forced cvar, and its segsize column would silently pipeline the
+    # alg-phase timings (pick_segsize: rules > cvar). The ambient
+    # coll_pipeline_segsize is pinned to 0 too: the alg phase times
+    # MONOLITHIC algorithms (the segsize sweep's own 0-baseline), and
+    # pipelining is explored only by the explicit sweep
+    prev_rules = mca_var.get("coll_tuned_use_dynamic_rules", False)
+    prev_seg = mca_var.get("coll_pipeline_segsize", 1 << 20)
+    mca_var.set_value("coll_tuned_use_dynamic_rules", False)
+    mca_var.set_value("coll_pipeline_segsize", 0)
+    try:
+        results: Dict[str, List[Dict]] = {}
+        for op in ops:
+            runner, unit_fn = _OPS[op]
+            var = f"coll_tuned_{op}_algorithm"
+            rows = []
+            for size in sizes:
+                elems = max(n, size // 4)
+                elems = -(-elems // n) * n  # alltoall/scatter: % n == 0
+                x = np.ones((n, elems), np.float32)
+                times: Dict[str, float] = {}
+                compiles: Dict[str, float] = {}
+                for alg in (algs or _algorithms(op)):
+                    mca_var.set_value(var, alg)
+                    try:
+                        # compile + warm: this first call also primes
+                        # the driver plan cache, so the repeats below
+                        # never pay compile time
+                        t_first = _time_once(runner, tuned, x)
+                        times[alg] = min(
+                            _time_once(runner, tuned, x)
+                            for _ in range(repeats)
+                        )
+                        compiles[alg] = max(0.0, t_first - times[alg])
+                    except Exception as e:
+                        # an algorithm an op/shape cannot run (e.g.
+                        # ring without identity) is skipped, not fatal
+                        _log.verbose(2, f"{op}/{alg}@{size}: {e}")
+                    finally:
+                        mca_var.set_value(var, "auto")
+                if not times:
+                    continue
+                winner = min(times, key=times.get)
+                row = {
+                    "size": size, "unit_bytes": unit_fn(elems * 4, n),
+                    "times": times, "compile": compiles, "winner": winner,
+                }
+                pipe_alg = pipeline.PIPELINE_CAPABLE.get(op)
+                pos_segs = [s for s in (segsizes or ()) if s > 0]
+                if (pos_segs and winner == pipe_alg
+                        and size > min(pos_segs)):
+                    seg_times = sweep_segsizes(
+                        tuned, op, winner, x, segsizes, repeats
                     )
-                except Exception as e:
-                    # an algorithm an op/shape cannot run (e.g. ring
-                    # without identity) is skipped, not fatal
-                    _log.verbose(2, f"{op}/{alg}@{size}: {e}")
-                finally:
-                    mca_var.set_value(var, "auto")
-            if not times:
-                continue
-            winner = min(times, key=times.get)
-            rows.append({
-                "size": size, "unit_bytes": unit_fn(elems * 4, n),
-                "times": times, "winner": winner,
-            })
-        results[op] = rows
-    return results
+                    if seg_times:
+                        row["segsize_times"] = seg_times
+                        row["segsize"] = min(seg_times, key=seg_times.get)
+                rows.append(row)
+            results[op] = rows
+        return results
+    finally:
+        mca_var.set_value("coll_tuned_use_dynamic_rules", prev_rules)
+        mca_var.set_value("coll_pipeline_segsize", prev_seg)
+        tuned.free()
 
 
 def _fixed_choice(comm, op: str, size: int) -> Optional[str]:
@@ -166,7 +278,7 @@ def emit(comm, results: Dict[str, List[Dict]]) -> str:
         "# load with: --mca coll_tuned_use_dynamic_rules 1 "
         "--mca coll_tuned_dynamic_rules_filename <this file>",
         "#",
-        "# collective  min_comm_size  min_msg_bytes  algorithm",
+        "# collective  min_comm_size  min_msg_bytes  algorithm  [segsize]",
     ]
     for op, rows in results.items():
         if not rows:
@@ -182,12 +294,29 @@ def emit(comm, results: Dict[str, List[Dict]]) -> str:
                     if fixed is not None
                     and fixed != row["winner"] else "")
             lines.append(f"# {op} @ {row['size']}B/rank: {t}{note}")
-            if row["winner"] != prev:
-                thresh = 0 if i == 0 else row["unit_bytes"]
+            if row.get("compile"):
+                c = ", ".join(
+                    f"{a}={s * 1e3:.0f}ms"
+                    for a, s in sorted(row["compile"].items(),
+                                       key=lambda kv: kv[1]))
+                lines.append(f"#   compile: {c}")
+            if row.get("segsize_times"):
+                st = ", ".join(
+                    f"{('off' if k == 0 else k)}={v * 1e6:.0f}us"
+                    for k, v in sorted(row["segsize_times"].items(),
+                                       key=lambda kv: kv[1]))
                 lines.append(
-                    f"{op}  0  {thresh}  {row['winner']}"
+                    f"#   segsize sweep ({row['winner']}): {st}"
                 )
-                prev = row["winner"]
+            pick = (row["winner"], row.get("segsize"))
+            if pick != prev:
+                thresh = 0 if i == 0 else row["unit_bytes"]
+                seg_col = ("" if row.get("segsize") is None
+                           else f"  {row['segsize']}")
+                lines.append(
+                    f"{op}  0  {thresh}  {row['winner']}{seg_col}"
+                )
+                prev = pick
     return "\n".join(lines) + "\n"
 
 
@@ -203,6 +332,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--repeats", type=int, default=5)
     ap.add_argument("--ops", default="allreduce,bcast,reduce,"
                                      "allgather,alltoall")
+    ap.add_argument("--segsizes", default="65536,262144,1048576",
+                    help="comma-separated pipeline segment sizes to "
+                         "sweep for pipeline-capable winners (emits "
+                         "the segsize rule column); empty disables")
     args = ap.parse_args(argv)
 
     import ompi_release_tpu as mpi
@@ -212,7 +345,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # order and dynamic_rules takes the LAST match
     sizes = sorted(int(s) for s in args.sizes.split(",") if s)
     ops = [o.strip() for o in args.ops.split(",") if o.strip()]
-    results = measure(comm, ops, sizes, repeats=args.repeats)
+    segsizes = sorted(int(s) for s in args.segsizes.split(",") if s)
+    results = measure(comm, ops, sizes, repeats=args.repeats,
+                      segsizes=segsizes or None)
     text = emit(comm, results)
     with open(args.output, "w") as f:
         f.write(text)
