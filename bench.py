@@ -771,6 +771,238 @@ def _coll_micro_suite(backend_label):
     return lines
 
 
+#: worker app for the wire micro-suite: a REAL 3-process tpurun job on
+#: the CPU mesh (the wire is host-side regardless of accelerator), so
+#: the emitted numbers exercise the exact envelope/fragment/lane code
+#: a multi-controller job runs. Process 0 writes its JSON lines to
+#: OMPITPU_WIRE_BENCH_OUT; the parent re-emits them as bench lines.
+_WIRE_BENCH_APP = r'''
+import json, os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# distinct shm identity per worker: every byte rides the DCN staged
+# path — the fragment pipeline under measurement (shm handoffs are a
+# single segment memcpy and would hide it)
+os.environ["OMPITPU_HOST_ID"] = (
+    "wirebench-" + os.environ["OMPITPU_NODE_ID"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.mca import pvar, var as mca_var
+from ompi_release_tpu.runtime.runtime import Runtime
+
+SIZES = json.loads(os.environ["OMPITPU_WIRE_BENCH_SIZES"])
+HOL_MIB = int(os.environ.get("OMPITPU_WIRE_BENCH_HOL_MIB", "8"))
+AGV_MIB = int(os.environ.get("OMPITPU_WIRE_BENCH_AGV_MIB", "1"))
+world = mpi.init()
+rt = Runtime.current()
+me = rt.bootstrap["process_index"]
+lines = []
+
+def _hol():
+    pv = pvar.PVARS.lookup("wire_hol_wait_seconds")
+    return float(pv.read()) if pv is not None else 0.0
+
+# -- p2p ping-pong bandwidth (rank 1 in p0 <-> rank 3 in p1) ---------------
+for size in SIZES:
+    x = np.ones(max(1, size // 4), np.float32)
+    best = None
+    for _ in range(3):
+        world.barrier()
+        if me == 0:
+            t0 = time.perf_counter()
+            world.send(x, 3, tag=11, rank=1)
+            v, _st = world.recv(source=3, tag=12, rank=1)
+            dt = time.perf_counter() - t0
+            assert np.asarray(v).shape == x.shape
+            best = dt if best is None else min(best, dt)
+        elif me == 1:
+            v, _st = world.recv(source=1, tag=11, rank=3)
+            world.send(np.asarray(v), 1, tag=12, rank=3)
+    if me == 0:
+        lines.append({
+            "metric": "wire_p2p_%%dMiB" %% (size >> 20),
+            "value": round(2 * size / best / 1e9, 4), "unit": "GB/s",
+            "vs_baseline": None, "suite": "wire", "rtt_s": round(best, 5),
+        })
+
+# -- two concurrent large transfers, distinct tags: lanes 4 vs 1 -----------
+hol_size = HOL_MIB << 20
+xh = np.ones(hol_size // 4, np.float32)
+for lanes in (4, 1):
+    mca_var.set_value("wire_p2p_lanes", lanes)
+    world.barrier()
+    h0 = _hol()
+    world.barrier()
+    if me == 0:
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=lambda: world.send(xh, 3, tag=1,
+                                                         rank=0)),
+              threading.Thread(target=lambda: world.send(xh, 3, tag=2,
+                                                         rank=1))]
+        for t in ts: t.start()
+        for t in ts: t.join()
+        wall = time.perf_counter() - t0
+    elif me == 1:
+        world.recv(source=1, tag=2, rank=3)
+        world.recv(source=0, tag=1, rank=3)
+    world.barrier()
+    if me == 0:
+        lines.append({
+            "metric": "wire_hol_2x%%dMiB_lanes%%d" %% (HOL_MIB, lanes),
+            "value": round(_hol() - h0, 4), "unit": "hol_wait_s",
+            "vs_baseline": None, "suite": "wire",
+            "wall_s": round(wall, 4),
+        })
+mca_var.VARS.unset("wire_p2p_lanes")
+
+# -- spanning-comm allgatherv round: three wire configurations -------------
+#   pipelined     zero-copy fragments + overlapped reap (the PR path)
+#   legacy_frames wire_pipeline_segsize=0 (tobytes + ordered join)
+#   sequential    pipelined frames, fixed process-order reap
+agv = np.arange((AGV_MIB << 20) // 4, dtype=np.float32)
+bufs = [agv + r for r in world.local_comm_ranks]
+configs = (("pipelined", 1 << 20, True),
+           ("legacy_frames", 0, True),
+           ("sequential", 1 << 20, False))
+times = {}
+for key, seg, overlap in configs:
+    mca_var.set_value("wire_pipeline_segsize", seg)
+    mca_var.set_value("wire_overlap_exchange", overlap)
+    world.barrier()
+    best = None
+    for _ in range(3):
+        world.barrier()
+        t0 = time.perf_counter()
+        out = world.allgatherv(bufs)
+        dt = time.perf_counter() - t0
+        assert np.asarray(out).shape[0] == world.size * agv.shape[0]
+        best = dt if best is None else min(best, dt)
+    times[key] = best
+mca_var.VARS.unset("wire_pipeline_segsize")
+mca_var.VARS.unset("wire_overlap_exchange")
+
+# -- skewed exchange: time-to-first-data, arrival order vs process order ---
+# Process 1 (FIRST in reap order) enters its round late; the overlap
+# reap returns process 2's payload almost immediately while the
+# sequential baseline parks on the slow peer — the latency a pipelined
+# consumer of early rows actually feels.
+SKEW_S = 0.4
+first = {}
+rt_router = rt.wire
+for key, overlap in (("overlap", True), ("sequential", False)):
+    world.barrier()
+    if me == 0:
+        t0 = time.perf_counter()
+        if overlap:
+            pending = {1: 1, 2: 1}
+            src, _arr = rt_router.coll_recv_any(world, pending)
+            first[key] = time.perf_counter() - t0
+            pending[src] -= 1
+            while sum(pending.values()):
+                s2, _ = rt_router.coll_recv_any(world, pending)
+                pending[s2] -= 1
+        else:
+            _ = rt_router.coll_recv(world, 1)   # parks on the slow peer
+            first[key] = time.perf_counter() - t0
+            _ = rt_router.coll_recv(world, 2)
+    elif me == 1:
+        time.sleep(SKEW_S)
+        rt_router.coll_send(world, 0, agv)
+    else:
+        rt_router.coll_send(world, 0, agv)
+    world.barrier()
+
+if me == 0:
+    for key, _seg, _ov in configs:
+        lines.append({
+            "metric": "wire_allgatherv_%%dMiB_%%s" %% (AGV_MIB, key),
+            "value": round(times[key], 4), "unit": "s",
+            "vs_baseline": None, "suite": "wire",
+        })
+    lines.append({
+        "metric": "wire_allgatherv_pipeline_speedup",
+        "value": round(times["legacy_frames"]
+                       / max(times["pipelined"], 1e-9), 4),
+        "unit": "x_vs_legacy_framing", "vs_baseline": None,
+        "suite": "wire",
+    })
+    lines.append({
+        "metric": "wire_allgatherv_overlap_speedup",
+        "value": round(times["sequential"]
+                       / max(times["pipelined"], 1e-9), 4),
+        "unit": "x_vs_sequential", "vs_baseline": None, "suite": "wire",
+    })
+    lines.append({
+        "metric": "wire_skewed_first_data_overlap",
+        "value": round(first["overlap"], 4), "unit": "s",
+        "vs_baseline": None, "suite": "wire",
+        "sequential_s": round(first["sequential"], 4),
+        "first_data_speedup": round(
+            first["sequential"] / max(first["overlap"], 1e-9), 2),
+        "skew_s": SKEW_S,
+        "pvars": {k: v for k, v in pvar.PVARS.read_all().items()
+                  if k.startswith(("wire_", "btl_dcn_"))},
+        "cumulative": True,
+    })
+    with open(os.environ["OMPITPU_WIRE_BENCH_OUT"], "w") as f:
+        json.dump(lines, f)
+world.barrier()
+mpi.finalize()
+'''
+
+
+def _wire_micro_suite(backend_label):
+    """Cross-process wire lines: p2p ping-pong bandwidth (1 MiB up to
+    256 MiB on full machines), two concurrent distinct-tag transfers
+    under 4 lanes vs 1 (the head-of-line pvar is the metric), and a
+    spanning-comm allgatherv with overlapped vs sequential reaping —
+    all through a REAL 3-process tpurun job, CPU mesh (the wire rides
+    host sockets/shm either way). Same labelled CPU fallback contract
+    as every other line: ``backend`` marks tpu_unavailable rounds."""
+    import os
+    import sys as _sys
+    import tempfile
+
+    from ompi_release_tpu.tools.tpurun import Job
+
+    full = backend_label is None
+    sizes = [1 << 20, 16 << 20, 64 << 20, 256 << 20] if full else \
+        [1 << 20, 4 << 20, 16 << 20]
+    with tempfile.TemporaryDirectory() as td:
+        app = os.path.join(td, "wire_bench_app.py")
+        out_path = os.path.join(td, "wire_bench.json")
+        with open(app, "w") as f:
+            f.write(_WIRE_BENCH_APP % {"repo": os.path.dirname(
+                os.path.abspath(__file__))})
+        env_keep = dict(os.environ)
+        os.environ["OMPITPU_WIRE_BENCH_SIZES"] = json.dumps(sizes)
+        os.environ["OMPITPU_WIRE_BENCH_OUT"] = out_path
+        os.environ["OMPITPU_WIRE_BENCH_HOL_MIB"] = "32" if full else "8"
+        os.environ["OMPITPU_WIRE_BENCH_AGV_MIB"] = "4" if full else "1"
+        try:
+            job = Job(3, [_sys.executable, app], [], heartbeat_s=0.5,
+                      miss_limit=8)
+            rc = job.run(timeout_s=420 if full else 240)
+        finally:
+            os.environ.clear()
+            os.environ.update(env_keep)
+        if rc != 0 or not os.path.exists(out_path):
+            return [{"metric": "wire_micro_suite", "value": None,
+                     "unit": None, "vs_baseline": None,
+                     "error": f"wire bench job rc={rc}"}]
+        with open(out_path) as f:
+            lines = json.load(f)
+    if backend_label:
+        for ln in lines:
+            ln["backend"] = backend_label
+    return lines
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -956,6 +1188,18 @@ def main():
     except Exception as e:
         lines.append({
             "metric": "coll_micro_suite", "value": None, "unit": None,
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}"[:300],
+        })
+
+    # wire micro-suite: cross-process p2p bandwidth, lane-concurrency
+    # head-of-line wait, and spanning-comm allgatherv overlap — the
+    # cross-process bandwidth trajectory line
+    try:
+        lines.extend(_wire_micro_suite(backend_label))
+    except Exception as e:
+        lines.append({
+            "metric": "wire_micro_suite", "value": None, "unit": None,
             "vs_baseline": None,
             "error": f"{type(e).__name__}: {e}"[:300],
         })

@@ -32,6 +32,8 @@ import uuid
 import numpy as np
 
 from ..mca import component as mca_component
+from ..mca import pvar as _pvar
+from ..mca import var as mca_var
 from ..native import USER_TAG_BASE
 from ..utils.errors import ErrorCode, MPIError
 from . import base
@@ -42,7 +44,51 @@ from . import base
 #: as a header or delivered to the wrong transfer
 _HDR_MAGIC = "SGH1"
 _CHUNK_MAGIC = b"SGC1"
+#: pipelined staged framing (``wire_pipeline_segsize`` > 0): chunks
+#: carry an explicit fragment index so the receiver reassembles into a
+#: PREALLOCATED buffer at ``idx * segsize`` (no join copy) and a late
+#: or reordered fragment still lands at its own offset
+_HDR2_MAGIC = "SGH2"
+_CHUNK2_MAGIC = b"SGC2"
 _xfer_ids = itertools.count(1)
+
+#: bytes shipped as memoryview slices over the source buffer instead
+#: of a monolithic ``tobytes()`` materialization (the wire layer's
+#: zero-copy discipline; shared registration with runtime/wire.py)
+_zero_copy_bytes = _pvar.counter(
+    "wire_bytes_zero_copy",
+    "payload bytes sent/received through memoryview slices or "
+    "preallocated-buffer views instead of whole-array copies",
+)
+_frags_inflight = _pvar.highwatermark(
+    "wire_frags_inflight",
+    "high watermark of pipeline fragments announced but not yet "
+    "reassembled for a single staged transfer",
+)
+
+
+def register_pipeline_vars() -> None:
+    """Wire-pipeline cvars live HERE (the transport that reads them)
+    so any staged-path user — the wire router, tpu-tune's loopback
+    sweep, a bare DcnBtl — sees them registered; runtime/wire.py
+    re-exports through its own register_vars."""
+    mca_var.register(
+        "wire_pipeline_segsize", "size", 1 << 20,
+        "Bytes per in-flight wire fragment for cross-process payloads "
+        "(the ob1 RNDV pipeline's fragment size): payloads cross as "
+        "zero-copy memoryview slices reassembled into a preallocated "
+        "receive buffer; 0 restores the legacy single-pass tobytes() "
+        "framing",
+    )
+    mca_var.register(
+        "wire_pipeline_depth", "int", 4,
+        "Fragments enqueued per destination per round-robin turn when "
+        "one exchange posts transfers to several peers (the sliding "
+        "in-flight window of coll_send_all striping)",
+    )
+
+
+register_pipeline_vars()  # idempotent; read on every staged send
 
 
 def _check_user_tag(tag: int) -> None:
@@ -244,15 +290,79 @@ class DcnBtl(base.BtlModule):
     # -- cross-process staged path (the honest multi-controller route) ----
     _recv_from = staticmethod(stashed_recv)  # kept as the historical name
 
+    def pipeline_segsize(self) -> int:
+        """Effective pipelined-fragment size: the ``wire_pipeline_segsize``
+        cvar clamped to this btl's max frame size; 0 = the legacy
+        monolithic ``tobytes()`` framing (exact pre-pipeline path)."""
+        seg = int(mca_var.get("wire_pipeline_segsize", 0) or 0)
+        if seg <= 0:
+            return 0
+        return min(seg, max(1, self.max_send_size))
+
+    def staged_frames(self, data, *, segsize: int):
+        """Yield the wire frames of ONE pipelined staged transfer:
+        header first, then idx-stamped fragments whose payloads are
+        memoryview slices over the source buffer (no whole-array
+        ``tobytes()`` materialization). The caller owns the actual
+        ``oob_ep.send`` calls, so frames from several transfers bound
+        for DIFFERENT peers can be striped round-robin (the sliding
+        in-flight window the wire router's ``coll_send_all`` drives).
+
+        Sender-side pvar accounting lives HERE — the single place that
+        knows frames — so ``send_staged`` and the router's striping
+        path can never drift: chunks count as they are yielded, bytes
+        count once when the stream completes."""
+        import zlib
+
+        from ..native import DssBuffer
+
+        arr = np.ascontiguousarray(np.asarray(data))
+        # uint8 reinterpret instead of memoryview(arr): extension
+        # dtypes (bfloat16) don't implement the buffer protocol
+        mv = memoryview(arr.reshape(-1).view(np.uint8)) if arr.size \
+            else memoryview(b"")
+        nbytes = len(mv)
+        chunk = max(1, int(segsize))
+        nchunks = max(1, -(-nbytes // chunk))
+        xfer = next(_xfer_ids)
+        hdr = DssBuffer()
+        hdr.pack_string(_HDR2_MAGIC)
+        hdr.pack_int64(xfer)
+        _pack_array_header(hdr, arr)
+        hdr.pack_int64([nchunks, chunk])
+        # end-to-end payload CRC (the opal_datatype_checksum role):
+        # one read pass over the source view, no copy
+        hdr.pack_int64(zlib.crc32(mv))
+        yield hdr.tobytes()
+        xb = _CHUNK2_MAGIC + int(xfer).to_bytes(8, "big")
+        for i in range(nchunks):
+            sl = mv[i * chunk:(i + 1) * chunk]
+            _zero_copy_bytes.add(len(sl))
+            yield b"".join((xb, int(i).to_bytes(8, "big"), sl))
+            self.staged_chunks_pvar.add()
+        self.staged_bytes_pvar.add(nbytes)
+
     def send_staged(self, oob_ep, peer_nid: int, tag: int, data) -> int:
-        """Stream ``data`` to ``peer_nid`` over the OOB in
-        max_send_size chunks. Returns the number of chunks sent. Every
-        frame carries a transfer id so a receiver that abandoned an
-        earlier transfer resynchronizes instead of parsing orphan
-        chunks as headers."""
+        """Stream ``data`` to ``peer_nid`` over the OOB in chunks.
+        Returns the number of chunks sent. Every frame carries a
+        transfer id so a receiver that abandoned an earlier transfer
+        resynchronizes instead of parsing orphan chunks as headers.
+
+        With ``wire_pipeline_segsize`` > 0 the transfer is pipelined:
+        segsize-bounded fragments sliced straight off the source
+        buffer (:meth:`staged_frames`); with 0 the exact legacy
+        monolithic path runs (whole-array ``tobytes()``, max_send_size
+        chunks, ordered join on receive)."""
         from ..native import DssBuffer
 
         _check_user_tag(tag)
+        seg = self.pipeline_segsize()
+        if seg > 0:
+            nframes = 0
+            for frame in self.staged_frames(data, segsize=seg):
+                oob_ep.send(peer_nid, tag, frame)
+                nframes += 1
+            return nframes - 1  # header is not a chunk
         xfer = next(_xfer_ids)
         arr = np.ascontiguousarray(np.asarray(data))
         raw = arr.tobytes()
@@ -280,11 +390,19 @@ class DcnBtl(base.BtlModule):
         return nchunks
 
     def recv_staged(self, oob_ep, tag: int, *, src=None,
-                    dst_device=None, timeout_ms: int = 30_000):
+                    dst_device=None, timeout_ms: int = 30_000,
+                    first=None):
         """Reassemble one staged transfer; places the result on
         ``dst_device`` (default: this process's first device). All
         chunk frames are matched to the header's source, so transfers
-        from different peers on one tag cannot interleave."""
+        from different peers on one tag cannot interleave. The
+        receiver accepts BOTH framings regardless of its local cvar:
+        legacy ordered chunks are joined; pipelined idx-stamped
+        fragments land in a preallocated buffer at their own offsets
+        and the result is a ``np.frombuffer`` view over it (no join
+        copy). ``first`` is an already-popped ``(src_nid, frame)``
+        pair to resume from — the wire router's any-source reaping
+        peeks the first frame to pick the readiest peer."""
         import time as _time
 
         import jax
@@ -296,37 +414,83 @@ class DcnBtl(base.BtlModule):
         # resync: discard frames until a valid header (orphan chunks
         # from an abandoned transfer must not be parsed as headers)
         while True:
-            src_got, hraw = self._recv_from(oob_ep, src, tag, deadline)
+            if first is not None:
+                src_got, hraw = first
+                first = None
+            else:
+                src_got, hraw = self._recv_from(oob_ep, src, tag,
+                                                deadline)
             try:
                 hdr = DssBuffer(hraw)
-                if hdr.unpack_string() != _HDR_MAGIC:
+                magic = hdr.unpack_string()
+                if magic not in (_HDR_MAGIC, _HDR2_MAGIC):
                     continue
                 (xfer,) = hdr.unpack_int64()
                 dtype, shape = _unpack_array_header(hdr)
-                (nchunks,) = hdr.unpack_int64()
+                if magic == _HDR2_MAGIC:
+                    nchunks, chunk = hdr.unpack_int64(2)
+                else:
+                    (nchunks,) = hdr.unpack_int64()
+                    chunk = 0
                 (crc,) = hdr.unpack_int64()
             except MPIError:
                 continue  # a chunk frame: skip to the next header
             src = src_got
             break
-        want = _CHUNK_MAGIC + int(xfer).to_bytes(8, "big")
-        parts = []
-        while len(parts) < int(nchunks):
-            _, praw = self._recv_from(oob_ep, src, tag, deadline)
-            if not praw.startswith(want):
-                continue  # stale chunk from an abandoned transfer
-            parts.append(praw[len(want):])
-            self.staged_chunks_pvar.add()
         import zlib
 
-        raw = b"".join(parts)
-        if zlib.crc32(raw) != int(crc):
-            raise MPIError(
-                ErrorCode.ERR_TRUNCATE,
-                f"staged transfer {xfer} failed its payload CRC — "
-                "wire corruption or interleaved frames",
-            )
-        arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
+        if magic == _HDR2_MAGIC:
+            nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            if nbytes < 0 or any(d < 0 for d in shape):
+                raise MPIError(ErrorCode.ERR_TRUNCATE,
+                               f"staged transfer {xfer}: malformed "
+                               f"shape {shape}")
+            buf = bytearray(nbytes)
+            bmv = memoryview(buf)
+            want = _CHUNK2_MAGIC + int(xfer).to_bytes(8, "big")
+            _frags_inflight.set(int(nchunks))
+            got = 0
+            while got < int(nchunks):
+                _, praw = self._recv_from(oob_ep, src, tag, deadline)
+                if not praw.startswith(want):
+                    continue  # stale frame from an abandoned transfer
+                idx = int.from_bytes(praw[12:20], "big")
+                payload = memoryview(praw)[20:]
+                off = idx * int(chunk)
+                if idx >= int(nchunks) or off + len(payload) > nbytes:
+                    raise MPIError(
+                        ErrorCode.ERR_TRUNCATE,
+                        f"staged transfer {xfer}: fragment {idx} "
+                        f"overruns the {nbytes}-byte buffer",
+                    )
+                bmv[off:off + len(payload)] = payload
+                got += 1
+                self.staged_chunks_pvar.add()
+            if zlib.crc32(bmv) != int(crc):
+                raise MPIError(
+                    ErrorCode.ERR_TRUNCATE,
+                    f"staged transfer {xfer} failed its payload CRC — "
+                    "wire corruption or interleaved frames",
+                )
+            _zero_copy_bytes.add(nbytes)
+            arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+        else:
+            want = _CHUNK_MAGIC + int(xfer).to_bytes(8, "big")
+            parts = []
+            while len(parts) < int(nchunks):
+                _, praw = self._recv_from(oob_ep, src, tag, deadline)
+                if not praw.startswith(want):
+                    continue  # stale chunk from an abandoned transfer
+                parts.append(praw[len(want):])
+                self.staged_chunks_pvar.add()
+            raw = b"".join(parts)
+            if zlib.crc32(raw) != int(crc):
+                raise MPIError(
+                    ErrorCode.ERR_TRUNCATE,
+                    f"staged transfer {xfer} failed its payload CRC — "
+                    "wire corruption or interleaved frames",
+                )
+            arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
         self.staged_bytes_pvar.add(arr.nbytes)
         if dst_device is None:
             dst_device = jax.local_devices()[0]
@@ -571,11 +735,13 @@ class ShmBtl(base.BtlModule):
         return name
 
     def recv_shm(self, oob_ep, tag: int, *, src=None, dst_device=None,
-                 timeout_ms: int = 30_000):
+                 timeout_ms: int = 30_000, first=None):
         """Map the announced segment, device_put out of it (the single
         copy), unlink. ``src`` filters control frames by sender node id
         (frames from other senders on the same tag are stashed for
-        their own consumer — same discipline as the staged path)."""
+        their own consumer — same discipline as the staged path).
+        ``first`` is an already-popped ``(src_nid, frame)`` pair to
+        resume from (the wire router's any-source reaping)."""
         import time as _time
 
         from multiprocessing import shared_memory
@@ -586,7 +752,10 @@ class ShmBtl(base.BtlModule):
 
         _check_user_tag(tag)
         deadline = _time.monotonic() + timeout_ms / 1000
-        _, raw = stashed_recv(oob_ep, src, tag, deadline)
+        if first is not None:
+            _, raw = first
+        else:
+            _, raw = stashed_recv(oob_ep, src, tag, deadline)
         frame = DssBuffer(raw)
         name = frame.unpack_string()
         dtype, shape = _unpack_array_header(frame)

@@ -26,6 +26,15 @@ The inter step is linear (every process exchanges with every peer):
 honest O(P^2) messaging that is fine at realistic controller counts;
 the pvar ``hier_inter_bytes`` counts exactly what crossed a process
 boundary so the two-level byte reduction vs flat is measurable.
+
+Exchange overlap (``wire_overlap_exchange``, default on): every round
+posts ALL its sends first — striped across peers in pipelined fragment
+bursts by ``WireRouter.coll_send_all`` — then reaps receives in
+ARRIVAL order (``coll_recv_any``), so one slow peer no longer blocks
+the reap of peers whose data already landed, the failure mode of the
+old fixed-process-order ``self._recv(p)`` loops. Per-peer FIFO order
+still holds (the OOB guarantees it), so multi-message rounds keep
+their member ordering.
 """
 
 from __future__ import annotations
@@ -37,6 +46,7 @@ import numpy as np
 
 from ..mca import component as mca_component
 from ..mca import pvar
+from ..mca import var as mca_var
 from ..ops.op import Op
 from ..utils import output
 from ..utils.errors import ErrorCode, MPIError
@@ -91,6 +101,10 @@ class _HierModule:
     def peers(self) -> List[int]:
         return [p for p in self.procs if p != self.my_pidx]
 
+    @staticmethod
+    def _overlap() -> bool:
+        return bool(mca_var.get("wire_overlap_exchange", True))
+
     def _send(self, peer: int, arr) -> None:
         arr = np.asarray(arr)
         self.router.coll_send(self.comm, peer, arr)
@@ -102,18 +116,50 @@ class _HierModule:
         _inter_msgs.add()
         return out
 
+    def _send_all(self, sends: Dict[int, list]) -> None:
+        """Post one round's sends to every peer, striped across
+        destinations in pipelined fragment bursts (same pvar
+        accounting as per-peer :meth:`_send`)."""
+        self.router.coll_send_all(self.comm, sends)
+        for arrs in sends.values():
+            for a in arrs:
+                _inter_msgs.add()
+                _inter_bytes.add(int(a.nbytes))
+
+    def _reap(self, pending: Dict[int, int],
+              on_arrival: Callable[[int, np.ndarray], None]) -> None:
+        """Reap ``pending[p]`` messages per peer in ARRIVAL order —
+        a slow peer never blocks the reap of one whose data already
+        landed (the posted-sends overlap the module docstring pins)."""
+        left = sum(pending.values())
+        while left:
+            src, arr = self.router.coll_recv_any(self.comm, pending)
+            _inter_msgs.add()
+            pending[src] -= 1
+            left -= 1
+            on_arrival(src, np.asarray(arr))
+
     def _exchange(self, arrs_for: Dict[int, list]) -> Dict[int, list]:
         """Linear inter-process exchange: send every peer its arrays,
-        then receive the same count back from each peer in process
-        order (all sends land before any recv parks — deadlock-free
-        for the linear pattern)."""
-        for p in self.peers:
-            for a in arrs_for.get(p, []):
-                self._send(p, a)
-        got: Dict[int, list] = {}
-        for p in self.peers:
-            got[p] = [self._recv(p)
-                      for _ in range(len(arrs_for.get(p, [])))]
+        then receive the same count back from each peer (all sends
+        land before any recv parks — deadlock-free for the linear
+        pattern). Receives reap in arrival order unless
+        ``wire_overlap_exchange`` pins the sequential baseline."""
+        sends = {p: [np.asarray(a) for a in arrs_for.get(p, [])]
+                 for p in self.peers}
+        if not self._overlap():
+            for p in self.peers:
+                for a in sends[p]:
+                    self._send(p, a)
+            got_seq: Dict[int, list] = {}
+            for p in self.peers:
+                got_seq[p] = [self._recv(p)
+                              for _ in range(len(sends[p]))]
+            return got_seq
+        self._send_all(sends)
+        got: Dict[int, list] = {p: [] for p in self.peers}
+        self._reap({p: len(sends[p]) for p in self.peers},
+                   lambda src, arr: got[src].append(arr))
         return got
 
     def _check_local_axis(self, x, what: str) -> None:
@@ -259,8 +305,11 @@ class _HierModule:
         if owner == self.my_pidx:
             self._check_local_axis(x, "bcast")
             val = np.asarray(x[self.local_ranks.index(root)])
-            for p in self.peers:
-                self._send(p, val)
+            if self._overlap():
+                self._send_all({p: [val] for p in self.peers})
+            else:
+                for p in self.peers:
+                    self._send(p, val)
         else:
             val = self._recv(owner)
         return self._bcast_local_axis(val)
@@ -289,10 +338,16 @@ class _HierModule:
         rows: Dict[int, np.ndarray] = {}
         for pos, r in enumerate(self.members_of[self.my_pidx]):
             rows[r] = block[pos]
-        for p in self.peers:
-            pblock = self._recv(p)
+
+        def place(p: int, pblock: np.ndarray) -> None:
             for pos, r in enumerate(self.members_of[p]):
                 rows[r] = pblock[pos]
+
+        if self._overlap():
+            self._reap({p: 1 for p in self.peers}, place)
+        else:
+            for p in self.peers:
+                place(p, self._recv(p))
         full = self._cat([rows[r] for r in range(comm.size)])
         out = np.zeros((self.local_n,) + full.shape, full.dtype)
         out[self.local_ranks.index(root)] = full
@@ -311,8 +366,12 @@ class _HierModule:
                     f"divisible by comm size {n}",
                 )
             chunks = full.reshape((n, -1) + full.shape[1:])
-            for p in self.peers:
-                self._send(p, chunks[self.members_of[p]])
+            if self._overlap():
+                self._send_all({p: [chunks[self.members_of[p]]]
+                                for p in self.peers})
+            else:
+                for p in self.peers:
+                    self._send(p, chunks[self.members_of[p]])
             mine = chunks[self.members_of[self.my_pidx]]
         else:
             mine = self._recv(owner)  # (local_n, chunk...)
@@ -446,13 +505,24 @@ class _HierModule:
         """Every rank's ragged buffer: send each LOCAL member's buffer
         as its own message (shapes ride the wire, so no count
         pre-exchange), receive each peer's members' in comm-rank
-        order."""
-        for p in self.peers:
-            for b in bufs:
-                self._send(p, b)
+        order (per-peer FIFO keeps member order under arrival-order
+        reaping)."""
         rows: Dict[int, np.ndarray] = {
             r: bufs[pos] for pos, r in enumerate(self.local_ranks)
         }
+        if self._overlap():
+            self._send_all({p: list(bufs) for p in self.peers})
+            slots = {p: list(self.members_of[p]) for p in self.peers}
+
+            def place(p: int, arr: np.ndarray) -> None:
+                rows[slots[p].pop(0)] = arr
+
+            self._reap({p: len(self.members_of[p])
+                        for p in self.peers}, place)
+            return rows
+        for p in self.peers:
+            for b in bufs:
+                self._send(p, b)
         for p in self.peers:
             for r in self.members_of[p]:
                 rows[r] = self._recv(p)
@@ -487,9 +557,16 @@ class _HierModule:
         rows: Dict[int, np.ndarray] = {
             r: bufs[pos] for pos, r in enumerate(self.local_ranks)
         }
-        for p in self.peers:
-            for r in self.members_of[p]:
-                rows[r] = self._recv(p)
+        if self._overlap():
+            slots = {p: list(self.members_of[p]) for p in self.peers}
+            self._reap(
+                {p: len(self.members_of[p]) for p in self.peers},
+                lambda p, arr: rows.__setitem__(slots[p].pop(0), arr),
+            )
+        else:
+            for p in self.peers:
+                for r in self.members_of[p]:
+                    rows[r] = self._recv(p)
         return jnp.asarray(np.concatenate([rows[r] for r in range(n)]))
 
     def scatterv(self, comm, sendbuf, counts, root: int):
@@ -521,9 +598,13 @@ class _HierModule:
             )
         offs = np.concatenate([[0], np.cumsum(counts)])
         chunks = [buf[offs[j]:offs[j] + counts[j]] for j in range(n)]
-        for p in self.peers:
-            for j in self.members_of[p]:
-                self._send(p, chunks[j])
+        if self._overlap():
+            self._send_all({p: [chunks[j] for j in self.members_of[p]]
+                            for p in self.peers})
+        else:
+            for p in self.peers:
+                for j in self.members_of[p]:
+                    self._send(p, chunks[j])
         return [jnp.asarray(chunks[j]) for j in self.local_ranks]
 
     def reduce_scatter(self, comm, x, recvcounts, op: Op):

@@ -358,6 +358,16 @@ class Window:
         if self._pending:
             raise MPIError(ErrorCode.ERR_RMA_SYNC,
                            "free with unsynchronized RMA operations")
+        # MPI_Win_free runs the attribute delete callbacks for every
+        # still-attached user keyval — the same shared attribute
+        # machinery Communicator.free() drains (win.c keyval contract)
+        from ..comm.communicator import _keyval_table
+
+        for kv_id, value in list(self._attrs.items()):
+            kv = _keyval_table.get(kv_id)
+            if kv and kv.delete_fn:
+                kv.delete_fn(self, kv, value, kv.extra_state)
+        self._attrs.clear()
         self._freed = True
 
     # -- RMA operations ----------------------------------------------------

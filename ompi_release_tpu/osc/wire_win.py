@@ -163,10 +163,15 @@ class WinService:
         self._posts: Dict[Tuple[int, int], set] = {}
         self._completes: Dict[Tuple[int, int], set] = {}
         self._pscw_cv = threading.Condition(self._state_lock)
-        #: serializes this process's outbound request+reply pairs so a
-        #: reply on the shared reply channel always belongs to the one
-        #: outstanding request
-        self.outbound = threading.Lock()
+        #: token-demultiplexed replies: every outstanding request
+        #: registers a slot keyed by its token; ONE thread at a time
+        #: pumps the shared WIRE_WIN_REPLY channel (``_pump_lock``) and
+        #: routes each reply — and its RDATA payload — to its slot, so
+        #: any number of threads can have requests in flight and a
+        #: deferred grant for one can never block another's reply
+        self._reply_slots: Dict[int, dict] = {}
+        self._reply_guard = threading.Lock()
+        self._pump_lock = threading.Lock()
         #: per-request token echoed in replies: after a timeout, a
         #: LATE reply must not be mistaken for the retry's (same cid/
         #: seq/kind) — tokens make staleness decidable
@@ -306,74 +311,135 @@ class WinService:
                                       _pack_reads(reads))
 
     # -- origin-side request/reply -----------------------------------------
+    def _send_lock(self, owner_pidx: int) -> threading.Lock:
+        """Per-OWNER outbound framing lock (the router's lazily-created
+        registry): a request envelope and its payload must land
+        back-to-back on the owner's service FIFO, but the lock is held
+        only for the SEND — never across the reply wait (the old
+        process-wide ``outbound`` lock held through deferred
+        lock-grant waits deadlocked a second thread's unlock for up to
+        120 s)."""
+        return self.router._chan_lock("win_send", owner_pidx)
+
+    def _pump_replies(self, deadline: float) -> None:
+        """Pop ONE reply (and its RDATA payload, if any) off the shared
+        reply channel and route it to its token's slot. Caller holds
+        ``_pump_lock``. Replies whose requester already timed out and
+        deregistered are drained and dropped — their RDATA must be
+        consumed here or the NEXT read-carrying reply would unpack the
+        wrong arrays."""
+        from ..btl.components import stashed_recv
+
+        try:
+            src_nid, raw = stashed_recv(self.ep, None, WIRE_WIN_REPLY,
+                                        deadline)
+        except MPIError as e:
+            if e.code is ErrorCode.ERR_PENDING:
+                return  # nothing within the slice; caller re-checks
+            raise  # endpoint closed / link dead: surface it NOW, not
+            #        as a misleading 120 s reply timeout
+        renv = DssBuffer(raw)
+        if renv.unpack_string() != _WIN_MAGIC:
+            raise MPIError(ErrorCode.ERR_INTERN,
+                           "corrupt window reply envelope")
+        rcid, rseq, rkind, n_reads, rtoken = renv.unpack_int64(5)
+        reads: List[np.ndarray] = []
+        if int(n_reads) and int(rkind) != KIND_ERROR:
+            # the owner's service thread sends a reply's RDATA directly
+            # behind its envelope, so consuming it HERE (src-matched)
+            # keeps the per-owner payload stream aligned no matter
+            # which thread's reply this is
+            rdata = self.router._recv_payload(WIRE_WIN_RDATA,
+                                              src_nid - 1)
+            reads = _unpack_reads(rdata, int(n_reads))
+        with self._reply_guard:
+            slot = self._reply_slots.get(int(rtoken))
+            if slot is None:
+                _log.verbose(
+                    1, f"discarding stale window reply (cid={rcid}, "
+                       f"seq={rseq}, kind={rkind}, token={rtoken})")
+                return
+            slot["cid"], slot["seq"] = int(rcid), int(rseq)
+            slot["kind"] = int(rkind)
+            slot["reads"] = reads
+            slot["ev"].set()
+
     def request(self, win: "WireWindow", owner_pidx: int, kind: int,
                 arg1: int, arg2: int,
                 payload: Optional[np.ndarray] = None,
                 timeout_ms: int = 120_000) -> List[np.ndarray]:
         """Send one request to ``owner_pidx`` and await its reply
         (lock grants may be deferred behind another holder, hence the
-        generous timeout). Returns the read arrays."""
-        from ..btl.components import stashed_recv
+        generous timeout). Returns the read arrays.
 
+        Concurrency: the reply channel is demultiplexed by token, so
+        any number of threads may have requests outstanding — while a
+        thread waits for a deferred lock grant, the thread whose
+        unlock PRODUCES that grant proceeds through its own
+        request/reply unimpeded (the ADVICE r5 two-thread deadlock)."""
         token = next(self._token)
-        env = DssBuffer()
-        env.pack_string(_WIN_MAGIC)
-        env.pack_int64([win.comm.cid, win.win_seq, kind, arg1, arg2,
-                        token])
-        with self.outbound:
-            self.router._retry(
-                lambda: self.ep.send(self.router._nid(owner_pidx),
-                                     WIRE_WIN_SERVICE, env.tobytes()),
-                f"window request to process {owner_pidx}",
-            )
-            if payload is not None:
-                self.router._send_payload(owner_pidx, WIRE_WIN_DATA,
-                                          payload)
+        slot = {"ev": threading.Event(), "reads": None, "kind": None,
+                "cid": -1, "seq": -1}
+        with self._reply_guard:
+            self._reply_slots[token] = slot
+        try:
+            env = DssBuffer()
+            env.pack_string(_WIN_MAGIC)
+            env.pack_int64([win.comm.cid, win.win_seq, kind, arg1, arg2,
+                            token])
+            with self._send_lock(owner_pidx):
+                self.router._retry(
+                    lambda: self.ep.send(self.router._nid(owner_pidx),
+                                         WIRE_WIN_SERVICE, env.tobytes()),
+                    f"window request to process {owner_pidx}",
+                )
+                if payload is not None:
+                    self.router._send_payload(owner_pidx, WIRE_WIN_DATA,
+                                              payload)
             deadline = time.monotonic() + timeout_ms / 1000
-            while True:
-                _, raw = stashed_recv(self.ep,
-                                      self.router._nid(owner_pidx),
-                                      WIRE_WIN_REPLY, deadline)
-                renv = DssBuffer(raw)
-                if renv.unpack_string() != _WIN_MAGIC:
-                    raise MPIError(ErrorCode.ERR_INTERN,
-                                   "corrupt window reply envelope")
-                rcid, rseq, rkind, n_reads, rtoken = renv.unpack_int64(5)
-                if int(rtoken) != token:
-                    # STALE: a reply whose requester timed out/abandoned
-                    # (the token makes this decidable even for a retry
-                    # with identical cid/seq/kind). Its RDATA payload —
-                    # if any — must be drained or the NEXT read-carrying
-                    # reply would unpack the wrong arrays
-                    if int(n_reads) and int(rkind) != KIND_ERROR:
-                        self.router._recv_payload(WIRE_WIN_RDATA,
-                                                  owner_pidx)
-                    _log.verbose(
-                        1, f"discarding stale window reply (cid={rcid}, "
-                           f"seq={rseq}, kind={rkind}, token={rtoken}) "
-                           f"while awaiting token {token}")
-                    continue
-                if int(rkind) == KIND_ERROR:
+            while not slot["ev"].is_set():
+                # one thread at a time pumps the shared channel; the
+                # others park on their event (woken the instant the
+                # pump routes their reply) — whoever holds the pump
+                # routes EVERY arriving reply to its waiter
+                if self._pump_lock.acquire(blocking=False):
+                    try:
+                        if slot["ev"].is_set():
+                            break
+                        self._pump_replies(time.monotonic() + 0.2)
+                    finally:
+                        self._pump_lock.release()
+                else:
+                    slot["ev"].wait(timeout=0.02)
+                if slot["ev"].is_set():
+                    break
+                if time.monotonic() >= deadline:
                     raise MPIError(
-                        ErrorCode.ERR_RMA_SYNC,
-                        f"window request (kind {kind}) failed at its "
-                        f"home process {owner_pidx} — bad payload "
-                        "shape/dtype for the target window?",
+                        ErrorCode.ERR_PENDING,
+                        f"window request (kind {kind}) to process "
+                        f"{owner_pidx} got no reply within "
+                        f"{timeout_ms / 1000:.0f}s",
                     )
-                if (int(rcid), int(rseq), int(rkind)) != (
-                        win.comm.cid, win.win_seq, kind):
-                    raise MPIError(
-                        ErrorCode.ERR_INTERN,
-                        f"window reply token {token} carries "
-                        f"(cid={rcid}, seq={rseq}, kind={rkind}), "
-                        f"expected (cid={win.comm.cid}, "
-                        f"seq={win.win_seq}, kind={kind})",
-                    )
-                if int(n_reads):
-                    rdata = self.router._recv_payload(WIRE_WIN_RDATA,
-                                                      owner_pidx)
-                    return _unpack_reads(rdata, int(n_reads))
-                return []
+        finally:
+            with self._reply_guard:
+                self._reply_slots.pop(token, None)
+        if slot["kind"] == KIND_ERROR:
+            raise MPIError(
+                ErrorCode.ERR_RMA_SYNC,
+                f"window request (kind {kind}) failed at its "
+                f"home process {owner_pidx} — bad payload "
+                "shape/dtype for the target window?",
+            )
+        if (slot["cid"], slot["seq"], slot["kind"]) != (
+                win.comm.cid, win.win_seq, kind):
+            raise MPIError(
+                ErrorCode.ERR_INTERN,
+                f"window reply token {token} carries "
+                f"(cid={slot['cid']}, seq={slot['seq']}, "
+                f"kind={slot['kind']}), expected (cid={win.comm.cid}, "
+                f"seq={win.win_seq}, kind={kind})",
+            )
+        return slot["reads"] or []
 
     # -- PSCW notices (one-way; no reply awaited) --------------------------
     def notify(self, dst_pidx: int, win: "WireWindow", kind: int) -> None:

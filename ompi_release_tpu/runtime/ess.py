@@ -23,6 +23,25 @@ from ..utils import output
 _log = output.stream("ess")
 
 
+def read_stdin_secret(stream) -> str:
+    """One line of ``stream`` as the job secret (OMPITPU_SECRET_STDIN
+    rsh handoff). An empty line / EOF means the launcher died or the
+    pipe was misplumbed — that MUST fail the launch loudly: silently
+    proceeding would disable auth on this endpoint and surface later
+    as an inexplicable connect hang against the authenticated HNP."""
+    from ..utils.errors import ErrorCode, MPIError
+
+    secret = stream.readline().strip()
+    if not secret:
+        raise MPIError(
+            ErrorCode.ERR_OTHER,
+            "OMPITPU_SECRET_STDIN=1 but stdin closed before a job "
+            "secret arrived (launcher died, or the rsh pipe was not "
+            "plumbed) — refusing to start with auth silently disabled",
+        )
+    return secret
+
+
 class SingletonEss(mca_component.Component):
     """Single-controller bootstrap: all visible devices, process 0."""
 
@@ -137,7 +156,7 @@ class TpurunEss(mca_component.Component):
             import sys as _sys
 
             os.environ["OMPITPU_JOB_SECRET"] = \
-                _sys.stdin.readline().strip()
+                read_stdin_secret(_sys.stdin)
         agent = coord.WorkerAgent(node_id, host, int(port))
         card = {
             "node_id": node_id,

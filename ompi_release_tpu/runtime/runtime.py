@@ -83,6 +83,10 @@ class Runtime:
 
             # 1. core vars + CLI
             mesh_mod.register_vars()
+            from .wire import register_vars as _wire_register_vars
+
+            _wire_register_vars()  # wire transport cvars: visible to
+            #                        tpu_info/CLI even in singleton mode
             mca_var.register(
                 "runtime_abort_on_error", "bool", True,
                 "Abort the process on unhandled MPI errors "

@@ -15,20 +15,41 @@ collectives use those transports *through the public API*:
 - every worker holds a live OOB link to every peer (full wire-up runs
   during the ESS bootstrap, gated by the init barrier);
 - p2p messages are an envelope frame (cid, src/dst comm ranks, user
-  tag, sync flag, seq) followed by the btl payload on a per-destination
-  channel tag — the receiving process drains its channels into the
-  normal PML matching queues, so ordering and wildcards keep MPI
-  semantics;
+  tag, sync flag, seq, delivery order) followed by the btl payload on
+  a per-(destination, lane) channel tag — the receiving process drains
+  its channels into the normal PML matching queues, so ordering and
+  wildcards keep MPI semantics;
 - collectives get per-communicator payload and control channels used
   by the ``hier`` coll component for the inter-process combine step.
+
+**Pipelined wire transport** (the ob1 RNDV-pipeline role,
+``pml_ob1_sendreq.c:785``): payloads above ``wire_pipeline_segsize``
+cross as a stream of fixed-size fragments sliced straight off the
+source buffer (memoryview, no monolithic ``tobytes()`` — see
+``DcnBtl.staged_frames``), reassembled into a preallocated buffer at
+each fragment's own offset on the receiver. ``wire_pipeline_segsize=0``
+restores the exact legacy single-pass framing.
+
+**Channel concurrency**: the old coarse ``("send", dst)`` /
+``("drain", dst)`` locks serialized every tag behind one destination
+stream — the head-of-line blocking the previous revision of this file
+documented. Tags now hash onto ``wire_p2p_lanes`` per-destination
+lanes, each with its own channel tag and lock, so independent tags and
+comms no longer queue behind each other's large transfers. MPI's
+non-overtaking rule survives lane reordering through a per-(sender
+process, destination rank) delivery order stamped in the envelope: a
+transfer may COMPLETE out of order, but messages enter the PML
+matching queues in send order. ``wire_hol_wait_seconds`` times what is
+left of the head-of-line wait.
 
 Channel tags live far above ``USER_TAG_BASE`` so they can never shadow
 the coordinator/pubsub control plane or hand-rolled staged transfers.
 
 Thread model: driver-mode processes issue wire operations from the
-main thread (plus completion threads polling acks); the ack set and
-sequence counter are lock-protected, payload channels rely on the
-per-(src, tag) FIFO the OOB provides plus the shared stash in
+main thread (plus completion threads polling acks and the nbc worker);
+the ack set, sequence/order counters, reorder buffers, and the early
+collective-transfer queue are lock-protected; payload channels rely on
+the per-(src, tag) FIFO the OOB provides plus the shared stash in
 ``btl.components.stashed_recv``.
 """
 
@@ -41,13 +62,16 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import obs as _obs
+from ..mca import pvar
+from ..mca import var as mca_var
 from ..native import DssBuffer
 from ..utils import output
 from ..utils.errors import ErrorCode, MPIError
 
 _log = output.stream("wire")
 
-#: p2p envelope+payload channel: + destination WORLD rank
+#: p2p envelope+payload channel: + lane stride + destination WORLD rank
 WIRE_P2P_BASE = 1 << 20
 #: ssend acknowledgements: + the original sender's WORLD rank
 WIRE_ACK_BASE = 2 << 20
@@ -56,7 +80,43 @@ WIRE_COLL_BASE = 3 << 20
 #: per-communicator collective control channel (barrier tokens): + cid
 WIRE_CTL_BASE = 4 << 20
 
+#: per-lane tag stride inside the p2p block: lane L of destination D is
+#: ``WIRE_P2P_BASE + L * _LANE_STRIDE + D`` (lane 0 == the legacy tag)
+_LANE_STRIDE = 1 << 17
+_MAX_LANES = 8
+
 _ENV_MAGIC = "WPM1"
+
+#: sender time spent blocked behind another transfer's channel lock —
+#: the head-of-line wait the per-(peer, tag-class) lanes exist to cut.
+#: Module-level registration (the PR-1 zero-cost-counter class); the
+#: uncontended path costs one try-acquire and never reads a clock.
+_hol_wait = pvar.timer(
+    "wire_hol_wait_seconds",
+    "seconds senders spent waiting behind another transfer's wire "
+    "channel lock (head-of-line wait)",
+)
+
+
+def register_vars() -> None:
+    from ..btl.components import register_pipeline_vars
+
+    register_pipeline_vars()  # wire_pipeline_segsize / _depth
+    mca_var.register(
+        "wire_p2p_lanes", "int", 4,
+        "Per-destination p2p channel lanes; user tags hash onto lanes "
+        "so independent tags no longer serialize behind one "
+        "destination stream (1 = the legacy single channel)",
+    )
+    mca_var.register(
+        "wire_overlap_exchange", "bool", True,
+        "Reap spanning-comm exchange receives in arrival order "
+        "(posted-sends overlap) instead of fixed process order; false "
+        "restores the sequential per-peer receive loop",
+    )
+
+
+register_vars()  # idempotent; cvars must exist before the first router
 
 
 class ProcTopology:
@@ -115,14 +175,35 @@ class WireRouter:
         self._seq = itertools.count(1)
         self._acks: set = set()
         self._ack_lock = threading.Lock()
-        # per-destination-channel locks: an envelope and its payload
-        # must land back-to-back on the channel FIFO (send side) and
-        # be popped as a unit (drain side) — concurrent threads on one
-        # channel would interleave frames and corrupt the stream
-        self._chan_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        # per-channel locks, keyed ("send"|"drain", (dst_world, lane))
+        # or ("deliver", dst_world): an envelope and its payload must
+        # land back-to-back on one lane FIFO (send side) and be popped
+        # as a unit (drain side) — concurrent threads on ONE lane would
+        # interleave frames and corrupt the stream. Distinct lanes are
+        # independent: that is the whole point.
+        self._chan_locks: Dict[Tuple[str, Any], threading.Lock] = {}
         self._chan_guard = threading.Lock()
+        # per-destination delivery order (sender side) and the
+        # receiver's reorder state: completed-but-early messages wait
+        # in _rx_hold until every lower-order message delivered, so
+        # lane concurrency can never reorder PML matching
+        self._order: Dict[int, int] = {}
+        self._order_lock = threading.Lock()
+        self._rx_hold: Dict[Tuple[int, int], Dict[int, tuple]] = {}
+        self._rx_next: Dict[Tuple[int, int], int] = {}
+        self._rx_lock = threading.Lock()
+        # rotating first-lane offset per destination: a 1 ms
+        # nonblocking poll pumps at most one lane, so successive polls
+        # must start at different lanes or lanes past 0 would starve
+        # (benign races: worst case two polls share a start lane)
+        self._drain_rr: Dict[int, int] = {}
+        # collective transfers completed by an any-source reap before
+        # their round asked for them (a peer racing one round ahead):
+        # (cid, src_pidx) -> FIFO of arrays
+        self._coll_early: Dict[Tuple[int, int], List] = {}
+        self._coll_early_lock = threading.Lock()
 
-    def _chan_lock(self, kind: str, key: int) -> threading.Lock:
+    def _chan_lock(self, kind: str, key) -> threading.Lock:
         with self._chan_guard:
             lk = self._chan_locks.get((kind, key))
             if lk is None:
@@ -152,6 +233,26 @@ class WireRouter:
             == self.cards[peer_pidx].get("host")
         )
         return self._shm if same_host else self._dcn
+
+    # -- lanes -------------------------------------------------------------
+    @staticmethod
+    def _lanes() -> int:
+        return max(1, min(_MAX_LANES,
+                          int(mca_var.get("wire_p2p_lanes", 4) or 1)))
+
+    @staticmethod
+    def _lane_of(user_tag: int) -> int:
+        return int(user_tag) % WireRouter._lanes()
+
+    @staticmethod
+    def _p2p_tag(dst_world: int, lane: int) -> int:
+        if dst_world >= _LANE_STRIDE:
+            raise MPIError(
+                ErrorCode.ERR_INTERN,
+                f"world rank {dst_world} exceeds the per-lane wire tag "
+                f"space ({_LANE_STRIDE})",
+            )
+        return WIRE_P2P_BASE + lane * _LANE_STRIDE + dst_world
 
     # -- payload channel ---------------------------------------------------
     def _retry(self, fn, what: str):
@@ -195,76 +296,219 @@ class WireRouter:
                                timeout_ms=timeout_ms)
 
     # -- p2p (the PML's cross-process route) -------------------------------
+    def _next_order(self, dst_world: int) -> int:
+        with self._order_lock:
+            n = self._order.get(dst_world, 0) + 1
+            self._order[dst_world] = n
+            return n
+
     def send_p2p(self, comm, src_rank: int, dst_rank: int, user_tag: int,
                  data, sync: bool) -> int:
         """Envelope + payload to the process owning ``dst_rank``.
         Ranks in the envelope are COMM-local (matching happens against
         the destination comm's queues); the channel is keyed by the
-        destination's WORLD rank so every comm shares one ordered
-        stream per destination."""
+        destination's WORLD rank plus the user tag's lane, so
+        independent tags ride independent streams while every comm
+        still shares the per-destination delivery order."""
         dst_world = comm.group.world_rank(dst_rank)
         peer = self.owner_of(dst_world)
         seq = next(self._seq)
-        tag = WIRE_P2P_BASE + dst_world
-        env = DssBuffer()
-        env.pack_string(_ENV_MAGIC)
-        env.pack_int64([comm.cid, src_rank, dst_rank, int(user_tag),
-                        1 if sync else 0, seq])
-        with self._chan_lock("send", dst_world):
-            self._retry(
-                lambda: self.ep.send(self._nid(peer), tag, env.tobytes()),
-                f"p2p envelope to process {peer}",
-            )
-            self._send_payload(peer, tag, np.asarray(data))
+        lane = self._lane_of(user_tag)
+        tag = self._p2p_tag(dst_world, lane)
+        arr = np.asarray(data)
+        rec = _obs.enabled  # capture once: flag may flip mid-send
+        t0 = time.perf_counter() if rec else 0.0
+        lock = self._chan_lock("send", (dst_world, lane))
+        if not lock.acquire(blocking=False):
+            # contended: another transfer owns this lane — time the
+            # head-of-line wait (the uncontended path never reads a
+            # clock, keeping the off-cost at one try-acquire)
+            w0 = time.perf_counter()
+            lock.acquire()
+            _hol_wait.add(time.perf_counter() - w0)
+        try:
+            # order allocation and the envelope send are one atomic
+            # step per destination: if the envelope never reaches the
+            # wire, the slot is rolled back under the same lock, so a
+            # failed send can never leave a permanent gap that strands
+            # every later message in the receiver's reorder hold.
+            # Envelopes are single small frames — cross-lane payloads
+            # (the actual bytes) still stream concurrently below.
+            with self._chan_lock("order", dst_world):
+                order = self._next_order(dst_world)
+                env = DssBuffer()
+                env.pack_string(_ENV_MAGIC)
+                env.pack_int64([comm.cid, src_rank, dst_rank,
+                                int(user_tag), 1 if sync else 0, seq,
+                                order])
+                try:
+                    self._retry(
+                        lambda: self.ep.send(self._nid(peer), tag,
+                                             env.tobytes()),
+                        f"p2p envelope to process {peer}",
+                    )
+                except MPIError:
+                    with self._order_lock:
+                        # safe: no other thread can have allocated a
+                        # later slot while we hold the order chan lock
+                        self._order[dst_world] = order - 1
+                    raise
+            self._send_payload(peer, tag, arr)
+        finally:
+            lock.release()
+        if rec and _obs.enabled:
+            _obs.record("wire_send", "wire", t0,
+                        time.perf_counter() - t0,
+                        nbytes=int(arr.nbytes), peer=dst_world,
+                        comm_id=comm.cid)
         return seq
 
     def drain_p2p(self, dst_world_rank: int, timeout_ms: int = 50) -> bool:
-        """Receive at most ONE wire message destined to
-        ``dst_world_rank`` and push it into the owning communicator's
-        PML matching queues. Returns True if a message was delivered.
+        """Receive wire traffic destined to ``dst_world_rank`` and push
+        completed messages into the owning communicator's PML matching
+        queues, in per-sender send order. Returns True if at least one
+        message was delivered.
 
-        ``timeout_ms`` bounds only the wait for an ENVELOPE; once one
-        is popped, its payload is consumed to completion — the sender
-        wrote it immediately behind the envelope on the same FIFO, so
-        the stall is bounded by the in-flight transfer, not by user
-        behavior (head-of-line blocking per destination channel; a
-        nonblocking probe can stall for the tail of a large in-flight
-        message). A sender dying between envelope and payload surfaces
-        as a loud ERR_TRUNCATE here, never a silently dropped message.
+        ``timeout_ms`` bounds only the wait for ENVELOPES; once one is
+        popped, its payload is consumed to completion — the sender
+        wrote it immediately behind the envelope on the same lane FIFO,
+        so the stall is bounded by the in-flight transfer, not by user
+        behavior (head-of-line now scoped to ONE lane: other tags'
+        lanes stay drainable, by this thread on its next sweep or by a
+        concurrent thread — busy lanes are skipped, never waited on).
+        A sender dying between envelope and payload surfaces as a loud
+        ERR_TRUNCATE here, never a silently dropped message.
         """
-        from ..btl.components import stashed_recv
-        from ..comm.communicator import _comm_registry
-
-        tag = WIRE_P2P_BASE + dst_world_rank
+        if self._deliver_ready(dst_world_rank):
+            return True
         # cheap empty-channel fast path for nonblocking progress
         # (imprecise: pending() counts frames on every tag, so other
         # traffic forces the short recv below — never misses a frame)
         if timeout_ms <= 1 and self.ep.pending() == 0:
             return False
         deadline = time.monotonic() + timeout_ms / 1000
-        with self._chan_lock("drain", dst_world_rank):
-            try:
-                src_nid, raw = stashed_recv(self.ep, None, tag, deadline)
-            except MPIError:
-                return False  # nothing pending within the timeout
-            env = DssBuffer(raw)
-            if env.unpack_string() != _ENV_MAGIC:
-                _log.verbose(1, f"dropping non-envelope frame on p2p "
-                                f"channel {tag}")
+        nlanes = self._lanes()
+        # lanes beyond the local cvar get ONE cheap probe per blocking
+        # drain call: a sender configured with MORE lanes
+        # (heterogeneous MCA env, or the cvar flipped mid-flight) must
+        # never have its messages stranded on a tag we refuse to poll —
+        # but the mismatch path must not tax every sweep either
+        probe_extras = timeout_ms > 1 and nlanes < _MAX_LANES
+        start = self._drain_rr.get(dst_world_rank, 0) % max(nlanes, 1)
+        self._drain_rr[dst_world_rank] = start + 1
+        first_sweep = True
+        while True:
+            pumped_any = False
+            for i in range(_MAX_LANES):
+                # rotate only the first sweep's order; later sweeps
+                # are inside a blocking wait and cover every lane
+                lane = (start + i) % nlanes if (first_sweep
+                                                and i < nlanes) else i
+                local = lane < nlanes
+                if not local and not probe_extras:
+                    continue
+                if pumped_any and time.monotonic() >= deadline:
+                    break  # bound nonblocking polls at ~one lane pump
+                lk = self._chan_lock("drain", (dst_world_rank, lane))
+                if not lk.acquire(blocking=False):
+                    continue  # another thread is pumping this lane
+                try:
+                    pumped_any = True
+                    left = deadline - time.monotonic()
+                    # short per-lane envelope wait so one silent lane
+                    # cannot eat the whole budget when others have
+                    # frames queued; a single lane gets the full wait;
+                    # extra (mismatch-tolerance) lanes get the minimum
+                    if not local:
+                        per = 0.001
+                    elif nlanes == 1:
+                        per = left
+                    else:
+                        per = min(left, 0.01)
+                    self._pump_lane(dst_world_rank, lane,
+                                    time.monotonic() + max(per, 0.001))
+                finally:
+                    lk.release()
+                if self._deliver_ready(dst_world_rank):
+                    return True
+            probe_extras = False  # once per call is tolerance enough
+            first_sweep = False
+            if time.monotonic() >= deadline:
                 return False
-            cid, src_rank, dst_rank, user_tag, sync, seq = \
-                env.unpack_int64(6)
-            src_pidx = src_nid - 1
-            try:
-                data = self._recv_payload(tag, src_pidx)
-            except MPIError as e:
-                raise MPIError(
-                    ErrorCode.ERR_TRUNCATE,
-                    f"wire message from process {src_pidx} (comm cid "
-                    f"{cid}, src rank {src_rank}, tag {user_tag}) "
-                    "announced by its envelope but the payload never "
-                    f"completed — peer died mid-transfer? ({e})",
-                )
+            if not pumped_any:
+                # every lane is owned by another thread: yield instead
+                # of spinning on try-acquires until the deadline
+                time.sleep(0.001)
+
+    def _pump_lane(self, dst_world: int, lane: int,
+                   deadline: float) -> bool:
+        """Pop one envelope (+ its payload, to completion) off one lane
+        and park the completed message in the reorder buffer. Returns
+        True if a frame was consumed. Caller holds the lane's drain
+        lock."""
+        from ..btl.components import stashed_recv
+
+        tag = self._p2p_tag(dst_world, lane)
+        try:
+            src_nid, raw = stashed_recv(self.ep, None, tag, deadline)
+        except MPIError:
+            return False  # nothing pending within the timeout
+        env = DssBuffer(raw)
+        if env.unpack_string() != _ENV_MAGIC:
+            _log.verbose(1, f"dropping non-envelope frame on p2p "
+                            f"channel {tag}")
+            return True
+        cid, src_rank, dst_rank, user_tag, sync, seq, order = \
+            env.unpack_int64(7)
+        src_pidx = src_nid - 1
+        try:
+            data = self._recv_payload(tag, src_pidx)
+        except MPIError as e:
+            raise MPIError(
+                ErrorCode.ERR_TRUNCATE,
+                f"wire message from process {src_pidx} (comm cid "
+                f"{cid}, src rank {src_rank}, tag {user_tag}) "
+                "announced by its envelope but the payload never "
+                f"completed — peer died mid-transfer? ({e})",
+            )
+        with self._rx_lock:
+            self._rx_hold.setdefault((src_pidx, dst_world), {})[
+                int(order)] = (int(cid), int(src_rank), int(dst_rank),
+                               int(user_tag), int(sync), int(seq),
+                               src_pidx, data)
+        return True
+
+    def _deliver_ready(self, dst_world: int) -> bool:
+        """Deliver every reorder-buffer message whose per-sender order
+        is next-expected. The deliver lock serializes PML insertion per
+        destination so two drain threads can never swap send order."""
+        if not self._rx_hold:  # racy-but-safe fast path (dict bool)
+            return False
+        delivered = False
+        with self._chan_lock("deliver", dst_world):
+            while True:
+                ready = None
+                with self._rx_lock:
+                    for key in list(self._rx_hold):
+                        if key[1] != dst_world:
+                            continue
+                        nxt = self._rx_next.get(key, 1)
+                        hold = self._rx_hold[key]
+                        if nxt in hold:
+                            ready = hold.pop(nxt)
+                            self._rx_next[key] = nxt + 1
+                            if not hold:
+                                del self._rx_hold[key]
+                            break
+                if ready is None:
+                    return delivered
+                self._deliver_one(ready)
+                delivered = True
+
+    def _deliver_one(self, msg: tuple) -> None:
+        from ..comm.communicator import _comm_registry
+
+        cid, src_rank, dst_rank, user_tag, sync, seq, src_pidx, data = msg
         comm = _comm_registry.get(int(cid))
         if comm is None:
             raise MPIError(
@@ -282,7 +526,6 @@ class WireRouter:
 
         comm.pml._enqueue_wire(int(src_rank), int(dst_rank),
                                int(user_tag), data, on_matched=on_matched)
-        return True
 
     # -- ssend acknowledgements --------------------------------------------
     def send_ack(self, peer_pidx: int, cid: int, seq: int,
@@ -335,12 +578,112 @@ class WireRouter:
                            f"cid {comm.cid} exceeds the wire tag space")
         return WIRE_COLL_BASE + comm.cid
 
+    def _coll_early_pop(self, cid: int, src_pidx: int):
+        with self._coll_early_lock:
+            q = self._coll_early.get((cid, src_pidx))
+            if q:
+                arr = q.pop(0)
+                if not q:
+                    del self._coll_early[(cid, src_pidx)]
+                return arr
+        return None
+
     def coll_send(self, comm, peer_pidx: int, arr) -> None:
         self._send_payload(peer_pidx, self._coll_tag(comm), arr)
 
     def coll_recv(self, comm, src_pidx: int, timeout_ms: int = 60_000):
+        early = self._coll_early_pop(comm.cid, src_pidx)
+        if early is not None:
+            return early
         return self._recv_payload(self._coll_tag(comm), src_pidx,
                                   timeout_ms=timeout_ms)
+
+    def _peer_frames(self, peer: int, tag: int, arrs: List):
+        """Side-effecting generator: each ``next()`` puts ONE wire
+        frame of this peer's transfer queue on the OOB. DCN transfers
+        above the pipeline segsize stream as zero-copy fragments; shm
+        handoffs and legacy/small transfers count as one frame."""
+        btl = self._btl_for(peer)
+        nid = self._nid(peer)
+        for a in arrs:
+            seg = self._dcn.pipeline_segsize() if btl is self._dcn else 0
+            if seg > 0:
+                # pvar accounting happens inside staged_frames — the
+                # one place that knows frames (shared with send_staged)
+                for frame in self._dcn.staged_frames(a, segsize=seg):
+                    self._retry(
+                        lambda f=frame: self.ep.send(nid, tag, f),
+                        f"pipelined fragment to process {peer}",
+                    )
+                    yield
+            else:
+                self._send_payload(peer, tag, a)
+                yield
+
+    def coll_send_all(self, comm, arrs_for: Dict[int, List]) -> None:
+        """Post one exchange round's sends to EVERY peer, striping
+        pipelined fragments round-robin across destinations in
+        ``wire_pipeline_depth``-sized bursts — every peer's receive
+        side starts reassembling while the round is still being sent,
+        instead of peer P+1 waiting for peer P's full payload."""
+        tag = self._coll_tag(comm)
+        depth = max(1, int(mca_var.get("wire_pipeline_depth", 4) or 1))
+        streams = [self._peer_frames(p, tag, arrs_for[p])
+                   for p in sorted(arrs_for) if arrs_for[p]]
+        while streams:
+            keep = []
+            for it in streams:
+                alive = True
+                for _ in range(depth):
+                    try:
+                        next(it)
+                    except StopIteration:
+                        alive = False
+                        break
+                if alive:
+                    keep.append(it)
+            streams = keep
+
+    def coll_recv_any(self, comm, pending: Dict[int, int],
+                      timeout_ms: int = 60_000):
+        """Complete the NEXT transfer on ``comm``'s payload channel
+        from whichever peer's frames arrive first; returns
+        ``(src_pidx, array)``. ``pending`` maps peer -> messages still
+        expected this round; a completed transfer from a peer with no
+        outstanding count belongs to a FUTURE round (that peer raced
+        ahead) and is queued for its own round's receive instead of
+        being returned out of context."""
+        from ..btl.components import stashed_recv
+
+        for p in list(pending):
+            if pending.get(p, 0) > 0:
+                early = self._coll_early_pop(comm.cid, p)
+                if early is not None:
+                    return p, early
+        tag = self._coll_tag(comm)
+        deadline = time.monotonic() + timeout_ms / 1000
+        while True:
+            src_nid, raw = stashed_recv(self.ep, None, tag, deadline)
+            src = src_nid - 1
+            arr = self._finish_transfer(src, tag, raw, deadline)
+            if pending.get(src, 0) > 0:
+                return src, arr
+            with self._coll_early_lock:
+                self._coll_early.setdefault((comm.cid, src),
+                                            []).append(arr)
+
+    def _finish_transfer(self, src_pidx: int, tag: int, first_raw,
+                         deadline: float):
+        """Complete one payload transfer whose first frame was already
+        popped by an any-source peek."""
+        btl = self._btl_for(src_pidx)
+        left_ms = max(1, int((deadline - time.monotonic()) * 1000))
+        first = (self._nid(src_pidx), first_raw)
+        if btl is self._shm:
+            return btl.recv_shm(self.ep, tag, src=self._nid(src_pidx),
+                                timeout_ms=left_ms, first=first)
+        return btl.recv_staged(self.ep, tag, src=self._nid(src_pidx),
+                               timeout_ms=left_ms, first=first)
 
     def ctl_send(self, comm, peer_pidx: int, payload: bytes = b"") -> None:
         self._retry(

@@ -115,6 +115,7 @@ def sweep_segsizes(comm, op: str, alg: str, x,
     out: Dict[int, float] = {}
     prev_rules = mca_var.get("coll_tuned_use_dynamic_rules", False)
     prev_seg = mca_var.get("coll_pipeline_segsize", 1 << 20)
+    prev_alg = mca_var.get(var, "auto")
     mca_var.set_value("coll_tuned_use_dynamic_rules", False)
     mca_var.set_value(var, alg)
     try:
@@ -128,12 +129,78 @@ def sweep_segsizes(comm, op: str, alg: str, x,
             except Exception as e:
                 _log.verbose(2, f"{op}/{alg} segsize {seg}: {e}")
     finally:
-        # restore (not unset): the caller may have pinned its own
-        # segsize — measure() pins 0 for monolithic alg-phase timings
+        # restore (not clobber): the operator may have forced their
+        # own algorithm/segsize before running tpu-tune
         mca_var.set_value("coll_pipeline_segsize", prev_seg)
-        mca_var.set_value(var, "auto")
+        mca_var.set_value(var, prev_alg)
         mca_var.set_value("coll_tuned_use_dynamic_rules", prev_rules)
     return out
+
+
+def sweep_wire_segsizes(segsizes: Sequence[int],
+                        size_bytes: int = 16 << 20,
+                        repeats: int = 3) -> Dict[int, float]:
+    """Time ONE cross-process-shaped staged transfer through a real
+    loopback OOB endpoint pair at each ``wire_pipeline_segsize`` (0 =
+    the legacy monolithic ``tobytes()`` framing); returns
+    {segsize: best_seconds}. This sweeps the cvar the wire router's
+    DCN staging path reads (``DcnBtl.pipeline_segsize``), so the
+    emitted recommendation measures the exact send+reassemble code a
+    ``tpurun`` job will run — sockets, framing, CRC and all."""
+    from ..btl.components import DcnBtl
+    from ..native import OobEndpoint
+
+    a, b = OobEndpoint(0), OobEndpoint(1)
+    out: Dict[int, float] = {}
+    prev = mca_var.get("wire_pipeline_segsize", 1 << 20)
+    try:
+        b.connect(0, "127.0.0.1", a.port)
+        m = DcnBtl()
+        x = np.ones(max(1, size_bytes // 4), np.float32)
+        for seg in [0] + sorted({int(s) for s in segsizes if s > 0}):
+            mca_var.set_value("wire_pipeline_segsize", seg)
+            try:
+                best = None
+                for _ in range(repeats):
+                    t0 = time.perf_counter()
+                    m.send_staged(b, 0, 151, x)
+                    got = np.asarray(m.recv_staged(a, 151))
+                    dt = time.perf_counter() - t0
+                    best = dt if best is None else min(best, dt)
+                if got.shape != x.shape or got[0] != x[0]:
+                    continue  # never crown a corrupting config
+                out[seg] = best
+            except Exception as e:
+                _log.verbose(2, f"wire segsize {seg}: {e}")
+    finally:
+        mca_var.set_value("wire_pipeline_segsize", prev)
+        a.close()
+        b.close()
+    return out
+
+
+def emit_wire_rules(seg_times: Dict[int, float],
+                    size_bytes: int = 16 << 20) -> str:
+    """Rule-comment block for the wire sweep (the same measured-
+    justification treatment as the coll segsize column): every point's
+    time, plus the winning ``--mca wire_pipeline_segsize`` the operator
+    should launch with. Wire cvars are job-wide, not per-collective, so
+    this block is advisory comments rather than rule lines — the
+    loader ignores it."""
+    if not seg_times:
+        return ""
+    pts = ", ".join(
+        f"{('off' if k == 0 else k)}={v * 1e3:.1f}ms"
+        for k, v in sorted(seg_times.items(), key=lambda kv: kv[1]))
+    best = min(seg_times, key=seg_times.get)
+    lines = [
+        "",
+        f"# wire pipeline sweep ({size_bytes >> 20} MiB staged "
+        f"loopback): {pts}",
+        f"# recommended: --mca wire_pipeline_segsize {best}"
+        + ("  (legacy monolithic framing won)" if best == 0 else ""),
+    ]
+    return "\n".join(lines)
 
 
 def measure(comm, ops: Sequence[str], sizes: Sequence[int],
@@ -178,6 +245,10 @@ def measure(comm, ops: Sequence[str], sizes: Sequence[int],
         for op in ops:
             runner, unit_fn = _OPS[op]
             var = f"coll_tuned_{op}_algorithm"
+            # restore the OPERATOR's forced value after each timing,
+            # not the literal 'auto' — tpu-tune must not clobber a
+            # deployment's pinned algorithm (ADVICE r5)
+            prev_alg = mca_var.get(var, "auto")
             rows = []
             for size in sizes:
                 elems = max(n, size // 4)
@@ -202,7 +273,7 @@ def measure(comm, ops: Sequence[str], sizes: Sequence[int],
                         # ring without identity) is skipped, not fatal
                         _log.verbose(2, f"{op}/{alg}@{size}: {e}")
                     finally:
-                        mca_var.set_value(var, "auto")
+                        mca_var.set_value(var, prev_alg)
                 if not times:
                     continue
                 winner = min(times, key=times.get)
@@ -336,6 +407,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="comma-separated pipeline segment sizes to "
                          "sweep for pipeline-capable winners (emits "
                          "the segsize rule column); empty disables")
+    ap.add_argument("--wire-segsizes", default="",
+                    help="comma-separated wire_pipeline_segsize values "
+                         "to sweep through a loopback OOB staged "
+                         "transfer (emits a recommendation comment); "
+                         "empty disables")
     args = ap.parse_args(argv)
 
     import ompi_release_tpu as mpi
@@ -349,6 +425,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     results = measure(comm, ops, sizes, repeats=args.repeats,
                       segsizes=segsizes or None)
     text = emit(comm, results)
+    wire_segs = sorted(int(s) for s in args.wire_segsizes.split(",")
+                       if s.strip())
+    if wire_segs:
+        text += emit_wire_rules(sweep_wire_segsizes(wire_segs)) + "\n"
     with open(args.output, "w") as f:
         f.write(text)
     # validate what we just wrote parses (a typo'd generator must not

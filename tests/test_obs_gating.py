@@ -1,13 +1,17 @@
-"""Static hot-path observability discipline for the new coll engines.
+"""Static hot-path observability discipline for the new coll engines
+and the wire transport.
 
-``coll/pipeline.py`` and ``coll/fusion.py`` sit on the collective hot
-path; PR 1's contract is that observability costs ONE attribute check
+``coll/pipeline.py``, ``coll/fusion.py``, and ``runtime/wire.py`` sit
+on hot paths (the wire router is EVERY cross-process byte); PR 1's
+contract is that observability costs ONE attribute check
 (``_obs.enabled``) when off. This test enforces it statically, without
 importing jax: every emit site (journal ``record``, skew
 ``begin/body/end``, per-call pvar registry lookups) must be gated on
 ``_obs.enabled``, and every pvar bump (``.add``/``.observe``) must
 target a MODULE-LEVEL pre-registered pvar (the zero-cost-counter
 class the driver already uses) or itself be gated.
+``btl/components.py`` carries wire pvars but no journal emits, so it
+is checked for gating violations only.
 
 Gating shapes recognized:
 
@@ -20,7 +24,11 @@ import os
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHECKED = ("ompi_release_tpu/coll/pipeline.py",
-           "ompi_release_tpu/coll/fusion.py")
+           "ompi_release_tpu/coll/fusion.py",
+           "ompi_release_tpu/runtime/wire.py")
+#: gating violations checked, but no journal-emit-site requirement
+#: (module-level wire pvars only — no _obs import)
+PVAR_ONLY = ("ompi_release_tpu/btl/components.py",)
 
 #: attribute calls that ARE emit sites when ungated
 EMIT_ATTRS = {"record", "begin", "body", "end"}
@@ -124,6 +132,19 @@ def _scan_stmts(stmts, gated, pvars, violations, path):
                         _check_calls(v, gated, pvars, violations, path)
             elif isinstance(value, ast.AST):
                 _check_calls(value, gated, pvars, violations, path)
+
+
+def test_pvar_only_files_have_no_ungated_sites():
+    for rel in PVAR_ONLY:
+        path = os.path.join(REPO, rel)
+        tree = ast.parse(open(path).read(), filename=rel)
+        pvars = _module_pvars(tree)
+        assert pvars, f"{rel}: expected module-level pvar registrations"
+        violations = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_stmts(node.body, False, pvars, violations, rel)
+        assert not violations, "\n".join(violations)
 
 
 def test_pipeline_and_fusion_emit_sites_are_gated():
