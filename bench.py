@@ -118,17 +118,23 @@ def _calibrate_k(loop, args, static_hi):
     return max(2, k_hi // 32), k_hi
 
 
-def _run_rounds(specs, rounds):
+def _run_rounds(specs, rounds, progress=None):
     """Interleaved slope timing: every round times every loop's K_lo
     and K_hi back to back, so cross-loop ratios (metric/ceiling) are
-    taken between samples milliseconds apart, not minutes."""
+    taken between samples milliseconds apart, not minutes.
+
+    ``progress`` (a dict, if given) is refreshed after every completed
+    round with copies of the per-spec timings, so an abort path — the
+    global watchdog's hard-exit, a mid-sweep backend crash — can
+    salvage metric lines from the rounds already measured instead of
+    losing the whole sweep."""
     for s in specs:  # compile + warm both K values
         _sync(s["loop"](*s["args"], s["k_lo"]))
         _sync(s["loop"](*s["args"], s["k_hi"]))
     slopes = [[] for _ in specs]
     lo_t = [[] for _ in specs]
     hi_t = [[] for _ in specs]
-    for _ in range(rounds):
+    for r in range(rounds):
         for i, s in enumerate(specs):
             tlo = _timed(s["loop"], s["args"], s["k_lo"])
             thi = _timed(s["loop"], s["args"], s["k_hi"])
@@ -137,13 +143,25 @@ def _run_rounds(specs, rounds):
             slopes[i].append(
                 max((thi - tlo) / (s["k_hi"] - s["k_lo"]), 1e-12)
             )
+        if progress is not None:
+            # fresh copies + whole-reference assignment: the reader is
+            # the watchdog thread, which must never see a row
+            # mid-append
+            progress["slopes"] = [list(row) for row in slopes]
+            progress["lo_t"] = [list(row) for row in lo_t]
+            progress["hi_t"] = [list(row) for row in hi_t]
+            progress["rounds_done"] = r + 1
+    _flag_unstable(specs, lo_t, hi_t)
+    return np.asarray(slopes)  # (n_specs, rounds)
+
+
+def _flag_unstable(specs, lo_t, hi_t):
     for i, s in enumerate(specs):
         # a median K-delta inside the tunnel's jitter band means the
         # slope is noise, not signal — flag rather than report garbage
         s["unstable"] = (
             np.median(hi_t[i]) - np.median(lo_t[i])
         ) < 0.05 and jnp_on_tpu()
-    return np.asarray(slopes)  # (n_specs, rounds)
 
 
 def jnp_on_tpu():
@@ -630,6 +648,12 @@ def _init_backend(jax, attempts=3, first_delay=5.0,
     return None
 
 
+#: callables the watchdog runs (best-effort) before its hard-exit, so
+#: partially-measured phases can flush what they have — see
+#: ``salvage_sweep`` in main()
+_SALVAGE_HOOKS = []
+
+
 def _arm_global_watchdog(budget_s=1500.0):
     """If the whole run exceeds ``budget_s`` (a healthy TPU run takes
     ~2-4 min; only a mid-sweep tunnel hang gets near this), print the
@@ -639,6 +663,11 @@ def _arm_global_watchdog(budget_s=1500.0):
     import threading
 
     def fire():
+        for hook in list(_SALVAGE_HOOKS):
+            try:
+                hook()
+            except Exception:
+                pass  # salvage must never block the exit marker
         print(json.dumps({
             "metric": "bench_error", "value": None, "unit": None,
             "vs_baseline": None, "error": "tpu_unavailable",
@@ -685,7 +714,7 @@ def _micro_pvars():
     return out
 
 
-def _coll_micro_suite(backend_label):
+def _coll_micro_suite():
     """coll_pipeline / coll_fusion micro-suite through the framework's
     own driver (not raw meshes): a ≥1 MiB pipelined allreduce + bcast
     and a 64-small-tensors fusion burst, one JSON line each, every
@@ -765,10 +794,7 @@ def _coll_micro_suite(backend_label):
         "seconds": round(dt, 6),
         "pvars": _micro_pvars(), "cumulative": True,
     })
-    if backend_label:
-        for ln in lines:
-            ln["backend"] = backend_label
-    return lines
+    return lines  # main()'s emit() stamps the backend label
 
 
 #: worker app for the wire micro-suite: a REAL 3-process tpurun job on
@@ -997,60 +1023,14 @@ def _wire_micro_suite(backend_label):
                      "error": f"wire bench job rc={rc}"}]
         with open(out_path) as f:
             lines = json.load(f)
-    if backend_label:
-        for ln in lines:
-            ln["backend"] = backend_label
-    return lines
+    return lines  # main()'s emit() stamps the backend label
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
-
-    from ompi_release_tpu.utils import jaxcompat
-
-    jaxcompat.install()  # jax.shard_map/typeof/pvary on 0.4.x jaxlibs
-    watchdog = _arm_global_watchdog()
-    devices = _init_backend(jax)
-    backend_label = None
-    if devices is None:
-        # tpu_unavailable: emit the CPU-backend numbers, labelled, so
-        # the round record carries data instead of a bare bench_error
-        try:
-            devices = jax.devices("cpu")
-            backend_label = "cpu"
-            print(json.dumps({"event": "tpu_unavailable",
-                              "fallback": "cpu"}), flush=True)
-        except Exception as e:
-            print(json.dumps({
-                "metric": "bench_error", "value": None, "unit": None,
-                "vs_baseline": None, "error": "tpu_unavailable",
-                "detail": f"cpu fallback failed: "
-                          f"{type(e).__name__}: {e}"[:300],
-            }))
-            return 0
-    n = len(devices)
-    on_tpu = backend_label is None and jax.default_backend() == "tpu"
-
-    if n >= 2:
-        specs, ceiling_names = _mesh_specs(jax, jnp, devices, on_tpu)
-    else:
-        specs, ceiling_names = _single_chip_specs(
-            jax, jnp, devices[0], on_tpu
-        )
-
-    if on_tpu:
-        # compile/warm at the static guess, then size K from measured
-        # per-iteration time (VMEM-resident loops are 5-20x faster
-        # than the HBM estimate)
-        for s in specs:
-            s["k_lo"], s["k_hi"] = _calibrate_k(
-                s["loop"], s["args"], s["k_hi"]
-            )
-
-    rounds = 5 if on_tpu else 3
-    slopes = _run_rounds(specs, rounds)  # (n_specs, rounds)
-
+def _sweep_lines(specs, ceiling_names, slopes, n):
+    """Metric lines + headline from the sweep's slope matrix
+    ``(n_specs, rounds_measured)``. Pure computation so the salvage
+    path can run it on a partial matrix (fewer rounds than planned)
+    with exactly the same ceiling/CV/tiering rules as a healthy run."""
     # per-round bandwidths; ceiling_r = best bw ANY copy candidate or
     # the line itself achieved that round (vs_baseline <= 1.0 by
     # construction; see module docstring)
@@ -1167,15 +1147,112 @@ def main():
         }
         if dropped_rounds:
             headline["ceiling_rounds_dropped"] = dropped_rounds
+    return lines, headline
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_release_tpu.utils import jaxcompat
+
+    jaxcompat.install()  # jax.shard_map/typeof/pvary on 0.4.x jaxlibs
+    watchdog = _arm_global_watchdog()
+    devices = _init_backend(jax)
+    backend_label = None
+    if devices is None:
+        # tpu_unavailable: emit the CPU-backend numbers, labelled, so
+        # the round record carries data instead of a bare bench_error
+        try:
+            devices = jax.devices("cpu")
+            backend_label = "cpu"
+            print(json.dumps({"event": "tpu_unavailable",
+                              "fallback": "cpu"}), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "metric": "bench_error", "value": None, "unit": None,
+                "vs_baseline": None, "error": "tpu_unavailable",
+                "detail": f"cpu fallback failed: "
+                          f"{type(e).__name__}: {e}"[:300],
+            }))
+            return 0
+    n = len(devices)
+    on_tpu = backend_label is None and jax.default_backend() == "tpu"
+
+    if n >= 2:
+        specs, ceiling_names = _mesh_specs(jax, jnp, devices, on_tpu)
+    else:
+        specs, ceiling_names = _single_chip_specs(
+            jax, jnp, devices[0], on_tpu
+        )
+
+    if on_tpu:
+        # compile/warm at the static guess, then size K from measured
+        # per-iteration time (VMEM-resident loops are 5-20x faster
+        # than the HBM estimate)
+        for s in specs:
+            s["k_lo"], s["k_hi"] = _calibrate_k(
+                s["loop"], s["args"], s["k_hi"]
+            )
+
+    rounds = 5 if on_tpu else 3
+
+    def emit(ln):
+        if backend_label:
+            ln["backend"] = backend_label
+        print(json.dumps(ln), flush=True)
+
+    # INCREMENTAL emission: every completed metric line prints
+    # (flushed) the moment it exists, so a mid-sweep TPU outage — the
+    # global watchdog's os._exit, a tunnel hang killed by the driver —
+    # preserves the numbers already measured instead of leaving only
+    # the tpu_unavailable marker (round 5 lost two consecutive BENCH
+    # records exactly this way). The sweep itself can compute nothing
+    # until every interleaved round is in (the ceiling is a cross-spec
+    # per-round max), so it additionally publishes per-round timings
+    # into ``progress``, and the abort paths — watchdog hard-exit,
+    # backend crash — salvage metric lines from whatever rounds
+    # finished, marked with "partial_rounds".
+    progress = {}
+
+    def salvage_sweep():
+        done = progress.get("rounds_done", 0)
+        if progress.get("emitted") or not done:
+            return
+        _flag_unstable(specs, progress["lo_t"], progress["hi_t"])
+        lines, headline = _sweep_lines(
+            specs, ceiling_names, np.asarray(progress["slopes"]), n)
+        for ln in lines + [headline]:
+            ln["partial_rounds"] = done
+            emit(ln)
+        # the crash path and a later watchdog fire must not both
+        # salvage: duplicate metric rows would corrupt the record
+        progress["emitted"] = True
+
+    _SALVAGE_HOOKS.append(salvage_sweep)
+    try:
+        slopes = _run_rounds(specs, rounds, progress)
+    except BaseException:
+        try:
+            salvage_sweep()
+        except Exception:
+            pass  # never mask the real failure
+        raise
+
+    lines, headline = _sweep_lines(specs, ceiling_names, slopes, n)
+    progress["emitted"] = True  # the normal path owns emission now
+
+    for ln in lines:
+        emit(ln)
 
     # compute-bound line (single-chip fwd+bwd MFU): measured after the
     # bandwidth sweep so its compile time cannot contaminate those
     # loops' interleaved rounds
     try:
-        lines.append(_mfu_metric(jax, jnp, devices[0], on_tpu,
-                                 rounds=max(3, rounds)))
+        emit(_mfu_metric(jax, jnp, devices[0], on_tpu,
+                         rounds=max(3, rounds)))
     except Exception as e:
-        lines.append({
+        emit({
             "metric": "transformer_fwdbwd_step", "value": None,
             "unit": "TFLOP/s", "vs_baseline": None,
             "error": f"{type(e).__name__}: {e}"[:200],
@@ -1184,9 +1261,10 @@ def main():
     # coll pipeline/fusion micro-suite: framework-driver lines with
     # labelled pvar snapshots (segment counts, fusion savings)
     try:
-        lines.extend(_coll_micro_suite(backend_label))
+        for ln in _coll_micro_suite():
+            emit(ln)
     except Exception as e:
-        lines.append({
+        emit({
             "metric": "coll_micro_suite", "value": None, "unit": None,
             "vs_baseline": None,
             "error": f"{type(e).__name__}: {e}"[:300],
@@ -1196,9 +1274,10 @@ def main():
     # head-of-line wait, and spanning-comm allgatherv overlap — the
     # cross-process bandwidth trajectory line
     try:
-        lines.extend(_wire_micro_suite(backend_label))
+        for ln in _wire_micro_suite(backend_label):
+            emit(ln)
     except Exception as e:
-        lines.append({
+        emit({
             "metric": "wire_micro_suite", "value": None, "unit": None,
             "vs_baseline": None,
             "error": f"{type(e).__name__}: {e}"[:300],
@@ -1210,14 +1289,10 @@ def main():
     snapshot = json.dumps(
         {"pvars": _pvar_snapshot(), "cumulative": True}, default=str
     )
-    for ln in lines:
-        if backend_label:
-            ln["backend"] = backend_label
-        print(json.dumps(ln))
     if backend_label:
         headline["backend"] = backend_label
-    print(snapshot)
-    print(json.dumps(headline))  # headline stays the LAST line
+    print(snapshot, flush=True)
+    print(json.dumps(headline), flush=True)  # headline stays LAST
     watchdog.cancel()
 
 

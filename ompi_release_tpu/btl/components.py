@@ -31,6 +31,7 @@ import uuid
 
 import numpy as np
 
+from .. import obs as _obs
 from ..mca import component as mca_component
 from ..mca import pvar as _pvar
 from ..mca import var as mca_var
@@ -353,15 +354,24 @@ class DcnBtl(base.BtlModule):
         buffer (:meth:`staged_frames`); with 0 the exact legacy
         monolithic path runs (whole-array ``tobytes()``, max_send_size
         chunks, ordered join on receive)."""
+        import time as _time
+
         from ..native import DssBuffer
 
         _check_user_tag(tag)
+        rec = _obs.enabled  # capture once: flag may flip mid-send
+        t0 = _time.perf_counter() if rec else 0.0
         seg = self.pipeline_segsize()
         if seg > 0:
             nframes = 0
             for frame in self.staged_frames(data, segsize=seg):
                 oob_ep.send(peer_nid, tag, frame)
                 nframes += 1
+            if rec and _obs.enabled:
+                _obs.record("btl_staged_send", "btl", t0,
+                            _time.perf_counter() - t0,
+                            nbytes=int(getattr(data, "nbytes", 0)),
+                            peer=peer_nid - 1)
             return nframes - 1  # header is not a chunk
         xfer = next(_xfer_ids)
         arr = np.ascontiguousarray(np.asarray(data))
@@ -387,6 +397,10 @@ class DcnBtl(base.BtlModule):
                         xb + raw[i * chunk:(i + 1) * chunk])
             self.staged_chunks_pvar.add()
         self.staged_bytes_pvar.add(len(raw))
+        if rec and _obs.enabled:
+            _obs.record("btl_staged_send", "btl", t0,
+                        _time.perf_counter() - t0,
+                        nbytes=len(raw), peer=peer_nid - 1)
         return nchunks
 
     def recv_staged(self, oob_ep, tag: int, *, src=None,
@@ -410,6 +424,8 @@ class DcnBtl(base.BtlModule):
         from ..native import DssBuffer
 
         _check_user_tag(tag)
+        rec = _obs.enabled  # capture once: flag may flip mid-recv
+        t_obs = _time.perf_counter() if rec else 0.0
         deadline = _time.monotonic() + timeout_ms / 1000
         # resync: discard frames until a valid header (orphan chunks
         # from an abandoned transfer must not be parsed as headers)
@@ -492,6 +508,11 @@ class DcnBtl(base.BtlModule):
                 )
             arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
         self.staged_bytes_pvar.add(arr.nbytes)
+        if rec and _obs.enabled:
+            _obs.record("btl_staged_recv", "btl", t_obs,
+                        _time.perf_counter() - t_obs,
+                        nbytes=int(arr.nbytes),
+                        peer=(src - 1) if src is not None else -1)
         if dst_device is None:
             dst_device = jax.local_devices()[0]
         return jax.device_put(arr, dst_device)
@@ -688,6 +709,8 @@ class ShmBtl(base.BtlModule):
         from ..native import DssBuffer
 
         _check_user_tag(tag)
+        rec = _obs.enabled  # capture once: flag may flip mid-handoff
+        t_obs = _time.perf_counter() if rec else 0.0
         self._reap_orphaned_segments()
         arr = np.ascontiguousarray(np.asarray(data))
         # name carries the creator pid so tpu-clean can reap segments
@@ -732,6 +755,10 @@ class ShmBtl(base.BtlModule):
             self._pending_segments.append(
                 (name, _time.monotonic() + self.SEGMENT_TTL_S)
             )
+        if rec and _obs.enabled:
+            _obs.record("btl_shm_send", "btl", t_obs,
+                        _time.perf_counter() - t_obs,
+                        nbytes=int(arr.nbytes), peer=peer_nid - 1)
         return name
 
     def recv_shm(self, oob_ep, tag: int, *, src=None, dst_device=None,
@@ -751,6 +778,8 @@ class ShmBtl(base.BtlModule):
         from ..native import DssBuffer
 
         _check_user_tag(tag)
+        rec = _obs.enabled  # capture once: flag may flip mid-handoff
+        t_obs = _time.perf_counter() if rec else 0.0
         deadline = _time.monotonic() + timeout_ms / 1000
         if first is not None:
             _, raw = first
@@ -801,6 +830,10 @@ class ShmBtl(base.BtlModule):
             seg.unlink()
         self.handoffs_pvar.add()
         self.shm_bytes_pvar.add(nbytes)
+        if rec and _obs.enabled:
+            _obs.record("btl_shm_recv", "btl", t_obs,
+                        _time.perf_counter() - t_obs, nbytes=int(nbytes),
+                        peer=(src - 1) if src is not None else -1)
         return out
 
 

@@ -39,14 +39,17 @@ their member ordering.
 
 from __future__ import annotations
 
+import time as _time
 from typing import Callable, Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs as _obs
 from ..mca import component as mca_component
 from ..mca import pvar
 from ..mca import var as mca_var
+from ..obs import watchdog as _watchdog
 from ..ops.op import Op
 from ..utils import output
 from ..utils.errors import ErrorCode, MPIError
@@ -60,6 +63,19 @@ _inter_bytes = pvar.counter(
 _inter_msgs = pvar.counter(
     "hier_inter_msgs", "inter-process messages in hier collectives"
 )
+
+#: current spanning-collective round per comm cid, maintained only
+#: while obs is enabled: {"op", "round", "awaiting_procs",
+#: "awaiting_ranks"}. THE answer to "the job is stuck — who is waiting
+#: in what?": the flight recorder dumps this table verbatim.
+_round_state: Dict[int, Dict] = {}
+
+
+def _hier_rounds_snapshot() -> Dict[str, Dict]:
+    return {str(cid): dict(st) for cid, st in list(_round_state.items())}
+
+
+_watchdog.add_contributor("hier_rounds", _hier_rounds_snapshot)
 
 
 class _HierModule:
@@ -95,6 +111,16 @@ class _HierModule:
         comm._on_free = tuple(getattr(comm, "_on_free", ())) + (
             self.shadow.free,
         )
+        # trace context (maintained only while obs is on): a
+        # process-synchronized round counter plus per-(src, dst) message
+        # indices within the round. Both sides of every inter-process
+        # message derive the SAME flow id from (cid, round, pair, k) —
+        # collective call order is identical on every process (MPI's
+        # own rule) and per-peer FIFO keeps k aligned, so journals join
+        # into flow arrows with no wire-format change. Requires obs
+        # enabled on every rank (same MCA env under tpurun).
+        self._round = 0
+        self._flow_k: Dict[tuple, int] = {}
 
     # -- plumbing ----------------------------------------------------------
     @property
@@ -105,26 +131,129 @@ class _HierModule:
     def _overlap() -> bool:
         return bool(mca_var.get("wire_overlap_exchange", True))
 
+    # -- trace context / round bookkeeping ---------------------------------
+    def _flow(self, src_p: int, dst_p: int) -> int:
+        """Flow id of the NEXT message src_p -> dst_p this round (call
+        only under an ``_obs.enabled`` gate: the k counters must
+        advance in lockstep on both sides)."""
+        key = (src_p, dst_p)
+        k = self._flow_k.get(key, 0)
+        self._flow_k[key] = k + 1
+        return _obs.flow_id("hier", self.comm.cid, self._round,
+                            src_p, dst_p, k)
+
+    def _round_begin(self, name: str) -> float:
+        self._round += 1
+        self._flow_k = {}
+        _round_state[self.comm.cid] = {
+            "op": name, "round": self._round, "comm": self.comm.name,
+            "awaiting_procs": [], "awaiting_ranks": [],
+        }
+        return _time.perf_counter()
+
+    def _round_end(self, name: str, t0: float) -> None:
+        _round_state.pop(self.comm.cid, None)
+        if _obs.enabled:
+            _obs.record(name, "coll", t0, _time.perf_counter() - t0,
+                        comm_id=self.comm.cid)
+
+    def _awaiting_info(self, pending: Dict[int, int]) -> Callable:
+        """Watchdog info resolver: who has NOT arrived, as processes
+        AND world ranks — resolved at dump time so it reflects
+        arrivals since arming, and mirrored into the round-state table
+        the flight recorder dumps."""
+
+        def resolve() -> Dict[str, list]:
+            procs = sorted(p for p, c in pending.items() if c > 0)
+            ranks = sorted(
+                self.comm.group.world_rank(i)
+                for p in procs for i in self.members_of.get(p, ())
+            )
+            st = _round_state.get(self.comm.cid)
+            if st is not None:
+                st["awaiting_procs"] = procs
+                st["awaiting_ranks"] = ranks
+            return {"awaiting_procs": procs, "awaiting_ranks": ranks}
+
+        return resolve
+
+    def _stalled_op(self) -> str:
+        st = _round_state.get(self.comm.cid)
+        return st["op"] if st else "hier"
+
+    # -- transport touchpoints ---------------------------------------------
     def _send(self, peer: int, arr) -> None:
         arr = np.asarray(arr)
+        rec = _obs.enabled  # capture once: flag may flip mid-send
+        t0 = _time.perf_counter() if rec else 0.0
         self.router.coll_send(self.comm, peer, arr)
         _inter_msgs.add()
         _inter_bytes.add(int(arr.nbytes))
+        if rec and _obs.enabled:
+            _obs.record("hier_send", "hier", t0,
+                        _time.perf_counter() - t0,
+                        nbytes=int(arr.nbytes), peer=peer,
+                        comm_id=self.comm.cid,
+                        flow=self._flow(self.my_pidx, peer),
+                        flow_side="s")
 
     def _recv(self, peer: int):
-        out = np.asarray(self.router.coll_recv(self.comm, peer))
+        rec = _obs.enabled
+        t0 = _time.perf_counter() if rec else 0.0
+        tok = None
+        if _watchdog.enabled:
+            tok = _watchdog.arm(self._stalled_op(),
+                                comm_id=self.comm.cid, peer=peer,
+                                info=self._awaiting_info({peer: 1}))
+        try:
+            out = np.asarray(self.router.coll_recv(self.comm, peer))
+        finally:
+            if tok is not None:
+                _watchdog.disarm(tok)
         _inter_msgs.add()
+        if rec and _obs.enabled:
+            _obs.record("hier_recv", "hier", t0,
+                        _time.perf_counter() - t0,
+                        nbytes=int(out.nbytes), peer=peer,
+                        comm_id=self.comm.cid,
+                        flow=self._flow(peer, self.my_pidx),
+                        flow_side="t")
         return out
 
     def _send_all(self, sends: Dict[int, list]) -> None:
         """Post one round's sends to every peer, striped across
         destinations in pipelined fragment bursts (same pvar
         accounting as per-peer :meth:`_send`)."""
+        rec = _obs.enabled
+        t0 = _time.perf_counter() if rec else 0.0
         self.router.coll_send_all(self.comm, sends)
-        for arrs in sends.values():
+        dt = (_time.perf_counter() - t0) if rec else 0.0
+        if rec and _obs.enabled:
+            # the burst's duration lives on ONE aggregate span; the
+            # per-message producer spans below are INSTANTS at the
+            # burst start — coll_send_all stripes internally, so no
+            # per-message completion time exists, and stamping every
+            # message with the burst-end time would put flow-arrow
+            # origins AFTER receivers consumed the early fragments
+            # (negative latencies in the merged trace). The post time
+            # is the causally safe bound.
+            _obs.record("hier_send_all", "hier", t0, dt,
+                        nbytes=sum(int(a.nbytes) for arrs in
+                                   sends.values() for a in arrs),
+                        comm_id=self.comm.cid)
+        for p, arrs in sends.items():
             for a in arrs:
                 _inter_msgs.add()
                 _inter_bytes.add(int(a.nbytes))
+                if rec and _obs.enabled:
+                    # one producer span per message: k advances in list
+                    # order, the same order coll_send_all puts each
+                    # peer's messages on its FIFO
+                    _obs.record("hier_send", "hier", t0, 0.0,
+                                nbytes=int(a.nbytes), peer=p,
+                                comm_id=self.comm.cid,
+                                flow=self._flow(self.my_pidx, p),
+                                flow_side="s")
 
     def _reap(self, pending: Dict[int, int],
               on_arrival: Callable[[int, np.ndarray], None]) -> None:
@@ -132,12 +261,38 @@ class _HierModule:
         a slow peer never blocks the reap of one whose data already
         landed (the posted-sends overlap the module docstring pins)."""
         left = sum(pending.values())
-        while left:
-            src, arr = self.router.coll_recv_any(self.comm, pending)
-            _inter_msgs.add()
-            pending[src] -= 1
-            left -= 1
-            on_arrival(src, np.asarray(arr))
+        tok = None
+        if _watchdog.enabled:
+            tok = _watchdog.arm(self._stalled_op(),
+                                comm_id=self.comm.cid,
+                                info=self._awaiting_info(pending))
+        try:
+            while left:
+                rec = _obs.enabled
+                t0 = _time.perf_counter() if rec else 0.0
+                src, arr = self.router.coll_recv_any(self.comm, pending)
+                if tok is not None:
+                    # progress resets the stall clock (and re-arms a
+                    # wait that already dumped): a slow but ARRIVING
+                    # round is not a stall, and false dumps would burn
+                    # the MAX_STALL_DUMPS budget the real hang needs
+                    tok.t0 = _time.perf_counter()
+                    tok.dumped = False
+                _inter_msgs.add()
+                pending[src] -= 1
+                left -= 1
+                arr = np.asarray(arr)
+                if rec and _obs.enabled:
+                    _obs.record("hier_recv", "hier", t0,
+                                _time.perf_counter() - t0,
+                                nbytes=int(arr.nbytes), peer=src,
+                                comm_id=self.comm.cid,
+                                flow=self._flow(src, self.my_pidx),
+                                flow_side="t")
+                on_arrival(src, arr)
+        finally:
+            if tok is not None:
+                _watchdog.disarm(tok)
 
     def _exchange(self, arrs_for: Dict[int, list]) -> Dict[int, list]:
         """Linear inter-process exchange: send every peer its arrays,
@@ -233,7 +388,29 @@ class _HierModule:
         return np.concatenate(parts, axis=0)
 
     # -- operation table ---------------------------------------------------
+    def _wrap(self, name: str, fn: Callable) -> Callable:
+        """Round instrumentation around one table entry: when obs is
+        off this is ONE attribute check and a tail call; when on, it
+        advances the synchronized round counter, publishes the round
+        state the flight recorder dumps, and journals the whole op as
+        a coll-layer span (what the doctor's skew report rounds on)."""
+
+        def run(comm, *args, **kw):
+            if not _obs.enabled:
+                return fn(comm, *args, **kw)
+            t0 = self._round_begin(name)
+            try:
+                return fn(comm, *args, **kw)
+            finally:
+                self._round_end(name, t0)
+
+        return run
+
     def fns(self) -> Dict[str, Callable]:
+        return {name: self._wrap(name, fn)
+                for name, fn in self._table().items()}
+
+    def _table(self) -> Dict[str, Callable]:
         return {
             "allreduce": self.allreduce,
             "reduce": self.reduce,

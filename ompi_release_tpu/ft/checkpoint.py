@@ -31,7 +31,29 @@ _ckpt_count = pvar.counter("ft_checkpoints_taken", "checkpoints committed")
 
 class Checkpointer:
     def __init__(self, directory: str, *, keep: int = 3,
-                 comm=None) -> None:
+                 comm=None, private_dir: bool = False) -> None:
+        if comm is not None and getattr(comm, "spans_processes", False) \
+                and not private_dir:
+            # Snapshot commit is process-local filesystem surgery
+            # (rmtree of an existing step dir + rename + keep-last-N
+            # GC): two controller processes checkpointing into one
+            # shared directory race those steps unsynchronized — one
+            # process's commit can rmtree the dir another is renaming
+            # into. Refuse with a typed error until the coordinated
+            # (rank-0-commits, barrier-fenced) snapshot lands. The ONE
+            # safe shape today is a directory no other process touches
+            # — declare that explicitly with ``private_dir=True``
+            # (e.g. ``dir/rank{pidx}``, the recovery tests' layout).
+            raise MPIError(
+                ErrorCode.ERR_NOT_AVAILABLE,
+                f"Checkpointer on {comm.name}: this communicator spans "
+                "controller processes, and the commit protocol "
+                "(rmtree/rename/GC) is process-local — concurrent "
+                "commits into one directory race. Checkpoint on a "
+                "process-local comm (e.g. split_type_shared), or give "
+                "each process its own directory and declare it with "
+                "private_dir=True",
+            )
         self.directory = directory
         self.keep = keep
         self.comm = comm

@@ -299,10 +299,34 @@ class File:
         )
 
     # -- shared file pointer (sharedfp) ------------------------------------
+    def _require_single_process(self, what: str) -> None:
+        """The shared pointer is PROCESS-local state (``self._shared_
+        ptr`` under ``self._lock``); on a communicator spanning
+        controller processes each process would advance its OWN copy
+        and two ranks' appends would silently land at the same offset.
+        Until the pointer lives in a wire-window (the sharedfp/sm ->
+        lockedfile gap), refuse loudly instead of corrupting."""
+        if getattr(self.comm, "spans_processes", False):
+            raise MPIError(
+                ErrorCode.ERR_NOT_AVAILABLE,
+                f"{what} on {self.comm.name}: the shared file pointer "
+                "is process-local, but this communicator spans "
+                "controller processes — concurrent shared-pointer ops "
+                "from different processes would silently overlap. Use "
+                "explicit-offset writes (write_at/write_at_all) or a "
+                "process-local comm",
+            )
+
     def write_ordered(self, blocks) -> None:
         """Rank-ordered append at the shared pointer (sharedfp
         'ordered' semantics)."""
         self._check()
+        self._require_single_process("write_ordered")
+        self._append_at_shared(blocks)
+
+    def _append_at_shared(self, blocks) -> None:
+        """Shared-pointer append, checks done by the public caller
+        (so each entry point reports its own name exactly once)."""
         with self._lock:
             for blk in blocks:
                 buf = np.ascontiguousarray(np.asarray(blk, self._etype))
@@ -310,20 +334,45 @@ class File:
                           self._byte_offset(self._shared_ptr))
                 self._shared_ptr += buf.size
 
+    def read_ordered(self, counts) -> list:
+        """Rank-ordered read at the shared pointer (MPI_File_read_
+        ordered): rank i's buffer is the ``counts[i]`` elements
+        starting where rank i-1's read ended; the pointer advances by
+        the amount actually read (MPI's accessed-amount semantics, so
+        an EOF short read leaves the pointer at EOF rather than past
+        data appended later). Driver mode holds every rank, so the
+        whole ordered pass is one call returning one array per rank."""
+        self._check()
+        self._require_single_process("read_ordered")
+        counts = [int(c) for c in counts]
+        if any(c < 0 for c in counts):
+            raise MPIError(ErrorCode.ERR_COUNT,
+                           f"read_ordered counts must be >= 0: {counts}")
+        with self._lock:
+            out = []
+            for c in counts:
+                arr = self.read_at(self._shared_ptr, c)
+                out.append(arr)
+                self._shared_ptr += arr.size
+        return out
+
     def write_shared(self, data) -> int:
         """Append one buffer at the shared pointer (sharedfp
         non-ordered write: first-come placement) — one rank's
         write_ordered, sharing the placement logic."""
+        self._check()
+        self._require_single_process("write_shared")
         buf = np.asarray(data, self._etype)
-        self.write_ordered([buf])
+        self._append_at_shared([buf])
         return int(buf.size)  # not a pointer diff: races with other
         #                       shared-pointer writers would misreport
 
     def read_shared(self, count: int) -> np.ndarray:
         self._check()
+        self._require_single_process("read_shared")
         with self._lock:
             out = self.read_at(self._shared_ptr, count)
-            self._shared_ptr += count
+            self._shared_ptr += out.size  # amount accessed, not requested
         return out
 
     # -- admin -------------------------------------------------------------

@@ -29,7 +29,7 @@ import os
 from ..mca import pvar as _pvar
 from ..mca import var as _var
 from . import journal as journal_mod
-from .journal import Journal, Span  # noqa: F401  (public API)
+from .journal import Journal, Span, flow_id  # noqa: F401  (public API)
 
 #: THE hot-path gate: emit points check ``obs.enabled`` and do nothing
 #: else when False. One module attribute, mutated only by
@@ -68,6 +68,46 @@ _pvar.PVARS.register(
 )
 
 
+#: cross-controller clock alignment (runtime/coordinator.py ping-pong
+#: estimator): offset_s maps THIS process's perf_counter timebase into
+#: the HNP's; tpu-doctor subtracts per-rank offsets to merge journals
+#: onto one timeline. None = never estimated (singleton, or no HNP).
+_clock_state: dict = {"offset_s": None, "rtt_s": None, "source": None}
+
+
+def rank_identity() -> dict:
+    """Best-effort process identity (pid, pidx, world-rank span) — THE
+    shared derivation behind both the postmortem's ``rank`` block and
+    the finalize dump's ``meta``, so the doctor's two input formats
+    can never drift. Never raises (dumps run from signal handlers and
+    half-initialized runtimes)."""
+    import os as _os
+
+    ident = {"pid": _os.getpid(), "pidx": 0, "rank_offset": 0,
+             "local_size": 0}
+    try:
+        from ..runtime.runtime import Runtime
+
+        rt = Runtime._instance
+        if rt is not None and rt.bootstrap:
+            ident["pidx"] = int(rt.bootstrap.get("process_index", 0))
+            ident["rank_offset"] = int(rt.local_rank_offset)
+            ident["local_size"] = int(
+                rt.local_size or len(rt.endpoints or ())
+            )
+    except Exception:
+        pass
+    return ident
+
+
+def set_clock(offset_s: float, rtt_s: float, source: str = "oob") -> None:
+    _clock_state.update(offset_s=offset_s, rtt_s=rtt_s, source=source)
+
+
+def clock_offset():
+    return _clock_state["offset_s"]
+
+
 def enable(size: int = None) -> None:
     """Turn the plane on; the journal takes ``obs_journal_size`` (or
     the explicit ``size``) without losing already-buffered spans."""
@@ -77,11 +117,35 @@ def enable(size: int = None) -> None:
     if int(size) != journal.size:
         journal.resize(int(size))
     enabled = True
+    from . import watchdog as _wd
+
+    _wd.refresh(True)
+    # obs turned on AFTER mpi.init() (Runtime.init only installs the
+    # flight-recorder signal handlers when obs was already on): the
+    # documented `kill -USR1` dump must work for mid-run enables too.
+    # Only when a runtime is live — a bare tracing-unit enable() in a
+    # host process (pytest, bench) must not hijack its faulthandler —
+    # so probe sys.modules rather than importing the runtime (a live
+    # runtime implies the module is imported; a light obs import must
+    # not drag it in).
+    try:
+        import sys as _sys
+
+        _rt_mod = _sys.modules.get("ompi_release_tpu.runtime.runtime")
+        rt = (_rt_mod.Runtime._instance
+              if _rt_mod is not None else None)
+        if rt is not None and rt.initialized and not rt.finalized:
+            _wd.install_signal_handlers()
+    except Exception:
+        pass
 
 
 def disable() -> None:
     global enabled
     enabled = False
+    from . import watchdog as _wd
+
+    _wd.refresh(False)
 
 
 def is_enabled() -> bool:
@@ -89,10 +153,12 @@ def is_enabled() -> bool:
 
 
 def record(op: str, layer: str, t_start: float, dt: float,
-           nbytes: int = 0, peer: int = -1, comm_id: int = -1) -> Span:
+           nbytes: int = 0, peer: int = -1, comm_id: int = -1,
+           flow: int = 0, flow_side: str = "") -> Span:
     """Emit-point helper: journal one span. Callers gate on
     ``obs.enabled`` themselves so the off cost stays one attr check."""
-    return journal.record(op, layer, t_start, dt, nbytes, peer, comm_id)
+    return journal.record(op, layer, t_start, dt, nbytes, peer, comm_id,
+                          flow, flow_side)
 
 
 # the always-on switch: env var wins, then the MCA cvar
@@ -101,6 +167,7 @@ if (os.environ.get("OMPI_TPU_OBS", "").strip().lower()
         or bool(_var.get("obs_enable", False))):
     enable()
 
-# convenience: obs.export.dump_chrome_trace(...), obs.skew — imported
-# last so their journal/pvar imports see a fully-initialized package
-from . import export, skew  # noqa: E402,F401
+# convenience: obs.export.dump_chrome_trace(...), obs.skew, the stall
+# watchdog, and the doctor merge — imported last so their journal/pvar
+# imports see a fully-initialized package
+from . import export, skew, watchdog  # noqa: E402,F401

@@ -18,7 +18,7 @@ import time
 
 def selftest() -> int:
     from ..mca import mpit, pvar
-    from . import disable, enable, journal
+    from . import disable, enable, flow_id, journal
     from . import export, skew
 
     # 1. every pvar class: register, bump, read
@@ -69,6 +69,13 @@ def selftest() -> int:
     spans = journal.snapshot()
     assert len(spans) == 8 and spans[-1].op == "op11", spans
     assert spans[0].seq < spans[-1].seq
+    # flow context round-trip: deterministic id, side survives asdict
+    fid = flow_id("selftest", 1, 2)
+    assert fid == flow_id("selftest", 1, 2) and fid != flow_id("x")
+    journal.record("flow_s", "selftest", time.perf_counter(), 1e-6,
+                   flow=fid, flow_side="s")
+    fs = journal.snapshot()[-1]
+    assert fs.flow == fid and fs.asdict()["fs"] == "s", fs.asdict()
     tok = skew.begin("selftest")
     skew.body(tok)
     skew.end(tok, nbytes=64)
@@ -120,9 +127,17 @@ def selftest() -> int:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "doctor":
+        # `python -m ompi_release_tpu.obs doctor ...` == tpu-doctor
+        from ..tools.tpu_doctor import main as doctor_main
+
+        return doctor_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m ompi_release_tpu.obs",
-        description="Observability-plane utilities")
+        description="Observability-plane utilities ('doctor ...' "
+                    "forwards to tpu-doctor: merge/report/postmortem/"
+                    "collect)")
     ap.add_argument("--selftest", action="store_true",
                     help="register/bump/export/verify every pvar class "
                          "and exporter (device-free)")

@@ -1,0 +1,280 @@
+"""Job-level trace assembly — merge per-rank journals, draw flows,
+name the slow rank.
+
+Input: one :func:`obs.export.rank_dump` document per controller
+process (written at finalize via ``obs_dump_dir``, embedded in
+postmortems, or fetched over the ``tpu_server`` journal RPC). Each
+carries the rank identity and the OOB clock offset mapping that
+process's ``perf_counter`` timebase into the HNP's.
+
+Output:
+
+- :func:`merge`: ONE Perfetto/Chrome ``trace_event`` document — pid =
+  controller process (named with its world-rank span), tid = layer,
+  timestamps clock-offset-corrected, and **flow arrows** joining every
+  producer span ("s" side) to its consumer span ("t" side) by the
+  deterministic flow ids the emit points stamped (p2p envelope seq,
+  hier round/pair/index, window request token).
+- :func:`skew_report`: per (comm, op) collective-round table — round k
+  is the k-th occurrence of that op on each process (collective call
+  order is identical everywhere, MPI's own rule), arrival spread is
+  max-min corrected start, and the LAST arriver is the critical-path
+  rank for that round.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from .export import span_event
+
+
+def load_dump(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if "spans" not in doc or "meta" not in doc:
+        raise ValueError(f"{path}: not a rank journal dump "
+                         "(missing meta/spans)")
+    return doc
+
+
+def load_dir(directory: str) -> List[Dict[str, Any]]:
+    """Every ``journal-p*.json`` under ``directory``, plus — for ranks
+    that never finalized (a hung rank killed mid-job leaves ONLY
+    postmortems) — the journal tail of that rank's newest
+    ``postmortem-*.json``."""
+    dumps = []
+    for p in sorted(glob.glob(os.path.join(directory, "journal-p*.json"))):
+        dumps.append(load_dump(p))
+    finalized = {int(d["meta"].get("pidx", 0)) for d in dumps}
+    # one postmortem dump per missing rank: a hung rank routinely
+    # writes SEVERAL postmortems (one per newly stalled wait, plus
+    # operator SIGUSR1 pokes) whose journal tails overlap — merging
+    # them all would render that rank's spans twice and desync the
+    # skew report's tail alignment. Keep only the newest per pidx
+    # (latest time_unix: the longest journal tail), and only for
+    # ranks without a finalize-time journal (which supersedes tails).
+    newest: Dict[int, Tuple[float, Dict[str, Any]]] = {}
+    for p in sorted(glob.glob(os.path.join(directory,
+                                           "postmortem-*.json"))):
+        with open(p) as f:
+            pm = json.load(f)
+        tail = pm.get("journal_tail")
+        if not isinstance(tail, list):
+            continue
+        rank = pm.get("rank", {})
+        clock = pm.get("clock", {}) or {}
+        pidx = int(rank.get("pidx", 0))
+        if pidx in finalized:
+            continue
+        t = float(pm.get("time_unix", 0.0) or 0.0)
+        prev = newest.get(pidx)
+        if prev is not None and prev[0] >= t:
+            continue
+        newest[pidx] = (t, {
+            "meta": {"pidx": pidx,
+                     "rank_offset": rank.get("rank_offset", 0),
+                     "local_size": rank.get("local_size", 0),
+                     "pid": rank.get("pid"),
+                     "clock_offset_s": clock.get("offset_s"),
+                     "clock_rtt_s": clock.get("rtt_s")},
+            "spans": tail,
+        })
+    dumps.extend(d for _, (_, d) in sorted(newest.items()))
+    dumps.sort(key=lambda d: int(d["meta"].get("pidx", 0)))
+    if not dumps:
+        raise FileNotFoundError(
+            f"no journal-p*.json or postmortem-*.json dumps under "
+            f"{directory} (set --mca obs_dump_dir, or send SIGUSR1 to "
+            "the ranks first)")
+    return dumps
+
+
+def _offset(meta: Dict[str, Any]) -> float:
+    off = meta.get("clock_offset_s")
+    return float(off) if off is not None else 0.0
+
+
+def _corrected(dump: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Spans with a ``ts`` key in the merged (HNP) timebase, seconds.
+    Cached on the dump: merge(), flow_pairs(), and _coll_rounds() all
+    walk the same spans (a `tpu-doctor report` hits all three), and at
+    job scale recomputing means millions of redundant dict copies. The
+    spans are read-only downstream, so one shared list is safe."""
+    cached = dump.get("_corrected_spans")
+    if cached is None:
+        off = _offset(dump["meta"])
+        cached = []
+        for s in dump["spans"]:
+            c = dict(s)
+            c["ts"] = float(s["t"]) + off
+            cached.append(c)
+        dump["_corrected_spans"] = cached
+    return cached
+
+
+def flow_pairs(dumps: List[Dict[str, Any]]
+               ) -> List[Dict[str, Any]]:
+    """Matched (producer, consumer) span pairs across dumps: one entry
+    per flow id seen with both sides. Producer/consumer carry the
+    owning pidx so callers can tell cross-process flows apart."""
+    sides: Dict[int, Dict[str, List[Tuple[int, Dict]]]] = {}
+    for d in dumps:
+        pidx = int(d["meta"].get("pidx", 0))
+        for s in _corrected(d):
+            fl = s.get("flow")
+            if not fl:
+                continue
+            side = "s" if s.get("fs") == "s" else "t"
+            sides.setdefault(int(fl), {"s": [], "t": []})[side].append(
+                (pidx, s))
+    pairs = []
+    for fl, ends in sorted(sides.items()):
+        if not ends["s"] or not ends["t"]:
+            continue
+        # multiple spans per id would mean an id collision (64-bit FNV
+        # over distinct identifiers: vanishingly rare) — pair in order
+        for (sp, ss), (tp, ts) in zip(ends["s"], ends["t"]):
+            pairs.append({"flow": fl, "src_pidx": sp, "dst_pidx": tp,
+                          "src": ss, "dst": ts,
+                          "cross_process": sp != tp,
+                          "latency_s": ts["ts"] - (ss["ts"] + ss["dt"])})
+    return pairs
+
+
+def merge(dumps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One clock-aligned Perfetto trace for the whole job."""
+    events: List[Dict[str, Any]] = []
+    meta_events: List[Dict[str, Any]] = []
+    tids: Dict[Tuple[int, str], int] = {}
+    for d in sorted(dumps, key=lambda d: int(d["meta"].get("pidx", 0))):
+        m = d["meta"]
+        pidx = int(m.get("pidx", 0))
+        off0 = int(m.get("rank_offset", 0))
+        n = int(m.get("local_size", 0))
+        label = (f"proc {pidx} (world ranks {off0}..{off0 + n - 1})"
+                 if n else f"proc {pidx}")
+        meta_events.append({"name": "process_name", "ph": "M",
+                            "pid": pidx, "args": {"name": label}})
+        for s in _corrected(d):
+            key = (pidx, s["layer"])
+            tid = tids.get(key)
+            if tid is None:
+                tid = tids[key] = len(tids) + 1
+                meta_events.append({
+                    "name": "thread_name", "ph": "M", "pid": pidx,
+                    "tid": tid, "args": {"name": s["layer"]},
+                })
+            events.append(span_event(s, pid=pidx, tid=tid,
+                                     ts_s=s["ts"]))
+    flows = flow_pairs(dumps)
+    for p in flows:
+        src, dst = p["src"], p["dst"]
+        src_tid = tids.get((p["src_pidx"], src["layer"]), 1)
+        dst_tid = tids.get((p["dst_pidx"], dst["layer"]), 1)
+        fid = str(p["flow"])
+        events.append({
+            "name": src["op"], "cat": "flow", "ph": "s", "id": fid,
+            "pid": p["src_pidx"], "tid": src_tid,
+            "ts": (src["ts"] + src["dt"]) * 1e6,
+        })
+        events.append({
+            "name": src["op"], "cat": "flow", "ph": "f", "bp": "e",
+            "id": fid, "pid": p["dst_pidx"], "tid": dst_tid,
+            "ts": (dst["ts"] + dst["dt"]) * 1e6,
+        })
+    doc = {"traceEvents": meta_events + events, "displayTimeUnit": "ms"}
+    doc["otherData"] = {
+        "processes": len(dumps),
+        "spans": sum(len(d["spans"]) for d in dumps),
+        "flows": len(flows),
+        "cross_process_flows": sum(1 for p in flows
+                                   if p["cross_process"]),
+    }
+    return doc
+
+
+def _coll_rounds(dumps: List[Dict[str, Any]]
+                 ) -> Dict[Tuple[int, str], Dict[int, List[Dict]]]:
+    """(comm, op) -> pidx -> that pid's coll-layer spans in call
+    order. Only the 'coll' layer counts as a round marker (hier and
+    driver both stamp it)."""
+    table: Dict[Tuple[int, str], Dict[int, List[Dict]]] = {}
+    for d in dumps:
+        pidx = int(d["meta"].get("pidx", 0))
+        for s in _corrected(d):
+            if s["layer"] != "coll":
+                continue
+            table.setdefault((int(s.get("comm", -1)), s["op"]), {}) \
+                .setdefault(pidx, []).append(s)
+    return table
+
+
+def skew_report(dumps: List[Dict[str, Any]]
+                ) -> Tuple[str, Dict[str, Any]]:
+    """Critical-path + rank-skew report: for every collective round
+    observed on EVERY process, name the last arriver (the rank the
+    round waited for) and the arrival spread."""
+    by_pid_ranks = {
+        int(d["meta"].get("pidx", 0)): (
+            int(d["meta"].get("rank_offset", 0)),
+            int(d["meta"].get("local_size", 0)))
+        for d in dumps
+    }
+
+    def rank_span(pidx: int) -> str:
+        off, n = by_pid_ranks.get(pidx, (0, 0))
+        return f"ranks {off}..{off + n - 1}" if n else "ranks ?"
+
+    rounds_out: List[Dict[str, Any]] = []
+    crit_count: Dict[int, int] = {}
+    lateness: Dict[int, float] = {}
+    for (comm, op), per_pid in sorted(_coll_rounds(dumps).items()):
+        if len(per_pid) < 2:
+            continue  # a round needs >= 2 processes to have skew
+        # align rounds from the TAIL: ring journals keep the NEWEST
+        # spans, so when ranks wrapped or truncated differently the
+        # common suffix is the set of rounds every dump still holds —
+        # head alignment would pair different rounds and blame the
+        # wrong rank (finalize-time dumps all end at the job's last
+        # collective, making the suffix exact)
+        n_rounds = min(len(v) for v in per_pid.values())
+        tails = {p: v[-n_rounds:] for p, v in per_pid.items()}
+        for k in range(n_rounds):
+            arrivals = {p: tails[p][k]["ts"] for p in per_pid}
+            slow = max(arrivals, key=arrivals.get)
+            fast = min(arrivals, key=arrivals.get)
+            spread = arrivals[slow] - arrivals[fast]
+            crit_count[slow] = crit_count.get(slow, 0) + 1
+            lateness[slow] = lateness.get(slow, 0.0) + spread
+            rounds_out.append({
+                "comm": comm, "op": op, "round": k,
+                "slowest_pidx": slow, "spread_s": spread,
+                "arrivals": {str(p): arrivals[p] for p in arrivals},
+            })
+    lines = ["tpu-doctor rank-skew / critical-path report",
+             f"  processes: {len(dumps)}  collective rounds: "
+             f"{len(rounds_out)}"]
+    worst = sorted(rounds_out, key=lambda r: -r["spread_s"])[:10]
+    if worst:
+        lines.append("  worst rounds by arrival spread:")
+        for r in worst:
+            lines.append(
+                f"    comm {r['comm']} {r['op']} round {r['round']}: "
+                f"spread {r['spread_s'] * 1e3:.3f} ms, slowest proc "
+                f"{r['slowest_pidx']} ({rank_span(r['slowest_pidx'])})")
+    if crit_count:
+        lines.append("  critical-path share (times slowest / total "
+                     "lateness):")
+        for p in sorted(crit_count, key=lambda p: -crit_count[p]):
+            lines.append(
+                f"    proc {p} ({rank_span(p)}): {crit_count[p]} "
+                f"round(s), {lateness[p] * 1e3:.3f} ms accumulated")
+    else:
+        lines.append("  no multi-process collective rounds found "
+                     "(was obs enabled on every rank?)")
+    return "\n".join(lines), {"rounds": rounds_out,
+                              "critical_path": crit_count}

@@ -31,6 +31,33 @@ from .journal import Span
 # ---------------------------------------------------------------------------
 
 
+def span_event(s: Dict[str, Any], pid: int, tid: int,
+               ts_s: Optional[float] = None) -> Dict[str, Any]:
+    """One journal span (``Span.asdict`` form) as a Chrome
+    ``trace_event`` — THE conversion shared by the single-rank
+    :func:`chrome_trace` and tpu-doctor's multi-rank merge, so the two
+    trace shapes cannot drift. ``ts_s`` overrides the span's own
+    timestamp (the merge passes clock-offset-corrected seconds)."""
+    args = {"bytes": s.get("bytes", 0), "peer": s.get("peer", -1),
+            "comm": s.get("comm", -1), "seq": s.get("seq", -1)}
+    if s.get("flow"):
+        args["flow"] = s["flow"]
+        args["flow_side"] = s.get("fs", "")
+    ev: Dict[str, Any] = {
+        "name": s["op"], "cat": s["layer"], "pid": pid, "tid": tid,
+        # trace_event wants microseconds
+        "ts": (s["t"] if ts_s is None else ts_s) * 1e6,
+        "args": args,
+    }
+    if s["dt"] > 0:
+        ev["ph"] = "X"
+        ev["dur"] = s["dt"] * 1e6
+    else:
+        ev["ph"] = "i"
+        ev["s"] = "t"  # thread-scoped instant
+    return ev
+
+
 def chrome_trace(spans: Optional[Sequence[Span]] = None) -> Dict[str, Any]:
     """The journal as a ``trace_event`` JSON document (dict form)."""
     if spans is None:
@@ -39,19 +66,7 @@ def chrome_trace(spans: Optional[Sequence[Span]] = None) -> Dict[str, Any]:
     events: List[Dict[str, Any]] = []
     for s in spans:
         tid = tids.setdefault(s.layer, len(tids) + 1)
-        ev: Dict[str, Any] = {
-            "name": s.op, "cat": s.layer, "pid": 0, "tid": tid,
-            "ts": s.t_start * 1e6,  # trace_event wants microseconds
-            "args": {"bytes": s.nbytes, "peer": s.peer,
-                     "comm": s.comm_id, "seq": s.seq},
-        }
-        if s.dt > 0:
-            ev["ph"] = "X"
-            ev["dur"] = s.dt * 1e6
-        else:
-            ev["ph"] = "i"
-            ev["s"] = "t"  # thread-scoped instant
-        events.append(ev)
+        events.append(span_event(s.asdict(), pid=0, tid=tid))
     meta = [
         {"name": "process_name", "ph": "M", "pid": 0,
          "args": {"name": "ompi_release_tpu"}},
@@ -77,6 +92,58 @@ def dump_jsonl(path: str, spans: Optional[Sequence[Span]] = None) -> str:
         for s in spans:
             f.write(json.dumps(s.asdict()) + "\n")
     return path
+
+
+# ---------------------------------------------------------------------------
+# per-rank journal dump (the tpu-doctor merge input)
+# ---------------------------------------------------------------------------
+
+
+def rank_dump(clock_sync: bool = True) -> Dict[str, Any]:
+    """This process's journal + identity + OOB clock offset as one
+    JSON-able document — the unit ``tpu-doctor merge`` joins across
+    ranks. ``clock_sync=True`` refreshes the offset against the HNP
+    when an agent link exists (a few OOB round trips)."""
+    from .. import obs as _obs
+
+    meta: Dict[str, Any] = _obs.rank_identity()
+    if clock_sync:
+        try:
+            from ..runtime.runtime import Runtime
+
+            rt = Runtime._instance
+            if rt is not None and rt.agent is not None:
+                off, rtt = rt.agent.clock_sync()
+                _obs.set_clock(off, rtt)
+        except Exception:
+            pass  # offset stays at its last/None value
+    meta["clock_offset_s"] = _obs._clock_state["offset_s"]
+    meta["clock_rtt_s"] = _obs._clock_state["rtt_s"]
+    return {"meta": meta,
+            "spans": [s.asdict() for s in _JOURNAL.snapshot()]}
+
+
+def dump_rank_journal(path: str, clock_sync: bool = True) -> str:
+    with open(path, "w") as f:
+        json.dump(rank_dump(clock_sync=clock_sync), f)
+    return path
+
+
+def maybe_dump_rank_journal(runtime=None) -> Optional[str]:
+    """Finalize hook: when ``obs_dump_dir`` is set (and obs is on),
+    write this rank's journal dump there. Returns the path or None."""
+    import os
+
+    from ..mca import var as _var
+
+    d = str(_var.get("obs_dump_dir", "") or "")
+    if not d:
+        return None
+    os.makedirs(d, exist_ok=True)
+    pidx = 0
+    if runtime is not None and runtime.bootstrap:
+        pidx = int(runtime.bootstrap.get("process_index", 0))
+    return dump_rank_journal(os.path.join(d, f"journal-p{pidx}.json"))
 
 
 # ---------------------------------------------------------------------------

@@ -14,6 +14,14 @@ work ``dt`` is *dispatch-side* time (jax dispatch is async; blocking
 for device completion inside an emit point would change program
 behavior); peruse-bridge spans carry the event's element count in the
 ``nbytes`` slot, as fired.
+
+**Cross-rank flow context**: a span may carry a ``flow`` id plus a
+``flow_side`` ("s" = this span produced the message, "t" = this span
+consumed it). Both sides derive the SAME id from identifiers that
+already cross the wire (the p2p envelope's (sender process, seq), the
+hier round's (cid, round, pair, msg index), the window service's
+(origin process, token)) — no wire-format change, and ``tpu-doctor``
+joins per-rank journals into Perfetto flow arrows by matching ids.
 """
 
 from __future__ import annotations
@@ -23,14 +31,31 @@ from typing import Any, Dict, List, Optional
 
 DEFAULT_SIZE = 4096
 
+_FNV_OFFSET = 0xcbf29ce484222325
+_FNV_PRIME = 0x100000001b3
+
+
+def flow_id(*parts) -> int:
+    """Deterministic 64-bit flow id from identifying parts (FNV-1a over
+    their joined string form). Python's ``hash()`` is salted per
+    process (PYTHONHASHSEED), so two ranks hashing the same tuple would
+    NOT agree — this must stay an explicit, process-independent fold.
+    Never returns 0 (0 = "no flow" in a span)."""
+    h = _FNV_OFFSET
+    for b in "\x1f".join(str(p) for p in parts).encode():
+        h ^= b
+        h = (h * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h or 1
+
 
 class Span:
     __slots__ = ("seq", "op", "layer", "t_start", "dt", "nbytes",
-                 "peer", "comm_id")
+                 "peer", "comm_id", "flow", "flow_side")
 
     def __init__(self, seq: int, op: str, layer: str, t_start: float,
                  dt: float, nbytes: int = 0, peer: int = -1,
-                 comm_id: int = -1) -> None:
+                 comm_id: int = -1, flow: int = 0,
+                 flow_side: str = "") -> None:
         self.seq = seq
         self.op = op
         self.layer = layer
@@ -39,11 +64,17 @@ class Span:
         self.nbytes = nbytes
         self.peer = peer
         self.comm_id = comm_id
+        self.flow = flow
+        self.flow_side = flow_side
 
     def asdict(self) -> Dict[str, Any]:
-        return {"seq": self.seq, "op": self.op, "layer": self.layer,
-                "t": self.t_start, "dt": self.dt, "bytes": self.nbytes,
-                "peer": self.peer, "comm": self.comm_id}
+        d = {"seq": self.seq, "op": self.op, "layer": self.layer,
+             "t": self.t_start, "dt": self.dt, "bytes": self.nbytes,
+             "peer": self.peer, "comm": self.comm_id}
+        if self.flow:
+            d["flow"] = self.flow
+            d["fs"] = self.flow_side
+        return d
 
     def __repr__(self) -> str:
         return (f"Span(#{self.seq} {self.layer}/{self.op} "
@@ -78,11 +109,13 @@ class Journal:
             return self._wrapped
 
     def record(self, op: str, layer: str, t_start: float, dt: float,
-               nbytes: int = 0, peer: int = -1, comm_id: int = -1) -> Span:
+               nbytes: int = 0, peer: int = -1, comm_id: int = -1,
+               flow: int = 0, flow_side: str = "") -> Span:
         with self._lock:
             seq = self._next_seq
             self._next_seq = seq + 1
-            sp = Span(seq, op, layer, t_start, dt, nbytes, peer, comm_id)
+            sp = Span(seq, op, layer, t_start, dt, nbytes, peer, comm_id,
+                      flow, flow_side)
             slot = seq % self._size
             if self._buf[slot] is not None:
                 self._wrapped += 1

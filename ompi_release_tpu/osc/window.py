@@ -22,6 +22,7 @@ outside any epoch raises; fence/lock/PSCW cannot be mixed.
 from __future__ import annotations
 
 import enum
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -50,6 +51,16 @@ _epoch_dispatches = pvar.counter(
 #: branches, scalar-payload mode) — padding keeps the cache O(log n)
 #: per branch set across varying epoch lengths
 _program_cache: Dict[Tuple, object] = {}
+
+#: one epoch program compiles/executes at a time, PROCESS-wide: two
+#: threads driving first-call jit compilation/execution concurrently
+#: (distinct windows, so the per-window _op_lock does not serialize
+#: them) deadlock inside this jaxlib — both park in prog() forever
+#: (reproduced ~1 in 3 by test_shmem_topo's lock-contention test, the
+#: flight recorder's own thread stacks pinpointed it). Epoch programs
+#: are sub-ms on driver-mode windows, so serializing dispatch costs
+#: nothing measurable.
+_dispatch_lock = threading.Lock()
 
 LOCK_EXCLUSIVE = 1
 LOCK_SHARED = 2
@@ -395,6 +406,20 @@ class Window:
             self._pending.append(op)
         return op.request
 
+    def _rma_request(self, target: int) -> Request:
+        """A Request completable by ``wait()`` ALONE: its block_fn
+        flushes the op's target (``_apply_pending(only_target)``), the
+        per-op completion MPI 3.1 gives request-based RMA inside a
+        passive epoch (``osc.h:341-366`` — MPI_Wait on an Rput/Rget
+        request has flush semantics for that operation). Without this,
+        wait() before the epoch close raised 'wait() would deadlock'
+        even though the spec promises completion. Flushing the whole
+        target is stronger than one op — allowed, same-origin ordering
+        makes it indistinguishable."""
+        return Request(
+            block_fn=lambda: self._apply_pending(only_target=target)
+        )
+
     def put(self, data, target: int, index: Optional[int] = None) -> None:
         """Put a whole slot, or (``index`` given) a single element at a
         flat offset within the slot (MPI target_disp addressing)."""
@@ -402,7 +427,7 @@ class Window:
                                index=index))
 
     def get(self, target: int) -> Request:
-        req = Request()
+        req = self._rma_request(target)
         self._queue(_PendingOp("get", target, request=req))
         return req
 
@@ -413,7 +438,7 @@ class Window:
 
     def get_accumulate(self, data, target: int, op: Op = SUM,
                        index: Optional[int] = None) -> Request:
-        req = Request()
+        req = self._rma_request(target)
         self._queue(
             _PendingOp("get_acc", target, jnp.asarray(data), op, req,
                        index=index)
@@ -436,14 +461,14 @@ class Window:
     # origin-completion semantics allow.
     def rput(self, data, target: int,
              index: Optional[int] = None) -> Request:
-        req = Request()
+        req = self._rma_request(target)
         self._queue(_PendingOp("put", target, jnp.asarray(data), REPLACE,
                                request=req, index=index))
         return req
 
     def raccumulate(self, data, target: int, op: Op = SUM,
                     index: Optional[int] = None) -> Request:
-        req = Request()
+        req = self._rma_request(target)
         self._queue(_PendingOp("acc", target, jnp.asarray(data), op,
                                request=req, index=index))
         return req
@@ -461,7 +486,7 @@ class Window:
         CAS at a flat offset (MPI semantics, ``osc.h:324``); without,
         an elementwise CAS over the whole slot (a documented
         whole-block extension)."""
-        req = Request()
+        req = self._rma_request(target)
         self._queue(
             _PendingOp("cas", target, jnp.asarray(value), None, req,
                        compare=jnp.asarray(compare), index=index)
@@ -630,36 +655,38 @@ class Window:
         )
 
         sig = (n_pad, block, str(dtype), tuple(branch_keys), scalar_mode)
-        prog = _program_cache.get(sig)
-        if prog is None:
-            _epoch_programs.add()
+        with _dispatch_lock:
+            prog = _program_cache.get(sig)
+            if prog is None:
+                _epoch_programs.add()
 
-            def close_epoch(data, codes, targets, payloads, compares,
-                            indices):
-                def step(data, xs):
-                    code, tgt, payv, cmpv, idx = xs
-                    cur = lax.dynamic_index_in_dim(
-                        data, tgt, 0, keepdims=False
-                    )
-                    new, read = lax.switch(
-                        code, branch_fns, cur, payv, cmpv, idx
-                    )
-                    data = lax.dynamic_update_index_in_dim(
-                        data, new, tgt, 0
-                    )
-                    return data, read
+                def close_epoch(data, codes, targets, payloads,
+                                compares, indices):
+                    def step(data, xs):
+                        code, tgt, payv, cmpv, idx = xs
+                        cur = lax.dynamic_index_in_dim(
+                            data, tgt, 0, keepdims=False
+                        )
+                        new, read = lax.switch(
+                            code, branch_fns, cur, payv, cmpv, idx
+                        )
+                        data = lax.dynamic_update_index_in_dim(
+                            data, new, tgt, 0
+                        )
+                        return data, read
 
-                return lax.scan(
-                    step, data,
-                    (codes, targets, payloads, compares, indices)
-                )
+                    return lax.scan(
+                        step, data,
+                        (codes, targets, payloads, compares, indices)
+                    )
 
-            prog = jax.jit(close_epoch)
-            _program_cache[sig] = prog
-        _epoch_dispatches.add()
-        new_data, reads = prog(
-            self._data, codes_a, targets_a, payloads, compares, indices
-        )
+                prog = jax.jit(close_epoch)
+                _program_cache[sig] = prog
+            _epoch_dispatches.add()
+            new_data, reads = prog(
+                self._data, codes_a, targets_a, payloads, compares,
+                indices
+            )
         for i, p in enumerate(todo):
             if p.request is not None:
                 value = reads[i]

@@ -36,6 +36,7 @@ import itertools
 import json
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
@@ -44,7 +45,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs as _obs
+from ..mca import pvar
 from ..native import DssBuffer
+from ..obs import watchdog as _watchdog
 from ..ops.op import PREDEFINED_OPS
 from ..request.request import Status
 from ..utils import output
@@ -53,6 +57,55 @@ from .window import (LOCK_EXCLUSIVE, LOCK_SHARED, Window, _EpochKind,
                      _PendingOp)
 
 _log = output.stream("osc")
+
+_win_requests = pvar.counter(
+    "osc_wire_requests",
+    "cross-process window service requests (batch/lock/abandon)",
+)
+
+#: live window services (one per runtime) for the flight recorder's
+#: lock-table contributor — weak so a torn-down runtime's service
+#: never pins memory or shows up in dumps
+_services: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _lock_tables_snapshot() -> List[Dict]:
+    """Dump contributor: every live service's passive-target lock
+    table + outstanding reply slots (who holds what, who waits).
+    Lock acquisition is BOUNDED: the recorder dumps because something
+    is hung, possibly a thread wedged inside these very critical
+    sections — blocking here would hang the flight recorder itself
+    (and, via _dump_lock, every later dump)."""
+    out = []
+    for svc in list(_services):
+        entry: Dict = {"pidx": svc.my_pidx}
+        if svc._state_lock.acquire(timeout=0.5):
+            try:
+                entry["locks"] = [
+                    {"cid": k[0], "win_seq": k[1], "target": k[2],
+                     "mode": st.mode, "holders": sorted(st.holders),
+                     "waiters": [{"origin": w[0], "type": w[1],
+                                  "local": w[2] is not None}
+                                 for w in st.waiters]}
+                    for k, st in svc._locks.items()
+                ]
+            finally:
+                svc._state_lock.release()
+        else:
+            entry["locks"] = "unavailable: state lock held (a thread " \
+                             "is wedged inside the lock table)"
+        if svc._reply_guard.acquire(timeout=0.5):
+            try:
+                entry["outstanding_requests"] = len(svc._reply_slots)
+            finally:
+                svc._reply_guard.release()
+        else:
+            entry["outstanding_requests"] = "unavailable: reply guard held"
+        out.append(entry)
+    return out
+
+
+_watchdog.add_contributor("window_locks", _lock_tables_snapshot)
 
 #: window-service envelopes (any-source); payloads ride the three
 #: sibling channels so an any-source envelope pop can never swallow
@@ -177,6 +230,7 @@ class WinService:
         #: seq/kind) — tokens make staleness decidable
         self._token = itertools.count(1)
         self._stop = threading.Event()
+        _services.add(self)  # flight-recorder lock-table visibility
         self._thread = threading.Thread(
             target=self._serve, daemon=True, name="win-service"
         )
@@ -260,6 +314,8 @@ class WinService:
             # origin must get SOME reply or it stalls for the full
             # request timeout — failures reply KIND_ERROR (loud at the
             # origin, service stays alive)
+            rec = _obs.enabled  # capture once: flag may flip mid-apply
+            t0 = time.perf_counter() if rec else 0.0
             payload = self.router._recv_payload(WIRE_WIN_DATA, src_pidx)
             try:
                 win = self._window(int(cid), int(seq))
@@ -273,6 +329,15 @@ class WinService:
                 self._reply(src_pidx, int(cid), int(seq), KIND_ERROR, [],
                             token)
                 return
+            if rec and _obs.enabled:
+                # consumer side of the origin's (origin pidx, token)
+                # flow: both values rode the request envelope
+                _obs.record("win_apply", "osc", t0,
+                            time.perf_counter() - t0,
+                            nbytes=int(getattr(payload, "nbytes", 0)),
+                            peer=src_pidx, comm_id=int(cid),
+                            flow=_obs.flow_id("win", src_pidx, token),
+                            flow_side="t")
             self._reply(src_pidx, int(cid), int(seq), KIND_BATCH, reads,
                         token)
         elif kind == KIND_LOCK:
@@ -378,6 +443,17 @@ class WinService:
         unlock PRODUCES that grant proceeds through its own
         request/reply unimpeded (the ADVICE r5 two-thread deadlock)."""
         token = next(self._token)
+        _win_requests.add()
+        rec = _obs.enabled  # capture once: flag may flip mid-request
+        t0 = time.perf_counter() if rec else 0.0
+        wd_tok = None
+        if _watchdog.enabled:
+            wd_tok = _watchdog.arm(
+                f"win_request_kind{kind}", comm_id=win.comm.cid,
+                peer=owner_pidx,
+                info={"win_seq": win.win_seq, "token": token,
+                      "arg1": arg1, "arg2": arg2},
+            )
         slot = {"ev": threading.Event(), "reads": None, "kind": None,
                 "cid": -1, "seq": -1}
         with self._reply_guard:
@@ -396,6 +472,15 @@ class WinService:
                 if payload is not None:
                     self.router._send_payload(owner_pidx, WIRE_WIN_DATA,
                                               payload)
+            if rec and _obs.enabled:
+                # producer side: the home's win_apply span derives the
+                # same (origin pidx, token) id from the envelope
+                _obs.record(
+                    "win_request", "osc", t0, time.perf_counter() - t0,
+                    nbytes=int(getattr(payload, "nbytes", 0) or 0),
+                    peer=owner_pidx, comm_id=win.comm.cid,
+                    flow=_obs.flow_id("win", self.my_pidx, token),
+                    flow_side="s")
             deadline = time.monotonic() + timeout_ms / 1000
             while not slot["ev"].is_set():
                 # one thread at a time pumps the shared channel; the
@@ -421,6 +506,8 @@ class WinService:
                         f"{timeout_ms / 1000:.0f}s",
                     )
         finally:
+            if wd_tok is not None:
+                _watchdog.disarm(wd_tok)
             with self._reply_guard:
                 self._reply_slots.pop(token, None)
         if slot["kind"] == KIND_ERROR:
@@ -481,18 +568,30 @@ class WinService:
             return
         timeout_s = float(mca_var.get("osc_pscw_timeout_s", 0) or 0)
         deadline = (time.monotonic() + timeout_s) if timeout_s else None
-        with self._pscw_cv:
-            while not want <= table.get(key, set()):
-                if deadline is not None:
-                    left = deadline - time.monotonic()
-                    if left <= 0:
-                        raise MPIError(
-                            ErrorCode.ERR_RMA_SYNC,
-                            f"PSCW {what} timed out awaiting processes "
-                            f"{sorted(want - table.get(key, set()))}",
-                        )
-                self._pscw_cv.wait(timeout=1.0)
-            table[key] -= want
+        wd_tok = None
+        if _watchdog.enabled:
+            wd_tok = _watchdog.arm(
+                f"pscw_{what}", comm_id=key[0],
+                info=lambda: {"awaiting_procs": sorted(
+                    want - table.get(key, set()))},
+            )
+        try:
+            with self._pscw_cv:
+                while not want <= table.get(key, set()):
+                    if deadline is not None:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            raise MPIError(
+                                ErrorCode.ERR_RMA_SYNC,
+                                f"PSCW {what} timed out awaiting "
+                                f"processes "
+                                f"{sorted(want - table.get(key, set()))}",
+                            )
+                    self._pscw_cv.wait(timeout=1.0)
+                table[key] -= want
+        finally:
+            if wd_tok is not None:
+                _watchdog.disarm(wd_tok)
 
     # -- home-side lock table ----------------------------------------------
     def _lock_key(self, win: "WireWindow", target: int
@@ -577,7 +676,18 @@ class WinService:
         ev = threading.Event()
         if self.acquire(win, target, self.my_pidx, lock_type, event=ev):
             return
-        if ev.wait(timeout=timeout_s):
+        wd_tok = None
+        if _watchdog.enabled:
+            wd_tok = _watchdog.arm(
+                "win_lock_wait", comm_id=win.comm.cid, peer=target,
+                info={"win_seq": win.win_seq, "lock_type": lock_type},
+            )
+        try:
+            granted = ev.wait(timeout=timeout_s)
+        finally:
+            if wd_tok is not None:
+                _watchdog.disarm(wd_tok)
+        if granted:
             return
         with self._state_lock:
             if ev.is_set():

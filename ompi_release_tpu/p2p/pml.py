@@ -27,6 +27,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from .. import obs as _obs
 from ..mca import component as mca_component
+from ..obs import watchdog as _watchdog
 from ..mca import pvar
 from ..mca import var as mca_var
 from ..request.request import Request, Status
@@ -355,11 +356,18 @@ class PmlEngine:
         self._deliver(message, entry)
         return entry.request.value, entry.request.status
 
-    def dump_queues(self) -> Dict[str, list]:
+    def dump_queues(self, lock_timeout_s: float = 0.5) -> Dict[str, list]:
         """Debugger message-queue dump (the TotalView DLL contract,
         ``ompi/debuggers``): every pending send/recv with its
-        match envelope."""
-        with self._lock:
+        match envelope. Lock acquisition is BOUNDED: the flight
+        recorder calls this while diagnosing hangs, and a thread
+        wedged inside a match-lock critical section (e.g. a
+        rendezvous pull whose peer died) must not hang the dump."""
+        if not self._lock.acquire(timeout=lock_timeout_s):
+            return {"unexpected": [], "posted": [],
+                    "error": "match lock held (a thread is wedged "
+                             "inside the matching engine)"}
+        try:
             for dst in set(self._unexpected) | set(self._posted):
                 self._purge_cancelled(dst)
             return {
@@ -374,6 +382,8 @@ class PmlEngine:
                     for q in self._posted.values() for r in q
                 ],
             }
+        finally:
+            self._lock.release()
 
     # -- persistent --------------------------------------------------------
     def send_init(self, data, dst: int, tag: int = 0, *, src: int) -> Request:
@@ -413,7 +423,7 @@ class PmlEngine:
                     dst=recv.dst, tag=send.tag, count=int(data.size))
         peruse.fire(self.comm, peruse.REQ_COMPLETE, src=send.src,
                     dst=recv.dst, tag=send.tag)
-        if rec:  # matched delivery incl. any rendezvous pull
+        if rec and _obs.enabled:  # matched delivery incl. rndv pull
             _obs.record("deliver", "pml", t0, _time.perf_counter() - t0,
                         nbytes=self._nbytes(data), peer=send.src,
                         comm_id=self.comm.cid)
@@ -504,17 +514,28 @@ class WirePmlEngine(PmlEngine):
         def block() -> None:
             import time as _time
 
-            limit = float(mca_var.get("pml_wire_timeout", 30.0))
-            deadline = _time.monotonic() + limit
-            while _time.monotonic() < deadline:
-                router.poll_acks(src_world, timeout_ms=100)
-                if router.take_ack(cid, seq):
-                    return
-            raise MPIError(
-                ErrorCode.ERR_PENDING,
-                f"ssend to rank {dst} never matched (no ack within "
-                f"{limit}s; pml_wire_timeout raises the limit)",
-            )
+            tok = None
+            if _watchdog.enabled:
+                tok = _watchdog.arm(
+                    "p2p_ssend_ack", comm_id=cid, peer=dst,
+                    info={"src": src, "dst": dst, "tag": tag,
+                          "seq": seq},
+                )
+            try:
+                limit = float(mca_var.get("pml_wire_timeout", 30.0))
+                deadline = _time.monotonic() + limit
+                while _time.monotonic() < deadline:
+                    router.poll_acks(src_world, timeout_ms=100)
+                    if router.take_ack(cid, seq):
+                        return
+                raise MPIError(
+                    ErrorCode.ERR_PENDING,
+                    f"ssend to rank {dst} never matched (no ack within "
+                    f"{limit}s; pml_wire_timeout raises the limit)",
+                )
+            finally:
+                if tok is not None:
+                    _watchdog.disarm(tok)
 
         req = Request(progress_fn=progress, block_fn=block)
         # the block() completion path reaches Request.wait()'s bare
@@ -549,18 +570,30 @@ class WirePmlEngine(PmlEngine):
             def block() -> None:
                 import time as _time
 
-                limit = float(mca_var.get("pml_wire_timeout", 30.0))
-                deadline = _time.monotonic() + limit
-                while (not req.is_complete
-                       and _time.monotonic() < deadline):
-                    engine._drain(dst, timeout_ms=100)
-                if not req.is_complete:
-                    raise MPIError(
-                        ErrorCode.ERR_PENDING,
-                        f"recv(source={source}, tag={tag}) at rank "
-                        f"{dst}: no matching message within {limit}s "
-                        "(pml_wire_timeout raises the limit)",
+                tok = None
+                if _watchdog.enabled:
+                    tok = _watchdog.arm(
+                        "p2p_recv", comm_id=engine.comm.cid,
+                        peer=source,
+                        info={"source": source, "tag": tag, "dst": dst},
                     )
+                try:
+                    limit = float(mca_var.get("pml_wire_timeout", 30.0))
+                    deadline = _time.monotonic() + limit
+                    while (not req.is_complete
+                           and _time.monotonic() < deadline):
+                        engine._drain(dst, timeout_ms=100)
+                    if not req.is_complete:
+                        raise MPIError(
+                            ErrorCode.ERR_PENDING,
+                            f"recv(source={source}, tag={tag}) at rank "
+                            f"{dst}: no matching message within "
+                            f"{limit}s (pml_wire_timeout raises the "
+                            "limit)",
+                        )
+                finally:
+                    if tok is not None:
+                        _watchdog.disarm(tok)
 
             req._progress_fn = progress
             req._block_fn = block

@@ -53,6 +53,7 @@ TAG_XCAST_ORPHAN = 8  # worker->HNP: deliver xcast to unreachable child
 TAG_PS = 13           # ps/top client->HNP: live job snapshot query
 TAG_MIGRATE = 14      # migrate client->HNP: move ranks off a host
 TAG_DIE = 15          # HNP->worker: exit immediately (odls kill)
+TAG_CLOCK = 16        # worker->HNP ping-pong: clock-offset estimation
 #                       (9-12 are the pubsub name-service tags)
 # pubsub tags + protocol live in runtime/pubsub.py (shared with the
 # standalone tpu-server); re-exported here for the worker-facing API
@@ -404,6 +405,35 @@ class HnpCoordinator:
         self._ps_thread = threading.Thread(target=run, daemon=True)
         self._ps_thread.start()
 
+    # -- clock alignment (the obs-plane merge timebase) --------------------
+    def start_clock_responder(self) -> None:
+        """Serve TAG_CLOCK ping-pongs: echo the worker's payload back
+        with OUR ``perf_counter`` reading appended. Workers run the
+        classic NTP-style estimator (min-RTT sample, midpoint offset)
+        against these replies, so every rank's journal timestamps can
+        be mapped into ONE timebase — the HNP's — when tpu-doctor
+        merges them. Shares the ps responder's stop event (created in
+        __init__), so start order does not matter."""
+
+        def run() -> None:
+            while not self._ps_stop.is_set():
+                try:
+                    src, _, raw = self.ep.recv(tag=TAG_CLOCK,
+                                               timeout_ms=200)
+                except MPIError:
+                    continue
+                b = DssBuffer()
+                b.pack_string(raw.decode("utf-8", "replace"))
+                b.pack_string(repr(time.perf_counter()))
+                try:
+                    self.ep.send(src, TAG_CLOCK, b.tobytes())
+                except MPIError:
+                    pass  # client vanished between ping and pong
+
+        self._clock_thread = threading.Thread(
+            target=run, daemon=True, name="hnp-clock")
+        self._clock_thread.start()
+
     def kill_worker(self, node_id: int, code: int = 143) -> None:
         """Order a worker to exit via its die watcher (the odls kill
         path — reaches THE WORKER ITSELF even when it was launched
@@ -447,7 +477,8 @@ class HnpCoordinator:
         # an in-flight migrate_fn kills/respawns ranks (seconds of
         # process teardown/launch) and mutates Job state — shutdown
         # must wait for it, not race it with ep.close()
-        for name, budget in (("_ps_thread", 2), ("_migrate_thread", 30)):
+        for name, budget in (("_ps_thread", 2), ("_migrate_thread", 30),
+                             ("_clock_thread", 2)):
             t = getattr(self, name, None)
             if t is not None:
                 t.join(timeout=budget)
@@ -536,6 +567,9 @@ class WorkerAgent:
         # lazy check-then-set would mint two locks and defeat the
         # reply serialization pubsub_rpc requires
         self._pubsub_lock = threading.Lock()
+        # same discipline for clock ping-pongs (the dump path and an
+        # operator SIGUSR1 can race a finalize-time sync)
+        self._clock_lock = threading.Lock()
 
     def run_modex(self, my_card: Dict[str, Any], *,
                   timeout_ms: int = 30_000) -> List[Dict[str, Any]]:
@@ -647,6 +681,48 @@ class WorkerAgent:
         if not ok:
             raise MPIError(ErrorCode.ERR_NAME,
                            f"unpublish '{service}': not published")
+
+    # -- clock alignment ---------------------------------------------------
+    def clock_sync(self, rounds: int = 8,
+                   timeout_ms: int = 2000) -> tuple:
+        """Estimate this process's ``perf_counter`` offset to the
+        HNP's via TAG_CLOCK ping-pongs: offset = hnp_mid - local_mid
+        of the MINIMUM-RTT sample (the NTP discipline — the tightest
+        round trip bounds the asymmetry error by rtt/2). Returns
+        ``(offset_s, rtt_s)``; adding ``offset_s`` to a local
+        perf_counter reading yields HNP time. Raises ERR_PENDING when
+        no pong arrives (responder not running)."""
+        import uuid as _uuid
+
+        best: Optional[tuple] = None
+        with self._clock_lock:
+            for i in range(max(1, rounds)):
+                nonce = _uuid.uuid4().hex[:16]
+                t0 = time.perf_counter()
+                try:
+                    self.ep.send(0, TAG_CLOCK, nonce.encode())
+                    deadline = time.monotonic() + timeout_ms / 1000
+                    while True:
+                        left = max(1, int((deadline - time.monotonic())
+                                          * 1000))
+                        _, _, raw = self.ep.recv(tag=TAG_CLOCK,
+                                                 timeout_ms=left)
+                        t1 = time.perf_counter()
+                        b = DssBuffer(raw)
+                        if b.unpack_string() == nonce:
+                            break  # stale pong from an abandoned
+                            #        round: keep draining inside this
+                            #        round's budget until ours arrives
+                except MPIError:
+                    if best is None:
+                        raise  # responder absent: surface it
+                    break      # got samples; a late timeout ends early
+                th = float(b.unpack_string())
+                rtt = t1 - t0
+                off = th - (t0 + t1) / 2
+                if best is None or rtt < best[1]:
+                    best = (off, rtt)
+        return best
 
     # -- health ------------------------------------------------------------
     def heartbeat(self) -> None:

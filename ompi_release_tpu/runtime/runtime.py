@@ -108,6 +108,18 @@ class Runtime:
                 pairs = _parse_mca_cli(cli_args)
                 mca_var.VARS.apply_cli(pairs)
 
+            # observability plane hooks (cold path; one attr check when
+            # off): re-derive the stall-watchdog gate now that CLI/env
+            # cvars are final, and install the SIGUSR1/fatal-signal
+            # flight-recorder dumps
+            from .. import obs as _obs
+
+            if _obs.enabled:
+                from ..obs import watchdog as _obs_watchdog
+
+                _obs_watchdog.refresh(True)
+                _obs_watchdog.install_signal_handlers()
+
             self.job_state.activate(JobState.INIT)
 
             # 2. ESS bootstrap (identity + device discovery). Under
@@ -117,6 +129,19 @@ class Runtime:
             self.bootstrap = ess.bootstrap()
             self.agent = self.bootstrap.get("agent")  # tpurun WorkerAgent
             self.job_state.activate(JobState.ALLOCATE, self.bootstrap)
+
+            if _obs.enabled and self.agent is not None:
+                # estimate the clock offset NOW, not only at finalize:
+                # a hung job killed mid-run leaves postmortems as its
+                # only artifact, and without an offset their merged
+                # timeline is garbage across controllers (finalize
+                # re-estimates for the journal dump; drift over one
+                # job is negligible next to OOB rtt)
+                try:
+                    off, rtt = self.agent.clock_sync()
+                    _obs.set_clock(off, rtt)
+                except Exception as e:
+                    _log.verbose(1, f"obs clock sync skipped: {e}")
 
             # 3. mesh mapping
             self.mesh = mesh_mod.build_mesh(
@@ -262,6 +287,18 @@ class Runtime:
         with _lock:
             if not self.initialized or self.finalized:
                 return
+            from .. import obs as _obs
+
+            if _obs.enabled:
+                # per-rank journal dump (obs_dump_dir) BEFORE the agent
+                # closes: the clock-offset estimate in its meta needs
+                # the live HNP link
+                try:
+                    from ..obs import export as _obs_export
+
+                    _obs_export.maybe_dump_rank_journal(self)
+                except Exception as e:
+                    _log.verbose(1, f"obs rank-journal dump failed: {e}")
             from ..comm import communicator as comm_mod
             from ..comm import dpm as dpm_mod
 

@@ -63,6 +63,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import watchdog as _watchdog
 from ..mca import pvar
 from ..mca import var as mca_var
 from ..native import DssBuffer
@@ -357,10 +358,15 @@ class WireRouter:
         finally:
             lock.release()
         if rec and _obs.enabled:
+            # flow id from (sender process, wire seq) — both already
+            # ride the envelope, so the receiver derives the SAME id
+            # with no wire-format change (the trace-context contract)
             _obs.record("wire_send", "wire", t0,
                         time.perf_counter() - t0,
                         nbytes=int(arr.nbytes), peer=dst_world,
-                        comm_id=comm.cid)
+                        comm_id=comm.cid,
+                        flow=_obs.flow_id("p2p", self.my_pidx, seq),
+                        flow_side="s")
         return seq
 
     def drain_p2p(self, dst_world_rank: int, timeout_ms: int = 50) -> bool:
@@ -461,6 +467,8 @@ class WireRouter:
         cid, src_rank, dst_rank, user_tag, sync, seq, order = \
             env.unpack_int64(7)
         src_pidx = src_nid - 1
+        rec = _obs.enabled  # capture once: flag may flip mid-recv
+        t0 = time.perf_counter() if rec else 0.0
         try:
             data = self._recv_payload(tag, src_pidx)
         except MPIError as e:
@@ -471,6 +479,15 @@ class WireRouter:
                 "announced by its envelope but the payload never "
                 f"completed — peer died mid-transfer? ({e})",
             )
+        if rec and _obs.enabled:
+            # the matching consumer span: same (sender process, seq)
+            # flow id the sender stamped — tpu-doctor draws the arrow
+            _obs.record("wire_recv", "wire", t0,
+                        time.perf_counter() - t0,
+                        nbytes=int(getattr(data, "nbytes", 0)),
+                        peer=int(src_rank), comm_id=int(cid),
+                        flow=_obs.flow_id("p2p", src_pidx, int(seq)),
+                        flow_side="t")
         with self._rx_lock:
             self._rx_hold.setdefault((src_pidx, dst_world), {})[
                 int(order)] = (int(cid), int(src_rank), int(dst_rank),
@@ -662,15 +679,27 @@ class WireRouter:
                     return p, early
         tag = self._coll_tag(comm)
         deadline = time.monotonic() + timeout_ms / 1000
-        while True:
-            src_nid, raw = stashed_recv(self.ep, None, tag, deadline)
-            src = src_nid - 1
-            arr = self._finish_transfer(src, tag, raw, deadline)
-            if pending.get(src, 0) > 0:
-                return src, arr
-            with self._coll_early_lock:
-                self._coll_early.setdefault((comm.cid, src),
-                                            []).append(arr)
+        tok = None
+        if _watchdog.enabled:
+            tok = _watchdog.arm(
+                "coll_recv_any", comm_id=comm.cid,
+                info=lambda p=pending: {
+                    "awaiting_procs": sorted(
+                        q for q, c in p.items() if c > 0)},
+            )
+        try:
+            while True:
+                src_nid, raw = stashed_recv(self.ep, None, tag, deadline)
+                src = src_nid - 1
+                arr = self._finish_transfer(src, tag, raw, deadline)
+                if pending.get(src, 0) > 0:
+                    return src, arr
+                with self._coll_early_lock:
+                    self._coll_early.setdefault((comm.cid, src),
+                                                []).append(arr)
+        finally:
+            if tok is not None:
+                _watchdog.disarm(tok)
 
     def _finish_transfer(self, src_pidx: int, tag: int, first_raw,
                          deadline: float):
@@ -696,10 +725,19 @@ class WireRouter:
                  timeout_ms: int = 60_000) -> bytes:
         from ..btl.components import stashed_recv
 
-        deadline = time.monotonic() + timeout_ms / 1000
-        _, raw = stashed_recv(self.ep, self._nid(src_pidx),
-                              WIRE_CTL_BASE + comm.cid, deadline)
-        return raw
+        tok = None
+        if _watchdog.enabled:
+            tok = _watchdog.arm("barrier_token", comm_id=comm.cid,
+                                peer=src_pidx,
+                                info={"awaiting_procs": [src_pidx]})
+        try:
+            deadline = time.monotonic() + timeout_ms / 1000
+            _, raw = stashed_recv(self.ep, self._nid(src_pidx),
+                                  WIRE_CTL_BASE + comm.cid, deadline)
+            return raw
+        finally:
+            if tok is not None:
+                _watchdog.disarm(tok)
 
     def proc_barrier(self, comm, procs: List[int],
                      timeout_ms: int = 60_000) -> None:

@@ -44,25 +44,38 @@ from ..utils.errors import ErrorCode, MPIError
 _log = output.stream("tpu-server")
 
 TAG_METRICS = 13  # client->server: Prometheus pvar exposition request
+TAG_JOURNAL = 14  # client->server: obs rank-journal dump (JSON)
 
 
 class MetricsPubsubTable(PubsubTable):
-    """Name table + the ``metrics`` RPC: TAG_METRICS frames (seq only)
-    are answered with the Prometheus text page of every pvar registered
-    in this process, over the same seq-correlated reply channel."""
+    """Name table + two observability RPCs over the same
+    seq-correlated reply channel: TAG_METRICS answers with the
+    Prometheus text page of every pvar registered in this process;
+    TAG_JOURNAL answers with this process's rank journal dump
+    (``obs.export.rank_dump`` JSON) — the unit ``tpu-doctor collect``
+    fetches and ``tpu-doctor merge`` joins across ranks."""
 
     def __init__(self, ep) -> None:
         super().__init__(ep)
         self.serve_tags.append(TAG_METRICS)
+        self.serve_tags.append(TAG_JOURNAL)
 
     def handle(self, tag: int, src: int, raw: bytes) -> None:
-        if tag != TAG_METRICS:
+        if tag not in (TAG_METRICS, TAG_JOURNAL):
             return super().handle(tag, src, raw)
         b = DssBuffer(raw)
         (seq,) = b.unpack_int64()
-        from ..obs import export as obs_export
+        if tag == TAG_METRICS:
+            from ..obs import export as obs_export
 
-        self._reply(src, seq, True, obs_export.prometheus_text())
+            self._reply(src, seq, True, obs_export.prometheus_text())
+        else:
+            import json as _json
+
+            from ..obs import export as obs_export
+
+            self._reply(src, seq, True,
+                        _json.dumps(obs_export.rank_dump()))
 
 
 class NameServer:
@@ -137,6 +150,16 @@ class NameClient:
         if not ok:
             raise MPIError(ErrorCode.ERR_NAME, f"metrics: {text}")
         return text
+
+    def journal(self, *, timeout_ms: int = 10_000) -> dict:
+        """The server process's obs rank-journal dump (spans + rank
+        identity + clock offset) — tpu-doctor's remote collect path."""
+        import json as _json
+
+        ok, text = self._rpc(TAG_JOURNAL, timeout_ms=timeout_ms)
+        if not ok:
+            raise MPIError(ErrorCode.ERR_NAME, f"journal: {text}")
+        return _json.loads(text)
 
     def close(self) -> None:
         self.ep.close()

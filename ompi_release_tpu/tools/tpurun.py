@@ -817,6 +817,10 @@ class Job:
             # can discover and query this live job (orte-ps role)
             self.hnp.start_ps_responder(self._ps_extra)
             self.hnp.start_migrate_responder(self.migrate_off)
+            # clock ping-pong responder: workers estimate their
+            # perf_counter offset to OUR clock, so tpu-doctor can merge
+            # per-rank journals onto one timeline
+            self.hnp.start_clock_responder()
             self._write_contact_file()
             if self.on_failure == "restart":
                 # a respawned worker re-runs its full ESS wire-up

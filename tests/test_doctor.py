@@ -1,0 +1,601 @@
+"""Cross-rank distributed tracing, hang diagnosis, and the tpu-doctor
+flight recorder.
+
+Three layers under test:
+
+- unit: deterministic flow ids, journal flow round-trip, the doctor's
+  merge/clock-offset math and skew report on SYNTHETIC journals (known
+  offsets, known flows — the arithmetic is checked exactly);
+- in-process: the stall watchdog (arm -> timeout -> postmortem naming
+  the stuck op), SIGUSR1 dumps, the OOB clock-offset estimator against
+  a live HNP responder, and the tpu-server journal RPC;
+- job: a REAL 3-process tpurun job with one DELAYED rank — the
+  watchdog's postmortem must name the stuck collective and the ranks
+  that had not arrived, and the per-rank journal dumps must merge into
+  ONE Perfetto trace with clock-corrected timestamps and at least one
+  cross-rank send->recv flow arrow (the acceptance criterion).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ompi_release_tpu.mca import var as mca_var
+from ompi_release_tpu.obs import doctor as doctor_mod
+from ompi_release_tpu.obs.journal import flow_id
+from ompi_release_tpu.runtime.state import JobState
+from ompi_release_tpu.tools.tpurun import Job
+from ompi_release_tpu.utils.errors import ErrorCode, MPIError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# unit: flow ids + merge/clock math on synthetic journals
+# ---------------------------------------------------------------------------
+
+def _span(op, layer, t, dt, **kw):
+    d = {"seq": kw.pop("seq", 0), "op": op, "layer": layer, "t": t,
+         "dt": dt, "bytes": kw.pop("bytes", 0),
+         "peer": kw.pop("peer", -1), "comm": kw.pop("comm", -1)}
+    d.update(kw)
+    return d
+
+
+def _dump(pidx, offset, spans, rank_offset=None, local_size=2):
+    return {"meta": {"pidx": pidx, "pid": 1000 + pidx,
+                     "rank_offset": (rank_offset if rank_offset
+                                     is not None else pidx * local_size),
+                     "local_size": local_size,
+                     "clock_offset_s": offset, "clock_rtt_s": 1e-4},
+            "spans": spans}
+
+
+class TestFlowIds:
+    def test_deterministic_and_distinct(self):
+        a = flow_id("hier", 7, 3, 0, 1, 0)
+        assert a == flow_id("hier", 7, 3, 0, 1, 0)
+        assert a != flow_id("hier", 7, 3, 1, 0, 0)
+        assert a != flow_id("hier", 7, 4, 0, 1, 0)
+        assert flow_id("p2p", 0, 1) != flow_id("win", 0, 1)
+        assert all(flow_id(x) > 0 for x in range(64))
+
+    def test_journal_carries_flow(self):
+        from ompi_release_tpu.obs.journal import Journal
+
+        j = Journal(8)
+        fid = flow_id("t", 1)
+        j.record("send", "wire", 0.0, 1e-3, flow=fid, flow_side="s")
+        sp = j.snapshot()[-1]
+        assert sp.flow == fid and sp.flow_side == "s"
+        d = sp.asdict()
+        assert d["flow"] == fid and d["fs"] == "s"
+        # flowless spans stay compact
+        j.record("x", "wire", 0.0, 0.0)
+        assert "flow" not in j.snapshot()[-1].asdict()
+
+
+class TestMerge:
+    def _two_rank_dumps(self):
+        fid = flow_id("p2p", 0, 42)
+        # p0's clock reads 10.0 at the moment p1's clock reads 12.0:
+        # offsets map both into the HNP timebase (p0 +0.5, p1 -1.5)
+        d0 = _dump(0, 0.5, [
+            _span("wire_send", "wire", 10.0, 0.010, peer=2, comm=1,
+                  flow=fid, fs="s", bytes=4096),
+            _span("allreduce", "coll", 10.0, 0.050, comm=1),
+        ])
+        d1 = _dump(1, -1.5, [
+            _span("wire_recv", "wire", 12.1, 0.005, peer=0, comm=1,
+                  flow=fid, fs="t", bytes=4096),
+            _span("allreduce", "coll", 12.2, 0.050, comm=1),
+        ])
+        return d0, d1
+
+    def test_clock_offsets_applied(self):
+        d0, d1 = self._two_rank_dumps()
+        trace = doctor_mod.merge([d0, d1])
+        evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        send = next(e for e in evs if e["name"] == "wire_send")
+        recv = next(e for e in evs if e["name"] == "wire_recv")
+        # corrected: send at (10.0 + 0.5) s, recv at (12.1 - 1.5) s
+        assert send["ts"] == pytest.approx(10.5e6)
+        assert recv["ts"] == pytest.approx(10.6e6)
+        # and the recv lands AFTER the send in the merged timebase —
+        # the whole point of the offset correction
+        assert recv["ts"] > send["ts"]
+
+    def test_cross_rank_flow_events(self):
+        d0, d1 = self._two_rank_dumps()
+        pairs = doctor_mod.flow_pairs([d0, d1])
+        assert len(pairs) == 1
+        p = pairs[0]
+        assert p["cross_process"] and p["src_pidx"] == 0 \
+            and p["dst_pidx"] == 1
+        # recv starts at 12.1 - 1.5 = 10.6; send ends at 10.5 + 0.01
+        assert p["latency_s"] == pytest.approx(0.090, abs=1e-9)
+        trace = doctor_mod.merge([d0, d1])
+        flows = [e for e in trace["traceEvents"]
+                 if e.get("cat") == "flow"]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        s = next(e for e in flows if e["ph"] == "s")
+        f = next(e for e in flows if e["ph"] == "f")
+        assert s["id"] == f["id"]
+        assert s["pid"] == 0 and f["pid"] == 1
+        assert trace["otherData"]["cross_process_flows"] == 1
+
+    def test_unmatched_flow_is_not_paired(self):
+        d0, d1 = self._two_rank_dumps()
+        d1["spans"][0]["flow"] = flow_id("other")  # break the match
+        assert doctor_mod.flow_pairs([d0, d1]) == []
+
+    def test_skew_report_names_slowest_rank(self):
+        # two allreduce rounds; p1 arrives late in both (by 0.1/0.3 s
+        # AFTER offset correction — raw timestamps alone would blame
+        # the wrong rank in round 1)
+        d0 = _dump(0, 0.0, [
+            _span("allreduce", "coll", 1.0, 0.01, comm=1),
+            _span("allreduce", "coll", 2.0, 0.01, comm=1),
+        ])
+        d1 = _dump(1, -2.0, [
+            _span("allreduce", "coll", 3.1, 0.01, comm=1),
+            _span("allreduce", "coll", 4.3, 0.01, comm=1),
+        ])
+        text, data = doctor_mod.skew_report([d0, d1])
+        rounds = data["rounds"]
+        assert len(rounds) == 2
+        assert all(r["slowest_pidx"] == 1 for r in rounds)
+        assert rounds[0]["spread_s"] == pytest.approx(0.1)
+        assert rounds[1]["spread_s"] == pytest.approx(0.3)
+        assert data["critical_path"] == {1: 2}
+        assert "proc 1" in text and "ranks 2..3" in text
+
+    def test_load_dir_reads_postmortem_tails(self, tmp_path):
+        pm = {"reason": "stall",
+              "rank": {"pidx": 3, "rank_offset": 6, "local_size": 2,
+                       "pid": 99},
+              "clock": {"offset_s": 0.25, "rtt_s": 1e-4},
+              "journal_tail": [_span("x", "wire", 1.0, 0.0)]}
+        (tmp_path / "postmortem-p3-99-stall-1.json").write_text(
+            json.dumps(pm))
+        dumps = doctor_mod.load_dir(str(tmp_path))
+        assert len(dumps) == 1
+        assert dumps[0]["meta"]["pidx"] == 3
+        assert dumps[0]["meta"]["clock_offset_s"] == 0.25
+        with pytest.raises(FileNotFoundError):
+            doctor_mod.load_dir(str(tmp_path / "nope"))
+
+    def test_load_dir_keeps_newest_postmortem_per_rank(self, tmp_path):
+        """A hung rank writes SEVERAL postmortems (one per stalled
+        wait + SIGUSR1 pokes) with overlapping journal tails — only
+        the newest per pidx may enter the merge, or that rank's spans
+        render twice and the skew alignment desyncs."""
+        def pm(pidx, t_unix, tail_len):
+            return {"reason": "stall", "time_unix": t_unix,
+                    "rank": {"pidx": pidx, "rank_offset": pidx,
+                             "local_size": 1, "pid": 100 + pidx},
+                    "clock": {"offset_s": 0.0, "rtt_s": 1e-4},
+                    "journal_tail": [
+                        _span("x", "wire", 1.0 + i, 0.0, seq=i)
+                        for i in range(tail_len)]}
+        (tmp_path / "postmortem-p0-100-stall-1.json").write_text(
+            json.dumps(pm(0, 1000.0, 2)))
+        (tmp_path / "postmortem-p0-100-sigusr1-2.json").write_text(
+            json.dumps(pm(0, 2000.0, 5)))
+        (tmp_path / "postmortem-p1-101-stall-1.json").write_text(
+            json.dumps(pm(1, 1500.0, 3)))
+        dumps = doctor_mod.load_dir(str(tmp_path))
+        assert [d["meta"]["pidx"] for d in dumps] == [0, 1]
+        assert len(dumps[0]["spans"]) == 5  # the newer p0 dump won
+        assert len(dumps[1]["spans"]) == 3
+
+    def test_load_dir_merges_postmortems_for_unfinalized_ranks(
+            self, tmp_path):
+        """Mixed directory — healthy ranks finalized (journal-p*.json)
+        while the hung rank was killed leaving only postmortems: the
+        merge must include the hung rank's tail (it is exactly the
+        rank the operator is diagnosing), and a finalize journal must
+        supersede that rank's own postmortem tails."""
+        (tmp_path / "journal-p0.json").write_text(json.dumps(
+            _dump(0, 0.0, [_span("a", "wire", 1.0, 0.0)])))
+        pm = {"reason": "stall", "time_unix": 5.0,
+              "rank": {"pidx": 1, "rank_offset": 1, "local_size": 1,
+                       "pid": 101},
+              "clock": {"offset_s": 0.1, "rtt_s": 1e-4},
+              "journal_tail": [_span("b", "wire", 2.0, 0.0),
+                               _span("c", "wire", 3.0, 0.0)]}
+        (tmp_path / "postmortem-p1-101-stall-1.json").write_text(
+            json.dumps(pm))
+        # p0 also dumped a postmortem earlier: superseded by journal
+        pm0 = dict(pm, rank={"pidx": 0, "rank_offset": 0,
+                             "local_size": 1, "pid": 100})
+        (tmp_path / "postmortem-p0-100-stall-1.json").write_text(
+            json.dumps(pm0))
+        dumps = doctor_mod.load_dir(str(tmp_path))
+        assert [d["meta"]["pidx"] for d in dumps] == [0, 1]
+        assert len(dumps[0]["spans"]) == 1  # journal, not the tail
+        assert len(dumps[1]["spans"]) == 2  # the hung rank's tail
+        assert dumps[1]["meta"]["clock_offset_s"] == 0.1
+
+
+# ---------------------------------------------------------------------------
+# in-process: watchdog, SIGUSR1, clock estimator, journal RPC
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def obs_on(tmp_path):
+    """obs + watchdog enabled with a short stall timeout, postmortems
+    into tmp_path; fully restored afterwards."""
+    import ompi_release_tpu.obs as obs
+    from ompi_release_tpu.obs import watchdog as wd
+
+    mca_var.set_value("obs_postmortem_dir", str(tmp_path))
+    mca_var.set_value("obs_stall_timeout", "0.4")
+    obs.enable()
+    try:
+        yield wd
+    finally:
+        obs.disable()
+        mca_var.VARS.unset("obs_stall_timeout")
+        mca_var.VARS.unset("obs_postmortem_dir")
+
+
+def _wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = predicate()
+        if got:
+            return got
+        time.sleep(0.05)
+    return None
+
+
+class TestWatchdog:
+    def _postmortems(self, d):
+        return sorted(
+            os.path.join(d, f) for f in os.listdir(d)
+            if f.startswith("postmortem-") and f.endswith(".json")
+        )
+
+    def test_stall_dumps_postmortem_naming_the_wait(self, obs_on,
+                                                    tmp_path):
+        wd = obs_on
+        assert wd.enabled
+        tok = wd.arm("unit_allreduce", comm_id=5,
+                     info=lambda: {"awaiting_procs": [1],
+                                   "awaiting_ranks": [2, 3]})
+        try:
+            path = _wait_for(lambda: self._postmortems(str(tmp_path)))
+        finally:
+            wd.disarm(tok)
+        assert path, "watchdog never fired within the timeout"
+        pm = json.load(open(path[0]))
+        assert pm["reason"] == "stall"
+        st = pm["stalled"][0]
+        assert st["op"] == "unit_allreduce" and st["comm"] == 5
+        assert st["waited_s"] >= 0.4
+        assert st["info"]["awaiting_ranks"] == [2, 3]
+        # the recorder carries the debugger's queue dump + pvars +
+        # per-thread stacks (faulthandler)
+        assert isinstance(pm["msg_queues"], list)
+        assert isinstance(pm["pvars"], dict)
+        assert any("test_doctor" in ln or "Thread" in ln
+                   for ln in pm["thread_stacks"])
+
+    def test_disarm_prevents_dump(self, obs_on, tmp_path):
+        wd = obs_on
+        tok = wd.arm("quick_wait")
+        wd.disarm(tok)
+        time.sleep(0.8)
+        assert not self._postmortems(str(tmp_path))
+
+    def test_off_cost_is_one_attr_check(self):
+        from ompi_release_tpu.obs import watchdog as wd
+
+        # with obs disabled the gate is False and no token table work
+        # happens at the call sites (they check .enabled first)
+        assert wd.enabled is False
+
+    def test_sigusr1_dumps(self, obs_on, tmp_path):
+        import signal
+
+        wd = obs_on
+        prev = signal.getsignal(signal.SIGUSR1)
+        app_calls = []
+        try:
+            # an application handler installed BEFORE obs: it must
+            # still run after the dump (chained, not clobbered)
+            signal.signal(signal.SIGUSR1,
+                          lambda s, f: app_calls.append(s))
+            wd._signals_installed = False
+            wd.install_signal_handlers()
+            os.kill(os.getpid(), signal.SIGUSR1)
+            path = _wait_for(
+                lambda: [p for p in self._postmortems(str(tmp_path))
+                         if "sigusr1" in p])
+            assert path, "SIGUSR1 produced no postmortem"
+            pm = json.load(open(path[0]))
+            assert pm["reason"] == "sigusr1"
+            assert isinstance(pm["journal_tail"], list)
+            assert app_calls == [signal.SIGUSR1]
+        finally:
+            signal.signal(signal.SIGUSR1, prev)
+            wd._signals_installed = False
+
+
+class TestClockSync:
+    def test_estimator_against_live_responder(self):
+        from ompi_release_tpu.runtime.coordinator import (
+            HnpCoordinator, WorkerAgent)
+
+        hnp = HnpCoordinator(2)
+        agent = None
+        try:
+            hnp.start_clock_responder()
+            agent = WorkerAgent(1, "127.0.0.1", hnp.port)
+            off, rtt = agent.clock_sync(rounds=4)
+            # same process, same perf_counter: the true offset is ~0
+            # and must be bounded by the observed round trip
+            assert rtt > 0
+            assert abs(off) <= max(rtt, 0.05)
+        finally:
+            if agent is not None:
+                agent.ep.close()
+            hnp.shutdown()
+
+    def test_estimator_raises_without_responder(self):
+        from ompi_release_tpu.runtime.coordinator import (
+            HnpCoordinator, WorkerAgent)
+
+        hnp = HnpCoordinator(2)
+        agent = None
+        try:
+            agent = WorkerAgent(1, "127.0.0.1", hnp.port)
+            with pytest.raises(MPIError):
+                agent.clock_sync(rounds=1, timeout_ms=300)
+        finally:
+            if agent is not None:
+                agent.ep.close()
+            hnp.shutdown()
+
+
+class TestJournalRpc:
+    def test_tpu_server_serves_rank_dump(self):
+        from ompi_release_tpu.tools.tpu_server import (NameClient,
+                                                       NameServer)
+
+        srv = NameServer()
+        client = None
+        try:
+            client = NameClient("127.0.0.1", srv.port)
+            dump = client.journal()
+            assert "meta" in dump and "spans" in dump
+            assert isinstance(dump["spans"], list)
+            # and the metrics RPC still answers on the same table
+            assert "ompitpu_" in client.metrics()
+        finally:
+            if client is not None:
+                client.close()
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellites riding this PR
+# ---------------------------------------------------------------------------
+
+class _SpanningComm:
+    name = "fake_spanning"
+    spans_processes = True
+
+
+class _LocalComm:
+    name = "fake_local"
+    spans_processes = False
+
+
+class TestSatellites:
+    def test_shared_pointer_refused_on_spanning_comm(self, tmp_path):
+        from ompi_release_tpu.io.file import File
+
+        f = File(_SpanningComm(), str(tmp_path / "shared.bin"))
+        try:
+            for call in (lambda: f.write_shared(
+                             np.arange(4, dtype=np.uint8)),
+                         lambda: f.read_shared(4),
+                         lambda: f.write_ordered([np.arange(4)]),
+                         lambda: f.read_ordered([2, 2])):
+                with pytest.raises(MPIError) as ei:
+                    call()
+                assert ei.value.code == ErrorCode.ERR_NOT_AVAILABLE
+                assert "spans" in str(ei.value)
+        finally:
+            f.close()
+
+    def test_shared_pointer_still_works_locally(self, tmp_path):
+        from ompi_release_tpu.io.file import File
+
+        f = File(_LocalComm(), str(tmp_path / "local.bin"))
+        try:
+            f.set_view(etype=np.int32)
+            f.write_ordered([np.arange(3, dtype=np.int32),
+                             np.arange(3, 6, dtype=np.int32)])
+            f._shared_ptr = 0  # rewind the shared pointer
+            parts = f.read_ordered([3, 3])
+            np.testing.assert_array_equal(parts[0], [0, 1, 2])
+            np.testing.assert_array_equal(parts[1], [3, 4, 5])
+            assert f.write_shared(np.arange(2, dtype=np.int32)) == 2
+        finally:
+            f.close()
+
+    def test_checkpointer_refuses_spanning_comm(self, tmp_path):
+        from ompi_release_tpu.ft.checkpoint import Checkpointer
+
+        with pytest.raises(MPIError) as ei:
+            Checkpointer(str(tmp_path / "ck"), comm=_SpanningComm())
+        assert ei.value.code == ErrorCode.ERR_NOT_AVAILABLE
+        assert "spans controller processes" in str(ei.value)
+        # no comm / local comm still constructs, and a spanning comm
+        # with an explicitly-declared per-process directory (the one
+        # safe shape — the recovery tests' rank{pidx} layout) is let
+        # through
+        Checkpointer(str(tmp_path / "ck2"))
+        Checkpointer(str(tmp_path / "ck3"), comm=_LocalComm())
+        Checkpointer(str(tmp_path / "ck4-rank0"), comm=_SpanningComm(),
+                     private_dir=True)
+
+    def test_request_rma_wait_completes_via_flush(self):
+        """MPI 3.1: wait() ALONE completes request-based RMA inside a
+        passive epoch (was: 'wait() would deadlock')."""
+        import ompi_release_tpu as mpi
+        from ompi_release_tpu.osc.window import win_allocate
+
+        world = mpi.init()
+        win = win_allocate(world, (4,), np.float32)
+        try:
+            win.lock(2)
+            r1 = win.rput(np.full(4, 2.0, np.float32), 2)
+            assert not r1.is_complete
+            r1.wait()  # no flush(), no unlock: wait alone completes
+            assert r1.is_complete
+            r2 = win.raccumulate(np.full(4, 0.5, np.float32), 2)
+            r2.wait()
+            g = win.rget(2)
+            g.wait()
+            np.testing.assert_array_equal(np.asarray(g.value),
+                                          np.full(4, 2.5))
+            win.unlock(2)
+        finally:
+            win.free()
+
+
+# ---------------------------------------------------------------------------
+# the real thing: a 3-process tpurun job with one delayed rank
+# ---------------------------------------------------------------------------
+
+_HANG_APP = r'''
+import os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.runtime.runtime import Runtime
+from ompi_release_tpu import obs
+from ompi_release_tpu.obs import watchdog as wd
+
+world = mpi.init()          # 3 procs x 2 devices
+rt = Runtime.current()
+me = rt.bootstrap["process_index"]
+assert obs.enabled and wd.enabled, (obs.enabled, wd.enabled)
+
+if me == 1:
+    time.sleep(%(delay)s)   # the straggler every other rank waits on
+
+x = np.stack([np.arange(256, dtype=np.int32) * (rt.local_rank_offset
+                                                + i + 1)
+              for i in range(2)])
+got = np.asarray(world.allreduce(x))
+want = sum(np.arange(256, dtype=np.int32) * (r + 1)
+           for r in range(world.size))
+np.testing.assert_array_equal(got[0], want)
+
+# one cross-process p2p pair so the wire-level (envelope-seq) flow is
+# exercised alongside the hier round flows
+if me == 0:
+    world.send(np.arange(512, dtype=np.float32), 3, tag=7, rank=1)
+elif me == 1:
+    v, st = world.recv(source=1, tag=7, rank=3)
+    np.testing.assert_array_equal(np.asarray(v),
+                                  np.arange(512, dtype=np.float32))
+world.barrier()
+print(f"HANG-APP-OK {me}")
+mpi.finalize()              # journal dump (obs_dump_dir) happens here
+'''
+
+
+@pytest.mark.parametrize("delay", [4.0])
+def test_hang_injection_postmortem_and_merged_flow_trace(tmp_path,
+                                                         capfd, delay):
+    """The acceptance run: rank-span p1 sleeps before the allreduce;
+    the watchdog on p0/p2 must dump a postmortem naming the stuck
+    collective and the absent ranks WHILE the job is still hung, the
+    job must then complete cleanly, and tpu-doctor must merge the
+    three finalize-time journals into one Perfetto trace with
+    clock-offset metadata and cross-rank send->recv flow arrows."""
+    pm_dir = tmp_path / "pm"
+    dump_dir = tmp_path / "dumps"
+    app = tmp_path / "hang_app.py"
+    app.write_text(_HANG_APP % {"repo": REPO, "delay": delay})
+    job = Job(3, [sys.executable, str(app)],
+              [("obs_enable", "1"),
+               ("obs_stall_timeout", "1.2"),
+               ("obs_postmortem_dir", str(pm_dir)),
+               ("obs_dump_dir", str(dump_dir))],
+              heartbeat_s=0.5, miss_limit=10)
+    rc = job.run(timeout_s=180)
+    out = capfd.readouterr()
+    assert rc == 0, out.out + out.err
+    assert job.job_state.visited(JobState.TERMINATED)
+    for me in (0, 1, 2):
+        assert f"HANG-APP-OK {me}" in out.out
+
+    # -- postmortem: the hang left an artifact naming the wait --------
+    pms = sorted(pm_dir.glob("postmortem-*-stall-*.json"))
+    assert pms, f"no stall postmortem in {pm_dir}"
+    named_stuck = False
+    for p in pms:
+        pm = json.loads(p.read_text())
+        stalled_ops = [s["op"] for s in pm.get("stalled", [])]
+        infos = [s.get("info") or {} for s in pm.get("stalled", [])]
+        awaiting = [i for i in infos
+                    if 1 in (i.get("awaiting_procs") or [])
+                    or {2, 3} & set(i.get("awaiting_ranks") or [])]
+        if "allreduce" in stalled_ops and awaiting:
+            named_stuck = True
+            # the hier round table tells the same story
+            rounds = pm.get("hier_rounds", {})
+            assert any(st.get("op") == "allreduce"
+                       for st in rounds.values()), rounds
+    assert named_stuck, (
+        "no postmortem named the stuck allreduce + absent ranks: "
+        + "; ".join(str(json.loads(p.read_text()).get("stalled"))
+                    for p in pms))
+
+    # -- merged trace: >= 2 ranks, clock offsets, cross-rank flows ----
+    dumps = doctor_mod.load_dir(str(dump_dir))
+    assert len(dumps) == 3
+    for d in dumps:
+        assert d["meta"]["clock_offset_s"] is not None, d["meta"]
+        assert d["spans"], f"rank {d['meta']['pidx']} journal is empty"
+    pairs = doctor_mod.flow_pairs(dumps)
+    cross = [p for p in pairs if p["cross_process"]]
+    assert cross, "no cross-rank flow pair in the merged journals"
+    # the p2p send->recv pair specifically (wire envelope seq flow)
+    wire = [p for p in cross if p["src"]["op"] == "wire_send"
+            and p["dst"]["op"] == "wire_recv"]
+    assert wire, f"no wire send->recv flow among {len(cross)} flows"
+    trace = doctor_mod.merge(dumps)
+    od = trace["otherData"]
+    assert od["processes"] == 3 and od["cross_process_flows"] >= 1
+    flow_evs = [e for e in trace["traceEvents"]
+                if e.get("cat") == "flow"]
+    assert {e["ph"] for e in flow_evs} >= {"s", "f"}
+    # flow endpoints sit on DIFFERENT pids (the cross-rank arrow)
+    by_id = {}
+    for e in flow_evs:
+        by_id.setdefault(e["id"], set()).add(e["pid"])
+    assert any(len(pids) == 2 for pids in by_id.values())
+
+    # -- skew report: the delayed rank is the critical path -----------
+    text, data = doctor_mod.skew_report(dumps)
+    ar = [r for r in data["rounds"] if r["op"] == "allreduce"]
+    assert ar, f"no allreduce round in report: {text}"
+    assert ar[0]["slowest_pidx"] == 1, (text, ar)
+    assert ar[0]["spread_s"] > delay / 2
+    assert "proc 1" in text

@@ -1,22 +1,24 @@
-"""Static hot-path observability discipline for the new coll engines
-and the wire transport.
+"""Static hot-path observability discipline for the new coll engines,
+the wire transport, and the cross-process tracing layer.
 
-``coll/pipeline.py``, ``coll/fusion.py``, and ``runtime/wire.py`` sit
-on hot paths (the wire router is EVERY cross-process byte); PR 1's
-contract is that observability costs ONE attribute check
-(``_obs.enabled``) when off. This test enforces it statically, without
-importing jax: every emit site (journal ``record``, skew
-``begin/body/end``, per-call pvar registry lookups) must be gated on
-``_obs.enabled``, and every pvar bump (``.add``/``.observe``) must
+``coll/pipeline.py``, ``coll/fusion.py``, ``runtime/wire.py``,
+``coll/hier.py``, ``osc/wire_win.py``, ``p2p/pml.py``, and
+``btl/components.py`` sit on hot paths (the wire router is EVERY
+cross-process byte); PR 1's contract is that observability costs ONE
+attribute check (``_obs.enabled`` / ``_watchdog.enabled``) when off.
+This test enforces it statically, without importing jax: every emit
+site (journal ``record``, skew ``begin/body/end``, stall-watchdog
+``arm``/``disarm``, per-call pvar registry lookups) must be gated on
+an ``enabled`` flag, and every pvar bump (``.add``/``.observe``) must
 target a MODULE-LEVEL pre-registered pvar (the zero-cost-counter
 class the driver already uses) or itself be gated.
-``btl/components.py`` carries wire pvars but no journal emits, so it
-is checked for gating violations only.
 
 Gating shapes recognized:
 
 - ``if _obs.enabled: <emit>``   (including ``and``-compounds)
 - ``if not _obs.enabled: return`` followed by the emit (early-return)
+- ``if tok is not None: _watchdog.disarm(tok)`` — disarm of a token
+  that only exists under an enabled gate
 """
 
 import ast
@@ -25,19 +27,22 @@ import os
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/coll/fusion.py",
-           "ompi_release_tpu/runtime/wire.py")
-#: gating violations checked, but no journal-emit-site requirement
-#: (module-level wire pvars only — no _obs import)
-PVAR_ONLY = ("ompi_release_tpu/btl/components.py",)
+           "ompi_release_tpu/runtime/wire.py",
+           "ompi_release_tpu/coll/hier.py",
+           "ompi_release_tpu/osc/wire_win.py",
+           "ompi_release_tpu/p2p/pml.py",
+           "ompi_release_tpu/btl/components.py")
 
 #: attribute calls that ARE emit sites when ungated
-EMIT_ATTRS = {"record", "begin", "body", "end"}
+EMIT_ATTRS = {"record", "begin", "body", "end", "arm"}
 #: per-call pvar registry lookups (allocate/lock per call — never on
 #: an ungated hot path; module scope is where registration belongs)
 REGISTRY_ATTRS = {"counter", "aggregate", "histogram", "timer",
                   "highwatermark"}
 #: bumps allowed ungated ONLY on module-level pvars
 BUMP_ATTRS = {"add", "observe"}
+#: receiver-name tokens that mark an emit-capable object
+OBS_BASES = ("obs", "skew", "journal", "JOURNAL", "watchdog")
 
 
 def _mentions_enabled(node) -> bool:
@@ -67,7 +72,73 @@ def _module_pvars(tree) -> set:
     return out
 
 
-def _check_calls(node, gated, pvars, violations, path):
+def _is_registry_call(value) -> bool:
+    return (isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr in REGISTRY_ATTRS)
+
+
+def _assign_targets(node):
+    if isinstance(node, ast.Assign):
+        return node.targets, node.value
+    if isinstance(node, ast.AnnAssign) and node.value is not None:
+        return [node.target], node.value
+    return [], None
+
+
+def _import_names(node) -> set:
+    """Names bound by an import statement. An imported pvar is a
+    module-level registration living in ANOTHER module — bumping it is
+    the allowed zero-cost-counter pattern, not per-call allocation."""
+    if isinstance(node, (ast.Import, ast.ImportFrom)):
+        return {(a.asname or a.name).split(".")[0] for a in node.names}
+    return set()
+
+
+def _module_containers(tree) -> set:
+    """Module-level names visibly bound to something OTHER than a pvar
+    registration (``_services = weakref.WeakSet()``, imports): their
+    ``.add`` calls are container ops or cross-module pvar references,
+    exempt from the bump check."""
+    out = set()
+    for stmt in tree.body:
+        targets, value = _assign_targets(stmt)
+        if value is not None and not _is_registry_call(value):
+            out.update(t.id for t in targets if isinstance(t, ast.Name))
+        out |= _import_names(stmt)
+    return out
+
+
+def _bound_containers(func_node) -> set:
+    """Names visibly bound inside the function to anything that is NOT
+    a pvar-registry call — locals, loop vars, with-targets,
+    comprehension vars. Their ``.add``/``.observe`` are container ops.
+    Names with no such binding — including bare parameters — stay
+    checkable, so a pvar handle smuggled in as an argument and bumped
+    ungated is still flagged (the one-attr-check-off contract)."""
+    out = set()
+
+    def names(t):
+        return [x.id for x in ast.walk(t) if isinstance(x, ast.Name)]
+
+    for n in ast.walk(func_node):
+        out |= _import_names(n)
+        targets, value = _assign_targets(n)
+        if value is not None and not _is_registry_call(value):
+            for t in targets:
+                out.update(names(t))
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            out.update(names(n.target))
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                if item.optional_vars is not None:
+                    out.update(names(item.optional_vars))
+        elif isinstance(n, ast.comprehension):
+            out.update(names(n.target))
+    return out
+
+
+def _check_calls(node, gated, pvars, violations, path, exempt=()):
     """Check every Call in an expression subtree (no statements here)."""
     for n in ast.walk(node):
         if not isinstance(n, ast.Call):
@@ -77,14 +148,13 @@ def _check_calls(node, gated, pvars, violations, path):
             continue
         where = f"{path}:{n.lineno}"
         if f.attr in EMIT_ATTRS and not gated:
-            # record/begin/body/end on obs-ish receivers; skip
+            # record/begin/body/end/arm on obs-ish receivers; skip
             # unrelated receivers (e.g. dict methods named the same)
             base = f.value
             base_name = (base.id if isinstance(base, ast.Name) else
                          base.attr if isinstance(base, ast.Attribute)
                          else "")
-            if any(t in base_name for t in ("obs", "skew", "journal",
-                                            "JOURNAL")):
+            if any(t in base_name for t in OBS_BASES):
                 violations.append(
                     f"{where}: ungated emit {base_name}.{f.attr}()")
         if f.attr in REGISTRY_ATTRS and not gated:
@@ -96,77 +166,97 @@ def _check_calls(node, gated, pvars, violations, path):
                     f"{base.id}.{f.attr}() on the hot path")
         if f.attr in BUMP_ATTRS and not gated:
             base = f.value
-            if isinstance(base, ast.Name) and base.id not in pvars:
+            if isinstance(base, ast.Name) and base.id not in pvars \
+                    and base.id not in exempt:
                 violations.append(
                     f"{where}: {base.id}.{f.attr}() bumps a "
                     f"non-module-level pvar ungated")
 
 
-def _scan_stmts(stmts, gated, pvars, violations, path):
+def _scan_stmts(stmts, gated, pvars, violations, path, exempt=()):
     for stmt in stmts:
         if isinstance(stmt, ast.If) and _mentions_enabled(stmt.test):
             neg = (isinstance(stmt.test, ast.UnaryOp)
                    and isinstance(stmt.test.op, ast.Not))
-            _check_calls(stmt.test, gated, pvars, violations, path)
+            _check_calls(stmt.test, gated, pvars, violations, path,
+                         exempt)
             if neg:
-                _scan_stmts(stmt.body, gated, pvars, violations, path)
-                _scan_stmts(stmt.orelse, True, pvars, violations, path)
+                _scan_stmts(stmt.body, gated, pvars, violations, path,
+                            exempt)
+                _scan_stmts(stmt.orelse, True, pvars, violations, path,
+                            exempt)
                 if _terminates(stmt.body):
                     gated = True  # `if not enabled: return` early-out
             else:
-                _scan_stmts(stmt.body, True, pvars, violations, path)
-                _scan_stmts(stmt.orelse, gated, pvars, violations, path)
+                _scan_stmts(stmt.body, True, pvars, violations, path,
+                            exempt)
+                _scan_stmts(stmt.orelse, gated, pvars, violations, path,
+                            exempt)
             continue
         # other statements: recurse into child statement lists with the
         # same gating, check the non-statement (expression) children
         for field, value in ast.iter_fields(stmt):
             if isinstance(value, list) and value \
                     and isinstance(value[0], ast.stmt):
-                _scan_stmts(value, gated, pvars, violations, path)
+                _scan_stmts(value, gated, pvars, violations, path,
+                            exempt)
             elif isinstance(value, list):
                 for v in value:
                     if isinstance(v, ast.excepthandler):
                         _scan_stmts(v.body, gated, pvars, violations,
-                                    path)
+                                    path, exempt)
                     elif isinstance(v, ast.AST):
-                        _check_calls(v, gated, pvars, violations, path)
+                        _check_calls(v, gated, pvars, violations, path,
+                                     exempt)
             elif isinstance(value, ast.AST):
-                _check_calls(value, gated, pvars, violations, path)
+                _check_calls(value, gated, pvars, violations, path,
+                             exempt)
 
 
-def test_pvar_only_files_have_no_ungated_sites():
-    for rel in PVAR_ONLY:
-        path = os.path.join(REPO, rel)
-        tree = ast.parse(open(path).read(), filename=rel)
-        pvars = _module_pvars(tree)
-        assert pvars, f"{rel}: expected module-level pvar registrations"
-        violations = []
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                _scan_stmts(node.body, False, pvars, violations, rel)
-        assert not violations, "\n".join(violations)
+def _scan_file(rel):
+    path = os.path.join(REPO, rel)
+    tree = ast.parse(open(path).read(), filename=rel)
+    pvars = _module_pvars(tree)
+    assert pvars, f"{rel}: expected module-level pvar registrations"
+    mod_containers = _module_containers(tree)
+    violations = []
+    # scan only function bodies (module scope runs once at import)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_stmts(node.body, False, pvars, violations, rel,
+                        mod_containers | _bound_containers(node))
+    return violations
 
 
-def test_pipeline_and_fusion_emit_sites_are_gated():
+def test_hot_path_emit_sites_are_gated():
     checked_any_gate = 0
     for rel in CHECKED:
-        path = os.path.join(REPO, rel)
-        tree = ast.parse(open(path).read(), filename=rel)
-        pvars = _module_pvars(tree)
-        assert pvars, f"{rel}: expected module-level pvar registrations"
-        violations = []
-        # scan only function bodies (module scope runs once at import)
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                _scan_stmts(node.body, False, pvars, violations, rel)
+        violations = _scan_file(rel)
         assert not violations, "\n".join(violations)
         # non-vacuous: each file must actually contain a gated emit
-        src = open(path).read()
+        src = open(os.path.join(REPO, rel)).read()
         assert "_obs.enabled" in src and "_obs.record" in src, (
             f"{rel}: expected at least one _obs.enabled-gated "
             f"_obs.record emit site")
         checked_any_gate += 1
     assert checked_any_gate == len(CHECKED)
+
+
+def test_watchdog_arm_sites_are_gated_and_present():
+    """The stall-watchdog arm sites (the new tracing layer's wait
+    registry) must exist in the files that block on peers, and every
+    one must sit under a ``_watchdog.enabled`` gate — enforced by the
+    same scan (``arm`` is an EMIT_ATTR on a watchdog-ish base)."""
+    armed = 0
+    for rel in ("ompi_release_tpu/runtime/wire.py",
+                "ompi_release_tpu/coll/hier.py",
+                "ompi_release_tpu/osc/wire_win.py",
+                "ompi_release_tpu/p2p/pml.py"):
+        src = open(os.path.join(REPO, rel)).read()
+        assert "_watchdog.enabled" in src and "_watchdog.arm" in src, (
+            f"{rel}: expected gated stall-watchdog arm sites")
+        armed += src.count("_watchdog.arm(")
+    assert armed >= 6, f"expected >= 6 arm sites, found {armed}"
 
 
 def test_gating_checker_catches_violations():
@@ -182,14 +272,23 @@ def test_gating_checker_catches_violations():
         "    journal.record('op', 'l', 0, 0)\n"  # VIOLATION: ungated
         "    local = pvar.counter('y')\n"        # VIOLATION: per-call
         "    local.add()\n"                      # VIOLATION: non-module
+        "def hot2(ctr):\n"
+        "    ctr.add()\n"  # VIOLATION: pvar smuggled in as an argument
+        "def hot3():\n"
+        "    seen = set()\n"
+        "    seen.add(1)\n"     # fine: visibly a local container
+        "    for q in ():\n"
+        "        q.add(2)\n"    # fine: loop var
     )
     tree = ast.parse(bad)
     pvars = _module_pvars(tree)
     violations = []
     for node in ast.walk(tree):
         if isinstance(node, ast.FunctionDef):
-            _scan_stmts(node.body, False, pvars, violations, "bad.py")
-    assert len(violations) == 3, violations
+            _scan_stmts(node.body, False, pvars, violations, "bad.py",
+                        _module_containers(tree)
+                        | _bound_containers(node))
+    assert len(violations) == 4, violations
 
     good = (
         "from .. import obs as _obs\n"
@@ -209,5 +308,31 @@ def test_gating_checker_catches_violations():
     for node in ast.walk(tree):
         if isinstance(node, ast.FunctionDef):
             _scan_stmts(node.body, False, _module_pvars(tree),
-                        violations, "good.py")
+                        violations, "good.py",
+                        _module_containers(tree)
+                        | _bound_containers(node))
     assert not violations, violations
+
+    # an ungated watchdog arm is a violation; a gated one is not
+    wd = (
+        "from ..obs import watchdog as _watchdog\n"
+        "from ..mca import pvar\n"
+        "_ok = pvar.counter('x')\n"
+        "def bad_wait():\n"
+        "    tok = _watchdog.arm('op')\n"          # VIOLATION: ungated
+        "def good_wait():\n"
+        "    tok = None\n"
+        "    if _watchdog.enabled:\n"
+        "        tok = _watchdog.arm('op')\n"
+        "    if tok is not None:\n"
+        "        _watchdog.disarm(tok)\n"
+    )
+    tree = ast.parse(wd)
+    violations = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            _scan_stmts(node.body, False, _module_pvars(tree),
+                        violations, "wd.py",
+                        _module_containers(tree)
+                        | _bound_containers(node))
+    assert len(violations) == 1 and "arm" in violations[0], violations

@@ -232,7 +232,8 @@ class TestFailureDetection:
             world = mpi.init()
             rt = Runtime.current()
             pi = rt.bootstrap["process_index"]
-            ck = Checkpointer(%r + f"/rank{pi}", comm=world)
+            ck = Checkpointer(%r + f"/rank{pi}", comm=world,
+                              private_dir=True)
             state = {"step": jax.numpy.zeros((), jax.numpy.int32)}
             latest = ck.latest_step()
             restored = latest is not None
@@ -582,7 +583,8 @@ class TestMigration:
             world = mpi.init()
             rt = Runtime.current()
             pi = rt.bootstrap["process_index"]
-            ck = Checkpointer(%r + f"/rank{pi}", comm=world)
+            ck = Checkpointer(%r + f"/rank{pi}", comm=world,
+                              private_dir=True)
             state = {"step": jax.numpy.zeros((), jax.numpy.int32)}
             latest = ck.latest_step()
             start = 0
