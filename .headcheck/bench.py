@@ -1,0 +1,1233 @@
+"""BASELINE bench suite: all 5 configs, one JSON line each.
+
+BASELINE.json's five configs, each emitting one JSON metric line, the
+headline (op_sum_256MiB_f32_hbm_bw, comparable across rounds) LAST:
+
+  1. ring        — examples/ring_c.c 4-rank token ring
+  2. allreduce   — OSU-style f32 SUM sweep, 8 B..256 MiB
+  3. bcast       — contiguous f32 (+ allgather bf16, config 3's pair)
+  4. reduce_scatter_block — f32 SUM (ZeRO-style 64 MiB gradient shard)
+  5. alltoall    — int32 all-pairs shuffle (2-D torus)
+
+With n >= 2 devices the configs run the framework's own SPMD
+collectives (coll/spmd.py kernels under shard_map). On ONE chip — the
+driver's bench environment — each config runs its single-chip
+op-kernel analogue from ompi_release_tpu/ops/pallas_op.py: the
+HBM-bound data movement the collective would perform locally
+(allreduce/reduce_scatter -> the 3-stream SUM/axpy hot loop,
+bcast/allgather -> the 2-stream copy, alltoall -> the blocked
+transpose shuffle, ring -> chained dependent kernel dispatches).
+Pallas kernels on purpose: a pallas_call is opaque to XLA, so the
+timing loop cannot be algebraically folded across iterations.
+
+Timing: the tunneled single-chip backend has ~100 ms fixed per-call
+latency, so each measurement jits a fori_loop of K iterations and
+takes the (K_hi - K_lo) slope — pure device time, latency cancelled.
+Completion is forced by fetching an 8-byte checksum.
+
+The ceiling (the "baseline" in vs_baseline): measured single-run HBM
+bandwidth on this chip wobbles +-20% (tunnel contention/thermal) —
+round 2's vs_baseline of 1.054 was exactly a ceiling measured in a
+slow moment. So: (a) every round interleaves ALL loops, metric and
+ceiling alike; (b) the ceiling is the per-round MAX bandwidth any
+2-stream copy candidate OR the metric itself achieved — vs_baseline
+<= 1.0 by construction, because a chip that demonstrably moved X GB/s
+has a ceiling of at least X; (c) each line carries the ceiling and its
+cross-round coefficient of variation so the denominator's stability is
+in the output, not assumed; (d) sweep points whose working set fits in
+on-chip memory run at VMEM bandwidth (5-20x HBM; iterations verified
+by checksum) — those report tier "on-chip" with vs_baseline null
+rather than a fake HBM ratio. The HBM-bound lines (256 MiB headline,
+bcast/allgather, 128 MiB reduce_scatter, transpose) carry real
+ratios.
+
+Prints one JSON object per line; the LAST line is the headline
+{"metric", "value", "unit", "vs_baseline", ...} the driver parses.
+"""
+
+import json
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+MiB = 1024 * 1024
+SWEEP_BYTES = [8, 64 * 1024, MiB, 16 * MiB, 256 * MiB]
+# largest working set eligible for the "on-chip" tier label (v5e VMEM
+# is 128 MiB; leave headroom for double-buffering scratch)
+ONCHIP_WS = 112 * MiB
+
+
+def _human(nbytes):
+    for unit, div in (("MiB", 1024 * 1024), ("KiB", 1024)):
+        if nbytes >= div:
+            return f"{nbytes // div}{unit}"
+    return f"{nbytes}B"
+
+
+def _sync(r):
+    np.asarray(r)  # tiny checksum fetch forces remote completion
+
+
+def _timed(fn, args, k):
+    t0 = time.perf_counter()
+    _sync(fn(*args, k))
+    return time.perf_counter() - t0
+
+
+def _ks(traffic_bytes_per_iter, on_tpu):
+    """Static initial (K_lo, K_hi) guess from HBM traffic at
+    ~700 GB/s with a 3 us dispatch floor. Only a STARTING POINT:
+    sub-VMEM working sets run 5-20x faster than the HBM estimate
+    (on-chip residency), so the real K is set by :func:`_calibrate_k`
+    from a measured per-iteration time."""
+    if not on_tpu:
+        return (2, 18)
+    est = max(traffic_bytes_per_iter / 700e9, 3e-6)
+    k_hi = max(258, int(0.75 / est))
+    return (max(2, k_hi // 32), k_hi)
+
+
+K_CAP = 4_000_000
+TARGET_S = 0.75
+
+
+def _calibrate_k(loop, args, static_hi):
+    """Measure the loop's actual per-iteration time and size K_hi for
+    ~TARGET_S seconds of device time. The tunnel's per-call latency
+    jitter is tens of ms, so (a) the calibration probe grows K
+    geometrically until the K-call exceeds the base call by >250 ms
+    (jitter then contributes <16% error), and (b) the final K_hi-K_lo
+    delta towers over jitter by construction. Without this, a K sized
+    from the HBM estimate left VMEM-resident loops with ~10 ms deltas
+    inside ~40 ms jitter — slopes came out near zero and bandwidths
+    absurd."""
+    # min-of-N: tunnel latency spikes are one-sided (they only ADD
+    # time), so minima approach the true floor — a single probe can
+    # jitter past the threshold and size K from pure noise
+    base = min(_timed(loop, args, 2) for _ in range(3))
+    k = max(64, static_hi // 8)
+    while True:
+        dt = min(_timed(loop, args, k) for _ in range(2)) - base
+        if dt > 0.25 or k >= K_CAP:
+            per = max(dt / k, 2e-8)
+            break
+        k *= 4
+    k_hi = min(max(int(TARGET_S / per), 258), K_CAP)
+    return max(2, k_hi // 32), k_hi
+
+
+def _run_rounds(specs, rounds):
+    """Interleaved slope timing: every round times every loop's K_lo
+    and K_hi back to back, so cross-loop ratios (metric/ceiling) are
+    taken between samples milliseconds apart, not minutes."""
+    for s in specs:  # compile + warm both K values
+        _sync(s["loop"](*s["args"], s["k_lo"]))
+        _sync(s["loop"](*s["args"], s["k_hi"]))
+    slopes = [[] for _ in specs]
+    lo_t = [[] for _ in specs]
+    hi_t = [[] for _ in specs]
+    for _ in range(rounds):
+        for i, s in enumerate(specs):
+            tlo = _timed(s["loop"], s["args"], s["k_lo"])
+            thi = _timed(s["loop"], s["args"], s["k_hi"])
+            lo_t[i].append(tlo)
+            hi_t[i].append(thi)
+            slopes[i].append(
+                max((thi - tlo) / (s["k_hi"] - s["k_lo"]), 1e-12)
+            )
+    for i, s in enumerate(specs):
+        # a median K-delta inside the tunnel's jitter band means the
+        # slope is noise, not signal — flag rather than report garbage
+        s["unstable"] = (
+            np.median(hi_t[i]) - np.median(lo_t[i])
+        ) < 0.05 and jnp_on_tpu()
+    return np.asarray(slopes)  # (n_specs, rounds)
+
+
+def jnp_on_tpu():
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def _sweep_geom(elems):
+    """(rows, cols, blk_rows) for an axpy sweep point: full tuned
+    blocks for large sizes, one minimal (8, 128)-multiple tile padded
+    up for tiny ones."""
+    cols = 2048 if elems >= 8 * 2048 else 128
+    rows = max(8, -(-elems // cols))
+    blk = min(256, -(-rows // 8) * 8)
+    rows = -(-rows // blk) * blk
+    return rows, cols, blk
+
+
+def _single_chip_specs(jax, jnp, dev, on_tpu):
+    """The 5 configs as single-chip op-kernel analogues + ceiling
+    candidates. Returns (specs, ceiling_names)."""
+    from ompi_release_tpu.ops import pallas_op
+
+    put = lambda a: jax.device_put(a, dev)
+    specs = []
+
+    # config 1: ring — 4 chained dependent kernel dispatches per iter
+    ring_loop = pallas_op.make_chain_loop(hops=4)
+    k_lo, k_hi = _ks(0, on_tpu)  # dispatch-latency bound
+    specs.append(dict(
+        name="ring_4hop", loop=ring_loop,
+        args=(put(jnp.zeros((8, 128), jnp.float32)),),
+        k_lo=k_lo, k_hi=k_hi, nbytes=None, hops=4,
+    ))
+
+    # config 2: allreduce sweep — the SUM op hot loop (3 HBM streams)
+    sweep = SWEEP_BYTES if on_tpu else SWEEP_BYTES[:3]
+    for size in sweep:
+        elems = max(1, size // 4)
+        rows, cols, blk = _sweep_geom(elems)
+        loop = pallas_op.make_axpy_loop(rows, cols, blk_rows=blk)
+        k_lo, k_hi = _ks(3 * size, on_tpu)
+        specs.append(dict(
+            name=f"allreduce_{_human(size)}", loop=loop,
+            args=(put(jnp.ones((rows, cols), jnp.float32)),),
+            k_lo=k_lo, k_hi=k_hi, nbytes=3 * size, size=size,
+            ws=2 * size,
+        ))
+
+    big = 256 * MiB if on_tpu else 4 * MiB
+
+    # config 3: bcast f32 + allgather bf16 — 2-stream copy traffic
+    for nm, dtype, isz in (("bcast_f32", jnp.float32, 4),
+                           ("allgather_bf16", jnp.bfloat16, 2)):
+        elems = big // isz
+        cols = 2048
+        rows = elems // cols
+        loop = pallas_op.make_scale_loop(rows, cols, dtype=dtype)
+        k_lo, k_hi = _ks(2 * big, on_tpu)
+        specs.append(dict(
+            name=nm, loop=loop, args=(put(jnp.ones((rows, cols), dtype)),),
+            k_lo=k_lo, k_hi=k_hi, nbytes=2 * big, ws=2 * big,
+        ))
+
+    # config 4: reduce_scatter_block — the same reduction kernel at a
+    # ZeRO-ish 128 MiB gradient-shard size (3 x 128 MiB working set
+    # cannot be on-chip-resident: this line must be an HBM number)
+    rs_size = 128 * MiB if on_tpu else 2 * MiB
+    elems = rs_size // 4
+    rows, cols, blk = _sweep_geom(elems)
+    loop = pallas_op.make_axpy_loop(rows, cols, blk_rows=blk)
+    k_lo, k_hi = _ks(3 * rs_size, on_tpu)
+    specs.append(dict(
+        name="reduce_scatter_block_f32", loop=loop,
+        args=(put(jnp.ones((rows, cols), jnp.float32)),),
+        k_lo=k_lo, k_hi=k_hi, nbytes=3 * rs_size, ws=2 * rs_size,
+    ))
+
+    # config 5: alltoall i32 — blocked transpose (all-pairs shuffle),
+    # applied twice per loop iteration = 4 streams counted (see
+    # make_transpose_loop: a single non-aliased call per iteration
+    # makes XLA copy the fori_loop carry back every iteration — 2N
+    # uncounted bytes that capped three rounds of this line at ~0.49
+    # of ceiling; the r04 probes 5-7 nailed it to aliasing alone).
+    # 1024 sits exactly at the 16 MB scoped-VMEM limit (2 x 4 MB
+    # buffers double-buffered), so fall back if the compiler tightens
+    # it.
+    tn = 8192 if on_tpu else 1024
+    x = put(jnp.arange(tn * tn, dtype=jnp.int32).reshape(tn, tn))
+    small = None
+    last_err = None
+    for t_block in (1024, 512, 256):
+        if tn % t_block:
+            continue
+        try:
+            t_loop, t_call = pallas_op.make_transpose_loop(
+                tn, block=t_block
+            )
+            small = np.asarray(t_call(x)[:4, :4])  # compiles/executes
+            break
+        except Exception as e:  # scoped-VMEM tightened: smaller tile
+            last_err = e
+    if small is None:
+        raise RuntimeError(
+            f"no transpose block size compiled for n={tn}: {last_err}"
+        )
+    np.testing.assert_array_equal(small, np.asarray(x[:4, :4]).T)
+    k_lo, k_hi = _ks(4 * tn * tn * 4, on_tpu)
+    specs.append(dict(
+        name="alltoall_i32_torus", loop=t_loop, args=(x,),
+        k_lo=k_lo, k_hi=k_hi, nbytes=4 * tn * tn * 4,
+        ws=2 * tn * tn * 4,
+    ))
+
+    # ceiling candidates: alternate copy block shapes (the primary
+    # candidate is bcast_f32 above — same kernel, tuned SCALE_BLOCK).
+    # Which shape wins varies session to session (+-20% wobble), so
+    # the ceiling takes the per-round max over all of them.
+    elems = big // 4
+    for cand_name, (ar, ac) in (
+        ("ceiling_copy_alt", pallas_op.SCALE_BLOCK_ALT),
+        ("ceiling_copy_alt2", pallas_op.SCALE_BLOCK_ALT2),
+    ):
+        rows = elems // ac
+        loop = pallas_op.make_scale_loop(rows, ac, blk_rows=ar)
+        k_lo, k_hi = _ks(2 * big, on_tpu)
+        specs.append(dict(
+            name=cand_name, loop=loop,
+            args=(put(jnp.ones((rows, ac), jnp.float32)),),
+            k_lo=k_lo, k_hi=k_hi, nbytes=2 * big,
+        ))
+
+    # parity spot-check (BASELINE metric demands result parity): the
+    # op component's axpy against numpy
+    a = np.random.default_rng(0).standard_normal((64, 256)).astype(np.float32)
+    b = np.random.default_rng(1).standard_normal((64, 256)).astype(np.float32)
+    got = np.asarray(pallas_op.axpy(jnp.asarray(a), jnp.asarray(b), 0.5))
+    np.testing.assert_allclose(got, b * 0.5 + a, rtol=1e-6)
+
+    return specs, ("bcast_f32", "ceiling_copy_alt", "ceiling_copy_alt2")
+
+
+#: bf16 matmul peak by device kind substring (published chip specs);
+#: unknown kinds report achieved FLOP/s with mfu null rather than a
+#: made-up ratio
+PEAK_FLOPS = (
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v4", 275e12), ("v6", 918e12),
+)
+
+
+def _mfu_metric(jax, jnp, dev, on_tpu, rounds):
+    """Compute-bound line: the flagship transformer's fwd+bwd step on
+    one chip (tiny-but-MXU-shaped dims), slope-timed like every other
+    loop, FLOPs taken from XLA's own cost analysis. Every other bench
+    config is memory-bound, so without this a regression in the
+    compute path (e.g. ops/pallas_attention.py) would be invisible to
+    the round record."""
+    from jax import lax
+
+    from ompi_release_tpu.models import transformer as tfm
+    from ompi_release_tpu.parallel.mesh_axes import build_parallel_mesh
+
+    if on_tpu:
+        cfg = tfm.ModelConfig(
+            vocab=2048, d_model=512, n_layers=4, n_heads=8, head_dim=64,
+            d_ff=2048, max_seq=256, dtype=jnp.bfloat16,
+        )
+        b, s = 8, 256
+    else:  # CI-sized
+        cfg = tfm.ModelConfig(
+            vocab=128, d_model=64, n_layers=2, n_heads=4, head_dim=16,
+            d_ff=128, max_seq=32, dtype=jnp.float32,
+        )
+        b, s = 2, 32
+    mesh = build_parallel_mesh(devices=[dev])
+    params = tfm.shard_params(
+        tfm.init_params(jax.random.PRNGKey(0), cfg), cfg, mesh
+    )
+    fwd = tfm.make_forward(cfg, mesh)
+    rng = np.random.RandomState(0)
+    tok = jax.device_put(
+        jnp.asarray(rng.randint(0, cfg.vocab, size=(b, s), dtype=np.int32)),
+        dev,
+    )
+    tgt = jnp.roll(tok, -1, axis=1)
+    grad_fn = jax.value_and_grad(lambda p: fwd(p, tok, tgt))
+
+    def loop(params, k):
+        def body(_, p):
+            _, g = grad_fn(p)
+            # inline SGD keeps every iteration's bwd live (no folding)
+            return jax.tree.map(
+                lambda a, d: a - jnp.asarray(1e-6, a.dtype)
+                * d.astype(a.dtype), p, g)
+        p = lax.fori_loop(0, k, body, params)
+        return jnp.sum(jax.tree.leaves(p)[0].astype(jnp.float32))
+
+    loop = jax.jit(loop)
+
+    # FLOPs per fwd+bwd step from the compiler, not a hand formula
+    flops_per_step = None
+    try:
+        ca = jax.jit(grad_fn).lower(params).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops_per_step = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    k_lo, k_hi = _calibrate_k(loop, (params,), 258) if on_tpu else (2, 10)
+    # warm both K programs, then slope-time like the bandwidth lines
+    _sync(loop(params, k_lo))
+    _sync(loop(params, k_hi))
+    slopes, lo_t, hi_t = [], [], []
+    for _ in range(rounds):
+        tlo = _timed(loop, (params,), k_lo)
+        thi = _timed(loop, (params,), k_hi)
+        lo_t.append(tlo)
+        hi_t.append(thi)
+        slopes.append(max((thi - tlo) / (k_hi - k_lo), 1e-12))
+    sec_per_step = float(np.median(slopes))
+
+    entry = {
+        "metric": "transformer_fwdbwd_step", "unit": "TFLOP/s",
+        "sec_per_step": round(sec_per_step, 6),
+        "vs_baseline": None,
+    }
+    # same jitter gate as _run_rounds: a K-delta inside the tunnel's
+    # latency band is noise — flag it rather than report a confident
+    # garbage MFU
+    if on_tpu and (np.median(hi_t) - np.median(lo_t)) < 0.05:
+        entry.update(value=None, mfu=None, unstable=True,
+                     note="K-delta inside tunnel jitter; unreliable")
+        return entry
+    if flops_per_step is None:
+        entry["value"] = None
+        entry["note"] = "XLA cost analysis unavailable on this backend"
+        return entry
+    achieved = flops_per_step / sec_per_step
+    entry["value"] = round(achieved / 1e12, 3)
+    entry["flops_per_step"] = flops_per_step
+    kind = dev.device_kind.lower()
+    peak = next((p for sub, p in PEAK_FLOPS if sub in kind), None)
+    if peak is not None and on_tpu:
+        entry["mfu"] = round(achieved / peak, 4)
+        entry["peak_tflops"] = peak / 1e12
+        entry["device_kind"] = dev.device_kind
+    else:
+        entry["mfu"] = None
+    return entry
+
+
+def _mesh_specs(jax, jnp, devices, on_tpu):
+    """The 5 configs as real SPMD collectives over the device mesh,
+    using the framework's coll/spmd kernels.
+
+    No spec here carries a ``ws`` key ON PURPOSE: the on-chip tier
+    label exists for single-chip op loops whose whole working set can
+    sit in VMEM; a collective always crosses the interconnect, so
+    every mesh line is ineligible (the gate's missing-ws default) and
+    reports a real ratio."""
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ompi_release_tpu.coll import spmd
+    from ompi_release_tpu.ops import op as ops_mod
+    from ompi_release_tpu.ops import pallas_op
+
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("rank",))
+    sh = NamedSharding(mesh, P("rank"))
+    specs = []
+
+    def coll_loop(body_fn):
+        @partial(jax.jit, static_argnums=1)
+        def loop(x, k):
+            def spmd_body(b):
+                # pvary: psum-style outputs are rank-INvariant in
+                # shard_map's varying-axes type system; the loop carry
+                # must stay varying to match its input type (ppermute
+                # outputs are already varying — leave those alone)
+                def body(i, a):
+                    out = body_fn(a)
+                    if "rank" not in getattr(jax.typeof(out), "vma",
+                                             frozenset()):
+                        out = lax.pvary(out, ("rank",))
+                    return out
+
+                acc = lax.fori_loop(0, k, body, b)
+                flat = acc.reshape(-1)
+                return (flat[0] + flat[-1])[None]
+
+            s = jax.shard_map(spmd_body, mesh=mesh, in_specs=P("rank"),
+                              out_specs=P("rank"))(x)
+            return s[0]
+
+        return loop
+
+    inv_n = np.float32(1.0 / n)
+
+    # config 1: ring — one ppermute hop per iteration (token ring)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    ring = coll_loop(lambda a: lax.ppermute(a, "rank", perm))
+    tok = jax.device_put(jnp.zeros((n, 128), jnp.float32), sh)
+    k_lo, k_hi = _ks(0, on_tpu) if on_tpu else (2, 34)
+    specs.append(dict(name="ring_4hop", loop=ring, args=(tok,),
+                      k_lo=k_lo, k_hi=k_hi, nbytes=None, hops=1))
+
+    # config 2: allreduce sweep (psum = coll/xla's lowering)
+    sweep = SWEEP_BYTES if on_tpu else SWEEP_BYTES[:3]
+    for size in sweep:
+        elems = max(n, size // 4)
+        x = jax.device_put(jnp.ones((elems,), jnp.float32), sh)
+        loop = coll_loop(
+            lambda a: spmd.allreduce_lax(a, ops_mod.SUM, "rank") * inv_n
+        )
+        k_lo, k_hi = _ks(2 * size, on_tpu)
+        specs.append(dict(
+            name=f"allreduce_{_human(size)}", loop=loop, args=(x,),
+            k_lo=k_lo, k_hi=k_hi, size=size,
+            nbytes=int(2 * (n - 1) / n * size),  # ring bus traffic
+        ))
+
+    big = 256 * MiB if on_tpu else 2 * MiB
+    belems = max(n, big // 4)
+
+    # config 3: bcast f32 + allgather bf16
+    xb = jax.device_put(jnp.ones((belems,), jnp.float32), sh)
+    bcast = coll_loop(
+        lambda a: spmd.bcast_masked_psum(a, a.dtype, "rank", 0)
+    )
+    k_lo, k_hi = _ks(2 * big, on_tpu)
+    specs.append(dict(name="bcast_f32", loop=bcast, args=(xb,),
+                      k_lo=k_lo, k_hi=k_hi, nbytes=big))
+    xg = jax.device_put(jnp.ones((belems,), jnp.bfloat16), sh)
+    gather = coll_loop(
+        lambda a: lax.all_gather(a, "rank")[lax.axis_index("rank")]
+    )
+    specs.append(dict(name="allgather_bf16", loop=gather, args=(xg,),
+                      k_lo=k_lo, k_hi=k_hi,
+                      nbytes=int((n - 1) / n * big * 2 // 2)))
+
+    # config 4: reduce_scatter_block (psum_scatter lowering; the tile
+    # rebuilding the loop carry adds local HBM traffic — reported bw
+    # is collective bytes only, see docstring)
+    seg = belems // n
+    xr = jax.device_put(jnp.ones((n * seg,), jnp.float32), sh)
+    rs = coll_loop(
+        lambda a: jnp.tile(
+            spmd.reduce_scatter_lax(a, ops_mod.SUM, "rank", n) * inv_n, n
+        )
+    )
+    specs.append(dict(name="reduce_scatter_block_f32", loop=rs,
+                      args=(xr,), k_lo=k_lo, k_hi=k_hi,
+                      nbytes=int((n - 1) / n * 4 * n * seg)))
+
+    # config 5: alltoall int32 on a 2-D torus (two-phase x then y),
+    # falling back to 1-D when n has no 2-D factorization
+    a_ax = 2 if n % 2 == 0 and n > 2 else 1
+    if a_ax > 1:
+        mesh2 = Mesh(np.array(devices).reshape(a_ax, n // a_ax),
+                     ("x", "y"))
+
+        @partial(jax.jit, static_argnums=1)
+        def a2a(x, k):
+            def spmd_body(b):
+                def body(i, acc):
+                    acc = lax.all_to_all(acc, "x", 0, 0, tiled=True)
+                    return lax.all_to_all(acc, "y", 0, 0, tiled=True)
+
+                acc = lax.fori_loop(0, k, body, b)
+                flat = acc.reshape(-1)
+                return (flat[0] + flat[-1])[None]
+
+            from jax.sharding import PartitionSpec as P2
+            s = jax.shard_map(spmd_body, mesh=mesh2,
+                              in_specs=P2(("x", "y")),
+                              out_specs=P2(("x", "y")))(x)
+            return s[0]
+
+        xa = jax.device_put(
+            jnp.ones((belems,), jnp.int32),
+            NamedSharding(mesh2, jax.sharding.PartitionSpec(("x", "y"))),
+        )
+        specs.append(dict(name="alltoall_i32_torus", loop=a2a,
+                          args=(xa,), k_lo=k_lo, k_hi=k_hi,
+                          nbytes=int(2 * (n - 1) / n * big)))
+    else:
+        xa = jax.device_put(jnp.ones((belems,), jnp.int32), sh)
+        a2a = coll_loop(lambda a: spmd.alltoall_lax(
+            a.reshape(n, -1), "rank", n).reshape(-1))
+        specs.append(dict(name="alltoall_i32_torus", loop=a2a,
+                          args=(xa,), k_lo=k_lo, k_hi=k_hi,
+                          nbytes=int((n - 1) / n * big)))
+
+    # ceiling: single-device HBM copy (placeholder for an ICI-bandwidth
+    # ceiling until multi-chip hardware is available — documented, not
+    # hidden: collective busbw vs one chip's copy bw)
+    csize = 16 * MiB if on_tpu else MiB
+    elems = csize // 4
+    cols = 2048
+    loop = pallas_op.make_scale_loop(elems // cols, cols)
+    k_lo, k_hi = _ks(2 * csize, on_tpu)
+    specs.append(dict(
+        name="ceiling_copy", loop=loop,
+        args=(jax.device_put(jnp.ones((elems // cols, cols),
+                                      jnp.float32), devices[0]),),
+        k_lo=k_lo, k_hi=k_hi, nbytes=2 * csize,
+    ))
+
+    # parity: psum of ones over the mesh == n on every shard
+    ones = jax.device_put(jnp.ones((n,), jnp.float32), sh)
+    got = jax.shard_map(
+        lambda b: spmd.allreduce_lax(b, ops_mod.SUM, "rank"),
+        mesh=mesh, in_specs=jax.sharding.PartitionSpec("rank"),
+        out_specs=jax.sharding.PartitionSpec("rank"))(ones)
+    np.testing.assert_allclose(np.asarray(got), np.full(n, n), rtol=0)
+
+    return specs, ("ceiling_copy",)
+
+
+def _init_backend(jax, attempts=3, first_delay=5.0,
+                  attempt_timeout_s=180.0):
+    """jax.devices() with bounded retry-with-backoff AND a watchdog.
+
+    Round 4's BENCH record was lost to a transient axon outage
+    (UNAVAILABLE at backend setup); the same outage class can also make
+    ``jax.devices()`` HANG inside the tunnel rather than raise, which
+    no try/except can bound — so each attempt runs on a daemon thread
+    with a deadline. On final failure the caller gets None and main()
+    emits a parseable tpu_unavailable marker; a hung attempt exits via
+    ``os._exit`` after printing it (the stuck C call would otherwise
+    block interpreter teardown past the driver's timeout)."""
+    import os
+    import threading
+
+    delay = first_delay
+    last = "unknown"
+    for i in range(attempts):
+        box = {}
+
+        def probe():
+            try:
+                box["devices"] = jax.devices()
+            except Exception as e:  # jaxlib raises RuntimeError subtypes
+                box["error"] = e
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout=attempt_timeout_s)
+        if "devices" in box:
+            return box["devices"]
+        if t.is_alive():
+            # stuck inside the backend client: no recovery is possible
+            # in-process — record the marker and hard-exit parseably
+            print(json.dumps({
+                "metric": "bench_error", "value": None, "unit": None,
+                "vs_baseline": None, "error": "tpu_unavailable",
+                "detail": f"backend init hung > {attempt_timeout_s:.0f}s "
+                          f"(attempt {i + 1})",
+            }), flush=True)
+            os._exit(0)
+        last = str(box.get("error", "unknown"))
+        print(json.dumps({
+            "event": "backend_init_retry", "attempt": i + 1,
+            "error": last[:200],
+        }), file=sys.stderr)
+        if i + 1 < attempts:
+            time.sleep(delay)
+            delay *= 2
+            try:
+                import jax._src.api as _api
+                _api.clear_backends()
+            except Exception:
+                pass
+    # retries exhausted: the caller falls back to the CPU backend and
+    # labels its lines, instead of a bare bench_error (the trajectory
+    # stays non-empty); the marker below is informational only
+    print(json.dumps({
+        "event": "tpu_unavailable", "detail": last[:300],
+    }), file=sys.stderr)
+    return None
+
+
+def _arm_global_watchdog(budget_s=1500.0):
+    """If the whole run exceeds ``budget_s`` (a healthy TPU run takes
+    ~2-4 min; only a mid-sweep tunnel hang gets near this), print the
+    parseable marker and hard-exit so the driver records evidence
+    instead of a timeout."""
+    import os
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "bench_error", "value": None, "unit": None,
+            "vs_baseline": None, "error": "tpu_unavailable",
+            "detail": f"bench exceeded {budget_s:.0f}s wall budget "
+                      "(backend hang mid-sweep?)",
+        }), flush=True)
+        os._exit(0)
+
+    t = threading.Timer(budget_s, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _pvar_snapshot():
+    """Current pvar values, JSON-ready (per-config observability)."""
+    try:
+        import ompi_release_tpu.obs  # noqa: F401  journal pvars exist
+        from ompi_release_tpu.mca import pvar as _pvar_mod
+
+        return _pvar_mod.PVARS.read_all()
+    except Exception:
+        return {}
+
+
+#: pvars the coll micro-suite labels its lines with (segment counts,
+#: fusion savings, plan-cache behaviour — the PR-goal observables)
+_MICRO_PVARS = (
+    "coll_pipeline_segments", "coll_fusion_batched",
+    "coll_fusion_flushes", "coll_fusion_bytes_saved",
+    "coll_programs_compiled", "coll_invocations",
+    "coll_plan_cache_hits",
+)
+
+
+def _micro_pvars():
+    from ompi_release_tpu.mca import pvar as _pvar_mod
+
+    out = {}
+    for name in _MICRO_PVARS:
+        pv = _pvar_mod.PVARS.lookup(name)
+        if pv is not None:
+            out[name] = pv.read()
+    return out
+
+
+def _coll_micro_suite(backend_label):
+    """coll_pipeline / coll_fusion micro-suite through the framework's
+    own driver (not raw meshes): a ≥1 MiB pipelined allreduce + bcast
+    and a 64-small-tensors fusion burst, one JSON line each, every
+    line labelled with the cumulative pvar snapshot so BENCH_* files
+    capture segment counts and fusion savings. The fusion line's
+    device_collectives < tensors_fused check is pvar-based, so it
+    holds on the CPU backend too."""
+    import ompi_release_tpu as mpi
+    from ompi_release_tpu.mca import var as mca_var
+
+    lines = []
+    world = mpi.init()
+
+    # -- pipeline case: 1 MiB/rank allreduce + bcast, 256 KiB segments
+    mca_var.set_value("coll", "tuned")
+    try:
+        tuned = world.dup(name="bench_pipe")
+    finally:
+        mca_var.VARS.unset("coll")
+    elems = MiB // 4
+    x = np.ones((world.size, elems), np.float32)
+    try:
+        mca_var.set_value("coll_tuned_allreduce_algorithm", "ring")
+        mca_var.set_value("coll_tuned_bcast_algorithm", "binomial")
+        mca_var.set_value("coll_pipeline_segsize", 256 * 1024)
+        for name, call in (
+            ("coll_pipeline_allreduce_1MiB",
+             lambda: tuned.allreduce(x)),
+            ("coll_pipeline_bcast_1MiB",
+             lambda: tuned.bcast(x, root=0)),
+        ):
+            _sync(call())  # compile + prime the plan cache
+            t0 = time.perf_counter()
+            reps = 3
+            for _ in range(reps):
+                _sync(call())
+            dt = (time.perf_counter() - t0) / reps
+            lines.append({
+                "metric": name, "value": round(MiB / dt / 1e9, 4),
+                "unit": "GB/s", "vs_baseline": None,
+                "suite": "coll_pipeline", "seconds": round(dt, 6),
+                "pvars": _micro_pvars(), "cumulative": True,
+            })
+    finally:
+        mca_var.VARS.unset("coll_tuned_allreduce_algorithm")
+        mca_var.VARS.unset("coll_tuned_bcast_algorithm")
+        mca_var.VARS.unset("coll_pipeline_segsize")
+        tuned.free()
+
+    # -- fusion case: 64 small tensors through the fusion buffer
+    from ompi_release_tpu.mca import pvar as _pvar_mod
+
+    def _counter(name):
+        pv = _pvar_mod.PVARS.lookup(name)
+        return float(pv.read()) if pv is not None else 0.0
+
+    b0, f0 = _counter("coll_fusion_batched"), _counter("coll_fusion_flushes")
+    fb = world.fusion_buffer()
+    tensors = 64
+    small = [np.full((world.size, 256), i, np.float32)
+             for i in range(tensors)]
+    t0 = time.perf_counter()
+    handles = [fb.allreduce(s) for s in small]
+    fb.flush()
+    vals = [h.result() for h in handles]
+    dt = time.perf_counter() - t0
+    np.testing.assert_allclose(
+        np.asarray(vals[3][0]), np.full(256, 3.0 * world.size), rtol=0
+    )
+    fused = int(_counter("coll_fusion_batched") - b0)
+    issued = int(_counter("coll_fusion_flushes") - f0)
+    lines.append({
+        "metric": "coll_fusion_64x1KiB", "value": issued, "unit":
+        "device_collectives", "vs_baseline": None,
+        "suite": "coll_fusion", "tensors_fused": fused,
+        "fewer_collectives_than_tensors": issued < fused,
+        "seconds": round(dt, 6),
+        "pvars": _micro_pvars(), "cumulative": True,
+    })
+    if backend_label:
+        for ln in lines:
+            ln["backend"] = backend_label
+    return lines
+
+
+#: worker app for the wire micro-suite: a REAL 3-process tpurun job on
+#: the CPU mesh (the wire is host-side regardless of accelerator), so
+#: the emitted numbers exercise the exact envelope/fragment/lane code
+#: a multi-controller job runs. Process 0 writes its JSON lines to
+#: OMPITPU_WIRE_BENCH_OUT; the parent re-emits them as bench lines.
+_WIRE_BENCH_APP = r'''
+import json, os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# distinct shm identity per worker: every byte rides the DCN staged
+# path — the fragment pipeline under measurement (shm handoffs are a
+# single segment memcpy and would hide it)
+os.environ["OMPITPU_HOST_ID"] = (
+    "wirebench-" + os.environ["OMPITPU_NODE_ID"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.mca import pvar, var as mca_var
+from ompi_release_tpu.runtime.runtime import Runtime
+
+SIZES = json.loads(os.environ["OMPITPU_WIRE_BENCH_SIZES"])
+HOL_MIB = int(os.environ.get("OMPITPU_WIRE_BENCH_HOL_MIB", "8"))
+AGV_MIB = int(os.environ.get("OMPITPU_WIRE_BENCH_AGV_MIB", "1"))
+world = mpi.init()
+rt = Runtime.current()
+me = rt.bootstrap["process_index"]
+lines = []
+
+def _hol():
+    pv = pvar.PVARS.lookup("wire_hol_wait_seconds")
+    return float(pv.read()) if pv is not None else 0.0
+
+# -- p2p ping-pong bandwidth (rank 1 in p0 <-> rank 3 in p1) ---------------
+for size in SIZES:
+    x = np.ones(max(1, size // 4), np.float32)
+    best = None
+    for _ in range(3):
+        world.barrier()
+        if me == 0:
+            t0 = time.perf_counter()
+            world.send(x, 3, tag=11, rank=1)
+            v, _st = world.recv(source=3, tag=12, rank=1)
+            dt = time.perf_counter() - t0
+            assert np.asarray(v).shape == x.shape
+            best = dt if best is None else min(best, dt)
+        elif me == 1:
+            v, _st = world.recv(source=1, tag=11, rank=3)
+            world.send(np.asarray(v), 1, tag=12, rank=3)
+    if me == 0:
+        lines.append({
+            "metric": "wire_p2p_%%dMiB" %% (size >> 20),
+            "value": round(2 * size / best / 1e9, 4), "unit": "GB/s",
+            "vs_baseline": None, "suite": "wire", "rtt_s": round(best, 5),
+        })
+
+# -- two concurrent large transfers, distinct tags: lanes 4 vs 1 -----------
+hol_size = HOL_MIB << 20
+xh = np.ones(hol_size // 4, np.float32)
+for lanes in (4, 1):
+    mca_var.set_value("wire_p2p_lanes", lanes)
+    world.barrier()
+    h0 = _hol()
+    world.barrier()
+    if me == 0:
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=lambda: world.send(xh, 3, tag=1,
+                                                         rank=0)),
+              threading.Thread(target=lambda: world.send(xh, 3, tag=2,
+                                                         rank=1))]
+        for t in ts: t.start()
+        for t in ts: t.join()
+        wall = time.perf_counter() - t0
+    elif me == 1:
+        world.recv(source=1, tag=2, rank=3)
+        world.recv(source=0, tag=1, rank=3)
+    world.barrier()
+    if me == 0:
+        lines.append({
+            "metric": "wire_hol_2x%%dMiB_lanes%%d" %% (HOL_MIB, lanes),
+            "value": round(_hol() - h0, 4), "unit": "hol_wait_s",
+            "vs_baseline": None, "suite": "wire",
+            "wall_s": round(wall, 4),
+        })
+mca_var.VARS.unset("wire_p2p_lanes")
+
+# -- spanning-comm allgatherv round: three wire configurations -------------
+#   pipelined     zero-copy fragments + overlapped reap (the PR path)
+#   legacy_frames wire_pipeline_segsize=0 (tobytes + ordered join)
+#   sequential    pipelined frames, fixed process-order reap
+agv = np.arange((AGV_MIB << 20) // 4, dtype=np.float32)
+bufs = [agv + r for r in world.local_comm_ranks]
+configs = (("pipelined", 1 << 20, True),
+           ("legacy_frames", 0, True),
+           ("sequential", 1 << 20, False))
+times = {}
+for key, seg, overlap in configs:
+    mca_var.set_value("wire_pipeline_segsize", seg)
+    mca_var.set_value("wire_overlap_exchange", overlap)
+    world.barrier()
+    best = None
+    for _ in range(3):
+        world.barrier()
+        t0 = time.perf_counter()
+        out = world.allgatherv(bufs)
+        dt = time.perf_counter() - t0
+        assert np.asarray(out).shape[0] == world.size * agv.shape[0]
+        best = dt if best is None else min(best, dt)
+    times[key] = best
+mca_var.VARS.unset("wire_pipeline_segsize")
+mca_var.VARS.unset("wire_overlap_exchange")
+
+# -- skewed exchange: time-to-first-data, arrival order vs process order ---
+# Process 1 (FIRST in reap order) enters its round late; the overlap
+# reap returns process 2's payload almost immediately while the
+# sequential baseline parks on the slow peer — the latency a pipelined
+# consumer of early rows actually feels.
+SKEW_S = 0.4
+first = {}
+rt_router = rt.wire
+for key, overlap in (("overlap", True), ("sequential", False)):
+    world.barrier()
+    if me == 0:
+        t0 = time.perf_counter()
+        if overlap:
+            pending = {1: 1, 2: 1}
+            src, _arr = rt_router.coll_recv_any(world, pending)
+            first[key] = time.perf_counter() - t0
+            pending[src] -= 1
+            while sum(pending.values()):
+                s2, _ = rt_router.coll_recv_any(world, pending)
+                pending[s2] -= 1
+        else:
+            _ = rt_router.coll_recv(world, 1)   # parks on the slow peer
+            first[key] = time.perf_counter() - t0
+            _ = rt_router.coll_recv(world, 2)
+    elif me == 1:
+        time.sleep(SKEW_S)
+        rt_router.coll_send(world, 0, agv)
+    else:
+        rt_router.coll_send(world, 0, agv)
+    world.barrier()
+
+if me == 0:
+    for key, _seg, _ov in configs:
+        lines.append({
+            "metric": "wire_allgatherv_%%dMiB_%%s" %% (AGV_MIB, key),
+            "value": round(times[key], 4), "unit": "s",
+            "vs_baseline": None, "suite": "wire",
+        })
+    lines.append({
+        "metric": "wire_allgatherv_pipeline_speedup",
+        "value": round(times["legacy_frames"]
+                       / max(times["pipelined"], 1e-9), 4),
+        "unit": "x_vs_legacy_framing", "vs_baseline": None,
+        "suite": "wire",
+    })
+    lines.append({
+        "metric": "wire_allgatherv_overlap_speedup",
+        "value": round(times["sequential"]
+                       / max(times["pipelined"], 1e-9), 4),
+        "unit": "x_vs_sequential", "vs_baseline": None, "suite": "wire",
+    })
+    lines.append({
+        "metric": "wire_skewed_first_data_overlap",
+        "value": round(first["overlap"], 4), "unit": "s",
+        "vs_baseline": None, "suite": "wire",
+        "sequential_s": round(first["sequential"], 4),
+        "first_data_speedup": round(
+            first["sequential"] / max(first["overlap"], 1e-9), 2),
+        "skew_s": SKEW_S,
+        "pvars": {k: v for k, v in pvar.PVARS.read_all().items()
+                  if k.startswith(("wire_", "btl_dcn_"))},
+        "cumulative": True,
+    })
+    with open(os.environ["OMPITPU_WIRE_BENCH_OUT"], "w") as f:
+        json.dump(lines, f)
+world.barrier()
+mpi.finalize()
+'''
+
+
+def _wire_micro_suite(backend_label):
+    """Cross-process wire lines: p2p ping-pong bandwidth (1 MiB up to
+    256 MiB on full machines), two concurrent distinct-tag transfers
+    under 4 lanes vs 1 (the head-of-line pvar is the metric), and a
+    spanning-comm allgatherv with overlapped vs sequential reaping —
+    all through a REAL 3-process tpurun job, CPU mesh (the wire rides
+    host sockets/shm either way). Same labelled CPU fallback contract
+    as every other line: ``backend`` marks tpu_unavailable rounds."""
+    import os
+    import sys as _sys
+    import tempfile
+
+    from ompi_release_tpu.tools.tpurun import Job
+
+    full = backend_label is None
+    sizes = [1 << 20, 16 << 20, 64 << 20, 256 << 20] if full else \
+        [1 << 20, 4 << 20, 16 << 20]
+    with tempfile.TemporaryDirectory() as td:
+        app = os.path.join(td, "wire_bench_app.py")
+        out_path = os.path.join(td, "wire_bench.json")
+        with open(app, "w") as f:
+            f.write(_WIRE_BENCH_APP % {"repo": os.path.dirname(
+                os.path.abspath(__file__))})
+        env_keep = dict(os.environ)
+        os.environ["OMPITPU_WIRE_BENCH_SIZES"] = json.dumps(sizes)
+        os.environ["OMPITPU_WIRE_BENCH_OUT"] = out_path
+        os.environ["OMPITPU_WIRE_BENCH_HOL_MIB"] = "32" if full else "8"
+        os.environ["OMPITPU_WIRE_BENCH_AGV_MIB"] = "4" if full else "1"
+        try:
+            job = Job(3, [_sys.executable, app], [], heartbeat_s=0.5,
+                      miss_limit=8)
+            rc = job.run(timeout_s=420 if full else 240)
+        finally:
+            os.environ.clear()
+            os.environ.update(env_keep)
+        if rc != 0 or not os.path.exists(out_path):
+            return [{"metric": "wire_micro_suite", "value": None,
+                     "unit": None, "vs_baseline": None,
+                     "error": f"wire bench job rc={rc}"}]
+        with open(out_path) as f:
+            lines = json.load(f)
+    if backend_label:
+        for ln in lines:
+            ln["backend"] = backend_label
+    return lines
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_release_tpu.utils import jaxcompat
+
+    jaxcompat.install()  # jax.shard_map/typeof/pvary on 0.4.x jaxlibs
+    watchdog = _arm_global_watchdog()
+    devices = _init_backend(jax)
+    backend_label = None
+    if devices is None:
+        # tpu_unavailable: emit the CPU-backend numbers, labelled, so
+        # the round record carries data instead of a bare bench_error
+        try:
+            devices = jax.devices("cpu")
+            backend_label = "cpu"
+            print(json.dumps({"event": "tpu_unavailable",
+                              "fallback": "cpu"}), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "metric": "bench_error", "value": None, "unit": None,
+                "vs_baseline": None, "error": "tpu_unavailable",
+                "detail": f"cpu fallback failed: "
+                          f"{type(e).__name__}: {e}"[:300],
+            }))
+            return 0
+    n = len(devices)
+    on_tpu = backend_label is None and jax.default_backend() == "tpu"
+
+    if n >= 2:
+        specs, ceiling_names = _mesh_specs(jax, jnp, devices, on_tpu)
+    else:
+        specs, ceiling_names = _single_chip_specs(
+            jax, jnp, devices[0], on_tpu
+        )
+
+    if on_tpu:
+        # compile/warm at the static guess, then size K from measured
+        # per-iteration time (VMEM-resident loops are 5-20x faster
+        # than the HBM estimate)
+        for s in specs:
+            s["k_lo"], s["k_hi"] = _calibrate_k(
+                s["loop"], s["args"], s["k_hi"]
+            )
+
+    rounds = 5 if on_tpu else 3
+    slopes = _run_rounds(specs, rounds)  # (n_specs, rounds)
+
+    # per-round bandwidths; ceiling_r = best bw ANY copy candidate or
+    # the line itself achieved that round (vs_baseline <= 1.0 by
+    # construction; see module docstring)
+    bw = {}
+    for i, s in enumerate(specs):
+        if s["nbytes"] is not None:
+            bw[s["name"]] = s["nbytes"] / slopes[i] / 1e9
+    cand = np.stack([bw[nm] for nm in ceiling_names])
+    ceil_r = cand.max(axis=0)
+    ceil_med = float(np.median(ceil_r))
+    # the CV must be robust to a contaminated round: a tunnel hiccup
+    # (or a concurrent job on the chip) can drive one round's slope to
+    # the 1e-12 clamp, producing an absurd per-round bandwidth that
+    # explodes a plain std while the median stays sane — compute
+    # variability over rounds within a sane band of the median and
+    # surface how many rounds were discarded
+    sane = ceil_r[(ceil_r > 0.2 * ceil_med) & (ceil_r < 5 * ceil_med)]
+    dropped_rounds = int(ceil_r.size - sane.size)
+    if sane.size:
+        ceil_cv = float(np.std(sane) / max(float(np.median(sane)), 1e-12))
+    else:
+        ceil_cv = float("nan")
+
+    lines = []
+    headline = None
+    for i, s in enumerate(specs):
+        nm = s["name"]
+        if nm.startswith("ceiling_copy"):
+            continue  # ceiling candidates feed the denominator only
+        if s["nbytes"] is None:  # latency line (ring)
+            per_hop = np.median(slopes[i]) / s["hops"] * 1e6
+            lines.append({
+                "metric": f"{nm}_latency", "value": round(per_hop, 4),
+                "unit": "us/hop", "vs_baseline": None,
+                "note": "no published ref latency; tracked across rounds",
+            })
+            continue
+        value = float(np.median(bw[nm]))
+        if s.get("unstable"):
+            lines.append({
+                "metric": nm, "value": round(value, 3), "unit": "GB/s",
+                "vs_baseline": None, "unstable": True,
+                "note": "K-delta inside tunnel jitter; value unreliable",
+            })
+            continue
+        if value > 1.15 * ceil_med and s.get("ws", float("inf")) \
+                <= ONCHIP_WS:
+            # working set fits on-chip: the loop legitimately runs at
+            # VMEM bandwidth (iterations checksum-verified), so an HBM
+            # ratio would be meaningless — label the tier instead of
+            # faking a ceiling.  The ws gate keeps a lucky round from
+            # misfiling an HBM-bound line (a 256 MiB transpose at
+            # ceiling parity + the +-20% wobble can median past
+            # 1.15x): only working sets that can physically reside in
+            # VMEM are eligible for the tier; everything else takes
+            # the vs_baseline path, whose per-round max(ceil, self)
+            # already handles value > ceiling honestly
+            entry = {
+                "metric": nm, "value": round(value, 3), "unit": "GB/s",
+                "vs_baseline": None, "tier": "on-chip",
+                "ceiling_gbps": round(ceil_med, 1),
+            }
+            lines.append(entry)
+            continue
+        line_ceil = np.maximum(ceil_r, bw[nm])
+        vs = float(np.median(bw[nm] / line_ceil))
+        entry = {
+            "metric": nm,
+            "value": round(value, 3),
+            "unit": "GB/s",
+            "vs_baseline": round(vs, 4),
+            "ceiling_gbps": round(ceil_med, 1),
+            "ceiling_cv": round(ceil_cv, 4),
+        }
+        if dropped_rounds:
+            entry["ceiling_rounds_dropped"] = dropped_rounds
+        if nm == "allreduce_256MiB" and n < 2:
+            headline = {
+                "metric": "op_sum_256MiB_f32_hbm_bw",
+                "value": entry["value"], "unit": "GB/s",
+                "vs_baseline": entry["vs_baseline"],
+                "ceiling_gbps": entry["ceiling_gbps"],
+                "ceiling_cv": entry["ceiling_cv"],
+                "parity": True,
+            }
+        elif nm == "allreduce_256MiB" and n >= 2:
+            headline = {
+                "metric": f"allreduce_256MiB_f32_busbw_{n}dev",
+                "value": entry["value"], "unit": "GB/s",
+                "vs_baseline": entry["vs_baseline"],
+                "ceiling_gbps": entry["ceiling_gbps"],
+                "ceiling_cv": entry["ceiling_cv"],
+                "parity": True,
+            }
+        lines.append(entry)
+
+    if headline is None:  # CPU dev runs (truncated sweep): largest point
+        biggest = max(
+            (s for s in specs if s["nbytes"] is not None
+             and s["name"].startswith("allreduce_")),
+            key=lambda s: s["nbytes"],
+        )
+        headline = {
+            "metric": "op_sum_small_f32_hbm_bw" if n < 2
+            else f"allreduce_f32_busbw_{n}dev",
+            "value": round(float(np.median(bw[biggest["name"]])), 3),
+            "unit": "GB/s",
+            "vs_baseline": round(float(np.median(
+                bw[biggest["name"]]
+                / np.maximum(ceil_r, bw[biggest["name"]]))), 4),
+            "ceiling_gbps": round(ceil_med, 1),
+            "ceiling_cv": round(ceil_cv, 4),
+            "parity": True,
+        }
+        if dropped_rounds:
+            headline["ceiling_rounds_dropped"] = dropped_rounds
+
+    # compute-bound line (single-chip fwd+bwd MFU): measured after the
+    # bandwidth sweep so its compile time cannot contaminate those
+    # loops' interleaved rounds
+    try:
+        lines.append(_mfu_metric(jax, jnp, devices[0], on_tpu,
+                                 rounds=max(3, rounds)))
+    except Exception as e:
+        lines.append({
+            "metric": "transformer_fwdbwd_step", "value": None,
+            "unit": "TFLOP/s", "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}"[:200],
+        })
+
+    # coll pipeline/fusion micro-suite: framework-driver lines with
+    # labelled pvar snapshots (segment counts, fusion savings)
+    try:
+        lines.extend(_coll_micro_suite(backend_label))
+    except Exception as e:
+        lines.append({
+            "metric": "coll_micro_suite", "value": None, "unit": None,
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}"[:300],
+        })
+
+    # wire micro-suite: cross-process p2p bandwidth, lane-concurrency
+    # head-of-line wait, and spanning-comm allgatherv overlap — the
+    # cross-process bandwidth trajectory line
+    try:
+        lines.extend(_wire_micro_suite(backend_label))
+    except Exception as e:
+        lines.append({
+            "metric": "wire_micro_suite", "value": None, "unit": None,
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}"[:300],
+        })
+
+    # ONE cumulative snapshot: the configs run interleaved (see
+    # _run_rounds), so per-config pvar deltas do not exist — emitting
+    # the same blob per line would only masquerade as them
+    snapshot = json.dumps(
+        {"pvars": _pvar_snapshot(), "cumulative": True}, default=str
+    )
+    for ln in lines:
+        if backend_label:
+            ln["backend"] = backend_label
+        print(json.dumps(ln))
+    if backend_label:
+        headline["backend"] = backend_label
+    print(snapshot)
+    print(json.dumps(headline))  # headline stays the LAST line
+    watchdog.cancel()
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # keep the round record parseable, always
+        print(json.dumps({
+            "metric": "bench_error", "value": None, "unit": None,
+            "vs_baseline": None, "error": "bench_failed",
+            "detail": f"{type(e).__name__}: {e}"[:300],
+        }))
+        sys.exit(0)

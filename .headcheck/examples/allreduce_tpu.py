@@ -1,0 +1,30 @@
+"""The north-star op: MPI_Allreduce over the device mesh.
+
+Run:  python examples/allreduce_tpu.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu import ops
+
+
+def main() -> int:
+    world = mpi.init()
+    n = world.size
+    x = np.random.default_rng(0).normal(size=(n, 1 << 16)).astype(np.float32)
+    out = np.asarray(world.allreduce(x, ops.SUM))
+    np.testing.assert_allclose(out[0], x.sum(0), rtol=1e-4, atol=1e-4)
+    gb = x.nbytes / 1e9
+    print(f"allreduce OK: {n} ranks x {x.shape[1]} f32 "
+          f"({gb * 1000:.2f} MB total), parity vs numpy verified")
+    mpi.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""hello_oshmem_c.c analogue: every PE reports its identity.
+
+Run:  python examples/hello_oshmem_tpu.py   (driver mode, virtual PEs)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.oshmem import shmem
+
+
+def main() -> int:
+    mpi.init()
+    ctx = shmem.shmem_init()
+    # driver mode: one controller speaks for every PE
+    for pe in range(ctx.n_pes):
+        print(f"Hello, world, I am {pe} of {ctx.n_pes}")
+    shmem.shmem_finalize()
+    mpi.finalize()
+    print("hello_oshmem complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,25 @@
+"""hello_c.c analogue: every rank reports its identity.
+
+Run:  python -m ompi_release_tpu.tools.tpurun -n 4 python examples/hello_tpu.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ompi_release_tpu as mpi
+
+
+def main() -> int:
+    world = mpi.init()
+    rt = mpi.runtime.runtime.Runtime.current()
+    pi = rt.bootstrap.get("process_index", 0)
+    pc = rt.bootstrap.get("process_count", 1)
+    print(f"Hello, world, I am process {pi} of {pc} "
+          f"(world comm size {world.size})")
+    mpi.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""ring_oshmem_c.c analogue: a token circles the PEs via one-sided
+puts + wait_until instead of send/recv.
+
+Each PE waits until its symmetric flag holds the lap count its left
+neighbour put there, then decrements (PE 0) and puts onward — the
+put/wait_until pattern of ``examples/ring_oshmem_c.c``.
+
+Run:  python examples/ring_oshmem_tpu.py   (driver mode, virtual PEs)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.oshmem import shmem
+
+
+def main() -> int:
+    mpi.init()
+    ctx = shmem.shmem_init()
+    n = ctx.n_pes
+    laps = 3
+    # symmetric flag per PE: -1 = empty, >=0 = token with value
+    flag = ctx.malloc((1,), np.int32)
+    ctx.barrier_all()
+    for pe in range(n):
+        ctx.put(flag, np.full(1, -1, np.int32), pe=pe)
+    ctx.quiet()
+
+    passes = 0
+    ctx.put_elem(flag, np.int32(laps), 0, pe=0)  # seed at PE 0
+    token = laps
+    pe = 0
+    while True:
+        ctx.wait_until(flag, "ge", 0, pe=pe)
+        token = int(np.asarray(ctx.get(flag, pe=pe))[0])
+        ctx.put_elem(flag, np.int32(-1), 0, pe=pe)  # consume
+        passes += 1
+        if pe == 0 and passes > 1:
+            token -= 1
+            print(f"PE 0: {token} laps to go")
+        if token == 0 and pe == n - 1:
+            break
+        ctx.put_elem(flag, np.int32(token), 0, pe=(pe + 1) % n)
+        ctx.quiet()
+        pe = (pe + 1) % n
+    ctx.barrier_all()
+    flag.free()
+    shmem.shmem_finalize()
+    mpi.finalize()
+    print(f"ring_oshmem complete: {passes} passes over {n} PEs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

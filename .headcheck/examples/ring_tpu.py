@@ -1,0 +1,54 @@
+"""ring_c.c analogue (BASELINE config #1): a token circles the ring.
+
+Rank 0 seeds a lap counter; each rank receives from rank-1 and forwards
+to rank+1; rank 0 decrements per lap; everyone exits after passing a 0.
+
+Run:  python examples/ring_tpu.py        (driver mode, 4 virtual ranks)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import ompi_release_tpu as mpi
+
+
+def main() -> int:
+    world = mpi.init()
+    n = min(4, world.size)
+    ring = world.create(world.group.incl(list(range(n))), name="ring")
+    laps = 3
+
+    # driver mode: one controller plays every rank (the reference's
+    # oversubscribed-mpirun test style) — same message pattern as
+    # examples/ring_c.c:19-61
+    ring.send(np.int32(laps), dest=1 % n, tag=1, rank=0)
+    done = [False] * n
+    passes = 0
+    while not all(done):
+        for r in range(n):
+            if done[r]:
+                continue
+            st = ring.iprobe(source=(r - 1) % n, tag=1, rank=r)
+            if st is None:
+                continue
+            val, _ = ring.recv(source=(r - 1) % n, tag=1, rank=r)
+            v = int(np.asarray(val))
+            passes += 1
+            if r == 0:
+                v -= 1
+                print(f"rank 0: {v} laps to go")
+            ring.send(np.int32(v), dest=(r + 1) % n, tag=1, rank=r)
+            if v == 0:
+                done[r] = True
+    # rank 0 drains the final 0 off the ring
+    ring.recv(source=n - 1, tag=1, rank=0)
+    print(f"ring complete: {passes} passes over {n} ranks, {laps} laps")
+    mpi.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,191 @@
+"""Perf probe: find an op-kernel formulation that reaches >=0.8 of the
+HBM copy ceiling on the real chip (bench.py north-star path).
+
+Times several variants of the SUM op hot loop (acc = acc*c + a: read
+acc, read a, write acc -> 3 streams) against the 2-stream copy ceiling,
+using bench.py's slope method. Prints one line per variant.
+"""
+
+import json
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+K_LO, K_HI = 2, 34
+
+
+def _median_call(fn, *args, iters=5):
+    def sync(r):
+        np.asarray(r)
+
+    sync(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _per_iter(loop_fn, *args):
+    t_lo = _median_call(loop_fn, *args, K_LO)
+    t_hi = _median_call(loop_fn, *args, K_HI)
+    return max((t_hi - t_lo) / (K_HI - K_LO), 1e-12)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dev = jax.devices()[0]
+    size_bytes = 256 * 1024 * 1024
+    elems = size_bytes // 4
+
+    results = {}
+
+    def report(name, per, streams):
+        bw = streams * size_bytes / per / 1e9
+        results[name] = bw
+        print(json.dumps({"variant": name, "per_iter_ms": round(per * 1e3, 3),
+                          "gbps": round(bw, 1)}), flush=True)
+
+    # ---- ceiling: 2-stream (read+write) ----------------------------------
+    c = jax.device_put(jnp.ones((elems,), jnp.float32), dev)
+
+    @partial(jax.jit, static_argnums=1)
+    def copy_loop(c, k):
+        def body(i, acc):
+            return acc + lax.convert_element_type(i, jnp.float32)
+
+        acc = lax.fori_loop(0, k, body, c)
+        return acc[0] + acc[-1]
+
+    report("ceiling_2stream", _per_iter(copy_loop, c), 2)
+
+    # ---- current bench op loop (3 streams) -------------------------------
+    a = jax.device_put(jnp.ones((elems,), jnp.float32), dev)
+
+    @partial(jax.jit, static_argnums=1)
+    def op_loop(a, k):
+        def body(i, acc):
+            return acc * np.float32(0.999) + a
+
+        acc = lax.fori_loop(0, k, body, jnp.zeros_like(a))
+        return acc[0] + acc[-1]
+
+    report("xla_axpy", _per_iter(op_loop, a), 3)
+
+    # ---- XLA 2D layout variant -------------------------------------------
+    a2 = jax.device_put(jnp.ones((elems // 1024, 1024), jnp.float32), dev)
+
+    @partial(jax.jit, static_argnums=1)
+    def op_loop_2d(a, k):
+        def body(i, acc):
+            return acc * np.float32(0.999) + a
+
+        acc = lax.fori_loop(0, k, body, jnp.zeros_like(a))
+        return acc[0, 0] + acc[-1, -1]
+
+    report("xla_axpy_2d", _per_iter(op_loop_2d, a2), 3)
+
+    # ---- pallas variants --------------------------------------------------
+    def axpy_kernel(a_ref, acc_ref, out_ref):
+        out_ref[:] = acc_ref[:] * 0.999 + a_ref[:]
+
+    def make_pallas_axpy(rows, cols, blk_rows):
+        grid = (rows // blk_rows,)
+        spec = pl.BlockSpec((blk_rows, cols), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+        def one(a, acc):
+            return pl.pallas_call(
+                axpy_kernel,
+                out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+                grid=grid,
+                in_specs=[spec, spec],
+                out_specs=spec,
+                input_output_aliases={1: 0},
+            )(a, acc)
+
+        @partial(jax.jit, static_argnums=1)
+        def loop(a, k):
+            def body(i, acc):
+                return one(a, acc)
+
+            acc = lax.fori_loop(0, k, body, jnp.zeros((rows, cols),
+                                                      jnp.float32))
+            return acc[0, 0] + acc[-1, -1]
+
+        return loop
+
+    for cols, blk_rows in ((1024, 512), (1024, 1024), (1024, 2048),
+                           (8192, 128), (8192, 256), (512, 4096)):
+        rows = elems // cols
+        name = f"pallas_axpy_{rows // 1024}kx{cols}_blk{blk_rows}"
+        try:
+            loop = make_pallas_axpy(rows, cols, blk_rows)
+            a2 = jax.device_put(
+                jnp.ones((rows, cols), jnp.float32), dev
+            )
+            report(name, _per_iter(loop, a2), 3)
+        except Exception as e:
+            print(json.dumps({"variant": name, "error": str(e)[:200]}),
+                  flush=True)
+
+    # ---- pallas 2-stream ceiling check (out = in * 1.0001) ---------------
+    def scale_kernel(a_ref, out_ref):
+        out_ref[:] = a_ref[:] * 1.0001
+
+    def make_pallas_scale(rows, cols, blk_rows):
+        grid = (rows // blk_rows,)
+        spec = pl.BlockSpec((blk_rows, cols), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+        def one(acc):
+            return pl.pallas_call(
+                scale_kernel,
+                out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+                grid=grid,
+                in_specs=[spec],
+                out_specs=spec,
+                input_output_aliases={0: 0},
+            )(acc)
+
+        @partial(jax.jit, static_argnums=1)
+        def loop(a, k):
+            def body(i, acc):
+                return one(acc)
+
+            acc = lax.fori_loop(0, k, body, a)
+            return acc[0, 0] + acc[-1, -1]
+
+        return loop
+
+    for cols, blk_rows in ((1024, 1024), (8192, 256)):
+        rows = elems // cols
+        name = f"pallas_scale_{rows // 1024}kx{cols}_blk{blk_rows}"
+        try:
+            loop = make_pallas_scale(rows, cols, blk_rows)
+            a2 = jax.device_put(jnp.ones((rows, cols), jnp.float32), dev)
+            report(name, _per_iter(loop, a2), 2)
+        except Exception as e:
+            print(json.dumps({"variant": name, "error": str(e)[:200]}),
+                  flush=True)
+
+    best = max((v for k, v in results.items() if k != "ceiling_2stream"
+                and not k.startswith("pallas_scale")), default=0)
+    print(json.dumps({
+        "ceiling": round(results.get("ceiling_2stream", 0), 1),
+        "best_op": round(best, 1),
+        "ratio": round(best / results["ceiling_2stream"], 4)
+        if results.get("ceiling_2stream") else None,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,145 @@
+"""Probe 2: sweep pallas axpy/scale block shapes for the bench kernels."""
+
+import json
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+K_LO, K_HI = 2, 34
+
+
+def _median_call(fn, *args, iters=5):
+    def sync(r):
+        np.asarray(r)
+
+    sync(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _per_iter(loop_fn, *args):
+    t_lo = _median_call(loop_fn, *args, K_LO)
+    t_hi = _median_call(loop_fn, *args, K_HI)
+    return max((t_hi - t_lo) / (K_HI - K_LO), 1e-12)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dev = jax.devices()[0]
+    size_bytes = 256 * 1024 * 1024
+    elems = size_bytes // 4
+
+    def report(name, per, streams):
+        bw = streams * size_bytes / per / 1e9
+        print(json.dumps({"variant": name,
+                          "per_iter_ms": round(per * 1e3, 3),
+                          "gbps": round(bw, 1)}), flush=True)
+        return bw
+
+    def axpy_kernel(a_ref, acc_ref, out_ref):
+        out_ref[:] = acc_ref[:] * 0.999 + a_ref[:]
+
+    def scale_kernel(a_ref, out_ref):
+        out_ref[:] = a_ref[:] * 1.0001
+
+    def make_loop(kern, nin, rows, cols, blk_rows, dimsem=None):
+        grid = (rows // blk_rows,)
+        spec = pl.BlockSpec((blk_rows, cols), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+        kw = {}
+        if dimsem:
+            kw["compiler_params"] = pltpu.CompilerParams(
+                dimension_semantics=(dimsem,))
+
+        call = pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+            grid=grid,
+            in_specs=[spec] * nin,
+            out_specs=spec,
+            input_output_aliases={nin - 1: 0},
+            **kw,
+        )
+
+        if nin == 2:
+            @partial(jax.jit, static_argnums=1)
+            def loop(a, k):
+                def body(i, acc):
+                    return call(a, acc)
+
+                acc = lax.fori_loop(
+                    0, k, body, jnp.zeros((rows, cols), jnp.float32))
+                return acc[0, 0] + acc[-1, -1]
+        else:
+            @partial(jax.jit, static_argnums=1)
+            def loop(a, k):
+                def body(i, acc):
+                    return call(acc)
+
+                acc = lax.fori_loop(0, k, body, a)
+                return acc[0, 0] + acc[-1, -1]
+
+        return loop
+
+    shapes = [(1024, 128), (1024, 256), (1024, 512),
+              (2048, 128), (2048, 256), (2048, 512),
+              (512, 512), (512, 1024), (4096, 128), (4096, 256)]
+    best_axpy = (0, None)
+    for cols, blk in shapes:
+        rows = elems // cols
+        name = f"axpy_c{cols}_b{blk}"
+        try:
+            a = jax.device_put(jnp.ones((rows, cols), jnp.float32), dev)
+            bw = report(name, _per_iter(make_loop(axpy_kernel, 2, rows,
+                                                  cols, blk), a), 3)
+            if bw > best_axpy[0]:
+                best_axpy = (bw, name)
+        except Exception as e:
+            print(json.dumps({"variant": name, "error": str(e)[:120]}),
+                  flush=True)
+
+    # arbitrary dimension semantics on the best few
+    for cols, blk in [(1024, 256), (2048, 256)]:
+        rows = elems // cols
+        name = f"axpy_c{cols}_b{blk}_arb"
+        try:
+            a = jax.device_put(jnp.ones((rows, cols), jnp.float32), dev)
+            report(name, _per_iter(make_loop(axpy_kernel, 2, rows, cols,
+                                             blk, "arbitrary"), a), 3)
+        except Exception as e:
+            print(json.dumps({"variant": name, "error": str(e)[:120]}),
+                  flush=True)
+
+    best_scale = (0, None)
+    for cols, blk in [(1024, 256), (1024, 512), (1024, 1024),
+                      (2048, 256), (2048, 512), (512, 1024), (512, 2048)]:
+        rows = elems // cols
+        name = f"scale_c{cols}_b{blk}"
+        try:
+            a = jax.device_put(jnp.ones((rows, cols), jnp.float32), dev)
+            bw = report(name, _per_iter(make_loop(scale_kernel, 1, rows,
+                                                  cols, blk), a), 2)
+            if bw > best_scale[0]:
+                best_scale = (bw, name)
+        except Exception as e:
+            print(json.dumps({"variant": name, "error": str(e)[:120]}),
+                  flush=True)
+
+    print(json.dumps({"best_axpy": best_axpy, "best_scale": best_scale,
+                      "ratio": round(best_axpy[0] / best_scale[0], 4)
+                      if best_scale[0] else None}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""Probe 3: long-loop (K=258) stable timing of the best candidates."""
+
+import json
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+K_LO, K_HI = 2, 258
+
+
+def _median_call(fn, *args, iters=7):
+    def sync(r):
+        np.asarray(r)
+
+    sync(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _per_iter(loop_fn, *args):
+    t_lo = _median_call(loop_fn, *args, K_LO)
+    t_hi = _median_call(loop_fn, *args, K_HI)
+    return max((t_hi - t_lo) / (K_HI - K_LO), 1e-12)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    dev = jax.devices()[0]
+    size_bytes = 256 * 1024 * 1024
+    elems = size_bytes // 4
+
+    def report(name, per, streams):
+        bw = streams * size_bytes / per / 1e9
+        print(json.dumps({"variant": name,
+                          "per_iter_ms": round(per * 1e3, 3),
+                          "gbps": round(bw, 1)}), flush=True)
+        return bw
+
+    def axpy_kernel(a_ref, acc_ref, out_ref):
+        out_ref[:] = acc_ref[:] * 0.999 + a_ref[:]
+
+    def scale_kernel(a_ref, out_ref):
+        out_ref[:] = a_ref[:] * 1.0001
+
+    def make_loop(kern, nin, rows, cols, blk_rows):
+        grid = (rows // blk_rows,)
+        spec = pl.BlockSpec((blk_rows, cols), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+        call = pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+            grid=grid,
+            in_specs=[spec] * nin,
+            out_specs=spec,
+            input_output_aliases={nin - 1: 0},
+        )
+        if nin == 2:
+            @partial(jax.jit, static_argnums=1)
+            def loop(a, k):
+                def body(i, acc):
+                    return call(a, acc)
+
+                acc = lax.fori_loop(
+                    0, k, body, jnp.zeros((rows, cols), jnp.float32))
+                return acc[0, 0] + acc[-1, -1]
+        else:
+            @partial(jax.jit, static_argnums=1)
+            def loop(a, k):
+                def body(i, acc):
+                    return call(acc)
+
+                acc = lax.fori_loop(0, k, body, a)
+                return acc[0, 0] + acc[-1, -1]
+        return loop
+
+    for name, kern, nin, cols, blk in [
+        ("axpy_c2048_b256", axpy_kernel, 2, 2048, 256),
+        ("axpy_c1024_b256", axpy_kernel, 2, 1024, 256),
+        ("axpy_c2048_b128", axpy_kernel, 2, 2048, 128),
+        ("scale_c2048_b256", scale_kernel, 1, 2048, 256),
+        ("scale_c1024_b256", scale_kernel, 1, 1024, 256),
+        ("scale_c512_b2048", scale_kernel, 1, 512, 2048),
+        ("scale_c2048_b128", scale_kernel, 1, 2048, 128),
+    ]:
+        rows = elems // cols
+        try:
+            a = jax.device_put(jnp.ones((rows, cols), jnp.float32), dev)
+            report(name, _per_iter(make_loop(kern, nin, rows, cols, blk), a),
+                   3 if nin == 2 else 2)
+        except Exception as e:
+            print(json.dumps({"variant": name, "error": str(e)[:120]}),
+                  flush=True)
+
+    # XLA references with the long loop
+    a = jax.device_put(jnp.ones((elems,), jnp.float32), dev)
+
+    @partial(jax.jit, static_argnums=1)
+    def op_loop(a, k):
+        def body(i, acc):
+            return acc * np.float32(0.999) + a
+
+        acc = lax.fori_loop(0, k, body, jnp.zeros_like(a))
+        return acc[0] + acc[-1]
+
+    report("xla_axpy", _per_iter(op_loop, a), 3)
+
+    @partial(jax.jit, static_argnums=1)
+    def copy_loop(c, k):
+        def body(i, acc):
+            return acc + lax.convert_element_type(i, jnp.float32)
+
+        acc = lax.fori_loop(0, k, body, c)
+        return acc[0] + acc[-1]
+
+    report("xla_iota_add", _per_iter(copy_loop, a), 2)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,107 @@
+"""Block-shape re-sweep on the tunneled v5e chip (round 3, 2026-07-30).
+
+HISTORICAL RECORD — r03.  The transpose rows below were measured
+against the r03 make_transpose_loop (body `call(acc) + 1`, 2N bytes
+counted); r04 changed that function to a double-apply body moving 4N
+bytes per iteration (see probes 5-7 and ops/pallas_op.py), so
+re-running this sweep today would report ~half the true transpose
+bandwidth under this file's 2N accounting.  Keep for the tuning
+trail; do not re-run for new numbers.
+
+Dev scratch (like perf_probe*.py): measures axpy/scale/transpose Pallas
+block candidates with interleaved long-window slope timing. Findings
+baked into the shipped constants:
+
+  axpy (3-stream):  (256, 2048) still best     ~686-885 GB/s
+  scale (2-stream): (16, 16384) won this run    ~679 GB/s (others ~655)
+                    -> added as SCALE_BLOCK_ALT2 ceiling candidate
+  transpose 8192^2: block 1024 ~385 GB/s, 512 ~350, 256 ~330
+                    -> bench.py's alltoall config now prefers 1024
+                       (16 MB scoped-VMEM boundary; guarded fallback)
+
+Method notes (the two traps that produced garbage numbers first):
+  * BOTH K variants must be compiled+warmed before timing (static
+    argnums => two programs; timing the cold one measures compile).
+  * The K delta must be >= ~0.2 s of device time: the tunnel adds
+    ~100 ms jitter per call, so 10-iteration deltas yield negative
+    slopes.
+"""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ompi_release_tpu.ops import pallas_op as po
+
+
+def slope_bw(loop, arr, k_lo, k_hi, streams, nbytes):
+    np.asarray(loop(arr, k_lo))
+    np.asarray(loop(arr, k_hi))  # compile/warm BOTH programs
+    t0 = time.perf_counter()
+    np.asarray(loop(arr, k_lo))
+    t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.asarray(loop(arr, k_hi))
+    t_hi = time.perf_counter() - t0
+    return streams * nbytes * (k_hi - k_lo) / (t_hi - t_lo) / 1e9
+
+
+def main() -> None:
+    N = 64 * 1024 * 1024  # 256 MiB f32
+    results = {}
+    cfgs = {
+        ("axpy", 3, po.make_axpy_loop): [
+            (256, 2048), (128, 2048), (128, 4096), (64, 4096),
+            (64, 8192), (512, 1024),
+        ],
+        ("scale", 2, po.make_scale_loop): [
+            (128, 2048), (32, 8192), (64, 8192), (256, 2048),
+            (16, 16384),
+        ],
+    }
+    for rnd in range(3):
+        for (kind, streams, mk), blocks in cfgs.items():
+            for br, cols in blocks:
+                if br * cols * 4 > 2 * 1024 * 1024:
+                    continue  # scoped-VMEM limit (3 bufs, dbl-buffered)
+                rows = N // cols
+                if rows % br:
+                    continue
+                loop = mk(rows, cols, blk_rows=br)
+                a = jax.device_put(jnp.ones((rows, cols), jnp.float32))
+                k_hi = 200 if streams == 3 else 300
+                bw = slope_bw(loop, a, 8, k_hi, streams, N * 4)
+                results.setdefault((kind, br, cols), []).append(bw)
+
+        n = 8192
+        for block in (256, 512, 1024):
+            loop, _ = po.make_transpose_loop(n, block=block)
+            x = jax.device_put(
+                jnp.arange(n * n, dtype=jnp.int32).reshape(n, n)
+            )
+            bw = slope_bw(loop, x, 8, 208, 1, 2 * n * n * 4)
+            results.setdefault(("transpose", block, n), []).append(bw)
+
+    for k in sorted(results, key=lambda k: (k[0], -max(results[k]))):
+        vals = results[k]
+        print(f"{k[0]:9s} blk={k[1]:5d}x{k[2]:<5d} "
+              f"max={max(vals):7.1f} GB/s "
+              f"runs={[f'{v:.0f}' for v in vals]}")
+
+
+if __name__ == "__main__":
+    main()
+
+
+# Addendum (same session): is the 8192^2 transpose VPU-bound or
+# HBM-bound? A COPY kernel at the identical (1024,1024)-blocked 2-D
+# grid measured ~357-390 GB/s vs the transpose's ~300-385 — i.e. the
+# blocked 2-D data movement itself (4 KB bursts with tile-to-tile
+# jumps) is the ceiling, not the in-VMEM transpose. The 1-D scale
+# kernel reaches ~660 GB/s only because its blocks are full rows
+# (pure sequential streams). Conclusion: alltoall_i32_torus at ~0.5 of
+# the sequential-copy ceiling is the strided-access reality of this
+# geometry, not kernel inefficiency.

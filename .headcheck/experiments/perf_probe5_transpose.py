@@ -1,0 +1,166 @@
+"""Round-4 transpose probe: is alltoall's 0.49 an artifact of the
+anti-folding `+1` running as a SEPARATE kernel?
+
+The r03-shipped make_transpose_loop body was `call(acc) + 1` (frozen
+inline below as old1024 — the shipped function has since been fixed):
+pallas_call is opaque to XLA, so the +1 cannot fuse into it — a
+second elementwise pass, 2N extra HBM bytes per iteration the bench
+did not count.  Serial estimate: transpose at ceiling B with an
+uncounted extra copy pass reports 2N / (4N/B) = B/2 = 333 GB/s at
+B = 667 — the measured 330.
+
+OUTCOME (run on the real chip, r04): hypothesis WRONG in the detail,
+right in spirit — fused/blockperm/xla_t/old1024 ALL measured 333
+while copy hit 658, pointing past the +1 to something structural;
+probes 6-7 isolated it to the fori_loop carry copy-back (absence of
+input_output_aliases), fixed by the double-apply body.
+
+Candidates (all 8192^2 int32 = 256 MiB, slope-timed interleaved):
+  fused1024 — +1 fused INTO the transpose kernel (x.T + 1), block 1024
+  fused512  — same, block 512
+  blockperm — block-permute copy (blocks move (i,j)->(j,i), NO element
+              transpose) + fused +1: upper bound separating HBM block
+              movement from the in-VMEM element transpose cost
+  xla_t     — plain XLA acc.T + 1 in the fori_loop (what the compiler
+              achieves unaided)
+  old1024   — the shipped kernel (+1 outside) for a same-session ref
+  copy      — 2-stream scale kernel = the ceiling
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ompi_release_tpu.ops import pallas_op as po
+
+N = 8192
+NB = 2 * N * N * 4  # nominal 2-stream bytes
+
+
+def fused_transpose_loop(n, block, shift=1):
+    def kernel(x_ref, out_ref):
+        out_ref[:] = x_ref[:].T + shift
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, n), jnp.int32),
+        grid=(n // block, n // block),
+        in_specs=[pl.BlockSpec((block, block), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((block, block), lambda i, j: (j, i),
+                               memory_space=pltpu.VMEM),
+    )
+
+    @partial(jax.jit, static_argnums=1)
+    def loop(a, k):
+        return jax.lax.fori_loop(0, k, lambda i, acc: call(acc), a)[0, 0]
+
+    return loop
+
+
+def blockperm_loop(n, block):
+    def kernel(x_ref, out_ref):
+        out_ref[:] = x_ref[:] + 1  # no element transpose
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, n), jnp.int32),
+        grid=(n // block, n // block),
+        in_specs=[pl.BlockSpec((block, block), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((block, block), lambda i, j: (j, i),
+                               memory_space=pltpu.VMEM),
+    )
+
+    @partial(jax.jit, static_argnums=1)
+    def loop(a, k):
+        return jax.lax.fori_loop(0, k, lambda i, acc: call(acc), a)[0, 0]
+
+    return loop
+
+
+def xla_t_loop():
+    @partial(jax.jit, static_argnums=1)
+    def loop(a, k):
+        return jax.lax.fori_loop(0, k, lambda i, acc: acc.T + 1, a)[0, 0]
+
+    return loop
+
+
+def timed(loop, a, k):
+    t0 = time.perf_counter()
+    np.asarray(loop(a, k))
+    return time.perf_counter() - t0
+
+
+def main():
+    dev = jax.devices()[0]
+    x = jax.device_put(
+        jnp.arange(N * N, dtype=jnp.int32).reshape(N, N), dev)
+
+    specs = {}
+    specs["fused1024"] = fused_transpose_loop(N, 1024)
+    specs["fused512"] = fused_transpose_loop(N, 512)
+    specs["blockperm1024"] = blockperm_loop(N, 1024)
+    specs["xla_t"] = xla_t_loop()
+    # the r03-shipped body, frozen inline: make_transpose_loop itself
+    # was changed to the double-apply fix after this probe ran, so
+    # calling it here would no longer reproduce the 330 GB/s artifact
+    # this probe exists to explain
+    def _old_call(n=N, block=1024):
+        def kernel(x_ref, out_ref):
+            out_ref[:] = x_ref[:].T
+
+        return pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct((n, n), jnp.int32),
+            grid=(n // block, n // block),
+            in_specs=[pl.BlockSpec((block, block), lambda i, j: (i, j),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((block, block), lambda i, j: (j, i),
+                                   memory_space=pltpu.VMEM),
+        )
+
+    _oc = _old_call()
+
+    @partial(jax.jit, static_argnums=1)
+    def old_loop(a, k):
+        acc = jax.lax.fori_loop(0, k, lambda i, acc: _oc(acc) + 1, a)
+        return acc[0, 0] + acc[-1, -1]
+
+    specs["old1024"] = old_loop
+
+    cols = 2048
+    rows = N * N // cols
+    specs["copy"] = po.make_scale_loop(rows, cols)
+    args = {nm: x for nm in specs}
+    args["copy"] = jax.device_put(
+        jnp.ones((rows, cols), jnp.float32), dev)
+
+    # tunnel jitter is tens of ms one-sided: the K delta must dwarf it
+    # (~1 ms/iter at ceiling => 384-iter delta ~ 0.4 s device time)
+    K_LO, K_HI = 16, 400
+    for nm, loop in specs.items():  # compile/warm both programs
+        np.asarray(loop(args[nm], K_LO))
+        np.asarray(loop(args[nm], K_HI))
+
+    slopes = {nm: [] for nm in specs}
+    for rnd in range(4):
+        for nm, loop in specs.items():
+            tlo = timed(loop, args[nm], K_LO)
+            thi = timed(loop, args[nm], K_HI)
+            slopes[nm].append((thi - tlo) / (K_HI - K_LO))
+
+    for nm in specs:
+        per = float(np.median(slopes[nm]))
+        print(f"{nm:16s} {per*1e3:8.2f} ms/iter  {NB/per/1e9:8.1f} GB/s"
+              f"  (rounds: {[f'{NB/s/1e9:.0f}' for s in slopes[nm]]})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Round-4 probe 6: WHY do square-block grids run at half the copy
+bandwidth? (probe5: blockperm-no-transpose == transpose == xla.T ==
+333 GB/s while full-width contiguous copy = 658.)
+
+Hypothesis: HBM efficiency is set by the contiguous run length of the
+block rows (square 1024-blocks => 4 KiB runs, 32 KiB stride), not by
+the transpose itself.  Sweep run length via block shape:
+
+  sqcopy_b     — square (b, b) blocks, IDENTITY map (no permutation):
+                 isolates the access pattern from the block shuffle
+  rect_rxc     — transpose with in (r, c), out (c, r) blocks: read
+                 runs c*4 B, write runs r*4 B
+  wide_in512   — in (512, 8192) full-width contiguous read slabs, out
+                 (8192, 512) transposed: contiguous reads, 2 KiB-run
+                 writes (raised VMEM limit, grid 16)
+  t2048        — square transpose, 8 KiB runs both sides (64 MiB of
+                 double-buffered VMEM, raised limit)
+  copy         — full-width 2-stream scale = ceiling
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ompi_release_tpu.ops import pallas_op as po
+
+N = 8192
+NB = 2 * N * N * 4
+
+VMEM_HI = pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024)
+
+
+def loopify(call):
+    @partial(jax.jit, static_argnums=1)
+    def loop(a, k):
+        return jax.lax.fori_loop(0, k, lambda i, acc: call(acc), a)[0, 0]
+
+    return loop
+
+
+def sqcopy(b, params=None):
+    def kernel(x_ref, out_ref):
+        out_ref[:] = x_ref[:] + 1
+
+    return loopify(pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((N, N), jnp.int32),
+        grid=(N // b, N // b),
+        in_specs=[pl.BlockSpec((b, b), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((b, b), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        **({"compiler_params": params} if params else {}),
+    ))
+
+
+def rect_t(r, c, params=None):
+    def kernel(x_ref, out_ref):
+        out_ref[:] = x_ref[:].T + 1
+
+    return loopify(pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((N, N), jnp.int32),
+        grid=(N // r, N // c),
+        in_specs=[pl.BlockSpec((r, c), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((c, r), lambda i, j: (j, i),
+                               memory_space=pltpu.VMEM),
+        **({"compiler_params": params} if params else {}),
+    ))
+
+
+def timed(loop, a, k):
+    t0 = time.perf_counter()
+    np.asarray(loop(a, k))
+    return time.perf_counter() - t0
+
+
+def main():
+    dev = jax.devices()[0]
+    x = jax.device_put(
+        jnp.arange(N * N, dtype=jnp.int32).reshape(N, N), dev)
+
+    specs = {
+        "sqcopy1024": sqcopy(1024),
+        "sqcopy512": sqcopy(512),
+        "sqcopy2048": sqcopy(2048, VMEM_HI),
+        "t2048": rect_t(2048, 2048, VMEM_HI),
+        "rect_1024x2048": rect_t(1024, 2048, VMEM_HI),
+        "wide_in512": rect_t(512, 8192, VMEM_HI),
+    }
+    cols = 2048
+    rows = N * N // cols
+    specs["copy"] = po.make_scale_loop(rows, cols)
+    args = {nm: x for nm in specs}
+    args["copy"] = jax.device_put(
+        jnp.ones((rows, cols), jnp.float32), dev)
+
+    K_LO, K_HI = 16, 400
+    ok = {}
+    for nm, loop in list(specs.items()):
+        try:
+            np.asarray(loop(args[nm], K_LO))
+            np.asarray(loop(args[nm], K_HI))
+            ok[nm] = loop
+        except Exception as e:
+            print(f"{nm}: FAILED to compile: {str(e)[:160]}")
+    specs = ok
+
+    slopes = {nm: [] for nm in specs}
+    for rnd in range(4):
+        for nm, loop in specs.items():
+            tlo = timed(loop, args[nm], K_LO)
+            thi = timed(loop, args[nm], K_HI)
+            slopes[nm].append((thi - tlo) / (K_HI - K_LO))
+
+    for nm in specs:
+        per = float(np.median(slopes[nm]))
+        print(f"{nm:16s} {per*1e3:8.2f} ms/iter  {NB/per/1e9:8.1f} GB/s"
+              f"  (rounds: {[f'{NB/s/1e9:.0f}' for s in slopes[nm]]})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Round-4 probe 7: the carry-copy hypothesis.
+
+Probe 6 eliminated access-pattern explanations (contiguous reads, 8 KiB
+runs, 1-D vs 2-D — all 333).  The one structural difference from the
+658 GB/s copy kernel: `input_output_aliases={0:0}` — in-place.  A
+fori_loop carry must live in a FIXED buffer across iterations (XLA
+while-loop buffer assignment); a non-aliased kernel writes a fresh
+buffer, so XLA inserts a copy-back of the carry every iteration:
+2N uncounted extra bytes = exactly the 2x.
+
+  sq_alias     — square-block identity copy WITH aliasing: expect ~658
+  scale_noal   — the ceiling kernel WITHOUT aliasing: expect ~333
+  dbl1024      — transpose applied TWICE per iteration (call(call(x))):
+                 call1's input buffer is dead when call2 runs, XLA can
+                 write call2's output there — carry fixed, no copy.
+                 4N bytes/iter; expect ~658 effective
+  t1024        — single transpose (today's shipped shape): 333 control
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 8192
+
+
+def sq_kernel_call(alias, transpose=False, block=1024):
+    def kernel(x_ref, out_ref):
+        out_ref[:] = (x_ref[:].T if transpose else x_ref[:]) + 1
+
+    omap = (lambda i, j: (j, i)) if transpose else (lambda i, j: (i, j))
+    return pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((N, N), jnp.int32),
+        grid=(N // block, N // block),
+        in_specs=[pl.BlockSpec((block, block), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((block, block), omap,
+                               memory_space=pltpu.VMEM),
+        **({"input_output_aliases": {0: 0}} if alias else {}),
+    )
+
+
+def loopify(body):
+    @partial(jax.jit, static_argnums=1)
+    def loop(a, k):
+        return jax.lax.fori_loop(0, k, lambda i, acc: body(acc), a)[0, 0]
+
+    return loop
+
+
+def scale_call(alias):
+    rows, cols = N * N // 2048, 2048
+    blk = 128
+
+    def kernel(x_ref, out_ref):
+        out_ref[:] = x_ref[:] * jnp.float32(1.0001)
+
+    return pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+        grid=(rows // blk,),
+        in_specs=[pl.BlockSpec((blk, cols), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((blk, cols), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        **({"input_output_aliases": {0: 0}} if alias else {}),
+    )
+
+
+def timed(loop, a, k):
+    t0 = time.perf_counter()
+    np.asarray(loop(a, k))
+    return time.perf_counter() - t0
+
+
+def main():
+    dev = jax.devices()[0]
+    xi = jax.device_put(
+        jnp.arange(N * N, dtype=jnp.int32).reshape(N, N), dev)
+    xf = jax.device_put(
+        jnp.ones((N * N // 2048, 2048), jnp.float32), dev)
+
+    t_call = sq_kernel_call(alias=False, transpose=True)
+    specs = {
+        "sq_alias": (loopify(sq_kernel_call(True)), xi, 2),
+        "scale_noal": (loopify(scale_call(False)), xf, 2),
+        "scale_alias": (loopify(scale_call(True)), xf, 2),
+        "dbl1024": (loopify(lambda a: t_call(t_call(a))), xi, 4),
+        "t1024": (loopify(t_call), xi, 2),
+    }
+
+    K_LO, K_HI = 16, 400
+    for nm, (loop, a, _) in specs.items():
+        np.asarray(loop(a, K_LO))
+        np.asarray(loop(a, K_HI))
+
+    slopes = {nm: [] for nm in specs}
+    for rnd in range(4):
+        for nm, (loop, a, _) in specs.items():
+            tlo = timed(loop, a, K_LO)
+            thi = timed(loop, a, K_HI)
+            slopes[nm].append((thi - tlo) / (K_HI - K_LO))
+
+    for nm, (_, _, streams) in specs.items():
+        nb = streams * N * N * 4
+        per = float(np.median(slopes[nm]))
+        print(f"{nm:12s} {per*1e3:8.2f} ms/iter "
+              f"{nb/per/1e9:8.1f} GB/s ({streams} streams counted)  "
+              f"(rounds: {[f'{nb/s/1e9:.0f}' for s in slopes[nm]]})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,152 @@
+// DSS — self-describing typed serialization for the control plane.
+//
+// The reference's opal/dss packs typed items into buffers that every
+// ORTE out-of-band message rides in (SURVEY §2.1 DSS). Same contract
+// here, rebuilt for the TPU framework's host control plane: each item
+// is [1-byte type][4-byte LE count][payload]; unpack verifies the type
+// tag so protocol mismatches fail loudly instead of corrupting.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+enum DssType : uint8_t {
+  DSS_INT64 = 1,
+  DSS_DOUBLE = 2,
+  DSS_STRING = 3,
+  DSS_BYTES = 4,
+};
+
+struct DssBuffer {
+  std::vector<uint8_t> data;
+  size_t cursor = 0;
+
+  void put_raw(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    data.insert(data.end(), b, b + n);
+  }
+  bool get_raw(void* out, size_t n) {
+    if (cursor + n > data.size()) return false;
+    std::memcpy(out, data.data() + cursor, n);
+    cursor += n;
+    return true;
+  }
+  void put_header(uint8_t type, uint32_t count) {
+    data.push_back(type);
+    put_raw(&count, 4);
+  }
+  bool get_header(uint8_t* type, uint32_t* count) {
+    if (cursor + 5 > data.size()) return false;
+    *type = data[cursor++];
+    return get_raw(count, 4);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dss_new() { return new DssBuffer(); }
+void dss_free(void* h) { delete static_cast<DssBuffer*>(h); }
+
+const uint8_t* dss_data(void* h) {
+  return static_cast<DssBuffer*>(h)->data.data();
+}
+int64_t dss_size(void* h) {
+  return static_cast<int64_t>(static_cast<DssBuffer*>(h)->data.size());
+}
+void dss_rewind(void* h) { static_cast<DssBuffer*>(h)->cursor = 0; }
+
+void* dss_from_bytes(const uint8_t* p, int64_t n) {
+  auto* b = new DssBuffer();
+  b->data.assign(p, p + n);
+  return b;
+}
+
+int dss_pack_int64(void* h, const int64_t* vals, int32_t count) {
+  auto* b = static_cast<DssBuffer*>(h);
+  b->put_header(DSS_INT64, count);
+  b->put_raw(vals, sizeof(int64_t) * count);
+  return 0;
+}
+
+int dss_pack_double(void* h, const double* vals, int32_t count) {
+  auto* b = static_cast<DssBuffer*>(h);
+  b->put_header(DSS_DOUBLE, count);
+  b->put_raw(vals, sizeof(double) * count);
+  return 0;
+}
+
+int dss_pack_string(void* h, const char* s) {
+  auto* b = static_cast<DssBuffer*>(h);
+  uint32_t n = static_cast<uint32_t>(std::strlen(s));
+  b->put_header(DSS_STRING, n);
+  b->put_raw(s, n);
+  return 0;
+}
+
+int dss_pack_bytes(void* h, const uint8_t* p, int32_t n) {
+  auto* b = static_cast<DssBuffer*>(h);
+  b->put_header(DSS_BYTES, n);
+  b->put_raw(p, n);
+  return 0;
+}
+
+// Peek the next item's (type, count) without consuming. -1 = end/error.
+int dss_peek(void* h, int32_t* type, int32_t* count) {
+  auto* b = static_cast<DssBuffer*>(h);
+  size_t save = b->cursor;
+  uint8_t t;
+  uint32_t c;
+  if (!b->get_header(&t, &c)) return -1;
+  b->cursor = save;
+  *type = t;
+  *count = static_cast<int32_t>(c);
+  return 0;
+}
+
+static int unpack_typed(DssBuffer* b, uint8_t want, void* out,
+                        int32_t max_count, size_t elem) {
+  size_t save = b->cursor;
+  uint8_t t;
+  uint32_t c;
+  if (!b->get_header(&t, &c)) return -1;
+  if (t != want || c > static_cast<uint32_t>(max_count)) {
+    b->cursor = save;
+    return -2;  // type mismatch: protocol error, not corruption
+  }
+  if (!b->get_raw(out, elem * c)) {
+    b->cursor = save;
+    return -1;
+  }
+  return static_cast<int>(c);
+}
+
+int dss_unpack_int64(void* h, int64_t* out, int32_t max_count) {
+  return unpack_typed(static_cast<DssBuffer*>(h), DSS_INT64, out,
+                      max_count, sizeof(int64_t));
+}
+
+int dss_unpack_double(void* h, double* out, int32_t max_count) {
+  return unpack_typed(static_cast<DssBuffer*>(h), DSS_DOUBLE, out,
+                      max_count, sizeof(double));
+}
+
+int dss_unpack_string(void* h, char* out, int32_t max_len) {
+  int n = unpack_typed(static_cast<DssBuffer*>(h), DSS_STRING, out,
+                       max_len - 1, 1);
+  if (n >= 0) out[n] = '\0';
+  return n;
+}
+
+int dss_unpack_bytes(void* h, uint8_t* out, int32_t max_len) {
+  return unpack_typed(static_cast<DssBuffer*>(h), DSS_BYTES, out,
+                      max_len, 1);
+}
+
+}  // extern "C"

@@ -1,0 +1,102 @@
+"""ompi_release_tpu — a TPU-native message-passing & collectives framework.
+
+A ground-up re-design of the capabilities of Open MPI 1.8.5 (reference
+surveyed in SURVEY.md) for TPUs: the data plane lowers to JAX/XLA
+(`psum`/`ppermute`/`all_gather` over a persistent device mesh, Pallas
+kernels where hand scheduling wins); the control plane is a lightweight
+in-process runtime with an ORTE-style job state machine.
+
+Layering (mirrors the reference's OPAL/ORTE/OMPI/OSHMEM stack, SURVEY §1):
+
+  - ``mca``/``utils``     — OPAL analogue: config vars, components, logging
+  - ``runtime``           — ORTE analogue: mesh bring-up, job state machine
+  - ``datatype``/``ops``/``comm``/``coll``/``p2p``/``osc``/``io`` — OMPI
+  - ``shmem``             — OSHMEM analogue: symmetric heap put/get
+  - ``parallel``/``models`` — parallelism strategies (DP/TP/PP/SP/EP/CP)
+    built over the substrate, with a flagship model as validation workload
+
+Heavy (jax-importing) subpackages are imported lazily so that pure-host
+config/unit tooling stays cheap, mirroring opal_init_util vs full init
+(``opal/runtime/opal_init.c:245,350``).
+"""
+
+from . import mca, utils
+from .utils.errors import ErrorCode, MPIError
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "runtime", "datatype", "ops", "comm", "coll", "p2p", "osc", "shmem",
+    "io", "parallel", "models", "tools", "obs",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def init(*, cli_args=None):
+    """Bring up the full runtime (the ``MPI_Init`` analogue).
+
+    Returns the WORLD communicator. See ``runtime.init`` for details.
+    """
+    from .runtime import init as _rt_init
+
+    return _rt_init(cli_args=cli_args)
+
+
+def finalize():
+    from .runtime import finalize as _rt_finalize
+
+    return _rt_finalize()
+
+
+def initialized() -> bool:
+    """MPI_Initialized."""
+    from .runtime.runtime import Runtime
+
+    return Runtime.is_initialized()
+
+
+def finalized() -> bool:
+    """MPI_Finalized."""
+    from .runtime.runtime import Runtime
+
+    rt = Runtime._instance
+    return bool(rt is not None and rt.finalized)
+
+
+def wtime() -> float:
+    """MPI_Wtime: monotonic wall-clock seconds."""
+    import time
+
+    return time.monotonic()
+
+
+def wtick() -> float:
+    """MPI_Wtick: the wtime clock's resolution."""
+    import time
+
+    return time.get_clock_info("monotonic").resolution
+
+
+def get_version():
+    """MPI_Get_version analogue: (framework version, reference level).
+
+    The capability level mirrors the reference's MPI-3.0-era surface
+    (the subset re-designed TPU-native; see README's inventory)."""
+    return __version__, "ompi-1.8.5-capability"
+
+
+def error_string(code) -> str:
+    """MPI_Error_string: human text for an error class."""
+    try:
+        return ErrorCode(code).name
+    except ValueError:
+        return f"unknown error code {code}"

@@ -1,0 +1,6 @@
+"""btl — pluggable device-transfer layer (ompi/mca/btl + bml analogue)."""
+
+from .base import BTL_FRAMEWORK, BmlEndpoint, BmlR2, BtlModule
+from . import components as _components  # noqa: F401  (self-register)
+
+__all__ = ["BTL_FRAMEWORK", "BmlEndpoint", "BmlR2", "BtlModule"]

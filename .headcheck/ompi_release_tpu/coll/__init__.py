@@ -1,0 +1,17 @@
+"""Collectives: SPMD kernels, host driver, framework + components,
+pipelined segmentation (:mod:`coll.pipeline`), and small-message
+fusion (:mod:`coll.fusion`)."""
+
+import importlib
+
+from . import spmd
+from .base import COLL_FRAMEWORK, OP_NAMES, comm_select
+
+__all__ = ["spmd", "COLL_FRAMEWORK", "OP_NAMES", "comm_select",
+           "pipeline", "fusion"]
+
+
+def __getattr__(name):  # lazy: pipeline/fusion pull the jax-heavy driver
+    if name in ("pipeline", "fusion"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

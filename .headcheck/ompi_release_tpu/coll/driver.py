@@ -1,0 +1,286 @@
+"""Host-side collective driver: persistent compiled programs per comm.
+
+Wraps the SPMD kernels (``coll/spmd.py``) into MPI-semantic host calls:
+inputs/outputs carry a leading ``size`` axis (slice i = rank i's
+buffer). Each (comm, operation, algorithm) pair gets ONE persistent
+jitted ``shard_map`` program, cached on the communicator — re-invoking
+with the same shapes never retraces (the "no per-call retrace"
+requirement from SURVEY §6's north star).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..utils import jaxcompat as _jaxcompat
+
+_jaxcompat.install()  # jax.shard_map on 0.4.x jaxlibs
+
+from .. import obs as _obs
+from ..mca import pvar
+from ..obs import skew as _skew
+
+_invoke_count = pvar.counter(
+    "coll_invocations", "host-driver collective invocations"
+)
+_compile_count = pvar.counter(
+    "coll_programs_compiled", "distinct compiled collective programs"
+)
+# per-invocation plan-cache outcome: observe(1) on a cache hit,
+# observe(0) on a compile — so sum/count IS the hit ratio
+# (coll_programs_compiled vs coll_invocations, as one AGGREGATE)
+_plan_cache = pvar.aggregate(
+    "coll_plan_cache_hits",
+    "plan-cache outcome per driver invocation (1=hit, 0=compile); "
+    "sum/count = hit ratio",
+)
+
+
+def _op_name(key: Tuple) -> str:
+    """Collective-op label from a program-cache key — keys are
+    (component, op, ...) tuples by convention throughout coll/."""
+    if isinstance(key, tuple) and len(key) > 1 and isinstance(key[1], str):
+        return key[1]
+    return str(key[0]) if isinstance(key, tuple) and key else str(key)
+
+
+def _arr_nbytes(x) -> int:
+    try:
+        return int(x.size) * int(x.dtype.itemsize)
+    except (AttributeError, TypeError):
+        return 0
+
+
+def _program_cache(comm) -> Dict[Tuple, Callable]:
+    cache = getattr(comm, "_coll_programs", None)
+    if cache is None:
+        cache = {}
+        comm._coll_programs = cache
+    return cache
+
+
+def run_sharded2d(comm, key: Tuple, body: Callable, x, *,
+                  inter: int, intra: int) -> Any:
+    """Like run_sharded but over a 2-D (node, local) factorization of
+    the comm's ranks: rank r = node r//intra, local r%intra (the sbgp
+    subgrouping). Used by hierarchical (ml) algorithms."""
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    _invoke_count.add()
+    tok = (_skew.begin(_op_name(key), getattr(comm, "cid", -1))
+           if _obs.enabled else None)
+    if x.shape[0] != comm.size or inter * intra != comm.size:
+        from ..utils.errors import ErrorCode, MPIError
+
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"2-D driver needs leading axis == size ({comm.size}) and "
+            f"inter*intra == size (got {inter}x{intra})",
+        )
+    cache = _program_cache(comm)
+    prog = cache.get(key)
+    _plan_cache.observe(0.0 if prog is None else 1.0)
+    if prog is None:
+        _compile_count.add()
+        devs = _np.asarray(
+            list(comm.submesh.devices.reshape(-1)), dtype=object
+        ).reshape(inter, intra)
+        mesh2d = Mesh(devs, ("node", "local"))
+
+        def wrapper(xb):
+            return body(xb[0])[None]
+
+        prog = jax.jit(
+            jax.shard_map(
+                wrapper, mesh=mesh2d,
+                in_specs=P(("node", "local")),
+                out_specs=P(("node", "local")),
+            )
+        )
+        cache[key] = prog
+    if tok is None:
+        return prog(jnp.asarray(x))
+    _skew.body(tok)
+    out = prog(jnp.asarray(x))
+    _skew.end(tok, _arr_nbytes(x))
+    return out
+
+
+def _local_rank_count(comm) -> int:
+    """Ranks of this comm whose device is addressable by THIS
+    controller (jax.distributed multi-controller SPMD mode)."""
+    pidx = jax.process_index()
+    return sum(
+        1 for d in comm.submesh.devices.reshape(-1)
+        if int(getattr(d, "process_index", 0)) == pidx
+    )
+
+
+def run_sharded_spmd(comm, key: Tuple, body: Callable, local_x) -> Any:
+    """Multi-controller SPMD mode (``jax.distributed``): every
+    controller passes only ITS ranks' leading-axis slices; the global
+    array is assembled from the per-process shards, ONE compiled
+    program runs SPMD across all controllers (XLA's cross-host
+    collectives ride ICI/DCN), and each controller receives its local
+    shard of the result back. This is the collective path the
+    single-controller driver cannot provide under ``jax.distributed``
+    — the leading-rank-axis array never materializes on one host."""
+    import numpy as _np
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as _P
+
+    _invoke_count.add()
+    tok = (_skew.begin(_op_name(key), getattr(comm, "cid", -1))
+           if _obs.enabled else None)
+    mesh = comm.submesh
+    sharding = NamedSharding(mesh, _P("rank"))
+    local_x = _np.asarray(local_x)
+    global_shape = (comm.size,) + local_x.shape[1:]
+    garr = jax.make_array_from_process_local_data(
+        sharding, local_x, global_shape
+    )
+    cache = _program_cache(comm)
+    prog = cache.get(key)
+    _plan_cache.observe(0.0 if prog is None else 1.0)
+    if prog is None:
+        _compile_count.add()
+
+        def wrapper(xb):
+            out = body(xb[0])
+            return jax.tree.map(lambda a: a[None], out)
+
+        prog = jax.jit(
+            jax.shard_map(wrapper, mesh=mesh, in_specs=P("rank"),
+                          out_specs=P("rank"))
+        )
+        cache[key] = prog
+    if tok is not None:
+        _skew.body(tok)
+    out = prog(garr)
+    if tok is not None:
+        _skew.end(tok, _arr_nbytes(local_x))
+
+    def to_local(a):
+        shards = sorted(a.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return _np.concatenate([_np.asarray(s.data) for s in shards],
+                               axis=0)
+
+    return jax.tree.map(to_local, out)
+
+
+def _check_no_narrowing(arr) -> None:
+    """MPI_DOUBLE is not MPI_FLOAT: with jax_enable_x64 off (the JAX
+    default), ``jnp.asarray`` silently narrows 64-bit host buffers to
+    32 bits — a reduction over them would return plausible-but-wrong
+    values. Refuse loudly; with x64 enabled the widths pass through
+    and this is a no-op."""
+    dt = getattr(arr, "dtype", None)
+    if dt is None:
+        return
+    try:
+        jt = jax.dtypes.canonicalize_dtype(dt)  # pure metadata, no
+    except TypeError:                           # dispatch on the hot path
+        return  # non-canonicalizable dtypes fail later with their own error
+    if np.dtype(jt).itemsize < np.dtype(dt).itemsize:
+        from ..utils.errors import ErrorCode, MPIError
+
+        raise MPIError(
+            ErrorCode.ERR_TYPE,
+            f"{np.dtype(dt).name} buffer would be silently narrowed "
+            f"to {np.dtype(jt).name} (jax_enable_x64 is off) — enable "
+            "x64 (jax.config.update('jax_enable_x64', True)) or cast "
+            "the buffer explicitly",
+        )
+
+
+def run_sharded(comm, key: Tuple, body: Callable, x, *,
+                extra_arrays: Tuple = ()) -> Any:
+    """Run ``body(block, *extra_blocks)`` under shard_map over the comm's
+    1-D ``rank`` axis. ``x`` has leading axis == comm.size; every extra
+    array is sharded the same way. Result keeps the leading rank axis.
+
+    Under a ``jax.distributed`` multi-controller runtime, a buffer
+    whose leading axis matches this controller's LOCAL rank count is
+    dispatched through :func:`run_sharded_spmd` (per-process shards in,
+    per-process shards out) — the single-controller convention cannot
+    apply there because no controller holds every rank's slice.
+    """
+    _invoke_count.add()
+    tok = (_skew.begin(_op_name(key), getattr(comm, "cid", -1))
+           if _obs.enabled else None)
+    if getattr(comm, "spans_processes", False):
+        from ..utils.errors import ErrorCode, MPIError
+
+        # the submesh covers only LOCAL members on a spanning comm:
+        # compiling over it with comm.size rows would silently place
+        # remote ranks' slices on local devices (wrong results, no
+        # error). Everything with a cross-process implementation
+        # dispatches through coll/hier or the wire — reaching this
+        # compiled in-process path is a capability boundary.
+        raise MPIError(
+            ErrorCode.ERR_NOT_AVAILABLE,
+            f"compiled in-process collective invoked on {comm.name}, "
+            "which spans controller processes — this operation has no "
+            "cross-process implementation; run it on a process-local "
+            "sub-communicator (split_type_shared)",
+        )
+    if not hasattr(x, "shape"):
+        from ..utils.errors import ErrorCode, MPIError
+
+        raise MPIError(
+            ErrorCode.ERR_TYPE,
+            "driver-mode collectives take a single array with a leading "
+            "rank axis; pair-op (value, index) tuples are supported by "
+            "allreduce/reduce/reduce_scatter_block/scan/exscan "
+            "(MINLOC/MAXLOC)",
+        )
+    if x.shape[0] != comm.size:
+        from ..utils.errors import ErrorCode, MPIError
+
+        if (jax.process_count() > 1 and not extra_arrays
+                and x.shape[0] == _local_rank_count(comm)):
+            _invoke_count.add(-1)  # the spmd entry counts this call
+            return run_sharded_spmd(comm, key, body, x)
+        raise MPIError(
+            ErrorCode.ERR_COUNT,
+            f"driver-mode buffer leading axis {x.shape[0]} != comm size "
+            f"{comm.size} (one slice per rank)",
+        )
+    for arr in (x,) + tuple(extra_arrays):
+        _check_no_narrowing(arr)
+    cache = _program_cache(comm)
+    prog = cache.get(key)
+    _plan_cache.observe(0.0 if prog is None else 1.0)
+    if prog is None:
+        _compile_count.add()
+        mesh = comm.submesh
+        n_extra = len(extra_arrays)
+
+        def wrapper(xb, *eb):
+            out = body(xb[0], *[e[0] for e in eb])
+            return jax.tree.map(lambda a: a[None], out)
+
+        prog = jax.jit(
+            jax.shard_map(
+                wrapper,
+                mesh=mesh,
+                in_specs=tuple([P("rank")] * (1 + n_extra)),
+                out_specs=P("rank"),
+            )
+        )
+        cache[key] = prog
+    if tok is None:
+        return prog(jnp.asarray(x), *[jnp.asarray(e) for e in extra_arrays])
+    # skew emit point: wait = arrival -> program launch (cache lookup /
+    # compile / validation), body = the dispatch itself
+    _skew.body(tok)
+    out = prog(jnp.asarray(x), *[jnp.asarray(e) for e in extra_arrays])
+    _skew.end(tok, _arr_nbytes(x))
+    return out

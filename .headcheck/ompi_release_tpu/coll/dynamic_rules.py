@@ -1,0 +1,231 @@
+"""tuned dynamic rule files — operator-supplied decision tables
+(``ompi/mca/coll/tuned/coll_tuned_dynamic_file.c`` +
+``coll_tuned_dynamic_rules.c`` analogue).
+
+The reference lets an operator replace tuned's compiled-in decision
+constants with a rule file mapping (collective, communicator size,
+message size) to an algorithm, selected with
+``--mca coll_tuned_use_dynamic_rules 1 --mca
+coll_tuned_dynamic_rules_filename FILE``.  Same feature here, with a
+readable line format instead of the reference's positional numeric
+one::
+
+    # collective  min_comm_size  min_msg_bytes  algorithm  [segsize]
+    allreduce     0              0              recursive_doubling
+    allreduce     0              1048576        ring       262144
+    alltoall      8              0              pairwise
+
+The LAST line whose ``min_comm_size <= comm.size`` and
+``min_msg_bytes <= message bytes`` wins (file order = increasing
+specificity, mirroring the reference's nested size tables).  An
+algorithm of ``auto`` falls through to the fixed decision constants.
+
+The optional fifth column, ``segsize``, is the pipeline segment size
+in bytes for that rule (``coll_tuned_<op>_segmentsize`` analogue,
+consumed by :mod:`coll.pipeline`): pipeline-capable algorithms (ring
+allreduce, binomial bcast/reduce) split messages into
+``ceil(bytes / segsize)`` double-buffered segments.  ``auto`` (or an
+omitted column) defers to the ``coll_pipeline_segsize`` cvar; ``0``
+disables pipelining for calls matching the rule.  Size suffixes are
+accepted (``256K``, ``1M``).  ``tpu-tune --segsizes`` sweeps this
+column and emits measured values (:mod:`tools.tpu_tune`).
+:func:`lookup_segsize` answers the segsize query with the same
+last-match-wins semantics as :func:`lookup`.
+
+``min_msg_bytes`` is measured in each collective's OWN decision
+unit — the same size its fixed decision rule tests, exactly like the
+reference (each ``*_intra_dec_fixed`` computes its own
+dsize/block_dsize/total_dsize):
+
+======== =================================================
+allreduce  bytes per rank (``block_dsize``)
+bcast      bytes per rank
+reduce     bytes per rank
+gather     bytes per rank (the per-rank block the root collects)
+scatter    bytes per DESTINATION BLOCK (per-rank / n)
+allgather  TOTAL bytes across the comm (``total_dsize``,
+           coll_tuned_decision_fixed.c:535)
+alltoall   bytes per DESTINATION BLOCK (``block_dsize``,
+           coll_tuned_decision_fixed.c:122 — per-rank / n)
+======== =================================================
+
+For reduce, a rule naming ``binomial`` on a NONCOMMUTATIVE op is
+upgraded to ``in_order_binary`` (binomial's root-relative vranks
+rotate operand order; a config file cannot waive MPI semantics).
+
+Precedence inside the tuned component: operator forcing
+(``coll_tuned_<op>_algorithm``) > dynamic rules > fixed constants —
+the reference's order (forcing checked first in
+``coll_tuned_<op>_intra_dec_dynamic``, falling back to the rule
+table, then to the fixed decisions).
+
+Unknown collectives or algorithms fail at LOAD time with the file and
+line number: a typo'd rule silently reverting to defaults would defeat
+the operator's tuning run.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..mca import var as mca_var
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+
+_log = output.Stream("coll")
+
+#: collective name -> algorithms a rule may name (filled by
+#: components.py at import; kept here to avoid a cycle)
+RULE_COLLECTIVES: Dict[str, Tuple[str, ...]] = {}
+
+# (path, mtime_ns, size) -> parsed rules; a rewritten file is
+# re-parsed, an unchanged one costs a stat per lookup.  mtime_ns +
+# size (not float mtime): some filesystems round mtime to 1 s, so a
+# rewrite landing within the same second as the first parse would
+# otherwise keep serving stale rules.  Collectives may run from
+# multiple threads; _cache_lock guards every _cache access.
+_cache: Dict[Tuple[str, int, int],
+             Dict[str, List[Tuple[int, int, str, Optional[int]]]]] = {}
+_cache_lock = threading.Lock()
+
+
+def load_rules(path: str) -> Dict[str, List[Tuple[int, int, str,
+                                                  Optional[int]]]]:
+    """Parse a rule file into {collective: [(min_n, min_bytes, alg,
+    segsize)]} preserving file order; ``segsize`` is None when the
+    fifth column is absent or ``auto`` (defer to the cvar)."""
+    try:
+        lines = open(path).read().splitlines()
+    except OSError as e:
+        raise MPIError(ErrorCode.ERR_FILE,
+                       f"cannot read dynamic rules file {path}: {e}")
+    rules: Dict[str, List[Tuple[int, int, str, Optional[int]]]] = {}
+    for lineno, line in enumerate(lines, 1):
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        if len(parts) not in (4, 5):
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"{path}:{lineno}: expected 'collective min_comm_size "
+                f"min_msg_bytes algorithm [segsize]', got '{line}'",
+            )
+        coll, n_s, bytes_s, alg = parts[:4]
+        if coll not in RULE_COLLECTIVES:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"{path}:{lineno}: unknown collective '{coll}' "
+                f"(rule-capable: {', '.join(sorted(RULE_COLLECTIVES))})",
+            )
+        try:
+            min_n, min_bytes = int(n_s), int(bytes_s)
+        except ValueError:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"{path}:{lineno}: sizes must be integers in '{line}'",
+            )
+        if min_n < 0 or min_bytes < 0:
+            raise MPIError(ErrorCode.ERR_ARG,
+                           f"{path}:{lineno}: sizes must be >= 0")
+        if alg not in RULE_COLLECTIVES[coll]:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"{path}:{lineno}: unknown {coll} algorithm '{alg}' "
+                f"(choices: {', '.join(RULE_COLLECTIVES[coll])})",
+            )
+        segsize: Optional[int] = None
+        if len(parts) == 5 and parts[4] != "auto":
+            try:
+                segsize = mca_var.parse_size(parts[4])
+            except ValueError:
+                raise MPIError(
+                    ErrorCode.ERR_ARG,
+                    f"{path}:{lineno}: segsize must be bytes (suffixes "
+                    f"K/M/G ok) or 'auto', got '{parts[4]}'",
+                )
+        rules.setdefault(coll, []).append((min_n, min_bytes, alg, segsize))
+    return rules
+
+
+def _active_rules() -> Optional[Dict[str, List[Tuple[int, int, str,
+                                                     Optional[int]]]]]:
+    """The currently configured rule table, or None when dynamic rules
+    are off / no file is configured. Handles the stat-based cache and
+    the vanished-mid-run fallback (see the comments inline)."""
+    if not mca_var.get("coll_tuned_use_dynamic_rules", False):
+        return None
+    path = mca_var.get("coll_tuned_dynamic_rules_filename", "")
+    if not path:
+        return None
+    try:
+        st = os.stat(path)
+        key = (path, st.st_mtime_ns, st.st_size)
+    except OSError as e:
+        # the file vanished MID-RUN (scratch-dir cleanup): keep
+        # serving the last successfully parsed copy rather than
+        # turning a config deletion into a crash inside the
+        # collective hot path; only a file that never parsed is fatal
+        with _cache_lock:
+            rules_for_path = next(
+                (r for (p, _, _), r in _cache.items() if p == path), None
+            )
+        if rules_for_path is None:
+            raise MPIError(ErrorCode.ERR_FILE,
+                           f"dynamic rules file {path} unreadable: {e}")
+        _log.verbose(1, f"dynamic rules file {path} vanished; "
+                        "keeping the last parsed rules")
+        key = None
+    if key is not None:
+        with _cache_lock:
+            rules_for_path = _cache.get(key)
+        if rules_for_path is None:
+            # parse BEFORE dropping the old copy (and outside the
+            # lock: load_rules may raise on a mid-run rewrite with a
+            # syntax error, and the last-good rules must stay cached
+            # so deleting the broken file falls back to them)
+            parsed = load_rules(path)
+            with _cache_lock:
+                _cache.clear()  # at most one live file; drop stale keys
+                _cache[key] = parsed
+            rules_for_path = parsed
+    return rules_for_path
+
+
+def lookup(coll: str, comm_size: int, msg_bytes: int) -> Optional[str]:
+    """The algorithm the operator's rule file picks for this call, or
+    None (no file configured / no matching rule / rule says auto)."""
+    rules = _active_rules()
+    if rules is None:
+        return None
+    picked: Optional[str] = None
+    for min_n, min_bytes, alg, _segsize in rules.get(coll, ()):
+        if comm_size >= min_n and msg_bytes >= min_bytes:
+            picked = alg
+    if picked == "auto":
+        return None
+    if picked is not None:
+        _log.verbose(3, f"dynamic rule: {coll} n={comm_size} "
+                        f"bytes={msg_bytes} -> {picked}")
+    return picked
+
+
+def lookup_segsize(coll: str, comm_size: int,
+                   msg_bytes: int) -> Optional[int]:
+    """The pipeline segment size the rule file picks for this call, or
+    None (no file / no matching rule / rule says auto) — the caller
+    (``coll/pipeline.py``) falls back to the ``coll_pipeline_segsize``
+    cvar. Last matching rule wins, same as :func:`lookup`."""
+    rules = _active_rules()
+    if rules is None:
+        return None
+    picked: Optional[int] = None
+    for min_n, min_bytes, _alg, segsize in rules.get(coll, ()):
+        if comm_size >= min_n and msg_bytes >= min_bytes:
+            picked = segsize
+    if picked is not None:
+        _log.verbose(3, f"dynamic rule: {coll} n={comm_size} "
+                        f"bytes={msg_bytes} -> segsize={picked}")
+    return picked

@@ -1,0 +1,230 @@
+"""Pipelined segmented collectives — the large-message throughput
+engine of the tuned component.
+
+The reference's tuned component takes most of its large-message wins
+from *segmentation* (``coll_tuned_<op>_segmentsize``): a collective is
+split into segments whose transfer and reduce phases overlap, keeping
+every link busy while bounding the working set. This module is the
+compiled-program analogue: messages above the ``coll_pipeline_segsize``
+cvar (or a dynamic rule's ``segsize`` column — see
+:mod:`coll.dynamic_rules`) split into K segments that run as unrolled
+chains inside ONE jitted ``shard_map`` program, **double-buffered**:
+segment s carries an ``optimization_barrier`` dependency on segment
+s-2, so at most two segments are in flight — segment s+1's transfers
+overlap segment s's combines, and the live working set stays at two
+segments, the double-buffer schedule of the reference's segmented
+algorithms (``coll_tuned_allreduce.c:636``,
+``coll_tuned_bcast.c`` pipeline).
+
+Bitwise parity with the monolithic kernels is a design invariant, not
+an accident (pinned by ``tests/test_coll_pipeline.py``):
+
+- ring allreduce segments WITHIN ring-chunk rows: the buffer is chunked
+  exactly like the monolithic ring first, then each row splits into
+  column segments, so every element keeps its chunk index — and a ring
+  element's accumulation order is a function of its chunk index alone.
+  (Contrast ``spmd.allreduce_segmented_ring``, which re-chunks each
+  segment and therefore pins its OWN order.)
+- binomial bcast/reduce segment the flat buffer: the tree schedule —
+  hence each element's combine order — never depends on the element's
+  position.
+
+Programs land in the driver's per-comm plan cache with the segment
+count appended to the key (:func:`run_pipelined`): a changed segsize
+compiles a new program, an unchanged one never retraces.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import obs as _obs
+from ..mca import pvar
+from ..mca import var as mca_var
+from ..ops.op import Op
+from . import dynamic_rules, spmd
+from . import driver as _driver
+
+#: collectives the pipeline wrapper can segment, with the algorithm it
+#: wraps (consumed by the tuned pickers and tpu_tune's segsize sweep)
+PIPELINE_CAPABLE = {
+    "allreduce": "ring",
+    "bcast": "binomial",
+    "reduce": "binomial",
+}
+
+# per-dispatch segment counts: count = pipelined calls, sum/max expose
+# the segment counts a rules file or cvar actually produced (the
+# acceptance signal for segsize tuning) — a module-level pvar bump,
+# the same zero-cost class as the driver's invocation counter
+_segments = pvar.aggregate(
+    "coll_pipeline_segments",
+    "segments per pipelined collective dispatch (count = pipelined "
+    "calls, sum/max = segment counts in effect)",
+)
+
+
+def register_vars() -> None:
+    mca_var.register(
+        "coll_pipeline_segsize", "size", 1 << 20,
+        "Per-rank bytes per pipeline segment: messages above this "
+        "split into double-buffered segments inside one compiled "
+        "program (coll_tuned_<op>_segmentsize analogue); 0 disables "
+        "pipelining; a dynamic rule's segsize column overrides this",
+    )
+    mca_var.register(
+        "coll_pipeline_max_segments", "int", 64,
+        "Upper bound on segments per pipelined collective (each "
+        "segment unrolls its own schedule into the compiled program)",
+    )
+
+
+register_vars()  # idempotent; cvars must exist before first dispatch
+
+
+# ---------------------------------------------------------------------------
+# segment-count policy (rules > cvar, mirroring tuned's precedence)
+# ---------------------------------------------------------------------------
+
+def pick_segsize(coll: str, comm_size: int, msg_bytes: int) -> int:
+    """Segment size in bytes for this call: the dynamic rule file's
+    ``segsize`` column when one matches (tuning wins), else the
+    ``coll_pipeline_segsize`` cvar. 0 = pipelining off."""
+    seg = dynamic_rules.lookup_segsize(coll, comm_size, msg_bytes)
+    if seg is None:
+        seg = int(mca_var.get("coll_pipeline_segsize", 1 << 20))
+    return seg
+
+
+def segment_count(coll: str, comm_size: int, msg_bytes: int) -> int:
+    """How many segments this message splits into (1 = monolithic)."""
+    seg = pick_segsize(coll, comm_size, msg_bytes)
+    if seg <= 0 or msg_bytes <= seg:
+        return 1
+    cap = max(1, int(mca_var.get("coll_pipeline_max_segments", 64)))
+    return min(-(-msg_bytes // seg), cap)
+
+
+# ---------------------------------------------------------------------------
+# double-buffered segment schedule
+# ---------------------------------------------------------------------------
+
+def _double_buffered(blocks: List[jax.Array],
+                     run_one: Callable[[jax.Array], jax.Array]
+                     ) -> List[jax.Array]:
+    """Run ``run_one`` over segments with at most TWO in flight:
+    segment s gains an ``optimization_barrier`` data dependency on
+    segment s-2's output, so s and s+1 overlap freely (s+1's first
+    transfer is independent of s's combines) while s+2 cannot start
+    until s retires — the double-buffer working-set bound, enforced in
+    the compiled program itself rather than hoped for from the
+    scheduler."""
+    outs: List[jax.Array] = []
+    for s, blk in enumerate(blocks):
+        if s >= 2:
+            blk, _ = lax.optimization_barrier((blk, outs[s - 2]))
+        outs.append(run_one(blk))
+    return outs
+
+
+def allreduce_ring_pipelined(x: jax.Array, op: Op, axis_name: str,
+                             n: int, nseg: int) -> jax.Array:
+    """Ring allreduce pipelined over ``nseg`` column segments of the
+    ring-chunk matrix. Chunking matches :func:`spmd.allreduce_ring`
+    exactly (rows = ring chunks), then each row splits into ``nseg``
+    column segments — every element keeps its chunk index, so the
+    per-element accumulation order (a function of the chunk index
+    alone) is bitwise-identical to the monolithic ring's."""
+    if n == 1:
+        return x
+    if nseg <= 1:
+        return spmd.allreduce_ring(x, op, axis_name, n)
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    total = flat.shape[0]
+    chunk = -(-total // n)  # ceil — same row assignment as the mono ring
+    ident = op.identity_for(dtype)
+    chunks = spmd._pad_to(flat, chunk * n, ident).reshape(n, chunk)
+    seg = -(-chunk // nseg)
+    pad = nseg * seg - chunk
+    if pad:
+        chunks = jnp.concatenate(
+            [chunks, jnp.full((n, pad), ident, dtype)], axis=1
+        )
+    segs = chunks.reshape(n, nseg, seg).transpose(1, 0, 2)  # (nseg, n, seg)
+    outs = _double_buffered(
+        [segs[s] for s in range(nseg)],
+        lambda blk: spmd._ring_passes(blk, op, axis_name, n),
+    )
+    out = jnp.stack(outs, axis=0).transpose(1, 0, 2).reshape(n, nseg * seg)
+    return out[:, :chunk].reshape(-1)[:total].reshape(shape).astype(dtype)
+
+
+def _flat_segments(x: jax.Array, nseg: int, fill) -> Tuple[List[jax.Array],
+                                                           int]:
+    """Split a buffer into ``nseg`` equal flat segments (last one
+    padded with ``fill``); returns (segments, total_elems)."""
+    flat = x.reshape(-1)
+    total = flat.shape[0]
+    seg = -(-total // nseg)
+    padded = spmd._pad_to(flat, nseg * seg, fill).reshape(nseg, seg)
+    return [padded[s] for s in range(nseg)], total
+
+
+def bcast_binomial_pipelined(x: jax.Array, axis_name: str, n: int,
+                             root: int, nseg: int) -> jax.Array:
+    """Binomial-tree bcast over double-buffered flat segments. No
+    reduction happens, so any segmentation is trivially bitwise-equal
+    to the monolithic tree; the win is the pipeline: segment s+1
+    streams down the tree while segment s is still in flight."""
+    if n == 1 or nseg <= 1:
+        return spmd.bcast_binomial(x, axis_name, n, root)
+    segs, total = _flat_segments(x, nseg, jnp.zeros((), x.dtype))
+    outs = _double_buffered(
+        segs, lambda blk: spmd.bcast_binomial(blk, axis_name, n, root)
+    )
+    return jnp.concatenate(outs)[:total].reshape(x.shape)
+
+
+def reduce_binomial_pipelined(x: jax.Array, op: Op, axis_name: str,
+                              n: int, root: int, nseg: int) -> jax.Array:
+    """Binomial-tree reduce over double-buffered flat segments. The
+    tree's combine order per element depends only on the rank pairing,
+    never on the element's position, so the segmented result is
+    bitwise-identical to the monolithic :func:`spmd.reduce_binomial`.
+    Like the monolithic kernel, non-root ranks end with partials —
+    the caller applies the root mask."""
+    if n == 1 or nseg <= 1:
+        return spmd.reduce_binomial(x, op, axis_name, n, root)
+    segs, total = _flat_segments(x, nseg, jnp.zeros((), x.dtype))
+    outs = _double_buffered(
+        segs, lambda blk: spmd.reduce_binomial(blk, op, axis_name, n, root)
+    )
+    return jnp.concatenate(outs)[:total].reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# dispatch: plan-cache key extended by the segment count
+# ---------------------------------------------------------------------------
+
+def run_pipelined(comm, key: Tuple, body: Callable, x, *, nseg: int,
+                  nbytes: int = 0, opname: str = "") -> jax.Array:
+    """Dispatch a pipelined body through the driver with the segment
+    count appended to the plan-cache key: a changed segsize compiles a
+    new program, an unchanged one re-runs the cached plan with no
+    retrace."""
+    _segments.observe(nseg)  # zero-cost pvar site (module-level)
+    full_key = key + ("pipelined", nseg)
+    if not _obs.enabled:
+        return _driver.run_sharded(comm, full_key, body, x)
+    label = opname or _driver._op_name(key)
+    t0 = time.perf_counter()
+    out = _driver.run_sharded(comm, full_key, body, x)
+    _obs.record(label, "pipeline", t0, time.perf_counter() - t0,
+                nbytes=nbytes, comm_id=getattr(comm, "cid", -1))
+    return out

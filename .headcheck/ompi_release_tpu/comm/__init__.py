@@ -1,0 +1,25 @@
+"""Communicator/group layer (ompi/communicator + ompi/group analogue)."""
+
+from .group import EMPTY, IDENT, SIMILAR, UNDEFINED, UNEQUAL, Group
+from .communicator import (
+    Communicator, Keyval, clear_comm_registry, create_keyval, free_keyval,
+)
+from .info import INFO_ENV, INFO_NULL, Info
+from .intercomm import Intercommunicator, intercomm_create
+from .dpm import (
+    open_port, close_port, publish_name, unpublish_name, lookup_name,
+    comm_accept, comm_connect,
+)
+from .spawn import SpawnedJob, comm_spawn
+from .world import create_world
+
+__all__ = [
+    "Group", "EMPTY", "IDENT", "SIMILAR", "UNEQUAL", "UNDEFINED",
+    "Communicator", "Keyval", "create_keyval", "free_keyval",
+    "clear_comm_registry", "create_world",
+    "Intercommunicator", "intercomm_create",
+    "Info", "INFO_ENV", "INFO_NULL",
+    "SpawnedJob", "comm_spawn",
+    "open_port", "close_port", "publish_name", "unpublish_name",
+    "lookup_name", "comm_accept", "comm_connect",
+]

@@ -1,0 +1,699 @@
+"""Communicators — the ``ompi/communicator`` analogue, mesh-native.
+
+A communicator binds a :class:`Group` to a sub-mesh of the world device
+mesh, carries a CID, attributes, an error handler, and — the load-
+bearing part, exactly as in the reference — a per-communicator table of
+collective implementations installed by priority query over the coll
+framework (``ompi/mca/coll/base/coll_base_comm_select.c:66-88``).
+
+Driver-mode data convention (single-controller SPMD): operations whose
+MPI result is rank-dependent take/return arrays with a leading ``size``
+axis (slice i = rank i's buffer, matching the reference's oversubscribed
+-mpirun test style, SURVEY §4); operations whose result is identical on
+every rank return it once. The in-jit SPMD API (``coll.allreduce`` under
+``shard_map``) is the performance path; this host API is the semantic
+(MPI-compatible) path and compiles one persistent program per
+(op, shape, dtype, algorithm).
+
+CID allocation: the reference runs an iterated MAX-allreduce agreement
+(``ompi/communicator/comm_cid.c:190,264-318``); under a static mesh
+with a single controller the agreement outcome is a deterministic
+monotone counter, so that is what we use.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..mca import pvar
+from ..utils import output
+from ..utils.errors import Errhandler, ErrorCode, MPIError, ERRORS_ARE_FATAL
+from .group import Group, UNDEFINED
+
+_log = output.stream("comm")
+_cid_counter = itertools.count(0)
+#: internal (runtime-private) communicators — e.g. the hier module's
+#: process-local shadow — draw NEGATIVE cids from a separate counter:
+#: their creation is conditional on local membership, so letting them
+#: consume the global counter would desynchronize cid allocation
+#: across controller processes (cids must agree SPMD-wide because the
+#: wire router addresses communicators by cid)
+_internal_cid_counter = itertools.count(-1, -1)
+_cid_lock = threading.Lock()
+_comm_registry: Dict[int, "Communicator"] = {}
+
+_comm_count = pvar.counter("comm_active_count", "live communicators")
+
+#: set on a spanning comm's progress-worker thread so collectives
+#: nested inside a worker-run operation execute directly instead of
+#: re-submitting to (and deadlocking on) the same single worker
+_nbc_tls = threading.local()
+
+#: serializes lazy FusionBuffer creation (comm.fusion_buffer): the
+#: buffer itself is thread-safe, so first use may race — an orphaned
+#: second instance would silently escape free()'s drain
+_fusion_create_lock = threading.Lock()
+
+
+def _next_cid(internal: bool = False) -> int:
+    with _cid_lock:
+        return next(_internal_cid_counter if internal else _cid_counter)
+
+
+def clear_comm_registry() -> None:
+    """Finalize-time teardown: mark every live communicator freed (so
+    stale handles raise instead of silently working) and keep the
+    comm_active_count pvar honest."""
+    for c in list(_comm_registry.values()):
+        c._freed = True
+        _comm_count.add(-1)
+    _comm_registry.clear()
+
+
+class Keyval:
+    """MPI_Comm_create_keyval analogue."""
+
+    _counter = itertools.count(0)
+
+    def __init__(self, copy_fn: Optional[Callable] = None,
+                 delete_fn: Optional[Callable] = None,
+                 extra_state: Any = None) -> None:
+        self.id = next(Keyval._counter)
+        self.copy_fn = copy_fn
+        self.delete_fn = delete_fn
+        self.extra_state = extra_state
+
+
+class Communicator:
+    is_inter = False  # Intercommunicator overrides (MPI_Comm_test_inter)
+
+    def __init__(self, runtime, group: Group, *, name: str = "",
+                 parent: Optional["Communicator"] = None,
+                 topo: Optional[Any] = None,
+                 internal: bool = False) -> None:
+        from ..runtime.mesh import build_submesh  # local: avoid cycle
+
+        self.runtime = runtime
+        self.group = group
+        self.cid = _next_cid(internal)
+        self.name = name or f"comm{self.cid}"
+        self.errhandler: Errhandler = (
+            parent.errhandler if parent else ERRORS_ARE_FATAL
+        )
+        from .info import Info
+
+        parent_info = getattr(parent, "info", None)
+        self.info: Info = (parent_info.dup() if isinstance(parent_info, Info)
+                           else Info())  # MPI_Comm_set/get_info object
+        self.topo = topo  # topology module (cart/graph), if any
+        self._attrs: Dict[int, Any] = {}
+        self._freed = False
+
+        # Local membership: under a unified multi-controller world this
+        # process owns only a span of world ranks; the submesh (and
+        # every compiled collective) covers the LOCAL members, while
+        # cross-process traffic rides the wire (hier coll + wire pml).
+        # Single-controller: every member is local and nothing changes.
+        if getattr(runtime, "unified", False):
+            off = runtime.local_rank_offset
+            cnt = runtime.local_size
+            self.local_comm_ranks = [
+                i for i, wr in enumerate(group.world_ranks)
+                if off <= wr < off + cnt
+            ]
+            self.spans_processes = len(self.local_comm_ranks) < group.size
+            local_positions = [
+                group.world_rank(i) - off for i in self.local_comm_ranks
+            ]
+        else:
+            self.local_comm_ranks = list(range(group.size))
+            self.spans_processes = False
+            local_positions = list(group.world_ranks)
+
+        # sub-mesh over this group's LOCAL devices, 1-D "rank" axis:
+        # collectives ride ICI in world-mesh order regardless of group
+        # order (a comm with no local members carries no submesh and
+        # installs no engines — its operations are never invoked here)
+        if local_positions:
+            self.submesh = build_submesh(runtime.mesh, local_positions)
+        else:
+            self.submesh = None
+
+        # per-comm collective table (c_coll analogue), installed at
+        # creation time exactly like coll_base_comm_select
+        from ..coll import base as coll_base
+
+        if self.submesh is not None:
+            self.c_coll = coll_base.comm_select(self)
+        else:
+            self.c_coll = {}
+
+        # nonblocking-progress worker for spanning comms (created on
+        # first i-collective; one worker => posting order preserved)
+        self._nbc_guard = threading.Lock()
+        self._nbc_exec = None
+
+        _comm_registry[self.cid] = self
+        _comm_count.add()
+        _log.verbose(2, f"created {self.name} cid={self.cid} size={self.size}")
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    def rank_of(self, world_rank: int) -> int:
+        return self.group.rank_of(world_rank)
+
+    @property
+    def is_self(self) -> bool:
+        return self.size == 1
+
+    def _check_alive(self) -> None:
+        if self._freed:
+            raise MPIError(ErrorCode.ERR_COMM, f"{self.name} already freed")
+
+    # -- construction ------------------------------------------------------
+    def dup(self, name: str = "") -> "Communicator":
+        self._check_alive()
+        c = Communicator(
+            self.runtime, self.group,
+            name=name or f"dup({self.name})", parent=self, topo=self.topo,
+        )
+        # MPI_Comm_dup runs attribute copy callbacks
+        for kv_id, value in list(self._attrs.items()):
+            kv = _keyval_table.get(kv_id)
+            if kv and kv.copy_fn:
+                keep, new_val = kv.copy_fn(self, kv, value, kv.extra_state)
+                if keep:
+                    c._attrs[kv_id] = new_val
+            elif kv:
+                c._attrs[kv_id] = value
+        return c
+
+    def create(self, group: Group, name: str = "") -> Optional["Communicator"]:
+        """MPI_Comm_create: new comm over a subgroup (None if empty)."""
+        self._check_alive()
+        if group.size == 0:
+            return None
+        for r in group.world_ranks:
+            if self.group.rank_of(r) == UNDEFINED:
+                raise MPIError(
+                    ErrorCode.ERR_GROUP,
+                    f"rank {r} not in parent {self.name}",
+                )
+        return Communicator(self.runtime, group, name=name, parent=self)
+
+    def split(self, colors: Sequence[int], keys: Optional[Sequence[int]] = None
+              ) -> List[Optional["Communicator"]]:
+        """MPI_Comm_split, driver mode: per-rank colors/keys vectors.
+
+        Returns one entry per local rank: the communicator that rank
+        landed in (ranks sharing a color share the object), or None for
+        color=UNDEFINED. Single-controller makes the exchange the
+        reference does (allgather of color/key) a local sort.
+        """
+        self._check_alive()
+        if len(colors) != self.size:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"need {self.size} colors, got {len(colors)}",
+            )
+        keys = list(keys) if keys is not None else [0] * self.size
+        buckets: Dict[int, List[Tuple[int, int]]] = {}
+        for local, (color, key) in enumerate(zip(colors, keys)):
+            if color == UNDEFINED:
+                continue
+            if color < 0:
+                raise MPIError(ErrorCode.ERR_ARG, f"negative color {color}")
+            buckets.setdefault(color, []).append((key, local))
+        result: List[Optional[Communicator]] = [None] * self.size
+        for color in sorted(buckets):
+            members = sorted(buckets[color])  # by (key, local-rank), MPI rule
+            g = Group([self.group.world_rank(l) for _, l in members])
+            sub = Communicator(
+                self.runtime, g,
+                name=f"split({self.name},{color})", parent=self,
+            )
+            for _, local in members:
+                result[local] = sub
+        return result
+
+    def split_type_shared(self) -> List["Communicator"]:
+        """MPI_Comm_split_type(COMM_TYPE_SHARED): group by host process."""
+        eps = {e.rank: e for e in self.runtime.endpoints}
+        colors = [
+            eps[self.group.world_rank(i)].process_index
+            for i in range(self.size)
+        ]
+        return self.split(colors)  # type: ignore[return-value]
+
+    def free(self) -> None:
+        self._check_alive()
+        fb = getattr(self, "_fusion_buffer", None)
+        if fb is not None:
+            # pending fused tensors drain before the comm dies —
+            # freeing with queued submissions is a late flush, not a
+            # lost handle
+            fb.flush()
+            self._fusion_buffer = None
+        if self._nbc_exec is not None:
+            # outstanding i-collectives must drain FIRST — before the
+            # _on_free hooks free the hier shadow comm and the cid
+            # leaves the registry, both of which a mid-flight spanning
+            # collective still uses (MPI_Comm_free after pending
+            # nonblocking ops is erroneous; draining turns it into a
+            # late completion, not a crash)
+            self._nbc_exec.shutdown(wait=True)
+            self._nbc_exec = None
+        for kv_id, value in list(self._attrs.items()):
+            kv = _keyval_table.get(kv_id)
+            if kv and kv.delete_fn:
+                kv.delete_fn(self, kv, value, kv.extra_state)
+        self._attrs.clear()
+        # runtime-private dependents (e.g. the hier module's shadow
+        # comm) registered teardown hooks: free them with their owner
+        # or they leak registry entries for the owner's lifetime
+        for cb in getattr(self, "_on_free", ()):
+            try:
+                cb()
+            except MPIError:
+                pass  # already freed
+        _comm_registry.pop(self.cid, None)
+        self._freed = True
+        _comm_count.add(-1)
+
+    # -- attributes (MPI keyvals) ------------------------------------------
+    def set_attr(self, keyval: Keyval, value: Any) -> None:
+        self._check_alive()
+        self._attrs[keyval.id] = value
+
+    def get_attr(self, keyval: Keyval) -> Tuple[bool, Any]:
+        v = self._attrs.get(keyval.id, _MISSING)
+        if v is _MISSING:
+            return False, None
+        return True, v
+
+    def delete_attr(self, keyval: Keyval) -> None:
+        v = self._attrs.pop(keyval.id, _MISSING)
+        if v is not _MISSING and keyval.delete_fn:
+            keyval.delete_fn(self, keyval, v, keyval.extra_state)
+
+    # -- errors ------------------------------------------------------------
+    def set_errhandler(self, handler: Errhandler) -> None:
+        self.errhandler = handler
+
+    def call_errhandler(self, err: MPIError) -> None:
+        self.errhandler.invoke(self, err)
+
+    def abort(self, errorcode: int = 1):
+        """MPI_Abort analogue."""
+        raise SystemExit(
+            f"MPI_Abort on {self.name} with errorcode {errorcode}"
+        )
+
+    # -- point-to-point (dispatched through the selected PML engine) -------
+    @property
+    def pml(self):
+        """Per-comm PML engine, installed on first use
+        (mca_pml_base_select analogue)."""
+        eng = getattr(self, "_pml", None)
+        if eng is None:
+            self._check_alive()
+            if self.submesh is None:
+                raise MPIError(
+                    ErrorCode.ERR_COMM,
+                    f"{self.name} has no members on this controller "
+                    "process — its operations can only be invoked on "
+                    "the processes that own its ranks",
+                )
+            from ..p2p import pml as pml_mod
+
+            eng = pml_mod.comm_select(self)
+            self._pml = eng
+        return eng
+
+    def isend(self, data, dest: int, tag: int = 0, *, rank: int, **kw):
+        """Nonblocking send issued by ``rank`` (driver mode: the acting
+        rank is explicit because one controller plays every rank)."""
+        self._check_alive()
+        return self.pml.isend(data, dest, tag, src=rank, **kw)
+
+    def send(self, data, dest: int, tag: int = 0, *, rank: int, **kw):
+        self._check_alive()
+        return self.pml.send(data, dest, tag, src=rank, **kw)
+
+    def irecv(self, source: int = -1, tag: int = -1, *, rank: int):
+        self._check_alive()
+        return self.pml.irecv(source, tag, dst=rank)
+
+    def recv(self, source: int = -1, tag: int = -1, *, rank: int):
+        self._check_alive()
+        return self.pml.recv(source, tag, dst=rank)
+
+    def iprobe(self, source: int = -1, tag: int = -1, *, rank: int):
+        self._check_alive()
+        return self.pml.iprobe(source, tag, dst=rank)
+
+    def sendrecv(self, sendbufs, dests, sendtag: int = 0,
+                 sources=None, recvtag: int = -1):
+        """MPI_Sendrecv, driver mode: EVERY rank's exchange in one call
+        (like split's per-rank vectors) — all sends post first, then
+        all recvs complete, which is what makes it deadlock-free. A
+        per-rank blocking sendrecv cannot work under a single
+        controller: rank 0's recv would block before rank 1 ever ran.
+
+        sendbufs/dests (and optional sources): sequences of length
+        ``size``. Returns (values, statuses) lists.
+        """
+        self._check_alive()
+        if self.spans_processes:
+            raise MPIError(
+                ErrorCode.ERR_NOT_AVAILABLE,
+                "driver-mode sendrecv acts as every rank at once; on a "
+                "communicator spanning controller processes use "
+                "per-rank isend/recv (each process acts only as its "
+                "local ranks)",
+            )
+        n = self.size
+        if (len(sendbufs) != n or len(dests) != n
+                or (sources is not None and len(sources) != n)):
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"sendrecv needs {n} sendbufs/dests/sources "
+                "(one per rank)",
+            )
+        sreqs = [
+            self.pml.isend(sendbufs[r], dests[r], sendtag, src=r)
+            for r in range(n)
+        ]
+        values, statuses = [], []
+        for r in range(n):
+            src = sources[r] if sources is not None else -1
+            v, st = self.pml.recv(src, recvtag, dst=r)
+            values.append(v)
+            statuses.append(st)
+        for sr in sreqs:
+            sr.wait()
+        return values, statuses
+
+    # -- collectives (dispatch through the installed c_coll table) ---------
+    def _coll(self, op_name: str) -> Callable:
+        self._check_alive()
+        fn = self.c_coll.get(op_name)
+        if fn is None:
+            raise MPIError(
+                ErrorCode.ERR_INTERN,
+                f"no {op_name} implementation installed on {self.name}",
+            )
+        if not self.spans_processes:
+            return fn
+        # spanning comms: EVERY collective funnels through the one
+        # progress worker so blocking and nonblocking calls execute in
+        # posting order on every process — their wire exchanges share
+        # one per-cid channel, and two concurrently-running collectives
+        # would interleave frames on it
+        return lambda comm_, *a, **k: self._run_serialized(
+            fn, comm_, *a, **k)
+
+    def _on_worker(self, fn, *args, **kw):
+        _nbc_tls.comm = self  # the worker serves exactly this comm
+        return fn(*args, **kw)
+
+    def _run_serialized(self, fn, *args, **kw):
+        """Run a collective through the comm's single progress worker
+        (direct when already on it — nested collectives inside a
+        worker-run op, e.g. the barrier closing a two-phase IO)."""
+        if not self.spans_processes \
+                or getattr(_nbc_tls, "comm", None) is self:
+            return fn(*args, **kw)
+        return self._nbc_pool().submit(
+            self._on_worker, fn, *args, **kw).result()
+
+    def _submit_serialized(self, fn, *args, **kw):
+        """Nonblocking variant of :meth:`_run_serialized`: returns a
+        Request backed by the worker future."""
+        from ..request.request import from_future
+
+        return from_future(self._nbc_pool().submit(
+            self._on_worker, fn, *args, **kw))
+
+    def allreduce(self, x, op=None, **kw):
+        from .. import ops as ops_mod
+
+        return self._coll("allreduce")(self, x, op or ops_mod.SUM, **kw)
+
+    def reduce(self, x, op=None, root: int = 0, **kw):
+        from .. import ops as ops_mod
+
+        return self._coll("reduce")(self, x, op or ops_mod.SUM, root, **kw)
+
+    def bcast(self, x, root: int = 0, **kw):
+        return self._coll("bcast")(self, x, root, **kw)
+
+    def allgather(self, x, **kw):
+        return self._coll("allgather")(self, x, **kw)
+
+    def gather(self, x, root: int = 0, **kw):
+        return self._coll("gather")(self, x, root, **kw)
+
+    def scatter(self, x, root: int = 0, **kw):
+        return self._coll("scatter")(self, x, root, **kw)
+
+    def reduce_scatter_block(self, x, op=None, **kw):
+        from .. import ops as ops_mod
+
+        return self._coll("reduce_scatter_block")(
+            self, x, op or ops_mod.SUM, **kw
+        )
+
+    def alltoall(self, x, **kw):
+        return self._coll("alltoall")(self, x, **kw)
+
+    def scan(self, x, op=None, **kw):
+        from .. import ops as ops_mod
+
+        return self._coll("scan")(self, x, op or ops_mod.SUM, **kw)
+
+    def exscan(self, x, op=None, **kw):
+        from .. import ops as ops_mod
+
+        return self._coll("exscan")(self, x, op or ops_mod.SUM, **kw)
+
+    def barrier(self) -> None:
+        self._coll("barrier")(self)
+
+    # -- small-message fusion (coll/fusion.py) -----------------------------
+    def fusion_buffer(self):
+        """This communicator's small-message fusion buffer (Horovod
+        fusion-buffer / BTL-coalescing analogue): collectives below
+        ``coll_fusion_threshold`` pack into one fused device
+        collective per (op, dtype). Created lazily, one per comm;
+        FusionBuffer is documented thread-safe, so first use may be
+        concurrent — creation must not orphan a racing instance."""
+        fb = getattr(self, "_fusion_buffer", None)
+        if fb is None:
+            from ..coll.fusion import FusionBuffer
+
+            with _fusion_create_lock:
+                fb = getattr(self, "_fusion_buffer", None)
+                if fb is None:
+                    fb = FusionBuffer(self)
+                    self._fusion_buffer = fb
+        return fb
+
+    def fused_allreduce(self, x, op=None):
+        """Allreduce through the fusion buffer: small tensors coalesce
+        with concurrent submissions (flush with
+        ``comm.fusion_buffer().flush()`` or the handle's ``result()``);
+        large ones dispatch immediately. Returns a
+        :class:`~..coll.fusion.FusedHandle`."""
+        return self.fusion_buffer().allreduce(x, op)
+
+    # -- v-variant collectives (per-rank counts; ragged driver edge) -------
+    def alltoallv(self, sendbufs, sendcounts):
+        """MPI_Alltoallv: ``sendbufs[i]`` holds rank i's chunks for
+        ranks 0..n-1 back to back, ``sendcounts[i][j]`` elements for
+        rank j. Returns ``recv[i]`` = chunks from each source, in
+        source order."""
+        return self._coll("alltoallv")(self, sendbufs, sendcounts)
+
+    def allgatherv(self, sendbufs):
+        """MPI_Allgatherv: ragged per-rank buffers, concatenated in
+        rank order (identical on all ranks — returned once)."""
+        return self._coll("allgatherv")(self, sendbufs)
+
+    def gatherv(self, sendbufs, root: int = 0):
+        return self._coll("gatherv")(self, sendbufs, root)
+
+    def scatterv(self, sendbuf, counts, root: int = 0):
+        """MPI_Scatterv: root's buffer split into counts[i] elements
+        per rank; returns one array per rank."""
+        return self._coll("scatterv")(self, sendbuf, counts, root)
+
+    def reduce_scatter(self, x, recvcounts, op=None):
+        """General MPI_Reduce_scatter with per-rank recv counts."""
+        from .. import ops as ops_mod
+
+        return self._coll("reduce_scatter")(
+            self, x, recvcounts, op or ops_mod.SUM
+        )
+
+    # -- nonblocking collectives (libnbc analogue) -------------------------
+    # XLA dispatch is already asynchronous: invoking the compiled
+    # collective returns immediately with arrays that are futures, so a
+    # nonblocking collective is the blocking call's result wrapped in a
+    # Request whose readiness is the arrays' readiness (the libnbc
+    # round-schedule becomes the compiled program itself).
+    def _async(self, value):
+        import jax
+
+        from ..request.request import Request
+
+        arrs = [a for a in jax.tree.leaves(value) if hasattr(a, "is_ready")]
+        req = Request(
+            ready_fn=lambda: all(a.is_ready() for a in arrs),
+            block_fn=lambda: jax.block_until_ready(value),
+        )
+        req.value = value
+        return req
+
+    def _async_call(self, fn, *args, **kw):
+        """Nonblocking collective dispatch. In-process comms: XLA
+        dispatch is already async, so call now and wrap the future
+        arrays (the compiled program IS the libnbc round schedule).
+        SPANNING comms: the hier collective's OOB exchanges block, so
+        run the whole call on the comm's nonblocking-progress worker
+        (the ``NBC_Progress`` thread analogue,
+        ``ompi/mca/coll/libnbc/nbc.c:310``) — the i-call returns
+        immediately and overlaps with user compute. ONE worker per
+        comm: outstanding collectives progress in posting order, which
+        preserves the same-order-on-every-rank collective contract
+        across processes."""
+        if not self.spans_processes:
+            return self._async(fn(*args, **kw))
+        return self._submit_serialized(fn, *args, **kw)
+
+    def _nbc_pool(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._nbc_guard:
+            if self._nbc_exec is None:
+                self._nbc_exec = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix=f"nbc-{self.name}"
+                )
+            return self._nbc_exec
+
+    def iallreduce(self, x, op=None, **kw):
+        return self._async_call(self.allreduce, x, op, **kw)
+
+    def ireduce(self, x, op=None, root: int = 0, **kw):
+        return self._async_call(self.reduce, x, op, root, **kw)
+
+    def ibcast(self, x, root: int = 0, **kw):
+        return self._async_call(self.bcast, x, root, **kw)
+
+    def iallgather(self, x, **kw):
+        return self._async_call(self.allgather, x, **kw)
+
+    def igather(self, x, root: int = 0, **kw):
+        return self._async_call(self.gather, x, root, **kw)
+
+    def iscatter(self, x, root: int = 0, **kw):
+        return self._async_call(self.scatter, x, root, **kw)
+
+    def ireduce_scatter_block(self, x, op=None, **kw):
+        return self._async_call(self.reduce_scatter_block, x, op, **kw)
+
+    def ialltoall(self, x, **kw):
+        return self._async_call(self.alltoall, x, **kw)
+
+    def iscan(self, x, op=None, **kw):
+        return self._async_call(self.scan, x, op, **kw)
+
+    def iexscan(self, x, op=None, **kw):
+        return self._async_call(self.exscan, x, op, **kw)
+
+    def ialltoallv(self, sendbufs, sendcounts):
+        return self._async_call(self.alltoallv, sendbufs, sendcounts)
+
+    def iallgatherv(self, sendbufs):
+        return self._async_call(self.allgatherv, sendbufs)
+
+    def ibarrier(self):
+        """Nonblocking barrier that really is nonblocking: the
+        compiled barrier program is dispatched asynchronously and the
+        returned request's readiness is the dispatch's readiness (the
+        reference's libnbc round schedule, ``nbc.c``, becomes the
+        compiled program; XLA async dispatch is the progress engine).
+        Providers without an async dispatch path run the blocking
+        barrier on a completion thread instead — either way ibarrier
+        returns before the barrier completes."""
+        self._check_alive()
+        fn = self.c_coll.get("ibarrier")
+        if fn is not None:
+            return self._async(fn(self))
+        if self.spans_processes:
+            # same single progress worker as the other i-collectives:
+            # an ibarrier posted between two iallreduces keeps its
+            # posting-order slot across every process
+            return self._submit_serialized(self.barrier)
+
+        import threading
+
+        from ..request.request import Request
+
+        done = threading.Event()
+        errs: list = []
+
+        def run() -> None:
+            try:
+                self.barrier()
+            except Exception as exc:  # surfaced at wait()
+                errs.append(exc)
+            finally:
+                done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+
+        def block() -> None:
+            done.wait()
+            if errs:
+                raise errs[0]
+
+        # a failed barrier must surface through test() as well as
+        # wait(): the progress hook (polled by test) raises the stored
+        # error — the MPI_ERRORS_ARE_FATAL convention this layer uses
+        # — instead of reporting completion or pending forever
+        def progress(req) -> None:
+            if done.is_set() and errs:
+                raise errs[0]
+
+        return Request(
+            progress_fn=progress,
+            ready_fn=lambda: done.is_set() and not errs,
+            block_fn=block,
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"Communicator({self.name}, cid={self.cid}, size={self.size})"
+        )
+
+
+_MISSING = object()
+_keyval_table: Dict[int, Keyval] = {}
+
+
+def create_keyval(copy_fn=None, delete_fn=None, extra_state=None) -> Keyval:
+    kv = Keyval(copy_fn, delete_fn, extra_state)
+    _keyval_table[kv.id] = kv
+    return kv
+
+
+def free_keyval(kv: Keyval) -> None:
+    _keyval_table.pop(kv.id, None)

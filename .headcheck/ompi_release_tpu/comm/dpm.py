@@ -1,0 +1,278 @@
+"""MPI-2 dynamics: connect/accept + name publish/lookup (dpm/pubsub).
+
+Reference analogues: ``ompi/mca/dpm/dpm_orte/dpm_orte.c`` (the
+connect/accept handshake over the runtime's OOB) and
+``ompi/mca/pubsub/orte/pubsub_orte.c`` (name service hosted by the
+HNP / orte-server). Here the rendezvous service has two backends:
+
+* **in-process** (singleton/driver mode): a module-level registry with
+  condition variables, so accept/connect work across threads of one
+  controller — the analogue of dpm_orte's same-job shortcut.
+* **OOB-backed** (tpurun jobs): the HNP coordinator serves
+  publish/lookup frames over the native OOB (see
+  ``runtime.coordinator.HnpCoordinator.start_name_server`` /
+  ``WorkerAgent.publish_name/lookup_name``) — the orte-server role.
+  The module-level publish/lookup/unpublish below route there
+  automatically when this process is part of a job; the standalone
+  ``tools.tpu_server`` covers names ACROSS jobs.
+
+Scope note (design honesty): the NAME service spans processes and
+jobs; the ``comm_accept``/``comm_connect`` RENDEZVOUS below forms an
+:class:`~.intercomm.Intercommunicator`, which is a single-controller
+object — so accept/connect pair up threads/comms of one controller.
+Cross-controller pairing exchanges addresses through the name service
+and then talks via the transports built for that boundary
+(``DcnBtl.send_staged`` / ``ShmBtl.send_shm`` /
+``comm.spawn.SpawnedJob`` messaging); a cross-controller device-data
+intercommunicator would be a lie in this runtime (see
+``comm/spawn.py``'s scope note).
+
+A *port* (``MPI_Open_port``) is an opaque string naming a pending
+acceptor. ``comm_accept`` registers the port and blocks (with
+timeout) until a connector arrives; ``comm_connect`` completes the
+rendezvous; both sides receive mirrored
+:class:`~.intercomm.Intercommunicator` handles over the two groups —
+exactly the reference flow where both jobs end with an
+intercommunicator whose remote group is the peer job.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+from .communicator import Communicator
+from .intercomm import Intercommunicator
+
+_log = output.stream("dpm")
+
+_port_counter = itertools.count(0)
+_lock = threading.Condition()
+
+# port -> rendezvous slot
+_pending: Dict[str, "_Rendezvous"] = {}
+# published service name -> port (MPI_Publish_name)
+_names: Dict[str, str] = {}
+
+
+class _Rendezvous:
+    """One port's accept/connect meeting point."""
+
+    def __init__(self, port: str) -> None:
+        self.port = port
+        self.acceptor: Optional[Communicator] = None
+        self.connector: Optional[Communicator] = None
+        self.building = False  # one side claimed the construction
+        self.result: Optional[Tuple[Intercommunicator,
+                                    Intercommunicator]] = None
+        self.error: Optional[BaseException] = None
+
+
+def _check_disjoint(a: Communicator, b: Communicator) -> None:
+    if set(a.group.world_ranks) & set(b.group.world_ranks):
+        raise MPIError(ErrorCode.ERR_GROUP,
+                       "connect/accept groups must be disjoint")
+
+
+def _build_intercomm(rv: _Rendezvous, runtime, acceptor: Communicator,
+                     connector: Communicator) -> None:
+    """Construct the mirrored pair OUTSIDE the lock (submesh build +
+    coll selection can be slow — unrelated ports must not stall), then
+    publish result/error under the lock. ``acceptor``/``connector``
+    are snapshots taken under the lock: the parked side may withdraw
+    (timeout) while we build."""
+    try:
+        pair = Intercommunicator.create(
+            runtime, acceptor.group, connector.group,
+            name=f"accept({rv.port})",
+        )
+    except BaseException as exc:
+        with _lock:
+            rv.error = exc
+            rv.acceptor = None
+            rv.connector = None
+            _lock.notify_all()
+        raise
+    with _lock:
+        rv.result = pair
+        _lock.notify_all()
+
+
+def _await_result(rv: _Rendezvous, deadline: float, side: str):
+    """Wait under the lock for result/error; caller holds _lock."""
+    import time
+
+    while rv.result is None and rv.error is None:
+        left = deadline - time.monotonic()
+        if left <= 0 or not _lock.wait(timeout=left):
+            if rv.result is not None or rv.error is not None:
+                break
+            # the rendezvous is DEAD, not just this side: poison the
+            # slot and retire the port, else a build completing after
+            # our withdrawal would publish a result carrying OUR group
+            # into a later retry with a different communicator
+            if side == "accept":
+                rv.acceptor = None
+            else:
+                rv.connector = None
+            err = MPIError(ErrorCode.ERR_PORT,
+                           f"{side} on '{rv.port}' timed out")
+            rv.error = err
+            _reset_slot(rv)  # port stays valid for later attempts
+            _lock.notify_all()
+            raise err
+    if rv.error is not None:
+        err = rv.error
+        _reset_slot(rv)
+        raise err
+    return rv.result
+
+
+def open_port() -> str:
+    """``MPI_Open_port``: mint an opaque port name."""
+    port = f"tpu-port:{next(_port_counter)}"
+    with _lock:
+        _pending[port] = _Rendezvous(port)
+    return port
+
+
+def close_port(port: str) -> None:
+    with _lock:
+        _pending.pop(port, None)
+
+
+def _job_agent():
+    """The tpurun WorkerAgent when this process is part of a job —
+    the public pubsub API must reach the JOB-global name table (the
+    HNP server) there, not this process's local dict (which no other
+    worker can see)."""
+    from ..runtime.runtime import Runtime
+
+    rt = Runtime._instance
+    return getattr(rt, "agent", None) if rt is not None else None
+
+
+def publish_name(service: str, port: str) -> None:
+    """``MPI_Publish_name`` (pubsub_orte: HNP-hosted name table).
+
+    Under tpurun this routes to the HNP's OOB name server so every
+    worker sees it; in singleton/driver mode the table is local."""
+    agent = _job_agent()
+    if agent is not None:
+        agent.publish_name(service, port)
+        return
+    with _lock:
+        if service in _names:
+            raise MPIError(ErrorCode.ERR_NAME,
+                           f"service '{service}' already published")
+        _names[service] = port
+        _lock.notify_all()
+
+
+def unpublish_name(service: str) -> None:
+    agent = _job_agent()
+    if agent is not None:
+        agent.unpublish_name(service)
+        return
+    with _lock:
+        if _names.pop(service, None) is None:
+            raise MPIError(ErrorCode.ERR_NAME,
+                           f"service '{service}' not published")
+
+
+def lookup_name(service: str, *, timeout_s: float = 10.0) -> str:
+    """``MPI_Lookup_name``: blocks until published (the reference's
+    pubsub lookup spins on the server) or times out."""
+    import time
+
+    agent = _job_agent()
+    if agent is not None:
+        return agent.lookup_name(service,
+                                 timeout_ms=int(timeout_s * 1000))
+    deadline = time.monotonic() + timeout_s
+    with _lock:
+        while service not in _names:
+            left = deadline - time.monotonic()
+            if left <= 0 or not _lock.wait(timeout=left):
+                if service in _names:  # published at the deadline edge
+                    break
+                raise MPIError(ErrorCode.ERR_NAME,
+                               f"service '{service}' not found")
+        return _names[service]
+
+
+def _reset_slot(rv: _Rendezvous) -> None:
+    """Replace a consumed/dead rendezvous with a fresh slot so the
+    PORT stays valid (MPI keeps a port open until MPI_Close_port — a
+    server loops accept on one published port). Only replaces if the
+    port still maps to ``rv`` (close_port may have retired it)."""
+    if _pending.get(rv.port) is rv:
+        _pending[rv.port] = _Rendezvous(rv.port)
+
+
+def _rendezvous(comm: Communicator, port: str, side: str,
+                timeout_s: float) -> Intercommunicator:
+    """The shared accept/connect protocol; ``side`` picks which slot
+    this caller fills and which handle of the pair it receives."""
+    import time
+
+    mine, theirs = (
+        ("acceptor", "connector") if side == "accept"
+        else ("connector", "acceptor")
+    )
+    deadline = time.monotonic() + timeout_s
+    with _lock:
+        rv = _pending.get(port)
+        if rv is None:
+            raise MPIError(ErrorCode.ERR_PORT, f"unknown port '{port}'")
+        if getattr(rv, mine) is not None:
+            raise MPIError(ErrorCode.ERR_PORT,
+                           f"port '{port}' already has an {mine}")
+        other = getattr(rv, theirs)
+        if other is not None:
+            _check_disjoint(comm, other)  # before registering
+        setattr(rv, mine, comm)
+        _lock.notify_all()
+        build = other is not None and not rv.building
+        if build:
+            rv.building = True
+            acceptor, connector = rv.acceptor, rv.connector
+    if build:
+        _build_intercomm(rv, comm.runtime, acceptor, connector)
+    with _lock:
+        server_side, client_side = _await_result(rv, deadline, side)
+        _reset_slot(rv)  # port stays valid for the next accept
+        return server_side if side == "accept" else client_side
+
+
+def comm_accept(comm: Communicator, port: str, *,
+                timeout_s: float = 30.0) -> Intercommunicator:
+    """``MPI_Comm_accept``: block on ``port`` until a connector
+    arrives; returns this (server) side's intercomm handle. The port
+    remains valid afterwards — a server can loop accept on one
+    published port (dpm_orte server pattern)."""
+    return _rendezvous(comm, port, "accept", timeout_s)
+
+
+def comm_connect(comm: Communicator, port: str, *,
+                 timeout_s: float = 30.0) -> Intercommunicator:
+    """``MPI_Comm_connect``: rendezvous with the acceptor on ``port``;
+    returns this (client) side's intercomm handle."""
+    return _rendezvous(comm, port, "connect", timeout_s)
+
+
+def clear() -> None:
+    """Finalize-time teardown: fail parked waiters immediately (they
+    must not sleep out their deadlines against wiped state), then drop
+    ports and names."""
+    with _lock:
+        err = MPIError(ErrorCode.ERR_PORT, "dpm torn down (finalize)")
+        for rv in _pending.values():
+            if rv.result is None and rv.error is None:
+                rv.error = err
+        _pending.clear()
+        _names.clear()
+        _lock.notify_all()

@@ -1,0 +1,611 @@
+"""Intercommunicators — ``MPI_Intercomm_create/merge`` + ``coll/inter``.
+
+Reference analogues: intercommunicator construction and merge in
+``ompi/communicator/comm.c`` (ompi_comm_create with remote group,
+ompi_intercomm_merge), inter-collective semantics in
+``ompi/mca/coll/inter/coll_inter.c``.
+
+An intercommunicator binds a *local* group and a disjoint *remote*
+group; collectives have cross-group semantics (data always flows
+between the groups, never within one). The reference implements
+inter-collectives by composing intra-collectives with a leader
+exchange (coll_inter's gather-to-leader / leader-exchange /
+bcast-from-leader pattern). TPU-native, the same composition appears
+as compiled collectives over each group's sub-mesh — the "leader
+exchange" is a device-to-device array handoff that XLA routes over
+ICI, free of host staging, and the rooted ops are single bcast/gather
+programs over the receiving group's sub-mesh.
+
+Driver-mode conventions match :class:`Communicator`: one controller
+plays every rank, so cross-group ops take both sides' buffers
+(leading axis = that group's size) and results are reported from the
+handle's perspective (what *local* ranks receive). Every
+intercommunicator is created as a mirrored pair sharing one merged
+sub-mesh; ``mirror`` is the remote side's handle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+from .communicator import Communicator
+from .group import Group
+
+_log = output.stream("comm")
+
+
+class Intercommunicator(Communicator):
+    """One side's handle of an intercommunicator pair.
+
+    ``group`` (inherited) is the LOCAL group; ``remote_group`` is the
+    other side. ``size``/``rank_of`` follow MPI: they describe the
+    local group; ``remote_size`` describes the remote group.
+    """
+
+    is_inter = True
+
+    def __init__(self, runtime, local_group: Group, remote_group: Group,
+                 *, name: str = "", parent: Optional[Communicator] = None,
+                 _bridge: Optional[Communicator] = None) -> None:
+        overlap = set(local_group.world_ranks) & set(remote_group.world_ranks)
+        if overlap:
+            raise MPIError(
+                ErrorCode.ERR_GROUP,
+                f"intercomm groups must be disjoint; overlap={sorted(overlap)}",
+            )
+        if local_group.size == 0 or remote_group.size == 0:
+            raise MPIError(ErrorCode.ERR_GROUP,
+                           "intercomm groups must be non-empty")
+        self.remote_group = remote_group
+        super().__init__(runtime, local_group, name=name, parent=parent)
+        # the bridge is an ordinary intra-communicator over
+        # local+remote in that order — the compiled union mesh both
+        # perspectives share (the coll/inter "merged" substrate)
+        if _bridge is None:
+            _bridge = Communicator(
+                runtime,
+                Group(local_group.world_ranks + remote_group.world_ranks),
+                name=f"bridge({self.name})", parent=parent,
+            )
+        self._bridge = _bridge
+        self.mirror: Optional["Intercommunicator"] = None  # set by create
+
+    # -- queries ----------------------------------------------------------
+    @property
+    def remote_size(self) -> int:
+        return self.remote_group.size
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def create(cls, runtime, group_a: Group, group_b: Group, *,
+               name: str = "", parent: Optional[Communicator] = None,
+               ) -> Tuple["Intercommunicator", "Intercommunicator"]:
+        """Build the mirrored pair (side A's handle, side B's handle)."""
+        ia = cls(runtime, group_a, group_b, name=name or "intercomm",
+                 parent=parent)
+        ib = cls(runtime, group_b, group_a, name=f"{ia.name}~mirror",
+                 parent=parent, _bridge=ia._bridge)
+        ia.mirror, ib.mirror = ib, ia
+        return ia, ib
+
+    def merge(self, high: bool = False) -> Communicator:
+        """``MPI_Intercomm_merge``: intra-communicator over the union.
+
+        ``high`` is this side's vote: the low group's ranks come first
+        (``comm.c`` ompi_intercomm_merge ordering). Mirrored handles
+        created by :meth:`create` are merged from either side.
+        """
+        self._check_alive()
+        first, second = (
+            (self.remote_group, self.group) if high
+            else (self.group, self.remote_group)
+        )
+        return Communicator(
+            self.runtime,
+            Group(first.world_ranks + second.world_ranks),
+            name=f"merge({self.name})", parent=self,
+        )
+
+    # -- inter collectives (coll/inter analogue) --------------------------
+    # All take driver-mode buffers: *_local has leading axis = local
+    # size, *_remote leading axis = remote size. Results are what the
+    # LOCAL side receives.
+    def _local_comm(self) -> Communicator:
+        c = getattr(self, "_local_intra", None)
+        if c is None:
+            c = Communicator(self.runtime, self.group,
+                             name=f"local({self.name})", parent=self)
+            self._local_intra = c
+        return c
+
+    def _remote_comm(self) -> Communicator:
+        # the mirror's local comm, so compiled programs are shared
+        if self.mirror is not None:
+            return self.mirror._local_comm()
+        c = getattr(self, "_remote_intra", None)
+        if c is None:
+            c = Communicator(self.runtime, self.remote_group,
+                             name=f"remote({self.name})", parent=self)
+            self._remote_intra = c
+        return c
+
+    def _check_counts(self, bufs, n: int, what: str) -> None:
+        if len(bufs) != n:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"{what} needs {n} per-rank buffers, got {len(bufs)}",
+            )
+
+    def barrier(self) -> None:
+        """Inter-barrier: no rank leaves until every rank of BOTH
+        groups arrived — the bridge's barrier is exactly that."""
+        self._check_alive()
+        self._bridge.barrier()
+
+    def ibarrier(self):
+        """Nonblocking inter-barrier rides the BRIDGE (both groups),
+        not the inherited local-group dispatch — an ibarrier that
+        completes without the remote group arriving would be a
+        semantic lie."""
+        self._check_alive()
+        return self._bridge.ibarrier()
+
+    # nonblocking inter variants: the blocking inter ops already
+    # dispatch asynchronously (XLA arrays are futures), so each i-op
+    # is its blocking form wrapped in a readiness Request. The
+    # inherited intra i-variants would misbind onto the inter
+    # signatures (send_local/send_remote), so every one is overridden.
+    def iallgather(self, send_local, send_remote):
+        return self._async(self.allgather(send_local, send_remote))
+
+    def iallreduce(self, send_local, send_remote, op=None):
+        return self._async(self.allreduce(send_local, send_remote, op))
+
+    def ibcast(self, x, root: int):
+        return self._async(self.bcast(x, root))
+
+    def ireduce(self, send_remote, op=None, root: int = 0):
+        return self._async(self.reduce(send_remote, op, root))
+
+    def igather(self, send_remote, root: int = 0):
+        return self._async(self.gather(send_remote, root))
+
+    def iscatter(self, sendbuf, root: int):
+        return self._async(self.scatter(sendbuf, root))
+
+    def ialltoall(self, send_local, send_remote):
+        return self._async(self.alltoall(send_local, send_remote))
+
+    def allgather(self, send_local, send_remote):
+        """Each local rank receives the remote group's buffers
+        concatenated in remote rank order (identical across local
+        ranks — returned once, driver convention)."""
+        self._check_alive()
+        self._check_counts(send_local, self.size, "allgather local")
+        self._check_counts(send_remote, self.remote_size, "allgather remote")
+        # coll_inter_allgather: intra-gather in the remote group, then
+        # deliver across. The intra-allgather runs on the remote
+        # sub-mesh; the handoff to our ranks is a device array the
+        # bridge mesh already spans. Identical on every local rank,
+        # so returned once (driver convention for uniform results).
+        return self._remote_comm().allgather(np.asarray(send_remote))[0]
+
+    def allreduce(self, send_local, send_remote, op=None):
+        """Local ranks receive the reduction of the REMOTE group's
+        contributions (MPI inter-allreduce semantics). ``send_local``
+        is what OUR ranks contribute to the remote side's result; it
+        is validated here (both handles must be well-formed on either
+        side of the intercomm) and consumed by the remote group's own
+        call."""
+        self._check_alive()
+        from .. import ops as ops_mod
+
+        self._check_counts(send_local, self.size, "allreduce local")
+        self._check_counts(send_remote, self.remote_size, "allreduce remote")
+        return self._remote_comm().allreduce(
+            np.asarray(send_remote), op or ops_mod.SUM
+        )[0]
+
+    def bcast(self, x, root: int):
+        """Root is a rank in the REMOTE group (the MPI_ROOT side);
+        local ranks receive its buffer. The rooted broadcast is a
+        bridge bcast from the remote root's bridge rank across the
+        union mesh."""
+        self._check_alive()
+        if not 0 <= root < self.remote_size:
+            raise MPIError(ErrorCode.ERR_ROOT,
+                           f"root {root} not in remote group")
+        bridge_root = self._bridge.group.rank_of(
+            self.remote_group.world_rank(root)
+        )
+        x = np.asarray(x)
+        placed = np.broadcast_to(x, (self._bridge.size,) + x.shape)
+        return self._bridge.bcast(placed, root=bridge_root)[0]
+
+    def reduce(self, send_remote, op=None, root: int = 0):
+        """Reduce the REMOTE group's contributions to local rank
+        ``root`` (this side is the root group).
+
+        Driver convention — root-agnostic result: with one controller
+        playing every local rank there is no per-rank delivery, so the
+        reduction is computed once (as a remote-group allreduce — the
+        reduction order is that allreduce's order, not a rooted-tree
+        order) and returned to the caller, who IS every local rank
+        including the root. ``root`` is range-validated so erroneous
+        programs fail identically to the reference."""
+        self._check_alive()
+        from .. import ops as ops_mod
+
+        if not 0 <= root < self.size:
+            raise MPIError(ErrorCode.ERR_ROOT,
+                           f"root {root} not in local group")
+        self._check_counts(send_remote, self.remote_size, "reduce remote")
+        return self._remote_comm().allreduce(
+            np.asarray(send_remote), op or ops_mod.SUM
+        )[0]
+
+    def gather(self, send_remote, root: int = 0):
+        """Local rank ``root`` receives the remote group's buffers in
+        remote rank order (root-group perspective). Root-agnostic
+        driver convention as in :meth:`reduce`: the gathered buffer is
+        returned once to the caller (who plays every local rank);
+        ``root`` is range-validated only."""
+        self._check_alive()
+        if not 0 <= root < self.size:
+            raise MPIError(ErrorCode.ERR_ROOT,
+                           f"root {root} not in local group")
+        self._check_counts(send_remote, self.remote_size, "gather remote")
+        return self._remote_comm().allgather(np.asarray(send_remote))[0]
+
+    def scatter(self, sendbuf, root: int):
+        """Remote rank ``root`` scatters; local ranks receive one
+        chunk each (leading axis of ``sendbuf`` = local size)."""
+        self._check_alive()
+        if not 0 <= root < self.remote_size:
+            raise MPIError(ErrorCode.ERR_ROOT,
+                           f"root {root} not in remote group")
+        sendbuf = np.asarray(sendbuf)
+        if sendbuf.shape[0] != self.size:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"scatter sendbuf leading axis {sendbuf.shape[0]} != "
+                f"local size {self.size}",
+            )
+        # the rooted delivery runs as the local sub-mesh's compiled
+        # scatter (coll_inter's bcast-then-intra pattern; the remote
+        # root's buffer is host-visible under one controller). The
+        # result stays a device array so iscatter keeps real overlap.
+        import jax.numpy as jnp
+
+        n = self.size
+        flat = sendbuf.reshape(n, -1)
+        arr = np.broadcast_to(flat.reshape(-1), (n, flat.size))
+        out = self._local_comm().scatter(arr, root=0)
+        return jnp.reshape(out, sendbuf.shape)
+
+    def alltoall(self, send_local, send_remote):
+        """Inter-alltoall: local rank i sends ``send_local[i][j]`` to
+        remote rank j; returns what local ranks receive —
+        ``recv[i][j] = send_remote[j][i]``.
+
+        Runs as the BRIDGE's compiled intra-alltoall with the
+        off-diagonal block pattern (local rows only populate remote
+        destinations and vice versa): one program over the union mesh,
+        so the result lands sharded on the union mesh like every other
+        inter op — not as a host-side transpose."""
+        self._check_alive()
+        send_local = np.asarray(send_local)
+        send_remote = np.asarray(send_remote)
+        nl, nr = self.size, self.remote_size
+        if send_local.shape[:2] != (nl, nr):
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"send_local must be (local={nl}, remote={nr}, ...), "
+                f"got {send_local.shape}",
+            )
+        if send_remote.shape[:2] != (nr, nl):
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                f"send_remote must be (remote={nr}, local={nl}, ...), "
+                f"got {send_remote.shape}",
+            )
+        if send_local.shape[2:] != send_remote.shape[2:]:
+            raise MPIError(
+                ErrorCode.ERR_ARG,
+                "send_local/send_remote chunk shapes differ: "
+                f"{send_local.shape[2:]} vs {send_remote.shape[2:]}",
+            )
+        n = nl + nr
+        trail = send_local.shape[2:]
+        full = np.zeros((n, n) + trail, send_local.dtype)
+        full[:nl, nl:] = send_local          # local rows -> remote dests
+        full[nl:, :nl] = send_remote         # remote rows -> local dests
+        # bridge alltoall convention: per-rank slice holds n chunks
+        # back to back along the leading axis. Reshape/slice stay jnp
+        # (device-side, async dispatch) so ialltoall keeps overlap.
+        import jax.numpy as jnp
+
+        out = self._bridge.alltoall(full.reshape((n, -1) + trail[1:])
+                                    if trail else full.reshape(n, n))
+        out = jnp.reshape(out, (n, n) + trail)
+        # local rank i's received remote chunks: out[i][nl:]
+        return out[:nl, nl:]
+
+    # -- point-to-point (MPI intercomm addressing) -------------------------
+    # On an intercommunicator, dest/source are ranks in the REMOTE
+    # group (MPI-2 semantics). The inherited Communicator p2p would
+    # silently deliver within the local group — wrong recipient, no
+    # error — so every p2p op translates through the bridge comm's
+    # PML: local rank -> bridge rank [0, nl), remote rank -> bridge
+    # rank [nl, nl+nr).
+    def _bridge_local(self, r: int) -> int:
+        if not 0 <= r < self.size:
+            raise MPIError(ErrorCode.ERR_RANK,
+                           f"local rank {r} out of range")
+        return self._bridge.group.rank_of(self.group.world_rank(r))
+
+    def _bridge_remote(self, r: int) -> int:
+        if not 0 <= r < self.remote_size:
+            raise MPIError(ErrorCode.ERR_RANK,
+                           f"remote rank {r} out of range")
+        return self._bridge.group.rank_of(self.remote_group.world_rank(r))
+
+    def isend(self, data, dest: int, tag: int = 0, *, rank: int, **kw):
+        return self._bridge.isend(
+            data, self._bridge_remote(dest), tag,
+            rank=self._bridge_local(rank), **kw,
+        )
+
+    def send(self, data, dest: int, tag: int = 0, *, rank: int, **kw):
+        return self._bridge.send(
+            data, self._bridge_remote(dest), tag,
+            rank=self._bridge_local(rank), **kw,
+        )
+
+    def _status_to_remote(self, status):
+        """Translate a Status carrying a bridge source rank into the
+        REMOTE-group rank MPI intercomm semantics report (a server
+        replying to status.source would otherwise address the wrong
+        process — or a nonexistent one)."""
+        if status is not None and status.source >= 0:
+            world = self._bridge.group.world_rank(status.source)
+            status.source = self.remote_group.rank_of(world)
+        return status
+
+    def irecv(self, source: int = -1, tag: int = -1, *, rank: int):
+        src = -1 if source == -1 else self._bridge_remote(source)
+        req = self._bridge.irecv(src, tag, rank=self._bridge_local(rank))
+        req.on_complete(lambda r: self._status_to_remote(r.status))
+        return req
+
+    def recv(self, source: int = -1, tag: int = -1, *, rank: int):
+        src = -1 if source == -1 else self._bridge_remote(source)
+        value, status = self._bridge.recv(
+            src, tag, rank=self._bridge_local(rank)
+        )
+        return value, self._status_to_remote(status)
+
+    def iprobe(self, source: int = -1, tag: int = -1, *, rank: int):
+        src = -1 if source == -1 else self._bridge_remote(source)
+        status = self._bridge.iprobe(
+            src, tag, rank=self._bridge_local(rank)
+        )
+        return self._status_to_remote(status)
+
+    def sendrecv(self, *a, **kw):
+        raise MPIError(
+            ErrorCode.ERR_COMM,
+            "sendrecv has no inter-communicator implementation here "
+            "(use isend/recv with remote-rank addressing)",
+        )
+
+    # intra-only operations are ERR_COMM on an intercommunicator,
+    # matching MPI (scan/exscan/split et al. require an intracomm);
+    # inter variants not yet implemented raise rather than silently
+    # running with intra semantics over the local group
+    def _intra_only(self, what: str):
+        raise MPIError(ErrorCode.ERR_COMM,
+                       f"{what} is intra-communicator only")
+
+    def scan(self, *a, **kw):
+        self._intra_only("scan")
+
+    def exscan(self, *a, **kw):
+        self._intra_only("exscan")
+
+    def iscan(self, *a, **kw):
+        self._intra_only("iscan")
+
+    def iexscan(self, *a, **kw):
+        self._intra_only("iexscan")
+
+    def split(self, *a, **kw):
+        raise MPIError(
+            ErrorCode.ERR_COMM,
+            "split on intercommunicators is not supported (use merge)",
+        )
+
+    # -- inter v-variants (ragged; results land in the group
+    # complementary to the contributors, MPI inter semantics) -------------
+    def allgatherv(self, send_local, send_remote):
+        """Local ranks receive the REMOTE group's ragged buffers
+        concatenated in remote rank order (returned once — the driver
+        convention for uniform results). ``send_local`` feeds the
+        mirrored call and is validated here."""
+        self._check_alive()
+        self._check_counts(send_local, self.size, "allgatherv local")
+        self._check_counts(send_remote, self.remote_size,
+                           "allgatherv remote")
+        return self._remote_comm().allgatherv(list(send_remote))
+
+    def gatherv(self, send_remote, root: int = 0):
+        """Local rank ``root`` receives the remote group's ragged
+        concatenation (root-agnostic driver convention, see
+        :meth:`reduce`)."""
+        self._check_alive()
+        if not 0 <= root < self.size:
+            raise MPIError(ErrorCode.ERR_ROOT,
+                           f"root {root} not in local group")
+        self._check_counts(send_remote, self.remote_size,
+                           "gatherv remote")
+        return self._remote_comm().allgatherv(list(send_remote))
+
+    def scatterv(self, sendbuf, counts, root: int = 0):
+        """Remote rank ``root`` scatters ``counts[i]`` elements to
+        local rank i (ragged chunks; one array per local rank)."""
+        self._check_alive()
+        if not 0 <= root < self.remote_size:
+            raise MPIError(ErrorCode.ERR_ROOT,
+                           f"root {root} not in remote group")
+        return self._local_comm().scatterv(
+            np.asarray(sendbuf).reshape(-1), counts, root=0
+        )
+
+    def reduce_scatter_block(self, send_remote, op=None):
+        """The remote group's contributions reduced elementwise, the
+        result split in equal blocks over the local ranks (leading
+        local axis, like the intra form)."""
+        self._check_alive()
+        import jax.numpy as jnp
+
+        from .. import ops as ops_mod
+
+        self._check_counts(send_remote, self.remote_size, "rsb remote")
+        red = np.asarray(self._remote_comm().allreduce(
+            np.asarray(send_remote), op or ops_mod.SUM
+        )[0])
+        n = self.size
+        if red.shape[0] % n:
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"reduce_scatter_block length {red.shape[0]} not "
+                f"divisible by local size {n}",
+            )
+        return jnp.asarray(red.reshape((n, -1) + red.shape[1:]))
+
+    def reduce_scatter(self, send_remote, recvcounts, op=None):
+        """General inter reduce_scatter: local rank i keeps the
+        ``recvcounts[i]``-long segment of the remote group's
+        reduction. Returns one array per local rank."""
+        self._check_alive()
+        import jax.numpy as jnp
+
+        from .. import ops as ops_mod
+
+        recvcounts = [int(c) for c in recvcounts]
+        if len(recvcounts) != self.size or any(c < 0 for c in recvcounts):
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"reduce_scatter needs {self.size} non-negative counts",
+            )
+        self._check_counts(send_remote, self.remote_size, "rs remote")
+        red = np.asarray(self._remote_comm().allreduce(
+            np.asarray(send_remote), op or ops_mod.SUM
+        )[0]).reshape(-1)
+        if red.shape[0] != sum(recvcounts):
+            raise MPIError(
+                ErrorCode.ERR_COUNT,
+                f"reduce_scatter buffer length {red.shape[0]} != "
+                f"counts sum {sum(recvcounts)}",
+            )
+        offs = np.concatenate([[0], np.cumsum(recvcounts)])
+        return [jnp.asarray(red[offs[i]:offs[i] + recvcounts[i]])
+                for i in range(self.size)]
+
+    def alltoallv(self, send_local, counts_local, send_remote,
+                  counts_remote):
+        """Inter alltoallv: local rank i sends ``counts_local[i][j]``
+        elements to remote rank j and receives remote rank j's chunk
+        for it. Returns ``recv[i]`` per local rank in remote-rank
+        order. Pure ragged edge slicing under one controller (the
+        compiled equal-block path is :meth:`alltoall`)."""
+        self._check_alive()
+        import jax.numpy as jnp
+
+        nl, nr = self.size, self.remote_size
+        self._check_counts(send_local, nl, "alltoallv local")
+        self._check_counts(send_remote, nr, "alltoallv remote")
+        cl = np.asarray(counts_local, np.int64).reshape(nl, nr)
+        cr = np.asarray(counts_remote, np.int64).reshape(nr, nl)
+        if (cl < 0).any() or (cr < 0).any():
+            raise MPIError(ErrorCode.ERR_COUNT,
+                           "alltoallv counts must be >= 0")
+        bufs_r = [np.asarray(b).reshape(-1) for b in send_remote]
+        for j in range(nr):
+            if bufs_r[j].shape[0] != int(cr[j].sum()):
+                raise MPIError(
+                    ErrorCode.ERR_COUNT,
+                    f"alltoallv remote rank {j}: buffer has "
+                    f"{bufs_r[j].shape[0]} elements, counts sum to "
+                    f"{int(cr[j].sum())}",
+                )
+        offs = np.concatenate(
+            [np.zeros((nr, 1), np.int64), np.cumsum(cr, axis=1)], axis=1
+        )
+        # no blocking barrier here: the sibling v-variants complete
+        # through their device results, and a barrier inside the
+        # blocking body would make ialltoallv synchronous
+        return [
+            jnp.asarray(np.concatenate(
+                [bufs_r[j][offs[j, i]:offs[j, i] + int(cr[j, i])]
+                 for j in range(nr)]
+            ) if nr else np.zeros(0))
+            for i in range(nl)
+        ]
+
+    def iallgatherv(self, send_local, send_remote):
+        return self._async(self.allgatherv(send_local, send_remote))
+
+    def igatherv(self, send_remote, root: int = 0):
+        return self._async(self.gatherv(send_remote, root))
+
+    def iscatterv(self, sendbuf, counts, root: int = 0):
+        return self._async(self.scatterv(sendbuf, counts, root))
+
+    def ireduce_scatter_block(self, send_remote, op=None):
+        return self._async(self.reduce_scatter_block(send_remote, op))
+
+    def ireduce_scatter(self, send_remote, recvcounts, op=None):
+        return self._async(
+            self.reduce_scatter(send_remote, recvcounts, op))
+
+    def ialltoallv(self, send_local, counts_local, send_remote,
+                   counts_remote):
+        return self._async(self.alltoallv(
+            send_local, counts_local, send_remote, counts_remote))
+
+    def __repr__(self) -> str:
+        return (
+            f"Intercommunicator({self.name}, cid={self.cid}, "
+            f"local={self.size}, remote={self.remote_size})"
+        )
+
+
+def intercomm_create(
+    local_comm: Communicator, local_leader: int,
+    peer_comm: Communicator, remote_leader: int, tag: int = 0,
+) -> Tuple[Intercommunicator, Intercommunicator]:
+    """``MPI_Intercomm_create``: bridge two disjoint intra-comms.
+
+    ``local_leader``/``remote_leader`` are ranks within each comm whose
+    peer link carries the group exchange in the reference
+    (``comm.c`` ompi_intercomm_create's leader handshake over
+    ``peer_comm``); under one controller the handshake is immediate
+    but the leaders are still validated. Returns the mirrored pair
+    (local side's handle first).
+    """
+    if not 0 <= local_leader < local_comm.size:
+        raise MPIError(ErrorCode.ERR_RANK,
+                       f"local_leader {local_leader} out of range")
+    if not 0 <= remote_leader < peer_comm.size:
+        raise MPIError(ErrorCode.ERR_RANK,
+                       f"remote_leader {remote_leader} out of range")
+    return Intercommunicator.create(
+        local_comm.runtime, local_comm.group, peer_comm.group,
+        name=f"intercomm({local_comm.name},{peer_comm.name})",
+        parent=local_comm,
+    )

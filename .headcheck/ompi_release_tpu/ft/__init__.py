@@ -1,0 +1,12 @@
+"""Fault tolerance — checkpoint/restart, failure detection, injection.
+
+The reference's FT stack (SURVEY §5): crs (process image capture),
+crcp (network quiescence before checkpoint), snapc (distributed
+snapshot orchestration), sstore (image storage), sensor/heartbeat +
+errmgr (detection/response), sensor/ft_tester (random fault
+injection).
+"""
+
+from .checkpoint import Checkpointer  # noqa: F401
+from .sensor import Heartbeat, FtTester, resource_usage  # noqa: F401
+from .errmgr import ErrMgr, run_with_restart  # noqa: F401

@@ -1,0 +1,98 @@
+"""Error management policies — the ``orte/mca/errmgr`` analogue.
+
+The reference installs a per-role policy component reacting to error
+states posted on the state machine (``errmgr_default_orted.c:118-121``);
+the TPU-native response to an unsurvivable failure is job-level
+restart-from-checkpoint (SURVEY §5: ICI failures are not survivable
+in-place), which ``run_with_restart`` implements: run the step loop,
+checkpoint on cadence, and on failure restore the last committed
+checkpoint and continue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..mca import pvar
+from ..utils import output
+from .checkpoint import Checkpointer
+from .sensor import InjectedFault
+
+_log = output.stream("errmgr")
+_restarts = pvar.counter("errmgr_restarts", "restart-from-checkpoint events")
+
+
+class ErrMgr:
+    """Callback registry per error class (policy component analogue)."""
+
+    def __init__(self) -> None:
+        self._handlers: Dict[type, List[Callable]] = {}
+
+    def register(self, exc_type: type, handler: Callable) -> None:
+        self._handlers.setdefault(exc_type, []).append(handler)
+
+    def handle(self, exc: BaseException) -> bool:
+        """Run matching handlers; True if any claimed the error."""
+        claimed = False
+        for t, hs in self._handlers.items():
+            if isinstance(exc, t):
+                for h in hs:
+                    h(exc)
+                    claimed = True
+        return claimed
+
+
+def run_with_restart(
+    step_fn: Callable[[int, Any], Any],
+    init_state: Any,
+    *,
+    num_steps: int,
+    checkpointer: Checkpointer,
+    checkpoint_every: int = 10,
+    max_restarts: int = 5,
+    recoverable: Tuple[type, ...] = (InjectedFault,),
+) -> Tuple[Any, Dict]:
+    """Drive ``state = step_fn(step, state)`` for num_steps with
+    checkpoint/restart fault tolerance.
+
+    On a recoverable failure: restore the last committed checkpoint
+    and resume from its step (deterministic replay of the collective
+    schedule — SURVEY §5's recovery model). Non-recoverable exceptions
+    propagate.
+    """
+    stats = {"restarts": 0, "failures": []}
+    start = 0
+    latest = checkpointer.latest_step()
+    state = init_state
+    if latest is not None:
+        state = checkpointer.restore(init_state, latest)
+        start = latest + 1
+        _log.verbose(1, f"resuming from checkpoint step {latest}")
+
+    step = start
+    while step < num_steps:
+        try:
+            state = step_fn(step, state)
+            if step % checkpoint_every == 0:
+                checkpointer.save(step, state)
+            step += 1
+        except recoverable as e:
+            stats["restarts"] += 1
+            stats["failures"].append((step, repr(e)))
+            _restarts.add()
+            if stats["restarts"] > max_restarts:
+                raise
+            checkpointer.abort()  # in-flight snapshot is suspect
+            latest = checkpointer.latest_step()
+            if latest is None:
+                state = init_state
+                step = 0
+            else:
+                state = checkpointer.restore(init_state, latest)
+                step = latest + 1
+            _log.verbose(
+                1, f"restarted after failure at step {stats['failures'][-1][0]}"
+                   f" -> resume at {step}"
+            )
+    checkpointer.wait()
+    return state, stats

@@ -1,0 +1,120 @@
+"""Failure detection + fault injection — the ``orte/mca/sensor``
+analogue.
+
+- Heartbeat: periodic beats with a miss limit; missing beats fires the
+  failure callback (``sensor_heartbeat.c:61,78`` check_heartbeat).
+- FtTester: probabilistic fault injection for exercising errmgr paths
+  (``sensor_ft_tester.c:67-106`` random kills, here raised as
+  InjectedFault so tests/restart loops can exercise recovery).
+- resource_usage: /proc vmsize/rss sampling (``pstat_linux_module``).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..mca import var as mca_var
+from ..utils import output
+
+_log = output.stream("sensor")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by FtTester to simulate a process failure."""
+
+
+class Heartbeat:
+    """Monitor thread: the watched party calls beat(); if more than
+    ``miss_limit`` intervals pass without one, ``on_failure`` fires."""
+
+    def __init__(self, interval_s: float = 1.0, miss_limit: int = 3,
+                 on_failure: Optional[Callable[[], None]] = None) -> None:
+        self.interval_s = interval_s
+        self.miss_limit = miss_limit
+        self.on_failure = on_failure
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._failed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    @property
+    def failed(self) -> bool:
+        return self._failed
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s / 2):
+            silent = time.monotonic() - self._last
+            if silent > self.interval_s * self.miss_limit:
+                self._failed = True
+                _log.verbose(
+                    1, f"heartbeat missed for {silent:.2f}s -> failure"
+                )
+                if self.on_failure is not None:
+                    self.on_failure()
+                return
+
+    def start(self) -> "Heartbeat":
+        # the clock starts when monitoring starts — construction-to-
+        # start delay must not count as missed beats
+        self._last = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class FtTester:
+    """Random fault injector (``sensor/ft_tester``): call maybe_fail()
+    at interesting points; with probability ``fail_prob`` it raises."""
+
+    def __init__(self, fail_prob: Optional[float] = None,
+                 seed: Optional[int] = None) -> None:
+        if fail_prob is None:
+            fail_prob = float(mca_var.get("sensor_ft_tester_prob", 0.0))
+        self.fail_prob = fail_prob
+        self._rng = random.Random(seed)
+        self.injected = 0
+
+    def maybe_fail(self, where: str = "") -> None:
+        if self._rng.random() < self.fail_prob:
+            self.injected += 1
+            _log.verbose(1, f"ft_tester: injecting fault at {where}")
+            raise InjectedFault(f"injected fault at {where or 'unknown'}")
+
+
+def register_vars() -> None:
+    mca_var.register(
+        "sensor_ft_tester_prob", "float", 0.0,
+        "Probability of injected failure per maybe_fail() call "
+        "(sensor_ft_tester.c analogue)",
+    )
+    mca_var.register(
+        "sensor_heartbeat_interval", "float", 1.0,
+        "Heartbeat period in seconds",
+    )
+
+
+def resource_usage() -> Dict[str, int]:
+    """vmsize/rss in bytes from /proc/self/status (pstat/linux)."""
+    out = {"vmsize": 0, "rss": 0}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmSize:"):
+                    out["vmsize"] = int(line.split()[1]) * 1024
+                elif line.startswith("VmRSS:"):
+                    out["rss"] = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return out

@@ -1,0 +1,13 @@
+"""Model family built on the framework's parallelism strategies.
+
+The reference ships no model layer (SURVEY §2.4); these models are the
+validation workloads its communication patterns exist to serve, and the
+flagship (``transformer.TpuLM``) exercises every strategy at once:
+dp/ep-sharded batch, pp-pipelined trunk, sp ring attention, tp-sharded
+matmuls and vocab, ep-routed experts.
+"""
+
+from .transformer import (  # noqa: F401
+    ModelConfig, init_params, param_specs, forward_loss, make_train_step,
+    make_forward,
+)

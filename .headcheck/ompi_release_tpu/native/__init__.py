@@ -1,0 +1,9 @@
+"""ctypes bindings for the native control-plane library.
+
+Builds ``native/libompitpu_native.so`` on demand (g++ is in the image;
+pybind11 is not, so the C ABI + ctypes is the binding layer).
+"""
+
+from .bindings import (  # noqa: F401
+    USER_TAG_BASE, DssBuffer, OobEndpoint, load_library,
+)

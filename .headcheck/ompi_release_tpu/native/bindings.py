@@ -1,0 +1,351 @@
+"""ctypes wrappers over the native DSS + OOB library."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple, Union
+
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+
+_log = output.stream("native")
+
+#: OOB tag space: tags below this are reserved for the control plane
+#: (coordinator wire-up 1-8, pubsub 9-12); user payload transports
+#: (staged DCN, shm handoff, spawn messaging) must use tags >= this
+USER_TAG_BASE = 100
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libompitpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building if needed) the native library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        srcs = [os.path.join(_NATIVE_DIR, f) for f in ("dss.cc", "oob.cc")]
+        if (not os.path.exists(_SO_PATH)
+                or any(os.path.getmtime(s) > os.path.getmtime(_SO_PATH)
+                       for s in srcs)):
+            _log.verbose(1, "building native control-plane library")
+            r = subprocess.run(
+                ["make", "-s", "all"], cwd=_NATIVE_DIR,
+                capture_output=True, text=True,
+            )
+            if r.returncode != 0:
+                raise MPIError(
+                    ErrorCode.ERR_OTHER,
+                    f"native build failed:\n{r.stdout}\n{r.stderr}",
+                )
+        lib = ctypes.CDLL(_SO_PATH)
+        _declare(lib)
+        _lib = lib
+        return lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    P = ctypes.c_void_p
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+
+    lib.dss_new.restype = P
+    lib.dss_free.argtypes = [P]
+    lib.dss_data.argtypes = [P]
+    lib.dss_data.restype = u8p
+    lib.dss_size.argtypes = [P]
+    lib.dss_size.restype = ctypes.c_int64
+    lib.dss_rewind.argtypes = [P]
+    lib.dss_from_bytes.argtypes = [u8p, ctypes.c_int64]
+    lib.dss_from_bytes.restype = P
+    lib.dss_pack_int64.argtypes = [P, i64p, ctypes.c_int32]
+    lib.dss_pack_double.argtypes = [P, f64p, ctypes.c_int32]
+    lib.dss_pack_string.argtypes = [P, ctypes.c_char_p]
+    lib.dss_pack_bytes.argtypes = [P, u8p, ctypes.c_int32]
+    lib.dss_peek.argtypes = [P, i32p, i32p]
+    lib.dss_unpack_int64.argtypes = [P, i64p, ctypes.c_int32]
+    lib.dss_unpack_double.argtypes = [P, f64p, ctypes.c_int32]
+    lib.dss_unpack_string.argtypes = [P, ctypes.c_char_p, ctypes.c_int32]
+    lib.dss_unpack_bytes.argtypes = [P, u8p, ctypes.c_int32]
+
+    lib.oob_create.argtypes = [ctypes.c_int32, ctypes.c_int]
+    lib.oob_create.restype = P
+    lib.oob_create_bound.argtypes = [ctypes.c_int32, ctypes.c_int,
+                                     ctypes.c_char_p]
+    lib.oob_create_bound.restype = P
+    lib.oob_port.argtypes = [P]
+    lib.oob_port.restype = ctypes.c_int
+    lib.oob_connect.argtypes = [P, ctypes.c_int32, ctypes.c_char_p,
+                                ctypes.c_int]
+    lib.oob_connect.restype = ctypes.c_int
+    lib.oob_add_route.argtypes = [P, ctypes.c_int32, ctypes.c_int32]
+    lib.oob_send.argtypes = [P, ctypes.c_int32, ctypes.c_int32, u8p,
+                             ctypes.c_int32]
+    lib.oob_send.restype = ctypes.c_int
+    lib.oob_recv.argtypes = [P, i32p, i32p, u8p, ctypes.c_int32,
+                             ctypes.c_int]
+    lib.oob_recv.restype = ctypes.c_int
+    lib.oob_pending.argtypes = [P]
+    lib.oob_pending.restype = ctypes.c_int
+    lib.oob_ttl_dropped.argtypes = [P]
+    lib.oob_ttl_dropped.restype = ctypes.c_int
+    lib.oob_create_auth.argtypes = [ctypes.c_int32, ctypes.c_int,
+                                    ctypes.c_char_p, u8p,
+                                    ctypes.c_int32]
+    lib.oob_create_auth.restype = P
+    lib.oob_auth_rejected.argtypes = [P]
+    lib.oob_auth_rejected.restype = ctypes.c_int
+    lib.oob_next_len.argtypes = [P, ctypes.c_int32, ctypes.c_int]
+    lib.oob_next_len.restype = ctypes.c_int
+    lib.oob_destroy.argtypes = [P]
+
+
+def _u8(data: bytes):
+    return ctypes.cast(
+        ctypes.create_string_buffer(data, len(data)),
+        ctypes.POINTER(ctypes.c_uint8),
+    )
+
+
+class DssBuffer:
+    """Typed pack/unpack buffer (opal/dss analogue)."""
+
+    TYPES = {1: "int64", 2: "double", 3: "string", 4: "bytes"}
+
+    def __init__(self, raw: Optional[bytes] = None) -> None:
+        self._lib = load_library()
+        if raw is None:
+            self._h = self._lib.dss_new()
+        else:
+            self._h = self._lib.dss_from_bytes(_u8(raw), len(raw))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dss_free(self._h)
+            self._h = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- pack --------------------------------------------------------------
+    def pack_int64(self, vals: Union[int, List[int]]) -> "DssBuffer":
+        vals = [vals] if isinstance(vals, int) else list(vals)
+        arr = (ctypes.c_int64 * len(vals))(*vals)
+        self._lib.dss_pack_int64(self._h, arr, len(vals))
+        return self
+
+    def pack_double(self, vals: Union[float, List[float]]) -> "DssBuffer":
+        vals = [vals] if isinstance(vals, float) else list(vals)
+        arr = (ctypes.c_double * len(vals))(*vals)
+        self._lib.dss_pack_double(self._h, arr, len(vals))
+        return self
+
+    def pack_string(self, s: str) -> "DssBuffer":
+        self._lib.dss_pack_string(self._h, s.encode())
+        return self
+
+    def pack_bytes(self, b: bytes) -> "DssBuffer":
+        self._lib.dss_pack_bytes(self._h, _u8(b), len(b))
+        return self
+
+    # -- unpack ------------------------------------------------------------
+    def peek(self) -> Optional[Tuple[str, int]]:
+        t = ctypes.c_int32()
+        c = ctypes.c_int32()
+        if self._lib.dss_peek(self._h, ctypes.byref(t),
+                              ctypes.byref(c)) != 0:
+            return None
+        return self.TYPES.get(t.value, "?"), c.value
+
+    def _check(self, n: int, what: str) -> int:
+        if n == -2:
+            raise MPIError(
+                ErrorCode.ERR_TYPE,
+                f"dss unpack type mismatch: next item is "
+                f"{self.peek()}, wanted {what}",
+            )
+        if n < 0:
+            raise MPIError(ErrorCode.ERR_TRUNCATE,
+                           f"dss buffer exhausted unpacking {what}")
+        return n
+
+    def unpack_int64(self, max_count: int = 1_048_576) -> List[int]:
+        arr = (ctypes.c_int64 * max_count)()
+        n = self._check(
+            self._lib.dss_unpack_int64(self._h, arr, max_count), "int64"
+        )
+        return list(arr[:n])
+
+    def unpack_double(self, max_count: int = 1_048_576) -> List[float]:
+        arr = (ctypes.c_double * max_count)()
+        n = self._check(
+            self._lib.dss_unpack_double(self._h, arr, max_count), "double"
+        )
+        return list(arr[:n])
+
+    def unpack_string(self, max_len: int = 1 << 20) -> str:
+        buf = ctypes.create_string_buffer(max_len)
+        self._check(
+            self._lib.dss_unpack_string(self._h, buf, max_len), "string"
+        )
+        return buf.value.decode()
+
+    def unpack_bytes(self, max_len: int = 1 << 26) -> bytes:
+        arr = (ctypes.c_uint8 * max_len)()
+        n = self._check(
+            self._lib.dss_unpack_bytes(self._h, arr, max_len), "bytes"
+        )
+        return bytes(arr[:n])
+
+    # -- raw ---------------------------------------------------------------
+    def tobytes(self) -> bytes:
+        n = self._lib.dss_size(self._h)
+        p = self._lib.dss_data(self._h)
+        return ctypes.string_at(p, n)  # one memcpy, not a Python loop
+
+    def rewind(self) -> None:
+        self._lib.dss_rewind(self._h)
+
+
+#: env var carrying the per-job control-plane secret (minted by tpurun,
+#: inherited by every worker it launches) — see SECRET_ENV consumers in
+#: tools/tpurun.py and tools/tpu_server.py
+SECRET_ENV = "OMPITPU_JOB_SECRET"
+
+
+class OobEndpoint:
+    """Tagged TCP messaging endpoint with tree routing (oob/rml/routed
+    analogue).
+
+    Authentication (``opal/mca/sec`` analogue): when ``secret`` is
+    given — or ``OMPITPU_JOB_SECRET`` is set, which tpurun exports to
+    every worker — inbound connections must answer a fresh-nonce
+    SipHash challenge before any of their frames are accepted, and
+    outbound connects answer the peer's challenge. ``secret=b""``
+    explicitly disables auth regardless of the environment."""
+
+    def __init__(self, node_id: int, port: int = 0,
+                 bind_addr: str = "127.0.0.1",
+                 secret: Optional[bytes] = None) -> None:
+        import os as _os
+
+        self._lib = load_library()
+        if secret is None:
+            env = _os.environ.get(SECRET_ENV, "")
+            secret = env.encode() if env else b""
+        # the secret rides the CREATE call: installed before the
+        # listener accepts its first connection, so there is no window
+        # in which an unauthenticated connection can be admitted
+        self._h = self._lib.oob_create_auth(
+            node_id, port, bind_addr.encode(),
+            _u8(secret) if secret else None, len(secret),
+        )
+        if not self._h:
+            raise MPIError(ErrorCode.ERR_OTHER,
+                           f"oob_create failed ({bind_addr}:{port})")
+        self.node_id = node_id
+
+    def auth_rejected(self) -> int:
+        """Inbound connections refused by the auth challenge."""
+        return self._lib.oob_auth_rejected(self._handle())
+
+    def _handle(self):
+        """The live native handle; a closed endpoint raises a clean
+        MPIError instead of handing NULL to the C layer (which
+        segfaults — observed via use-after-close in spawn teardown)."""
+        h = self._h
+        if not h:
+            raise MPIError(ErrorCode.ERR_OTHER,
+                           "oob endpoint is closed")
+        return h
+
+    @property
+    def port(self) -> int:
+        return self._lib.oob_port(self._handle())
+
+    def connect(self, peer_id: int, host: str, port: int) -> None:
+        if self._lib.oob_connect(self._handle(), peer_id, host.encode(),
+                                 port) != 0:
+            raise MPIError(
+                ErrorCode.ERR_OTHER,
+                f"oob connect to node {peer_id} at {host}:{port} failed",
+            )
+
+    def add_route(self, dst: int, via: int) -> None:
+        self._lib.oob_add_route(self._handle(), dst, via)
+
+    def set_default_route(self, via: int) -> None:
+        self._lib.oob_add_route(self._handle(), -1, via)
+
+    def send(self, dst: int, tag: int, payload: bytes) -> None:
+        if self._lib.oob_send(self._handle(), dst, tag, _u8(payload),
+                              len(payload)) != 0:
+            raise MPIError(
+                ErrorCode.ERR_OTHER,
+                f"oob send to {dst} failed (no connection or route)",
+            )
+
+    def recv(self, tag: int = -1,
+             timeout_ms: int = 10_000) -> Tuple[int, int, bytes]:
+        """Returns (src, tag, payload); raises on timeout.
+
+        The buffer is sized from the queued frame's actual length
+        (oob_next_len) instead of a worst-case allocation. A concurrent
+        consumer of the same tag can race the size query; the -2 retry
+        loop below re-sizes and tries again. One deadline bounds the
+        whole call — retries never extend it past timeout_ms.
+        """
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_ms / 1000
+        while True:
+            left = max(1, int((deadline - _time.monotonic()) * 1000))
+            n = self._lib.oob_next_len(self._handle(), tag, left)
+            if n < 0:
+                raise MPIError(ErrorCode.ERR_PENDING,
+                               f"oob recv timeout (tag {tag})")
+            src = ctypes.c_int32()
+            tg = ctypes.c_int32(tag)
+            arr = (ctypes.c_uint8 * max(n, 1))()
+            left = max(1, int((deadline - _time.monotonic()) * 1000))
+            got = self._lib.oob_recv(self._handle(), ctypes.byref(src),
+                                     ctypes.byref(tg), arr, n, left)
+            if got == -2:
+                continue  # raced with another consumer; re-size
+            if got == -1:
+                raise MPIError(ErrorCode.ERR_PENDING,
+                               f"oob recv timeout (tag {tag})")
+            return src.value, tg.value, ctypes.string_at(arr, got)
+
+    def ttl_dropped(self) -> int:
+        """Frames dropped by the routing-cycle ttl guard."""
+        return self._lib.oob_ttl_dropped(self._handle())
+
+    def pending(self) -> int:
+        return self._lib.oob_pending(self._handle())
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.oob_destroy(self._h)
+            self._h = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,106 @@
+"""Always-on observability plane — journal, skew metrics, exporters.
+
+The reference instruments itself at every layer (MPI_T pvars, PERUSE
+events, PMPI interposition, orte-top sampling); this package is the
+TPU-native unification: emit points *inside* the framework (coll
+driver, vcoll edge, pml, btl, request wait, sharded IO) write spans
+into one ring-buffer journal (:mod:`obs.journal`) and bump per-op /
+per-BTL histogram, aggregate, and rank-skew pvars
+(:mod:`obs.skew`), all readable through the existing MPI_T handles
+(``mca/mpit.py``) and exportable as Chrome/Perfetto ``trace_event``
+JSON, JSONL, or Prometheus text (:mod:`obs.export`).
+
+Switching on (any one of):
+
+  - env var ``OMPI_TPU_OBS=1`` (read at import)
+  - MCA cvar ``obs_enable`` (``OMPITPU_MCA_obs_enable=1``)
+  - :func:`enable` at runtime
+
+The hot-path cost when off is a single module-attribute check
+(``obs.enabled``) per instrumented call site — no locks, no clock
+reads, no allocation. ``python -m ompi_release_tpu.obs --selftest``
+exercises every pvar class and exporter round-trip, device-free.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..mca import pvar as _pvar
+from ..mca import var as _var
+from . import journal as journal_mod
+from .journal import Journal, Span  # noqa: F401  (public API)
+
+#: THE hot-path gate: emit points check ``obs.enabled`` and do nothing
+#: else when False. One module attribute, mutated only by
+#: enable()/disable().
+enabled: bool = False
+
+#: process-global journal (identity is stable across enable/resize)
+journal = journal_mod.JOURNAL
+
+
+def register_vars() -> None:
+    _var.register(
+        "obs_enable", "bool", False,
+        "Enable the observability plane (event journal + per-op "
+        "histogram/skew pvars) at import — same effect as "
+        "OMPI_TPU_OBS=1 or obs.enable()",
+    )
+    _var.register(
+        "obs_journal_size", "size", journal_mod.DEFAULT_SIZE,
+        "Ring-buffer event-journal capacity in spans (oldest spans are "
+        "overwritten); applied when obs.enable() runs",
+    )
+
+
+register_vars()  # idempotent; cvars must exist before any enable()
+
+_pvar.PVARS.register(
+    "obs_journal_events", _pvar.PvarClass.COUNTER,
+    "spans ever recorded in the obs event journal",
+    getter=lambda: journal.total_recorded,
+)
+_pvar.PVARS.register(
+    "obs_journal_dropped", _pvar.PvarClass.COUNTER,
+    "journal spans lost to ring wrap (raise obs_journal_size)",
+    getter=lambda: journal.dropped,
+)
+
+
+def enable(size: int = None) -> None:
+    """Turn the plane on; the journal takes ``obs_journal_size`` (or
+    the explicit ``size``) without losing already-buffered spans."""
+    global enabled
+    if size is None:
+        size = int(_var.get("obs_journal_size", journal_mod.DEFAULT_SIZE))
+    if int(size) != journal.size:
+        journal.resize(int(size))
+    enabled = True
+
+
+def disable() -> None:
+    global enabled
+    enabled = False
+
+
+def is_enabled() -> bool:
+    return enabled
+
+
+def record(op: str, layer: str, t_start: float, dt: float,
+           nbytes: int = 0, peer: int = -1, comm_id: int = -1) -> Span:
+    """Emit-point helper: journal one span. Callers gate on
+    ``obs.enabled`` themselves so the off cost stays one attr check."""
+    return journal.record(op, layer, t_start, dt, nbytes, peer, comm_id)
+
+
+# the always-on switch: env var wins, then the MCA cvar
+if (os.environ.get("OMPI_TPU_OBS", "").strip().lower()
+        in ("1", "true", "yes", "on")
+        or bool(_var.get("obs_enable", False))):
+    enable()
+
+# convenience: obs.export.dump_chrome_trace(...), obs.skew — imported
+# last so their journal/pvar imports see a fully-initialized package
+from . import export, skew  # noqa: E402,F401

@@ -1,0 +1,137 @@
+"""``python -m ompi_release_tpu.obs`` — observability selftest.
+
+``--selftest`` registers one pvar of every class, bumps each, drives
+the journal through a ring wrap, runs a skew-timer cycle, exports
+through every exporter, and verifies the round-trip — device-free and
+fast, so the tier-1 suite can run it as a subprocess smoke test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def selftest() -> int:
+    from ..mca import mpit, pvar
+    from . import disable, enable, journal
+    from . import export, skew
+
+    # 1. every pvar class: register, bump, read
+    c = pvar.counter("obs_selftest_counter", "selftest")
+    c.add(2)
+    t = pvar.timer("obs_selftest_timer", "selftest")
+    with t.timing():
+        pass
+    hw = pvar.highwatermark("obs_selftest_hwm", "selftest")
+    hw.set(5)
+    hw.set(3)
+    assert hw.read() == 5, "highwatermark must keep the max"
+    hist = pvar.histogram("obs_selftest_hist", "selftest")
+    for v in (0.0, 1e-4, 3.0, 4.0, 1024.0):
+        hist.observe(v)
+    snap = hist.read()
+    assert snap["count"] == 5 and snap["max"] == 1024.0, snap
+    assert sum(snap["buckets"].values()) == 5, snap
+    agg = pvar.aggregate("obs_selftest_agg", "selftest")
+    agg.observe(2.0)
+    agg.observe(-1.0)
+    a = agg.read()
+    assert a["count"] == 2 and a["min"] == -1.0 and a["max"] == 2.0, a
+
+    # 2. MPI_T session round-trip: session-relative deltas per class
+    sess = mpit.Mpit().pvar_session()
+    hc = sess.handle("obs_selftest_counter")
+    hc.start()
+    c.add(3)
+    assert hc.read() == 3.0, hc.read()
+    hh = sess.handle("obs_selftest_hist")
+    hh.start()
+    hist.observe(7.0)
+    d = hh.read()
+    assert d["count"] == 1.0 and d["sum"] == 7.0, d
+    assert sum(d["buckets"].values()) == 1.0, d
+    ha = sess.handle("obs_selftest_agg")
+    ha.start()
+    ha.reset()
+    assert ha.read()["count"] == 0.0
+    sess.free()
+
+    # 3. journal ring wrap + skew cycle
+    enable(size=8)
+    for i in range(12):
+        journal.record(f"op{i}", "selftest", time.perf_counter(), 1e-5,
+                       nbytes=i)
+    spans = journal.snapshot()
+    assert len(spans) == 8 and spans[-1].op == "op11", spans
+    assert spans[0].seq < spans[-1].seq
+    tok = skew.begin("selftest")
+    skew.body(tok)
+    skew.end(tok, nbytes=64)
+    sk = pvar.PVARS.lookup("coll_selftest_skew_seconds")
+    assert sk is not None and sk.read()["count"] == 1
+
+    # 4. exporters round-trip
+    with tempfile.TemporaryDirectory() as td:
+        tp = export.dump_chrome_trace(os.path.join(td, "trace.json"))
+        with open(tp) as f:
+            doc = json.load(f)
+        evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+        assert evs, "chrome trace has no events"
+        assert all("name" in e and "ts" in e and "ph" in e for e in evs)
+        jp = export.dump_jsonl(os.path.join(td, "journal.jsonl"))
+        with open(jp) as f:
+            lines = [json.loads(line) for line in f]
+        assert len(lines) == len(journal.snapshot())
+        assert lines[-1]["op"] == "selftest"
+    page = export.prometheus_text()
+    for needle in (
+        "ompitpu_obs_selftest_counter 5",
+        "ompitpu_obs_selftest_hist_bucket",
+        "ompitpu_obs_selftest_hist_count 6",
+        "ompitpu_obs_selftest_agg_min -1",
+        "ompitpu_coll_selftest_skew_seconds_count 1",
+        "ompitpu_obs_journal_events",
+    ):
+        assert needle in page, f"{needle!r} missing from exposition"
+
+    # 5. coll driver plan-cache statistics (registered at driver
+    # import; sum = hits, count = invocations → sum/count = hit ratio)
+    from ..coll import driver as _coll_driver  # noqa: F401
+
+    pc = pvar.PVARS.lookup("coll_plan_cache_hits")
+    assert pc is not None, "coll driver must register coll_plan_cache_hits"
+    st = pc.read()
+    hits, total = int(st["sum"]), int(st["count"])
+    ratio = (hits / total) if total else 0.0
+    print(f"plan cache: {hits}/{total} hits "
+          f"(ratio {ratio:.2f}; compiled="
+          f"{pvar.PVARS.lookup('coll_programs_compiled').read():.0f}, "
+          f"invocations="
+          f"{pvar.PVARS.lookup('coll_invocations').read():.0f})")
+
+    disable()
+    print("obs selftest: ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ompi_release_tpu.obs",
+        description="Observability-plane utilities")
+    ap.add_argument("--selftest", action="store_true",
+                    help="register/bump/export/verify every pvar class "
+                         "and exporter (device-free)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

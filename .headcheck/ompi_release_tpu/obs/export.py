@@ -1,0 +1,131 @@
+"""Exporters — journal and pvars in standard tool formats.
+
+Three consumers, three formats, one data source:
+
+  - :func:`chrome_trace` / :func:`dump_chrome_trace`: Chrome/Perfetto
+    ``trace_event`` JSON (load in chrome://tracing or ui.perfetto.dev).
+    One pseudo-thread per layer (named via ``thread_name`` metadata
+    events); spans with dt > 0 are complete events ("X"), instant
+    emit points are thread-scoped instants ("i").
+  - :func:`dump_jsonl`: one JSON object per span (the tracer sink's
+    line format), for ad-hoc grep/pandas analysis.
+  - :func:`prometheus_text`: text exposition of every registered pvar
+    (``ompitpu_<name>``), served by the ``tpu_server`` metrics RPC and
+    rendered live by ``tpu_top --metrics``. HISTOGRAM pvars become
+    real Prometheus histograms (cumulative ``_bucket{le=...}`` +
+    ``_sum``/``_count``), AGGREGATE pvars a gauge family.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..mca import pvar as _pvar
+from .journal import JOURNAL as _JOURNAL
+from .journal import Span
+
+# ---------------------------------------------------------------------------
+# Chrome/Perfetto trace_event
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace(spans: Optional[Sequence[Span]] = None) -> Dict[str, Any]:
+    """The journal as a ``trace_event`` JSON document (dict form)."""
+    if spans is None:
+        spans = _JOURNAL.snapshot()
+    tids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    for s in spans:
+        tid = tids.setdefault(s.layer, len(tids) + 1)
+        ev: Dict[str, Any] = {
+            "name": s.op, "cat": s.layer, "pid": 0, "tid": tid,
+            "ts": s.t_start * 1e6,  # trace_event wants microseconds
+            "args": {"bytes": s.nbytes, "peer": s.peer,
+                     "comm": s.comm_id, "seq": s.seq},
+        }
+        if s.dt > 0:
+            ev["ph"] = "X"
+            ev["dur"] = s.dt * 1e6
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"  # thread-scoped instant
+        events.append(ev)
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "ompi_release_tpu"}},
+    ] + [
+        {"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+         "args": {"name": layer}}
+        for layer, tid in sorted(tids.items(), key=lambda kv: kv[1])
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def dump_chrome_trace(path: str,
+                      spans: Optional[Sequence[Span]] = None) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(spans), f)
+    return path
+
+
+def dump_jsonl(path: str, spans: Optional[Sequence[Span]] = None) -> str:
+    if spans is None:
+        spans = _JOURNAL.snapshot()
+    with open(path, "w") as f:
+        for s in spans:
+            f.write(json.dumps(s.asdict()) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(name: str) -> str:
+    n = _NAME_BAD.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return "ompitpu_" + n
+
+
+def _help_line(m: str, help: str) -> str:
+    return f"# HELP {m} " + " ".join(str(help).split())
+
+
+def prometheus_text(registry: Optional[_pvar.PvarRegistry] = None) -> str:
+    """Every registered pvar as Prometheus text exposition format."""
+    reg = registry if registry is not None else _pvar.PVARS
+    out: List[str] = []
+    for d in reg.describe_all():
+        name, pclass, value = d["name"], d["class"], d["value"]
+        m = _metric_name(name)
+        if pclass == "histogram" and isinstance(value, dict):
+            out.append(_help_line(m, d["help"]))
+            out.append(f"# TYPE {m} histogram")
+            cum = 0
+            for le in sorted(value.get("buckets", {})):
+                cum += value["buckets"][le]
+                out.append(f'{m}_bucket{{le="{float(le):g}"}} {cum}')
+            out.append(f'{m}_bucket{{le="+Inf"}} {value["count"]}')
+            out.append(f"{m}_sum {float(value['sum']):g}")
+            out.append(f"{m}_count {value['count']}")
+        elif pclass == "aggregate" and isinstance(value, dict):
+            out.append(_help_line(m, d["help"]))
+            for suffix in ("count", "sum", "min", "max"):
+                out.append(f"# TYPE {m}_{suffix} gauge")
+                out.append(f"{m}_{suffix} {float(value[suffix]):g}")
+        else:
+            try:
+                fv = float(value)
+            except (TypeError, ValueError):
+                continue  # non-numeric getter pvar: not exposable
+            ptype = "counter" if pclass in ("counter", "timer") else "gauge"
+            out.append(_help_line(m, d["help"]))
+            out.append(f"# TYPE {m} {ptype}")
+            out.append(f"{m} {fv:g}")
+    return "\n".join(out) + "\n"

@@ -1,0 +1,124 @@
+"""Ring-buffer event journal — the observability plane's spine.
+
+A fixed-size, lock-protected ring of :class:`Span` records ``(op,
+layer, t_start, dt, nbytes, peer, comm_id, seq)`` written by emit
+points inside the framework (coll driver, vcoll edge, pml, btl,
+request wait, sharded IO, peruse bridge, PMPI tracer). Oldest spans
+are overwritten; ``seq`` is process-monotonic so exporters and tools
+can detect wrap/loss. Recording never allocates beyond the span
+object and never blocks on IO — exporters (``obs/export.py``) read a
+snapshot and format offline.
+
+Timestamps are ``time.perf_counter()`` seconds. For XLA-dispatched
+work ``dt`` is *dispatch-side* time (jax dispatch is async; blocking
+for device completion inside an emit point would change program
+behavior); peruse-bridge spans carry the event's element count in the
+``nbytes`` slot, as fired.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+DEFAULT_SIZE = 4096
+
+
+class Span:
+    __slots__ = ("seq", "op", "layer", "t_start", "dt", "nbytes",
+                 "peer", "comm_id")
+
+    def __init__(self, seq: int, op: str, layer: str, t_start: float,
+                 dt: float, nbytes: int = 0, peer: int = -1,
+                 comm_id: int = -1) -> None:
+        self.seq = seq
+        self.op = op
+        self.layer = layer
+        self.t_start = t_start
+        self.dt = dt
+        self.nbytes = nbytes
+        self.peer = peer
+        self.comm_id = comm_id
+
+    def asdict(self) -> Dict[str, Any]:
+        return {"seq": self.seq, "op": self.op, "layer": self.layer,
+                "t": self.t_start, "dt": self.dt, "bytes": self.nbytes,
+                "peer": self.peer, "comm": self.comm_id}
+
+    def __repr__(self) -> str:
+        return (f"Span(#{self.seq} {self.layer}/{self.op} "
+                f"dt={self.dt:.3e}s bytes={self.nbytes})")
+
+
+class Journal:
+    def __init__(self, size: int = DEFAULT_SIZE) -> None:
+        self._lock = threading.Lock()
+        self._size = max(1, int(size))
+        self._buf: List[Optional[Span]] = [None] * self._size
+        self._next_seq = 0
+        self._wrapped = 0  # spans overwritten or squeezed out by resize
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    @property
+    def total_recorded(self) -> int:
+        """Spans ever recorded (monotonic across wraps and clears)."""
+        with self._lock:
+            return self._next_seq
+
+    @property
+    def dropped(self) -> int:
+        """Spans lost to CAPACITY — ring wrap or a shrinking resize.
+        Spans removed by an explicit clear() are not losses and do not
+        count (the obs_journal_dropped pvar tells operators to raise
+        obs_journal_size; clear() must not trigger that advice)."""
+        with self._lock:
+            return self._wrapped
+
+    def record(self, op: str, layer: str, t_start: float, dt: float,
+               nbytes: int = 0, peer: int = -1, comm_id: int = -1) -> Span:
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq = seq + 1
+            sp = Span(seq, op, layer, t_start, dt, nbytes, peer, comm_id)
+            slot = seq % self._size
+            if self._buf[slot] is not None:
+                self._wrapped += 1
+            self._buf[slot] = sp
+            return sp
+
+    def _snapshot_locked(self) -> List[Span]:
+        spans = [s for s in self._buf if s is not None]
+        spans.sort(key=lambda s: s.seq)
+        return spans
+
+    def snapshot(self) -> List[Span]:
+        """Buffered spans, oldest first."""
+        with self._lock:
+            return self._snapshot_locked()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._buf if s is not None)
+
+    def clear(self) -> None:
+        """Drop buffered spans; seq keeps counting (monotonic)."""
+        with self._lock:
+            self._buf = [None] * self._size
+
+    def resize(self, size: int) -> None:
+        """Change capacity in place, keeping the newest spans."""
+        with self._lock:
+            spans = self._snapshot_locked()
+            keep = spans[-max(1, int(size)):]
+            self._wrapped += len(spans) - len(keep)  # squeezed out
+            self._size = max(1, int(size))
+            self._buf = [None] * self._size
+            for sp in keep:
+                self._buf[sp.seq % self._size] = sp
+
+
+#: process-global journal every emit point writes into
+JOURNAL = Journal()

@@ -1,0 +1,181 @@
+"""Predefined reduction operations — the ``ompi/op`` analogue.
+
+The reference implements every (op × dtype) kernel as a C loop in
+``ompi/mca/op/base/op_base_functions.c`` (1544 LoC) with an ``op`` MCA
+framework for accelerated overrides. On TPU each op is one XLA
+elementwise combiner executed on the VPU, fused by the compiler into the
+surrounding collective — there is nothing to hand-roll per dtype.
+
+Each op carries the metadata the collective decision rules need:
+commutativity (tuned picks ring only for commutative ops,
+``coll_tuned_decision_fixed.c:71``) and an identity element per dtype
+(for padded/segmented algorithms).
+
+MINLOC/MAXLOC operate on a (value, index) pair carried as two arrays,
+matching MPI's pair-type semantics without byte-packed structs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..mca import component as mca_component
+
+
+@dataclasses.dataclass(frozen=True)
+class Op:
+    """A reduction operator usable by collectives and RMA accumulate."""
+
+    name: str
+    fn: Callable[[Any, Any], Any]  # elementwise combiner a⊕b
+    commutative: bool = True
+    identity: Optional[Callable[[Any], Any]] = None  # dtype -> identity scalar
+    # lax reduce primitive name when XLA has a fused collective for it
+    # (psum/pmax/pmin); None -> reduce via generic combinator tree
+    lax_collective: Optional[str] = None
+    is_pair_op: bool = False  # MINLOC/MAXLOC operate on (value, index)
+
+    def identity_for(self, dtype) -> Any:
+        if self.identity is None:
+            raise ValueError(f"op {self.name} has no identity element")
+        return self.identity(np.dtype(dtype) if str(dtype) != "bfloat16" else dtype)
+
+    def __call__(self, a, b):
+        return self.fn(a, b)
+
+    def __repr__(self) -> str:
+        return f"Op({self.name}, commutative={self.commutative})"
+
+
+def _min_identity(dtype):
+    d = jnp.dtype(dtype)
+    if d == jnp.bool_:
+        return True
+    if jnp.issubdtype(d, jnp.integer):
+        return jnp.iinfo(d).max
+    return jnp.array(jnp.inf, d)
+
+
+def _max_identity(dtype):
+    d = jnp.dtype(dtype)
+    if d == jnp.bool_:
+        return False
+    if jnp.issubdtype(d, jnp.integer):
+        return jnp.iinfo(d).min
+    return jnp.array(-jnp.inf, d)
+
+
+def _band_identity(dtype):
+    d = jnp.dtype(dtype)
+    if d == jnp.bool_:
+        return True
+    return d.type(np.iinfo(d).max) if d.kind == "u" else d.type(-1)  # all bits set
+
+
+SUM = Op("sum", lambda a, b: a + b, True, lambda d: 0, "psum")
+PROD = Op("prod", lambda a, b: a * b, True, lambda d: 1)
+MAX = Op("max", jnp.maximum, True, _max_identity, "pmax")
+MIN = Op("min", jnp.minimum, True, _min_identity, "pmin")
+LAND = Op("land", jnp.logical_and, True, lambda d: True)
+LOR = Op("lor", jnp.logical_or, True, lambda d: False)
+LXOR = Op("lxor", jnp.logical_xor, True, lambda d: False)
+BAND = Op("band", lambda a, b: a & b, True, _band_identity)
+BOR = Op("bor", lambda a, b: a | b, True, lambda d: 0)
+BXOR = Op("bxor", lambda a, b: a ^ b, True, lambda d: 0)
+REPLACE = Op("replace", lambda a, b: b, False)  # MPI_REPLACE (RMA)
+NO_OP = Op("no_op", lambda a, b: a, False)  # MPI_NO_OP (RMA get-accumulate)
+
+
+def _maxloc_fn(a, b):
+    """a, b are (value, index) tuples; ties pick the lower index (MPI)."""
+    av, ai = a
+    bv, bi = b
+    take_a = (av > bv) | ((av == bv) & (ai <= bi))
+    return jnp.where(take_a, av, bv), jnp.where(take_a, ai, bi)
+
+
+def _minloc_fn(a, b):
+    av, ai = a
+    bv, bi = b
+    take_a = (av < bv) | ((av == bv) & (ai <= bi))
+    return jnp.where(take_a, av, bv), jnp.where(take_a, ai, bi)
+
+
+MAXLOC = Op("maxloc", _maxloc_fn, True, is_pair_op=True)
+MINLOC = Op("minloc", _minloc_fn, True, is_pair_op=True)
+
+PREDEFINED_OPS: Dict[str, Op] = {
+    op.name: op
+    for op in [SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR,
+               MAXLOC, MINLOC, REPLACE, NO_OP]
+}
+
+
+def user_op(name: str, fn: Callable, commute: bool = True,
+            identity: Optional[Callable] = None) -> Op:
+    """MPI_Op_create analogue: wrap a user combiner (must be jax-traceable)."""
+    return Op(name, fn, commutative=commute, identity=identity)
+
+
+class XlaOpComponent(mca_component.Component):
+    """Default op component: XLA elementwise combiners (always available).
+
+    The ``op`` framework mirrors ``ompi/mca/op``: accelerated components
+    (the Pallas streaming-reduce component in ``pallas_op.py``) register
+    with higher priority and claim the (op, dtype, size) shapes their
+    kernels beat the compiler on — ``resolve`` walks the components in
+    priority order exactly like ``ompi_op_base_op_select``.
+    """
+
+    NAME = "xla"
+    PRIORITY = 10
+
+    def lookup(self, name: str, dtype=None, nbytes: int = 0
+               ) -> Optional[Op]:
+        return PREDEFINED_OPS.get(name)
+
+
+OP_FRAMEWORK = mca_component.framework(
+    "op", "reduction operator kernels (ompi/mca/op analogue)"
+)
+OP_FRAMEWORK.register(XlaOpComponent())
+
+
+def reduce_local(inbuf, inoutbuf, op: Op):
+    """MPI_Reduce_local (``ompi/mpi/c/reduce_local.c``): combine two
+    local buffers, ``inout = in OP inout`` — no communication.  Pair
+    ops take/return ``(values, indices)`` tuples.  Routed through the
+    op framework, so an accelerated component (pallas) claims the
+    shapes its kernels win on, exactly like the collectives' local
+    reduction steps."""
+    import jax.numpy as jnp
+
+    if op.is_pair_op:
+        (va, ia), (vb, ib) = inbuf, inoutbuf
+        return op((jnp.asarray(va), jnp.asarray(ia)),
+                  (jnp.asarray(vb), jnp.asarray(ib)))
+    a = jnp.asarray(inbuf)
+    b = jnp.asarray(inoutbuf)
+    resolved = resolve(op, a.dtype, a.size * a.dtype.itemsize)
+    return resolved(a, b)
+
+
+def resolve(op: Op, dtype=None, nbytes: int = 0) -> Op:
+    """Accelerated-kernel resolution (``ompi/mca/op`` select): query
+    components highest-priority first with the reduction's shape
+    context; the first claim wins. Ops no component knows (user ops)
+    pass through unchanged. Callers that bake the combiner into a
+    compiled program must include the resolved op's name in their
+    program cache key — accelerated ops carry distinct names
+    (e.g. ``sum[pallas]``) precisely so those keys differ. The
+    framework include/exclude variable applies (``--mca op ^pallas``
+    turns the accelerated component off job-wide)."""
+    for _prio, _comp, module in OP_FRAMEWORK.available():
+        found = module.lookup(op.name, dtype=dtype, nbytes=int(nbytes))
+        if found is not None:
+            return found
+    return op

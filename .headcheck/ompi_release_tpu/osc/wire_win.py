@@ -1,0 +1,891 @@
+"""Cross-process one-sided communication — home-process-applies RMA.
+
+The reference's osc framework moves RMA data between ANY two ranks
+over the BTLs (``ompi/mca/osc/rdma/osc_rdma_data_move.c``: active and
+passive target movement; ``osc/pt2pt`` ships ops as active messages
+when no RDMA path exists). Under the unified ``tpurun`` world each
+controller process owns only its LOCAL members' window slices, so an
+RMA op whose target lives in another process is SHIPPED to that
+process (the target's *home*) at synchronization time:
+
+- epoch close partitions the pending queue by target owner; local ops
+  run as the normal compiled epoch program over the local submesh,
+  remote ops serialize into one batch per owner process;
+- the owner's *window service thread* applies an incoming batch into
+  its local slices — the same ``lax.scan``/``lax.switch`` epoch
+  program — and replies with the pre-op values (get/get_accumulate/
+  fetch_and_op/compare_and_swap reads) plus a completion ack, which
+  gives ``flush`` its remote-completion meaning;
+- passive target is real: the lock state for a target rank lives at
+  the target's OWNER process (service-side lock table with waiter
+  queues), so origins in different processes contending for an
+  exclusive lock serialize without the target's application code ever
+  being involved — the osc/rdma passive-target model.
+
+Serialization is ``np.savez``/``np.load(allow_pickle=False)`` over the
+wire's payload transports (shm handoff on one host, chunked DCN
+staging across hosts) with a ``DssBuffer`` envelope — no pickle, no
+eval. Only predefined reduction ops may cross a process boundary
+(MPI itself restricts MPI_Accumulate to predefined ops).
+"""
+
+from __future__ import annotations
+
+import io
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..native import DssBuffer
+from ..ops.op import PREDEFINED_OPS
+from ..request.request import Status
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+from .window import (LOCK_EXCLUSIVE, LOCK_SHARED, Window, _EpochKind,
+                     _PendingOp)
+
+_log = output.stream("osc")
+
+#: window-service envelopes (any-source); payloads ride the three
+#: sibling channels so an any-source envelope pop can never swallow
+#: another sender's payload frame
+WIRE_WIN_SERVICE = 5 << 20
+WIRE_WIN_DATA = 6 << 20
+WIRE_WIN_REPLY = 7 << 20
+WIRE_WIN_RDATA = 8 << 20
+
+_WIN_MAGIC = "WWIN"
+
+KIND_BATCH = 1    # arg1 = release_target comm rank (or -1)
+KIND_LOCK = 2     # arg1 = target, arg2 = lock type
+KIND_ABANDON = 3  # arg1 = target: forget this origin's lock interest
+KIND_POST = 4     # one-way: src process posted an exposure epoch
+KIND_COMPLETE = 5  # one-way: src process completed its access epoch
+KIND_ERROR = 99   # home-side failure applying a request
+
+
+def _pack_batch(todo: List[_PendingOp]) -> np.ndarray:
+    """Serialize a pending-op batch to one uint8 array (npz form)."""
+    meta = []
+    arrays: Dict[str, np.ndarray] = {}
+    for i, p in enumerate(todo):
+        if p.op is not None and p.op.name not in PREDEFINED_OPS:
+            raise MPIError(
+                ErrorCode.ERR_OP,
+                f"cross-process RMA requires a predefined op, got "
+                f"'{p.op.name}' (MPI_Accumulate's own rule)",
+            )
+        meta.append({
+            "k": p.kind,
+            "t": int(p.target),
+            "o": p.op.name if p.op is not None else "",
+            "i": -1 if p.index is None else int(p.index),
+            "r": p.request is not None,
+        })
+        if p.data is not None:
+            arrays[f"d{i}"] = np.asarray(p.data)
+        if p.compare is not None:
+            arrays[f"c{i}"] = np.asarray(p.compare)
+    arrays["meta"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    ).copy()
+    bio = io.BytesIO()
+    np.savez(bio, **arrays)
+    return np.frombuffer(bio.getvalue(), dtype=np.uint8).copy()
+
+
+def _unpack_batch(raw) -> List[_PendingOp]:
+    """Inverse of :func:`_pack_batch`; requests are fresh local ones
+    for ops that want a read back."""
+    from ..request.request import Request
+
+    z = np.load(io.BytesIO(np.asarray(raw, dtype=np.uint8).tobytes()),
+                allow_pickle=False)
+    meta = json.loads(bytes(z["meta"]).decode())
+    todo = []
+    for i, m in enumerate(meta):
+        todo.append(_PendingOp(
+            m["k"], m["t"],
+            data=(jnp.asarray(z[f"d{i}"]) if f"d{i}" in z else None),
+            op=(PREDEFINED_OPS[m["o"]] if m["o"] else None),
+            request=(Request() if m["r"] else None),
+            compare=(jnp.asarray(z[f"c{i}"]) if f"c{i}" in z else None),
+            index=(None if m["i"] < 0 else m["i"]),
+        ))
+    return todo
+
+
+def _pack_reads(values: List[np.ndarray]) -> np.ndarray:
+    bio = io.BytesIO()
+    np.savez(bio, **{f"r{i}": np.asarray(v)
+                     for i, v in enumerate(values)})
+    return np.frombuffer(bio.getvalue(), dtype=np.uint8).copy()
+
+
+def _unpack_reads(raw, n: int) -> List[np.ndarray]:
+    z = np.load(io.BytesIO(np.asarray(raw, dtype=np.uint8).tobytes()),
+                allow_pickle=False)
+    return [z[f"r{i}"] for i in range(n)]
+
+
+class _LockState:
+    __slots__ = ("mode", "holders", "waiters")
+
+    def __init__(self) -> None:
+        self.mode: Optional[int] = None
+        self.holders: set = set()  # origin process indices
+        self.waiters: deque = deque()  # (origin, type, event|None)
+
+
+class WinService:
+    """Per-runtime window service: applies incoming RMA batches into
+    home windows and arbitrates passive-target locks."""
+
+    def __init__(self, runtime) -> None:
+        self.rt = runtime
+        self.router = runtime.wire
+        self.ep = runtime.wire.ep
+        self.my_pidx = int(runtime.bootstrap["process_index"])
+        self.windows: Dict[Tuple[int, int], "WireWindow"] = {}
+        self._locks: Dict[Tuple[int, int, int], _LockState] = {}
+        self._state_lock = threading.Lock()
+        # PSCW notice sets per window key: which processes have posted
+        # an exposure epoch / completed an access epoch (consumed by
+        # start()/wait() respectively)
+        self._posts: Dict[Tuple[int, int], set] = {}
+        self._completes: Dict[Tuple[int, int], set] = {}
+        self._pscw_cv = threading.Condition(self._state_lock)
+        #: token-demultiplexed replies: every outstanding request
+        #: registers a slot keyed by its token; ONE thread at a time
+        #: pumps the shared WIRE_WIN_REPLY channel (``_pump_lock``) and
+        #: routes each reply — and its RDATA payload — to its slot, so
+        #: any number of threads can have requests in flight and a
+        #: deferred grant for one can never block another's reply
+        self._reply_slots: Dict[int, dict] = {}
+        self._reply_guard = threading.Lock()
+        self._pump_lock = threading.Lock()
+        #: per-request token echoed in replies: after a timeout, a
+        #: LATE reply must not be mistaken for the retry's (same cid/
+        #: seq/kind) — tokens make staleness decidable
+        self._token = itertools.count(1)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True, name="win-service"
+        )
+        self._thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    def ensure(cls, runtime) -> "WinService":
+        svc = getattr(runtime, "_win_service", None)
+        if svc is None:
+            svc = runtime._win_service = cls(runtime)
+        return svc
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+    def register(self, win: "WireWindow") -> None:
+        with self._state_lock:
+            self.windows[(win.comm.cid, win.win_seq)] = win
+
+    def unregister(self, win: "WireWindow") -> None:
+        key = (win.comm.cid, win.win_seq)
+        with self._state_lock:
+            self.windows.pop(key, None)
+            # win_seq is monotone per comm, so a freed window's notice
+            # and lock entries can never be consumed again — drop them
+            # (late frames for the key are refused by _window())
+            self._posts.pop(key, None)
+            self._completes.pop(key, None)
+            for lk in [k for k in self._locks if k[:2] == key]:
+                del self._locks[lk]
+
+    def _window(self, cid: int, seq: int) -> "WireWindow":
+        with self._state_lock:
+            w = self.windows.get((cid, seq))
+        if w is None:
+            raise MPIError(
+                ErrorCode.ERR_WIN,
+                f"window service: no window (cid={cid}, seq={seq}) — "
+                "window creation order diverged across processes?",
+            )
+        return w
+
+    # -- service loop ------------------------------------------------------
+    def _serve(self) -> None:
+        from ..btl.components import stashed_recv
+
+        while not self._stop.is_set():
+            try:
+                src_nid, raw = stashed_recv(
+                    self.ep, None, WIRE_WIN_SERVICE,
+                    time.monotonic() + 0.2,
+                )
+            except MPIError:
+                continue
+            except Exception:
+                if self._stop.is_set():
+                    return
+                raise
+            try:
+                self._handle(src_nid - 1, raw)
+            except Exception as e:
+                # NOTHING may kill the service: a malformed frame, a
+                # corrupt npz, or a user error surfacing as a jax/numpy
+                # exception (bad payload shape) would otherwise silently
+                # disable all cross-process RMA for this process
+                _log.verbose(1, f"win service: dropping frame from "
+                                f"process {src_nid - 1}: "
+                                f"{type(e).__name__}: {e}")
+
+    def _handle(self, src_pidx: int, raw: bytes) -> None:
+        env = DssBuffer(raw)
+        if env.unpack_string() != _WIN_MAGIC:
+            _log.verbose(1, "win service: non-window frame dropped")
+            return
+        cid, seq, kind, arg1, arg2, token = env.unpack_int64(6)
+        token = int(token)
+        if kind == KIND_BATCH:
+            # payload must be consumed even if applying fails, and the
+            # origin must get SOME reply or it stalls for the full
+            # request timeout — failures reply KIND_ERROR (loud at the
+            # origin, service stays alive)
+            payload = self.router._recv_payload(WIRE_WIN_DATA, src_pidx)
+            try:
+                win = self._window(int(cid), int(seq))
+                todo = _unpack_batch(payload)
+                reads = win._apply_home_batch(todo)
+                if int(arg1) >= 0:
+                    self.release(win, int(arg1), src_pidx)
+            except Exception as e:
+                _log.verbose(1, f"win service: batch from process "
+                                f"{src_pidx} failed: {e}")
+                self._reply(src_pidx, int(cid), int(seq), KIND_ERROR, [],
+                            token)
+                return
+            self._reply(src_pidx, int(cid), int(seq), KIND_BATCH, reads,
+                        token)
+        elif kind == KIND_LOCK:
+            win = self._window(int(cid), int(seq))
+            granted = self.acquire(win, int(arg1), src_pidx, int(arg2),
+                                   event=None, token=token)
+            if granted:
+                self._reply(src_pidx, int(cid), int(seq), KIND_LOCK, [],
+                            token)
+            # else: deferred — release() sends the grant later
+        elif kind == KIND_ABANDON:
+            win = self._window(int(cid), int(seq))
+            self.abandon(win, int(arg1), src_pidx)
+            self._reply(src_pidx, int(cid), int(seq), KIND_ABANDON, [],
+                        token)
+        elif kind == KIND_POST:
+            self.pscw_record(self._posts, (int(cid), int(seq)), src_pidx)
+        elif kind == KIND_COMPLETE:
+            self.pscw_record(self._completes, (int(cid), int(seq)),
+                             src_pidx)
+        else:
+            _log.verbose(1, f"win service: unknown kind {kind}")
+
+    def _reply(self, dst_pidx: int, cid: int, seq: int, kind: int,
+               reads: List[np.ndarray], token: int = 0) -> None:
+        env = DssBuffer()
+        env.pack_string(_WIN_MAGIC)
+        env.pack_int64([cid, seq, kind, len(reads), token])
+        self.router._retry(
+            lambda: self.ep.send(self.router._nid(dst_pidx),
+                                 WIRE_WIN_REPLY, env.tobytes()),
+            f"window reply to process {dst_pidx}",
+        )
+        if reads:
+            self.router._send_payload(dst_pidx, WIRE_WIN_RDATA,
+                                      _pack_reads(reads))
+
+    # -- origin-side request/reply -----------------------------------------
+    def _send_lock(self, owner_pidx: int) -> threading.Lock:
+        """Per-OWNER outbound framing lock (the router's lazily-created
+        registry): a request envelope and its payload must land
+        back-to-back on the owner's service FIFO, but the lock is held
+        only for the SEND — never across the reply wait (the old
+        process-wide ``outbound`` lock held through deferred
+        lock-grant waits deadlocked a second thread's unlock for up to
+        120 s)."""
+        return self.router._chan_lock("win_send", owner_pidx)
+
+    def _pump_replies(self, deadline: float) -> None:
+        """Pop ONE reply (and its RDATA payload, if any) off the shared
+        reply channel and route it to its token's slot. Caller holds
+        ``_pump_lock``. Replies whose requester already timed out and
+        deregistered are drained and dropped — their RDATA must be
+        consumed here or the NEXT read-carrying reply would unpack the
+        wrong arrays."""
+        from ..btl.components import stashed_recv
+
+        try:
+            src_nid, raw = stashed_recv(self.ep, None, WIRE_WIN_REPLY,
+                                        deadline)
+        except MPIError as e:
+            if e.code is ErrorCode.ERR_PENDING:
+                return  # nothing within the slice; caller re-checks
+            raise  # endpoint closed / link dead: surface it NOW, not
+            #        as a misleading 120 s reply timeout
+        renv = DssBuffer(raw)
+        if renv.unpack_string() != _WIN_MAGIC:
+            raise MPIError(ErrorCode.ERR_INTERN,
+                           "corrupt window reply envelope")
+        rcid, rseq, rkind, n_reads, rtoken = renv.unpack_int64(5)
+        reads: List[np.ndarray] = []
+        if int(n_reads) and int(rkind) != KIND_ERROR:
+            # the owner's service thread sends a reply's RDATA directly
+            # behind its envelope, so consuming it HERE (src-matched)
+            # keeps the per-owner payload stream aligned no matter
+            # which thread's reply this is
+            rdata = self.router._recv_payload(WIRE_WIN_RDATA,
+                                              src_nid - 1)
+            reads = _unpack_reads(rdata, int(n_reads))
+        with self._reply_guard:
+            slot = self._reply_slots.get(int(rtoken))
+            if slot is None:
+                _log.verbose(
+                    1, f"discarding stale window reply (cid={rcid}, "
+                       f"seq={rseq}, kind={rkind}, token={rtoken})")
+                return
+            slot["cid"], slot["seq"] = int(rcid), int(rseq)
+            slot["kind"] = int(rkind)
+            slot["reads"] = reads
+            slot["ev"].set()
+
+    def request(self, win: "WireWindow", owner_pidx: int, kind: int,
+                arg1: int, arg2: int,
+                payload: Optional[np.ndarray] = None,
+                timeout_ms: int = 120_000) -> List[np.ndarray]:
+        """Send one request to ``owner_pidx`` and await its reply
+        (lock grants may be deferred behind another holder, hence the
+        generous timeout). Returns the read arrays.
+
+        Concurrency: the reply channel is demultiplexed by token, so
+        any number of threads may have requests outstanding — while a
+        thread waits for a deferred lock grant, the thread whose
+        unlock PRODUCES that grant proceeds through its own
+        request/reply unimpeded (the ADVICE r5 two-thread deadlock)."""
+        token = next(self._token)
+        slot = {"ev": threading.Event(), "reads": None, "kind": None,
+                "cid": -1, "seq": -1}
+        with self._reply_guard:
+            self._reply_slots[token] = slot
+        try:
+            env = DssBuffer()
+            env.pack_string(_WIN_MAGIC)
+            env.pack_int64([win.comm.cid, win.win_seq, kind, arg1, arg2,
+                            token])
+            with self._send_lock(owner_pidx):
+                self.router._retry(
+                    lambda: self.ep.send(self.router._nid(owner_pidx),
+                                         WIRE_WIN_SERVICE, env.tobytes()),
+                    f"window request to process {owner_pidx}",
+                )
+                if payload is not None:
+                    self.router._send_payload(owner_pidx, WIRE_WIN_DATA,
+                                              payload)
+            deadline = time.monotonic() + timeout_ms / 1000
+            while not slot["ev"].is_set():
+                # one thread at a time pumps the shared channel; the
+                # others park on their event (woken the instant the
+                # pump routes their reply) — whoever holds the pump
+                # routes EVERY arriving reply to its waiter
+                if self._pump_lock.acquire(blocking=False):
+                    try:
+                        if slot["ev"].is_set():
+                            break
+                        self._pump_replies(time.monotonic() + 0.2)
+                    finally:
+                        self._pump_lock.release()
+                else:
+                    slot["ev"].wait(timeout=0.02)
+                if slot["ev"].is_set():
+                    break
+                if time.monotonic() >= deadline:
+                    raise MPIError(
+                        ErrorCode.ERR_PENDING,
+                        f"window request (kind {kind}) to process "
+                        f"{owner_pidx} got no reply within "
+                        f"{timeout_ms / 1000:.0f}s",
+                    )
+        finally:
+            with self._reply_guard:
+                self._reply_slots.pop(token, None)
+        if slot["kind"] == KIND_ERROR:
+            raise MPIError(
+                ErrorCode.ERR_RMA_SYNC,
+                f"window request (kind {kind}) failed at its "
+                f"home process {owner_pidx} — bad payload "
+                "shape/dtype for the target window?",
+            )
+        if (slot["cid"], slot["seq"], slot["kind"]) != (
+                win.comm.cid, win.win_seq, kind):
+            raise MPIError(
+                ErrorCode.ERR_INTERN,
+                f"window reply token {token} carries "
+                f"(cid={slot['cid']}, seq={slot['seq']}, "
+                f"kind={slot['kind']}), expected (cid={win.comm.cid}, "
+                f"seq={win.win_seq}, kind={kind})",
+            )
+        return slot["reads"] or []
+
+    # -- PSCW notices (one-way; no reply awaited) --------------------------
+    def notify(self, dst_pidx: int, win: "WireWindow", kind: int) -> None:
+        env = DssBuffer()
+        env.pack_string(_WIN_MAGIC)
+        env.pack_int64([win.comm.cid, win.win_seq, kind, 0, 0, 0])
+        self.router._retry(
+            lambda: self.ep.send(self.router._nid(dst_pidx),
+                                 WIRE_WIN_SERVICE, env.tobytes()),
+            f"window notice (kind {kind}) to process {dst_pidx}",
+        )
+
+    def pscw_record(self, table: Dict, key: Tuple[int, int],
+                    pidx: int) -> None:
+        with self._pscw_cv:
+            table.setdefault(key, set()).add(pidx)
+            self._pscw_cv.notify_all()
+
+    def pscw_check(self, table: Dict, key: Tuple[int, int],
+                   procs) -> bool:
+        """Non-consuming peek: have all of ``procs`` recorded their
+        notice? (MPI_Win_test's question.)"""
+        with self._pscw_cv:
+            return set(procs) <= table.get(key, set())
+
+    def pscw_await(self, table: Dict, key: Tuple[int, int],
+                   procs, what: str) -> None:
+        """Block until every process in ``procs`` has recorded its
+        notice, then CONSUME those notices (the next epoch must wait
+        for its own). MPI requires wait() to block as long as it
+        takes (the partner may compute arbitrarily long before
+        complete()), so the default is unbounded; operators can bound
+        it with ``--mca osc_pscw_timeout_s N`` to turn a hung partner
+        into a diagnosable error."""
+        from ..mca import var as mca_var
+
+        want = set(procs)
+        if not want:  # MPI_GROUP_EMPTY epochs are legal no-ops
+            return
+        timeout_s = float(mca_var.get("osc_pscw_timeout_s", 0) or 0)
+        deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        with self._pscw_cv:
+            while not want <= table.get(key, set()):
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise MPIError(
+                            ErrorCode.ERR_RMA_SYNC,
+                            f"PSCW {what} timed out awaiting processes "
+                            f"{sorted(want - table.get(key, set()))}",
+                        )
+                self._pscw_cv.wait(timeout=1.0)
+            table[key] -= want
+
+    # -- home-side lock table ----------------------------------------------
+    def _lock_key(self, win: "WireWindow", target: int
+                  ) -> Tuple[int, int, int]:
+        return (win.comm.cid, win.win_seq, target)
+
+    def acquire(self, win: "WireWindow", target: int, origin: int,
+                lock_type: int, event: Optional[threading.Event],
+                token: int = 0) -> bool:
+        """Try to acquire ``target``'s lock for ``origin``. Returns
+        True when granted now; otherwise queues the waiter (remote
+        origins get their grant reply — echoing ``token`` — from
+        :meth:`release`; local ones wait on ``event``)."""
+        with self._state_lock:
+            st = self._locks.setdefault(self._lock_key(win, target),
+                                        _LockState())
+            grantable = (
+                not st.holders
+                or (st.mode == LOCK_SHARED and lock_type == LOCK_SHARED
+                    and not st.waiters)  # don't starve a queued EXCL
+            )
+            if grantable:
+                st.mode = lock_type
+                st.holders.add(origin)
+                return True
+            st.waiters.append((origin, lock_type, event, token))
+            return False
+
+    def release(self, win: "WireWindow", target: int, origin: int) -> None:
+        grants: List[Tuple[int, int]] = []  # (remote origin, token)
+        with self._state_lock:
+            st = self._locks.get(self._lock_key(win, target))
+            if st is None or origin not in st.holders:
+                raise MPIError(
+                    ErrorCode.ERR_RMA_SYNC,
+                    f"unlock of target {target} not held by process "
+                    f"{origin}",
+                )
+            st.holders.discard(origin)
+            if not st.holders:
+                st.mode = None
+                while st.waiters:
+                    o, t, ev, tok = st.waiters[0]
+                    if st.mode is None:
+                        st.mode = t
+                    elif not (st.mode == LOCK_SHARED
+                              and t == LOCK_SHARED):
+                        break
+                    st.waiters.popleft()
+                    st.holders.add(o)
+                    if ev is not None:
+                        # LOCAL grant: set the event INSIDE the lock so
+                        # a timed-out acquire_blocking can atomically
+                        # distinguish "granted" from "still waiting"
+                        ev.set()
+                    else:
+                        grants.append((o, tok))
+                    if t == LOCK_EXCLUSIVE:
+                        break
+        for origin_p, tok in grants:
+            self._reply(origin_p, win.comm.cid, win.win_seq,
+                        KIND_LOCK, [], tok)
+
+    def abandon(self, win: "WireWindow", target: int, origin: int) -> None:
+        """Forget ``origin``'s interest in ``target``'s lock: drop its
+        waiter entry, or release a grant it never saw (the origin timed
+        out; without this the ghost holder wedges the lock forever)."""
+        with self._state_lock:
+            st = self._locks.get(self._lock_key(win, target))
+            if st is None:
+                return
+            st.waiters = deque(w for w in st.waiters if w[0] != origin)
+            ghost = origin in st.holders
+        if ghost:
+            self.release(win, target, origin)
+
+    def acquire_blocking(self, win: "WireWindow", target: int,
+                         lock_type: int, timeout_s: float = 120.0) -> None:
+        """Local-origin acquire against the home table (the target is
+        owned by THIS process, but remote origins contend through the
+        same table)."""
+        ev = threading.Event()
+        if self.acquire(win, target, self.my_pidx, lock_type, event=ev):
+            return
+        if ev.wait(timeout=timeout_s):
+            return
+        with self._state_lock:
+            if ev.is_set():
+                return  # granted in the race window — we hold it
+            st = self._locks.get(self._lock_key(win, target))
+            if st is not None:
+                st.waiters = deque(
+                    w for w in st.waiters if w[2] is not ev
+                )
+        raise MPIError(
+            ErrorCode.ERR_RMA_SYNC,
+            f"timed out waiting for lock on target {target} "
+            f"(held elsewhere for > {timeout_s:.0f}s)",
+        )
+
+
+class WireWindow(Window):
+    """A window on a communicator spanning controller processes: this
+    process stores one slice per LOCAL member (the hier driver-mode
+    convention); RMA to remote targets ships to the target's home at
+    synchronization. Creation is collective and synchronizing (like
+    MPI_Win_create), so a peer's first batch can never outrun the
+    window's existence."""
+
+    def __init__(self, comm, base: jax.Array, name: str = "") -> None:
+        rt = comm.runtime
+        if getattr(rt, "wire", None) is None:
+            raise MPIError(
+                ErrorCode.ERR_WIN,
+                "spanning-comm window needs the wire router "
+                "(runtime_unified_world)",
+            )
+        from ..runtime.wire import proc_topology
+
+        t = proc_topology(comm)  # the one shared layout derivation
+        self.router = t.router
+        self.my_pidx = t.my_pidx
+        self.owner = t.owner
+        self.local_ranks = t.local_ranks
+        self.local_n = t.local_n
+        if base.shape[0] != self.local_n:
+            raise MPIError(
+                ErrorCode.ERR_WIN,
+                f"spanning-comm window base carries one slice per "
+                f"LOCAL member ({self.local_n}), got leading axis "
+                f"{base.shape[0]}",
+            )
+        self._init_state(comm, base, name)  # shared Window field setup
+        # collective creation: same per-comm sequence on every process
+        self.win_seq = getattr(comm, "_win_seq", 0)
+        comm._win_seq = self.win_seq + 1
+        self.service = WinService.ensure(rt)
+        self.service.register(self)
+        comm.barrier()  # MPI_Win_create is collective + synchronizing
+
+    # -- storage indexing --------------------------------------------------
+    def _local_pos(self, target: int) -> int:
+        return self.local_ranks.index(target)
+
+    def _queue(self, op: _PendingOp):
+        """Validate at the CALL SITE what the wire cannot ship: a
+        user-defined op bound for a remote home would otherwise raise
+        at epoch close, after sibling ops were already dequeued (and a
+        piggybacked lock release lost)."""
+        if (op.op is not None and op.op.name not in PREDEFINED_OPS
+                and self.owner[op.target] != self.my_pidx):
+            raise MPIError(
+                ErrorCode.ERR_OP,
+                f"cross-process RMA requires a predefined op, got "
+                f"'{op.op.name}' (MPI_Accumulate's own rule)",
+            )
+        return super()._queue(op)
+
+    def read(self) -> jax.Array:
+        """LOCAL members' slices only (leading axis ``local_n``) — the
+        remote slices live in their home processes' HBM."""
+        return self._data
+
+    # -- epoch close: split local / per-home batches -----------------------
+    def _apply_pending(self, only_target: Optional[int] = None) -> None:
+        from .window import _epoch_count
+
+        with self._op_lock:
+            if not self._pending:
+                return
+            _epoch_count.add()
+            todo = self._take_pending(only_target)
+            if not todo:
+                return
+            local: List[_PendingOp] = []
+            remote: Dict[int, List[_PendingOp]] = {}
+            for p in todo:
+                own = self.owner[p.target]
+                if own == self.my_pidx:
+                    local.append(p)
+                else:
+                    remote.setdefault(own, []).append(p)
+            if local:
+                remapped = [
+                    _PendingOp(p.kind, self._local_pos(p.target),
+                               data=p.data, op=p.op, request=p.request,
+                               compare=p.compare, index=p.index,
+                               status_rank=p.target)
+                    for p in local
+                ]
+                self._run_epoch_program(remapped)
+        # ship OUTSIDE _op_lock: holding it while awaiting the peer's
+        # ack would deadlock two processes fencing into each other
+        # (each service thread needs the lock to apply the other's
+        # batch)
+        for own in sorted(remote):
+            self._ship_batch(own, remote[own], release_target=-1)
+
+    def _ship_batch(self, owner_pidx: int, ops: List[_PendingOp],
+                    release_target: int) -> None:
+        reads = self.service.request(
+            self, owner_pidx, KIND_BATCH, release_target, 0,
+            payload=_pack_batch(ops),
+        )
+        want = [p for p in ops if p.request is not None]
+        if len(want) != len(reads):
+            raise MPIError(
+                ErrorCode.ERR_INTERN,
+                f"window batch reply carried {len(reads)} reads for "
+                f"{len(want)} read-requests",
+            )
+        for p, v in zip(want, reads):
+            p.request.complete(value=jnp.asarray(v),
+                               status=Status(source=p.target))
+
+    def _apply_home_batch(self, todo: List[_PendingOp]
+                          ) -> List[np.ndarray]:
+        """Service-side: apply a peer's batch into the local slices and
+        return the read values in op order."""
+        for p in todo:
+            if self.owner[p.target] != self.my_pidx:
+                raise MPIError(
+                    ErrorCode.ERR_RANK,
+                    f"batch targets rank {p.target}, owned by process "
+                    f"{self.owner[p.target]}, not {self.my_pidx}",
+                )
+            p.target = self._local_pos(p.target)
+        with self._op_lock:
+            self._run_epoch_program(todo)
+        return [np.asarray(p.request.value) for p in todo
+                if p.request is not None]
+
+    # -- passive target over the home lock table ---------------------------
+    def lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
+        self._require(_EpochKind.NONE, _EpochKind.LOCK)
+        if target in self._locked:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           f"target {target} already locked")
+        self._acquire(target, lock_type)
+        self._locked[target] = lock_type
+        self._epoch = _EpochKind.LOCK
+
+    def _acquire(self, target: int, lock_type: int) -> None:
+        own = self.owner[target]
+        if own == self.my_pidx:
+            self.service.acquire_blocking(self, target, lock_type)
+            return
+        try:
+            self.service.request(self, own, KIND_LOCK, target, lock_type)
+        except MPIError:
+            # timed out awaiting the grant: tell the home to forget us
+            # (drops our waiter entry, or releases a grant we never
+            # saw) so the lock cannot wedge on a ghost holder
+            try:
+                self.service.request(self, own, KIND_ABANDON, target, 0,
+                                     timeout_ms=10_000)
+            except MPIError:
+                pass  # home unreachable; nothing more to clean
+            raise
+
+    def lock_all(self) -> None:
+        """Shared lock on every target (remote ones at their homes)."""
+        self._require(_EpochKind.NONE)
+        for t in range(self.comm.size):
+            self._acquire(t, LOCK_SHARED)
+            self._locked[t] = LOCK_SHARED
+        self._epoch = _EpochKind.LOCK
+
+    def _release_one(self, target: int) -> None:
+        own = self.owner[target]
+        if own == self.my_pidx:
+            self._apply_pending(only_target=target)
+            self.service.release(self, target, self.my_pidx)
+        else:
+            with self._op_lock:
+                ops = self._take_pending(only_target=target)
+            remote = [p for p in ops if self.owner[p.target] != self.my_pidx]
+            assert len(remote) == len(ops)  # only_target => one owner
+            self._ship_batch(own, remote, release_target=target)
+
+    def unlock(self, target: int) -> None:
+        self._require(_EpochKind.LOCK)
+        if target not in self._locked:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           f"target {target} not locked")
+        self._release_one(target)
+        del self._locked[target]
+        if not self._locked:
+            self._epoch = _EpochKind.NONE
+
+    def unlock_all(self) -> None:
+        self._require(_EpochKind.LOCK)
+        for t in sorted(self._locked):
+            self._release_one(t)
+        self._locked.clear()
+        self._epoch = _EpochKind.NONE
+
+    # -- PSCW (generalized active target) across processes -----------------
+    # post -> a one-way notice to every accessor process; start blocks
+    # for its targets' notices; complete ships+acks the batches THEN
+    # notifies each target (service frames from one src are processed
+    # in order, so a COMPLETE can never pass its own epoch's data);
+    # wait blocks for every accessor process's COMPLETE. This is
+    # osc/rdma's PSCW state machine at process granularity (one
+    # controller acts as all its local ranks).
+
+    def _procs_of_group(self, group) -> List[int]:
+        return sorted({self.router.owner_of(r)
+                       for r in group.world_ranks})
+
+    def _key(self) -> Tuple[int, int]:
+        return (self.comm.cid, self.win_seq)
+
+    def post(self, group) -> None:
+        # PSCW is legal in either order (post-then-start or
+        # start-then-post on a process that is both target and
+        # origin), so an open PSCW access epoch does not forbid
+        # opening the exposure side
+        self._require(_EpochKind.NONE, _EpochKind.PSCW)
+        if self._group_exposed is not None:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           "post() with an exposure epoch already open")
+        self._group_exposed = group
+        self._epoch = _EpochKind.PSCW
+        for p in self._procs_of_group(group):
+            if p == self.my_pidx:
+                self.service.pscw_record(self.service._posts,
+                                         self._key(), self.my_pidx)
+            else:
+                self.service.notify(p, self, KIND_POST)
+
+    def start(self, group) -> None:
+        self._require(_EpochKind.NONE, _EpochKind.PSCW)
+        targets = self._procs_of_group(group)
+        self.service.pscw_await(self.service._posts, self._key(),
+                                targets, "start")
+        self._start_procs = targets
+        self._epoch = _EpochKind.PSCW
+
+    def complete(self) -> None:
+        self._require(_EpochKind.PSCW)
+        self._apply_pending()  # ships + acks every remote batch first
+        for p in getattr(self, "_start_procs", []):
+            if p == self.my_pidx:
+                self.service.pscw_record(self.service._completes,
+                                         self._key(), self.my_pidx)
+            else:
+                self.service.notify(p, self, KIND_COMPLETE)
+        self._start_procs = []
+        # keep the epoch open while the exposure side is: a fence()
+        # slipped between complete() and wait() must still raise
+        self._epoch = (_EpochKind.NONE if self._group_exposed is None
+                       else _EpochKind.PSCW)
+
+    def wait(self) -> None:
+        if self._group_exposed is None:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           "wait() without a matching post()")
+        accessors = self._procs_of_group(self._group_exposed)
+        self.service.pscw_await(self.service._completes, self._key(),
+                                accessors, "wait")
+        if self._epoch is _EpochKind.PSCW:
+            self._apply_pending()
+            self._epoch = _EpochKind.NONE
+        self._group_exposed = None
+
+    def test(self) -> bool:
+        """MPI_Win_test: True (and the exposure closes, like wait)
+        exactly when every accessor process's COMPLETE has arrived —
+        a non-consuming peek otherwise."""
+        if self._group_exposed is None:
+            raise MPIError(ErrorCode.ERR_RMA_SYNC,
+                           "test() without a matching post()")
+        accessors = self._procs_of_group(self._group_exposed)
+        if not self.service.pscw_check(self.service._completes,
+                                       self._key(), accessors):
+            return False
+        self.wait()  # consumes the notices; will not block
+        return True
+
+    def free(self) -> None:
+        super().free()
+        # mirror-image of the creation barrier: peers may still have
+        # in-flight release batches bound for this home — unregistering
+        # before they land would drop them (no reply -> the peer stalls
+        # its full request timeout mid-free)
+        self.comm.barrier()
+        self.service.unregister(self)
+
+    def shared_query(self, rank: int):
+        raise MPIError(
+            ErrorCode.ERR_RMA_SHARED,
+            "shared windows cannot span controller processes "
+            "(device buffers are per-process); use a "
+            "split_type_shared communicator",
+        )

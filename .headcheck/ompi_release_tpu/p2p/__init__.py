@@ -1,0 +1,17 @@
+"""Point-to-point engine — the pml/bml/btl stack reimagined for TPU.
+
+Two paths, mirroring SURVEY §2.4 item 1:
+
+- ``spmd``: static ppermute schedules compiled into XLA programs — the
+  performance path for fixed communication patterns (rings, halos,
+  pipeline stages).
+- ``pml``: MPI dynamic semantics — (rank, tag, comm) matching with
+  wildcards, unexpected-message queue, eager/rendezvous/pipelined
+  transfer scheduling — executed as host-orchestrated device-to-device
+  transfers (the ``btl/tpu`` data mover).
+"""
+
+from . import pml, spmd  # noqa: F401
+from .pml import (  # noqa: F401
+    ANY_SOURCE, ANY_TAG, PmlEngine,
+)

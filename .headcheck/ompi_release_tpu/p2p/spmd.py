@@ -1,0 +1,43 @@
+"""Static P2P schedules — compiled ppermute data plane.
+
+The TPU-native form of a fixed send/recv pattern is a permutation
+compiled into the surrounding XLA program (the reference's isend/irecv
+schedule in ``coll_tuned_util.c:50-59`` becomes one ppermute); use
+these inside shard_map. The host PML (``pml.py``) is for dynamic
+patterns only.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+from jax import lax
+
+
+def sendrecv(x: jax.Array, perm: Sequence[Tuple[int, int]],
+             axis_name: str) -> jax.Array:
+    """MPI_Sendrecv over a static pattern: each (src, dst) pair is one
+    edge; ranks not receiving get zeros (ppermute semantics)."""
+    return lax.ppermute(x, axis_name, list(perm))
+
+
+def ring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
+    """Rotate values around the ring by ``shift`` (ring_c.c pattern)."""
+    n = lax.psum(1, axis_name)
+    return lax.ppermute(
+        x, axis_name, [(i, (i + shift) % n) for i in range(n)]
+    )
+
+
+def halo_exchange(x: jax.Array, axis_name: str) -> Tuple[jax.Array, jax.Array]:
+    """Neighbor exchange: returns (from_left, from_right) for a 1-D
+    non-periodic decomposition; boundary ranks receive zeros."""
+    n = lax.psum(1, axis_name)
+    from_left = lax.ppermute(
+        x, axis_name, [(i, i + 1) for i in range(n - 1)]
+    )
+    from_right = lax.ppermute(
+        x, axis_name, [(i + 1, i) for i in range(n - 1)]
+    )
+    return from_left, from_right

@@ -1,0 +1,22 @@
+"""Parallelism-strategy layers built on the communication substrate.
+
+The reference is a message-passing substrate with no model layer; the
+strategies here are the first-class demo layers SURVEY §2.4 requires,
+each built on the communication pattern the reference provides for it:
+
+  DP  — ring/bucketed gradient allreduce (coll_tuned_allreduce.c:361)
+  TP  — sharded matmul + psum/all_gather (coll_tuned_allgather.c)
+  PP  — stage-to-stage ppermute rings (examples/ring_c.c:39-61)
+  SP  — Ulysses head<->sequence all-to-all (coll_tuned_alltoall.c)
+  CP  — ring attention: blockwise K/V rotation (ring allreduce pattern,
+        coll_tuned_allreduce.c:297-361)
+  EP  — expert token routing all-to-all (coll_tuned_alltoallv.c)
+  ZeRO — reduce_scatter gradient/optimizer sharding
+        (coll_tuned_reduce_scatter.c)
+"""
+
+from .mesh_axes import (  # noqa: F401
+    AXIS_DP, AXIS_PP, AXIS_TP, AXIS_SP, AXIS_EP,
+    build_parallel_mesh, axis_size_or_1,
+)
+from . import dp, tp, pp, sp, cp, ep, zero  # noqa: F401

@@ -1,0 +1,105 @@
+"""Context parallelism — ring attention over a ppermute K/V ring.
+
+The reference's ring-allreduce pass structure
+(``coll_tuned_allreduce.c:297-361``) applied to attention: each rank
+holds one block of the sequence; K/V blocks rotate around the ring
+while every rank accumulates its queries' attention against each
+passing block with an online (flash-style) softmax — numerically exact,
+memory O(block), and the ppermute overlaps with the block matmuls
+inside one compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask):
+    """One Q-block x K/V-block partial attention.
+
+    q: (Hq, Sq, D), k/v: (Hkv, Sk, D); mask: (Sq, Sk) bool or None.
+    Returns (out_unnorm, row_max, row_sumexp) for online combination.
+    """
+    scores = jnp.einsum(
+        "hqd,hkd->hqk", q, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask[None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)  # (H, Sq)
+    # rows that are fully masked contribute nothing (exp underflows to 0)
+    p = jnp.exp(scores - jnp.maximum(m, NEG_INF / 2)[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("hqk,hkd->hqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str = "sp", causal: bool = False) -> jax.Array:
+    """Exact blockwise attention with K/V rotating over the ring.
+
+    q/k/v: (H, S/n, D) per rank — rank i holds global positions
+    [i*Sb, (i+1)*Sb). Returns (H, S/n, D) in q.dtype.
+    """
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    h, sb, d = q.shape
+    # send backwards so at step s the resident block originated at rank+s
+    back = [(i, (i - 1) % n) for i in range(n)]
+
+    qpos = rank * sb + jnp.arange(sb)
+
+    from .mesh_axes import vary_like
+
+    acc = vary_like(jnp.zeros((h, sb, d), jnp.float32), q)
+    row_m = vary_like(jnp.full((h, sb), NEG_INF, jnp.float32), q)
+    row_l = vary_like(jnp.zeros((h, sb), jnp.float32), q)
+
+    def step(carry, s):
+        acc, row_m, row_l, kc, vc = carry
+        src = (rank + s) % n  # owner of the resident K/V block
+        kpos = src * sb + jnp.arange(sb)
+        mask = (qpos[:, None] >= kpos[None, :]) if causal else None
+        out, m, l = _block_attn(q, kc, vc, mask)
+        new_m = jnp.maximum(row_m, m)
+        alpha = jnp.exp(row_m - new_m)  # rescale old accumulator
+        beta = jnp.exp(m - new_m)  # rescale incoming block
+        acc = acc * alpha[..., None] + out * beta[..., None]
+        row_l = row_l * alpha + l * beta
+        if n > 1:
+            kc = lax.ppermute(kc, axis_name, back)
+            vc = lax.ppermute(vc, axis_name, back)
+        return (acc, new_m, row_l, kc, vc), None
+
+    (acc, _, row_l, _, _), _ = lax.scan(
+        step, (acc, row_m, row_l, k, v), jnp.arange(n)
+    )
+    # fully-masked rows (none under causal self-attn) would have l==0
+    out = acc / jnp.maximum(row_l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def local_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                          causal: bool = False,
+                          block: Optional[int] = None) -> jax.Array:
+    """Single-device blockwise-exact attention (the n=1 reference for
+    ring_attention parity tests and the inner attn for Ulysses).
+
+    q/k/v: (H, S, D).
+    """
+    h, s, d = q.shape
+    scores = jnp.einsum("hqd,hkd->hqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(d).astype(jnp.float32)
+    if causal:
+        i = jnp.arange(s)
+        scores = jnp.where(i[:, None] >= i[None, :], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("hqk,hkd->hqd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

@@ -1,0 +1,189 @@
+"""Expert parallelism — capacity-bounded token routing over all-to-all.
+
+The alltoallv pattern (``coll_tuned_alltoallv.c``) made static-shape
+for XLA: top-1 (switch) routing with a fixed per-expert capacity so the
+dispatch/combine tensors have compile-time shapes; the two
+``lax.all_to_all`` calls move each token to its expert's rank and back.
+Tokens over capacity are dropped (standard switch-transformer
+semantics) and their outputs fall back to zero (residual carries them).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _one_hot_dispatch(logits: jax.Array, n_experts: int, capacity: int
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Build (dispatch, combine) for top-1 routing.
+
+    logits: (T, E). dispatch: (T, E, C) one-hot slot assignment;
+    combine: (T, E, C) = dispatch * gate prob.
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    eh = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)  # (T, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(eh, axis=0) * eh - eh  # (T, E), valid where eh==1
+    keep = (pos < capacity) & (eh == 1)
+    slot = jnp.where(keep, pos, 0)
+    dispatch = (
+        jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        * keep[..., None]
+    )  # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
+              expert_params, *, axis_name: str = "ep",
+              capacity_factor: float = 1.25) -> Tuple[jax.Array, jax.Array]:
+    """Switch-MoE layer under shard_map over the ep axis.
+
+    x: (T, D) this rank's tokens; router_w: (D, E_global) replicated;
+    expert_params: this rank's local experts' params with leading axis
+    E_local; ``expert_fn(params_e, tokens) -> tokens`` applied per local
+    expert via vmap. Returns (output (T, D), aux_loss scalar).
+    """
+    n = lax.psum(1, axis_name)
+    t, dmodel = x.shape
+    e_global = router_w.shape[1]
+    if e_global % n:
+        raise ValueError(f"{e_global} experts not divisible by ep={n}")
+    e_local = e_global // n
+    capacity = max(1, int(capacity_factor * t / e_global))
+
+    logits = jnp.matmul(x, router_w, preferred_element_type=jnp.float32)
+    dispatch, combine = _one_hot_dispatch(logits, e_global, capacity)
+
+    # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e.
+    # f_e counts router argmax assignments BEFORE capacity dropping —
+    # using the post-drop dispatch would clamp an overloaded expert's
+    # fraction at capacity, weakening the balancing gradient exactly
+    # when that expert overflows.
+    probs = jax.nn.softmax(logits, axis=-1)
+    pre_drop = jax.nn.one_hot(jnp.argmax(logits, axis=-1), e_global,
+                              dtype=jnp.float32)
+    frac_tokens = jnp.mean(pre_drop, axis=0)  # (E,)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e_global * jnp.sum(frac_tokens * frac_probs)
+    aux = lax.pmean(aux, axis_name)
+
+    # local tokens -> (E, C, D) expert queues
+    sent = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # route: (E, C, D) -> (n, E_local, C, D): each rank keeps its experts'
+    # queues from every peer
+    sent = sent.reshape(n, e_local, capacity, dmodel)
+    recv = lax.all_to_all(sent, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)  # (n, E_local, C, D)
+    # run local experts over all peers' tokens
+    per_expert = recv.transpose(1, 0, 2, 3).reshape(
+        e_local, n * capacity, dmodel
+    ).astype(x.dtype)
+    done = jax.vmap(expert_fn)(expert_params, per_expert)
+    done = done.reshape(e_local, n, capacity, dmodel).transpose(1, 0, 2, 3)
+    # route back
+    back = lax.all_to_all(done.astype(jnp.float32), axis_name,
+                          split_axis=0, concat_axis=0, tiled=False)
+    back = back.reshape(e_global, capacity, dmodel)
+    out = jnp.einsum("tec,ecd->td", combine, back)
+    return out.astype(x.dtype), aux
+
+
+def dropless_moe(comm, tokens, assignments, expert_fn, n_experts: int):
+    """Dropless expert routing over alltoallv — uneven capacities.
+
+    The in-jit :func:`moe_layer` pays for static shapes with token
+    dropping; this driver-mode path is the exact-count alternative: the
+    per-(rank, rank) token counts become an alltoallv count matrix
+    (``coll_tuned_alltoallv.c``'s own use case, SURVEY §2.4 EP row), so
+    no token is ever dropped. (The compiled kernel under alltoallv
+    still pads each chunk to the max count — XLA needs static shapes —
+    so a heavily skewed load pays padding bandwidth; what this path
+    buys over moe_layer is exactness, not wire volume.)
+
+    tokens[i]: (T_i, D) rank i's tokens (ragged T_i); assignments[i]:
+    (T_i,) global expert ids; expert ``e`` lives on rank
+    ``e // (n_experts // n)``. ``expert_fn(e, x)`` applies expert e to
+    (K, D) tokens. Returns per-rank (T_i, D) outputs in original token
+    order.
+
+    On a communicator SPANNING controller processes (the unified
+    ``tpurun`` world) each process acts only as its LOCAL member
+    ranks: pass one tokens/assignments entry per local member (the
+    hier v-collective convention) and the count matrix is completed
+    with an allgather before routing.
+    """
+    import numpy as np
+
+    n = comm.size
+    if n_experts % n:
+        raise ValueError(f"{n_experts} experts not divisible by {n} ranks")
+    e_local = n_experts // n
+    acting = (list(comm.local_comm_ranks)
+              if getattr(comm, "spans_processes", False) else list(range(n)))
+    if len(tokens) != len(acting) or len(assignments) != len(acting):
+        raise ValueError(
+            f"dropless_moe: need one tokens and assignments entry per "
+            f"acting rank ({len(acting)}), got {len(tokens)} tokens / "
+            f"{len(assignments)} assignments"
+        )
+    toks = [np.asarray(t) for t in tokens]
+    # int32: expert ids are tiny, and 64-bit buffers do not traverse
+    # the collectives under x64-off (the narrowing refusal)
+    assign = [np.asarray(a).astype(np.int32) for a in assignments]
+    d = toks[0].shape[1] if toks[0].ndim == 2 else 1
+
+    # sort each acting rank's tokens by destination rank (stable keeps
+    # order within a destination — needed to invert the permutation)
+    owners = [a // e_local for a in assign]
+    order = [np.argsort(o, kind="stable") for o in owners]
+    local_counts = np.zeros((len(acting), n), dtype=np.int64)
+    for pos in range(len(acting)):
+        for j, k in zip(*np.unique(owners[pos], return_counts=True)):
+            local_counts[pos, int(j)] = int(k)
+    if len(acting) == n:
+        counts = local_counts
+    else:
+        # complete the (n, n) matrix: every process contributes its
+        # members' rows in comm-rank order (int32 on the wire — token
+        # counts fit comfortably, and the hier path refuses int64
+        # under x64-off rather than narrowing silently)
+        counts = np.asarray(
+            comm.allgather(local_counts.astype(np.int32))
+        )[0].reshape(n, n).astype(np.int64)
+
+    sendbufs = [toks[pos][order[pos]].reshape(-1)
+                for pos in range(len(acting))]
+    recv = comm.alltoallv(sendbufs, counts * d)
+    # forward the expert ids alongside (same counts, 1 elem per token)
+    recv_ids = comm.alltoallv(
+        [assign[pos][order[pos]] for pos in range(len(acting))], counts
+    )
+
+    # each acting rank runs its local experts on the exact token set
+    processed = []
+    for pos, j in enumerate(acting):
+        rt = np.asarray(recv[pos]).reshape(-1, d)
+        ids = np.asarray(recv_ids[pos])
+        out = np.empty_like(rt)
+        for e in range(j * e_local, (j + 1) * e_local):
+            sel = ids == e
+            if sel.any():
+                out[sel] = np.asarray(expert_fn(e, rt[sel]))
+        processed.append(out.reshape(-1))
+
+    # route back: the return counts matrix is the transpose
+    back = comm.alltoallv(processed, counts.T * d)
+    outputs = []
+    for pos in range(len(acting)):
+        sorted_out = np.asarray(back[pos]).reshape(-1, d)
+        inv = np.empty_like(order[pos])
+        inv[order[pos]] = np.arange(order[pos].shape[0])
+        outputs.append(jnp.asarray(sorted_out[inv]))
+    return outputs

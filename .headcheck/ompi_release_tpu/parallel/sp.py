@@ -1,0 +1,44 @@
+"""Sequence parallelism — Ulysses head<->sequence resharding.
+
+The alltoall pattern (``coll_tuned_alltoall.c``; DeepSpeed-Ulysses):
+attention needs full sequence per head, the rest of the model wants the
+sequence sharded. One ``lax.all_to_all`` flips between the two layouts,
+moving each (seq-block, head-block) tile exactly once over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def seq_to_heads(x: jax.Array, *, axis_name: str = "sp",
+                 seq_axis: int = 0, head_axis: int = 1) -> jax.Array:
+    """(S/n, H, ...) per rank -> (S, H/n, ...): gather the sequence,
+    shard the heads. H must be divisible by the sp axis size."""
+    return lax.all_to_all(
+        x, axis_name, split_axis=head_axis, concat_axis=seq_axis, tiled=True
+    )
+
+
+def heads_to_seq(x: jax.Array, *, axis_name: str = "sp",
+                 seq_axis: int = 0, head_axis: int = 1) -> jax.Array:
+    """Inverse reshard: (S, H/n, ...) -> (S/n, H, ...)."""
+    return lax.all_to_all(
+        x, axis_name, split_axis=seq_axis, concat_axis=head_axis, tiled=True
+    )
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      attn_fn, *, axis_name: str = "sp") -> jax.Array:
+    """Full Ulysses round trip: reshard q/k/v to head-sharded full
+    sequence, run ``attn_fn(q, k, v)`` (any local attention), reshard
+    the output back to sequence-sharded full heads.
+
+    q/k/v: (S/n, H, D) per rank.
+    """
+    qh = seq_to_heads(q, axis_name=axis_name)
+    kh = seq_to_heads(k, axis_name=axis_name)
+    vh = seq_to_heads(v, axis_name=axis_name)
+    oh = attn_fn(qh, kh, vh)  # (S, H/n, D)
+    return heads_to_seq(oh, axis_name=axis_name)

@@ -1,0 +1,128 @@
+"""Tensor parallelism: Megatron-style sharded linear layers + embedding.
+
+Built on the allreduce/allgather/reduce_scatter patterns of the
+reference (``coll_tuned_allgather.c``, ``coll_tuned_reduce_scatter.c``):
+a column-parallel matmul shards the output features (no communication),
+a row-parallel matmul shards the input features and psums partial
+products — the classic f/g conjugate pair. Matmuls accumulate in f32
+on the MXU (``preferred_element_type``) with bf16 storage.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.custom_jvp, nondiff_argnums=(1,))
+def _pmax_nograd(x, axis_name):
+    """pmax with a defined (zero) tangent: used for flash-softmax max
+    shifts, whose gradient cancels exactly; lax.pmax itself has no
+    differentiation rule."""
+    return lax.pmax(x, axis_name)
+
+
+@_pmax_nograd.defjvp
+def _pmax_nograd_jvp(axis_name, primals, tangents):
+    out = lax.pmax(primals[0], axis_name)
+    return out, jnp.zeros_like(out)
+
+
+def column_parallel(x: jax.Array, w_shard: jax.Array,
+                    b_shard: Optional[jax.Array] = None, *,
+                    axis_name: str = "tp",
+                    gather_output: bool = False) -> jax.Array:
+    """y_shard = x @ w_shard (+ b_shard).
+
+    x: (..., D) replicated over tp; w_shard: (D, F/n) this rank's output
+    columns. With ``gather_output`` the full (..., F) is all_gathered
+    (MPI_Allgather over the tp axis).
+    """
+    y = jnp.matmul(x, w_shard, preferred_element_type=jnp.float32)
+    y = y.astype(x.dtype)
+    if b_shard is not None:
+        y = y + b_shard
+    if gather_output:
+        y = lax.all_gather(y, axis_name, axis=y.ndim - 1, tiled=True)
+    return y
+
+
+def row_parallel(x_shard: jax.Array, w_shard: jax.Array,
+                 b: Optional[jax.Array] = None, *,
+                 axis_name: str = "tp",
+                 scatter_output: bool = False) -> jax.Array:
+    """y = psum_tp(x_shard @ w_shard) (+ b).
+
+    x_shard: (..., F/n) — exactly what column_parallel produced;
+    w_shard: (F/n, D) this rank's input rows. The psum is the MPI
+    allreduce of partial products; with ``scatter_output`` it becomes a
+    reduce_scatter over the leading dim (sequence) instead — the
+    ZeRO/sequence-parallel fusion that halves ICI traffic.
+    """
+    part = jnp.matmul(x_shard, w_shard, preferred_element_type=jnp.float32)
+    part = part.astype(x_shard.dtype)
+    if scatter_output:
+        y = lax.psum_scatter(part, axis_name, scatter_dimension=0, tiled=True)
+    else:
+        y = lax.psum(part, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def vocab_parallel_embedding(ids: jax.Array, table_shard: jax.Array, *,
+                             axis_name: str = "tp") -> jax.Array:
+    """Embedding with the vocab dimension sharded over tp.
+
+    table_shard: (V/n, D). Each rank looks up only ids in its vocab
+    range (out-of-range rows contribute zeros) and the psum assembles
+    the full lookup — one fused collective instead of a host gather.
+    """
+    n = lax.psum(1, axis_name)
+    vshard = table_shard.shape[0]
+    start = lax.axis_index(axis_name) * vshard
+    local = ids - start
+    in_range = (local >= 0) & (local < vshard)
+    rows = jnp.take(table_shard, jnp.clip(local, 0, vshard - 1), axis=0)
+    rows = jnp.where(in_range[..., None], rows, jnp.zeros_like(rows))
+    return lax.psum(rows, axis_name) if n > 1 else rows
+
+
+def vocab_parallel_logits(h: jax.Array, table_shard: jax.Array, *,
+                          axis_name: str = "tp",
+                          gather: bool = True) -> jax.Array:
+    """Tied-embedding LM head: logits over the sharded vocab."""
+    logits = jnp.matmul(h, table_shard.T, preferred_element_type=jnp.float32)
+    if gather:
+        logits = lax.all_gather(
+            logits, axis_name, axis=logits.ndim - 1, tiled=True
+        )
+    return logits
+
+
+def vocab_parallel_xent(h: jax.Array, table_shard: jax.Array,
+                        targets: jax.Array, *,
+                        axis_name: str = "tp") -> jax.Array:
+    """Cross-entropy over a vocab-sharded LM head WITHOUT materializing
+    the full (..., V) logits: per-shard max/sum-exp + target-row dot are
+    each one psum/pmax — the flash-softmax of the loss layer.
+    """
+    logits = jnp.matmul(h, table_shard.T, preferred_element_type=jnp.float32)
+    # the max shift is for numerical stability only — its gradient
+    # cancels exactly, so it carries a zero tangent
+    m = _pmax_nograd(jnp.max(logits, axis=-1), axis_name)
+    se = lax.psum(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), axis_name)
+
+    vshard = table_shard.shape[0]
+    start = lax.axis_index(axis_name) * vshard
+    local = targets - start
+    in_range = (local >= 0) & (local < vshard)
+    tgt_logit = jnp.take_along_axis(
+        logits, jnp.clip(local, 0, vshard - 1)[..., None], axis=-1
+    )[..., 0]
+    tgt_logit = lax.psum(jnp.where(in_range, tgt_logit, 0.0), axis_name)
+    return m + jnp.log(se) - tgt_logit  # -log p(target)

@@ -1,0 +1,83 @@
+"""ZeRO-style sharded optimizer state over the dp axis.
+
+The reduce_scatter_block pattern (``coll_tuned_reduce_scatter.c``;
+BASELINE.json config #4 "ZeRO-style gradient shard"): instead of every
+dp replica allreducing and holding full gradients + optimizer state,
+gradients are reduce_scattered so each replica owns 1/n of them,
+updates its shard, and all_gathers fresh params — same total ICI bytes
+as allreduce (reduce_scatter + allgather IS the ring allreduce), but
+optimizer memory drops by n.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pad_len(size: int, n: int) -> int:
+    return (-size) % n
+
+
+def shard_gradients(grads: Any, axis_name: str, *, mean: bool = True) -> Any:
+    """reduce_scatter every leaf over dp: returns rank's flat shard pytree
+    (leaf i -> 1-D array of ceil(size/n) elements)."""
+    n = lax.psum(1, axis_name)
+
+    def rs(g):
+        flat = g.reshape(-1)
+        pad = _pad_len(flat.size, n)
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), g.dtype)])
+        out = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                               tiled=True)
+        return out / n if mean and jnp.issubdtype(g.dtype, jnp.inexact) else out
+
+    return jax.tree.map(rs, grads)
+
+
+def unshard_params(param_shards: Any, shapes: Any, axis_name: str) -> Any:
+    """all_gather each flat shard back to the full (reshaped) leaf."""
+    def ag(shard, shape):
+        full = lax.all_gather(shard, axis_name, axis=0, tiled=True)
+        size = 1
+        for d in shape:
+            size *= d
+        return full[:size].reshape(shape)
+
+    return jax.tree.map(ag, param_shards, shapes)
+
+
+def shard_like(params: Any, axis_name: str) -> Any:
+    """Slice each leaf to this rank's flat shard (for building sharded
+    optimizer state at init)."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+
+    def sl(p):
+        flat = p.reshape(-1)
+        pad = _pad_len(flat.size, n)
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), p.dtype)])
+        chunk = flat.size // n
+        return lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
+
+    return jax.tree.map(sl, params)
+
+
+def zero_step(params: Any, grads: Any, opt_state_shards: Any, opt_update,
+              axis_name: str) -> Tuple[Any, Any]:
+    """One ZeRO-1 step: shard grads, update the owned shard, regather.
+
+    ``opt_update(grad_shard_tree, state_shards, param_shard_tree)`` must
+    follow optax's transform signature over the flat-shard pytrees.
+    """
+    gshards = shard_gradients(grads, axis_name)
+    pshards = shard_like(params, axis_name)
+    updates, new_state = opt_update(gshards, opt_state_shards, pshards)
+    new_pshards = jax.tree.map(lambda p, u: p + u, pshards, updates)
+    shapes = jax.tree.map(lambda p: p.shape, params)
+    return unshard_params(new_pshards, shapes, axis_name), new_state

@@ -1,0 +1,195 @@
+"""Device mesh construction + modex — the ORTE wire-up analogue.
+
+The reference's ESS/RAS/RMAPS pipeline discovers the allocation, maps
+procs onto nodes, and exchanges contact info (the *modex*,
+``orte/mca/grpcomm/base/grpcomm_base_modex.c:67,201``). On TPU the
+"allocation" is the device set jax exposes, "mapping" is laying ranks
+onto a ``jax.sharding.Mesh`` whose axes ride the physical ICI torus,
+and the modex is an allgather of per-device endpoint records
+{rank, host process, coords, platform} — device coordinates replace
+TCP business cards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..mca import var as mca_var
+from ..utils import output
+
+_log = output.stream("mesh")
+
+
+@dataclasses.dataclass(frozen=True)
+class Endpoint:
+    """One participant's modex record (the business-card analogue)."""
+
+    rank: int
+    device_id: int
+    process_index: int  # host process (multi-host: one per host)
+    platform: str
+    device_kind: str
+    coords: Tuple[int, ...]  # physical coords if exposed, else mesh coords
+    slice_index: int = 0
+    host: str = ""  # machine identity: same-host cross-process peers
+    #                 can hand buffers off through shared memory
+
+    def describe(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def factorize_torus(n: int, ndims: int) -> Tuple[int, ...]:
+    """Balanced factorization of ``n`` into ``ndims`` dims (MPI_Dims_create).
+
+    Mirrors the reference's dims_create semantics: dims as close to each
+    other as possible, sorted non-increasing.
+    """
+    if ndims <= 0:
+        raise ValueError("ndims must be >= 1")
+    dims = [1] * ndims
+    # greedy: repeatedly assign the largest prime factor to the smallest dim
+    factors: List[int] = []
+    m = n
+    p = 2
+    while p * p <= m:
+        while m % p == 0:
+            factors.append(p)
+            m //= p
+        p += 1
+    if m > 1:
+        factors.append(m)
+    for f in sorted(factors, reverse=True):
+        dims[dims.index(min(dims))] *= f
+    return tuple(sorted(dims, reverse=True))
+
+
+def register_vars() -> None:
+    mca_var.register(
+        "rmaps_mesh_shape", "str", "",
+        "Explicit mesh shape as comma list (e.g. '4,2'); empty = auto 1D",
+    )
+    mca_var.register(
+        "rmaps_mesh_axes", "str", "world",
+        "Comma list of mesh axis names matching rmaps_mesh_shape",
+    )
+    # NOTE: no oversubscription variable — a jax Mesh requires unique
+    # devices, so ranks-per-device wrapping (mpirun oversubscription)
+    # has no TPU analogue; the simulator backend (forced host device
+    # count) covers the reference's oversubscribed-test use case.
+
+
+def device_coords(dev) -> Tuple[int, ...]:
+    """Physical coords when the platform exposes them (TPU does)."""
+    c = getattr(dev, "coords", None)
+    if c is not None:
+        try:
+            return tuple(int(x) for x in c)
+        except TypeError:
+            pass
+    return (int(dev.id),)
+
+
+def build_mesh(
+    devices: Optional[Sequence] = None,
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+) -> Mesh:
+    """Build the world mesh.
+
+    Defaults: all visible devices on a 1-D ``world`` axis. An explicit
+    shape (from args or the ``rmaps_mesh_shape`` variable) lays the same
+    devices out as a torus; jax device order already follows the
+    physical ICI torus for TPU slices, so contiguous reshapes keep
+    neighbors physically adjacent.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+
+    if shape is None:
+        spec = (mca_var.get("rmaps_mesh_shape") or "").strip()
+        if spec:
+            shape = tuple(int(s) for s in spec.split(","))
+    if shape is None:
+        shape = (n,)
+    shape = tuple(int(s) for s in shape)
+    if math.prod(shape) != n:
+        raise ValueError(
+            f"mesh shape {shape} does not cover {n} devices"
+        )
+
+    if axis_names is None:
+        spec = (mca_var.get("rmaps_mesh_axes") or "world").strip()
+        names = [s.strip() for s in spec.split(",") if s.strip()]
+        if len(names) != len(shape):
+            names = (
+                ["world"]
+                if len(shape) == 1
+                else [f"axis{i}" for i in range(len(shape))]
+            )
+        axis_names = names
+
+    dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    mesh = Mesh(dev_array, tuple(axis_names))
+    _log.verbose(
+        1,
+        f"built mesh shape={shape} axes={tuple(axis_names)} "
+        f"platform={devices[0].platform}",
+    )
+    return mesh
+
+
+def build_submesh(world_mesh: Mesh, world_ranks: Sequence[int]) -> Mesh:
+    """1-D sub-mesh over the given world ranks, in group order.
+
+    Group order defines the collective's rank order (MPI semantics);
+    jax's device order inside the sub-mesh array defines how XLA routes
+    the collective over ICI. Keeping group order here preserves MPI
+    rank numbering; XLA still picks ICI-optimal routes for the ring.
+    """
+    flat = list(world_mesh.devices.reshape(-1))
+    devs = np.asarray([flat[r] for r in world_ranks], dtype=object)
+    return Mesh(devs, ("rank",))
+
+
+def run_modex(mesh: Mesh) -> List[Endpoint]:
+    """Allgather endpoint records for every mesh position.
+
+    Single-controller: all device handles are visible in-process, so
+    the allgather is a local enumeration (multi-host jax runs this
+    after ``jax.distributed.initialize`` where ``jax.devices()`` is
+    already the global view — the allgather the reference does over
+    its daemon tree is done by the jax runtime during init).
+    """
+    import socket
+
+    flat = list(mesh.devices.reshape(-1))
+    hostname = socket.gethostname()
+    my_process = jax.process_index()
+    endpoints = []
+    for rank, dev in enumerate(flat):
+        pidx = int(getattr(dev, "process_index", 0))
+        endpoints.append(
+            Endpoint(
+                rank=rank,
+                device_id=int(dev.id),
+                process_index=pidx,
+                platform=str(dev.platform),
+                device_kind=str(getattr(dev, "device_kind", "unknown")),
+                coords=device_coords(dev),
+                slice_index=int(getattr(dev, "slice_index", 0) or 0),
+                # only claim OUR host for our own process's devices; a
+                # peer process's hostname comes from its modex card
+                # (coordinator wire-up), never assumed
+                host=hostname if pidx == my_process else "",
+            )
+        )
+    return endpoints

@@ -1,0 +1,204 @@
+"""Shared pubsub wire protocol — one implementation for every server.
+
+The name-service protocol (seq-correlated publish/lookup/unpublish
+frames over the OOB, parked lookups with client-supplied TTLs) is
+served by TWO hosts: a tpurun job's HNP (``coordinator.py``, the
+pubsub_orte role for the job's own workers) and the standalone
+cross-job ``tpu-server`` (the orte-server role). Both instantiate
+:class:`PubsubTable` and drive :func:`serve_once`; clients share
+:func:`pubsub_rpc`. One wire format, one parking/pruning policy — a
+protocol change lands in exactly one place.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Tuple
+
+from ..native import DssBuffer
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+
+_log = output.stream("pubsub")
+
+TAG_PUBLISH = 9       # client->server: publish service name
+TAG_LOOKUP = 10       # client->server: lookup service name
+TAG_PUBSUB_REPLY = 11  # server->client: response (seq-correlated)
+TAG_UNPUBLISH = 12    # client->server: unpublish service name
+
+SERVE_TAGS = (TAG_PUBLISH, TAG_LOOKUP, TAG_UNPUBLISH)
+
+
+class PubsubTable:
+    """Server-side name table + parked lookups (pubsub_orte core)."""
+
+    def __init__(self, ep) -> None:
+        self.ep = ep
+        self.names: Dict[str, str] = {}
+        # service -> [(client_id, seq, expire_at)]
+        self.waiters: Dict[str, List[Tuple[int, int, float]]] = {}
+        # per-instance so subclasses can serve extra RPCs (the
+        # tpu_server metrics page) without widening every host
+        self.serve_tags: List[int] = list(SERVE_TAGS)
+
+    def _reply(self, nid: int, seq: int, ok: bool, value: str) -> None:
+        frame = DssBuffer()
+        frame.pack_int64(seq)
+        frame.pack_int64(1 if ok else 0)
+        frame.pack_string(value)
+        try:
+            self.ep.send(nid, TAG_PUBSUB_REPLY, frame.tobytes())
+        except MPIError:
+            _log.verbose(1, f"pubsub reply to {nid} failed")
+
+    def prune(self) -> None:
+        """Drop parked lookups whose client gave up (the lookup frame
+        carries the client's deadline, so abandoned waiters cannot
+        accumulate)."""
+        now = time.monotonic()
+        for service in list(self.waiters):
+            alive = [w for w in self.waiters[service] if w[2] > now]
+            if alive:
+                self.waiters[service] = alive
+            else:
+                del self.waiters[service]
+
+    def handle(self, tag: int, src: int, raw: bytes) -> None:
+        b = DssBuffer(raw)
+        (seq,) = b.unpack_int64()
+        service = b.unpack_string()
+        if tag == TAG_PUBLISH:
+            port = b.unpack_string()
+            if service in self.names:
+                self._reply(src, seq, False, "already published")
+                return
+            self.names[service] = port
+            self._reply(src, seq, True, port)
+            for wnid, wseq, _exp in self.waiters.pop(service, []):
+                self._reply(wnid, wseq, True, port)
+        elif tag == TAG_UNPUBLISH:
+            ok = self.names.pop(service, None) is not None
+            self._reply(src, seq, ok, service)
+        else:  # TAG_LOOKUP
+            ttl_ms = int(b.unpack_string())
+            port = self.names.get(service)
+            if port is not None:
+                self._reply(src, seq, True, port)
+            else:
+                expire = time.monotonic() + ttl_ms / 1000
+                self.waiters.setdefault(service, []).append(
+                    (src, seq, expire)
+                )
+
+    def serve_once(self, timeout_ms: int = 50) -> None:
+        """One serve iteration: prune, then drain one frame per tag.
+        One malformed frame must not kill the service."""
+        self.prune()
+        for tag in self.serve_tags:
+            try:
+                src, _, raw = self.ep.recv(tag=tag,
+                                           timeout_ms=timeout_ms)
+            except MPIError:
+                continue
+            try:
+                self.handle(tag, src, raw)
+            except Exception as exc:
+                _log.verbose(1, f"dropping bad pubsub frame from "
+                                f"{src}: {exc}")
+
+    def serve_loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            self.serve_once()
+
+
+def pubsub_rpc(ep, lock: threading.Lock, seq_holder, tag: int,
+               *fields: str, server_id: int = 0,
+               timeout_ms: int = 10_000) -> Tuple[bool, str]:
+    """Client side: send one request, wait for OUR seq's reply.
+
+    Concurrent RPCs on one endpoint do NOT serialize behind each
+    other: replies are demultiplexed by seq through a shared stash —
+    one thread at a time plays receiver (condition-variable handoff),
+    parks replies that belong to other outstanding RPCs, and wakes
+    their owners. A publish issued while another thread's lookup is
+    parked server-side therefore completes immediately (and typically
+    unparks that very lookup) instead of waiting out its timeout.
+
+    ``lock`` protects only seq allocation + the request send (frame
+    ordering); ``seq_holder`` is any object with a mutable
+    ``pubsub_seq`` int attribute."""
+    with lock:
+        # mux creation under the lock: two first-RPC threads racing an
+        # unsynchronized check-then-set would mint two muxes and strand
+        # one thread's replies in the orphaned stash
+        state = getattr(ep, "_pubsub_mux", None)
+        if state is None:
+            state = ep._pubsub_mux = {
+                "cond": threading.Condition(),
+                "replies": {},      # seq -> (ok, value)
+                "receiving": False,  # one thread owns the recv at a time
+            }
+        seq_holder.pubsub_seq = getattr(seq_holder, "pubsub_seq", 0) + 1
+        seq = seq_holder.pubsub_seq
+        frame = DssBuffer()
+        frame.pack_int64(seq)
+        for f in fields:
+            frame.pack_string(f)
+        ep.send(server_id, tag, frame.tobytes())
+    cond = state["cond"]
+    deadline = time.monotonic() + timeout_ms / 1000
+    while True:
+        with cond:
+            if seq in state["replies"]:
+                ok, value = state["replies"].pop(seq)
+                return bool(ok), value
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise MPIError(
+                    ErrorCode.ERR_PENDING,
+                    f"pubsub rpc seq={seq} timed out",
+                )
+            if state["receiving"]:
+                # another thread is on the wire; it will park our
+                # reply and wake us
+                cond.wait(timeout=min(left, 0.5))
+                continue
+            state["receiving"] = True
+        got_seq = None
+        try:
+            left_ms = max(1, int((deadline - time.monotonic()) * 1000))
+            _, _, raw = ep.recv(tag=TAG_PUBSUB_REPLY,
+                                timeout_ms=min(left_ms, 500))
+            try:
+                b = DssBuffer(raw)
+                (got_seq,) = b.unpack_int64()
+                (ok,) = b.unpack_int64()
+                value = b.unpack_string()
+            except Exception:
+                # one garbled reply frame must cost only that frame —
+                # never wedge the receiver handoff for the process
+                _log.verbose(1, "dropping malformed pubsub reply")
+                got_seq = None
+        except MPIError:
+            if time.monotonic() >= deadline:
+                with cond:
+                    state["receiving"] = False
+                    cond.notify_all()
+                raise MPIError(
+                    ErrorCode.ERR_PENDING,
+                    f"pubsub rpc seq={seq} timed out",
+                )
+        with cond:
+            state["receiving"] = False
+            if got_seq is not None:
+                if got_seq == seq:
+                    cond.notify_all()
+                    return bool(ok), value
+                # another outstanding RPC's reply: park it and wake
+                # its owner; cap the stash so replies to long-dead
+                # RPCs cannot accumulate
+                state["replies"][int(got_seq)] = (int(ok), value)
+                if len(state["replies"]) > 64:
+                    state["replies"].pop(next(iter(state["replies"])))
+            cond.notify_all()

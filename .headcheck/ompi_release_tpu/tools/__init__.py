@@ -1,0 +1,1 @@
+"""CLI tools — the ``ompi/tools`` + ``orte/tools`` analogue."""

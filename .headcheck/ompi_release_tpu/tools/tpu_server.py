@@ -1,0 +1,173 @@
+"""tpu-server — the standalone ``orte-server`` analogue.
+
+The reference's cross-job dynamics need a name server that OUTLIVES
+any one job: ``orte-server`` (``orte/tools/orte-server``) hosts the
+``pubsub/orte`` name table so two independently-launched mpirun jobs
+can MPI_Publish_name / MPI_Lookup_name each other
+(``ompi/mca/pubsub/orte/pubsub_orte.c``). A tpurun job's HNP already
+serves names for its OWN workers; this tool is the job-independent
+server: any process (from any job) connects with a :class:`NameClient`
+and publishes/looks up over the same seq-correlated frame protocol.
+
+Beyond names, the server answers a ``metrics`` RPC (TAG_METRICS): the
+Prometheus text exposition of every pvar registered in the server
+process (``obs/export.py``), so ``tpu_top --metrics host:port`` (or
+any scraper speaking the frame protocol) can watch the observability
+plane live.
+
+Usage::
+
+    python -m ompi_release_tpu.tools.tpu_server [--port P] [--bind A]
+    # prints "tpu-server URI: host:port" then serves until SIGINT
+
+    client = NameClient("hostA", 45123)
+    client.publish("my-service", port_str)
+    port = client.lookup("my-service", timeout_ms=20000)
+    page = client.metrics()          # Prometheus text page
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ..native import DssBuffer, OobEndpoint
+from ..runtime.coordinator import local_addr_toward
+from ..runtime.pubsub import (PubsubTable, TAG_LOOKUP, TAG_PUBLISH,
+                              TAG_UNPUBLISH)
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+
+_log = output.stream("tpu-server")
+
+TAG_METRICS = 13  # client->server: Prometheus pvar exposition request
+
+
+class MetricsPubsubTable(PubsubTable):
+    """Name table + the ``metrics`` RPC: TAG_METRICS frames (seq only)
+    are answered with the Prometheus text page of every pvar registered
+    in this process, over the same seq-correlated reply channel."""
+
+    def __init__(self, ep) -> None:
+        super().__init__(ep)
+        self.serve_tags.append(TAG_METRICS)
+
+    def handle(self, tag: int, src: int, raw: bytes) -> None:
+        if tag != TAG_METRICS:
+            return super().handle(tag, src, raw)
+        b = DssBuffer(raw)
+        (seq,) = b.unpack_int64()
+        from ..obs import export as obs_export
+
+        self._reply(src, seq, True, obs_export.prometheus_text())
+
+
+class NameServer:
+    """Standalone name-table server: the shared runtime/pubsub.py
+    protocol on its own endpoint (no job attached)."""
+
+    def __init__(self, port: int = 0, bind_addr: str = "127.0.0.1") -> None:
+        self.ep = OobEndpoint(0, port, bind_addr)
+        self._table = MetricsPubsubTable(self.ep)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._table.serve_loop, args=(self._stop,),
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self.ep.port
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self.ep.close()
+
+
+class NameClient:
+    """A job-independent pubsub client (any process, any job).
+
+    Client ids are random high ints so clients from different jobs
+    (which all call their own rank "1") cannot collide on the
+    server's per-connection identity. The RPC protocol is the shared
+    runtime/pubsub.py helper (same as WorkerAgent's in-job client).
+    """
+
+    def __init__(self, host: str, port: int) -> None:
+        self.client_id = random.randrange(1 << 20, 1 << 30)
+        self.ep = OobEndpoint(self.client_id)
+        self.ep.connect(0, host, port)
+        self._lock = threading.Lock()
+
+    def _rpc(self, tag: int, *fields: str,
+             timeout_ms: int = 10_000) -> Tuple[bool, str]:
+        from ..runtime.pubsub import pubsub_rpc
+
+        return pubsub_rpc(self.ep, self._lock, self, tag, *fields,
+                          timeout_ms=timeout_ms)
+
+    def publish(self, service: str, port: str) -> None:
+        ok, msg = self._rpc(TAG_PUBLISH, service, port)
+        if not ok:
+            raise MPIError(ErrorCode.ERR_NAME,
+                           f"publish '{service}': {msg}")
+
+    def lookup(self, service: str, *, timeout_ms: int = 10_000) -> str:
+        ok, value = self._rpc(TAG_LOOKUP, service, str(timeout_ms),
+                              timeout_ms=timeout_ms)
+        if not ok:
+            raise MPIError(ErrorCode.ERR_NAME,
+                           f"lookup '{service}': {value}")
+        return value
+
+    def unpublish(self, service: str) -> None:
+        ok, _ = self._rpc(TAG_UNPUBLISH, service)
+        if not ok:
+            raise MPIError(ErrorCode.ERR_NAME,
+                           f"unpublish '{service}': not published")
+
+    def metrics(self, *, timeout_ms: int = 10_000) -> str:
+        """Prometheus text exposition of the server process's pvars."""
+        ok, text = self._rpc(TAG_METRICS, timeout_ms=timeout_ms)
+        if not ok:
+            raise MPIError(ErrorCode.ERR_NAME, f"metrics: {text}")
+        return text
+
+    def close(self) -> None:
+        self.ep.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-server",
+        description="Standalone cross-job name server (orte-server "
+                    "analogue)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="listen port (0 = ephemeral)")
+    ap.add_argument("--bind", default="0.0.0.0",
+                    help="listen address (default: all interfaces)")
+    args = ap.parse_args(argv)
+    srv = NameServer(args.port, args.bind)
+    # advertise an address clients can actually dial: the outward
+    # interface only when listening on all interfaces, else the bound
+    # address itself
+    host = (local_addr_toward("192.0.2.1") if args.bind == "0.0.0.0"
+            else args.bind)
+    print(f"tpu-server URI: {host}:{srv.port}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""tpu-top — refresh-loop entry point (``orte-top`` analogue).
+
+Default mode is tpu_ps's snapshot machinery on a loop
+(``python -m ompi_release_tpu.tools.tpu_top [-d SECS]``). With
+``--metrics HOST:PORT`` it instead polls a ``tpu_server``'s metrics
+RPC and renders the live Prometheus pvar page — the observability
+plane's terminal UI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+
+def _metrics_loop(target: str, delay: float, iterations: int) -> int:
+    from ..utils.errors import MPIError
+    from .tpu_server import NameClient
+
+    try:
+        host, port_s = target.rsplit(":", 1)
+        port = int(port_s)
+    except ValueError:
+        print(f"tpu-top: --metrics wants HOST:PORT, got {target!r}",
+              file=sys.stderr)
+        return 2
+    try:
+        client = NameClient(host, port)
+    except (MPIError, OSError) as e:
+        print(f"tpu-top: cannot reach tpu-server at {target}: {e}",
+              file=sys.stderr)
+        return 1
+    i = 0
+    try:
+        while True:
+            page = client.metrics()
+            sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty()
+                             else "")
+            # target stays out of the strftime format: a '%' in it
+            # (IPv6 zone-id hosts) would expand or raise
+            print("tpu-top pvars @ " + target + "  "
+                  + time.strftime("%H:%M:%S"))
+            print(page, end="" if page.endswith("\n") else "\n")
+            sys.stdout.flush()
+            i += 1
+            if iterations and i >= iterations:
+                return 0
+            time.sleep(delay)
+    except KeyboardInterrupt:
+        return 0
+    except (MPIError, OSError) as e:
+        print(f"tpu-top: metrics query to {target} failed: {e}",
+              file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu-top", add_help=False)
+    ap.add_argument("--metrics", default=None,
+                    help="render a tpu-server's live pvar page "
+                         "(host:port) instead of job snapshots")
+    args, rest = ap.parse_known_args(argv)
+    if args.metrics is None:
+        from .tpu_ps import main_top
+
+        return main_top(rest)
+    mp = argparse.ArgumentParser(prog="tpu-top --metrics HOST:PORT")
+    mp.add_argument("-d", "--delay", type=float, default=2.0,
+                    help="refresh interval in seconds")
+    mp.add_argument("--iterations", type=int, default=0,
+                    help="stop after N refreshes (0 = until SIGINT)")
+    ma = mp.parse_args(rest)
+    return _metrics_loop(args.metrics, ma.delay, ma.iterations)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,446 @@
+"""tpu-tune — measure collective algorithms and emit a dynamic rule
+file.
+
+The reference ships tuned's decision constants baked in and leaves the
+operator to hand-write a dynamic rules file
+(``ompi/mca/coll/tuned/coll_tuned_dynamic_file.c`` reads it; nothing
+generates it). This tool closes that loop: it times EVERY legal
+algorithm of each tunable collective at each sweep size on the actual
+device mesh, picks the winner, and writes a
+``coll/dynamic_rules.py``-format file whose comments carry the
+measurements that justify each rule — load it with::
+
+    --mca coll_tuned_use_dynamic_rules 1 \\
+    --mca coll_tuned_dynamic_rules_filename FILE
+
+Sizes in the emitted rules are each collective's own decision unit
+(per-rank bytes, total bytes for allgather, per-destination block for
+alltoall/scatter — the same units ``dynamic_rules.lookup`` is queried
+with; see that module's table).
+
+Timing protocol: the first call of every (algorithm, size) compiles
+the program AND primes the driver's plan cache; the measured repeats
+that follow therefore never include compile time. The compile cost is
+still reported — as a separate ``compile:`` field in the emitted
+rule-file comments — because an operator choosing between algorithms
+with similar steady-state times may care which one stalls the first
+iteration longer.
+
+``--segsizes`` additionally sweeps the pipeline segment size
+(``coll/pipeline.py``) for rows whose winner is pipeline-capable (ring
+allreduce, binomial bcast/reduce) and emits the winning value as the
+rule file's fifth ``segsize`` column (0 pins pipelining off when
+monolithic won), with the per-segsize measurements in a comment.
+
+Usage::
+
+    python -m ompi_release_tpu.tools.tpu_tune -o rules.conf \\
+        [--sizes 1024,65536,1048576] [--repeats 5] [--ops allreduce,...] \\
+        [--segsizes 65536,262144,1048576]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..mca import var as mca_var
+from ..utils import output
+
+_log = output.stream("tune")
+
+#: op -> (runner(comm, x), decision-unit bytes for per-rank bytes b
+#: and comm size n)
+_OPS: Dict[str, Tuple] = {
+    "allreduce": (lambda c, x: c.allreduce(x), lambda b, n: b),
+    "bcast": (lambda c, x: c.bcast(x, root=0), lambda b, n: b),
+    "reduce": (lambda c, x: c.reduce(x, root=0), lambda b, n: b),
+    "allgather": (lambda c, x: c.allgather(x), lambda b, n: b * n),
+    "alltoall": (lambda c, x: c.alltoall(x), lambda b, n: b // n),
+    "gather": (lambda c, x: c.gather(x, root=0), lambda b, n: b),
+    "scatter": (lambda c, x: c.scatter(x, root=0), lambda b, n: b // n),
+}
+
+
+def _algorithms(op: str) -> List[str]:
+    from ..coll import dynamic_rules
+
+    return [a for a in dynamic_rules.RULE_COLLECTIVES[op]
+            if a != "auto"]
+
+
+def _time_once(fn, comm, x) -> float:
+    import jax
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(comm, x))
+    return time.perf_counter() - t0
+
+
+def _tuned_dup(comm):
+    """A dup whose c_coll table is served by the tuned component:
+    ``coll_tuned_<op>_algorithm`` forcing and rule files only act
+    through the tuned pickers, while a default comm's chain is led by
+    xla (priority 100) — measuring there would time xla's one program
+    under every forced name and crown a noise winner."""
+    mca_var.set_value("coll", "tuned")
+    try:
+        return comm.dup(name="tune_tuned")
+    finally:
+        mca_var.VARS.unset("coll")
+
+
+def sweep_segsizes(comm, op: str, alg: str, x,
+                   segsizes: Sequence[int], repeats: int = 5
+                   ) -> Dict[int, float]:
+    """Time ``alg`` under each pipeline segment size (plus 0 = the
+    monolithic baseline); returns {segsize: best_seconds}. The cvar
+    under sweep is ``coll_pipeline_segsize`` — exactly what the
+    emitted rule's ``segsize`` column will set per matching call.
+
+    Dynamic rules are pinned OFF for the sweep: a live rules file's
+    segsize column outranks the swept cvar (pick_segsize: rules >
+    cvar), which would make every sweep point measure the same
+    configuration when re-tuning an already-tuned deployment.
+    Segment sizes >= the per-rank message are skipped — they compile
+    the identical monolithic program as 0 and would only let timer
+    noise crown a never-exercised value."""
+    runner, _ = _OPS[op]
+    var = f"coll_tuned_{op}_algorithm"
+    msg_bytes = int(x[0].size) * int(x.dtype.itemsize)
+    out: Dict[int, float] = {}
+    prev_rules = mca_var.get("coll_tuned_use_dynamic_rules", False)
+    prev_seg = mca_var.get("coll_pipeline_segsize", 1 << 20)
+    prev_alg = mca_var.get(var, "auto")
+    mca_var.set_value("coll_tuned_use_dynamic_rules", False)
+    mca_var.set_value(var, alg)
+    try:
+        for seg in [0] + [s for s in segsizes if 0 < s < msg_bytes]:
+            mca_var.set_value("coll_pipeline_segsize", seg)
+            try:
+                _time_once(runner, comm, x)  # compile + prime plan cache
+                out[seg] = min(
+                    _time_once(runner, comm, x) for _ in range(repeats)
+                )
+            except Exception as e:
+                _log.verbose(2, f"{op}/{alg} segsize {seg}: {e}")
+    finally:
+        # restore (not clobber): the operator may have forced their
+        # own algorithm/segsize before running tpu-tune
+        mca_var.set_value("coll_pipeline_segsize", prev_seg)
+        mca_var.set_value(var, prev_alg)
+        mca_var.set_value("coll_tuned_use_dynamic_rules", prev_rules)
+    return out
+
+
+def sweep_wire_segsizes(segsizes: Sequence[int],
+                        size_bytes: int = 16 << 20,
+                        repeats: int = 3) -> Dict[int, float]:
+    """Time ONE cross-process-shaped staged transfer through a real
+    loopback OOB endpoint pair at each ``wire_pipeline_segsize`` (0 =
+    the legacy monolithic ``tobytes()`` framing); returns
+    {segsize: best_seconds}. This sweeps the cvar the wire router's
+    DCN staging path reads (``DcnBtl.pipeline_segsize``), so the
+    emitted recommendation measures the exact send+reassemble code a
+    ``tpurun`` job will run — sockets, framing, CRC and all."""
+    from ..btl.components import DcnBtl
+    from ..native import OobEndpoint
+
+    a, b = OobEndpoint(0), OobEndpoint(1)
+    out: Dict[int, float] = {}
+    prev = mca_var.get("wire_pipeline_segsize", 1 << 20)
+    try:
+        b.connect(0, "127.0.0.1", a.port)
+        m = DcnBtl()
+        x = np.ones(max(1, size_bytes // 4), np.float32)
+        for seg in [0] + sorted({int(s) for s in segsizes if s > 0}):
+            mca_var.set_value("wire_pipeline_segsize", seg)
+            try:
+                best = None
+                for _ in range(repeats):
+                    t0 = time.perf_counter()
+                    m.send_staged(b, 0, 151, x)
+                    got = np.asarray(m.recv_staged(a, 151))
+                    dt = time.perf_counter() - t0
+                    best = dt if best is None else min(best, dt)
+                if got.shape != x.shape or got[0] != x[0]:
+                    continue  # never crown a corrupting config
+                out[seg] = best
+            except Exception as e:
+                _log.verbose(2, f"wire segsize {seg}: {e}")
+    finally:
+        mca_var.set_value("wire_pipeline_segsize", prev)
+        a.close()
+        b.close()
+    return out
+
+
+def emit_wire_rules(seg_times: Dict[int, float],
+                    size_bytes: int = 16 << 20) -> str:
+    """Rule-comment block for the wire sweep (the same measured-
+    justification treatment as the coll segsize column): every point's
+    time, plus the winning ``--mca wire_pipeline_segsize`` the operator
+    should launch with. Wire cvars are job-wide, not per-collective, so
+    this block is advisory comments rather than rule lines — the
+    loader ignores it."""
+    if not seg_times:
+        return ""
+    pts = ", ".join(
+        f"{('off' if k == 0 else k)}={v * 1e3:.1f}ms"
+        for k, v in sorted(seg_times.items(), key=lambda kv: kv[1]))
+    best = min(seg_times, key=seg_times.get)
+    lines = [
+        "",
+        f"# wire pipeline sweep ({size_bytes >> 20} MiB staged "
+        f"loopback): {pts}",
+        f"# recommended: --mca wire_pipeline_segsize {best}"
+        + ("  (legacy monolithic framing won)" if best == 0 else ""),
+    ]
+    return "\n".join(lines)
+
+
+def measure(comm, ops: Sequence[str], sizes: Sequence[int],
+            repeats: int = 5, *, segsizes: Optional[Sequence[int]] = None,
+            algs: Optional[Sequence[str]] = None) -> Dict[str, List[Dict]]:
+    """{op: [{size, unit_bytes, times: {alg: s}, compile: {alg: s},
+    winner[, segsize, segsize_times]}]} — per-rank buffer sizes in
+    bytes; min-of-repeats timing (dispatch latency spikes are
+    one-sided). The first call per algorithm compiles AND primes the
+    driver plan cache, so the measured repeats exclude compile time;
+    the compile cost is reported separately in ``compile``. With
+    ``segsizes``, pipeline-capable winners get a segment-size sweep
+    (``segsize`` = best, 0 = monolithic won). ``algs`` restricts the
+    algorithm menu (default: every legal algorithm of the op)."""
+    if getattr(comm, "spans_processes", False):
+        from ..utils.errors import ErrorCode, MPIError
+
+        raise MPIError(
+            ErrorCode.ERR_NOT_AVAILABLE,
+            "tpu-tune measures the in-process compiled algorithms "
+            "(driver-mode buffers); run it single-process on the "
+            "target mesh shape — the rule file it emits applies to "
+            "any job",
+        )
+    from ..coll import pipeline
+
+    n = comm.size
+    tuned = _tuned_dup(comm)
+    # measure from scratch: an active rules file (a previous tuning
+    # run) must not steer this one — the algorithm is pinned by the
+    # forced cvar, and its segsize column would silently pipeline the
+    # alg-phase timings (pick_segsize: rules > cvar). The ambient
+    # coll_pipeline_segsize is pinned to 0 too: the alg phase times
+    # MONOLITHIC algorithms (the segsize sweep's own 0-baseline), and
+    # pipelining is explored only by the explicit sweep
+    prev_rules = mca_var.get("coll_tuned_use_dynamic_rules", False)
+    prev_seg = mca_var.get("coll_pipeline_segsize", 1 << 20)
+    mca_var.set_value("coll_tuned_use_dynamic_rules", False)
+    mca_var.set_value("coll_pipeline_segsize", 0)
+    try:
+        results: Dict[str, List[Dict]] = {}
+        for op in ops:
+            runner, unit_fn = _OPS[op]
+            var = f"coll_tuned_{op}_algorithm"
+            # restore the OPERATOR's forced value after each timing,
+            # not the literal 'auto' — tpu-tune must not clobber a
+            # deployment's pinned algorithm (ADVICE r5)
+            prev_alg = mca_var.get(var, "auto")
+            rows = []
+            for size in sizes:
+                elems = max(n, size // 4)
+                elems = -(-elems // n) * n  # alltoall/scatter: % n == 0
+                x = np.ones((n, elems), np.float32)
+                times: Dict[str, float] = {}
+                compiles: Dict[str, float] = {}
+                for alg in (algs or _algorithms(op)):
+                    mca_var.set_value(var, alg)
+                    try:
+                        # compile + warm: this first call also primes
+                        # the driver plan cache, so the repeats below
+                        # never pay compile time
+                        t_first = _time_once(runner, tuned, x)
+                        times[alg] = min(
+                            _time_once(runner, tuned, x)
+                            for _ in range(repeats)
+                        )
+                        compiles[alg] = max(0.0, t_first - times[alg])
+                    except Exception as e:
+                        # an algorithm an op/shape cannot run (e.g.
+                        # ring without identity) is skipped, not fatal
+                        _log.verbose(2, f"{op}/{alg}@{size}: {e}")
+                    finally:
+                        mca_var.set_value(var, prev_alg)
+                if not times:
+                    continue
+                winner = min(times, key=times.get)
+                row = {
+                    "size": size, "unit_bytes": unit_fn(elems * 4, n),
+                    "times": times, "compile": compiles, "winner": winner,
+                }
+                pipe_alg = pipeline.PIPELINE_CAPABLE.get(op)
+                pos_segs = [s for s in (segsizes or ()) if s > 0]
+                if (pos_segs and winner == pipe_alg
+                        and size > min(pos_segs)):
+                    seg_times = sweep_segsizes(
+                        tuned, op, winner, x, segsizes, repeats
+                    )
+                    if seg_times:
+                        row["segsize_times"] = seg_times
+                        row["segsize"] = min(seg_times, key=seg_times.get)
+                rows.append(row)
+            results[op] = rows
+        return results
+    finally:
+        mca_var.set_value("coll_tuned_use_dynamic_rules", prev_rules)
+        mca_var.set_value("coll_pipeline_segsize", prev_seg)
+        tuned.free()
+
+
+def _fixed_choice(comm, op: str, size: int) -> Optional[str]:
+    """What the baked-in decision constants would pick (for the
+    emitted differs-from-fixed annotations)."""
+    from .. import ops as ops_mod
+    from ..coll import components as coll_components
+
+    n = comm.size
+    elems = max(n, size // 4)
+    elems = -(-elems // n) * n
+    x = np.ones((n, elems), np.float32)
+    mod = coll_components._TunedModule(comm)
+    # the pickers consult dynamic rules BEFORE the fixed constants —
+    # when re-tuning an already-tuned deployment the annotation must
+    # still compare against the constants, not the old rule file
+    prev = mca_var.get("coll_tuned_use_dynamic_rules", False)
+    mca_var.set_value("coll_tuned_use_dynamic_rules", False)
+    try:
+        if op == "allreduce":
+            return mod._pick_allreduce(x, ops_mod.SUM)
+        if op == "bcast":
+            return mod._pick_bcast(x)[0]
+        if op == "reduce":
+            return mod._pick_reduce(x, ops_mod.SUM)
+        if op == "allgather":
+            return mod._pick_allgather(x)
+        if op == "alltoall":
+            return mod._pick_alltoall(x)
+    except Exception:
+        pass
+    finally:
+        mca_var.set_value("coll_tuned_use_dynamic_rules", prev)
+    return None
+
+
+def emit(comm, results: Dict[str, List[Dict]]) -> str:
+    """Render measurements as a dynamic rule file: ascending
+    min_msg_bytes lines per op (LAST match wins, so each line is the
+    threshold where the winner changes), every rule justified by its
+    measurements in a comment."""
+    import jax
+
+    dev = jax.devices()[0]
+    lines = [
+        "# generated by tpu-tune — measured algorithm selection",
+        f"# mesh: {len(jax.devices())} x {dev.device_kind} "
+        f"({jax.default_backend()}), comm size {comm.size}",
+        "# load with: --mca coll_tuned_use_dynamic_rules 1 "
+        "--mca coll_tuned_dynamic_rules_filename <this file>",
+        "#",
+        "# collective  min_comm_size  min_msg_bytes  algorithm  [segsize]",
+    ]
+    for op, rows in results.items():
+        if not rows:
+            continue
+        lines.append("")
+        prev = None
+        for i, row in enumerate(rows):
+            t = ", ".join(f"{a}={s * 1e6:.0f}us"
+                          for a, s in sorted(row["times"].items(),
+                                             key=lambda kv: kv[1]))
+            fixed = _fixed_choice(comm, op, row["size"])
+            note = (f"  [differs from fixed constants: {fixed}]"
+                    if fixed is not None
+                    and fixed != row["winner"] else "")
+            lines.append(f"# {op} @ {row['size']}B/rank: {t}{note}")
+            if row.get("compile"):
+                c = ", ".join(
+                    f"{a}={s * 1e3:.0f}ms"
+                    for a, s in sorted(row["compile"].items(),
+                                       key=lambda kv: kv[1]))
+                lines.append(f"#   compile: {c}")
+            if row.get("segsize_times"):
+                st = ", ".join(
+                    f"{('off' if k == 0 else k)}={v * 1e6:.0f}us"
+                    for k, v in sorted(row["segsize_times"].items(),
+                                       key=lambda kv: kv[1]))
+                lines.append(
+                    f"#   segsize sweep ({row['winner']}): {st}"
+                )
+            pick = (row["winner"], row.get("segsize"))
+            if pick != prev:
+                thresh = 0 if i == 0 else row["unit_bytes"]
+                seg_col = ("" if row.get("segsize") is None
+                           else f"  {row['segsize']}")
+                lines.append(
+                    f"{op}  0  {thresh}  {row['winner']}{seg_col}"
+                )
+                prev = pick
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-tune",
+        description="Measure collective algorithms on this mesh and "
+                    "emit a dynamic rules file",
+    )
+    ap.add_argument("-o", "--output", required=True)
+    ap.add_argument("--sizes", default="1024,65536,1048576,16777216",
+                    help="comma-separated per-rank buffer sizes (bytes)")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--ops", default="allreduce,bcast,reduce,"
+                                     "allgather,alltoall")
+    ap.add_argument("--segsizes", default="65536,262144,1048576",
+                    help="comma-separated pipeline segment sizes to "
+                         "sweep for pipeline-capable winners (emits "
+                         "the segsize rule column); empty disables")
+    ap.add_argument("--wire-segsizes", default="",
+                    help="comma-separated wire_pipeline_segsize values "
+                         "to sweep through a loopback OOB staged "
+                         "transfer (emits a recommendation comment); "
+                         "empty disables")
+    args = ap.parse_args(argv)
+
+    import ompi_release_tpu as mpi
+
+    comm = mpi.init()
+    # ascending is load-bearing: emit() writes threshold lines in row
+    # order and dynamic_rules takes the LAST match
+    sizes = sorted(int(s) for s in args.sizes.split(",") if s)
+    ops = [o.strip() for o in args.ops.split(",") if o.strip()]
+    segsizes = sorted(int(s) for s in args.segsizes.split(",") if s)
+    results = measure(comm, ops, sizes, repeats=args.repeats,
+                      segsizes=segsizes or None)
+    text = emit(comm, results)
+    wire_segs = sorted(int(s) for s in args.wire_segsizes.split(",")
+                       if s.strip())
+    if wire_segs:
+        text += emit_wire_rules(sweep_wire_segsizes(wire_segs)) + "\n"
+    with open(args.output, "w") as f:
+        f.write(text)
+    # validate what we just wrote parses (a typo'd generator must not
+    # hand the operator a file that fails at job start)
+    from ..coll import dynamic_rules
+
+    dynamic_rules.load_rules(args.output)
+    n_rules = sum(1 for ln in text.splitlines()
+                  if ln and not ln.startswith("#"))
+    print(f"tpu-tune: wrote {n_rules} rule(s) to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Utility layer: logging streams, help catalogs, error codes."""
+
+from . import output
+from .errors import Errhandler, MPIError, ErrorCode
+
+__all__ = ["output", "Errhandler", "MPIError", "ErrorCode"]

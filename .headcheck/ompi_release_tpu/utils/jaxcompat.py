@@ -1,0 +1,66 @@
+"""Version-compat shims for the jax surface this framework uses.
+
+The framework targets the modern jax API (``jax.shard_map``,
+``jax.typeof``); 0.4.x jaxlibs ship the same machinery under
+``jax.experimental.shard_map`` / core avals. :func:`install` aliases
+the missing names onto the jax module once, so every call site (library
+and test suite alike) uses the one modern spelling. No-op on jax
+versions that already export them.
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map
+        except ImportError:
+            return  # neither spelling: let call sites raise their own
+
+        def _compat_shard_map(f, *args, **kwargs):
+            # 0.4.x's check_rep cannot type code written for the
+            # modern varying-axes (vma) system (scan carries cast via
+            # pvary read as mismatched) — it is validation only, so
+            # drop the knob and disable it
+            kwargs.pop("check_vma", None)
+            kwargs["check_rep"] = False
+            return shard_map(f, *args, **kwargs)
+
+        jax.shard_map = _compat_shard_map
+    if not hasattr(jax, "typeof"):
+        try:
+            # call sites only getattr(.vma, default) off the result, so
+            # the 0.4.x aval (no vma tracking) is a faithful stand-in
+            jax.typeof = jax.core.get_aval
+        except AttributeError:
+            pass
+    from jax import lax
+
+    if not hasattr(lax, "pvary"):
+        # no varying-axes type system on 0.4.x: pvary/pcast are identities
+        lax.pvary = lambda x, axis_name: x
+    if not hasattr(lax, "pcast"):
+        lax.pcast = lambda x, axes, to=None, **kw: x
+
+    import inspect
+
+    try:
+        params = inspect.signature(jax.ShapeDtypeStruct.__init__).parameters
+    except (TypeError, ValueError):
+        params = {}
+    if params and "vma" not in params and not any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    ):
+        _Orig = jax.ShapeDtypeStruct
+
+        class _ShapeDtypeStruct(_Orig):  # type: ignore[misc,valid-type]
+            """Accepts-and-drops ``vma=`` (no varying-axes tracking on
+            0.4.x, so the annotation is vacuous there)."""
+
+            def __init__(self, shape, dtype, *args, vma=None, **kwargs):
+                super().__init__(shape, dtype, *args, **kwargs)
+
+        jax.ShapeDtypeStruct = _ShapeDtypeStruct

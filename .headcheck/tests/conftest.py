@@ -1,0 +1,68 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirror of the reference's clusterless test strategy (SURVEY §4): the
+``ras/simulator`` analogue is N fake XLA host devices, so every
+collective/algorithm runs multi-"device" in CI without a TPU. Must set
+env before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+# NOTE: the axon environment's sitecustomize preloads jax._src with
+# JAX_PLATFORMS=axon already captured, so plain env assignment is too
+# late — use the config API (and set XLA_FLAGS before backend init).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ompi_release_tpu.utils import jaxcompat  # noqa: E402
+
+jaxcompat.install()  # tests use jax.shard_map directly; alias on 0.4.x
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 run"
+    )
+
+
+def subprocess_env(**overrides):
+    """Environment for subprocess tests that must run on the virtual
+    CPU mesh: forces JAX_PLATFORMS=cpu and filters the axon
+    sitecustomize entry from PYTHONPATH (it pins the TPU platform
+    over the env var — subprocesses can't use the config API the way
+    this conftest does). Other PYTHONPATH entries stay."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in os.path.basename(p)
+    )
+    env.update(overrides)
+    return env
+
+
+@pytest.fixture
+def fresh_mca(monkeypatch):
+    """Isolated MCA var/pvar state for config-system tests."""
+    from ompi_release_tpu.mca.var import VarRegistry
+    from ompi_release_tpu.mca.pvar import PvarRegistry
+    from ompi_release_tpu.mca import var as var_mod, pvar as pvar_mod
+
+    fresh_vars = VarRegistry()
+    fresh_pvars = PvarRegistry()
+    monkeypatch.setattr(var_mod, "VARS", fresh_vars)
+    monkeypatch.setattr(pvar_mod, "PVARS", fresh_pvars)
+    yield fresh_vars

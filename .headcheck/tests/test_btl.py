@@ -1,0 +1,522 @@
+"""btl/bml transport framework tests.
+
+The reference's per-peer transfer plan: add_procs-style reachability,
+exclusivity tiers, latency/bandwidth-sorted eager/send/rdma lists and
+weighted rail striping (``ompi/mca/btl/btl.h:795-838``,
+``ompi/mca/bml/bml.h:71,229``).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu import btl as btl_mod
+from ompi_release_tpu.btl import base as btl_base
+from ompi_release_tpu.btl import components as btl_comps
+from ompi_release_tpu.mca import var as mca_var
+from ompi_release_tpu.runtime.mesh import Endpoint
+from ompi_release_tpu.utils.errors import MPIError
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+def _ep(rank, slice_index=0, process_index=0, platform="cpu", host=""):
+    return Endpoint(
+        rank=rank, device_id=rank, process_index=process_index,
+        platform=platform, device_kind="test", coords=(rank,),
+        slice_index=slice_index, host=host,
+    )
+
+
+class TestReachability:
+    def test_self_owns_loopback(self):
+        m = btl_comps.SelfBtl()
+        assert m.reachable(_ep(3), _ep(3))
+        assert not m.reachable(_ep(3), _ep(4))
+
+    def test_ici_same_slice_only(self):
+        m = btl_comps.IciBtl()
+        assert m.reachable(_ep(0), _ep(1))
+        assert not m.reachable(_ep(0), _ep(1, slice_index=1))
+        assert not m.reachable(_ep(0), _ep(0))  # loopback is self's
+
+    def test_dcn_cross_slice_or_process(self):
+        m = btl_comps.DcnBtl()
+        assert m.reachable(_ep(0), _ep(1, slice_index=1))
+        assert m.reachable(_ep(0), _ep(1, process_index=1))
+        assert not m.reachable(_ep(0), _ep(1))
+
+    def test_host_reaches_everything(self):
+        m = btl_comps.HostBtl()
+        assert m.reachable(_ep(0), _ep(1, slice_index=9, process_index=9))
+
+
+class TestEndpointLists:
+    def _modules(self):
+        return [btl_comps.SelfBtl(), btl_comps.IciBtl(),
+                btl_comps.DcnBtl(), btl_comps.HostBtl()]
+
+    def test_exclusivity_tiers(self):
+        """Loopback pairs keep only self; same-slice pairs keep only
+        ici (host drops: lower exclusivity) — btl.h:797 semantics."""
+        dev = None
+        ep = btl_base.BmlEndpoint(_ep(0), _ep(0), dev, self._modules())
+        assert [m.NAME for m in ep.btl_eager] == ["self"]
+        ep = btl_base.BmlEndpoint(_ep(0), _ep(1), dev, self._modules())
+        assert [m.NAME for m in ep.btl_eager] == ["ici"]
+        ep = btl_base.BmlEndpoint(
+            _ep(0), _ep(1, slice_index=1), dev, self._modules()
+        )
+        assert [m.NAME for m in ep.btl_eager] == ["dcn"]
+
+    def test_unreachable_raises(self):
+        with pytest.raises(MPIError):
+            btl_base.BmlEndpoint(
+                _ep(0), _ep(1), None, [btl_comps.SelfBtl()]
+            )
+
+    def test_rdma_sorted_by_bandwidth_eager_by_latency(self):
+        class A(btl_comps.IciBtl):
+            NAME = "railA"
+            LATENCY = 5
+            BANDWIDTH = 100
+            EXCLUSIVITY = 7
+
+        class B(btl_comps.IciBtl):
+            NAME = "railB"
+            LATENCY = 1
+            BANDWIDTH = 50
+            EXCLUSIVITY = 7
+
+        ep = btl_base.BmlEndpoint(_ep(0), _ep(1), None, [A(), B()])
+        assert [m.NAME for m in ep.btl_eager] == ["railB", "railA"]
+        assert [m.NAME for m in ep.btl_rdma] == ["railA", "railB"]
+
+
+class TestStriping:
+    def test_rail_schedule_weighted_by_bandwidth(self):
+        class A(btl_comps.IciBtl):
+            NAME = "rail3x"
+            BANDWIDTH = 300
+            EXCLUSIVITY = 7
+
+        class B(btl_comps.IciBtl):
+            NAME = "rail1x"
+            BANDWIDTH = 100
+            EXCLUSIVITY = 7
+
+        ep = btl_base.BmlEndpoint(_ep(0), _ep(1), None, [A(), B()])
+        sched = ep._rail_schedule(8)
+        assert len(sched) == 8
+        # 3:1 bandwidth ratio -> 6 segments on rail0, 2 on rail1
+        assert sched.count(0) == 6 and sched.count(1) == 2
+        # interleaved, not blocked: the first two segments use both rails
+        assert set(sched[:2]) == {0, 1}
+
+    def test_striped_move_correct_and_counted(self, world):
+        """A pipelined transfer across 2 rails reassembles exactly and
+        bumps the striping pvar."""
+        from ompi_release_tpu.mca import pvar
+
+        class A(btl_comps.IciBtl):
+            NAME = "ici"
+            EXCLUSIVITY = 7
+
+        class B(btl_comps.IciBtl):
+            NAME = "host"  # reuse registered var names
+            BANDWIDTH = 15_000
+            EXCLUSIVITY = 7
+
+        # class-attr overrides are shadowed by the registered
+        # btl_<name>_* defaults once another test file registers the
+        # btl vars (file-order dependent) — pin both rails' ranking
+        # attributes explicitly and clean up after
+        pinned = {
+            "btl_host_bandwidth": "15000",
+            "btl_host_exclusivity": "1024",
+            "btl_host_latency": "1",
+            "btl_ici_exclusivity": "1024",
+        }
+        for k, v in pinned.items():
+            mca_var.set_value(k, v)
+        try:
+            devs = list(world.submesh.devices.reshape(-1))
+            ep = btl_base.BmlEndpoint(_ep(0), _ep(1), devs[1], [A(), B()])
+            x = jnp.arange(5000, dtype=jnp.float32)
+            before = btl_base._striped_moves.read()
+            out = ep.move(x, max_send=4096)  # 1024 f32/segment -> 5 segs
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+            assert out.device == devs[1]
+            assert btl_base._striped_moves.read() == before + 1
+        finally:
+            for k in pinned:
+                mca_var.VARS.unset(k)
+
+
+class TestSelection:
+    def test_framework_selection_var(self, world):
+        """--mca btl host,self forces the host-staged path (the
+        'force tcp,self on a verbs cluster' debugging move)."""
+        mca_var.set_value("btl", "host,self")
+        try:
+            bml = btl_mod.BmlR2(world)
+            ep = bml.endpoint(0, 1)
+            assert [m.NAME for m in ep.btl_eager] == ["host"]
+            devs = list(world.submesh.devices.reshape(-1))
+            x = jnp.arange(64, dtype=jnp.int32)
+            out = ep.move(x)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+            assert out.device == devs[1]
+        finally:
+            mca_var.VARS.unset("btl")
+
+    def test_default_world_endpoints(self, world):
+        bml = btl_mod.BmlR2(world)
+        assert [m.NAME for m in bml.endpoint(0, 0).btl_eager] == ["self"]
+        assert [m.NAME for m in bml.endpoint(0, 1).btl_eager] == ["ici"]
+
+    def test_attribute_vars_override(self, world):
+        """btl_<name>_<attr> MCA variables steer the live module."""
+        mca_var.set_value("btl_ici_eager_limit", 128)
+        try:
+            bml = btl_mod.BmlR2(world)
+            assert bml.endpoint(0, 1).eager_limit == 128
+        finally:
+            mca_var.VARS.unset("btl_ici_eager_limit")
+
+
+class TestPmlIntegration:
+    def test_send_goes_through_btl_accounting(self, world):
+        """A send's bytes land on the selected btl's byte counter."""
+        sub = world.dup(name="btl_acct")
+        eng = sub.pml
+        ici = eng._bml.endpoint(0, 1).btl_eager[0]
+        assert ici.NAME == "ici"
+        before = ici.bytes_pvar.read()
+        sub.send(jnp.arange(100, dtype=jnp.float32), dest=1, tag=5, rank=0)
+        v, st = sub.recv(source=0, tag=5, rank=1)
+        np.testing.assert_array_equal(np.asarray(v), np.arange(100))
+        assert ici.bytes_pvar.read() == before + 400
+        sub.free()
+
+    def test_per_peer_eager_limit_drives_protocol(self, world):
+        """Shrinking the ici eager limit flips sends to rendezvous."""
+        from ompi_release_tpu.p2p.pml import _rndv_count
+
+        sub = world.dup(name="btl_proto")
+        mca_var.set_value("btl_ici_eager_limit", 4)
+        try:
+            before = _rndv_count.read()
+            r = sub.isend(jnp.arange(64, dtype=jnp.float32), 1, 7, rank=0)
+            assert _rndv_count.read() == before + 1
+            v, _ = sub.recv(source=0, tag=7, rank=1)
+            np.testing.assert_array_equal(
+                np.asarray(v), np.arange(64, dtype=np.float32)
+            )
+            r.wait()
+        finally:
+            mca_var.VARS.unset("btl_ici_eager_limit")
+            sub.free()
+
+
+class TestHonestDcn:
+    """VERDICT r2 #9: DCN's two real paths. device_put across
+    controllers is not a route — move_segment capability-checks and
+    the cross-process path is a chunked OOB-staged transfer with its
+    own accounting."""
+
+    def test_move_segment_unaddressable_raises(self):
+        from ompi_release_tpu.btl.components import DcnBtl
+
+        class FakeDevice:  # a peer process's device
+            process_index = 1
+
+            def __repr__(self):
+                return "FakeRemoteDevice(process=1)"
+
+        m = DcnBtl()
+        x = jnp.ones((4,), jnp.float32)
+        with pytest.raises(MPIError) as ei:
+            m.move_segment(x, FakeDevice())
+        assert "send_staged" in str(ei.value)
+
+    def test_staged_transfer_in_process_sockets(self):
+        """Chunked OOB transfer over real sockets: 3 MiB at 1 MiB
+        max_send -> 3 chunks, bitwise-identical reassembly, pvar
+        accounting."""
+        from ompi_release_tpu.btl.components import DcnBtl
+        from ompi_release_tpu.mca import var as mca_var
+        from ompi_release_tpu.native import OobEndpoint
+
+        a, b = OobEndpoint(0), OobEndpoint(1)
+        try:
+            b.connect(0, "127.0.0.1", a.port)
+            m = DcnBtl()
+            mca_var.set_value("btl_dcn_max_send_size", str(1 << 20))
+            try:
+                rng = np.random.RandomState(0)
+                x = rng.randn(3 << 18).astype(np.float32)  # 3 MiB
+                before = int(m.staged_chunks_pvar.read())
+                sent = m.send_staged(b, 0, 121, x)
+                assert sent == 3
+                got = m.recv_staged(a, 121)
+                np.testing.assert_array_equal(np.asarray(got), x)
+                # sender + receiver both account their chunks
+                assert int(m.staged_chunks_pvar.read()) - before == 6
+            finally:
+                mca_var.VARS.unset("btl_dcn_max_send_size")
+        finally:
+            a.close()
+            b.close()
+
+    def test_staged_transfer_cross_process(self, tmp_path):
+        """The real multi-controller shape: a second PROCESS streams
+        an array to us over the OOB; no device handle ever crosses
+        the process boundary."""
+        import subprocess
+        import sys
+        import textwrap
+
+        from ompi_release_tpu.btl.components import DcnBtl
+        from ompi_release_tpu.native import OobEndpoint
+
+        script = textwrap.dedent("""
+            import sys
+            sys.path.insert(0, "/root/repo")
+            import numpy as np
+            from ompi_release_tpu.btl.components import DcnBtl
+            from ompi_release_tpu.native import OobEndpoint
+
+            port = int(sys.argv[1])
+            ep = OobEndpoint(1)
+            ep.connect(0, "127.0.0.1", port)
+            x = np.arange(200_000, dtype=np.float32)
+            DcnBtl().send_staged(ep, 0, 133, x)
+            ep.recv(tag=134, timeout_ms=30000)  # ack gates teardown
+            ep.close()
+        """)
+        p = tmp_path / "dcn_sender.py"
+        p.write_text(script)
+        ep = OobEndpoint(0)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, str(p), str(ep.port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+            got = DcnBtl().recv_staged(ep, 133)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.arange(200_000, dtype=np.float32)
+            )
+            ep.send(1, 134, b"ok")
+            _, err = proc.communicate(timeout=30)
+            assert proc.returncode == 0, err
+        finally:
+            ep.close()
+
+    def test_concurrent_staged_transfers_do_not_interleave(self):
+        """Two senders on ONE tag: chunk frames are matched to each
+        transfer's header source (stash), not consumed blindly."""
+        from ompi_release_tpu.btl.components import DcnBtl
+        from ompi_release_tpu.mca import var as mca_var
+        from ompi_release_tpu.native import OobEndpoint
+        import threading
+
+        root = OobEndpoint(0)
+        s1, s2 = OobEndpoint(1), OobEndpoint(2)
+        try:
+            s1.connect(0, "127.0.0.1", root.port)
+            s2.connect(0, "127.0.0.1", root.port)
+            m = DcnBtl()
+            mca_var.set_value("btl_dcn_max_send_size", str(64 * 1024))
+            try:
+                x1 = np.full(100_000, 1.0, np.float32)
+                x2 = np.full(120_000, 2.0, np.float32)
+                t1 = threading.Thread(
+                    target=lambda: m.send_staged(s1, 0, 109, x1))
+                t2 = threading.Thread(
+                    target=lambda: m.send_staged(s2, 0, 109, x2))
+                t1.start(); t2.start()
+                a = np.asarray(m.recv_staged(root, 109))
+                b = np.asarray(m.recv_staged(root, 109))
+                t1.join(); t2.join()
+                got = {arr.shape[0]: arr for arr in (a, b)}
+                np.testing.assert_array_equal(got[100_000], x1)
+                np.testing.assert_array_equal(got[120_000], x2)
+            finally:
+                mca_var.VARS.unset("btl_dcn_max_send_size")
+        finally:
+            for e in (root, s1, s2):
+                e.close()
+
+
+class TestShmHandoff:
+    """Cross-process intra-host device-buffer handoff (SURVEY §2.4
+    item 9, btl/vader role): payload crosses through ONE shared-memory
+    segment; control rides the OOB."""
+
+    def test_reachability_same_host_cross_process_only(self):
+        from ompi_release_tpu.btl.components import ShmBtl
+
+        m = ShmBtl()
+        a = _ep(rank=0, process_index=0, host="hostA")
+        b = _ep(rank=1, process_index=1, host="hostA")
+        c = _ep(rank=2, process_index=1, host="hostB")
+        d = _ep(rank=3, process_index=0, host="hostA")
+        assert m.reachable(a, b)          # same host, other process
+        assert not m.reachable(a, c)      # other host
+        assert not m.reachable(a, d)      # same process
+        unknown = _ep(rank=4, process_index=1, host="")
+        assert not m.reachable(unknown, b)  # unknown host: never claim
+
+    def test_handoff_cross_process(self, tmp_path):
+        """A second process writes 800 KB into a shm segment and posts
+        the control frame; we map, device_put, unlink — bitwise."""
+        import subprocess
+        import sys
+        import textwrap
+
+        from ompi_release_tpu.btl.components import ShmBtl
+        from ompi_release_tpu.native import OobEndpoint
+
+        script = textwrap.dedent("""
+            import sys
+            sys.path.insert(0, "/root/repo")
+            import numpy as np
+            from ompi_release_tpu.btl.components import ShmBtl
+            from ompi_release_tpu.native import OobEndpoint
+
+            port = int(sys.argv[1])
+            ep = OobEndpoint(1)
+            ep.connect(0, "127.0.0.1", port)
+            x = np.arange(200_000, dtype=np.float32) * 0.5
+            ShmBtl().send_shm(ep, 0, 144, x)
+            ep.recv(tag=145, timeout_ms=30000)  # ack gates teardown
+            ep.close()
+        """)
+        p = tmp_path / "shm_sender.py"
+        p.write_text(script)
+        ep = OobEndpoint(0)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, str(p), str(ep.port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+            got = ShmBtl().recv_shm(ep, 144)
+            np.testing.assert_array_equal(
+                np.asarray(got),
+                np.arange(200_000, dtype=np.float32) * 0.5,
+            )
+            ep.send(1, 145, b"ok")
+            _, err = proc.communicate(timeout=30)
+            assert proc.returncode == 0, err
+        finally:
+            ep.close()
+
+    def test_move_segment_refuses(self):
+        from ompi_release_tpu.btl.components import ShmBtl
+
+        with pytest.raises(MPIError):
+            ShmBtl().move_segment(jnp.ones(3), None)
+
+    def test_orphaned_segments_reaped(self):
+        """A posted-but-never-consumed segment is unlinked after its
+        TTL on a later send (no /dev/shm leak from dead receivers)."""
+        from multiprocessing import shared_memory
+
+        from ompi_release_tpu.btl.components import ShmBtl
+        from ompi_release_tpu.native import OobEndpoint
+
+        a, b = OobEndpoint(0), OobEndpoint(1)
+        try:
+            b.connect(0, "127.0.0.1", a.port)
+            m = ShmBtl()
+            name = m.send_shm(b, 0, 177, np.ones(16, np.float32))
+            # segment exists while pending
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            # force expiry, then any send reaps it (pending segments
+            # are per-module-instance state: another job's module in
+            # this process could not reap ours early)
+            m._pending_segments[:] = [
+                (n, 0.0) for n, _ in m._pending_segments
+            ]
+            m.send_shm(b, 0, 178, np.ones(4, np.float32))
+            with pytest.raises(FileNotFoundError):
+                shared_memory.SharedMemory(name=name)
+            # drain the two frames + consume the second segment
+            m.recv_shm(a, 178)
+        finally:
+            a.close()
+            b.close()
+
+    def test_recv_staged_resyncs_past_orphan_frames(self):
+        """Orphan chunks from an abandoned transfer must be skipped —
+        not parsed as headers — and stale chunks must not leak into
+        the next transfer's data."""
+        from ompi_release_tpu.btl.components import DcnBtl, _CHUNK_MAGIC
+        from ompi_release_tpu.native import OobEndpoint
+
+        a, b = OobEndpoint(0), OobEndpoint(1)
+        try:
+            b.connect(0, "127.0.0.1", a.port)
+            m = DcnBtl()
+            # orphan chunk frames (an abandoned transfer's leftovers)
+            stale = _CHUNK_MAGIC + (424242).to_bytes(8, "big") + b"junk"
+            b.send(0, 151, stale)
+            b.send(0, 151, stale)
+            x = np.arange(1000, dtype=np.float32)
+            m.send_staged(b, 0, 151, x)
+            got = m.recv_staged(a, 151)
+            np.testing.assert_array_equal(np.asarray(got), x)
+        finally:
+            a.close()
+            b.close()
+
+    def test_control_plane_tags_rejected(self):
+        from ompi_release_tpu.btl.components import DcnBtl, ShmBtl
+
+        with pytest.raises(MPIError):
+            DcnBtl().send_staged(None, 0, 9, np.ones(2))  # TAG_PUBLISH
+        with pytest.raises(MPIError):
+            ShmBtl().send_shm(None, 0, 5, np.ones(2))  # TAG_XCAST
+
+    def test_staged_transfer_crc_catches_corruption(self):
+        """A hand-crafted transfer whose chunk bytes don't match the
+        header CRC must be rejected (wire-corruption detection, the
+        datatype-checksum role for the cross-process path)."""
+        import zlib
+
+        from ompi_release_tpu.btl.components import (
+            DcnBtl, _CHUNK_MAGIC, _HDR_MAGIC,
+        )
+        from ompi_release_tpu.native import DssBuffer, OobEndpoint
+
+        a, b = OobEndpoint(0), OobEndpoint(1)
+        try:
+            b.connect(0, "127.0.0.1", a.port)
+            good = np.arange(64, dtype=np.float32).tobytes()
+            hdr = DssBuffer()
+            hdr.pack_string(_HDR_MAGIC)
+            hdr.pack_int64(7)
+            hdr.pack_string("float32")
+            hdr.pack_string("64")
+            hdr.pack_int64(1)
+            hdr.pack_int64(zlib.crc32(good))
+            b.send(0, 161, hdr.tobytes())
+            corrupted = bytearray(good)
+            corrupted[12] ^= 0xFF  # one flipped byte
+            b.send(0, 161,
+                   _CHUNK_MAGIC + (7).to_bytes(8, "big") + bytes(corrupted))
+            with pytest.raises(MPIError) as ei:
+                DcnBtl().recv_staged(a, 161)
+            assert "CRC" in str(ei.value)
+        finally:
+            a.close()
+            b.close()

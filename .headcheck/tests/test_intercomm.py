@@ -1,0 +1,343 @@
+"""Intercommunicator + MPI-2 dynamics tests (8-device CPU mesh).
+
+Covers the reference surface of ``ompi/communicator/comm.c``
+(intercomm create/merge), ``ompi/mca/coll/inter/coll_inter.c``
+(inter collectives), ``ompi/mca/dpm/dpm_orte/dpm_orte.c`` +
+``ompi/mca/pubsub/orte/pubsub_orte.c`` (connect/accept, name
+publish/lookup) — VERDICT r2 task #2's done-criterion: two
+independently-created comms connect, form an intercomm, and run an
+inter-allgather.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.comm import (
+    Group, Intercommunicator, intercomm_create,
+    open_port, close_port, publish_name, unpublish_name, lookup_name,
+    comm_accept, comm_connect,
+)
+from ompi_release_tpu.utils.errors import MPIError
+
+
+@pytest.fixture(scope="module")
+def world():
+    return mpi.init()
+
+
+@pytest.fixture(scope="module")
+def pair(world):
+    """Two disjoint intra-comms: A = ranks 0-2, B = ranks 3-7."""
+    a = world.create(world.group.incl([0, 1, 2]), name="A")
+    b = world.create(world.group.incl([3, 4, 5, 6, 7]), name="B")
+    return a, b
+
+
+def test_intercomm_create_shape(world, pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    assert ia.is_inter and ib.is_inter
+    assert not world.is_inter
+    assert (ia.size, ia.remote_size) == (3, 5)
+    assert (ib.size, ib.remote_size) == (5, 3)
+    assert ia.mirror is ib and ib.mirror is ia
+    assert ia.remote_group.world_ranks == (3, 4, 5, 6, 7)
+
+
+def test_intercomm_groups_must_be_disjoint(world, pair):
+    a, _ = pair
+    overlapping = world.create(world.group.incl([2, 3]), name="overlap")
+    with pytest.raises(MPIError):
+        intercomm_create(a, 0, overlapping, 0)
+
+
+def test_intercomm_leader_validation(pair):
+    a, b = pair
+    with pytest.raises(MPIError):
+        intercomm_create(a, 5, b, 0)  # local leader out of range
+    with pytest.raises(MPIError):
+        intercomm_create(a, 0, b, 9)
+
+
+def test_inter_allgather(world, pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    bufs_a = np.arange(3 * 4, dtype=np.float32).reshape(3, 4)
+    bufs_b = 100 + np.arange(5 * 4, dtype=np.float32).reshape(5, 4)
+    got_a = np.asarray(ia.allgather(bufs_a, bufs_b))
+    got_b = np.asarray(ib.allgather(bufs_b, bufs_a))
+    # A-side ranks receive B's buffers in B rank order, and vice versa
+    np.testing.assert_array_equal(got_a.reshape(5, 4), bufs_b)
+    np.testing.assert_array_equal(got_b.reshape(3, 4), bufs_a)
+
+
+def test_inter_allreduce_and_reduce(pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    bufs_a = np.arange(3 * 2, dtype=np.float32).reshape(3, 2)
+    bufs_b = np.ones((5, 2), np.float32)
+    got_a = np.asarray(ia.allreduce(bufs_a, bufs_b))
+    got_b = np.asarray(ib.allreduce(bufs_b, bufs_a))
+    np.testing.assert_allclose(got_a, bufs_b.sum(0))
+    np.testing.assert_allclose(got_b, bufs_a.sum(0))
+    red = np.asarray(ia.reduce(bufs_b, root=1))
+    np.testing.assert_allclose(red, bufs_b.sum(0))
+    with pytest.raises(MPIError):
+        ia.reduce(bufs_b, root=3)  # root must be in LOCAL group (size 3)
+
+
+def test_inter_bcast_scatter_gather(pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    # bcast: remote root's buffer lands on local ranks
+    x = np.arange(6, dtype=np.float32)
+    got = np.asarray(ia.bcast(x, root=2))  # root = B's rank 2
+    np.testing.assert_array_equal(got, x)
+    with pytest.raises(MPIError):
+        ia.bcast(x, root=7)
+    # gather: local root receives remote group's buffers
+    bufs_b = np.arange(5 * 3, dtype=np.float32).reshape(5, 3)
+    got = np.asarray(ia.gather(bufs_b, root=0)).reshape(5, 3)
+    np.testing.assert_array_equal(got, bufs_b)
+    # scatter: remote root's buffer split across local ranks
+    sendbuf = np.arange(3 * 2, dtype=np.float32).reshape(3, 2)
+    got = np.asarray(ia.scatter(sendbuf, root=0))
+    np.testing.assert_array_equal(got, sendbuf)
+
+
+def test_inter_alltoall(pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    send_a = np.arange(3 * 5, dtype=np.int32).reshape(3, 5)
+    send_b = 100 + np.arange(5 * 3, dtype=np.int32).reshape(5, 3)
+    got_a = np.asarray(ia.alltoall(send_a, send_b))
+    got_b = np.asarray(ib.alltoall(send_b, send_a))
+    np.testing.assert_array_equal(got_a, send_b.T)  # recv[i][j]=send_b[j][i]
+    np.testing.assert_array_equal(got_b, send_a.T)
+    ia.barrier()
+
+
+def test_intra_only_ops_rejected(pair):
+    a, b = pair
+    ia, _ = intercomm_create(a, 0, b, 0)
+    for fn in (ia.scan, ia.exscan, ia.split):
+        with pytest.raises(MPIError):
+            fn(np.zeros(2))
+
+
+def test_intercomm_merge_ordering(pair):
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    low = ia.merge(high=False)  # A first
+    assert not low.is_inter
+    assert low.group.world_ranks == (0, 1, 2, 3, 4, 5, 6, 7)
+    high = ia.merge(high=True)  # A votes high -> B first
+    assert high.group.world_ranks == (3, 4, 5, 6, 7, 0, 1, 2)
+    # the merged comm is a full intracommunicator: run a collective
+    x = np.arange(8 * 2, dtype=np.float32).reshape(8, 2)
+    out = np.asarray(low.allreduce(x))
+    for r in range(8):
+        np.testing.assert_allclose(out[r], x.sum(0))
+
+
+def test_connect_accept_forms_intercomm(world, pair):
+    """The VERDICT done-criterion: two independently-created comms
+    connect via published name and run an inter-allgather."""
+    a, b = pair
+    port = open_port()
+    publish_name("ocean-svc", port)
+    results = {}
+
+    def server():
+        results["server"] = comm_accept(a, port, timeout_s=15)
+
+    t = threading.Thread(target=server)
+    t.start()
+    found = lookup_name("ocean-svc", timeout_s=15)
+    assert found == port
+    client_ic = comm_connect(b, found, timeout_s=15)
+    t.join(timeout=15)
+    server_ic = results["server"]
+    assert server_ic.group.world_ranks == (0, 1, 2)
+    assert server_ic.remote_group.world_ranks == (3, 4, 5, 6, 7)
+    assert client_ic.group.world_ranks == (3, 4, 5, 6, 7)
+    assert client_ic.mirror is server_ic
+    # inter-allgather across the dynamically-formed intercomm
+    bufs_a = np.arange(3, dtype=np.float32).reshape(3, 1)
+    bufs_b = 50 + np.arange(5, dtype=np.float32).reshape(5, 1)
+    got = np.asarray(server_ic.allgather(bufs_a, bufs_b)).ravel()
+    np.testing.assert_array_equal(got, bufs_b.ravel())
+    unpublish_name("ocean-svc")
+    with pytest.raises(MPIError):
+        lookup_name("ocean-svc", timeout_s=0.1)
+
+
+def test_connect_unknown_port_and_timeout(pair):
+    a, _ = pair
+    with pytest.raises(MPIError):
+        comm_connect(a, "tpu-port:99999", timeout_s=0.2)
+    port = open_port()
+    with pytest.raises(MPIError):
+        comm_accept(a, port, timeout_s=0.2)  # nobody connects
+    close_port(port)
+
+
+def test_publish_duplicate_rejected():
+    port = open_port()
+    publish_name("dup-svc", port)
+    with pytest.raises(MPIError):
+        publish_name("dup-svc", port)
+    unpublish_name("dup-svc")
+    with pytest.raises(MPIError):
+        unpublish_name("dup-svc")
+    close_port(port)
+
+
+def test_inter_nonblocking_variants(pair):
+    """i-variants have inter semantics (not the inherited intra
+    signatures) and ibarrier rides the bridge."""
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    bufs_a = np.arange(3, dtype=np.float32).reshape(3, 1)
+    bufs_b = 10 + np.arange(5, dtype=np.float32).reshape(5, 1)
+    req = ia.iallgather(bufs_a, bufs_b)
+    req.wait()
+    np.testing.assert_array_equal(np.asarray(req.value).ravel(),
+                                  bufs_b.ravel())
+    req = ia.iallreduce(bufs_a, bufs_b)
+    req.wait()
+    np.testing.assert_allclose(np.asarray(req.value).ravel(),
+                               [bufs_b.sum()])
+    rb = ia.ibarrier()
+    rb.wait()
+    assert rb.test()[0]
+
+
+def test_inter_unimplemented_ops_raise(pair):
+    """Intra-only ops must raise on an intercommunicator, not silently
+    run with intra semantics over the local group."""
+    a, b = pair
+    ia, _ = intercomm_create(a, 0, b, 0)
+    x = np.zeros((3, 4), np.float32)
+    for fn in (ia.iscan, ia.iexscan, ia.scan, ia.exscan):
+        with pytest.raises(MPIError):
+            fn(x)
+
+
+def test_inter_v_variants(pair):
+    """The ragged inter collectives (MPI-2.2 inter semantics: results
+    land in the group complementary to the contributors)."""
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    nl, nr = ia.size, ia.remote_size  # 3, 5
+
+    send_b = [np.arange(j + 1, dtype=np.float32) + 10 * j
+              for j in range(nr)]
+    send_a = [np.arange(2, dtype=np.float32) for _ in range(nl)]
+    got = np.asarray(ia.allgatherv(send_a, send_b))
+    np.testing.assert_array_equal(got, np.concatenate(send_b))
+    got = np.asarray(ia.gatherv(send_b, root=1))
+    np.testing.assert_array_equal(got, np.concatenate(send_b))
+
+    counts = [2, 1, 3]
+    buf = np.arange(6, dtype=np.float32)
+    out = ia.scatterv(buf, counts, root=2)
+    offs = [0, 2, 3]
+    for i in range(nl):
+        np.testing.assert_array_equal(
+            np.asarray(out[i]), buf[offs[i]:offs[i] + counts[i]])
+
+    xs = np.stack([np.arange(6, dtype=np.float32) * (j + 1)
+                   for j in range(nr)])
+    want = xs.sum(0)
+    rsb = np.asarray(ia.reduce_scatter_block(xs))
+    assert rsb.shape[0] == nl
+    np.testing.assert_allclose(rsb.reshape(-1), want)
+
+    rc = [1, 2, 3]
+    rs = ia.reduce_scatter(xs, rc)
+    o = np.concatenate([[0], np.cumsum(rc)])
+    for i in range(nl):
+        np.testing.assert_allclose(np.asarray(rs[i]),
+                                   want[o[i]:o[i] + rc[i]])
+
+    cl = np.asarray([[(i + j) % 2 for j in range(nr)]
+                     for i in range(nl)])
+    cr = np.asarray([[(j + 2 * i) % 3 for i in range(nl)]
+                     for j in range(nr)])
+    sb_l = [np.full(int(cl[i].sum()), float(i), np.float32)
+            for i in range(nl)]
+    sb_r = [np.concatenate([np.full(int(cr[j, i]), 100 * j + i,
+                                    np.float32) for i in range(nl)])
+            for j in range(nr)]
+    rv = ia.alltoallv(sb_l, cl, sb_r, cr)
+    for i in range(nl):
+        want_i = np.concatenate(
+            [np.full(int(cr[j, i]), 100 * j + i, np.float32)
+             for j in range(nr)])
+        np.testing.assert_array_equal(np.asarray(rv[i]), want_i)
+
+    # nonblocking variant round-trips
+    req = ia.iallgatherv(send_a, send_b)
+    req.wait()
+    np.testing.assert_array_equal(np.asarray(req.value),
+                                  np.concatenate(send_b))
+
+
+def test_inter_p2p_remote_addressing(pair):
+    """MPI-2 intercomm p2p: dest/source are ranks in the REMOTE
+    group. A message from A's rank 0 to remote rank 1 must arrive at
+    B's local rank 1 (world rank 4) — not local rank 1."""
+    a, b = pair
+    ia, ib = intercomm_create(a, 0, b, 0)
+    payload = np.arange(5, dtype=np.float32)
+    req = ia.isend(payload, dest=1, tag=7, rank=0)
+    got, st = ib.recv(source=0, tag=7, rank=1)
+    req.wait()
+    np.testing.assert_array_equal(np.asarray(got), payload)
+    # status.source is the REMOTE-group rank, not a bridge rank: B's
+    # handle received from A's rank 0 (bridge rank 0 happens to match
+    # here, so also check the reverse direction below)
+    assert st.source == 0
+    ib.send(payload, dest=2, tag=9, rank=3)  # B rank 3 -> A rank 2
+    got3, st3 = ia.recv(source=-1, tag=9, rank=2)
+    assert st3.source == 3  # remote (B-group) rank, not bridge rank 6
+    # reply flows back remote->local
+    ib.send(payload * 2, dest=0, tag=8, rank=1)
+    got2, _ = ia.recv(source=1, tag=8, rank=0)
+    np.testing.assert_array_equal(np.asarray(got2), payload * 2)
+    with pytest.raises(MPIError):
+        ia.isend(payload, dest=5, rank=0)  # remote group has 5 ranks 0-4
+    with pytest.raises(MPIError):
+        ia.sendrecv([payload], [0])
+
+
+def test_port_reusable_across_accepts(world):
+    """MPI keeps a port valid until close_port: a server loops accept
+    on one published port, serving multiple clients."""
+    srv = world.create(world.group.incl([0, 1]), name="srv")
+    c1 = world.create(world.group.incl([2, 3]), name="c1")
+    c2 = world.create(world.group.incl([4, 5]), name="c2")
+    port = open_port()
+    results = []
+
+    def serve():
+        for _ in range(2):
+            results.append(comm_accept(srv, port, timeout_s=15))
+
+    t = threading.Thread(target=serve)
+    t.start()
+    ic1 = comm_connect(c1, port, timeout_s=15)
+    ic2 = comm_connect(c2, port, timeout_s=15)
+    t.join(timeout=20)
+    assert len(results) == 2
+    assert results[0].remote_group.world_ranks == (2, 3)
+    assert results[1].remote_group.world_ranks == (4, 5)
+    assert ic1.remote_group.world_ranks == (0, 1)
+    assert ic2.remote_group.world_ranks == (0, 1)
+    close_port(port)
+    with pytest.raises(MPIError):
+        comm_connect(c1, port, timeout_s=0.2)

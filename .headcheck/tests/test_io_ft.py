@@ -1,0 +1,537 @@
+"""Parallel I/O + checkpoint/restart + failure detection tests."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.ft import (
+    Checkpointer, ErrMgr, FtTester, Heartbeat, run_with_restart,
+    resource_usage,
+)
+from ompi_release_tpu.ft.sensor import InjectedFault
+from ompi_release_tpu.io import File, MODE_CREATE, MODE_RDWR
+from ompi_release_tpu.io.sharded import (
+    load_pytree, load_sharded, save_pytree, save_sharded,
+)
+from ompi_release_tpu.utils.errors import MPIError
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+class TestShardedIO:
+    def test_roundtrip(self, tmp_path):
+        x = np.random.RandomState(0).randn(8, 16, 4).astype(np.float32)
+        save_sharded(str(tmp_path), x, name="w")
+        y = load_sharded(str(tmp_path), name="w")
+        np.testing.assert_array_equal(x, y)
+        # one object per shard on disk
+        assert len([f for f in os.listdir(tmp_path)
+                    if f.endswith(".npy")]) == 8
+
+    def test_async_write(self, tmp_path):
+        x = np.ones((4, 1000), np.float32)
+        futs = save_sharded(str(tmp_path), x, name="a", async_=True)
+        for f in futs:
+            f.result()
+        np.testing.assert_array_equal(
+            load_sharded(str(tmp_path), name="a"), x
+        )
+
+    def test_bfloat16_roundtrip(self, tmp_path):
+        x = jnp.asarray(np.random.RandomState(1).randn(4, 8),
+                        jnp.bfloat16)
+        save_sharded(str(tmp_path), x, name="b")
+        y = load_sharded(str(tmp_path), name="b")
+        assert y.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(x, np.float32), np.asarray(y, np.float32)
+        )
+
+    def test_pytree_roundtrip(self, tmp_path):
+        tree = {
+            "w": np.random.RandomState(2).randn(4, 3).astype(np.float32),
+            "b": np.float32(2.5),  # scalar leaf
+            "nested": {"i": np.arange(6, dtype=np.int32)},
+        }
+        save_pytree(str(tmp_path), tree)
+        out = load_pytree(str(tmp_path), tree)
+        np.testing.assert_array_equal(out["w"], tree["w"])
+        assert float(out["b"]) == 2.5
+        np.testing.assert_array_equal(out["nested"]["i"],
+                                      tree["nested"]["i"])
+
+    def test_missing_manifest_raises(self, tmp_path):
+        with pytest.raises(MPIError):
+            load_sharded(str(tmp_path), name="nope")
+
+
+class TestFileAPI:
+    def test_write_read_at_with_view(self, world, tmp_path):
+        p = str(tmp_path / "f.bin")
+        with File(world, p, MODE_RDWR | MODE_CREATE) as f:
+            f.set_view(disp=8, etype=np.float32)
+            f.write_at(2, np.array([1.5, 2.5], np.float32))
+            out = f.read_at(2, 2)
+        np.testing.assert_array_equal(out, [1.5, 2.5])
+        assert os.stat(p).st_size == 8 + 4 * 4  # disp + 4 elements
+
+    def test_collective_write_all(self, world, tmp_path):
+        p = str(tmp_path / "c.bin")
+        n = world.size
+        blocks = [np.full(4, r, np.float32) for r in range(n)]
+        with File(world, p) as f:
+            f.set_view(etype=np.float32)
+            f.write_at_all([r * 4 for r in range(n)], blocks)
+            whole = f.read_at(0, 4 * n)
+        np.testing.assert_array_equal(
+            whole.reshape(n, 4), np.stack(blocks)
+        )
+
+    def test_shared_pointer_ordered(self, world, tmp_path):
+        p = str(tmp_path / "s.bin")
+        with File(world, p) as f:
+            f.set_view(etype=np.int32)
+            f.write_ordered([np.array([r], np.int32)
+                             for r in range(world.size)])
+            f._shared_ptr = 0
+            out = f.read_shared(world.size)
+        np.testing.assert_array_equal(out, np.arange(world.size))
+
+
+class TestFiletypeViews:
+    """ROMIO-style file views with holes (``io/romio`` README:3): the
+    filetype tiles the file; only its data regions are addressable."""
+
+    def test_vector_view_skips_holes(self, world, tmp_path):
+        from ompi_release_tpu.datatype import datatype as dt
+
+        path = str(tmp_path / "v.bin")
+        with File(world, path) as f:
+            # background pattern so holes are observable
+            f.write_at(0, np.full(32, 0xEE, np.uint8))
+        with File(world, path) as f:
+            ft = dt.create_vector(4, 2, 4, dt.INT32)  # 2 data, 2 hole
+            f.set_view(0, np.int32, filetype=ft)
+            f.write_at(0, np.arange(8, dtype=np.int32))
+            got = f.read_at(0, 8)
+            np.testing.assert_array_equal(got,
+                                          np.arange(8, dtype=np.int32))
+        # raw file: data at int32 positions {0,1, 4,5, 8,9, 12,13}
+        raw = np.fromfile(path, np.int32)
+        np.testing.assert_array_equal(raw[[0, 1, 4, 5]], [0, 1, 2, 3])
+        hole = np.frombuffer(np.asarray(raw[[2, 3]]).tobytes(), np.uint8)
+        assert (hole == 0xEE).all()  # holes untouched
+
+    def test_view_spans_multiple_tiles(self, world, tmp_path):
+        from ompi_release_tpu.datatype import datatype as dt
+
+        path = str(tmp_path / "t.bin")
+        with File(world, path) as f:
+            ft = dt.create_vector(2, 1, 2, dt.FLOAT)
+            f.set_view(8, np.float32, filetype=ft)
+            # 7 elements from view position 3: crosses tile boundaries
+            f.write_at(3, np.arange(3, 10, dtype=np.float32))
+            got = f.read_at(3, 7)
+        np.testing.assert_array_equal(got,
+                                      np.arange(3, 10, dtype=np.float32))
+
+    def test_etype_filetype_size_mismatch_raises(self, world, tmp_path):
+        from ompi_release_tpu.datatype import datatype as dt
+
+        with File(world, str(tmp_path / "m.bin")) as f:
+            ft = dt.create_vector(2, 1, 2, dt.INT64)
+            with pytest.raises(MPIError):
+                f.set_view(0, np.int32, filetype=ft)
+
+
+class TestNonblockingIO:
+    """MPI_File_iwrite_at/iread_at (+ _all): Requests on the file's
+    thread pool; MPI_File_close completes outstanding ops."""
+
+    def test_iwrite_iread_roundtrip(self, world, tmp_path):
+        with File(world, str(tmp_path / "nb.bin")) as f:
+            f.set_view(0, np.float32)
+            wreq = f.iwrite_at(2, np.arange(16, dtype=np.float32))
+            st = wreq.wait()
+            assert st.count == 16 and wreq.value == 16
+            rreq = f.iread_at(2, 16)
+            rreq.wait()
+            np.testing.assert_array_equal(
+                np.asarray(rreq.value), np.arange(16, dtype=np.float32))
+
+    def test_interleaved_view_written_nonblockingly(self, world,
+                                                    tmp_path):
+        """The two-phase case: two ranks' views interleave element-wise
+        (rank 0 writes even int32 slots, rank 1 odd slots), both
+        written through iwrite_at, then round-tripped through each
+        view AND verified interleaved in the raw file."""
+        from ompi_release_tpu.datatype import datatype as dt
+
+        path = str(tmp_path / "ileave.bin")
+        n = 8
+        ft = dt.create_vector(n, 1, 2, dt.INT32)  # every 2nd slot
+        with File(world, path) as f:
+            f.set_view(0, np.int32, filetype=ft)          # rank 0 view
+            r0 = f.iwrite_at(0, np.arange(n, dtype=np.int32))
+            f2 = File(world, path)
+            f2.set_view(4, np.int32, filetype=ft)         # rank 1 view
+            r1 = f2.iwrite_at(0, 100 + np.arange(n, dtype=np.int32))
+            assert r0.wait().count == n
+            assert r1.wait().count == n
+            # round-trip through each rank's view (nonblocking read)
+            rr = f.iread_at(0, n)
+            rr.wait()
+            np.testing.assert_array_equal(np.asarray(rr.value),
+                                          np.arange(n, dtype=np.int32))
+            np.testing.assert_array_equal(
+                f2.read_at(0, n), 100 + np.arange(n, dtype=np.int32))
+            f2.close()
+        raw = np.fromfile(path, np.int32)
+        np.testing.assert_array_equal(raw[0::2],
+                                      np.arange(n, dtype=np.int32))
+        np.testing.assert_array_equal(raw[1::2],
+                                      100 + np.arange(n, dtype=np.int32))
+
+    def test_iwrite_at_all_collective(self, world, tmp_path):
+        n = world.size
+        with File(world, str(tmp_path / "call.bin")) as f:
+            f.set_view(0, np.int32)
+            offsets = [r * 4 for r in range(n)]
+            blocks = [np.full(4, r, np.int32) for r in range(n)]
+            req = f.iwrite_at_all(offsets, blocks)
+            req.wait()
+            got = f.read_at(0, 4 * n)
+        want = np.repeat(np.arange(n, dtype=np.int32), 4)
+        np.testing.assert_array_equal(got, want)
+
+    def test_error_surfaces_at_wait(self, world, tmp_path):
+        f = File(world, str(tmp_path / "err.bin"))
+        f.set_view(0, np.float32)
+        f.close()
+        # closed before submit: immediate raise
+        with pytest.raises(MPIError):
+            f.iwrite_at(0, np.ones(4, np.float32))
+
+    def test_close_completes_outstanding(self, world, tmp_path):
+        f = File(world, str(tmp_path / "drain.bin"))
+        f.set_view(0, np.uint8)
+        reqs = [f.iwrite_at(i * 1000, np.full(1000, i, np.uint8))
+                for i in range(8)]
+        f.close()  # must drain the pool
+        assert os.path.getsize(str(tmp_path / "drain.bin")) == 8000
+        for r in reqs:
+            assert r.wait().count == 1000
+
+
+class TestCheckpoint:
+    def test_save_restore(self, world, tmp_path):
+        ck = Checkpointer(str(tmp_path), comm=world)
+        state = {"p": np.random.RandomState(3).randn(4, 4).astype(
+            np.float32), "step": np.int32(7)}
+        ck.save(7, state, async_=False)
+        assert ck.steps() == [7]
+        out = ck.restore(state)
+        np.testing.assert_array_equal(out["p"], state["p"])
+        assert int(out["step"]) == 7
+
+    def test_async_commit_and_gc(self, world, tmp_path):
+        ck = Checkpointer(str(tmp_path), keep=2, comm=world)
+        s = {"x": np.ones(8, np.float32)}
+        for step in (1, 2, 3, 4):
+            ck.save(step, {"x": s["x"] * step})
+        ck.wait()
+        assert ck.steps() == [3, 4]  # keep=2
+        out = ck.restore(s, 3)
+        np.testing.assert_array_equal(out["x"], np.full(8, 3.0))
+
+    def test_uncommitted_tmp_not_restored(self, world, tmp_path):
+        ck = Checkpointer(str(tmp_path), comm=world)
+        ck.save(1, {"x": np.ones(2, np.float32)}, async_=False)
+        # simulate crash mid-write of step 2: tmp dir, no marker
+        os.makedirs(str(tmp_path / "step_0000000002.tmp"))
+        assert ck.latest_step() == 1
+
+    def test_quiesce_rejects_posted_recvs(self, world, tmp_path):
+        ck = Checkpointer(str(tmp_path), comm=world)
+        r = world.irecv(source=0, tag=4242, rank=1)
+        with pytest.raises(MPIError):
+            ck.save(1, {"x": np.zeros(2, np.float32)})
+        r.cancel()
+        ck.save(1, {"x": np.zeros(2, np.float32)}, async_=False)
+
+
+class TestSensors:
+    def test_heartbeat_detects_silence(self):
+        fired = []
+        hb = Heartbeat(interval_s=0.05, miss_limit=2,
+                       on_failure=lambda: fired.append(1)).start()
+        hb.beat()
+        time.sleep(0.3)
+        hb.stop()
+        assert hb.failed and fired
+
+    def test_heartbeat_stays_alive_with_beats(self):
+        hb = Heartbeat(interval_s=0.05, miss_limit=3).start()
+        for _ in range(10):
+            hb.beat()
+            time.sleep(0.02)
+        assert not hb.failed
+        hb.stop()
+
+    def test_ft_tester_deterministic(self):
+        t = FtTester(fail_prob=1.0, seed=0)
+        with pytest.raises(InjectedFault):
+            t.maybe_fail("here")
+        t2 = FtTester(fail_prob=0.0, seed=0)
+        for _ in range(100):
+            t2.maybe_fail()
+        assert t2.injected == 0
+
+    def test_resource_usage(self):
+        ru = resource_usage()
+        assert ru["rss"] > 0 and ru["vmsize"] >= ru["rss"]
+
+
+class TestErrMgr:
+    def test_handler_registry(self):
+        em = ErrMgr()
+        seen = []
+        em.register(ValueError, lambda e: seen.append(repr(e)))
+        assert em.handle(ValueError("x"))
+        assert not em.handle(KeyError("y"))
+        assert len(seen) == 1
+
+    def test_run_with_restart_recovers(self, world, tmp_path):
+        """Fault injection mid-training: training must complete with
+        the same result as a fault-free run (deterministic replay)."""
+        ck = Checkpointer(str(tmp_path), comm=world)
+        tester = FtTester(seed=7)
+        fail_at = {13, 27}  # inject at these steps, once each
+
+        def step_fn(step, state):
+            if step in fail_at:
+                fail_at.discard(step)
+                raise InjectedFault(f"boom@{step}")
+            return {"acc": state["acc"] + step}
+
+        init = {"acc": np.float32(0.0)}
+        final, stats = run_with_restart(
+            step_fn, init, num_steps=30, checkpointer=ck,
+            checkpoint_every=5,
+        )
+        assert stats["restarts"] == 2
+        assert float(final["acc"]) == float(sum(range(30)))
+
+    def test_run_with_restart_gives_up(self, world, tmp_path):
+        ck = Checkpointer(str(tmp_path / "b"), comm=world)
+
+        def always_fail(step, state):
+            raise InjectedFault("always")
+
+        with pytest.raises(InjectedFault):
+            run_with_restart(
+                always_fail, {"x": np.float32(0)}, num_steps=5,
+                checkpointer=ck, checkpoint_every=1, max_restarts=2,
+            )
+
+
+class TestFlatLayout:
+    def test_flat_shard_count_scales_with_bytes_not_axis0(self, tmp_path):
+        """ADVICE r1 (medium): a (4096, 8) leaf must produce a handful
+        of size-targeted shards, never one file per row."""
+        from ompi_release_tpu.mca import var as mca_var
+
+        x = np.arange(4096 * 8, dtype=np.float32).reshape(4096, 8)
+        mca_var.set_value("io_target_shard_bytes", 32 * 1024)
+        try:
+            save_sharded(str(tmp_path), x, name="flat", layout="flat")
+        finally:
+            mca_var.VARS.unset("io_target_shard_bytes")
+        shards = [f for f in os.listdir(tmp_path)
+                  if f.startswith("flat.shard")]
+        assert len(shards) == 4  # 128 KiB / 32 KiB
+        y = load_sharded(str(tmp_path), name="flat")
+        np.testing.assert_array_equal(y, x)
+
+    def test_pytree_uses_flat_layout(self, tmp_path):
+        tree = {"embed": np.random.RandomState(0).randn(512, 4)
+                .astype(np.float32),
+                "scale": np.float32(2.5)}
+        save_pytree(str(tmp_path), tree)
+        # one shard for the small embed table (well under target), one
+        # for the scalar — NOT 512 row files
+        shards = [f for f in os.listdir(tmp_path) if ".shard" in f]
+        assert len(shards) == 2, shards
+        out = load_pytree(str(tmp_path), tree)
+        np.testing.assert_array_equal(out["embed"], tree["embed"])
+        assert float(out["scale"]) == 2.5
+
+
+class TestMemchecker:
+    """Donated-buffer liveness (memchecker/valgrind analogue,
+    memchecker_valgrind_module.c:98-151) — closes the A2
+    'no donated-buffer liveness' gap."""
+
+    def test_donating_jit_marks_and_catches_reuse(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ompi_release_tpu.utils import memchecker
+        from ompi_release_tpu.utils.errors import MPIError
+
+        step = memchecker.donating_jit(
+            lambda acc, g: acc + g, donate_argnums=(0,),
+            owner="grad_accumulate",
+        )
+        acc = jnp.ones((256, 256), jnp.float32)
+        g = jnp.full((256, 256), 2.0, jnp.float32)
+        out = step(acc, g)
+        np.testing.assert_allclose(np.asarray(out)[0, 0], 3.0)
+        if not acc.is_deleted():
+            pytest.skip("backend did not donate (no aliasing on this "
+                        "platform/config)")
+        with pytest.raises(MPIError) as ei:
+            memchecker.check(acc)
+        assert "grad_accumulate" in str(ei.value)
+        # double-donation of a consumed buffer is caught BEFORE dispatch
+        with pytest.raises(MPIError):
+            step(acc, g)
+
+    def test_assert_all_alive_names_the_leaf(self):
+        import jax.numpy as jnp
+
+        from ompi_release_tpu.utils import memchecker
+        from ompi_release_tpu.utils.errors import MPIError
+
+        good = {"w": jnp.ones(4), "b": jnp.zeros(2)}
+        memchecker.assert_all_alive(good)  # no raise
+
+        class FakeDeleted:
+            dtype = np.float32
+
+            def is_deleted(self):
+                return True
+
+        memchecker.mark_donated(FakeDeleted(), "optimizer_update")
+        bad = {"w": jnp.ones(4), "dead": FakeDeleted()}
+        with pytest.raises(MPIError):
+            memchecker.assert_all_alive(bad, what="params")
+
+    def test_checkpoint_rejects_donated_state(self, tmp_path):
+        import jax.numpy as jnp
+
+        from ompi_release_tpu.ft.checkpoint import Checkpointer
+        from ompi_release_tpu.utils import memchecker
+        from ompi_release_tpu.utils.errors import MPIError
+
+        step = memchecker.donating_jit(
+            lambda x: x * 2, donate_argnums=(0,), owner="train_step",
+        )
+        x = jnp.ones((128, 128), jnp.float32)
+        _ = step(x)
+        if not x.is_deleted():
+            pytest.skip("backend did not donate")
+        ck = Checkpointer(str(tmp_path / "ckpt"))
+        with pytest.raises(MPIError) as ei:
+            ck.save(1, {"params": x}, async_=False)
+        assert "train_step" in str(ei.value)
+
+
+def test_write_shared_pointer_advances(tmp_path, world):
+    """sharedfp non-ordered append: each write lands at the current
+    shared pointer and advances it."""
+    from ompi_release_tpu.io.file import File
+
+    path = str(tmp_path / "sharedfp.bin")
+    with File(world, path) as f:
+        f.set_view(0, np.float32)
+        assert f.write_shared(np.arange(3, dtype=np.float32)) == 3
+        assert f.write_shared(np.full(2, 9.0, np.float32)) == 2
+        got = f.read_at(0, 5)
+        np.testing.assert_array_equal(got, [0, 1, 2, 9, 9])
+
+
+def test_donating_jit_pytree_arg_provenance():
+    """Pytree donated args: the pre-dispatch liveness check walks the
+    LEAVES, so reuse of a consumed state dict raises with provenance."""
+    import jax.numpy as jnp
+
+    from ompi_release_tpu.utils import memchecker
+    from ompi_release_tpu.utils.errors import MPIError
+
+    step = memchecker.donating_jit(
+        lambda st, g: {"w": st["w"] + g}, donate_argnums=(0,),
+        owner="tree_step",
+    )
+    st = {"w": jnp.ones((64, 64), jnp.float32)}
+    g = jnp.ones((64, 64), jnp.float32)
+    out = step(st, g)
+    if not st["w"].is_deleted():
+        pytest.skip("backend did not donate")
+    with pytest.raises(MPIError) as ei:
+        step(st, g)  # consumed pytree caught BEFORE dispatch
+    assert "tree_step" in str(ei.value)
+
+
+class TestCheckpointCli:
+    """tpu-checkpoint CLI (orte-checkpoint/orte-restart tool role)."""
+
+    def _make(self, tmp_path, steps=(3, 7)):
+        import jax.numpy as jnp
+
+        from ompi_release_tpu.ft.checkpoint import Checkpointer
+
+        ck = Checkpointer(str(tmp_path), keep=0)
+        state = {"w": jnp.arange(1000, dtype=jnp.float32),
+                 "b": jnp.ones((4,), jnp.float32)}
+        for s in steps:
+            ck.save(s, state, async_=False, extra_meta={"loss": 1.0 / s})
+        return ck
+
+    def test_list_show_verify_gc(self, tmp_path, capsys):
+        from ompi_release_tpu.tools import tpu_checkpoint as cli
+
+        self._make(tmp_path)
+        assert cli.main(["list", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "step        3" in out and "step        7" in out
+        assert cli.main(["show", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert '"step": 7' in out
+        assert cli.main(["verify", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "verified OK" in out
+        assert cli.main(["gc", str(tmp_path), "--keep", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "removed step 3" in out
+        assert cli.main(["list", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "step        3" not in out
+
+    def test_verify_detects_corruption(self, tmp_path, capsys):
+        import glob
+        import os
+
+        from ompi_release_tpu.tools import tpu_checkpoint as cli
+
+        self._make(tmp_path, steps=(1,))
+        shards = glob.glob(str(tmp_path / "step_*" / "leaf0000*"))
+        data_files = [p for p in shards if not p.endswith(".json")]
+        assert data_files
+        with open(data_files[0], "r+b") as f:
+            f.seek(16)
+            byte = f.read(1)
+            f.seek(16)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        assert cli.main(["verify", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "FAILED" in out or "corrupt" in out

@@ -1,0 +1,135 @@
+"""Unit tests for the framework/component lifecycle (mca/component.py)."""
+
+import pytest
+
+from ompi_release_tpu.mca import var as mca_var
+from ompi_release_tpu.mca.component import Component, Framework
+
+
+class CompA(Component):
+    NAME = "alpha"
+    PRIORITY = 10
+
+
+class CompB(Component):
+    NAME = "beta"
+    PRIORITY = 20
+
+
+class CompBroken(Component):
+    NAME = "broken"
+    PRIORITY = 99
+
+    def open(self):
+        raise RuntimeError("cannot init hardware")
+
+
+class CompUnavailable(Component):
+    NAME = "unavail"
+    PRIORITY = 99
+
+    def query(self, ctx=None):
+        return None
+
+
+def _fw(name):
+    fw = Framework(name, "test framework")
+    fw.register(CompA())
+    fw.register(CompB())
+    fw.register(CompBroken())
+    fw.register(CompUnavailable())
+    return fw
+
+
+def test_priority_selection(fresh_mca):
+    fw = _fw("tfw1")
+    mod = fw.select()
+    assert mod.NAME == "beta"  # highest openable+queryable priority
+
+
+def test_select_all_sorted(fresh_mca):
+    fw = _fw("tfw2")
+    mods = fw.select_all()
+    assert [m.NAME for m in mods] == ["beta", "alpha"]
+
+
+def test_include_list(fresh_mca):
+    fw = _fw("tfw3")
+    mca_var.VARS.set_value("tfw3", "alpha")
+    assert fw.select().NAME == "alpha"
+
+
+def test_exclude_list(fresh_mca):
+    fw = _fw("tfw4")
+    mca_var.VARS.set_value("tfw4", "^beta")
+    assert fw.select().NAME == "alpha"
+
+
+def test_priority_override_var(fresh_mca):
+    fw = _fw("tfw5")
+    fw.open()
+    mca_var.VARS.set_value("tfw5_alpha_priority", 1000)
+    assert fw.select().NAME == "alpha"
+
+
+def test_no_component_raises(fresh_mca):
+    fw = Framework("tfw6")
+    fw.register(CompUnavailable())
+    with pytest.raises(RuntimeError):
+        fw.select()
+
+
+def test_broken_component_skipped(fresh_mca):
+    fw = Framework("tfw7")
+    fw.register(CompBroken())
+    fw.register(CompA())
+    assert fw.select().NAME == "alpha"
+
+
+def test_selection_var_change_after_open(fresh_mca):
+    """Changing the include list after open must still find components."""
+    fw = Framework("tfw8")
+    fw.register(CompA())
+    fw.register(CompB())
+    mca_var.VARS.set_value("tfw8", "alpha")
+    assert fw.select().NAME == "alpha"
+    mca_var.VARS.set_value("tfw8", "beta")
+    assert fw.select().NAME == "beta"
+
+
+def test_framework_verbose_var_reaches_stream(fresh_mca):
+    import io
+    from ompi_release_tpu.utils import output
+    buf = io.StringIO()
+    output.set_sink(buf)
+    try:
+        fw = Framework("tfw9")
+        fw.register(CompA())
+        mca_var.VARS.set_value("tfw9_verbose", 5)
+        fw.select()
+        assert "selected component alpha" in buf.getvalue()
+    finally:
+        output.set_sink(None)
+
+
+def test_excluded_component_never_opened(fresh_mca):
+    opened = []
+
+    class Tracker(Component):
+        NAME = "tracker"
+        PRIORITY = 99
+
+        def open(self):
+            opened.append(self.NAME)
+            return True
+
+    mca_var.VARS.set_value("tfw10", "^tracker")
+    fw = Framework("tfw10")
+    fw.register(Tracker())
+    fw.register(CompA())
+    assert fw.select().NAME == "alpha"
+    assert opened == []  # exclusion respected at open time
+    # late re-inclusion opens on demand
+    mca_var.VARS.set_value("tfw10", "tracker")
+    assert fw.select().NAME == "tracker"
+    assert opened == ["tracker"]

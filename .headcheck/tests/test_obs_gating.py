@@ -1,0 +1,213 @@
+"""Static hot-path observability discipline for the new coll engines
+and the wire transport.
+
+``coll/pipeline.py``, ``coll/fusion.py``, and ``runtime/wire.py`` sit
+on hot paths (the wire router is EVERY cross-process byte); PR 1's
+contract is that observability costs ONE attribute check
+(``_obs.enabled``) when off. This test enforces it statically, without
+importing jax: every emit site (journal ``record``, skew
+``begin/body/end``, per-call pvar registry lookups) must be gated on
+``_obs.enabled``, and every pvar bump (``.add``/``.observe``) must
+target a MODULE-LEVEL pre-registered pvar (the zero-cost-counter
+class the driver already uses) or itself be gated.
+``btl/components.py`` carries wire pvars but no journal emits, so it
+is checked for gating violations only.
+
+Gating shapes recognized:
+
+- ``if _obs.enabled: <emit>``   (including ``and``-compounds)
+- ``if not _obs.enabled: return`` followed by the emit (early-return)
+"""
+
+import ast
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKED = ("ompi_release_tpu/coll/pipeline.py",
+           "ompi_release_tpu/coll/fusion.py",
+           "ompi_release_tpu/runtime/wire.py")
+#: gating violations checked, but no journal-emit-site requirement
+#: (module-level wire pvars only — no _obs import)
+PVAR_ONLY = ("ompi_release_tpu/btl/components.py",)
+
+#: attribute calls that ARE emit sites when ungated
+EMIT_ATTRS = {"record", "begin", "body", "end"}
+#: per-call pvar registry lookups (allocate/lock per call — never on
+#: an ungated hot path; module scope is where registration belongs)
+REGISTRY_ATTRS = {"counter", "aggregate", "histogram", "timer",
+                  "highwatermark"}
+#: bumps allowed ungated ONLY on module-level pvars
+BUMP_ATTRS = {"add", "observe"}
+
+
+def _mentions_enabled(node) -> bool:
+    return any(
+        (isinstance(n, ast.Attribute) and n.attr == "enabled")
+        or (isinstance(n, ast.Name) and n.id == "enabled")
+        for n in ast.walk(node)
+    )
+
+
+def _terminates(stmts) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _module_pvars(tree) -> set:
+    """Names bound at module level to pvar registrations."""
+    out = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            f = stmt.value.func
+            attr = f.attr if isinstance(f, ast.Attribute) else \
+                (f.id if isinstance(f, ast.Name) else None)
+            if attr in REGISTRY_ATTRS:
+                out.update(t.id for t in stmt.targets
+                           if isinstance(t, ast.Name))
+    return out
+
+
+def _check_calls(node, gated, pvars, violations, path):
+    """Check every Call in an expression subtree (no statements here)."""
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        where = f"{path}:{n.lineno}"
+        if f.attr in EMIT_ATTRS and not gated:
+            # record/begin/body/end on obs-ish receivers; skip
+            # unrelated receivers (e.g. dict methods named the same)
+            base = f.value
+            base_name = (base.id if isinstance(base, ast.Name) else
+                         base.attr if isinstance(base, ast.Attribute)
+                         else "")
+            if any(t in base_name for t in ("obs", "skew", "journal",
+                                            "JOURNAL")):
+                violations.append(
+                    f"{where}: ungated emit {base_name}.{f.attr}()")
+        if f.attr in REGISTRY_ATTRS and not gated:
+            base = f.value
+            if isinstance(base, ast.Name) and base.id in ("pvar",
+                                                          "_pvar"):
+                violations.append(
+                    f"{where}: per-call pvar registry lookup "
+                    f"{base.id}.{f.attr}() on the hot path")
+        if f.attr in BUMP_ATTRS and not gated:
+            base = f.value
+            if isinstance(base, ast.Name) and base.id not in pvars:
+                violations.append(
+                    f"{where}: {base.id}.{f.attr}() bumps a "
+                    f"non-module-level pvar ungated")
+
+
+def _scan_stmts(stmts, gated, pvars, violations, path):
+    for stmt in stmts:
+        if isinstance(stmt, ast.If) and _mentions_enabled(stmt.test):
+            neg = (isinstance(stmt.test, ast.UnaryOp)
+                   and isinstance(stmt.test.op, ast.Not))
+            _check_calls(stmt.test, gated, pvars, violations, path)
+            if neg:
+                _scan_stmts(stmt.body, gated, pvars, violations, path)
+                _scan_stmts(stmt.orelse, True, pvars, violations, path)
+                if _terminates(stmt.body):
+                    gated = True  # `if not enabled: return` early-out
+            else:
+                _scan_stmts(stmt.body, True, pvars, violations, path)
+                _scan_stmts(stmt.orelse, gated, pvars, violations, path)
+            continue
+        # other statements: recurse into child statement lists with the
+        # same gating, check the non-statement (expression) children
+        for field, value in ast.iter_fields(stmt):
+            if isinstance(value, list) and value \
+                    and isinstance(value[0], ast.stmt):
+                _scan_stmts(value, gated, pvars, violations, path)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.excepthandler):
+                        _scan_stmts(v.body, gated, pvars, violations,
+                                    path)
+                    elif isinstance(v, ast.AST):
+                        _check_calls(v, gated, pvars, violations, path)
+            elif isinstance(value, ast.AST):
+                _check_calls(value, gated, pvars, violations, path)
+
+
+def test_pvar_only_files_have_no_ungated_sites():
+    for rel in PVAR_ONLY:
+        path = os.path.join(REPO, rel)
+        tree = ast.parse(open(path).read(), filename=rel)
+        pvars = _module_pvars(tree)
+        assert pvars, f"{rel}: expected module-level pvar registrations"
+        violations = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_stmts(node.body, False, pvars, violations, rel)
+        assert not violations, "\n".join(violations)
+
+
+def test_pipeline_and_fusion_emit_sites_are_gated():
+    checked_any_gate = 0
+    for rel in CHECKED:
+        path = os.path.join(REPO, rel)
+        tree = ast.parse(open(path).read(), filename=rel)
+        pvars = _module_pvars(tree)
+        assert pvars, f"{rel}: expected module-level pvar registrations"
+        violations = []
+        # scan only function bodies (module scope runs once at import)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_stmts(node.body, False, pvars, violations, rel)
+        assert not violations, "\n".join(violations)
+        # non-vacuous: each file must actually contain a gated emit
+        src = open(path).read()
+        assert "_obs.enabled" in src and "_obs.record" in src, (
+            f"{rel}: expected at least one _obs.enabled-gated "
+            f"_obs.record emit site")
+        checked_any_gate += 1
+    assert checked_any_gate == len(CHECKED)
+
+
+def test_gating_checker_catches_violations():
+    """The checker itself must reject an ungated emit (guards against
+    the static test rotting into a rubber stamp)."""
+    bad = (
+        "import time\n"
+        "from .. import obs as _obs\n"
+        "from ..mca import pvar\n"
+        "_ok = pvar.counter('x')\n"
+        "def hot(journal):\n"
+        "    _ok.add()\n"                      # fine: module-level pvar
+        "    journal.record('op', 'l', 0, 0)\n"  # VIOLATION: ungated
+        "    local = pvar.counter('y')\n"        # VIOLATION: per-call
+        "    local.add()\n"                      # VIOLATION: non-module
+    )
+    tree = ast.parse(bad)
+    pvars = _module_pvars(tree)
+    violations = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            _scan_stmts(node.body, False, pvars, violations, "bad.py")
+    assert len(violations) == 3, violations
+
+    good = (
+        "from .. import obs as _obs\n"
+        "from ..mca import pvar\n"
+        "_ok = pvar.counter('x')\n"
+        "def hot(journal):\n"
+        "    _ok.add()\n"
+        "    if _obs.enabled:\n"
+        "        journal.record('op', 'l', 0, 0)\n"
+        "def hot2(journal):\n"
+        "    if not _obs.enabled:\n"
+        "        return 1\n"
+        "    journal.record('op', 'l', 0, 0)\n"
+    )
+    tree = ast.parse(good)
+    violations = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            _scan_stmts(node.body, False, _module_pvars(tree),
+                        violations, "good.py")
+    assert not violations, violations

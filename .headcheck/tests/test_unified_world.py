@@ -1,0 +1,1114 @@
+"""Unified multi-controller COMM_WORLD — the reference's core runtime
+promise (``ompi_mpi_init.c:759-786``: add_procs over ALL peers; any
+rank reaches any rank through one API, ``btl_tcp_component.c:883``).
+
+Real system tests: ``tpurun -n 2`` jobs where each worker process is
+forced to 4 virtual CPU devices, so COMM_WORLD spans 8 ranks across 2
+OS processes. Collectives parity-check against numpy on the SAME
+values a single-controller world would reduce, and p2p crosses the
+process boundary through the public ``comm.send``/``comm.recv`` API
+(the wire pml routing through the shm handoff under the hood — both
+workers share this host).
+"""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from ompi_release_tpu.runtime.state import JobState, ProcState
+from ompi_release_tpu.tools.tpurun import Job
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# NOTE: XLA_FLAGS must land before the first jax import in the WORKER
+# process (the prelude runs first in the launched script)
+APP_PRELUDE = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, %r)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_release_tpu as mpi
+    from ompi_release_tpu.runtime.runtime import Runtime
+""" % REPO)
+
+
+def _write_app(tmp_path, body, name="app.py"):
+    p = tmp_path / name
+    p.write_text(APP_PRELUDE + textwrap.dedent(body))
+    return str(p)
+
+
+def _run(tmp_path, capfd, body, n=2, timeout=180):
+    app = _write_app(tmp_path, body)
+    job = Job(n, [sys.executable, app], [], heartbeat_s=0.5,
+              miss_limit=8)
+    rc = job.run(timeout_s=timeout)
+    out = capfd.readouterr()
+    assert rc == 0, out.out + out.err
+    assert job.job_state.visited(JobState.TERMINATED)
+    return out.out
+
+
+class TestUnifiedWorld:
+    def test_world_spans_processes_with_allreduce_parity(self, tmp_path,
+                                                         capfd):
+        """2 processes x 4 devices = ONE 8-rank world; allreduce over
+        deterministic per-rank values must equal the numpy total a
+        single-controller 8-rank world would produce — bitwise for
+        int32."""
+        out = _run(tmp_path, capfd, """
+            world = mpi.init()
+            rt = Runtime.current()
+            assert world.size == 8, world.size
+            assert rt.local_size == 4
+            off = rt.local_rank_offset
+            # int32: parity must be exact
+            vals = np.stack([
+                np.arange(16, dtype=np.int32) * (off + i + 1)
+                for i in range(4)
+            ])
+            got = np.asarray(world.allreduce(vals))
+            want = sum(np.arange(16, dtype=np.int32) * (r + 1)
+                       for r in range(8))
+            assert got.shape == (4, 16), got.shape
+            for i in range(4):
+                np.testing.assert_array_equal(got[i], want)
+            # f32 parity within tolerance (fixed combine order)
+            fv = np.stack([np.full(8, 0.1, np.float32) * (off + i)
+                           for i in range(4)])
+            fgot = np.asarray(world.allreduce(fv))
+            fwant = sum(np.full(8, 0.1, np.float32) * r for r in range(8))
+            np.testing.assert_allclose(fgot[0], fwant, rtol=1e-5)
+            print(f"ALLREDUCE-OK {off}")
+            mpi.finalize()
+        """)
+        assert "ALLREDUCE-OK 0" in out and "ALLREDUCE-OK 4" in out
+
+    def test_cross_process_send_recv_public_api(self, tmp_path, capfd):
+        """comm.send from a rank in process 0 to a rank in process 1
+        (and back) through the PUBLIC API — the wire pml routes it
+        over the shm handoff with no caller-visible difference."""
+        out = _run(tmp_path, capfd, """
+            world = mpi.init()
+            rt = Runtime.current()
+            if rt.local_rank_offset == 0:
+                # rank 1 (process 0) -> rank 5 (process 1), tag 7
+                world.send(np.arange(32, dtype=np.float32) * 2, 5,
+                           tag=7, rank=1)
+                # and receive the reply at rank 2 from rank 6
+                val, st = world.recv(source=6, tag=9, rank=2)
+                assert st.source == 6 and st.tag == 9
+                np.testing.assert_array_equal(
+                    np.asarray(val), np.full(5, 3, np.int32))
+                print("P0-OK")
+            else:
+                val, st = world.recv(source=1, tag=7, rank=5)
+                assert st.source == 1 and st.tag == 7
+                np.testing.assert_array_equal(
+                    np.asarray(val), np.arange(32, dtype=np.float32) * 2)
+                world.send(np.full(5, 3, np.int32), 2, tag=9, rank=6)
+                print("P1-OK")
+            world.barrier()
+            mpi.finalize()
+        """)
+        assert "P0-OK" in out and "P1-OK" in out
+
+    def test_wildcards_and_probe_across_processes(self, tmp_path, capfd):
+        """ANY_SOURCE/ANY_TAG recvs and iprobe see wire arrivals."""
+        out = _run(tmp_path, capfd, """
+            world = mpi.init()
+            rt = Runtime.current()
+            if rt.local_rank_offset == 0:
+                world.send(np.int32([11]), 4, tag=3, rank=0)
+                world.barrier()
+            else:
+                import time
+                st = None
+                for _ in range(100):
+                    st = world.iprobe(rank=4)  # ANY_SOURCE, ANY_TAG
+                    if st is not None:
+                        break
+                    time.sleep(0.05)
+                assert st is not None and st.source == 0 and st.tag == 3
+                val, st2 = world.recv(rank=4)  # wildcards
+                assert int(np.asarray(val)[0]) == 11
+                assert st2.source == 0 and st2.tag == 3
+                print("WILDCARD-OK")
+                world.barrier()
+            mpi.finalize()
+        """)
+        assert "WILDCARD-OK" in out
+
+    def test_ssend_completes_on_remote_match(self, tmp_path, capfd):
+        """Cross-process ssend: the send request completes only after
+        the remote recv matches (ack over the wire)."""
+        out = _run(tmp_path, capfd, """
+            world = mpi.init()
+            rt = Runtime.current()
+            if rt.local_rank_offset == 0:
+                req = world.isend(np.float32([1, 2]), 6, tag=5, rank=3,
+                                  sync=True)
+                done, _ = req.test()
+                # receiver sleeps 0.5s before posting: almost surely
+                # not yet matched (don't assert: timing)
+                st = req.wait()
+                print("SSEND-DONE")
+            else:
+                import time
+                time.sleep(0.5)
+                val, st = world.recv(source=3, tag=5, rank=6)
+                np.testing.assert_array_equal(np.asarray(val),
+                                              np.float32([1, 2]))
+                print("SSEND-RECVD")
+            world.barrier()
+            mpi.finalize()
+        """)
+        assert "SSEND-DONE" in out and "SSEND-RECVD" in out
+
+    def test_hier_collectives_parity(self, tmp_path, capfd):
+        """bcast/reduce/allgather/alltoall/reduce_scatter_block/scan
+        across the 8-rank 2-process world, parity vs numpy."""
+        out = _run(tmp_path, capfd, """
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            # every rank's slice, deterministic
+            full = np.stack([np.arange(8, dtype=np.int32) + 10 * r
+                             for r in range(n)])
+            mine = full[off:off + 4]
+
+            # bcast from a REMOTE root for one of the processes
+            got = np.asarray(world.bcast(mine, root=5))
+            for i in range(4):
+                np.testing.assert_array_equal(got[i], full[5])
+
+            # rooted reduce to rank 2 (process 0)
+            red = np.asarray(world.reduce(mine, root=2))
+            want_sum = full.sum(0)
+            if off == 0:
+                np.testing.assert_array_equal(red[2], want_sum)
+                assert (np.asarray(red[[0, 1, 3]]) == 0).all()
+            else:
+                assert (red == 0).all()
+
+            # allgather
+            ag = np.asarray(world.allgather(mine))
+            np.testing.assert_array_equal(ag[1], full.reshape(-1))
+
+            # alltoall: rank i's chunk j = i*100 + j
+            a2a_in = np.stack([
+                np.asarray([ (off+i)*100 + j for j in range(n)],
+                           dtype=np.int32)
+                for i in range(4)])
+            a2a = np.asarray(world.alltoall(a2a_in))
+            for i in range(4):
+                want = np.asarray([s*100 + (off+i) for s in range(n)],
+                                  dtype=np.int32)
+                np.testing.assert_array_equal(a2a[i], want)
+
+            # reduce_scatter_block: 8 chunks of 2
+            rs_in = np.stack([np.arange(16, dtype=np.int32) + r
+                              for r in range(n)])[off:off+4]
+            rs = np.asarray(world.reduce_scatter_block(rs_in))
+            tot = np.stack([np.arange(16, dtype=np.int32) + r
+                            for r in range(n)]).sum(0)
+            for i in range(4):
+                np.testing.assert_array_equal(rs[i],
+                                              tot[(off+i)*2:(off+i)*2+2])
+
+            # scan (inclusive): prefix sums in rank order
+            sc = np.asarray(world.scan(mine))
+            for i in range(4):
+                np.testing.assert_array_equal(sc[i],
+                                              full[:off+i+1].sum(0))
+
+            # pair-op rooted reduce + reduce_scatter_block across the
+            # boundary
+            from ompi_release_tpu import ops as _ops
+            apv = np.asarray([3., 1., 7., 2., 9., 0., 7., 4.],
+                             np.float32).reshape(n, 1)
+            api = np.arange(n, dtype=np.int32).reshape(n, 1)
+            rv, ri = world.reduce(
+                (apv[off:off+4], api[off:off+4]), _ops.MAXLOC, root=6)
+            if off == 4:
+                assert float(np.asarray(rv)[6 - 4, 0]) == 9.0
+                assert int(np.asarray(ri)[6 - 4, 0]) == 4
+            bv = np.stack([np.roll(np.arange(n, dtype=np.float32), r)
+                           for r in range(n)])
+            bi = np.tile(np.arange(n, dtype=np.int32).reshape(n, 1),
+                         (1, n))
+            cv, ci = world.reduce_scatter_block(
+                (bv[off:off+4], bi[off:off+4]), _ops.MINLOC)
+            for i in range(4):
+                col = bv[:, off + i]
+                k = int(np.argmin(col))
+                assert float(np.asarray(cv)[i, 0]) == float(col[k])
+                assert int(np.asarray(ci)[i, 0]) == k
+
+            # pair-op scan (MAXLOC) across the process boundary
+            pv = np.asarray([3., 1., 7., 2., 9., 0., 7., 4.],
+                            np.float32).reshape(n, 1)
+            pi = np.arange(n, dtype=np.int32).reshape(n, 1)
+            sv, si = world.scan(
+                (pv[off:off+4], pi[off:off+4]), _ops.MAXLOC)
+            best, bi = -np.inf, 0
+            want_v, want_i = [], []
+            for k, v in enumerate(pv.ravel()):
+                if v > best:
+                    best, bi = v, k
+                want_v.append(best)
+                want_i.append(bi)
+            np.testing.assert_array_equal(
+                np.asarray(sv).ravel(), want_v[off:off+4])
+            np.testing.assert_array_equal(
+                np.asarray(si).ravel(), want_i[off:off+4])
+
+            world.barrier()
+            print(f"HIER-OK {off}")
+            mpi.finalize()
+        """)
+        assert "HIER-OK 0" in out and "HIER-OK 4" in out
+
+    def test_hier_vector_collectives_parity(self, tmp_path, capfd):
+        """The five v-variant collectives across the 8-rank 2-process
+        world: ragged buffers, zero counts included, parity vs the
+        global numpy picture (the round-4 ERR_NOT_AVAILABLE gap)."""
+        out = _run(tmp_path, capfd, """
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            # ragged: rank r holds r+1 elements valued 100*r + k
+            full = [np.asarray([100 * r + k for k in range(r + 1)],
+                               np.int32) for r in range(n)]
+            mine = full[off:off + 4]
+
+            ag = np.asarray(world.allgatherv(mine))
+            np.testing.assert_array_equal(ag, np.concatenate(full))
+
+            gv = world.gatherv(mine, root=5)
+            if off == 4:
+                np.testing.assert_array_equal(np.asarray(gv),
+                                              np.concatenate(full))
+            else:
+                assert gv is None
+
+            counts = [r + 1 for r in range(n)]
+            sendbuf = np.concatenate(full) if off == 0 else None
+            sv = world.scatterv(sendbuf, counts, root=2)
+            assert len(sv) == 4
+            for i in range(4):
+                np.testing.assert_array_equal(np.asarray(sv[i]),
+                                              full[off + i])
+
+            # alltoallv count matrix with zeros: c[i][j] = (i+j) % 3
+            c = np.asarray([[(i + j) % 3 for j in range(n)]
+                            for i in range(n)], np.int64)
+            sb = [np.concatenate([np.full(c[i, j], 10 * i + j, np.int32)
+                                  for j in range(n)])
+                  for i in range(off, off + 4)]
+            rv = world.alltoallv(sb, c)
+            for pos, j in enumerate(range(off, off + 4)):
+                want = np.concatenate([np.full(c[i, j], 10 * i + j,
+                                               np.int32)
+                                       for i in range(n)])
+                np.testing.assert_array_equal(np.asarray(rv[pos]), want)
+
+            # general reduce_scatter, uneven counts
+            rc = [r + 1 for r in range(n)]
+            tot = sum(rc)
+            x = np.stack([np.arange(tot, dtype=np.int32) * (off + i + 1)
+                          for i in range(4)])
+            rs = world.reduce_scatter(x, rc)
+            wantfull = sum(np.arange(tot, dtype=np.int32) * (r + 1)
+                           for r in range(n))
+            offs = np.concatenate([[0], np.cumsum(rc)])
+            for i in range(4):
+                r = off + i
+                np.testing.assert_array_equal(
+                    np.asarray(rs[i]), wantfull[offs[r]:offs[r] + rc[r]])
+
+            # pair-op (MINLOC) general reduce_scatter
+            from ompi_release_tpu import ops as _o
+            pv = np.stack([
+                np.roll(np.arange(tot, dtype=np.float32), off + i)
+                for i in range(4)])
+            pidx = np.full((4, tot), off, np.int32) \
+                + np.arange(4, dtype=np.int32)[:, None]
+            prs = world.reduce_scatter((pv, pidx), rc, _o.MINLOC)
+            fullv = np.stack([np.roll(np.arange(tot, dtype=np.float32),
+                                      r) for r in range(n)])
+            for i in range(4):
+                r = off + i
+                seg = slice(offs[r], offs[r] + rc[r])
+                vwant = fullv[:, seg].min(axis=0)
+                iwant = fullv[:, seg].argmin(axis=0)
+                np.testing.assert_array_equal(
+                    np.asarray(prs[i][0]), vwant)
+                np.testing.assert_array_equal(
+                    np.asarray(prs[i][1]), iwant)
+
+            world.barrier()
+            print(f"VCOLL-OK {off}")
+            mpi.finalize()
+        """)
+        assert "VCOLL-OK 0" in out and "VCOLL-OK 4" in out
+
+    def test_dropless_moe_on_spanning_world(self, tmp_path, capfd):
+        """The flagship dropless-MoE routing step (parallel/ep.py) on
+        the unified multi-controller world: alltoallv-driven token
+        routing with exact per-token parity — the round-4 blocker
+        ('the flagship MoE cannot run on a unified world')."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.parallel.ep import dropless_moe
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            n_experts = 16
+            d = 4
+            rng = np.random.RandomState(0)  # same stream everywhere
+            all_tokens = [rng.randn(3 + r, d).astype(np.float32)
+                          for r in range(n)]
+            all_assign = [rng.randint(0, n_experts, size=(3 + r,))
+                          for r in range(n)]
+
+            def expert_fn(e, x):
+                return x * (e + 1)
+
+            outs = dropless_moe(world, all_tokens[off:off + 4],
+                                all_assign[off:off + 4], expert_fn,
+                                n_experts)
+            for i in range(4):
+                r = off + i
+                want = all_tokens[r] * (all_assign[r][:, None] + 1)
+                np.testing.assert_allclose(np.asarray(outs[i]), want,
+                                           rtol=1e-6)
+            world.barrier()
+            print(f"MOE-OK {off}")
+            mpi.finalize()
+        """)
+        assert "MOE-OK 0" in out and "MOE-OK 4" in out
+
+    def test_split_type_shared_gives_local_comm(self, tmp_path, capfd):
+        """split_type(COMM_TYPE_SHARED) on the unified world yields the
+        process-local communicator, which runs the normal in-process
+        coll stack (xla), while the world itself selects hier."""
+        out = _run(tmp_path, capfd, """
+            world = mpi.init()
+            rt = Runtime.current()
+            assert "hier" in world._coll_providers.get("allreduce", []), \\
+                world._coll_providers
+            subs = world.split_type_shared()
+            # my local ranks all share one sub-comm of size 4
+            off = rt.local_rank_offset
+            sub = subs[off]
+            assert sub is not None and sub.size == 4
+            assert not sub.spans_processes
+            got = np.asarray(sub.allreduce(
+                np.stack([np.int32([r]) for r in range(4)])))
+            assert (got == 6).all()
+            print(f"SPLIT-OK {off}")
+            mpi.finalize()
+        """)
+        assert "SPLIT-OK 0" in out and "SPLIT-OK 4" in out
+
+    def test_hier_inter_domain_byte_reduction(self, tmp_path, capfd):
+        """The two-level compose must cross the process boundary with
+        PARTIALS, not per-rank buffers: for an allreduce of local_n=4
+        slices of B bytes each, inter traffic per process = (P-1) * B
+        sent (one combined partial per peer), a 4x reduction vs
+        shipping every rank's slice — the ml/bcol aggregation the
+        reference builds its hierarchy for."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.mca import pvar
+            world = mpi.init()
+            rt = Runtime.current()
+            x = np.ones((4, 1024), np.float32)  # B = 4096 bytes/slice
+            before = pvar.PVARS.read_all().get("hier_inter_bytes", 0)
+            world.allreduce(x)
+            sent = (pvar.PVARS.read_all()["hier_inter_bytes"] - before)
+            # P=2: exactly one 4096-byte partial sent to the one peer
+            assert sent == 4096, sent
+            print("BYTES-OK", sent)
+            world.barrier()
+            mpi.finalize()
+        """)
+        assert out.count("BYTES-OK 4096") == 2
+
+    def test_three_process_cid_sync_after_partial_split(self, tmp_path,
+                                                        capfd):
+        """A split whose sub-comm has NO members on one process must
+        not desynchronize cid allocation: the hier shadow comm draws
+        from the internal (negative) cid counter, so a LATER spanning
+        communicator gets the same cid everywhere and wire messages
+        route to the right comm. Also: operations on a no-local-member
+        comm fail loudly, not with an AttributeError."""
+        app = tmp_path / "app3.py"
+        app.write_text(textwrap.dedent("""
+            import os, sys
+            sys.path.insert(0, %r)
+            os.environ["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=2")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import ompi_release_tpu as mpi
+            from ompi_release_tpu.runtime.runtime import Runtime
+            from ompi_release_tpu.utils.errors import MPIError
+
+            world = mpi.init()          # 3 procs x 2 devices = 6 ranks
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            assert world.size == 6, world.size
+            # colors: ranks 0-3 (procs 0,1) together; 4,5 (proc 2) alone
+            subs = world.split([0, 0, 0, 0, 1, 1])
+            sub = subs[off]
+            if off in (0, 2):
+                assert sub.spans_processes
+                got = np.asarray(sub.allreduce(
+                    np.stack([np.int32([off + i]) for i in range(2)])))
+                assert (got == 0 + 1 + 2 + 3).all(), got
+            else:
+                assert not sub.spans_processes and sub.size == 2
+                # the OTHER sub-comm has no members here: ops must
+                # raise a diagnosable MPIError, not AttributeError
+                other = subs[0]
+                try:
+                    other.recv(rank=0)
+                    raise SystemExit("FAIL: foreign comm recv worked")
+                except MPIError:
+                    pass
+            # a LATER spanning comm: cids must still agree everywhere
+            later = world.dup(name="later")
+            if off == 0:
+                later.send(np.int32([99]), 5, tag=1, rank=0)
+            elif off == 4:
+                val, st = later.recv(source=0, tag=1, rank=5)
+                assert int(np.asarray(val)[0]) == 99 and st.source == 0
+                print("CID-SYNC-OK")
+            world.barrier()
+            mpi.finalize()
+        """ % REPO))
+        job = Job(3, [sys.executable, str(app)], [], heartbeat_s=0.5,
+                  miss_limit=8)
+        rc = job.run(timeout_s=180)
+        out = capfd.readouterr()
+        assert rc == 0, out.out + out.err
+        assert "CID-SYNC-OK" in out.out
+
+    def test_cross_process_rma_fence_parity(self, tmp_path, capfd):
+        """put/get/accumulate/CAS from process 0 into slices owned by
+        process 1 (and back), fence epochs, parity vs the values a
+        single-process window would hold — the round-4 'no
+        cross-process RMA' gap (osc/wire_win.py home-process-applies
+        path vs osc_rdma_data_move.c)."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.osc.window import win_allocate
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+
+            win = win_allocate(world, (4,), np.float32)
+            win.fence()
+            if off == 0:
+                # put into a REMOTE slice (rank 5, process 1)
+                win.put(np.full(4, 7.0, np.float32), 5)
+                # accumulate into remote rank 6
+                win.accumulate(np.full(4, 2.0, np.float32), 6)
+                # and a local put for contrast
+                win.put(np.full(4, 1.5, np.float32), 1)
+            else:
+                # process 1 accumulates into a REMOTE slice (rank 2)
+                win.accumulate(np.full(4, 3.0, np.float32), 2)
+            win.fence_end()
+            local = np.asarray(win.read())
+            if off == 0:
+                np.testing.assert_array_equal(local[1],
+                                              np.full(4, 1.5))
+                np.testing.assert_array_equal(local[2], np.full(4, 3.0))
+            else:
+                np.testing.assert_array_equal(local[5 - 4],
+                                              np.full(4, 7.0))
+                np.testing.assert_array_equal(local[6 - 4],
+                                              np.full(4, 2.0))
+
+            # remote get + fetch_and_op under a passive (lock) epoch
+            if off == 0:
+                win.lock(5)
+                req = win.get(5)
+                win.unlock(5)
+                np.testing.assert_array_equal(np.asarray(req.value),
+                                              np.full(4, 7.0))
+                win.lock(6)
+                req = win.fetch_and_op(np.full(4, 1.0, np.float32), 6)
+                win.flush(6)
+                old = np.asarray(req.value)
+                win.unlock(6)
+                np.testing.assert_array_equal(old, np.full(4, 2.0))
+                # request-based RMA completes at flush across the wire
+                win.lock(5)
+                rr = win.rput(np.full(4, 1.25, np.float32), 5)
+                assert not rr.is_complete
+                win.flush(5)
+                assert rr.is_complete
+                win.unlock(5)
+            world.barrier()
+            if off == 4:
+                got = np.asarray(win.read())[6 - 4]
+                np.testing.assert_array_equal(got, np.full(4, 3.0))
+
+            # single-element CAS into a remote slot
+            if off == 4:
+                win.lock(1)
+                req = win.compare_and_swap(
+                    np.float32(9.0), np.float32(1.5), 1, index=2)
+                win.unlock(1)
+                assert float(np.asarray(req.value)) == 1.5
+            world.barrier()
+            if off == 0:
+                got = np.asarray(win.read())[1]
+                np.testing.assert_array_equal(
+                    got, np.asarray([1.5, 1.5, 9.0, 1.5], np.float32))
+            win.free()
+            print(f"RMA-OK {off}")
+            mpi.finalize()
+        """)
+        assert "RMA-OK 0" in out and "RMA-OK 4" in out
+
+    def test_cross_process_pscw_epoch(self, tmp_path, capfd):
+        """Generalized active target across processes: process 1 posts
+        an exposure epoch for process 0's ranks; process 0
+        starts/puts/completes; process 1's wait() returns only after
+        the put is applied (osc/rdma's PSCW state machine at process
+        granularity)."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.comm.group import Group
+            from ompi_release_tpu.osc.window import win_allocate
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+
+            win = win_allocate(world, (4,), np.float32)
+            origins = Group([0, 1, 2, 3])   # process 0's ranks
+            targets = Group([4, 5, 6, 7])   # process 1's ranks
+            if off == 0:
+                win.start(targets)
+                win.put(np.full(4, 5.5, np.float32), 5)
+                req = win.get(6)
+                win.complete()
+                # exposure side of OUR window for the reverse epoch
+                win.post(targets)
+                win.wait()
+                got = np.asarray(win.read())[2]
+                np.testing.assert_array_equal(got,
+                                              np.full(4, 8.25))
+            else:
+                win.post(origins)
+                # MPI_Win_test polls without blocking until proc 0's
+                # COMPLETE notice lands, then closes like wait()
+                import time as _t
+                deadline = _t.monotonic() + 60
+                while not win.test():
+                    assert _t.monotonic() < deadline, "test() never true"
+                    _t.sleep(0.01)
+                got = np.asarray(win.read())[5 - 4]
+                np.testing.assert_array_equal(got, np.full(4, 5.5))
+                # reverse: proc 1 accesses proc 0's rank 2
+                win.start(origins)
+                win.accumulate(np.full(4, 8.25, np.float32), 2)
+                win.complete()
+            world.barrier()
+            win.free()
+            print(f"PSCW-OK {off}")
+            mpi.finalize()
+        """)
+        assert "PSCW-OK 0" in out and "PSCW-OK 4" in out
+
+    def test_cross_process_lock_exclusion(self, tmp_path, capfd):
+        """Two processes contending for an exclusive lock on the same
+        target serialize at the target's home: read-modify-write under
+        the lock never loses an update."""
+        out = _run(tmp_path, capfd, """
+            import time
+            from ompi_release_tpu.osc.window import win_allocate
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+
+            win = win_allocate(world, (1,), np.int32)
+            world.barrier()
+            # both processes: 20 exclusive-lock increments of rank 0's
+            # word via fetch_and_op (atomic at the home regardless) AND
+            # a read-modify-write via get + put (needs the lock)
+            for _ in range(10):
+                win.lock(0)
+                req = win.get(0)
+                win.flush(0)
+                cur = int(np.asarray(req.value)[0])
+                win.put(np.int32([cur + 1]), 0)
+                win.unlock(0)
+            world.barrier()
+            if off == 0:
+                total = int(np.asarray(win.read())[0, 0])
+                assert total == 20, total
+                print("LOCK-TOTAL", total)
+            win.free()
+            print(f"LOCK-OK {off}")
+            mpi.finalize()
+        """)
+        assert "LOCK-OK 0" in out and "LOCK-OK 4" in out
+        assert "LOCK-TOTAL 20" in out
+
+    def test_cross_process_shmem(self, tmp_path, capfd):
+        """OSHMEM symmetric heap riding the wire window: put/get/AMOs
+        between PEs in different processes, wait_until across the
+        boundary, and shmem_ptr correctly refusing non-local PEs."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.oshmem import shmem
+            from ompi_release_tpu.utils.errors import MPIError
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+
+            ctx = shmem.shmem_init(world)
+            sym = ctx.malloc((3,), np.float32)
+            world.barrier()
+            if off == 0:
+                ctx.put(sym, np.asarray([1., 2., 3.], np.float32), 6)
+                ctx.quiet()
+                world.barrier()  # put visible
+                world.barrier()  # proc 1 read it
+                # fetch-add on a remote PE
+                old = np.asarray(ctx.atomic_fetch_add(
+                    sym, np.ones(3, np.float32), 6))
+                np.testing.assert_array_equal(
+                    old, np.asarray([1., 2., 3.]))
+                try:
+                    sym.local(6)
+                    raise SystemExit("FAIL: shmem_ptr crossed processes")
+                except MPIError:
+                    pass
+                world.barrier()  # fetch-add done
+            else:
+                world.barrier()  # wait for the put+quiet
+                got = np.asarray(ctx.get(sym, 6))
+                np.testing.assert_array_equal(
+                    got, np.asarray([1., 2., 3.]))
+                world.barrier()  # release proc 0's fetch-add
+                world.barrier()  # fetch-add done
+                got = np.asarray(ctx.get(sym, 6))
+                np.testing.assert_array_equal(
+                    got, np.asarray([2., 3., 4.]))
+            world.barrier()
+            print(f"SHMEM-OK {off}")
+            mpi.finalize()
+        """)
+        assert "SHMEM-OK 0" in out and "SHMEM-OK 4" in out
+
+    def test_cross_process_collective_io_two_phase(self, tmp_path, capfd):
+        """write_at_all/read_at_all on the spanning world do a REAL
+        two-phase exchange over the wire (io/two_phase.py vs
+        fcoll_two_phase_file_write_all.c): interleaved per-rank
+        extents from 2 processes must produce a file bit-identical to
+        the single-process reference file, including through a holey
+        vector view; nonblocking variants included."""
+        ref = tmp_path / "ref.bin"
+        # single-process reference: ranks 0..7 write 5 elements each,
+        # rank r at element offset r*5, value 100*r + k
+        import numpy as np_
+        refdata = np_.concatenate([
+            100 * r + np_.arange(5, dtype=np_.int32) for r in range(8)
+        ])
+        refdata.tofile(str(ref))
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.io.file import File, MODE_RDWR, \\
+                MODE_CREATE
+            from ompi_release_tpu.datatype import datatype as dt
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            path = %r
+
+            f = File(world, path)
+            f.set_view(etype=np.int32)
+            # INTERLEAVED extents: local member i (comm rank off+i)
+            # writes at element (off+i)*5 — pieces of both processes'
+            # blocks land in both aggregators' file domains
+            offs = [(off + i) * 5 for i in range(4)]
+            blocks = [100 * (off + i) + np.arange(5, dtype=np.int32)
+                      for i in range(4)]
+            total = f.write_at_all(offs, blocks)
+            assert total == 40, total
+
+            # collective read back: every member its own extent
+            got = f.read_at_all(offs, [5] * 4)
+            for i in range(4):
+                np.testing.assert_array_equal(got[i], blocks[i])
+
+            # nonblocking collective variants
+            req = f.iwrite_at_all(offs, blocks)
+            req.wait()
+            req = f.iread_at_all(offs, [5] * 4)
+            req.wait()
+            for i in range(4):
+                np.testing.assert_array_equal(
+                    np.asarray(req.value[i]), blocks[i])
+            f.close()
+            world.barrier()
+
+            # holey view: 2-of-4 int32 vector tiles; member slots
+            # interleave across processes
+            path2 = path + ".holey"
+            f2 = File(world, path2)
+            ft = dt.create_vector(2, 2, 4, dt.INT32)
+            f2.set_view(0, np.int32, filetype=ft)
+            offs2 = [(off + i) * 4 for i in range(4)]
+            blocks2 = [1000 * (off + i) + np.arange(4, dtype=np.int32)
+                       for i in range(4)]
+            f2.write_at_all(offs2, blocks2)
+            got2 = f2.read_at_all(offs2, [4] * 4)
+            for i in range(4):
+                np.testing.assert_array_equal(got2[i], blocks2[i])
+            f2.close()
+            world.barrier()
+            print(f"IO-OK {off}")
+            mpi.finalize()
+        """ % str(tmp_path / "out.bin"))
+        assert "IO-OK 0" in out and "IO-OK 4" in out
+        got = np_.fromfile(str(tmp_path / "out.bin"), dtype=np_.int32)
+        np_.testing.assert_array_equal(got, refdata)
+
+    def test_nonblocking_hier_collectives_overlap(self, tmp_path, capfd):
+        """iallreduce on a spanning comm returns BEFORE the collective
+        completes (round 4: the 'nonblocking' wrapper ran the OOB
+        exchange to completion first). Proof of overlap: process 1
+        delays its matching allreduce by 0.5s; process 0 posts
+        iallreduce, executes user compute, and observes the request
+        still incomplete — then wait() delivers the parity result.
+        Posting order across two outstanding collectives is preserved."""
+        out = _run(tmp_path, capfd, """
+            import time
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            x = np.stack([np.arange(4, dtype=np.int32) * (off + i + 1)
+                          for i in range(4)])
+            want = sum(np.arange(4, dtype=np.int32) * (r + 1)
+                       for r in range(n))
+            if off == 0:
+                t0 = time.monotonic()
+                req = world.iallreduce(x)
+                post_t = time.monotonic() - t0
+                assert post_t < 0.25, f"posting blocked {post_t:.2f}s"
+                # user compute between post and wait
+                acc = 0
+                for i in range(1000):
+                    acc += i * i
+                done, _ = req.test()
+                assert not done, "completed before the peer even posted"
+                req2 = world.ibcast(x, root=0)  # second outstanding op
+                st = req.wait()
+                np.testing.assert_array_equal(np.asarray(req.value)[0],
+                                              want)
+                req2.wait()
+                print("OVERLAP-OK", acc > 0)
+            else:
+                time.sleep(0.5)
+                got = np.asarray(world.allreduce(x))
+                np.testing.assert_array_equal(got[0], want)
+                world.bcast(x, root=0)
+            world.barrier()
+            print(f"NBC-OK {off}")
+            mpi.finalize()
+        """)
+        assert "OVERLAP-OK True" in out
+        assert "NBC-OK 0" in out and "NBC-OK 4" in out
+
+    def test_cross_process_surface_over_dcn_staging(self, tmp_path,
+                                                    capfd):
+        """OMPITPU_HOST_ID gives each worker a distinct shm identity,
+        so every cross-process byte rides the DCN chunked-staging
+        transport instead of the shm handoff — collectives, vector
+        collectives, RMA, and two-phase IO all exercised over the
+        multi-host wire path on one machine."""
+        out = _run(tmp_path, capfd, """
+            import os
+            # distinct identity per worker BEFORE bootstrap: forces
+            # the cross-host transport choice
+            os.environ["OMPITPU_HOST_ID"] = (
+                "fakehost-" + os.environ["OMPITPU_NODE_ID"])
+            from ompi_release_tpu.mca import pvar
+            from ompi_release_tpu.osc.window import win_allocate
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            # transport choice is really DCN
+            peer = 1 if rt.bootstrap["process_index"] == 0 else 0
+            assert rt.wire._btl_for(peer).NAME == "dcn", \\
+                rt.wire._btl_for(peer).NAME
+
+            x = np.stack([np.arange(16, dtype=np.int32) * (off + i + 1)
+                          for i in range(4)])
+            got = np.asarray(world.allreduce(x))
+            want = sum(np.arange(16, dtype=np.int32) * (r + 1)
+                       for r in range(n))
+            np.testing.assert_array_equal(got[0], want)
+
+            full = [np.asarray([100 * r + k for k in range(r + 1)],
+                               np.int32) for r in range(n)]
+            ag = np.asarray(world.allgatherv(full[off:off + 4]))
+            np.testing.assert_array_equal(ag, np.concatenate(full))
+
+            win = win_allocate(world, (4,), np.float32)
+            win.fence()
+            if off == 0:
+                win.put(np.full(4, 2.5, np.float32), 6)
+            win.fence_end()
+            if off == 4:
+                np.testing.assert_array_equal(
+                    np.asarray(win.read())[6 - 4], np.full(4, 2.5))
+            world.barrier()
+            win.free()
+
+            from ompi_release_tpu.io.file import File
+            f = File(world, %r)
+            f.set_view(etype=np.int32)
+            offs = [(off + i) * 3 for i in range(4)]
+            blocks = [10 * (off + i) + np.arange(3, dtype=np.int32)
+                      for i in range(4)]
+            f.write_at_all(offs, blocks)
+            back = f.read_at_all(offs, [3] * 4)
+            for i in range(4):
+                np.testing.assert_array_equal(back[i], blocks[i])
+            f.close()
+
+            staged = pvar.PVARS.read_all().get("btl_dcn_staged_bytes", 0)
+            assert staged > 0, "no bytes rode the DCN staging path"
+            world.barrier()
+            print(f"DCN-OK {off} staged={staged > 0}")
+            mpi.finalize()
+        """ % str(tmp_path / "dcn_io.bin"))
+        assert "DCN-OK 0" in out and "DCN-OK 4" in out
+
+    def test_concurrent_cross_process_amo_no_lost_updates(self, tmp_path,
+                                                          capfd):
+        """Both processes shower fetch-adds at ONE remote slot under a
+        standing lock_all epoch, from two threads each, concurrently —
+        the home service must apply every batch atomically (op lock
+        around the compiled epoch program): the final value equals the
+        exact update count, and every fetch returns a distinct
+        pre-value."""
+        out = _run(tmp_path, capfd, """
+            import threading
+            from ompi_release_tpu.oshmem import shmem
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+
+            ctx = shmem.shmem_init(world)
+            counter = ctx.malloc((1,), np.int32)
+            world.barrier()
+            N = 12
+            fetched = []
+            flock = threading.Lock()
+
+            def shower():
+                for _ in range(N):
+                    old = np.asarray(ctx.atomic_fetch_add(
+                        counter, np.ones(1, np.int32), 0))
+                    with flock:
+                        fetched.append(int(old[0]))
+
+            ts = [threading.Thread(target=shower) for _ in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            world.barrier()
+            if off == 0:
+                final = int(np.asarray(ctx.get(counter, 0))[0])
+                assert final == 4 * N, final  # 2 procs x 2 threads x N
+                print("AMO-TOTAL", final)
+            # atomicity: this process's fetches are all distinct
+            assert len(set(fetched)) == len(fetched) == 2 * N
+            world.barrier()
+            print(f"AMO-OK {off}")
+            mpi.finalize()
+        """)
+        assert "AMO-OK 0" in out and "AMO-OK 4" in out
+        assert "AMO-TOTAL 48" in out
+
+    def test_three_process_vcoll_rma_pscw(self, tmp_path, capfd):
+        """P=3 battery for the paths with P>2-specific structure: the
+        vector collectives' per-peer sub-layouts, TWO remote origins
+        contending for one exclusive lock (home waiter queue with
+        remote grants), and a PSCW exposure with two accessor
+        processes."""
+        app = tmp_path / "app3.py"
+        app.write_text(textwrap.dedent("""
+            import os, sys
+            sys.path.insert(0, %r)
+            os.environ["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=2")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import ompi_release_tpu as mpi
+            from ompi_release_tpu.comm.group import Group
+            from ompi_release_tpu.osc.window import win_allocate
+            from ompi_release_tpu.runtime.runtime import Runtime
+
+            world = mpi.init()      # 3 procs x 2 devices = 6 ranks
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            assert n == 6, n
+
+            # alltoallv with zeros: c[i][j] = (i + 2*j) %% 3
+            c = np.asarray([[(i + 2 * j) %% 3 for j in range(n)]
+                            for i in range(n)], np.int64)
+            sb = [np.concatenate([np.full(c[i, j], 10 * i + j, np.int32)
+                                  for j in range(n)])
+                  for i in range(off, off + 2)]
+            rv = world.alltoallv(sb, c)
+            for pos, j in enumerate(range(off, off + 2)):
+                want = np.concatenate([np.full(c[i, j], 10 * i + j,
+                                               np.int32)
+                                       for i in range(n)])
+                np.testing.assert_array_equal(np.asarray(rv[pos]), want)
+
+            # uneven reduce_scatter over 3 processes
+            rc = [r + 1 for r in range(n)]
+            tot = sum(rc)
+            x = np.stack([np.arange(tot, dtype=np.int32) * (off + i + 1)
+                          for i in range(2)])
+            rs = world.reduce_scatter(x, rc)
+            wantfull = sum(np.arange(tot, dtype=np.int32) * (r + 1)
+                           for r in range(n))
+            offs = np.concatenate([[0], np.cumsum(rc)])
+            for i in range(2):
+                r = off + i
+                np.testing.assert_array_equal(
+                    np.asarray(rs[i]), wantfull[offs[r]:offs[r] + rc[r]])
+
+            # two REMOTE origins (procs 1, 2) contend for rank 0's
+            # exclusive lock: read-modify-write, no lost updates
+            win = win_allocate(world, (1,), np.int32)
+            world.barrier()
+            if off != 0:
+                for _ in range(8):
+                    win.lock(0)
+                    req = win.get(0)
+                    win.flush(0)
+                    cur = int(np.asarray(req.value)[0])
+                    win.put(np.int32([cur + 1]), 0)
+                    win.unlock(0)
+            world.barrier()
+            if off == 0:
+                total = int(np.asarray(win.read())[0, 0])
+                assert total == 16, total
+                print("LOCK3-TOTAL", total)
+
+            # PSCW: proc 0 exposes to accessors in procs 1 AND 2;
+            # wait() must collect BOTH completes
+            g_origins = Group([2, 3, 4, 5])   # procs 1, 2
+            g_targets = Group([0, 1])         # proc 0
+            if off == 0:
+                win.post(g_origins)
+                win.wait()
+                got = int(np.asarray(win.read())[1, 0])
+                assert got == 2 + 4, got   # both accumulates landed
+            else:
+                win.start(g_targets)
+                win.accumulate(np.int32([off]), 1)  # +2 and +4
+                win.complete()
+            world.barrier()
+            win.free()
+            print(f"P3-OK {off}")
+            mpi.finalize()
+        """ % REPO))
+        job = Job(3, [sys.executable, str(app)], [], heartbeat_s=0.5,
+                  miss_limit=8)
+        rc = job.run(timeout_s=240)
+        out = capfd.readouterr()
+        assert rc == 0, out.out + out.err
+        for o in (0, 2, 4):
+            assert f"P3-OK {o}" in out.out
+        assert "LOCK3-TOTAL 16" in out.out
+
+    def test_intercomm_across_processes(self, tmp_path, capfd):
+        """MPI_Intercomm_create bridging two process-local comms on the
+        unified world: p2p crosses the boundary with remote-rank
+        addressing through the intercomm, and Intercomm_merge yields a
+        spanning intracomm whose collectives run the hier stack."""
+        out = _run(tmp_path, capfd, """
+            from ompi_release_tpu.comm.intercomm import intercomm_create
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+
+            subs = world.split([0] * 4 + [1] * 4)
+            comm_a, comm_b = subs[0], subs[4]
+            ia, ib = intercomm_create(comm_a, 0, comm_b, 0)
+            inter = ia if off == 0 else ib
+            assert inter.remote_size == 4
+
+            # p2p with REMOTE-group rank addressing across processes
+            if off == 0:
+                inter.send(np.int32([41]), dest=2, tag=3, rank=1)
+                val, st = inter.recv(source=2, tag=4, rank=1)
+                assert int(np.asarray(val)[0]) == 42
+                assert st.source == 2  # remote-group rank, not bridge
+            else:
+                val, st = inter.recv(source=1, tag=3, rank=2)
+                assert int(np.asarray(val)[0]) == 41
+                assert st.source == 1
+                inter.send(np.int32([42]), dest=1, tag=4, rank=2)
+
+            # merge -> ONE spanning intracomm; hier collectives work
+            merged = inter.merge(high=(off == 4))
+            assert merged.size == n and merged.spans_processes
+            x = np.stack([np.int32([off + i]) for i in range(4)])
+            got = np.asarray(merged.allreduce(x))
+            assert (got == sum(range(n))).all(), got
+            world.barrier()
+            print(f"INTER-OK {off}")
+            mpi.finalize()
+        """)
+        assert "INTER-OK 0" in out and "INTER-OK 4" in out
+
+    def test_unified_world_opt_out(self, tmp_path, capfd):
+        """--mca runtime_unified_world false restores per-process
+        local worlds (the pre-unification behavior)."""
+        app = _write_app(tmp_path, """
+            world = mpi.init()
+            rt = Runtime.current()
+            assert world.size == 4, world.size
+            assert not rt.unified
+            print("LOCAL-OK")
+            mpi.finalize()
+        """)
+        job = Job(2, [sys.executable, app],
+                  [("runtime_unified_world", "false")], heartbeat_s=0.5,
+                  miss_limit=8)
+        rc = job.run(timeout_s=180)
+        out = capfd.readouterr().out
+        assert rc == 0, out
+        assert out.count("LOCAL-OK") == 2
