@@ -982,6 +982,124 @@ mpi.finalize()
 '''
 
 
+#: worker app for the hier_scaling micro-suite: a REAL 4-process
+#: tpurun job (one device per process) timing the spanning-collective
+#: INTER schedules against each other and reading the per-process
+#: hier_inter_bytes / hier_inter_msgs_sent deltas that prove the
+#: O(P^2) -> O(log P) / ~2n claims. Process 0 writes the JSON lines to
+#: OMPITPU_HIER_BENCH_OUT.
+_HIER_BENCH_APP = r'''
+import json, math, os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.mca import pvar, var as mca_var
+
+SIZE = int(os.environ.get("OMPITPU_HIER_BENCH_BYTES", str(256 << 10)))
+world = mpi.init()
+from ompi_release_tpu.runtime.runtime import Runtime
+rt = Runtime.current()
+me = rt.bootstrap["process_index"]
+P = n_procs = 4
+assert world.size == 4, world.size
+
+def _pv(name):
+    p = pvar.PVARS.lookup(name)
+    return float(p.read()) if p is not None else 0.0
+
+x = np.ones((1, SIZE // 4), np.float32) * (me + 1)
+want = float(sum(r + 1 for r in range(world.size)))
+ALGS = ("linear", "recursive_doubling", "ring", "rabenseifner")
+deltas, times = [], []
+for alg in ALGS:
+    mca_var.set_value("hier_inter_algorithm", alg)
+    world.barrier()
+    world.allreduce(x)          # warm the schedule + shadow programs
+    world.barrier()
+    b0 = _pv("hier_inter_bytes")
+    t0 = time.perf_counter()
+    got = np.asarray(world.allreduce(x))
+    dt = time.perf_counter() - t0
+    deltas.append(_pv("hier_inter_bytes") - b0)
+    times.append(dt)
+    assert abs(float(got[0][0]) - want) < 1e-3, got[0][0]
+    mca_var.VARS.unset("hier_inter_algorithm")
+
+# bcast: root send count, linear P-1 vs binomial ceil(log2 P)
+bd = {}
+for alg in ("linear", "binomial"):
+    mca_var.set_value("hier_inter_algorithm", alg)
+    world.barrier()
+    s0 = _pv("hier_inter_msgs_sent")
+    world.bcast(x, root=0)
+    bd[alg] = _pv("hier_inter_msgs_sent") - s0
+    mca_var.VARS.unset("hier_inter_algorithm")
+world.barrier()
+
+# every process's byte deltas to process 0 (AFTER the measurements)
+rows = world.gatherv([np.asarray(deltas, np.float32)], root=0)
+if me == 0:
+    per_proc = np.asarray(rows).reshape(world.size, len(ALGS))
+    lines = []
+    for i, alg in enumerate(ALGS):
+        worst = float(per_proc[:, i].max())
+        lines.append({
+            "metric": "hier_allreduce_%%dKiB_inter_bytes_%%s"
+                      %% (SIZE >> 10, alg),
+            "value": round(worst / SIZE, 4),
+            "unit": "xN_bytes_per_proc_max", "vs_baseline": None,
+            "suite": "hier_scaling", "procs": world.size,
+            "per_proc_xN": [round(float(v) / SIZE, 4)
+                            for v in per_proc[:, i]],
+            "seconds": round(times[i], 5),
+        })
+    lines.append({
+        "metric": "hier_bcast_root_msgs",
+        "value": bd["binomial"], "unit": "sends_at_root",
+        "vs_baseline": None, "suite": "hier_scaling",
+        "linear_sends": bd["linear"],
+        "binomial_depth_bound": math.ceil(math.log2(world.size)),
+        "pvars": {k: v for k, v in pvar.PVARS.read_all().items()
+                  if k.startswith("hier_")},
+        "cumulative": True,
+    })
+    with open(os.environ["OMPITPU_LOOPBACK_OUT"], "w") as f:
+        json.dump(lines, f)
+world.barrier()
+mpi.finalize()
+'''
+
+
+def _hier_micro_suite(backend_label):
+    """hier_scaling lines: per-process inter BYTES of a 4-process
+    spanning allreduce under every schedule (linear's (P-1)n = 3n vs
+    ring/Rabenseifner's <= 2n + padding), and the bcast root's send
+    count dropping from P-1 to the binomial ceil(log2 P) — measured
+    through a real 4-process tpurun job on the CPU mesh (the inter
+    step rides host wire transports either way)."""
+    import os
+
+    from ompi_release_tpu.tools.tpurun import run_loopback_app
+
+    lines = run_loopback_app(
+        4, _HIER_BENCH_APP % {"repo": os.path.dirname(
+            os.path.abspath(__file__))},
+        {"OMPITPU_HIER_BENCH_BYTES": str(
+            (1 << 20) if backend_label is None else (256 << 10))},
+        "hier_bench.json", timeout_s=300)
+    if lines is None:
+        return [{"metric": "hier_scaling_suite", "value": None,
+                 "unit": None, "vs_baseline": None,
+                 "error": "hier bench job failed"}]
+    return lines  # main()'s emit() stamps the backend label
+
+
 def _wire_micro_suite(backend_label):
     """Cross-process wire lines: p2p ping-pong bandwidth (1 MiB up to
     256 MiB on full machines), two concurrent distinct-tag transfers
@@ -1279,6 +1397,19 @@ def main():
     except Exception as e:
         emit({
             "metric": "wire_micro_suite", "value": None, "unit": None,
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}"[:300],
+        })
+
+    # hier_scaling micro-suite: spanning-collective inter schedules at
+    # 4 loopback processes — per-process inter bytes (linear 3n vs
+    # ring/Rabenseifner <= 2n) and the bcast root's log-depth sends
+    try:
+        for ln in _hier_micro_suite(backend_label):
+            emit(ln)
+    except Exception as e:
+        emit({
+            "metric": "hier_scaling_suite", "value": None, "unit": None,
             "vs_baseline": None,
             "error": f"{type(e).__name__}: {e}"[:300],
         })

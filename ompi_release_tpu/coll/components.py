@@ -21,7 +21,7 @@ from ..mca import component as mca_component
 from ..mca import var as mca_var
 from ..ops.op import Op
 from ..utils import output
-from . import dynamic_rules, pipeline, spmd
+from . import dynamic_rules, hier_schedules, pipeline, spmd  # noqa: F401
 from .base import COLL_FRAMEWORK
 from .driver import run_sharded
 
@@ -348,6 +348,9 @@ dynamic_rules.RULE_COLLECTIVES.update({
     "gather": GATHER_ALGORITHMS,
     "scatter": SCATTER_ALGORITHMS,
 })
+# (the hier_<coll> namespaces — the INTER-process schedules of
+# spanning collectives — register themselves in coll/hier_schedules,
+# which imports standalone; see hier_schedules.ALGORITHMS)
 
 
 class _TunedModule:

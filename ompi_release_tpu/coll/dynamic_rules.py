@@ -53,6 +53,15 @@ For reduce, a rule naming ``binomial`` on a NONCOMMUTATIVE op is
 upgraded to ``in_order_binary`` (binomial's root-relative vranks
 rotate operand order; a config file cannot waive MPI semantics).
 
+``hier_<collective>`` rules select the INTER-process schedule of
+spanning collectives (:mod:`coll.hier_schedules`): there
+``min_comm_size`` matches the PROCESS count of the spanning comm, and
+``min_msg_bytes`` the inter decision unit (partial/block bytes;
+allgather: total bytes; alltoall: per-pair chunk bytes). A
+``hier_allreduce`` rule naming ``ring``/``rabenseifner`` for a
+non-commutative or identity-less op is downgraded to
+``recursive_doubling`` — the same cannot-waive-semantics guard.
+
 Precedence inside the tuned component: operator forcing
 (``coll_tuned_<op>_algorithm``) > dynamic rules > fixed constants —
 the reference's order (forcing checked first in

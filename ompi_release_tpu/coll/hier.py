@@ -22,10 +22,25 @@ order; the inter step combines partials in process-index order — the
 same fixed-order tree discipline the parity harness pins for the
 in-process algorithms.
 
-The inter step is linear (every process exchanges with every peer):
-honest O(P^2) messaging that is fine at realistic controller counts;
-the pvar ``hier_inter_bytes`` counts exactly what crossed a process
-boundary so the two-level byte reduction vs flat is measurable.
+The inter step is SCHEDULED (:mod:`coll.hier_schedules`): recursive
+doubling for small allreduce, ring/Rabenseifner reduce-scatter +
+allgather for large allreduce (~2n inter bytes per process instead of
+(P-1)*n), binomial trees for bcast/reduce/gather/scatter, Bruck for
+small allgather/alltoall with pairwise exchange above the cutoff, and
+a ``linear`` all-pairs exchange kept as the baseline (and for the
+ragged v-variants, whose sizes are not globally derivable). Selection
+follows the tuned precedence — ``hier_inter_algorithm`` forcing >
+``hier_<coll>`` dynamic rules (PR-2 machinery; min_comm_size matches
+the PROCESS count) > fixed decision constants — and every schedule
+combines in a fixed, process-index-derived order identical across
+ranks and runs, falling back to exact-order schedules for
+non-commutative ops. A host-aware LEADER TIER (``hier_leader_tier``,
+the coll/ml subgrouping shape) activates when the job spans hosts:
+co-hosted processes combine/fan out over shm handoffs first and one
+leader per host crosses DCN. The pvars ``hier_inter_bytes`` /
+``hier_inter_msgs_sent`` / ``hier_inter_msgs_recvd`` count exactly
+what crossed a process boundary so both the two-level byte reduction
+and the O(P^2) -> O(log P) message-count claim are auditable.
 
 Exchange overlap (``wire_overlap_exchange``, default on): every round
 posts ALL its sends first — striped across peers in pipelined fragment
@@ -53,15 +68,35 @@ from ..obs import watchdog as _watchdog
 from ..ops.op import Op
 from ..utils import output
 from ..utils.errors import ErrorCode, MPIError
+from . import hier_schedules as _hs
 
 _log = output.stream("coll")
 
 _inter_bytes = pvar.counter(
     "hier_inter_bytes",
-    "bytes crossing a controller-process boundary in hier collectives",
+    "bytes crossing a controller-process boundary in hier collectives "
+    "(SENT side)",
 )
-_inter_msgs = pvar.counter(
-    "hier_inter_msgs", "inter-process messages in hier collectives"
+_inter_msgs_sent = pvar.counter(
+    "hier_inter_msgs_sent",
+    "inter-process messages SENT by hier collectives",
+)
+_inter_msgs_recvd = pvar.counter(
+    "hier_inter_msgs_recvd",
+    "inter-process messages RECEIVED by hier collectives",
+)
+# MPI_T-compat alias: the old ambiguous counter bumped on both sides
+# (one logical message counted twice per process); it lives on as a
+# read-only sum so existing tooling keeps a continuous series while
+# the split pvars make the O(P^2) -> O(log P) claim auditable.
+_inter_msgs = pvar.PVARS.register(
+    "hier_inter_msgs", pvar.PvarClass.COUNTER,
+    "inter-process messages in hier collectives (alias: sent + recvd)",
+    getter=lambda: _inter_msgs_sent.read() + _inter_msgs_recvd.read(),
+)
+_leader_combines = pvar.counter(
+    "hier_leader_combines",
+    "host-leader-tier combines performed by spanning collectives",
 )
 
 #: current spanning-collective round per comm cid, maintained only
@@ -76,6 +111,45 @@ def _hier_rounds_snapshot() -> Dict[str, Dict]:
 
 
 _watchdog.add_contributor("hier_rounds", _hier_rounds_snapshot)
+
+
+class _XchgAdapter:
+    """The round transport :mod:`coll.hier_schedules` drives: one call
+    posts ALL of a schedule round's sends (striped/pipelined by
+    ``coll_send_all`` under ``wire_overlap_exchange``), then reaps the
+    round's receives in arrival order. Every byte flows through the
+    module's instrumented ``_send/_send_all/_recv/_reap`` touchpoints,
+    so pvar accounting, ``(cid, round, pair, k)`` flow ids, and the
+    watchdog wait registry (``awaiting_info`` names exactly the
+    tree/ring neighbors still pending) are identical to the linear
+    path's — the PR-4 observability contract survives every schedule."""
+
+    __slots__ = ("m",)
+
+    def __init__(self, module: "_HierModule") -> None:
+        self.m = module
+
+    def exchange(self, sends: Dict[int, list],
+                 recvs: Dict[int, int]) -> Dict[int, list]:
+        m = self.m
+        sends = {p: [np.asarray(a) for a in arrs]
+                 for p, arrs in sends.items() if arrs}
+        recvs = {p: int(c) for p, c in recvs.items() if c > 0}
+        got: Dict[int, list] = {p: [] for p in recvs}
+        if m._overlap():
+            if sends:
+                m._send_all(sends)
+            if recvs:
+                m._reap(dict(recvs),
+                        lambda src, arr: got[src].append(arr))
+            return got
+        for p in sorted(sends):
+            for a in sends[p]:
+                m._send(p, a)
+        for p in sorted(recvs):
+            for _ in range(recvs[p]):
+                got[p].append(m._recv(p))
+        return got
 
 
 class _HierModule:
@@ -121,6 +195,25 @@ class _HierModule:
         # enabled on every rank (same MCA env under tpurun).
         self._round = 0
         self._flow_k: Dict[tuple, int] = {}
+        # host-aware leader tier (the coll/ml sbgp shape): group the
+        # participating processes by the SAME modex-card host identity
+        # the router's transport choice consults (_btl_for), so the
+        # leader fan-in/fan-out stages ride shm exactly when the
+        # transports do. Leader = lowest process index on the host.
+        cards = self.router.cards
+        self.host_of: Dict[int, str] = {
+            p: str(cards[p].get("host") or f"proc-{p}")
+            for p in self.procs
+        }
+        self.host_groups: Dict[str, List[int]] = {}
+        for p in self.procs:
+            self.host_groups.setdefault(self.host_of[p], []).append(p)
+        self.leader_of: Dict[int, int] = {
+            p: min(self.host_groups[self.host_of[p]]) for p in self.procs
+        }
+        self.leaders: List[int] = sorted(
+            min(g) for g in self.host_groups.values())
+        self._xchg = _XchgAdapter(self)
 
     # -- plumbing ----------------------------------------------------------
     @property
@@ -187,7 +280,7 @@ class _HierModule:
         rec = _obs.enabled  # capture once: flag may flip mid-send
         t0 = _time.perf_counter() if rec else 0.0
         self.router.coll_send(self.comm, peer, arr)
-        _inter_msgs.add()
+        _inter_msgs_sent.add()
         _inter_bytes.add(int(arr.nbytes))
         if rec and _obs.enabled:
             _obs.record("hier_send", "hier", t0,
@@ -210,7 +303,7 @@ class _HierModule:
         finally:
             if tok is not None:
                 _watchdog.disarm(tok)
-        _inter_msgs.add()
+        _inter_msgs_recvd.add()
         if rec and _obs.enabled:
             _obs.record("hier_recv", "hier", t0,
                         _time.perf_counter() - t0,
@@ -243,7 +336,7 @@ class _HierModule:
                         comm_id=self.comm.cid)
         for p, arrs in sends.items():
             for a in arrs:
-                _inter_msgs.add()
+                _inter_msgs_sent.add()
                 _inter_bytes.add(int(a.nbytes))
                 if rec and _obs.enabled:
                     # one producer span per message: k advances in list
@@ -278,7 +371,7 @@ class _HierModule:
                     # the MAX_STALL_DUMPS budget the real hang needs
                     tok.t0 = _time.perf_counter()
                     tok.dumped = False
-                _inter_msgs.add()
+                _inter_msgs_recvd.add()
                 pending[src] -= 1
                 left -= 1
                 arr = np.asarray(arr)
@@ -348,29 +441,160 @@ class _HierModule:
             return jnp.asarray(x[0])
         return self.shadow.allreduce(x, op)[0]
 
-    def _combine_with_peers(self, partial, op: Op):
-        """Exchange partials with every peer; combine in process-index
-        order (fixed order: every process computes the identical
-        sequence, so results are bitwise-identical across processes)."""
+    # -- partial packing / combine dispatch --------------------------------
+    def _note_alg(self, alg: str) -> None:
+        """Record the selected schedule in the round-state table the
+        flight recorder dumps (postmortems name op, round AND alg)."""
+        if not _obs.enabled:
+            return
+        st = _round_state.get(self.comm.cid)
+        if st is not None:
+            st["alg"] = alg
+
+    @staticmethod
+    def _pack_pair(pv: np.ndarray, pi: np.ndarray) -> np.ndarray:
+        """One contiguous wire payload for a MINLOC/MAXLOC (value,
+        index) partial: both sides know the shapes/dtypes from their
+        own partial, so the split point ships no metadata — one
+        message per peer per step instead of two (half the
+        ``hier_inter_msgs_sent`` and per-message framing)."""
+        pv = np.ascontiguousarray(pv)
+        pi = np.ascontiguousarray(pi)
+        return np.concatenate([pv.reshape(-1).view(np.uint8),
+                               pi.reshape(-1).view(np.uint8)])
+
+    @staticmethod
+    def _unpack_pair(buf: np.ndarray, like_v: np.ndarray,
+                     like_i: np.ndarray):
+        buf = np.ascontiguousarray(np.asarray(buf)).view(np.uint8)
+        nv = int(like_v.nbytes)
+        v = buf[:nv].view(like_v.dtype).reshape(like_v.shape)
+        i = buf[nv:].view(like_i.dtype).reshape(like_i.shape)
+        return v, i
+
+    def _pack_partial(self, partial, op: Op) -> np.ndarray:
         if op.is_pair_op:
-            pv, pi = partial
-            sends = {p: [np.asarray(pv), np.asarray(pi)]
-                     for p in self.peers}
-            got = self._exchange(sends)
-            parts = {self.my_pidx: (jnp.asarray(pv), jnp.asarray(pi))}
-            for p in self.peers:
-                parts[p] = (jnp.asarray(got[p][0]), jnp.asarray(got[p][1]))
-        else:
-            got = self._exchange({p: [np.asarray(partial)]
-                                  for p in self.peers})
-            parts = {self.my_pidx: jnp.asarray(partial)}
-            for p in self.peers:
-                parts[p] = jnp.asarray(got[p][0])
-        ordered = [parts[p] for p in self.procs]
-        acc = ordered[0]
-        for nxt in ordered[1:]:
+            return self._pack_pair(np.asarray(partial[0]),
+                                   np.asarray(partial[1]))
+        return np.asarray(partial)
+
+    def _unpack_partial(self, buf, like, op: Op):
+        # `like` is read for shape/dtype/nbytes only — attributes jax
+        # arrays expose directly; never np.asarray it here (that would
+        # force a device fetch of the unchanged partial per peer)
+        if op.is_pair_op:
+            v, i = self._unpack_pair(buf, like[0], like[1])
+            return (jnp.asarray(v), jnp.asarray(i))
+        return jnp.asarray(np.asarray(buf).reshape(like.shape))
+
+    @staticmethod
+    def _fold(parts: list, op: Op):
+        acc = parts[0]
+        for nxt in parts[1:]:
             acc = op(acc, nxt)
         return acc
+
+    def _fold_flats(self, procs: List[int], flats: Dict[int, object],
+                    partial, op: Op):
+        """Fold per-process packed partials in PROCESS-INDEX order —
+        the one combine sequence every exact-order schedule shares.
+        ``flats`` maps pidx -> packed payload for every peer; this
+        process contributes ``partial`` directly (never re-unpacked)."""
+        me = self.my_pidx
+        parts = [partial if p == me
+                 else self._unpack_partial(flats[p], partial, op)
+                 for p in procs]
+        if not op.is_pair_op:
+            parts = [jnp.asarray(t) for t in parts]
+        return self._fold(parts, op)
+
+    def _leader_tier_active(self, op: Optional[Op] = None) -> bool:
+        """Leader tier applies when the comm spans >1 host AND some
+        host holds >1 process (else grouping is the flat set); the
+        per-host fold regroups the combine order, so reductions keep
+        it for commutative ops only."""
+        if len(self.leaders) <= 1 or len(self.leaders) == len(self.procs):
+            return False
+        if op is not None and not op.commutative:
+            return False
+        return bool(mca_var.get("hier_leader_tier", True))
+
+    def _combine_partials(self, partial, op: Op):
+        """Inter-process combine of per-process partials; identical on
+        every process (fixed, process-index-derived order per
+        schedule)."""
+        if len(self.procs) == 1:
+            if op.is_pair_op:
+                return (jnp.asarray(partial[0]), jnp.asarray(partial[1]))
+            return jnp.asarray(partial)
+        if self._leader_tier_active(op):
+            return self._combine_leader(partial, op)
+        return self._combine_flat(self.procs, partial, op)
+
+    def _combine_flat(self, procs: List[int], partial, op: Op):
+        """Run the selected allreduce schedule over ``procs`` (the
+        whole process set, or the leader set under the leader tier)."""
+        P = len(procs)
+        if P == 1:
+            if op.is_pair_op:
+                return (jnp.asarray(partial[0]), jnp.asarray(partial[1]))
+            return jnp.asarray(partial)
+        packed = self._pack_partial(partial, op)
+        alg = _hs.pick(
+            "allreduce", P, int(packed.nbytes),
+            commutative=op.commutative,
+            has_identity=op.identity is not None,
+            pair_op=op.is_pair_op,
+        )
+        self._note_alg(alg)
+        me = self.my_pidx
+        if alg in _hs.ORDER_WAIVING:
+            arr = np.asarray(partial)
+            fn = (_hs.allreduce_ring if alg == "ring"
+                  else _hs.allreduce_rabenseifner)
+            out = fn(self._xchg, procs, me, arr,
+                     lambda a, b: np.asarray(op(a, b)),
+                     op.identity_for(arr.dtype))
+            return jnp.asarray(np.asarray(out).reshape(arr.shape))
+        if alg == "recursive_doubling":
+            flats = _hs.allgather_bruck(
+                self._xchg, procs, me, packed,
+                [int(packed.size)] * P)
+            return self._fold_flats(
+                procs, dict(zip(procs, flats)), partial, op)
+        # linear: the all-pairs exchange baseline (one packed message
+        # per peer; pair ops no longer ship two)
+        got = _hs.linear_exchange(self._xchg, procs, me, packed)
+        return self._fold_flats(procs, got, partial, op)
+
+    def _combine_leader(self, partial, op: Op):
+        """Host-aware two-stage combine: co-hosted processes fold at
+        their host leader (shm), leaders run the selected schedule
+        across hosts (DCN), results fan back out. Fold order is fixed:
+        host members in process-index order, then hosts in leader-
+        index order — identical on every rank and run."""
+        me = self.my_pidx
+        lead = self.leader_of[me]
+        if lead != me:
+            _hs.round_exchange(
+                self._xchg, {lead: [self._pack_partial(partial, op)]}, {})
+            got = _hs.round_exchange(self._xchg, {}, {lead: 1})[lead][0]
+            return self._unpack_partial(got, partial, op)
+        _leader_combines.add()
+        members = self.host_groups[self.host_of[me]]  # sorted (pidx)
+        parts = {me: partial}
+        others = [p for p in members if p != me]
+        if others:
+            got = _hs.round_exchange(self._xchg, {},
+                                     {p: 1 for p in others})
+            for p in others:
+                parts[p] = self._unpack_partial(got[p][0], partial, op)
+        acc = self._fold([parts[p] for p in members], op)
+        total = self._combine_flat(self.leaders, acc, op)
+        if others:
+            tp = self._pack_partial(total, op)
+            _hs.round_exchange(self._xchg, {p: [tp] for p in others}, {})
+        return total
 
     def _bcast_local_axis(self, value):
         value = jnp.asarray(value)
@@ -432,7 +656,7 @@ class _HierModule:
 
     # -- reductions --------------------------------------------------------
     def allreduce(self, comm, x, op: Op):
-        total = self._combine_with_peers(self._local_partial(x, op), op)
+        total = self._combine_partials(self._local_partial(x, op), op)
         if op.is_pair_op:
             tv, ti = total
             return (self._bcast_local_axis(tv),
@@ -440,20 +664,53 @@ class _HierModule:
         return self._bcast_local_axis(total)
 
     def reduce(self, comm, x, op: Op, root: int):
-        # combine like allreduce, then mask to the root's slice (the
-        # xla component's rooted-reduce convention: zeros elsewhere)
-        total = self._combine_with_peers(self._local_partial(x, op), op)
+        """Gather per-process partials to the root's owner — binomial
+        tree (one packed send per non-root, ceil(log2 P) receives at
+        the root) or direct linear sends — then ONE fold there in
+        process-index order: bitwise-identical to the historic
+        combine-everywhere path (same fold order) at a fraction of the
+        messages, and exact for non-commutative ops. The result is
+        masked to the root's slice (zeros elsewhere, the xla rooted-
+        reduce convention)."""
+        partial = self._local_partial(x, op)
+        owner = self.owner[root]
+        me = self.my_pidx
+        P = len(self.procs)
+        packed = self._pack_partial(partial, op)
+        alg = _hs.pick("reduce", P, int(packed.nbytes)) if P > 1 \
+            else "linear"
+        self._note_alg(alg)
+        flats = None
+        if P == 1:
+            total = partial
+        elif alg == "binomial":
+            flats = _hs.gather_binomial(
+                self._xchg, self.procs, me, owner, packed,
+                [int(packed.size)] * P)
+        elif me != owner:
+            _hs.round_exchange(self._xchg, {owner: [packed]}, {})
+        else:
+            got = _hs.round_exchange(
+                self._xchg, {}, {p: 1 for p in self.procs if p != me})
+            flats = [packed if p == me else got[p][0]
+                     for p in self.procs]
+        if flats is not None:
+            total = self._fold_flats(
+                self.procs, dict(zip(self.procs, flats)), partial, op)
+        elif P > 1 and me != owner:
+            total = None  # recv buffer undefined off-root (zeros)
 
         def place(t):
             out = np.zeros((self.local_n,) + np.asarray(t).shape,
                            np.asarray(t).dtype)
-            if root in self.local_ranks:
+            if total is not None and root in self.local_ranks:
                 out[self.local_ranks.index(root)] = np.asarray(t)
             return jnp.asarray(out)
 
         if op.is_pair_op:
-            return (place(total[0]), place(total[1]))
-        return place(total)
+            like = partial if total is None else total
+            return (place(like[0]), place(like[1]))
+        return place(partial if total is None else total)
 
     def reduce_scatter_block(self, comm, x, op: Op):
         n = comm.size
@@ -469,7 +726,7 @@ class _HierModule:
             out = np.stack([chunks[r] for r in self.local_ranks])
             return out.reshape((self.local_n, -1) + total.shape[1:])
 
-        total = self._combine_with_peers(self._local_partial(x, op), op)
+        total = self._combine_partials(self._local_partial(x, op), op)
         if op.is_pair_op:
             tv, ti = total
             return (jnp.asarray(chunked(np.asarray(tv))),
@@ -479,9 +736,26 @@ class _HierModule:
     # -- data movement -----------------------------------------------------
     def bcast(self, comm, x, root: int):
         owner = self.owner[root]
-        if owner == self.my_pidx:
+        me = self.my_pidx
+        if owner == me:
             self._check_local_axis(x, "bcast")
             val = np.asarray(x[self.local_ranks.index(root)])
+        else:
+            val = None
+        # every rank passes an x of the same per-slice shape (the
+        # driver-mode SPMD convention), so the decision byte count is
+        # derivable symmetrically off-root too
+        xa = np.asarray(x)
+        slice_bytes = int(xa.nbytes // xa.shape[0]) if xa.ndim else 0
+        alg = _hs.pick("bcast", len(self.procs), slice_bytes)
+        self._note_alg(alg)
+        if alg == "binomial" and len(self.procs) > 1:
+            if self._leader_tier_active():
+                val = self._bcast_leader(owner, val)
+            else:
+                val = _hs.bcast_binomial(self._xchg, self.procs, me,
+                                         owner, val)
+        elif owner == me:
             if self._overlap():
                 self._send_all({p: [val] for p in self.peers})
             else:
@@ -491,40 +765,117 @@ class _HierModule:
             val = self._recv(owner)
         return self._bcast_local_axis(val)
 
+    def _bcast_leader(self, owner: int, val):
+        """Leader-tier bcast: binomial over {owner + other hosts'
+        leaders} crosses DCN, then each of those fans out to its
+        co-hosted processes over shm (the owner serves its own host —
+        including that host's nominal leader)."""
+        me = self.my_pidx
+        host = self.host_of
+        bset = sorted({owner} | {l for l in self.leaders
+                                 if host[l] != host[owner]})
+        if me in bset:
+            val = _hs.bcast_binomial(self._xchg, bset, me, owner, val)
+            fan = [p for p in self.host_groups[host[me]] if p != me]
+            if fan:
+                _hs.round_exchange(
+                    self._xchg, {p: [np.asarray(val)] for p in fan}, {})
+            return val
+        src = owner if host[me] == host[owner] else self.leader_of[me]
+        return np.asarray(
+            _hs.round_exchange(self._xchg, {}, {src: 1})[src][0])
+
+    def _gather_block_rows(self,
+                           block: np.ndarray) -> Dict[int, np.ndarray]:
+        """Every rank's slice via the selected allgather schedule over
+        per-process blocks (one (local_n, chunk...) block each);
+        returns {comm rank: row}."""
+        me = self.my_pidx
+        P = len(self.procs)
+        chunk_shape = block.shape[1:]
+        chunk_elems = int(np.prod(chunk_shape, dtype=np.int64)) \
+            if chunk_shape else 1
+        total_bytes = int(self.comm.size * chunk_elems * block.itemsize)
+        alg = _hs.pick("allgather", P, total_bytes) if P > 1 else "linear"
+        self._note_alg(alg)
+        blocks: Dict[int, np.ndarray] = {}
+        if P == 1 or alg == "linear":
+            got = self._exchange({p: [block] for p in self.peers})
+            for p in self.procs:
+                blocks[p] = block if p == me else np.asarray(got[p][0])
+        elif alg == "bruck":
+            counts = [len(self.members_of[p]) * chunk_elems
+                      for p in self.procs]
+            flats = _hs.allgather_bruck(
+                self._xchg, self.procs, me,
+                np.ascontiguousarray(block).reshape(-1), counts)
+            for i, p in enumerate(self.procs):
+                blocks[p] = np.asarray(flats[i]).reshape(
+                    (len(self.members_of[p]),) + chunk_shape)
+        else:  # ring: neighbor-only passes, shapes ride the wire
+            parts = _hs.allgather_ring(self._xchg, self.procs, me, block)
+            for i, p in enumerate(self.procs):
+                blocks[p] = np.asarray(parts[i])
+        rows: Dict[int, np.ndarray] = {}
+        for p in self.procs:
+            pblock = blocks[p]
+            for pos, r in enumerate(self.members_of[p]):
+                rows[r] = pblock[pos]
+        return rows
+
     def allgather(self, comm, x):
         self._check_local_axis(x, "allgather")
         block = np.asarray(x)  # (local_n, chunk...)
-        got = self._exchange({p: [block] for p in self.peers})
-        rows: Dict[int, np.ndarray] = {}
-        for p in self.procs:
-            pblock = block if p == self.my_pidx else got[p][0]
-            for pos, r in enumerate(self.members_of[p]):
-                rows[r] = pblock[pos]
+        rows = self._gather_block_rows(block)
         full = self._cat([rows[r] for r in range(comm.size)])
         return self._bcast_local_axis(full)
 
     def gather(self, comm, x, root: int):
         self._check_local_axis(x, "gather")
         owner = self.owner[root]
+        me = self.my_pidx
+        P = len(self.procs)
         block = np.asarray(x)
         full_shape = (comm.size * block.shape[1],) + block.shape[2:] \
             if block.ndim > 1 else (comm.size,)
-        if owner != self.my_pidx:
-            self._send(owner, block)
-            return jnp.zeros((self.local_n,) + full_shape, block.dtype)
+        chunk_shape = block.shape[1:]
+        chunk_elems = int(np.prod(chunk_shape, dtype=np.int64)) \
+            if chunk_shape else 1
+        slice_bytes = int(chunk_elems * block.itemsize)
+        alg = _hs.pick("gather", P, slice_bytes) if P > 1 else "linear"
+        self._note_alg(alg)
         rows: Dict[int, np.ndarray] = {}
-        for pos, r in enumerate(self.members_of[self.my_pidx]):
-            rows[r] = block[pos]
-
-        def place(p: int, pblock: np.ndarray) -> None:
-            for pos, r in enumerate(self.members_of[p]):
-                rows[r] = pblock[pos]
-
-        if self._overlap():
-            self._reap({p: 1 for p in self.peers}, place)
+        if alg == "binomial" and P > 1:
+            counts = [len(self.members_of[p]) * chunk_elems
+                      for p in self.procs]
+            flats = _hs.gather_binomial(
+                self._xchg, self.procs, me, owner,
+                np.ascontiguousarray(block).reshape(-1), counts)
+            if flats is None:
+                return jnp.zeros((self.local_n,) + full_shape,
+                                 block.dtype)
+            for i, p in enumerate(self.procs):
+                pblock = np.asarray(flats[i]).reshape(
+                    (len(self.members_of[p]),) + chunk_shape)
+                for pos, r in enumerate(self.members_of[p]):
+                    rows[r] = pblock[pos]
         else:
-            for p in self.peers:
-                place(p, self._recv(p))
+            if owner != me:
+                self._send(owner, block)
+                return jnp.zeros((self.local_n,) + full_shape,
+                                 block.dtype)
+            for pos, r in enumerate(self.members_of[me]):
+                rows[r] = block[pos]
+
+            def place(p: int, pblock: np.ndarray) -> None:
+                for pos, r in enumerate(self.members_of[p]):
+                    rows[r] = pblock[pos]
+
+            if self._overlap():
+                self._reap({p: 1 for p in self.peers}, place)
+            else:
+                for p in self.peers:
+                    place(p, self._recv(p))
         full = self._cat([rows[r] for r in range(comm.size)])
         out = np.zeros((self.local_n,) + full.shape, full.dtype)
         out[self.local_ranks.index(root)] = full
@@ -533,7 +884,16 @@ class _HierModule:
     def scatter(self, comm, x, root: int):
         n = comm.size
         owner = self.owner[root]
-        if owner == self.my_pidx:
+        me = self.my_pidx
+        P = len(self.procs)
+        # MPI reads the buffer on the root only, so non-roots cannot
+        # know the message size — the schedule decision must still be
+        # identical everywhere, so it is taken at bytes=0 (forcing and
+        # zero-threshold rules apply; size-split rules cannot)
+        alg = _hs.pick("scatter", P, 0) if P > 1 else "linear"
+        self._note_alg(alg)
+        chunks = None
+        if owner == me:
             self._check_local_axis(x, "scatter")
             full = np.asarray(x[self.local_ranks.index(root)])
             if full.shape[0] % n:
@@ -543,13 +903,32 @@ class _HierModule:
                     f"divisible by comm size {n}",
                 )
             chunks = full.reshape((n, -1) + full.shape[1:])
+        if alg == "binomial" and P > 1:
+            weights = [len(self.members_of[p]) for p in self.procs]
+            per_pos = meta = None
+            if owner == me:
+                per_pos = [np.ascontiguousarray(
+                    chunks[self.members_of[p]]).reshape(-1)
+                    for p in self.procs]
+                meta = np.asarray(chunks.shape[1:], np.int64)
+            flat, meta = _hs.scatter_binomial(self._xchg, self.procs,
+                                              me, owner, per_pos,
+                                              weights, meta)
+            if owner == me:
+                mine = chunks[self.members_of[me]]
+            else:
+                # the forwarded meta header carries the per-rank chunk
+                # shape MPI lets only the root read
+                shape = (self.local_n,) + tuple(int(s) for s in meta)
+                mine = np.asarray(flat).reshape(shape)
+        elif owner == me:
             if self._overlap():
                 self._send_all({p: [chunks[self.members_of[p]]]
                                 for p in self.peers})
             else:
                 for p in self.peers:
                     self._send(p, chunks[self.members_of[p]])
-            mine = chunks[self.members_of[self.my_pidx]]
+            mine = chunks[self.members_of[me]]
         else:
             mine = self._recv(owner)  # (local_n, chunk...)
         return jnp.asarray(mine)
@@ -567,15 +946,50 @@ class _HierModule:
         c = block.shape[1] // n
         # chunks[a, j]: local member a's chunk destined to comm rank j
         chunks = block.reshape((self.local_n, n, c) + block.shape[2:])
-        sends = {p: [chunks[:, self.members_of[p]]] for p in self.peers}
-        got = self._exchange(sends)
+        P = len(self.procs)
+        me = self.my_pidx
+        trail = int(np.prod(block.shape[2:], dtype=np.int64)) \
+            if block.ndim > 2 else 1
+        # decision unit = one rank-pair chunk's bytes (block_dsize,
+        # coll_tuned_decision_fixed.c:122) — identical on every process
+        alg = _hs.pick("alltoall", P, int(c * trail * block.itemsize)) \
+            if P > 1 else "linear"
+        self._note_alg(alg)
+        recv_block: Dict[int, np.ndarray] = {}
+        if P == 1:
+            pass
+        elif alg == "bruck":
+            mlen = [len(self.members_of[p]) for p in self.procs]
+            cf = c * trail
+            pair_counts = [[mlen[o] * mlen[j] * cf for j in range(P)]
+                           for o in range(P)]
+            mine = [np.ascontiguousarray(
+                chunks[:, self.members_of[p]]).reshape(-1)
+                for p in self.procs]
+            res = _hs.alltoall_bruck(self._xchg, self.procs, me, mine,
+                                     pair_counts)
+            for i, p in enumerate(self.procs):
+                if p == me:
+                    continue
+                recv_block[p] = np.asarray(res[i]).reshape(
+                    (mlen[i], self.local_n, c) + block.shape[2:])
+        elif alg == "pairwise":
+            payload_for = {p: np.ascontiguousarray(
+                chunks[:, self.members_of[p]]) for p in self.peers}
+            got = _hs.alltoall_pairwise(self._xchg, self.procs, me,
+                                        payload_for)
+            recv_block = {p: np.asarray(a) for p, a in got.items()}
+        else:  # linear: every peer's aggregate posted at once
+            got = self._exchange({p: [chunks[:, self.members_of[p]]]
+                                  for p in self.peers})
+            recv_block = {p: np.asarray(got[p][0]) for p in self.peers}
         out = np.empty_like(chunks)
         # local block: out[b, i] = in[a, j] for local members i->j
         for a, i in enumerate(self.local_ranks):
             for b, j in enumerate(self.local_ranks):
                 out[b, i] = chunks[a, j]
         for p in self.peers:
-            r = got[p][0]  # [a, b]: p's member a -> my member b
+            r = recv_block[p]  # [a, b]: p's member a -> my member b
             for a, i in enumerate(self.members_of[p]):
                 for b in range(self.local_n):
                     out[b, i] = r[a, b]
@@ -807,7 +1221,7 @@ class _HierModule:
                     f"reduce_scatter needs values shaped "
                     f"({self.local_n}, {total}), got {vals.shape}",
                 )
-            tv, ti = self._combine_with_peers(
+            tv, ti = self._combine_partials(
                 self._local_partial((vals, idxs), op), op
             )
             tv, ti = np.asarray(tv).reshape(-1), np.asarray(ti).reshape(-1)
@@ -829,7 +1243,7 @@ class _HierModule:
                 f"{total}), got {x.shape}",
             )
         x = x.reshape(self.local_n, total)
-        red = np.asarray(self._combine_with_peers(
+        red = np.asarray(self._combine_partials(
             self._local_partial(jnp.asarray(x), op), op
         ))
         offs = np.concatenate([[0], np.cumsum(recvcounts)])
@@ -838,15 +1252,8 @@ class _HierModule:
 
     # -- prefix scans ------------------------------------------------------
     def _full_rows(self, x) -> Dict[int, np.ndarray]:
-        """Every rank's slice, via an allgather-style block exchange."""
-        block = np.asarray(x)
-        got = self._exchange({p: [block] for p in self.peers})
-        rows: Dict[int, np.ndarray] = {}
-        for p in self.procs:
-            pblock = block if p == self.my_pidx else got[p][0]
-            for pos, r in enumerate(self.members_of[p]):
-                rows[r] = pblock[pos]
-        return rows
+        """Every rank's slice, via the selected allgather schedule."""
+        return self._gather_block_rows(np.asarray(x))
 
     def _scan_impl(self, comm, x, op: Op, exclusive: bool):
         if op.is_pair_op:

@@ -203,6 +203,165 @@ def emit_wire_rules(seg_times: Dict[int, float],
     return "\n".join(lines)
 
 
+#: worker app for the hier sweep: a REAL loopback tpurun job (one
+#: device per process, so comm size == process count) that times every
+#: legal INTER schedule of each spanning collective under the
+#: ``hier_inter_algorithm`` forcing cvar. Process 0 writes the rows to
+#: OMPITPU_HIER_TUNE_OUT.
+_HIER_TUNE_APP = r'''
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.coll import hier_schedules
+from ompi_release_tpu.mca import var as mca_var
+from ompi_release_tpu.runtime.runtime import Runtime
+
+OPS = json.loads(os.environ["OMPITPU_HIER_TUNE_OPS"])
+SIZES = json.loads(os.environ["OMPITPU_HIER_TUNE_SIZES"])
+REPEATS = int(os.environ.get("OMPITPU_HIER_TUNE_REPEATS", "3"))
+world = mpi.init()
+rt = Runtime.current()
+me = rt.bootstrap["process_index"]
+n = world.size
+
+def runner(op, x):
+    if op == "allreduce":
+        return world.allreduce(x)
+    if op == "bcast":
+        return world.bcast(x, root=0)
+    if op == "reduce":
+        return world.reduce(x, root=0)
+    if op == "allgather":
+        return world.allgather(x)
+    if op == "alltoall":
+        return world.alltoall(x)
+    if op == "gather":
+        return world.gather(x, root=0)
+    if op == "scatter":
+        return world.scatter(x, root=0)
+    raise ValueError(op)
+
+def unit_bytes(op, elems):
+    # the hier decision units pick() documents
+    if op == "allgather":
+        return elems * 4 * n
+    if op == "alltoall":
+        return (elems // n) * 4
+    if op == "scatter":
+        return 0  # size-blind decision (root-only buffer)
+    return elems * 4
+
+results = {}
+for op in OPS:
+    rows = []
+    for size in SIZES:
+        elems = max(n, size // 4)
+        elems = -(-elems // n) * n
+        x = np.ones((1, elems), np.float32)
+        times = {}
+        for alg in hier_schedules.ALGORITHMS[op]:
+            if alg == "auto":
+                continue
+            mca_var.set_value("hier_inter_algorithm", alg)
+            try:
+                world.barrier()
+                runner(op, x)  # warm the shadow-comm programs
+                best = None
+                for _ in range(REPEATS):
+                    world.barrier()
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(runner(op, x))
+                    dt = time.perf_counter() - t0
+                    best = dt if best is None else min(best, dt)
+                times[alg] = best
+            except Exception as e:
+                if me == 0:
+                    print("hier-tune skip %%s/%%s@%%d: %%s"
+                          %% (op, alg, size, e), file=sys.stderr)
+            finally:
+                mca_var.VARS.unset("hier_inter_algorithm")
+        if times:
+            rows.append({"size": size,
+                         "unit_bytes": unit_bytes(op, elems),
+                         "times": times,
+                         "winner": min(times, key=times.get)})
+    results[op] = rows
+world.barrier()
+if me == 0:
+    with open(os.environ["OMPITPU_LOOPBACK_OUT"], "w") as f:
+        json.dump({"nprocs": n, "results": results}, f)
+mpi.finalize()
+'''
+
+
+def sweep_hier(nprocs: int, ops: Sequence[str], sizes: Sequence[int],
+               repeats: int = 3,
+               timeout_s: int = 600) -> Optional[Dict]:
+    """Measure the spanning collectives' INTER schedules through a
+    real ``nprocs``-process loopback ``tpurun`` job (the schedules
+    only exist across process boundaries — a single-process sweep
+    cannot time them). Returns ``{"nprocs", "results"}`` in
+    :func:`measure`'s row shape, or None if the job failed."""
+    import json as _json
+    import os as _os
+
+    from ..tools.tpurun import run_loopback_app
+
+    out = run_loopback_app(
+        nprocs,
+        _HIER_TUNE_APP % {
+            "repo": _os.path.dirname(_os.path.dirname(
+                _os.path.dirname(_os.path.abspath(__file__))))},
+        {"OMPITPU_HIER_TUNE_OPS": _json.dumps(list(ops)),
+         "OMPITPU_HIER_TUNE_SIZES": _json.dumps(
+             sorted(int(s) for s in sizes)),
+         "OMPITPU_HIER_TUNE_REPEATS": str(repeats)},
+        "hier_tune.json", timeout_s=timeout_s)
+    if out is None:
+        _log.verbose(1, "hier sweep job failed")
+    return out
+
+
+def emit_hier_rules(sweep: Dict) -> str:
+    """Render a hier sweep as ``hier_<op>`` rule lines (same
+    ascending-threshold last-match-wins shape as :func:`emit`, and the
+    same min_comm_size=0 convention: the emitted rules apply at every
+    process count, since one sweep measures one). The measured process
+    count is recorded in the header comment — re-run at another
+    ``--hier-procs`` and hand-scope the lines if your jobs vary."""
+    if not sweep:
+        return ""
+    nprocs = int(sweep["nprocs"])
+    lines = [
+        "",
+        f"# hier_* inter-process schedules, measured on a {nprocs}-"
+        "process loopback job (tpu-tune --hier-procs); min_comm_size "
+        "is the PROCESS count",
+    ]
+    for op, rows in sweep["results"].items():
+        if not rows:
+            continue
+        prev = None
+        for i, row in enumerate(rows):
+            t = ", ".join(f"{a}={s * 1e6:.0f}us"
+                          for a, s in sorted(row["times"].items(),
+                                             key=lambda kv: kv[1]))
+            lines.append(f"# hier_{op} @ {row['size']}B: {t}")
+            if row["winner"] != prev:
+                thresh = 0 if i == 0 else row["unit_bytes"]
+                lines.append(
+                    f"hier_{op}  0  {thresh}  {row['winner']}")
+                prev = row["winner"]
+    return "\n".join(lines) + "\n"
+
+
 def measure(comm, ops: Sequence[str], sizes: Sequence[int],
             repeats: int = 5, *, segsizes: Optional[Sequence[int]] = None,
             algs: Optional[Sequence[str]] = None) -> Dict[str, List[Dict]]:
@@ -412,6 +571,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "to sweep through a loopback OOB staged "
                          "transfer (emits a recommendation comment); "
                          "empty disables")
+    ap.add_argument("--hier-procs", type=int, default=0,
+                    help="process count for the spanning-collective "
+                         "INTER schedule sweep (a real loopback tpurun "
+                         "job; emits hier_* rule lines); 0 disables")
+    ap.add_argument("--hier-ops", default="allreduce,bcast,reduce,"
+                                          "allgather,alltoall",
+                    help="spanning collectives the hier sweep times")
+    ap.add_argument("--hier-sizes", default="1024,65536,1048576",
+                    help="per-rank buffer sizes (bytes) for the hier "
+                         "sweep")
     args = ap.parse_args(argv)
 
     import ompi_release_tpu as mpi
@@ -429,6 +598,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                        if s.strip())
     if wire_segs:
         text += emit_wire_rules(sweep_wire_segsizes(wire_segs)) + "\n"
+    if args.hier_procs >= 2:
+        hier_ops = [o.strip() for o in args.hier_ops.split(",")
+                    if o.strip()]
+        hier_sizes = sorted(int(s) for s in args.hier_sizes.split(",")
+                            if s.strip())
+        sweep = sweep_hier(args.hier_procs, hier_ops, hier_sizes,
+                           repeats=args.repeats)
+        if sweep:
+            text += emit_hier_rules(sweep)
     with open(args.output, "w") as f:
         f.write(text)
     # validate what we just wrote parses (a typo'd generator must not

@@ -928,6 +928,42 @@ class Job:
         return 0
 
 
+def run_loopback_app(nprocs: int, app_src: str, env: dict,
+                     out_path: str, *, timeout_s: int = 300,
+                     mca: Optional[List[tuple]] = None):
+    """Spawn ``app_src`` as an ``nprocs``-process loopback Job with
+    ``env`` exported for the workers, and return the JSON document the
+    app wrote to ``out_path`` (or None on failure). The shared harness
+    behind the bench micro-suites and the tpu-tune sweeps — the
+    tempdir/env-snapshot/Job/read-results dance lives exactly once.
+
+    Note: mutates ``os.environ`` for the spawn window (workers inherit
+    the parent environment) and restores it in a finally — callers
+    must not run concurrent spawns from other threads."""
+    import json as _json
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        app = os.path.join(td, "loopback_app.py")
+        with open(app, "w") as f:
+            f.write(app_src)
+        resolved_out = os.path.join(td, out_path)
+        env_keep = dict(os.environ)
+        os.environ.update({k: str(v) for k, v in env.items()})
+        os.environ["OMPITPU_LOOPBACK_OUT"] = resolved_out
+        try:
+            job = Job(nprocs, [sys.executable, app], list(mca or ()),
+                      heartbeat_s=0.5, miss_limit=8)
+            rc = job.run(timeout_s=timeout_s)
+        finally:
+            os.environ.clear()
+            os.environ.update(env_keep)
+        if rc != 0 or not os.path.exists(resolved_out):
+            return None
+        with open(resolved_out) as f:
+            return _json.load(f)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpurun", description="Launch an N-process tpu job "

@@ -29,6 +29,7 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/coll/fusion.py",
            "ompi_release_tpu/runtime/wire.py",
            "ompi_release_tpu/coll/hier.py",
+           "ompi_release_tpu/coll/hier_schedules.py",
            "ompi_release_tpu/osc/wire_win.py",
            "ompi_release_tpu/p2p/pml.py",
            "ompi_release_tpu/btl/components.py")

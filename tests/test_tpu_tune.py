@@ -74,3 +74,45 @@ class TestTpuTune:
         # every rule line's collective/algorithm already validated by
         # load_rules; check the justification comments carry timings
         assert "us" in text and "@" in text
+
+
+class TestHierSweep:
+    def test_emit_hier_rules_shape_and_parse(self, tmp_path):
+        """emit_hier_rules renders last-match-wins threshold lines in
+        the hier_* namespaces, justified by measurement comments, and
+        the output parses through the real rule loader."""
+        sweep = {"nprocs": 4, "results": {"allreduce": [
+            {"size": 1024, "unit_bytes": 1024,
+             "times": {"linear": 1e-3, "recursive_doubling": 5e-4},
+             "winner": "recursive_doubling"},
+            {"size": 1 << 20, "unit_bytes": 1 << 20,
+             "times": {"ring": 1e-3, "recursive_doubling": 2e-3},
+             "winner": "ring"},
+        ], "bcast": [
+            {"size": 1024, "unit_bytes": 1024,
+             "times": {"binomial": 1e-4}, "winner": "binomial"},
+        ]}}
+        text = tpu_tune.emit_hier_rules(sweep)
+        assert "hier_allreduce  0  0  recursive_doubling" in text
+        assert "hier_allreduce  0  1048576  ring" in text
+        assert "hier_bcast  0  0  binomial" in text
+        assert "us" in text  # measurement justification comments
+        p = tmp_path / "hier_rules.conf"
+        p.write_text(text)
+        rules = dynamic_rules.load_rules(str(p))
+        assert len(rules["hier_allreduce"]) == 2
+
+    def test_sweep_hier_loopback_job(self, tmp_path):
+        """The real 2-process loopback sweep: every timed algorithm is
+        a legal hier_allreduce rule name and the emitted file loads."""
+        sweep = tpu_tune.sweep_hier(2, ["allreduce"], [4096], repeats=1)
+        assert sweep is not None and sweep["nprocs"] == 2
+        rows = sweep["results"]["allreduce"]
+        assert rows, sweep
+        legal = set(dynamic_rules.RULE_COLLECTIVES["hier_allreduce"])
+        for row in rows:
+            assert row["winner"] in row["times"]
+            assert set(row["times"]) <= legal
+        p = tmp_path / "swept.conf"
+        p.write_text(tpu_tune.emit_hier_rules(sweep))
+        assert dynamic_rules.load_rules(str(p))["hier_allreduce"]
