@@ -682,6 +682,63 @@ def _arm_global_watchdog(budget_s=1500.0):
     return t
 
 
+def _backend_alive(jax, timeout_s=20.0):
+    """Cached-backend probe: ``jax.devices()`` after a successful init
+    is a client-cache read (fast), but a tunnel that died mid-run can
+    HANG it — so the probe runs on a daemon thread with a deadline.
+    Returns False on hang or error; the caller skips/labels the suite
+    instead of losing the whole round to a 180 s init stall."""
+    import threading
+
+    box = {}
+
+    def probe():
+        try:
+            box["ok"] = bool(jax.devices())
+        except Exception:
+            box["ok"] = False
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    return box.get("ok", False)
+
+
+def _run_suite(name, fn, emit, jax, attempts=2, first_delay=5.0):
+    """Run one micro-suite behind a cached-backend probe with bounded
+    retry-with-backoff on ``tpu_unavailable``-class failures. Every
+    outcome emits parseable lines: the suite's own on success, one
+    labelled error line on final failure — never a silent hole in the
+    round record (the BENCH r04/r05 failure mode)."""
+    delay = first_delay
+    last = None
+    for i in range(attempts):
+        if not _backend_alive(jax):
+            last = ("backend unavailable: cached jax.devices() probe "
+                    "hung or errored before the suite")
+            if i + 1 < attempts:
+                time.sleep(delay)
+                delay *= 2
+                continue
+            break
+        try:
+            for ln in fn():
+                emit(ln)
+            return
+        except Exception as e:
+            last = f"{type(e).__name__}: {e}"[:300]
+            retriable = "unavailable" in str(e).lower()
+            if retriable and i + 1 < attempts:
+                time.sleep(delay)
+                delay *= 2
+                continue
+            break
+    emit({"metric": name, "value": None, "unit": None,
+          "vs_baseline": None, "error": "tpu_unavailable"
+          if last and "unavailable" in last.lower() else "suite_failed",
+          "detail": last})
+
+
 def _pvar_snapshot():
     """Current pvar values, JSON-ready (per-config observability)."""
     try:
@@ -700,6 +757,8 @@ _MICRO_PVARS = (
     "coll_fusion_flushes", "coll_fusion_bytes_saved",
     "coll_programs_compiled", "coll_invocations",
     "coll_plan_cache_hits",
+    "obs_sample_overhead_seconds", "obs_series_points",
+    "obs_sample_ticks",
 )
 
 
@@ -758,6 +817,54 @@ def _coll_micro_suite():
                 "suite": "coll_pipeline", "seconds": round(dt, 6),
                 "pvars": _micro_pvars(), "cumulative": True,
             })
+        # -- sampled-overhead case: the SAME 1 MiB allreduce with the
+        # continuous metrics plane armed (obs + sampler at a busy
+        # 50 ms interval). The ratio line is the <2%-overhead claim
+        # measured in situ, with the obs_sample_overhead_seconds pvar
+        # delta as the sampler's own accounting of where time went.
+        import ompi_release_tpu.obs as _obs_pkg
+        from ompi_release_tpu.obs import sampler as _sampler
+        from ompi_release_tpu.runtime.runtime import Runtime as _Rt
+
+        from ompi_release_tpu.mca import pvar as _pvar_mod
+
+        def _ov():
+            pv = _pvar_mod.PVARS.lookup("obs_sample_overhead_seconds")
+            return float(pv.read()) if pv is not None else 0.0
+
+        call = lambda: tuned.allreduce(x)
+        reps = 5
+        _sync(call())
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _sync(call())
+        base_dt = (time.perf_counter() - t0) / reps
+        was_enabled = _obs_pkg.enabled
+        ov0 = _ov()
+        _obs_pkg.enable()
+        mca_var.set_value("obs_sample_interval", 0.05)
+        _sampler.SAMPLER.start(0.05, runtime=_Rt._instance)
+        try:
+            _sync(call())
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                _sync(call())
+            samp_dt = (time.perf_counter() - t0) / reps
+        finally:
+            _sampler.stop(final_push=False)
+            if not was_enabled:
+                _obs_pkg.disable()
+            mca_var.VARS.unset("obs_sample_interval")
+        lines.append({
+            "metric": "coll_pipeline_allreduce_1MiB_sampled",
+            "value": round(base_dt / max(samp_dt, 1e-9), 4),
+            "unit": "x_vs_sampled_run", "vs_baseline": None,
+            "suite": "coll_pipeline",
+            "seconds": round(samp_dt, 6),
+            "unsampled_seconds": round(base_dt, 6),
+            "sampler_overhead_s": round(_ov() - ov0, 6),
+            "pvars": _micro_pvars(), "cumulative": True,
+        })
     finally:
         mca_var.VARS.unset("coll_tuned_allreduce_algorithm")
         mca_var.VARS.unset("coll_tuned_bcast_algorithm")
@@ -1315,9 +1422,21 @@ def main():
 
     rounds = 5 if on_tpu else 3
 
+    emitted = []  # every metric line of this round, for the gate
+
+    tier = "tpu" if on_tpu else "loopback-cpu"
+
     def emit(ln):
         if backend_label:
             ln["backend"] = backend_label
+        # explicit tier label on EVERY line: tpu rounds and
+        # loopback-CPU rounds (fallback OR forced JAX_PLATFORMS=cpu)
+        # stay comparable within their own tier (the bench gate
+        # groups by it) instead of a cpu round poisoning the tpu
+        # noise fit — or vanishing entirely
+        ln.setdefault("tier_label", tier)
+        if ln.get("metric"):
+            emitted.append(ln)
         print(json.dumps(ln), flush=True)
 
     # INCREMENTAL emission: every completed metric line prints
@@ -1376,40 +1495,51 @@ def main():
             "error": f"{type(e).__name__}: {e}"[:200],
         })
 
-    # coll pipeline/fusion micro-suite: framework-driver lines with
-    # labelled pvar snapshots (segment counts, fusion savings)
-    try:
-        for ln in _coll_micro_suite():
-            emit(ln)
-    except Exception as e:
-        emit({
-            "metric": "coll_micro_suite", "value": None, "unit": None,
-            "vs_baseline": None,
-            "error": f"{type(e).__name__}: {e}"[:300],
-        })
+    # micro-suites, each behind a cached-backend probe with bounded
+    # retry/backoff (BENCH r04/r05 lost whole rounds to one 180 s
+    # backend hang; a dead backend now costs one labelled error line):
+    #   coll: pipeline/fusion framework-driver lines with pvar labels
+    #   wire: cross-process p2p bandwidth, HOL lanes, allgatherv overlap
+    #   hier: spanning-collective inter schedules at 4 loopback procs
+    _run_suite("coll_micro_suite", _coll_micro_suite, emit, jax)
+    _run_suite("wire_micro_suite",
+               lambda: _wire_micro_suite(backend_label), emit, jax)
+    _run_suite("hier_scaling_suite",
+               lambda: _hier_micro_suite(backend_label), emit, jax)
 
-    # wire micro-suite: cross-process p2p bandwidth, lane-concurrency
-    # head-of-line wait, and spanning-comm allgatherv overlap — the
-    # cross-process bandwidth trajectory line
+    # perf-regression gate: judge THIS round's lines against the
+    # on-disk BENCH_r*.json history (fitted noise bounds per metric
+    # line, grouped by tier label) so the round record itself says
+    # whether the trajectory regressed — tpu_bench_gate's CLI runs the
+    # same evaluate() standalone
     try:
-        for ln in _wire_micro_suite(backend_label):
-            emit(ln)
-    except Exception as e:
-        emit({
-            "metric": "wire_micro_suite", "value": None, "unit": None,
-            "vs_baseline": None,
-            "error": f"{type(e).__name__}: {e}"[:300],
-        })
+        import glob as _glob
+        import os as _os
 
-    # hier_scaling micro-suite: spanning-collective inter schedules at
-    # 4 loopback processes — per-process inter bytes (linear 3n vs
-    # ring/Rabenseifner <= 2n) and the bcast root's log-depth sends
-    try:
-        for ln in _hier_micro_suite(backend_label):
-            emit(ln)
+        from ompi_release_tpu.tools import tpu_bench_gate as _gate
+
+        hist_files = sorted(_glob.glob(_os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "BENCH_r*.json")))
+        if hist_files:
+            rounds_hist = [_gate.parse_round_file(p)
+                           for p in hist_files]
+            # the headline prints after this block (it must stay the
+            # LAST line) but belongs in the gated set
+            cand = list(emitted) + [dict(headline, tier_label=tier)]
+            verdict = _gate.evaluate(rounds_hist, cand)
+            emit({
+                "metric": "bench_gate",
+                "value": len(verdict["regressions"]),
+                "unit": "regressions", "vs_baseline": None,
+                "checked": verdict["checked"],
+                "skipped": verdict["skipped"],
+                "history_rounds": len(hist_files),
+                "regressions": verdict["regressions"][:10],
+            })
     except Exception as e:
         emit({
-            "metric": "hier_scaling_suite", "value": None, "unit": None,
+            "metric": "bench_gate", "value": None, "unit": None,
             "vs_baseline": None,
             "error": f"{type(e).__name__}: {e}"[:300],
         })
@@ -1422,6 +1552,7 @@ def main():
     )
     if backend_label:
         headline["backend"] = backend_label
+    headline.setdefault("tier_label", tier)
     print(snapshot, flush=True)
     print(json.dumps(headline), flush=True)  # headline stays LAST
     watchdog.cancel()

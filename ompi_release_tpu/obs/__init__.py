@@ -168,6 +168,8 @@ if (os.environ.get("OMPI_TPU_OBS", "").strip().lower()
     enable()
 
 # convenience: obs.export.dump_chrome_trace(...), obs.skew, the stall
-# watchdog, and the doctor merge — imported last so their journal/pvar
-# imports see a fully-initialized package
-from . import export, skew, watchdog  # noqa: E402,F401
+# watchdog, the continuous sampler, and the doctor merge — imported
+# last so their journal/pvar imports see a fully-initialized package
+# (sampler import also registers the obs_sample_* cvars and the
+# obs_series_points / obs_sample_overhead_seconds pvars)
+from . import export, sampler, skew, watchdog  # noqa: E402,F401

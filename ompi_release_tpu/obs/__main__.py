@@ -106,7 +106,47 @@ def selftest() -> int:
     ):
         assert needle in page, f"{needle!r} missing from exposition"
 
-    # 5. coll driver plan-cache statistics (registered at driver
+    # 5. continuous sampler: delta snapshots, per-cid scoping, the
+    # OpenMetrics-with-timestamps exposition, and the overhead pvar
+    from . import sampler as _sampler
+
+    _sampler._reset_for_tests()
+    sc = pvar.counter("obs_selftest_series_ctr", "selftest")
+    base_pts = _sampler.SAMPLER.sample_once()  # baseline tick
+    assert base_pts >= 0
+    sc.add(4)
+    hist.observe(9.0)
+    journal.record("allreduce", "coll", time.perf_counter(), 2e-3,
+                   nbytes=1024, comm_id=7)
+    n = _sampler.SAMPLER.sample_once()
+    assert n > 0, "second tick must record deltas"
+    pts = _sampler.snapshot()
+    by_name = {}
+    for p in pts:
+        by_name.setdefault(p["name"], []).append(p)
+    assert any(p["v"] == 4.0 for p in by_name["obs_selftest_series_ctr"])
+    assert any(p["cid"] == 7 for p in by_name.get("coll_ops", [])), (
+        "per-communicator coll series missing")
+    ov = pvar.PVARS.lookup("obs_sample_overhead_seconds")
+    assert ov is not None and float(ov.read()) > 0.0
+    assert float(pvar.PVARS.lookup("obs_series_points").read()) >= n
+    om = export.openmetrics_series(pts)
+    assert om.endswith("# EOF\n") and "ompitpu_" in om
+    assert 'cid="7"' in om, om[:400]
+    # percentile math: all mass in one log2 bucket -> its midpoint
+    est = _sampler.percentile({8.0: 10}, 0.5)
+    assert est is not None and 4.0 < est <= 8.0, est
+    # series dump/reload round-trip (the finalize-dump unit)
+    with tempfile.TemporaryDirectory() as td:
+        sp = export.dump_series_jsonl(os.path.join(td, "series-p0.jsonl"))
+        from . import doctor as _doctor_mod
+
+        doc = _doctor_mod.load_series_dump(sp)
+        assert len(doc["points"]) == len(pts)
+    print(f"sampler: {len(pts)} points "
+          f"(overhead {float(ov.read()) * 1e3:.3f} ms)")
+
+    # 6. coll driver plan-cache statistics (registered at driver
     # import; sum = hits, count = invocations → sum/count = hit ratio)
     from ..coll import driver as _coll_driver  # noqa: F401
 

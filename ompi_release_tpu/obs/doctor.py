@@ -197,6 +197,119 @@ def merge(dumps: List[Dict[str, Any]]) -> Dict[str, Any]:
     return doc
 
 
+
+# ---------------------------------------------------------------------------
+# continuous series (obs/sampler.py rings) — load, clock-correct, merge
+# ---------------------------------------------------------------------------
+
+
+def load_series_dump(path: str) -> Dict[str, Any]:
+    """One ``series-p*.jsonl`` file (meta header line + one point per
+    line, ``obs.export.dump_series_jsonl``) back into the
+    ``{"meta": ..., "points": [...]}`` document shape."""
+    meta: Dict[str, Any] = {}
+    points: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            if "meta" in doc and "t" not in doc:
+                meta = doc["meta"]
+            else:
+                points.append(doc)
+    if not meta and not points:
+        raise ValueError(f"{path}: empty series dump")
+    return {"meta": meta, "points": points}
+
+
+def load_series_dir(directory: str) -> List[Dict[str, Any]]:
+    """Every ``series-p*.jsonl`` under ``directory``, ordered by
+    pidx. Missing files are not an error here — callers that can
+    proceed without series (the report annotation) check for []."""
+    docs = []
+    for p in sorted(glob.glob(os.path.join(directory,
+                                           "series-p*.jsonl"))):
+        docs.append(load_series_dump(p))
+    docs.sort(key=lambda d: int(d["meta"].get("pidx", 0)))
+    return docs
+
+
+def merge_series(docs: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One clock-corrected fleet series: every point gains ``ts``
+    (sample time mapped into the HNP timebase via the dump's clock
+    offset — the same correction journals get) and ``pidx``, merged
+    across processes and sorted by corrected time."""
+    merged: List[Dict[str, Any]] = []
+    for d in docs:
+        off = _offset(d["meta"])
+        pidx = int(d["meta"].get("pidx", 0))
+        for p in d["points"]:
+            c = dict(p)
+            c["ts"] = float(p["t"]) + off
+            c["pidx"] = pidx
+            merged.append(c)
+    merged.sort(key=lambda p: p["ts"])
+    return merged
+
+
+def fleet_to_series_docs(fleet: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """A live HNP fleet document (``HnpCoordinator.fleet_series``)
+    reshaped into the same per-process doc list the offline loaders
+    produce, so merge_series/tpu_top render both identically."""
+    docs = []
+    for pidx_s, ent in sorted((fleet.get("procs") or {}).items(),
+                              key=lambda kv: int(kv[0])):
+        meta = dict(ent.get("meta") or {})
+        meta.update(pidx=int(pidx_s),
+                    clock_offset_s=ent.get("clock_offset_s"),
+                    push_age_s=ent.get("push_age_s"))
+        docs.append({"meta": meta,
+                     "points": list(ent.get("points") or ())})
+    return docs
+
+
+def series_rates(merged: List[Dict[str, Any]]
+                 ) -> Dict[int, Dict[str, float]]:
+    """Per-process sampled collective rates over the merged window:
+    pidx -> {"window_s", "coll_ops_per_s", "coll_mb_per_s",
+    "coll_busy_frac"} folded from the per-cid ``coll_*`` delta points.
+    The doctor report annotates its critical path with these — a rank
+    that is both the chronic last-arriver AND the lowest-rate rank is
+    compute-bound, not network-starved."""
+    by_pidx: Dict[int, Dict[str, float]] = {}
+    spans: Dict[int, List[float]] = {}
+    for p in merged:
+        pidx = int(p.get("pidx", 0))
+        name = p.get("name")
+        if name not in ("coll_ops", "coll_bytes", "coll_seconds"):
+            continue
+        acc = by_pidx.setdefault(
+            pidx, {"coll_ops": 0.0, "coll_bytes": 0.0,
+                   "coll_seconds": 0.0})
+        try:
+            acc[name] += float(p.get("v", 0.0))
+        except (TypeError, ValueError):
+            continue
+        spans.setdefault(pidx, []).append(float(p["ts"]))
+    out: Dict[int, Dict[str, float]] = {}
+    for pidx, acc in sorted(by_pidx.items()):
+        ts = sorted(set(spans.get(pidx) or ()))
+        if len(ts) < 2:
+            # a single tick has no measurable window — omitting the
+            # proc beats reporting a made-up (and wildly inflated) rate
+            continue
+        window = max(ts[-1] - ts[0], 1e-9)
+        out[pidx] = {
+            "window_s": window,
+            "coll_ops_per_s": acc["coll_ops"] / window,
+            "coll_mb_per_s": acc["coll_bytes"] / window / 1e6,
+            "coll_busy_frac": min(acc["coll_seconds"] / window, 1.0),
+        }
+    return out
+
+
 def _coll_rounds(dumps: List[Dict[str, Any]]
                  ) -> Dict[Tuple[int, str], Dict[int, List[Dict]]]:
     """(comm, op) -> pidx -> that pid's coll-layer spans in call
@@ -213,11 +326,15 @@ def _coll_rounds(dumps: List[Dict[str, Any]]
     return table
 
 
-def skew_report(dumps: List[Dict[str, Any]]
+def skew_report(dumps: List[Dict[str, Any]],
+                series: Optional[List[Dict[str, Any]]] = None
                 ) -> Tuple[str, Dict[str, Any]]:
     """Critical-path + rank-skew report: for every collective round
     observed on EVERY process, name the last arriver (the rank the
-    round waited for) and the arrival spread."""
+    round waited for) and the arrival spread. When ``series`` (the
+    per-process docs from :func:`load_series_dir` or
+    :func:`fleet_to_series_docs`) is given, the critical path is
+    annotated with each process's sampled collective rates."""
     by_pid_ranks = {
         int(d["meta"].get("pidx", 0)): (
             int(d["meta"].get("rank_offset", 0)),
@@ -276,5 +393,20 @@ def skew_report(dumps: List[Dict[str, Any]]
     else:
         lines.append("  no multi-process collective rounds found "
                      "(was obs enabled on every rank?)")
+    rates: Dict[int, Dict[str, float]] = {}
+    if series:
+        rates = series_rates(merge_series(series))
+        if rates:
+            lines.append("  sampled rates (continuous metrics plane):")
+            for p in sorted(rates):
+                r = rates[p]
+                lines.append(
+                    f"    proc {p} ({rank_span(p)}): "
+                    f"{r['coll_ops_per_s']:.1f} coll/s, "
+                    f"{r['coll_mb_per_s']:.2f} MB/s, "
+                    f"busy {r['coll_busy_frac'] * 100:.1f}% over "
+                    f"{r['window_s']:.1f}s sampled")
     return "\n".join(lines), {"rounds": rounds_out,
-                              "critical_path": crit_count}
+                              "critical_path": crit_count,
+                              "sampled_rates": {str(p): r for p, r
+                                                in rates.items()}}

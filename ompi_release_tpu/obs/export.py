@@ -147,6 +147,59 @@ def maybe_dump_rank_journal(runtime=None) -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# continuous time-series (the sampler ring, obs/sampler.py)
+# ---------------------------------------------------------------------------
+
+
+def series_dump() -> Dict[str, Any]:
+    """This process's continuous sampler ring + identity + clock
+    offset as one JSON-able document — the TAG_SERIES RPC unit and
+    the per-rank series-dump payload (same meta shape as
+    :func:`rank_dump`, so the doctor's clock correction is shared)."""
+    from .. import obs as _obs
+    from . import sampler as _sampler
+
+    meta: Dict[str, Any] = _obs.rank_identity()
+    meta["clock_offset_s"] = _obs._clock_state["offset_s"]
+    meta["clock_rtt_s"] = _obs._clock_state["rtt_s"]
+    return {"meta": meta, "points": _sampler.snapshot()}
+
+
+def dump_series_jsonl(path: str,
+                      doc: Optional[Dict[str, Any]] = None) -> str:
+    """Series dump as JSONL: first line is the meta header (tagged
+    ``"meta"``), then one point per line — greppable, streamable, and
+    what ``tpu-doctor`` merges with clock correction."""
+    if doc is None:
+        doc = series_dump()
+    with open(path, "w") as f:
+        f.write(json.dumps({"meta": doc["meta"]}) + "\n")
+        for p in doc["points"]:
+            f.write(json.dumps(p) + "\n")
+    return path
+
+
+def maybe_dump_series(runtime=None) -> Optional[str]:
+    """Finalize hook: when ``obs_dump_dir`` is set (and obs is on),
+    write this rank's time-series ring there as
+    ``series-p<pidx>.jsonl``. Empty rings write nothing (sampler was
+    never armed)."""
+    import os
+
+    from ..mca import var as _var
+    from . import sampler as _sampler
+
+    d = str(_var.get("obs_dump_dir", "") or "")
+    if not d or not _sampler.snapshot():
+        return None
+    os.makedirs(d, exist_ok=True)
+    pidx = 0
+    if runtime is not None and runtime.bootstrap:
+        pidx = int(runtime.bootstrap.get("process_index", 0))
+    return dump_series_jsonl(os.path.join(d, f"series-p{pidx}.jsonl"))
+
+
+# ---------------------------------------------------------------------------
 # Prometheus text exposition
 # ---------------------------------------------------------------------------
 
@@ -195,4 +248,69 @@ def prometheus_text(registry: Optional[_pvar.PvarRegistry] = None) -> str:
             out.append(_help_line(m, d["help"]))
             out.append(f"# TYPE {m} {ptype}")
             out.append(f"{m} {fv:g}")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics with timestamps (the time-series exposition)
+# ---------------------------------------------------------------------------
+
+
+def openmetrics_series(points: Optional[Sequence[Dict[str, Any]]] = None,
+                       pidx: Optional[int] = None,
+                       clock_offset_s: float = 0.0) -> str:
+    """Sampler points as an OpenMetrics exposition **with
+    timestamps** — every sample line carries its sample time (plus
+    the given clock offset, so a merged fleet page sits on one
+    timebase), labelled by communicator scope (``cid``) and owning
+    process (``pidx`` — the argument, or each point's own ``pidx``
+    key for pre-merged fleet points). Delta points are exposed as
+    gauges (each point IS a per-interval delta — rate numerators);
+    dict deltas (AGGREGATE/HISTOGRAM) expand to ``_count``/``_sum``
+    plus ``p50``/``p99`` quantile-estimate gauges from the delta
+    buckets. Spec discipline: every emitted sample name is its own
+    gauge family, all of a family's samples are contiguous under ONE
+    ``# TYPE`` line, and the text ends with ``# EOF`` — so one call
+    over merged multi-process points yields a parseable page (never
+    concatenate two expositions)."""
+    from . import sampler as _sampler
+
+    if points is None:
+        points = _sampler.snapshot()
+    # family name -> sample lines (insertion-ordered: families stay
+    # grouped and contiguous as the spec requires)
+    fams: Dict[str, List[str]] = {}
+
+    def sample(fam: str, lab: str, value: float, ts: str) -> None:
+        fams.setdefault(fam, []).append(f"{fam}{lab} {value:g} {ts}")
+
+    for p in points:
+        m = _metric_name(str(p.get("name", ""))) + "_delta"
+        own = pidx if pidx is not None else p.get("pidx")
+        labels = [f'cid="{int(p.get("cid", -1))}"']
+        if own is not None:
+            labels.insert(0, f'pidx="{int(own)}"')
+        lab = "{" + ",".join(labels) + "}"
+        ts = f"{float(p['t']) + clock_offset_s:.6f}"
+        v = p.get("v")
+        if isinstance(v, dict):
+            sample(m + "_count", lab, float(v.get("count", 0)), ts)
+            sample(m + "_sum", lab, float(v.get("sum", 0.0)), ts)
+            buckets = v.get("buckets")
+            if isinstance(buckets, dict) and buckets:
+                for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                    est = _sampler.percentile(buckets, q)
+                    if est is not None:
+                        sample(f"{m}_{tag}", lab, est, ts)
+        else:
+            try:
+                fv = float(v)
+            except (TypeError, ValueError):
+                continue
+            sample(m, lab, fv, ts)
+    out: List[str] = []
+    for fam, lines in fams.items():
+        out.append(f"# TYPE {fam} gauge")
+        out.extend(lines)
+    out.append("# EOF")
     return "\n".join(out) + "\n"

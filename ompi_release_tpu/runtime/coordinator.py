@@ -54,7 +54,14 @@ TAG_PS = 13           # ps/top client->HNP: live job snapshot query
 TAG_MIGRATE = 14      # migrate client->HNP: move ranks off a host
 TAG_DIE = 15          # HNP->worker: exit immediately (odls kill)
 TAG_CLOCK = 16        # worker->HNP ping-pong: clock-offset estimation
+TAG_SERIES = 17       # worker->HNP: pvar time-series delta push;
+#                       client->HNP: fleet series query (empty frame)
 #                       (9-12 are the pubsub name-service tags)
+
+#: per-process cap on buffered fleet series points at the HNP (the
+#: aggregation store is a ring too — a chatty worker cannot grow the
+#: launcher without bound)
+SERIES_KEEP = 8192
 # pubsub tags + protocol live in runtime/pubsub.py (shared with the
 # standalone tpu-server); re-exported here for the worker-facing API
 from .pubsub import (  # noqa: E402
@@ -434,6 +441,88 @@ class HnpCoordinator:
             target=run, daemon=True, name="hnp-clock")
         self._clock_thread.start()
 
+    # -- fleet series aggregation (the continuous metrics plane) -----------
+    def start_series_responder(self) -> None:
+        """Serve TAG_SERIES frames: a worker **push** (JSON with a
+        ``points`` list) is folded into the per-process fleet store —
+        a bounded ring per pidx, newest SERIES_KEEP points kept, with
+        the worker's clock offset and push time alongside; any other
+        frame is a **query** (tpu_top --fleet, tpu-doctor) answered
+        with the whole fleet document. Shares the ps responder's stop
+        event (created in __init__), so start order does not matter."""
+        self._series_lock = threading.Lock()
+        # pidx -> {"points": [..ring..], "clock_offset_s": float|None,
+        #          "last_push": monotonic seconds}
+        self._fleet_series: Dict[int, Dict[str, Any]] = {}
+
+        def run() -> None:
+            while not self._ps_stop.is_set():
+                try:
+                    src, _, raw = self.ep.recv(tag=TAG_SERIES,
+                                               timeout_ms=200)
+                except MPIError:
+                    continue
+                try:
+                    doc = json.loads(raw) if raw else {}
+                except ValueError:
+                    continue  # malformed frame: never kill the store
+                if isinstance(doc, dict) and "points" in doc:
+                    try:
+                        self._ingest_series(src, doc)
+                    except Exception:
+                        # a garbled push field (non-numeric pidx or
+                        # offset from a version-skewed worker) costs
+                        # that frame only — never the responder
+                        pass
+                    continue  # pushes are fire-and-forget
+                try:
+                    self.ep.send(src, TAG_SERIES,
+                                 json.dumps(self.fleet_series()).encode())
+                except MPIError:
+                    pass  # client vanished between query and reply
+
+        self._series_thread = threading.Thread(
+            target=run, daemon=True, name="hnp-series")
+        self._series_thread.start()
+
+    def _ingest_series(self, src: int, doc: Dict[str, Any]) -> None:
+        pidx = int(doc.get("pidx", src - 1))
+        pts = [p for p in doc.get("points", ()) if isinstance(p, dict)]
+        with self._series_lock:
+            ent = self._fleet_series.setdefault(
+                pidx, {"points": [], "clock_offset_s": None,
+                       "last_push": None, "meta": {}})
+            ent["points"].extend(pts)
+            if len(ent["points"]) > SERIES_KEEP:
+                del ent["points"][:len(ent["points"]) - SERIES_KEEP]
+            if doc.get("clock_offset_s") is not None:
+                ent["clock_offset_s"] = float(doc["clock_offset_s"])
+            if isinstance(doc.get("meta"), dict):
+                ent["meta"] = doc["meta"]
+            ent["last_push"] = time.monotonic()
+
+    def fleet_series(self) -> Dict[str, Any]:
+        """The aggregated fleet document: per-pidx point rings with
+        each worker's clock offset (consumers correct ``t`` into the
+        HNP timebase by adding it) and the seconds since its last
+        push (staleness marker for the dashboard)."""
+        now = time.monotonic()
+        lock = getattr(self, "_series_lock", None)
+        if lock is None:
+            return {"procs": {}}
+        with lock:
+            return {"procs": {
+                str(pidx): {
+                    "points": list(ent["points"]),
+                    "clock_offset_s": ent["clock_offset_s"],
+                    "push_age_s": (round(now - ent["last_push"], 3)
+                                   if ent["last_push"] is not None
+                                   else None),
+                    "meta": dict(ent.get("meta") or {}),
+                }
+                for pidx, ent in sorted(self._fleet_series.items())
+            }}
+
     def kill_worker(self, node_id: int, code: int = 143) -> None:
         """Order a worker to exit via its die watcher (the odls kill
         path — reaches THE WORKER ITSELF even when it was launched
@@ -478,7 +567,7 @@ class HnpCoordinator:
         # process teardown/launch) and mutates Job state — shutdown
         # must wait for it, not race it with ep.close()
         for name, budget in (("_ps_thread", 2), ("_migrate_thread", 30),
-                             ("_clock_thread", 2)):
+                             ("_clock_thread", 2), ("_series_thread", 2)):
             t = getattr(self, name, None)
             if t is not None:
                 t.join(timeout=budget)
@@ -570,6 +659,8 @@ class WorkerAgent:
         # same discipline for clock ping-pongs (the dump path and an
         # operator SIGUSR1 can race a finalize-time sync)
         self._clock_lock = threading.Lock()
+        # and for series pushes (sampler tick vs finalize flush)
+        self._series_lock = threading.Lock()
 
     def run_modex(self, my_card: Dict[str, Any], *,
                   timeout_ms: int = 30_000) -> List[Dict[str, Any]]:
@@ -723,6 +814,32 @@ class WorkerAgent:
                 if best is None or rtt < best[1]:
                     best = (off, rtt)
         return best
+
+    # -- fleet series push (the continuous metrics plane) ------------------
+    def push_series(self, points, offset_s=None, meta=None) -> None:
+        """Fire-and-forget push of new sampler points to the HNP's
+        fleet store. The worker's process_index rides in the frame
+        (node ids and pidx differ by one), plus the current clock
+        offset so the HNP-side document is mergeable onto one
+        timeline and optional identity meta (rank span) so dashboards
+        can label rows. Raises MPIError when the lifeline is gone —
+        the sampler counts failures and stops trying."""
+        pidx = self.node_id - 1
+        doc = {"pidx": pidx, "points": list(points),
+               "clock_offset_s": offset_s}
+        if meta:
+            doc["meta"] = dict(meta)
+        with self._series_lock:
+            self.ep.send(0, TAG_SERIES, json.dumps(doc).encode())
+
+    def query_fleet_series(self, *, timeout_ms: int = 5_000) -> Dict:
+        """Ask the HNP for the aggregated fleet document (mostly for
+        tests; dashboards use tools.tpu_top.FleetClient)."""
+        with self._series_lock:
+            self.ep.send(0, TAG_SERIES, b"{}")
+            _, _, raw = self.ep.recv(tag=TAG_SERIES,
+                                     timeout_ms=timeout_ms)
+        return json.loads(raw)
 
     # -- health ------------------------------------------------------------
     def heartbeat(self) -> None:

@@ -142,6 +142,17 @@ class Runtime:
                     _obs.set_clock(off, rtt)
                 except Exception as e:
                     _log.verbose(1, f"obs clock sync skipped: {e}")
+            if _obs.enabled:
+                # arm the continuous pvar sampler (the fleet metrics
+                # plane) — no-op unless obs_sample_interval > 0, and
+                # the clock offset above is already in place so pushed
+                # series points merge onto the HNP timeline
+                try:
+                    from ..obs import sampler as _obs_sampler
+
+                    _obs_sampler.maybe_start(self)
+                except Exception as e:
+                    _log.verbose(1, f"obs sampler start skipped: {e}")
 
             # 3. mesh mapping
             self.mesh = mesh_mod.build_mesh(
@@ -290,13 +301,22 @@ class Runtime:
             from .. import obs as _obs
 
             if _obs.enabled:
-                # per-rank journal dump (obs_dump_dir) BEFORE the agent
-                # closes: the clock-offset estimate in its meta needs
-                # the live HNP link
+                # disarm the sampler FIRST (its final tick + push run
+                # over the still-live HNP link), then the per-rank
+                # journal + series dumps (obs_dump_dir) BEFORE the
+                # agent closes: the clock-offset estimate in their
+                # meta needs the live HNP link
+                try:
+                    from ..obs import sampler as _obs_sampler
+
+                    _obs_sampler.stop(final_push=True)
+                except Exception as e:
+                    _log.verbose(1, f"obs sampler stop failed: {e}")
                 try:
                     from ..obs import export as _obs_export
 
                     _obs_export.maybe_dump_rank_journal(self)
+                    _obs_export.maybe_dump_series(self)
                 except Exception as e:
                     _log.verbose(1, f"obs rank-journal dump failed: {e}")
             from ..comm import communicator as comm_mod

@@ -1,0 +1,271 @@
+"""tpu-bench-gate — make the perf trajectory trustworthy.
+
+The round records (``BENCH_r*.json``) are the repo's only longitudinal
+perf evidence, and until now nothing *read* them: a regression had to
+be noticed by a human diffing JSON, and rounds 4-5 silently lost all
+TPU metrics to backend-init failures. This tool closes the loop:
+
+1. parse every historical round's metric lines (the ``tail`` JSONL of
+   a driver round record, or a plain JSONL file from ``bench.py``);
+2. group lines by ``(metric, tier)`` — the tier label keeps
+   loopback-CPU fallback rounds from contaminating TPU noise fits;
+3. fit a robust noise bound per line (median ± sigma × MAD-scale,
+   floored at a relative band, because the measured HBM ceiling
+   wobbles ±20% session to session — see bench.py's ceiling notes);
+4. exit non-zero when the candidate round regresses past the bound in
+   the metric's *bad* direction (lower for bandwidths/speedups,
+   higher for latencies/wait times).
+
+Lines that are not comparable are skipped, never gated: null values,
+``unstable`` / ``partial_rounds`` / ``error`` markers, units with no
+known good direction, and metrics with fewer than ``--min-rounds``
+clean historical points.
+
+Usage::
+
+    # newest BENCH_r*.json is the candidate, the rest are history
+    python -m ompi_release_tpu.tools.tpu_bench_gate BENCH_r*.json
+
+    # explicit candidate (e.g. a fresh bench run's JSONL output)
+    python -m ompi_release_tpu.tools.tpu_bench_gate BENCH_r*.json \
+        --candidate fresh.jsonl
+
+``bench.py`` also runs :func:`evaluate` in-process at the end of every
+round against the on-disk history and emits a ``bench_gate`` metric
+line, so the round record itself says whether the round regressed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: units where bigger is better (bandwidths, throughputs, speedups)
+HIGHER_BETTER = {"GB/s", "TFLOP/s"}
+#: units where smaller is better (latencies, waits, message counts)
+LOWER_BETTER = {"s", "seconds", "us", "us/hop", "hol_wait_s",
+                "sends_at_root", "device_collectives"}
+
+DEFAULT_SIGMA = 4.0
+#: relative noise floor: the bench's own ceiling docs put single-run
+#: wobble at ±20%, so no fit may claim a tighter band than this
+DEFAULT_REL_FLOOR = 0.25
+DEFAULT_MIN_ROUNDS = 3
+
+
+def _direction(unit: Optional[str]) -> Optional[int]:
+    """+1 = higher is better, -1 = lower is better, None = no gate."""
+    if unit is None:
+        return None
+    if unit in HIGHER_BETTER or unit.startswith("x_"):
+        return 1
+    if unit in LOWER_BETTER:
+        return -1
+    return None
+
+
+def line_tier(line: Dict[str, Any]) -> str:
+    """The comparability tier of one metric line. ``tier_label`` is
+    authoritative (bench.py stamps it on every line); older rounds
+    only carried ``backend: cpu`` on fallback lines, so that maps to
+    the loopback tier and everything else counts as tpu."""
+    t = line.get("tier_label")
+    if t:
+        return str(t)
+    return "loopback-cpu" if line.get("backend") == "cpu" else "tpu"
+
+
+def gateable(line: Dict[str, Any]) -> bool:
+    """Only clean, complete, direction-known lines feed the fit/gate."""
+    if not isinstance(line, dict) or not line.get("metric"):
+        return False
+    if line.get("metric") in ("bench_error", "bench_gate"):
+        return False
+    v = line.get("value")
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        return False
+    if line.get("unstable") or line.get("error") \
+            or line.get("partial_rounds"):
+        return False
+    return _direction(line.get("unit")) is not None
+
+
+def parse_round_file(path: str) -> List[Dict[str, Any]]:
+    """Metric lines from one round record: a driver round JSON (the
+    ``tail`` field holds the bench's JSONL stdout) or a plain JSONL
+    file. Non-JSON lines (jax warnings) and event lines are skipped."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict) and isinstance(doc.get("tail"), str):
+            text = doc["tail"]
+        elif isinstance(doc, list):
+            return [ln for ln in doc
+                    if isinstance(ln, dict) and ln.get("metric")]
+        elif isinstance(doc, dict) and doc.get("metric"):
+            return [doc]
+    except ValueError:
+        pass  # plain JSONL
+    lines = []
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            obj = json.loads(raw)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and obj.get("metric"):
+            lines.append(obj)
+    return lines
+
+
+def _median(vals: Sequence[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def fit_bound(history: Sequence[float], *,
+              sigma: float = DEFAULT_SIGMA,
+              rel_floor: float = DEFAULT_REL_FLOOR
+              ) -> Tuple[float, float]:
+    """(median, allowed absolute deviation) from a metric's clean
+    history: ``sigma`` MAD-scales (MAD × 1.4826 ≈ a robust stddev),
+    with the TOTAL band floored at ``rel_floor × |median|`` — a
+    coincidentally-quiet history cannot produce a hair-trigger gate,
+    and a genuinely noisy line gets the wider statistical band. With
+    the defaults the band is at least ±25% (the bench's own
+    session-to-session wobble) so a 2× latency regression or a halved
+    bandwidth always trips while ±20% ceiling wobble never does."""
+    med = _median(history)
+    mad = _median([abs(v - med) for v in history])
+    return med, max(sigma * mad * 1.4826, rel_floor * abs(med))
+
+
+def evaluate(history_rounds: List[List[Dict[str, Any]]],
+             candidate_lines: List[Dict[str, Any]], *,
+             sigma: float = DEFAULT_SIGMA,
+             rel_floor: float = DEFAULT_REL_FLOOR,
+             min_rounds: int = DEFAULT_MIN_ROUNDS) -> Dict[str, Any]:
+    """Gate one candidate round against the history. Returns
+    ``{"checked", "skipped", "regressions": [...], "lines": [...]}``;
+    a regression entry names the metric, the fitted bound, and how far
+    past it the candidate landed."""
+    hist: Dict[Tuple[str, str], List[float]] = {}
+    for rnd in history_rounds:
+        for ln in rnd:
+            if gateable(ln):
+                hist.setdefault((ln["metric"], line_tier(ln)),
+                                []).append(float(ln["value"]))
+    checked = 0
+    skipped = 0
+    regressions: List[Dict[str, Any]] = []
+    detail: List[Dict[str, Any]] = []
+    for ln in candidate_lines:
+        if not gateable(ln):
+            skipped += 1
+            continue
+        key = (ln["metric"], line_tier(ln))
+        series = hist.get(key, [])
+        if len(series) < min_rounds:
+            skipped += 1
+            detail.append({"metric": key[0], "tier": key[1],
+                           "status": "no-history",
+                           "rounds": len(series)})
+            continue
+        med, dev = fit_bound(series, sigma=sigma, rel_floor=rel_floor)
+        v = float(ln["value"])
+        direction = _direction(ln.get("unit"))
+        checked += 1
+        if direction > 0:
+            bound, bad = med - dev, v < med - dev
+        else:
+            bound, bad = med + dev, v > med + dev
+        entry = {"metric": key[0], "tier": key[1], "value": v,
+                 "median": round(med, 6), "bound": round(bound, 6),
+                 "unit": ln.get("unit"), "rounds": len(series),
+                 "status": "REGRESSION" if bad else "ok"}
+        detail.append(entry)
+        if bad:
+            regressions.append(entry)
+    return {"checked": checked, "skipped": skipped,
+            "regressions": regressions, "lines": detail}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-bench-gate",
+        description="Fail (exit != 0) when the newest bench round "
+                    "regresses past fitted noise bounds of the "
+                    "BENCH_r*.json history")
+    ap.add_argument("files", nargs="*",
+                    help="round records, oldest..newest (default: "
+                         "./BENCH_r*.json sorted by name)")
+    ap.add_argument("--candidate", default=None,
+                    help="gate this file instead of the newest "
+                         "history round (e.g. a fresh bench JSONL)")
+    ap.add_argument("--sigma", type=float, default=DEFAULT_SIGMA,
+                    help=f"bound width in MAD-scales (default "
+                         f"{DEFAULT_SIGMA})")
+    ap.add_argument("--rel-floor", type=float,
+                    default=DEFAULT_REL_FLOOR,
+                    help="minimum relative noise band (default "
+                         f"{DEFAULT_REL_FLOOR} — the bench's own "
+                         "ceiling wobble)")
+    ap.add_argument("--min-rounds", type=int,
+                    default=DEFAULT_MIN_ROUNDS,
+                    help="history points required before a metric is "
+                         f"gated (default {DEFAULT_MIN_ROUNDS})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable verdict on stdout")
+    args = ap.parse_args(argv)
+
+    files = args.files or sorted(glob.glob("BENCH_r*.json"))
+    if not files:
+        print("tpu-bench-gate: no round records given and no "
+              "./BENCH_r*.json found", file=sys.stderr)
+        return 2
+    files = sorted(files)
+    if args.candidate is not None:
+        history, cand_path = files, args.candidate
+    else:
+        if len(files) < 2:
+            print("tpu-bench-gate: need at least 2 rounds (history + "
+                  "candidate)", file=sys.stderr)
+            return 2
+        history, cand_path = files[:-1], files[-1]
+    rounds = [parse_round_file(p) for p in history]
+    cand = parse_round_file(cand_path)
+    verdict = evaluate(rounds, cand, sigma=args.sigma,
+                       rel_floor=args.rel_floor,
+                       min_rounds=args.min_rounds)
+    if args.json:
+        print(json.dumps(verdict, indent=2))
+    else:
+        print(f"tpu-bench-gate: {len(history)} history round(s), "
+              f"candidate {os.path.basename(cand_path)}: "
+              f"{verdict['checked']} line(s) gated, "
+              f"{verdict['skipped']} skipped")
+        for e in verdict["lines"]:
+            if e.get("status") == "no-history":
+                continue
+            mark = "FAIL" if e["status"] == "REGRESSION" else "  ok"
+            print(f"  {mark} {e['metric']} [{e['tier']}]: "
+                  f"{e['value']:g} {e['unit']} vs median "
+                  f"{e['median']:g} (bound {e['bound']:g}, "
+                  f"{e['rounds']} rounds)")
+        if verdict["regressions"]:
+            print(f"tpu-bench-gate: {len(verdict['regressions'])} "
+                  "REGRESSION(S) past fitted noise bounds")
+    return 1 if verdict["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,8 +51,47 @@ def _cmd_merge(args) -> int:
 
 def _cmd_report(args) -> int:
     dumps = _doctor.load_dir(args.dir)
-    text, _ = _doctor.skew_report(dumps)
+    # series dumps ride the same obs_dump_dir: when present, the
+    # report annotates its critical path with sampled rates
+    try:
+        series = _doctor.load_series_dir(args.dir)
+    except (OSError, ValueError):
+        series = []
+    text, _ = _doctor.skew_report(dumps, series=series or None)
     print(text)
+    return 0
+
+
+def _cmd_series(args) -> int:
+    """Merge per-rank series dumps into ONE clock-corrected fleet
+    series — JSONL (one corrected point per line) or OpenMetrics."""
+    from ..obs import export as _export
+
+    docs = _doctor.load_series_dir(args.dir)
+    if not docs:
+        print(f"no series-p*.jsonl under {args.dir} (set --mca "
+              "obs_sample_interval > 0 and obs_dump_dir)",
+              file=sys.stderr)
+        return 1
+    merged = _doctor.merge_series(docs)
+    if args.openmetrics:
+        # ONE exposition over the merged, clock-corrected points:
+        # concatenating per-process pages would repeat/interleave
+        # family TYPE lines, which the OpenMetrics spec forbids
+        text = _export.openmetrics_series(
+            [dict(p, t=p["ts"]) for p in merged])
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        else:
+            print(text, end="")
+    else:
+        out = args.out or os.path.join(args.dir, "merged-series.jsonl")
+        with open(out, "w") as f:
+            for p in merged:
+                f.write(json.dumps(p) + "\n")
+        print(f"tpu-doctor: merged {len(docs)} rank series "
+              f"({len(merged)} clock-corrected points) -> {out}")
     return 0
 
 
@@ -136,9 +175,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=_cmd_merge)
 
     p = sub.add_parser("report", help="critical-path + rank-skew "
-                                      "report per collective round")
+                                      "report per collective round "
+                                      "(annotated with sampled rates "
+                                      "when series-p*.jsonl exist)")
     p.add_argument("dir")
     p.set_defaults(fn=_cmd_report)
+
+    p = sub.add_parser("series", help="merge per-rank continuous "
+                                      "series dumps into one "
+                                      "clock-corrected fleet series")
+    p.add_argument("dir", help="directory of series-p*.jsonl dumps "
+                               "(obs_sample_interval + obs_dump_dir)")
+    p.add_argument("-o", "--out", default=None,
+                   help="output path (default: "
+                        "<dir>/merged-series.jsonl)")
+    p.add_argument("--openmetrics", action="store_true",
+                   help="emit OpenMetrics-with-timestamps text "
+                        "instead of JSONL")
+    p.set_defaults(fn=_cmd_series)
 
     p = sub.add_parser("postmortem", help="summarize flight-recorder "
                                           "dumps: stuck ops + waiting "

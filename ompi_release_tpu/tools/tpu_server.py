@@ -45,23 +45,28 @@ _log = output.stream("tpu-server")
 
 TAG_METRICS = 13  # client->server: Prometheus pvar exposition request
 TAG_JOURNAL = 14  # client->server: obs rank-journal dump (JSON)
+TAG_SERIES = 15   # client->server: continuous pvar time-series (JSON)
 
 
 class MetricsPubsubTable(PubsubTable):
-    """Name table + two observability RPCs over the same
+    """Name table + three observability RPCs over the same
     seq-correlated reply channel: TAG_METRICS answers with the
     Prometheus text page of every pvar registered in this process;
     TAG_JOURNAL answers with this process's rank journal dump
     (``obs.export.rank_dump`` JSON) — the unit ``tpu-doctor collect``
-    fetches and ``tpu-doctor merge`` joins across ranks."""
+    fetches and ``tpu-doctor merge`` joins across ranks; TAG_SERIES
+    answers with this process's continuous sampler ring
+    (``obs.export.series_dump`` JSON) — identity + clock offset +
+    time-series points, the live feed ``tpu_top`` renders."""
 
     def __init__(self, ep) -> None:
         super().__init__(ep)
         self.serve_tags.append(TAG_METRICS)
         self.serve_tags.append(TAG_JOURNAL)
+        self.serve_tags.append(TAG_SERIES)
 
     def handle(self, tag: int, src: int, raw: bytes) -> None:
-        if tag not in (TAG_METRICS, TAG_JOURNAL):
+        if tag not in (TAG_METRICS, TAG_JOURNAL, TAG_SERIES):
             return super().handle(tag, src, raw)
         b = DssBuffer(raw)
         (seq,) = b.unpack_int64()
@@ -74,8 +79,9 @@ class MetricsPubsubTable(PubsubTable):
 
             from ..obs import export as obs_export
 
-            self._reply(src, seq, True,
-                        _json.dumps(obs_export.rank_dump()))
+            doc = (obs_export.rank_dump() if tag == TAG_JOURNAL
+                   else obs_export.series_dump())
+            self._reply(src, seq, True, _json.dumps(doc))
 
 
 class NameServer:
@@ -159,6 +165,17 @@ class NameClient:
         ok, text = self._rpc(TAG_JOURNAL, timeout_ms=timeout_ms)
         if not ok:
             raise MPIError(ErrorCode.ERR_NAME, f"journal: {text}")
+        return _json.loads(text)
+
+    def series(self, *, timeout_ms: int = 10_000) -> dict:
+        """The server process's continuous pvar time-series ring
+        (``{"meta": ..., "points": [...]}``) — the live feed behind
+        ``tpu_top`` and the doctor's series merge."""
+        import json as _json
+
+        ok, text = self._rpc(TAG_SERIES, timeout_ms=timeout_ms)
+        if not ok:
+            raise MPIError(ErrorCode.ERR_NAME, f"series: {text}")
         return _json.loads(text)
 
     def close(self) -> None:

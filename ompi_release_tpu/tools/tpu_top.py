@@ -1,21 +1,270 @@
-"""tpu-top — refresh-loop entry point (``orte-top`` analogue).
+"""tpu-top — live fleet dashboard (``orte-top`` analogue, grown up).
 
-Default mode is tpu_ps's snapshot machinery on a loop
-(``python -m ompi_release_tpu.tools.tpu_top [-d SECS]``). With
-``--metrics HOST:PORT`` it instead polls a ``tpu_server``'s metrics
-RPC and renders the live Prometheus pvar page — the observability
-plane's terminal UI.
+Three modes:
+
+- default: tpu_ps's per-rank process snapshot on a refresh loop
+  (``python -m ompi_release_tpu.tools.tpu_top [-d SECS]``).
+- ``--metrics HOST:PORT``: poll a ``tpu_server``'s metrics RPC and
+  render the live Prometheus pvar page. Survives server restarts: a
+  failed poll prints a stale-data marker and reconnects with backoff
+  instead of exiting.
+- ``--fleet [HOST:PORT]`` / ``--fleet-from DIR``: the continuous
+  metrics plane's dashboard. Renders one row per controller process
+  from the sampler's time-series points — collective rate, bytes/s,
+  latency percentiles (from the ``coll_*_latency`` histogram pvar
+  deltas), mean arrival skew, and inline STALL / STALE flags — either
+  live from a job HNP's TAG_SERIES store (discovered via the session
+  dir when no target is given) or offline from ``series-p*.jsonl``
+  dumps. The refresh loop reconnects with backoff and marks rows
+  stale rather than dying with the server.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import random
 import sys
 import time
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
+
+#: a proc whose newest push/sample is older than this many refresh
+#: delays is flagged STALE (its rank may be hung — or the sampler off)
+STALE_FACTOR = 3.0
+
+
+# ---------------------------------------------------------------------------
+# fleet summarization (pure — the testable core)
+# ---------------------------------------------------------------------------
+
+
+def summarize_points(points: List[Dict[str, Any]],
+                     window_s: float = 15.0,
+                     now: Optional[float] = None) -> Dict[str, Any]:
+    """Fold one process's sampler points (newest ``window_s`` seconds
+    of them) into the dashboard row: collective ops/s and MB/s from
+    the per-cid ``coll_ops``/``coll_bytes`` deltas, p50/p99 latency
+    from the ``coll_*_latency`` histogram delta buckets, mean skew
+    from ``coll_*_skew_seconds``, and a stall flag from
+    ``obs_stalls_detected`` deltas. ``now`` defaults to the newest
+    point's time (dump replay); pass the live clock for live feeds."""
+    from ..obs.sampler import percentile
+
+    if not points:
+        return {"ops_s": None, "mb_s": None, "p50_ms": None,
+                "p99_ms": None, "skew_ms": None, "stalls": 0,
+                "cids": [], "age_s": None, "window_s": 0.0}
+    ts = [float(p["t"]) for p in points]
+    t_new = max(ts)
+    if now is None:
+        now = t_new
+    lo = t_new - window_s
+    ops = bytes_ = 0.0
+    lat_buckets: Dict[float, float] = {}
+    skew_sum = skew_count = 0.0
+    stalls = 0.0
+    cids = set()
+    t_used = []
+    for p in points:
+        t = float(p["t"])
+        if t < lo:
+            continue
+        name = str(p.get("name", ""))
+        v = p.get("v")
+        t_used.append(t)
+        cid = int(p.get("cid", -1))
+        if name == "coll_ops":
+            ops += float(v or 0)
+            cids.add(cid)
+        elif name == "coll_bytes":
+            bytes_ += float(v or 0)
+        elif name.endswith("_latency") and isinstance(v, dict):
+            for ub, c in (v.get("buckets") or {}).items():
+                lat_buckets[float(ub)] = (lat_buckets.get(float(ub), 0.0)
+                                          + float(c))
+        elif name.endswith("_skew_seconds") and isinstance(v, dict):
+            skew_sum += float(v.get("sum", 0.0))
+            skew_count += float(v.get("count", 0.0))
+        elif name == "obs_stalls_detected":
+            stalls += float(v or 0)
+    # a window holding a single sampler tick has NO measurable span —
+    # rates are unknown then, not "whatever 1 ms would imply" (a lone
+    # 10-op tick must render '-', never 10000 coll/s)
+    distinct = sorted(set(t_used))
+    window = (distinct[-1] - distinct[0]
+              if len(distinct) >= 2 else None)
+    p50 = percentile(lat_buckets, 0.5)
+    p99 = percentile(lat_buckets, 0.99)
+    return {
+        "ops_s": ops / window if window else None,
+        "mb_s": bytes_ / window / 1e6 if window else None,
+        "p50_ms": p50 * 1e3 if p50 is not None else None,
+        "p99_ms": p99 * 1e3 if p99 is not None else None,
+        "skew_ms": (skew_sum / skew_count * 1e3) if skew_count else None,
+        "stalls": int(stalls),
+        "cids": sorted(c for c in cids if c >= 0),
+        "age_s": max(now - t_new, 0.0),
+        "window_s": window or 0.0,
+    }
+
+
+def _fmt(v, spec: str, dash: str = "-") -> str:
+    return dash if v is None else format(v, spec)
+
+
+def render_fleet(docs: List[Dict[str, Any]], window_s: float = 15.0,
+                 stale_after_s: Optional[float] = None) -> str:
+    """The per-rank dashboard table from per-process series docs
+    (``{"meta": {...}, "points": [...]}`` — offline dumps and the
+    live fleet query share this shape via
+    ``obs.doctor.fleet_to_series_docs``)."""
+    head = (f"  {'proc':>4} {'ranks':>9} {'coll/s':>8} {'MB/s':>9} "
+            f"{'p50 ms':>8} {'p99 ms':>8} {'skew ms':>8} "
+            f"{'cids':>6} flags")
+    lines = [head]
+    for d in docs:
+        m = d.get("meta") or {}
+        pidx = int(m.get("pidx", 0))
+        off0 = int(m.get("rank_offset", 0) or 0)
+        n = int(m.get("local_size", 0) or 0)
+        ranks = f"{off0}..{off0 + n - 1}" if n else "?"
+        s = summarize_points(list(d.get("points") or ()),
+                             window_s=window_s)
+        flags = []
+        if s["stalls"]:
+            flags.append(f"STALL×{s['stalls']}")
+        age = m.get("push_age_s")
+        if age is None:
+            age = s["age_s"]
+        if (stale_after_s is not None and age is not None
+                and age > stale_after_s):
+            flags.append(f"STALE {age:.0f}s")
+        lines.append(
+            f"  {pidx:>4} {ranks:>9} "
+            f"{_fmt(s['ops_s'], '8.1f'):>8} "
+            f"{_fmt(s['mb_s'], '9.2f'):>9} "
+            f"{_fmt(s['p50_ms'], '8.3f'):>8} "
+            f"{_fmt(s['p99_ms'], '8.3f'):>8} "
+            f"{_fmt(s['skew_ms'], '8.3f'):>8} "
+            f"{len(s['cids']):>6} {' '.join(flags)}".rstrip())
+    if len(lines) == 1:
+        lines.append("  (no series points yet — is obs_sample_interval "
+                     "set on the job?)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# live fleet query (TAG_SERIES against a job HNP)
+# ---------------------------------------------------------------------------
+
+
+class FleetClient:
+    """One-shot fleet-series query against a job's HNP (high random
+    client id, like PsClient — must not collide with worker ids)."""
+
+    def __init__(self, host: str, port: int,
+                 secret: Optional[str] = None) -> None:
+        from ..native import OobEndpoint
+
+        self.ep = OobEndpoint(
+            random.randrange(1 << 20, 1 << 30),
+            secret=secret.encode() if secret else None,
+        )
+        self.ep.connect(0, host, int(port))
+
+    def query(self, timeout_ms: int = 5_000) -> Dict:
+        from ..runtime.coordinator import TAG_SERIES
+
+        self.ep.send(0, TAG_SERIES, b"{}")
+        _, _, raw = self.ep.recv(tag=TAG_SERIES, timeout_ms=timeout_ms)
+        return json.loads(raw)
+
+    def close(self) -> None:
+        self.ep.close()
+
+
+def _fleet_targets(target: Optional[str]) -> List[Dict[str, Any]]:
+    if target:
+        host, port_s = target.rsplit(":", 1)
+        return [{"host": host, "port": int(port_s), "pid": "?"}]
+    from .tpu_ps import discover_jobs
+
+    return discover_jobs()
+
+
+def _fleet_frame(target: Optional[str], window_s: float,
+                 delay: float) -> str:
+    """One refresh of the live fleet view: query every target job's
+    HNP; a job that does not answer renders as unreachable instead of
+    killing the loop (the reconnect contract)."""
+    from ..obs.doctor import fleet_to_series_docs
+    from ..utils.errors import MPIError
+
+    chunks = []
+    for info in _fleet_targets(target):
+        label = (f"job (tpurun pid {info.get('pid', '?')}) "
+                 f"@ {info.get('host')}:{info.get('port')}")
+        client = None
+        try:
+            client = FleetClient(info["host"], info["port"],
+                                 secret=info.get("secret"))
+            fleet = client.query()
+        except (MPIError, OSError, ValueError) as e:
+            chunks.append(f"{label}\n  (HNP unreachable: {e}; "
+                          "retrying next refresh)")
+            continue
+        finally:
+            if client is not None:
+                client.close()
+        docs = fleet_to_series_docs(fleet)
+        chunks.append(label + "\n" + render_fleet(
+            docs, window_s=window_s,
+            stale_after_s=STALE_FACTOR * delay))
+    return ("\n\n".join(chunks) if chunks
+            else "no live tpurun jobs found")
+
+
+def _fleet_loop(target: Optional[str], delay: float, iterations: int,
+                window_s: float) -> int:
+    i = 0
+    try:
+        while True:
+            frame = _fleet_frame(target, window_s, delay)
+            sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty()
+                             else "")
+            print("tpu-top fleet  " + time.strftime("%H:%M:%S"))
+            print(frame)
+            sys.stdout.flush()
+            i += 1
+            if iterations and i >= iterations:
+                return 0
+            time.sleep(delay)
+    except KeyboardInterrupt:
+        return 0
+
+
+def fleet_from_dir(directory: str, window_s: float = 1e18) -> str:
+    """One offline frame from ``series-p*.jsonl`` dumps (the whole
+    sampled history by default) — the post-run view of the same table
+    the live loop renders."""
+    from ..obs.doctor import load_series_dir
+
+    docs = load_series_dir(directory)
+    if not docs:
+        return (f"no series-p*.jsonl under {directory} (run with "
+                "--mca obs_sample_interval 1 --mca obs_dump_dir DIR)")
+    return render_fleet(docs, window_s=window_s)
+
+
+# ---------------------------------------------------------------------------
+# pvar page mode (tpu_server metrics RPC) — with reconnect
+# ---------------------------------------------------------------------------
 
 
 def _metrics_loop(target: str, delay: float, iterations: int) -> int:
+    """Poll a tpu_server's Prometheus page on a loop. A dead/restarted
+    server does NOT end the loop: the last page re-renders with a
+    stale marker and the client reconnects with bounded backoff."""
     from ..utils.errors import MPIError
     from .tpu_server import NameClient
 
@@ -26,36 +275,60 @@ def _metrics_loop(target: str, delay: float, iterations: int) -> int:
         print(f"tpu-top: --metrics wants HOST:PORT, got {target!r}",
               file=sys.stderr)
         return 2
-    try:
-        client = NameClient(host, port)
-    except (MPIError, OSError) as e:
-        print(f"tpu-top: cannot reach tpu-server at {target}: {e}",
-              file=sys.stderr)
-        return 1
+    client: Optional[NameClient] = None
+    last_page: Optional[str] = None
+    last_ok: Optional[float] = None
+    backoff = delay
     i = 0
     try:
         while True:
-            page = client.metrics()
+            page = None
+            err = None
+            try:
+                if client is None:
+                    client = NameClient(host, port)
+                page = client.metrics()
+            except (MPIError, OSError) as e:
+                err = e
+                if client is not None:
+                    try:
+                        client.close()
+                    except Exception:
+                        pass
+                    client = None  # reconnect fresh next round
             sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty()
                              else "")
             # target stays out of the strftime format: a '%' in it
             # (IPv6 zone-id hosts) would expand or raise
             print("tpu-top pvars @ " + target + "  "
                   + time.strftime("%H:%M:%S"))
-            print(page, end="" if page.endswith("\n") else "\n")
+            if page is not None:
+                last_page, last_ok = page, time.monotonic()
+                backoff = delay
+                print(page, end="" if page.endswith("\n") else "\n")
+            else:
+                age = (time.monotonic() - last_ok
+                       if last_ok is not None else None)
+                print(f"  [STALE — server unreachable: {err}; "
+                      + (f"showing data from {age:.0f}s ago; "
+                         if age is not None else "no data yet; ")
+                      + f"reconnecting in {backoff:.0f}s]")
+                if last_page is not None:
+                    print(last_page,
+                          end="" if last_page.endswith("\n") else "\n")
             sys.stdout.flush()
             i += 1
             if iterations and i >= iterations:
-                return 0
-            time.sleep(delay)
+                return 0 if page is not None or last_page is not None \
+                    else 1
+            time.sleep(backoff if page is None else delay)
+            if page is None:
+                backoff = min(backoff * 2, 30.0)
     except KeyboardInterrupt:
         return 0
-    except (MPIError, OSError) as e:
-        print(f"tpu-top: metrics query to {target} failed: {e}",
-              file=sys.stderr)
-        return 1
     finally:
-        client.close()
+        if client is not None:
+            client.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -63,17 +336,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--metrics", default=None,
                     help="render a tpu-server's live pvar page "
                          "(host:port) instead of job snapshots")
+    ap.add_argument("--fleet", nargs="?", const="", default=None,
+                    help="live per-rank collective-rate dashboard from "
+                         "a job HNP's series store (host:port; no "
+                         "argument = discover local jobs)")
+    ap.add_argument("--fleet-from", default=None, metavar="DIR",
+                    help="render one fleet frame from series-p*.jsonl "
+                         "dumps in DIR (post-run view)")
     args, rest = ap.parse_known_args(argv)
-    if args.metrics is None:
+    if args.fleet_from is not None:
+        print(fleet_from_dir(args.fleet_from))
+        return 0
+    if args.metrics is None and args.fleet is None:
         from .tpu_ps import main_top
 
         return main_top(rest)
-    mp = argparse.ArgumentParser(prog="tpu-top --metrics HOST:PORT")
+    mp = argparse.ArgumentParser(
+        prog="tpu-top --metrics/--fleet")
     mp.add_argument("-d", "--delay", type=float, default=2.0,
                     help="refresh interval in seconds")
     mp.add_argument("--iterations", type=int, default=0,
                     help="stop after N refreshes (0 = until SIGINT)")
+    mp.add_argument("--window", type=float, default=15.0,
+                    help="rate window in seconds (fleet mode)")
     ma = mp.parse_args(rest)
+    if args.fleet is not None:
+        return _fleet_loop(args.fleet or None, ma.delay,
+                           ma.iterations, ma.window)
     return _metrics_loop(args.metrics, ma.delay, ma.iterations)
 
 

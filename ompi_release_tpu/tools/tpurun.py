@@ -821,6 +821,9 @@ class Job:
             # perf_counter offset to OUR clock, so tpu-doctor can merge
             # per-rank journals onto one timeline
             self.hnp.start_clock_responder()
+            # fleet series store: workers push continuous pvar deltas
+            # (obs_sample_interval), tpu_top --fleet queries them live
+            self.hnp.start_series_responder()
             self._write_contact_file()
             if self.on_failure == "restart":
                 # a respawned worker re-runs its full ESS wire-up

@@ -1,11 +1,14 @@
 """Static hot-path observability discipline for the new coll engines,
-the wire transport, and the cross-process tracing layer.
+the wire transport, the cross-process tracing layer, and the
+continuous sampler itself.
 
 ``coll/pipeline.py``, ``coll/fusion.py``, ``runtime/wire.py``,
-``coll/hier.py``, ``osc/wire_win.py``, ``p2p/pml.py``, and
-``btl/components.py`` sit on hot paths (the wire router is EVERY
-cross-process byte); PR 1's contract is that observability costs ONE
-attribute check (``_obs.enabled`` / ``_watchdog.enabled``) when off.
+``coll/hier.py``, ``osc/wire_win.py``, ``p2p/pml.py``,
+``btl/components.py``, and ``obs/sampler.py`` sit on hot paths (the
+wire router is EVERY cross-process byte; the sampler's disabled state
+must cost literally nothing); PR 1's contract is that observability
+costs ONE attribute check (``_obs.enabled`` / ``_watchdog.enabled``)
+when off.
 This test enforces it statically, without importing jax: every emit
 site (journal ``record``, skew ``begin/body/end``, stall-watchdog
 ``arm``/``disarm``, per-call pvar registry lookups) must be gated on
@@ -32,7 +35,8 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/coll/hier_schedules.py",
            "ompi_release_tpu/osc/wire_win.py",
            "ompi_release_tpu/p2p/pml.py",
-           "ompi_release_tpu/btl/components.py")
+           "ompi_release_tpu/btl/components.py",
+           "ompi_release_tpu/obs/sampler.py")
 
 #: attribute calls that ARE emit sites when ungated
 EMIT_ATTRS = {"record", "begin", "body", "end", "arm"}
