@@ -391,24 +391,15 @@ class _HierModule:
         """Linear inter-process exchange: send every peer its arrays,
         then receive the same count back from each peer (all sends
         land before any recv parks — deadlock-free for the linear
-        pattern). Receives reap in arrival order unless
-        ``wire_overlap_exchange`` pins the sequential baseline."""
+        pattern). One thin shim over the exchange adapter — the SINGLE
+        round-advancing code path, shared with every schedule — which
+        owns the overlap/sequential split (``wire_overlap_exchange``)
+        and all pvar/flow/watchdog accounting."""
         sends = {p: [np.asarray(a) for a in arrs_for.get(p, [])]
                  for p in self.peers}
-        if not self._overlap():
-            for p in self.peers:
-                for a in sends[p]:
-                    self._send(p, a)
-            got_seq: Dict[int, list] = {}
-            for p in self.peers:
-                got_seq[p] = [self._recv(p)
-                              for _ in range(len(sends[p]))]
-            return got_seq
-        self._send_all(sends)
-        got: Dict[int, list] = {p: [] for p in self.peers}
-        self._reap({p: len(sends[p]) for p in self.peers},
-                   lambda src, arr: got[src].append(arr))
-        return got
+        got = self._xchg.exchange(
+            sends, {p: len(sends[p]) for p in self.peers})
+        return {p: got.get(p, []) for p in self.peers}
 
     def _check_local_axis(self, x, what: str) -> None:
         if not hasattr(x, "shape") or x.ndim == 0 \
@@ -756,13 +747,9 @@ class _HierModule:
                 val = _hs.bcast_binomial(self._xchg, self.procs, me,
                                          owner, val)
         elif owner == me:
-            if self._overlap():
-                self._send_all({p: [val] for p in self.peers})
-            else:
-                for p in self.peers:
-                    self._send(p, val)
+            self._xchg.exchange({p: [val] for p in self.peers}, {})
         else:
-            val = self._recv(owner)
+            val = self._xchg.exchange({}, {owner: 1})[owner][0]
         return self._bcast_local_axis(val)
 
     def _bcast_leader(self, owner: int, val):
@@ -861,21 +848,16 @@ class _HierModule:
                     rows[r] = pblock[pos]
         else:
             if owner != me:
-                self._send(owner, block)
+                self._xchg.exchange({owner: [block]}, {})
                 return jnp.zeros((self.local_n,) + full_shape,
                                  block.dtype)
             for pos, r in enumerate(self.members_of[me]):
                 rows[r] = block[pos]
-
-            def place(p: int, pblock: np.ndarray) -> None:
+            got = self._xchg.exchange({}, {p: 1 for p in self.peers})
+            for p in self.peers:
+                pblock = np.asarray(got[p][0])
                 for pos, r in enumerate(self.members_of[p]):
                     rows[r] = pblock[pos]
-
-            if self._overlap():
-                self._reap({p: 1 for p in self.peers}, place)
-            else:
-                for p in self.peers:
-                    place(p, self._recv(p))
         full = self._cat([rows[r] for r in range(comm.size)])
         out = np.zeros((self.local_n,) + full.shape, full.dtype)
         out[self.local_ranks.index(root)] = full
@@ -922,15 +904,12 @@ class _HierModule:
                 shape = (self.local_n,) + tuple(int(s) for s in meta)
                 mine = np.asarray(flat).reshape(shape)
         elif owner == me:
-            if self._overlap():
-                self._send_all({p: [chunks[self.members_of[p]]]
-                                for p in self.peers})
-            else:
-                for p in self.peers:
-                    self._send(p, chunks[self.members_of[p]])
+            self._xchg.exchange({p: [chunks[self.members_of[p]]]
+                                 for p in self.peers}, {})
             mine = chunks[self.members_of[me]]
         else:
-            mine = self._recv(owner)  # (local_n, chunk...)
+            # (local_n, chunk...)
+            mine = self._xchg.exchange({}, {owner: 1})[owner][0]
         return jnp.asarray(mine)
 
     def alltoall(self, comm, x):
@@ -1101,22 +1080,13 @@ class _HierModule:
         rows: Dict[int, np.ndarray] = {
             r: bufs[pos] for pos, r in enumerate(self.local_ranks)
         }
-        if self._overlap():
-            self._send_all({p: list(bufs) for p in self.peers})
-            slots = {p: list(self.members_of[p]) for p in self.peers}
-
-            def place(p: int, arr: np.ndarray) -> None:
-                rows[slots[p].pop(0)] = arr
-
-            self._reap({p: len(self.members_of[p])
-                        for p in self.peers}, place)
-            return rows
+        got = self._xchg.exchange(
+            {p: list(bufs) for p in self.peers},
+            {p: len(self.members_of[p]) for p in self.peers})
         for p in self.peers:
-            for b in bufs:
-                self._send(p, b)
-        for p in self.peers:
-            for r in self.members_of[p]:
-                rows[r] = self._recv(p)
+            # per-peer FIFO keeps member order under arrival reaping
+            for r, arr in zip(self.members_of[p], got[p]):
+                rows[r] = np.asarray(arr)
         return rows
 
     def allgatherv(self, comm, sendbufs):
@@ -1140,24 +1110,18 @@ class _HierModule:
         bufs = self._ragged_local(sendbufs, "gatherv")
         owner = self.owner[root]
         if owner != self.my_pidx:
-            for b in bufs:
-                self._send(owner, b)
+            self._xchg.exchange({owner: list(bufs)}, {})
             from .base import NO_RESULT
 
             return NO_RESULT  # recv buffer undefined off-root
         rows: Dict[int, np.ndarray] = {
             r: bufs[pos] for pos, r in enumerate(self.local_ranks)
         }
-        if self._overlap():
-            slots = {p: list(self.members_of[p]) for p in self.peers}
-            self._reap(
-                {p: len(self.members_of[p]) for p in self.peers},
-                lambda p, arr: rows.__setitem__(slots[p].pop(0), arr),
-            )
-        else:
-            for p in self.peers:
-                for r in self.members_of[p]:
-                    rows[r] = self._recv(p)
+        got = self._xchg.exchange(
+            {}, {p: len(self.members_of[p]) for p in self.peers})
+        for p in self.peers:
+            for r, arr in zip(self.members_of[p], got[p]):
+                rows[r] = np.asarray(arr)
         return jnp.asarray(np.concatenate([rows[r] for r in range(n)]))
 
     def scatterv(self, comm, sendbuf, counts, root: int):
@@ -1175,8 +1139,8 @@ class _HierModule:
             )
         owner = self.owner[root]
         if owner != self.my_pidx:
-            return [jnp.asarray(self._recv(owner))
-                    for _ in self.local_ranks]
+            got = self._xchg.exchange({}, {owner: self.local_n})
+            return [jnp.asarray(a) for a in got[owner]]
         buf = np.asarray(sendbuf).reshape(-1)
         from .driver import _check_no_narrowing
 
@@ -1189,13 +1153,8 @@ class _HierModule:
             )
         offs = np.concatenate([[0], np.cumsum(counts)])
         chunks = [buf[offs[j]:offs[j] + counts[j]] for j in range(n)]
-        if self._overlap():
-            self._send_all({p: [chunks[j] for j in self.members_of[p]]
-                            for p in self.peers})
-        else:
-            for p in self.peers:
-                for j in self.members_of[p]:
-                    self._send(p, chunks[j])
+        self._xchg.exchange({p: [chunks[j] for j in self.members_of[p]]
+                             for p in self.peers}, {})
         return [jnp.asarray(chunks[j]) for j in self.local_ranks]
 
     def reduce_scatter(self, comm, x, recvcounts, op: Op):

@@ -48,11 +48,6 @@ _comm_registry: Dict[int, "Communicator"] = {}
 
 _comm_count = pvar.counter("comm_active_count", "live communicators")
 
-#: set on a spanning comm's progress-worker thread so collectives
-#: nested inside a worker-run operation execute directly instead of
-#: re-submitting to (and deadlocking on) the same single worker
-_nbc_tls = threading.local()
-
 #: serializes lazy FusionBuffer creation (comm.fusion_buffer): the
 #: buffer itself is thread-safe, so first use may race — an orphaned
 #: second instance would silently escape free()'s drain
@@ -151,11 +146,6 @@ class Communicator:
             self.c_coll = coll_base.comm_select(self)
         else:
             self.c_coll = {}
-
-        # nonblocking-progress worker for spanning comms (created on
-        # first i-collective; one worker => posting order preserved)
-        self._nbc_guard = threading.Lock()
-        self._nbc_exec = None
 
         _comm_registry[self.cid] = self
         _comm_count.add()
@@ -261,15 +251,16 @@ class Communicator:
             # lost handle
             fb.flush()
             self._fusion_buffer = None
-        if self._nbc_exec is not None:
+        if self.spans_processes:
             # outstanding i-collectives must drain FIRST — before the
             # _on_free hooks free the hier shadow comm and the cid
             # leaves the registry, both of which a mid-flight spanning
             # collective still uses (MPI_Comm_free after pending
             # nonblocking ops is erroneous; draining turns it into a
             # late completion, not a crash)
-            self._nbc_exec.shutdown(wait=True)
-            self._nbc_exec = None
+            from ..coll import nbc as _nbc
+
+            _nbc.drain_comm(self)
         for kv_id, value in list(self._attrs.items()):
             kv = _keyval_table.get(kv_id)
             if kv and kv.delete_fn:
@@ -412,35 +403,44 @@ class Communicator:
             )
         if not self.spans_processes:
             return fn
-        # spanning comms: EVERY collective funnels through the one
-        # progress worker so blocking and nonblocking calls execute in
-        # posting order on every process — their wire exchanges share
-        # one per-cid channel, and two concurrently-running collectives
-        # would interleave frames on it
-        return lambda comm_, *a, **k: self._run_serialized(
-            fn, comm_, *a, **k)
+        # spanning comms: EVERY collective — blocking or not — goes
+        # through the async progress engine as "post schedule + wait",
+        # so blocking and nonblocking calls execute in posting order on
+        # every process (their wire exchanges share one per-cid
+        # channel, and two concurrently-running collectives would
+        # interleave frames on it) and there is ONE round-advancing
+        # code path (coll/nbc + runtime/progress)
+        from ..coll import nbc as _nbc
 
-    def _on_worker(self, fn, *args, **kw):
-        _nbc_tls.comm = self  # the worker serves exactly this comm
-        return fn(*args, **kw)
+        return lambda comm_, *a, **k: _nbc.run_blocking(
+            self, op_name, fn, (comm_,) + a, k)
 
     def _run_serialized(self, fn, *args, **kw):
-        """Run a collective through the comm's single progress worker
-        (direct when already on it — nested collectives inside a
-        worker-run op, e.g. the barrier closing a two-phase IO)."""
-        if not self.spans_processes \
-                or getattr(_nbc_tls, "comm", None) is self:
+        """Run ``fn`` in the comm's collective posting order, blocking
+        (the two-phase collective-IO path): fire + wait through the
+        progress engine on spanning comms, a direct call otherwise."""
+        if not self.spans_processes:
             return fn(*args, **kw)
-        return self._nbc_pool().submit(
-            self._on_worker, fn, *args, **kw).result()
+        from ..coll import nbc as _nbc
+
+        return _nbc.run_blocking(
+            self, getattr(fn, "__name__", "serialized"), fn, args, kw)
 
     def _submit_serialized(self, fn, *args, **kw):
-        """Nonblocking variant of :meth:`_run_serialized`: returns a
-        Request backed by the worker future."""
-        from ..request.request import from_future
+        """Nonblocking run of ``fn`` in the comm's collective posting
+        order (the nonblocking collective-IO path): returns a Request
+        backed by a schedule posted to the progress engine."""
+        from ..coll import nbc as _nbc
 
-        return from_future(self._nbc_pool().submit(
-            self._on_worker, fn, *args, **kw))
+        return _nbc.submit(self, getattr(fn, "__name__", "serialized"),
+                           fn, args, kw)
+
+    def _async(self, value):
+        """Wrap already-dispatched future arrays as a Request (XLA
+        async dispatch is the round schedule; see coll/nbc)."""
+        from ..coll import nbc as _nbc
+
+        return _nbc.async_request(value)
 
     def allreduce(self, x, op=None, **kw):
         from .. import ops as ops_mod
@@ -543,86 +543,111 @@ class Communicator:
             self, x, recvcounts, op or ops_mod.SUM
         )
 
-    # -- nonblocking collectives (libnbc analogue) -------------------------
-    # XLA dispatch is already asynchronous: invoking the compiled
-    # collective returns immediately with arrays that are futures, so a
-    # nonblocking collective is the blocking call's result wrapped in a
-    # Request whose readiness is the arrays' readiness (the libnbc
-    # round-schedule becomes the compiled program itself).
-    def _async(self, value):
-        import jax
+    # -- nonblocking collectives (libnbc analogue; coll/nbc.py) ------------
+    # In-process comms: XLA dispatch is already asynchronous — the
+    # compiled program IS the libnbc round schedule, and the Request
+    # wraps its future arrays. Spanning comms: the whole schedule posts
+    # to the async progress engine (runtime/progress.py) — dispatch
+    # returns before any wire traffic; execution happens in posting
+    # order, at wait() (polling mode) or off the caller on the
+    # dedicated progress thread (``progress_thread`` cvar).
+    def _icoll(self, name: str, *args, **kw):
+        from ..coll import nbc as _nbc
 
-        from ..request.request import Request
-
-        arrs = [a for a in jax.tree.leaves(value) if hasattr(a, "is_ready")]
-        req = Request(
-            ready_fn=lambda: all(a.is_ready() for a in arrs),
-            block_fn=lambda: jax.block_until_ready(value),
-        )
-        req.value = value
-        return req
-
-    def _async_call(self, fn, *args, **kw):
-        """Nonblocking collective dispatch. In-process comms: XLA
-        dispatch is already async, so call now and wrap the future
-        arrays (the compiled program IS the libnbc round schedule).
-        SPANNING comms: the hier collective's OOB exchanges block, so
-        run the whole call on the comm's nonblocking-progress worker
-        (the ``NBC_Progress`` thread analogue,
-        ``ompi/mca/coll/libnbc/nbc.c:310``) — the i-call returns
-        immediately and overlaps with user compute. ONE worker per
-        comm: outstanding collectives progress in posting order, which
-        preserves the same-order-on-every-rank collective contract
-        across processes."""
-        if not self.spans_processes:
-            return self._async(fn(*args, **kw))
-        return self._submit_serialized(fn, *args, **kw)
-
-    def _nbc_pool(self):
-        from concurrent.futures import ThreadPoolExecutor
-
-        with self._nbc_guard:
-            if self._nbc_exec is None:
-                self._nbc_exec = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix=f"nbc-{self.name}"
-                )
-            return self._nbc_exec
+        return _nbc.icoll(self, name, args, kw)
 
     def iallreduce(self, x, op=None, **kw):
-        return self._async_call(self.allreduce, x, op, **kw)
+        from .. import ops as ops_mod
+
+        return self._icoll("allreduce", x, op or ops_mod.SUM, **kw)
 
     def ireduce(self, x, op=None, root: int = 0, **kw):
-        return self._async_call(self.reduce, x, op, root, **kw)
+        from .. import ops as ops_mod
+
+        return self._icoll("reduce", x, op or ops_mod.SUM, root, **kw)
 
     def ibcast(self, x, root: int = 0, **kw):
-        return self._async_call(self.bcast, x, root, **kw)
+        return self._icoll("bcast", x, root, **kw)
 
     def iallgather(self, x, **kw):
-        return self._async_call(self.allgather, x, **kw)
+        return self._icoll("allgather", x, **kw)
 
     def igather(self, x, root: int = 0, **kw):
-        return self._async_call(self.gather, x, root, **kw)
+        return self._icoll("gather", x, root, **kw)
 
     def iscatter(self, x, root: int = 0, **kw):
-        return self._async_call(self.scatter, x, root, **kw)
+        return self._icoll("scatter", x, root, **kw)
 
     def ireduce_scatter_block(self, x, op=None, **kw):
-        return self._async_call(self.reduce_scatter_block, x, op, **kw)
+        from .. import ops as ops_mod
+
+        return self._icoll("reduce_scatter_block", x,
+                           op or ops_mod.SUM, **kw)
+
+    def ireduce_scatter(self, x, recvcounts, op=None):
+        from .. import ops as ops_mod
+
+        return self._icoll("reduce_scatter", x, recvcounts,
+                           op or ops_mod.SUM)
 
     def ialltoall(self, x, **kw):
-        return self._async_call(self.alltoall, x, **kw)
+        return self._icoll("alltoall", x, **kw)
 
     def iscan(self, x, op=None, **kw):
-        return self._async_call(self.scan, x, op, **kw)
+        from .. import ops as ops_mod
+
+        return self._icoll("scan", x, op or ops_mod.SUM, **kw)
 
     def iexscan(self, x, op=None, **kw):
-        return self._async_call(self.exscan, x, op, **kw)
+        from .. import ops as ops_mod
+
+        return self._icoll("exscan", x, op or ops_mod.SUM, **kw)
 
     def ialltoallv(self, sendbufs, sendcounts):
-        return self._async_call(self.alltoallv, sendbufs, sendcounts)
+        return self._icoll("alltoallv", sendbufs, sendcounts)
 
     def iallgatherv(self, sendbufs):
-        return self._async_call(self.allgatherv, sendbufs)
+        return self._icoll("allgatherv", sendbufs)
+
+    # -- persistent collectives (MPI-4 *_init; coll/nbc.persistent) --------
+    # The plan — resolved dispatch entry, op object, bound buffers —
+    # is built ONCE here; Request.start() fires it against the
+    # buffers' CURRENT contents each time without blocking (compiled
+    # programs / fusion plans are cached, so starts after the first
+    # fire cached plans).
+    def allreduce_init(self, x, op=None, **kw):
+        from .. import ops as ops_mod
+        from ..coll import nbc as _nbc
+
+        return _nbc.persistent(self, "allreduce",
+                               (x, op or ops_mod.SUM), kw)
+
+    def bcast_init(self, x, root: int = 0, **kw):
+        from ..coll import nbc as _nbc
+
+        return _nbc.persistent(self, "bcast", (x, root), kw)
+
+    def allgather_init(self, x, **kw):
+        from ..coll import nbc as _nbc
+
+        return _nbc.persistent(self, "allgather", (x,), kw)
+
+    def reduce_scatter_init(self, x, recvcounts, op=None):
+        from .. import ops as ops_mod
+        from ..coll import nbc as _nbc
+
+        return _nbc.persistent(self, "reduce_scatter",
+                               (x, recvcounts, op or ops_mod.SUM))
+
+    def alltoall_init(self, x, **kw):
+        from ..coll import nbc as _nbc
+
+        return _nbc.persistent(self, "alltoall", (x,), kw)
+
+    def barrier_init(self):
+        from ..coll import nbc as _nbc
+
+        return _nbc.persistent(self, "barrier", ())
 
     def ibarrier(self):
         """Nonblocking barrier that really is nonblocking: the
@@ -630,18 +655,20 @@ class Communicator:
         returned request's readiness is the dispatch's readiness (the
         reference's libnbc round schedule, ``nbc.c``, becomes the
         compiled program; XLA async dispatch is the progress engine).
-        Providers without an async dispatch path run the blocking
-        barrier on a completion thread instead — either way ibarrier
-        returns before the barrier completes."""
+        Spanning comms post the barrier schedule to the progress
+        engine — an ibarrier posted between two iallreduces keeps its
+        posting-order slot across every process. Providers without an
+        async dispatch path run the blocking barrier on a completion
+        thread instead — either way ibarrier returns before the
+        barrier completes."""
         self._check_alive()
+        from ..coll import nbc as _nbc
+
+        if self.spans_processes:
+            return _nbc.icoll(self, "barrier", ())
         fn = self.c_coll.get("ibarrier")
         if fn is not None:
-            return self._async(fn(self))
-        if self.spans_processes:
-            # same single progress worker as the other i-collectives:
-            # an ibarrier posted between two iallreduces keeps its
-            # posting-order slot across every process
-            return self._submit_serialized(self.barrier)
+            return _nbc.async_request(fn(self))
 
         import threading
 

@@ -687,15 +687,33 @@ class Window:
                 self._data, codes_a, targets_a, payloads, compares,
                 indices
             )
+        # Complete read requests from ONE host copy of the outputs.
+        # ``reads[i]`` on the sharded program output would dispatch an
+        # eager multi-device gather OUTSIDE _dispatch_lock; a
+        # concurrent thread's compiled epoch program then deadlocks
+        # jaxlib's cross-program collective rendezvous — each program
+        # holds a subset of the per-device threads and neither can
+        # assemble its full set (flight-recorder stacks during
+        # test_shmem_topo's lock-contention hang pinned one thread in
+        # apply_primitive(gather) at this line with two run_ids parked
+        # at the rendezvous). Device work stays exclusively under
+        # _dispatch_lock; the host fetch is per-shard copies, not a
+        # program, and epochs with no read requests skip it entirely.
+        reads_np = None
         for i, p in enumerate(todo):
             if p.request is not None:
-                value = reads[i]
+                if reads_np is None:
+                    import numpy as _np
+
+                    reads_np = _np.asarray(reads)
+                value = reads_np[i]
                 if p.index is not None:
                     # single-element op: hand back the element itself
                     value = value.reshape(-1)[p.index]
                 src = (p.target if p.status_rank is None
                        else p.status_rank)
-                p.request.complete(value=value, status=Status(source=src))
+                p.request.complete(value=jnp.asarray(value),
+                                   status=Status(source=src))
         self._data = new_data
 
 

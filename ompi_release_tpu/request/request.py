@@ -25,6 +25,32 @@ from ..utils.errors import ErrorCode, MPIError
 
 _req_count = pvar.counter("requests_created", "requests ever created")
 
+#: shared progress hooks (the async progress engine's tick lands here,
+#: registered by runtime/progress.py at import): ONE call advances
+#: every pending request an engine owns — wire-channel reaps, ready
+#: in-process arrays, queued schedule completions — so the multi-
+#: request operations below tick once per pass instead of spinning
+#: per-request, and a bare wait() drives the engine instead of
+#: sleeping blind.
+_progress_hooks: List[Callable[[], int]] = []
+
+
+def register_progress_hook(fn: Callable[[], int]) -> None:
+    """Register an engine tick (idempotent by identity). The hook must
+    be nonblocking and return how many items progressed."""
+    if fn not in _progress_hooks:
+        _progress_hooks.append(fn)
+
+
+def run_progress() -> int:
+    """Run every registered engine tick once; returns total items
+    progressed. THE shared hook wait_all/test_all/wait_any and
+    from_future-backed waits call between completion checks."""
+    n = 0
+    for fn in list(_progress_hooks):
+        n += int(fn() or 0)
+    return n
+
 
 class RequestState(enum.Enum):
     INACTIVE = "inactive"  # persistent request not started
@@ -122,6 +148,19 @@ class Request:
         self._persistent_start(self)
         return self
 
+    def poll(self) -> bool:
+        """Nonblocking readiness check used by the progress engine's
+        tick: completes the request if its async device work finished.
+        Unlike test(), never invokes progress_fn (the engine IS the
+        caller — recursing into its own tick would be a no-op)."""
+        if self.state is RequestState.COMPLETE:
+            return True
+        if self.state is not RequestState.ACTIVE:
+            return False
+        if self._ready_fn is not None and self._ready_fn():
+            self.complete()
+        return self.state is RequestState.COMPLETE
+
     def test(self) -> Tuple[bool, Optional[Status]]:
         if self.state is RequestState.INACTIVE:
             return True, None  # MPI: inactive tests as complete/empty
@@ -184,24 +223,49 @@ def from_future(fut) -> Request:
     completes with the future's value; failure surfaces the worker's
     exception at test()/wait() (the libnbc error-on-progress
     contract). Shared by the nonblocking-IO pool
-    (``io/file.py:_future_request`` adds its count Status on top) and
-    the spanning-comm nonblocking collectives."""
+    (``io/file.py:_future_request`` adds its count Status on top).
+    A bare wait() DRIVES the shared progress hook between bounded
+    future polls — the engine keeps advancing other in-flight work
+    (wire reaps, queued schedules) instead of this thread sleeping the
+    whole wait out."""
+    from concurrent.futures import TimeoutError as _FutTimeout
+
     completed = threading.Event()
 
     def block() -> None:
-        fut.result()  # raises the worker's exception
+        # poll cadence adapts to whether the engine actually has work:
+        # ticks that advance something keep the tight 5 ms cadence;
+        # an idle engine backs off to 100 ms so a long IO wait sleeps
+        # in fut.result() instead of burning CPU on empty ticks
+        delay = 0.005
+        while True:
+            progressed = run_progress()
+            try:
+                fut.result(timeout=delay)  # raises worker's exception
+                break
+            except _FutTimeout:
+                # the future may have SETTLED during this poll slice
+                # (and on 3.11+ concurrent.futures.TimeoutError IS
+                # builtin TimeoutError, so a done future re-raising a
+                # worker TimeoutError looks identical to the slice
+                # elapsing): loop — result() on a done future returns
+                # the value or raises the WORKER's own exception
+                # immediately, never the poll timeout
+                if fut.done():
+                    continue
+                delay = 0.005 if progressed else min(delay * 2, 0.1)
         # Future.set_result wakes result() BEFORE running done
         # callbacks: wait until the callback has completed the
         # request, or wait()'s bare complete() would win the race and
         # report value=None for a successful op
         completed.wait()
 
-    req = Request(
-        progress_fn=lambda r: (_raise(fut.exception())
-                               if fut.done() and fut.exception()
-                               else None),
-        block_fn=block,
-    )
+    def progress(r) -> None:
+        run_progress()
+        if fut.done() and fut.exception():
+            _raise(fut.exception())
+
+    req = Request(progress_fn=progress, block_fn=block)
 
     def _done(f) -> None:
         if f.exception() is None:
@@ -254,17 +318,25 @@ def wait(req: Request) -> Status:
 
 
 def test_all(reqs: Sequence[Request]) -> Tuple[bool, Optional[List[Status]]]:
+    # ONE shared tick first: a single engine pass reaps every pending
+    # request's progress; then each test() is a cheap completion check
+    run_progress()
     if all(r.test()[0] for r in reqs):
         return True, [r.status for r in reqs]
     return False, None
 
 
 def wait_all(reqs: Sequence[Request]) -> List[Status]:
+    # one tick up front may complete many at once (the engine advances
+    # ALL pending schedules/arrays in a pass); per-request wait() then
+    # drives the engine for whatever is still in flight
+    run_progress()
     return [r.wait() for r in reqs]
 
 
 def test_any(reqs: Sequence[Request]
              ) -> Tuple[Optional[int], Optional[Status]]:
+    run_progress()  # one tick covers the whole scan
     for i, r in enumerate(reqs):
         done, st = r.test()
         if done and r.state is not RequestState.INACTIVE:

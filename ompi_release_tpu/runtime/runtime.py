@@ -87,6 +87,10 @@ class Runtime:
 
             _wire_register_vars()  # wire transport cvars: visible to
             #                        tpu_info/CLI even in singleton mode
+            from .progress import register_vars as _progress_vars
+
+            _progress_vars()  # async progress engine cvars
+            #                   (progress_thread / progress_poll_us)
             mca_var.register(
                 "runtime_abort_on_error", "bool", True,
                 "Abort the process on unhandled MPI errors "
@@ -207,6 +211,13 @@ class Runtime:
             self.world, self.self_comm = comm_world.create_world(self)
             self.job_state.activate(JobState.REGISTERED)
 
+            # async progress engine: arm the dedicated thread when the
+            # operator opted in (lazy posts also arm it; this makes the
+            # opt-in effective from the first collective)
+            from . import progress as _progress
+
+            _progress.engine().ensure_thread()
+
             self.initialized = True
             _log.verbose(
                 1,
@@ -319,6 +330,12 @@ class Runtime:
                     _obs_export.maybe_dump_series(self)
                 except Exception as e:
                     _log.verbose(1, f"obs rank-journal dump failed: {e}")
+            # stop the async progress engine BEFORE communicators are
+            # torn down: a schedule running on the progress thread
+            # still uses the comm registry and the wire
+            from . import progress as _progress
+
+            _progress.engine().shutdown()
             from ..comm import communicator as comm_mod
             from ..comm import dpm as dpm_mod
 

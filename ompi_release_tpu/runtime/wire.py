@@ -98,6 +98,14 @@ _hol_wait = pvar.timer(
     "channel lock (head-of-line wait)",
 )
 
+#: collective transfers the progress engine reaped into the
+#: early-transfer queue off the caller (the opal_progress wire tick)
+_coll_pumped = pvar.counter(
+    "wire_coll_pumped",
+    "collective transfers completed by the async progress engine's "
+    "nonblocking wire pump (reaped before any reap parked on them)",
+)
+
 
 def register_vars() -> None:
     from ..btl.components import register_pipeline_vars
@@ -203,6 +211,17 @@ class WireRouter:
         # (cid, src_pidx) -> FIFO of arrays
         self._coll_early: Dict[Tuple[int, int], List] = {}
         self._coll_early_lock = threading.Lock()
+        #: cids whose progress-engine pump hit a mid-transfer failure:
+        #: the channel stream is unrecoverable, so pumps stand down and
+        #: the round's own reap surfaces the loud error
+        self._pump_dead: set = set()
+        #: per-cid pump backoff: an empty pump probe costs a ~1 ms
+        #: blocking OOB recv (ep.pending() counts frames on EVERY tag,
+        #: so unrelated p2p traffic defeats the cheap fast path) —
+        #: after an empty probe the pump skips this cid briefly so a
+        #: busy endpoint cannot turn the progress thread into a
+        #: continuous blocking-recv loop
+        self._pump_idle: Dict[int, float] = {}
 
     def _chan_lock(self, kind: str, key) -> threading.Lock:
         with self._chan_guard:
@@ -612,8 +631,88 @@ class WireRouter:
         early = self._coll_early_pop(comm.cid, src_pidx)
         if early is not None:
             return early
-        return self._recv_payload(self._coll_tag(comm), src_pidx,
-                                  timeout_ms=timeout_ms)
+        # serialize against the progress engine's pump: two consumers
+        # popping frames of ONE multi-frame transfer would split it.
+        # The caller's timeout budget covers the lock wait too — a
+        # pump mid-transfer must not silently extend a bounded reap.
+        deadline = time.monotonic() + timeout_ms / 1000
+        lk = self._chan_lock("collrx", comm.cid)
+        if not lk.acquire(timeout=max(0.001,
+                                      deadline - time.monotonic())):
+            raise MPIError(
+                ErrorCode.ERR_PENDING,
+                f"collective receive from process {src_pidx} timed out "
+                "waiting for the comm's wire channel (held by the "
+                "progress pump or another reap)",
+            )
+        try:
+            early = self._coll_early_pop(comm.cid, src_pidx)
+            if early is not None:
+                return early
+            left_ms = max(1, int((deadline - time.monotonic()) * 1000))
+            return self._recv_payload(self._coll_tag(comm), src_pidx,
+                                      timeout_ms=left_ms)
+        finally:
+            lk.release()
+
+    def coll_pump(self, comm, budget: int = 8) -> int:
+        """Nonblocking receive-side progress on ``comm``'s collective
+        payload channel — the progress engine's wire tick: complete up
+        to ``budget`` landed transfers into the early-transfer queue so
+        the round's reap (or the round that raced ahead) finds them
+        without parking. Skips out instantly when the endpoint is idle
+        or a reap already owns the channel (a parked reap IS the
+        progress for that channel). A pump only STARTS on a transfer
+        whose first frame already landed; it may then ride out the
+        transfer's in-flight tail (bounded by the sender's streaming —
+        the opal_progress discipline: completing in-flight fragments
+        IS the progress). A transfer that FAILS mid-pump (peer died)
+        leaves the channel stream unrecoverable for any consumer, so
+        the pump marks this cid poisoned and stands down — the round's
+        own reap surfaces the loud ERR_TRUNCATE instead of every tick
+        re-paying the timeout. The channel lock is held per TRANSFER,
+        not across the whole budget, so a reap arriving mid-pump
+        queues behind at most one in-flight tail."""
+        from ..btl.components import stashed_recv
+
+        if comm.cid in self._pump_dead or self.ep.pending() == 0:
+            return 0
+        if time.monotonic() < self._pump_idle.get(comm.cid, 0.0):
+            return 0  # recent empty probe: let the backoff expire
+        tag = self._coll_tag(comm)
+        lk = self._chan_lock("collrx", comm.cid)
+        n = 0
+        while n < budget:
+            if not lk.acquire(blocking=False):
+                return n  # a reap owns the channel: it IS the progress
+            try:
+                try:
+                    src_nid, raw = stashed_recv(
+                        self.ep, None, tag, time.monotonic() + 0.001)
+                except MPIError:
+                    if n == 0:
+                        self._pump_idle[comm.cid] = \
+                            time.monotonic() + 0.005
+                    return n  # nothing pending on this channel
+                src = src_nid - 1
+                try:
+                    # the finish budget matches the reaps' 60 s default
+                    # deliberately: a SHORTER pump deadline would strand
+                    # the popped frames and fail a transfer the round's
+                    # own reap budget would have absorbed
+                    arr = self._finish_transfer(
+                        src, tag, raw, time.monotonic() + 60.0)
+                except MPIError:
+                    self._pump_dead.add(comm.cid)
+                    raise
+                with self._coll_early_lock:
+                    self._coll_early.setdefault(
+                        (comm.cid, src), []).append(arr)
+                _coll_pumped.add()
+                n += 1
+            finally:
+                lk.release()
+        return n
 
     def _peer_frames(self, peer: int, tag: int, arrs: List):
         """Side-effecting generator: each ``next()`` puts ONE wire
@@ -687,16 +786,41 @@ class WireRouter:
                     "awaiting_procs": sorted(
                         q for q, c in p.items() if c > 0)},
             )
+        # serialize against the progress engine's pump (coll_pump):
+        # two consumers popping frames of one multi-frame transfer
+        # would split it. A parked reap holding the lock is fine — it
+        # IS the progress for this channel; the pump try-acquires and
+        # skips. The lock wait itself is bounded by the caller's
+        # deadline so a pump mid-transfer cannot extend a bounded reap.
+        lk = self._chan_lock("collrx", comm.cid)
         try:
-            while True:
-                src_nid, raw = stashed_recv(self.ep, None, tag, deadline)
-                src = src_nid - 1
-                arr = self._finish_transfer(src, tag, raw, deadline)
-                if pending.get(src, 0) > 0:
-                    return src, arr
-                with self._coll_early_lock:
-                    self._coll_early.setdefault((comm.cid, src),
-                                                []).append(arr)
+            if not lk.acquire(timeout=max(0.001,
+                                          deadline - time.monotonic())):
+                raise MPIError(
+                    ErrorCode.ERR_PENDING,
+                    f"collective any-source receive on {comm.name} "
+                    "timed out waiting for the comm's wire channel",
+                )
+            try:
+                while True:
+                    # the pump may have reaped our transfer while we
+                    # awaited the lock: early queue first, always
+                    for p in list(pending):
+                        if pending.get(p, 0) > 0:
+                            early = self._coll_early_pop(comm.cid, p)
+                            if early is not None:
+                                return p, early
+                    src_nid, raw = stashed_recv(self.ep, None, tag,
+                                                deadline)
+                    src = src_nid - 1
+                    arr = self._finish_transfer(src, tag, raw, deadline)
+                    if pending.get(src, 0) > 0:
+                        return src, arr
+                    with self._coll_early_lock:
+                        self._coll_early.setdefault((comm.cid, src),
+                                                    []).append(arr)
+            finally:
+                lk.release()
         finally:
             if tok is not None:
                 _watchdog.disarm(tok)

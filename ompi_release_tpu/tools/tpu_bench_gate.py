@@ -44,11 +44,16 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-#: units where bigger is better (bandwidths, throughputs, speedups)
-HIGHER_BETTER = {"GB/s", "TFLOP/s"}
+#: units where bigger is better (bandwidths, throughputs, speedups,
+#: hidden-comm fractions from the overlap suite)
+HIGHER_BETTER = {"GB/s", "TFLOP/s", "frac_hidden"}
 #: units where smaller is better (latencies, waits, message counts)
 LOWER_BETTER = {"s", "seconds", "us", "us/hop", "hol_wait_s",
                 "sends_at_root", "device_collectives"}
+#: metric-name fallback when the unit alone is ambiguous: the overlap
+#: suite's lines (hidden-comm fraction, overlap speedups) are all
+#: higher-better — less comm time exposed on the critical path
+METRIC_HIGHER_BETTER_PREFIXES = ("overlap_",)
 
 DEFAULT_SIGMA = 4.0
 #: relative noise floor: the bench's own ceiling docs put single-run
@@ -57,14 +62,17 @@ DEFAULT_REL_FLOOR = 0.25
 DEFAULT_MIN_ROUNDS = 3
 
 
-def _direction(unit: Optional[str]) -> Optional[int]:
+def _direction(unit: Optional[str],
+               metric: Optional[str] = None) -> Optional[int]:
     """+1 = higher is better, -1 = lower is better, None = no gate."""
-    if unit is None:
-        return None
-    if unit in HIGHER_BETTER or unit.startswith("x_"):
+    if unit is not None:
+        if unit in HIGHER_BETTER or unit.startswith("x_"):
+            return 1
+        if unit in LOWER_BETTER:
+            return -1
+    if metric and any(metric.startswith(p)
+                      for p in METRIC_HIGHER_BETTER_PREFIXES):
         return 1
-    if unit in LOWER_BETTER:
-        return -1
     return None
 
 
@@ -91,7 +99,7 @@ def gateable(line: Dict[str, Any]) -> bool:
     if line.get("unstable") or line.get("error") \
             or line.get("partial_rounds"):
         return False
-    return _direction(line.get("unit")) is not None
+    return _direction(line.get("unit"), line.get("metric")) is not None
 
 
 def parse_round_file(path: str) -> List[Dict[str, Any]]:
@@ -182,7 +190,7 @@ def evaluate(history_rounds: List[List[Dict[str, Any]]],
             continue
         med, dev = fit_bound(series, sigma=sigma, rel_floor=rel_floor)
         v = float(ln["value"])
-        direction = _direction(ln.get("unit"))
+        direction = _direction(ln.get("unit"), ln.get("metric"))
         checked += 1
         if direction > 0:
             bound, bad = med - dev, v < med - dev

@@ -25,8 +25,10 @@ TRACED = (
     "allreduce", "reduce", "bcast", "allgather", "gather", "scatter",
     "reduce_scatter_block", "alltoall", "scan", "exscan", "barrier",
     "iallreduce", "ireduce", "ibcast", "iallgather", "igather",
-    "iscatter", "ireduce_scatter_block", "ialltoall", "iscan",
-    "iexscan", "ibarrier",
+    "iscatter", "ireduce_scatter_block", "ireduce_scatter",
+    "ialltoall", "iscan", "iexscan", "ibarrier",
+    "allreduce_init", "bcast_init", "allgather_init",
+    "reduce_scatter_init", "alltoall_init", "barrier_init",
     "send", "recv", "isend", "irecv", "sendrecv", "iprobe",
 )
 
