@@ -1417,6 +1417,107 @@ def _overlap_micro_suite(backend_label):
     return lines  # main()'s emit() stamps the backend label
 
 
+#: worker app for the ft_recovery micro-suite: a REAL 3-process tpurun
+#: job under the --ft-continue policy driving an ElasticStep training
+#: loop; the sensor SIGKILLs rank 2 mid-run (kill cvars scoped by
+#: rank), the survivors detect via the job-epoch bump, revoke+shrink,
+#: roll back to the last committed checkpoint, and finish — process 0
+#: writes the recovery-time/steps-lost lines plus the pvar witnesses.
+_FT_BENCH_APP = r'''
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.mca import pvar
+from ompi_release_tpu.ft.checkpoint import Checkpointer
+from ompi_release_tpu.ft.sensor import FtTester
+from ompi_release_tpu.parallel.elastic import ElasticStep
+
+STEPS = int(os.environ.get("OMPITPU_FT_BENCH_STEPS", "8"))
+
+world = mpi.init()
+from ompi_release_tpu.runtime.runtime import Runtime
+rt = Runtime.current()
+me = rt.bootstrap["process_index"]
+
+def _pv(name):
+    p = pvar.PVARS.lookup(name)
+    return float(p.read()) if p is not None else 0.0
+
+ckpt = Checkpointer(os.path.join(
+    os.path.dirname(os.environ["OMPITPU_LOOPBACK_OUT"]),
+    "ft_ckpt", "rank%%d" %% me))
+
+def step_fn(step, state, comm):
+    contrib = np.full((len(comm.local_comm_ranks), 4),
+                      float(step + 1), np.float32)
+    got = np.asarray(comm.allreduce(contrib))
+    return np.asarray(state) + got[:1]
+
+es = ElasticStep(world, step_fn, ckpt, policy="shrink",
+                 checkpoint_every=1,
+                 tester=FtTester.from_cvars(me))
+t0 = time.perf_counter()
+state, stats = es.run(np.zeros((1, 4), np.float32), STEPS)
+wall = time.perf_counter() - t0
+
+if me == 0:
+    lines = [{
+        "metric": "ft_recovery_seconds", "value": round(
+            _pv("ft_recovery_seconds"), 4),
+        "unit": "s", "vs_baseline": None, "suite": "ft_recovery",
+        "procs": 3, "steps": STEPS, "wall_s": round(wall, 4),
+        "failures_detected": _pv("ft_failures_detected"),
+        "recoveries": _pv("ft_recoveries"),
+        "revokes": _pv("ft_revokes"),
+    }, {
+        "metric": "ft_steps_lost", "value": stats["steps_lost"],
+        "unit": "steps", "vs_baseline": None, "suite": "ft_recovery",
+        "checkpoint_every": 1,
+    }]
+    assert _pv("ft_failures_detected") == 1.0, "expected ONE failure"
+    assert _pv("ft_recoveries") == 1.0, "expected ONE recovery"
+    with open(os.environ["OMPITPU_LOOPBACK_OUT"], "w") as f:
+        json.dump(lines, f)
+mpi.finalize()
+'''
+
+
+def _ft_micro_suite(backend_label):
+    """ft_recovery lines: wall time of one detect->revoke->shrink->
+    rollback cycle and the steps recomputed after rollback, measured
+    through a real 3-process tpurun job (--ft-continue policy) whose
+    rank 2 is SIGKILLed by the armed sensor mid-run. Lower-better on
+    both metrics — a recovery-time regression gates exactly like a
+    latency regression (tpu_bench_gate METRIC_LOWER_BETTER_PREFIXES).
+    Loopback-CPU either way: detection, wire reaps, and the shrink
+    agreement are host-side paths."""
+    import os
+
+    from ompi_release_tpu.tools.tpurun import run_loopback_app
+
+    lines = run_loopback_app(
+        3, _FT_BENCH_APP % {"repo": os.path.dirname(
+            os.path.abspath(__file__))},
+        {"OMPITPU_FT_BENCH_STEPS": "8",
+         "OMPITPU_MCA_sensor_ft_kill_step": "3",
+         "OMPITPU_MCA_sensor_ft_kill_rank": "2"},
+        "ft_bench.json", timeout_s=300,
+        job_kw={"on_failure": "continue", "heartbeat_s": 0.3,
+                "miss_limit": 4})
+    if lines is None:
+        return [{"metric": "ft_recovery_suite", "value": None,
+                 "unit": None, "vs_baseline": None,
+                 "error": "ft recovery bench job failed"}]
+    return lines  # main()'s emit() stamps the backend label
+
+
 def _sweep_lines(specs, ceiling_names, slopes, n):
     """Metric lines + headline from the sweep's slope matrix
     ``(n_specs, rounds_measured)``. Pure computation so the salvage
@@ -1669,6 +1770,8 @@ def main():
     #   hier: spanning-collective inter schedules at 4 loopback procs
     #   overlap: exposed vs hidden comm time for iallreduce buckets
     #            under the async progress engine vs polling fallback
+    #   ft_recovery: detect->revoke->shrink->rollback wall time of a
+    #            3-proc job whose rank 2 is SIGKILLed mid-run
     _run_suite("coll_micro_suite", _coll_micro_suite, emit, jax)
     _run_suite("wire_micro_suite",
                lambda: _wire_micro_suite(backend_label), emit, jax)
@@ -1676,6 +1779,8 @@ def main():
                lambda: _hier_micro_suite(backend_label), emit, jax)
     _run_suite("overlap_suite",
                lambda: _overlap_micro_suite(backend_label), emit, jax)
+    _run_suite("ft_recovery_suite",
+               lambda: _ft_micro_suite(backend_label), emit, jax)
 
     # perf-regression gate: judge THIS round's lines against the
     # on-disk BENCH_r*.json history (fitted noise bounds per metric

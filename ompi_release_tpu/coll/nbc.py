@@ -184,7 +184,7 @@ def icoll(comm, name: str, args: Tuple, kw: Optional[Dict] = None
           ) -> Request:
     """Nonblocking collective: dispatch returns before completion for
     every family (no ``block_until_ready`` on the dispatch path)."""
-    comm._check_alive()
+    comm._check_usable()
     fn = _resolve(comm, name)
     if not comm.spans_processes:
         return async_request(fn(comm, *args, **(kw or {})))
@@ -224,7 +224,7 @@ def submit(comm, name: str, fn: Callable, args: Tuple,
     """Nonblocking run of an arbitrary collective-ordered callable on
     the comm's schedule queue (the nonblocking collective-IO path):
     keeps posting order with every other collective on the comm."""
-    comm._check_alive()
+    comm._check_usable()
     nested = _nested_inline(comm, fn, args, kw)
     if nested is not None:
         return nested
@@ -253,7 +253,7 @@ def persistent(comm, name: str, args: Tuple, kw: Optional[Dict] = None
     buffer reuse) without blocking — a fresh schedule posts to the
     engine (spanning) or a fresh async dispatch launches (in-process,
     where the compiled program cached at first fire IS the plan)."""
-    comm._check_alive()
+    comm._check_usable()
     kw = kw or {}
     if name == "barrier" and not comm.spans_processes:
         ifn = comm.c_coll.get("ibarrier")

@@ -89,12 +89,74 @@ class Communicator:
     def __init__(self, runtime, group: Group, *, name: str = "",
                  parent: Optional["Communicator"] = None,
                  topo: Optional[Any] = None,
-                 internal: bool = False) -> None:
+                 internal: bool = False,
+                 cid: Optional[int] = None) -> None:
         from ..runtime.mesh import build_submesh  # local: avoid cycle
 
         self.runtime = runtime
         self.group = group
-        self.cid = _next_cid(internal)
+        if cid is not None:
+            # explicit cid: the ULFM shrink/rebuild path derives the
+            # cid from the HNP-agreed job epoch so survivors and a
+            # respawned replacement (whose local counter restarted
+            # from zero) mint the SAME cid without agreement traffic.
+            # A REVOKED/freed occupant (the epoch-wrapped slot of this
+            # lineage's own poisoned ancestor) is evicted — it can
+            # never be used again by ULFM rule; a LIVE occupant is a
+            # real collision and stays a loud error.
+            occupant = _comm_registry.get(cid)
+            if occupant is not None and (occupant._revoked
+                                         or occupant._freed):
+                if not occupant._freed:
+                    # real teardown, not flag-poking: the evicted
+                    # comm's _on_free hooks (hier shadow, fusion
+                    # buffer) must run or they leak registry entries
+                    # for the process lifetime
+                    try:
+                        occupant.free()
+                    except MPIError:
+                        pass  # a poisoned drain must not block rebuild
+                _comm_registry.pop(cid, None)
+                occupant = None
+            if occupant is not None:
+                raise MPIError(
+                    ErrorCode.ERR_COMM,
+                    f"explicit cid {cid} already registered "
+                    f"({_comm_registry[cid].name}) — free it before "
+                    "rebuilding at the same epoch",
+                )
+            # any stale revocation record for this slot belongs to an
+            # ANCESTOR's epoch (evicted above, or revoked-then-freed
+            # by the app long ago), not to the comm being built — a
+            # leftover entry would make every wire wait on the fresh
+            # cid raise ERR_REVOKED immediately
+            from ..ft import ulfm as _ulfm_slot
+
+            _ulfm_slot.state().clear_revoked(cid)
+            self.cid = cid
+        else:
+            self.cid = _next_cid(internal)
+        self._revoked = False  # ULFM revocation flag (see revoke())
+        # ULFM lineage anchor: shrink/rebuild children inherit the
+        # ORIGINAL comm's identity, so across ANY number of
+        # recoveries every participant — a survivor holding
+        # rebuild#N, a fresh replacement holding only its world —
+        # keys the recovery agreement and the epoch-derived cid on
+        # the same value. The lineage is also the constant ft_cid
+        # parent slot, which is what makes an epoch-wrapped slot
+        # collision land on this lineage's own revoked ancestor.
+        if cid is not None and parent is not None:
+            self._ft_lineage = getattr(parent, "_ft_lineage",
+                                       parent.cid)
+        else:
+            self._ft_lineage = self.cid
+        # the job epoch this comm was born at: ULFM failures are
+        # permanent per communicator, so bounded waits compare each
+        # peer's failure episode against THIS epoch — a replacement
+        # incarnation is visible only to comms built after its rejoin
+        from ..ft import ulfm as _ulfm_mod
+
+        self._ft_epoch0 = _ulfm_mod.state().epoch
         self.name = name or f"comm{self.cid}"
         self.errhandler: Errhandler = (
             parent.errhandler if parent else ERRORS_ARE_FATAL
@@ -166,6 +228,20 @@ class Communicator:
     def _check_alive(self) -> None:
         if self._freed:
             raise MPIError(ErrorCode.ERR_COMM, f"{self.name} already freed")
+
+    def _check_usable(self) -> None:
+        """Alive AND not revoked: every communication entry point runs
+        this (ULFM: all ops except agree/shrink/get_failed fail with
+        ERR_REVOKED on a revoked communicator). One bool check — the
+        flag is set by revoke() locally and by the FT watcher when a
+        peer's poison frame arrives."""
+        self._check_alive()
+        if self._revoked:
+            raise MPIError(
+                ErrorCode.ERR_REVOKED,
+                f"{self.name} (cid {self.cid}) has been revoked — "
+                "shrink() or rebuild it to continue",
+            )
 
     # -- construction ------------------------------------------------------
     def dup(self, name: str = "") -> "Communicator":
@@ -307,6 +383,140 @@ class Communicator:
             f"MPI_Abort on {self.name} with errorcode {errorcode}"
         )
 
+    # -- ULFM fault tolerance (MPIX_Comm_revoke/shrink/agree) --------------
+    def _member_procs(self) -> List[int]:
+        """Process indices owning this comm's ranks (spanning comms;
+        [my process] otherwise)."""
+        if not self.spans_processes:
+            return [int(self.runtime.bootstrap.get("process_index", 0))]
+        from ..runtime.wire import proc_topology
+
+        return proc_topology(self).procs
+
+    @property
+    def revoked(self) -> bool:
+        return self._revoked
+
+    def revoke(self) -> None:
+        """``MPIX_Comm_revoke``: epoch-stamped poison. Marks the comm
+        revoked locally (every pending bounded wait on its wire
+        channels raises ERR_REVOKED within one slice, and queued
+        progress-engine schedules complete in error without running),
+        then pushes TAG_FT_REVOKE frames to every live peer process so
+        THEIR pending ops are interrupted too. Idempotent; never
+        raises on a dead peer — a corpse needs no poison."""
+        self._check_alive()
+        from ..ft import ulfm as _ulfm
+
+        st = _ulfm.state()
+        self._revoked = True
+        first = st.apply_revoke(self.cid, st.epoch)
+        agent = getattr(self.runtime, "agent", None)
+        if not first or agent is None or not self.spans_processes:
+            return
+        from ..runtime.wire import proc_topology
+
+        topo = proc_topology(self)
+        for p in topo.peers:
+            if p in st.failed:
+                continue
+            try:
+                agent.ft_revoke_notify(p, self.cid, st.epoch)
+            except MPIError:
+                pass  # peer died between the check and the send
+        _log.verbose(1, f"{self.name} revoked (epoch {st.epoch})")
+
+    def get_failed(self) -> List[int]:
+        """``MPIX_Comm_get_failed``: this comm's ranks owned by
+        processes the job epoch marks failed."""
+        self._check_alive()
+        from ..ft import ulfm as _ulfm
+
+        if not self.spans_processes:
+            return []
+        from ..runtime.wire import proc_topology
+
+        topo = proc_topology(self)
+        dead = set(_ulfm.state().dead_for(set(topo.owner),
+                                          self._ft_epoch0))
+        return [i for i in range(self.size) if topo.owner[i] in dead]
+
+    def agree(self, flag: bool = True, *, aseq: Optional[int] = None,
+              timeout_ms: int = 60_000) -> bool:
+        """``MPIX_Comm_agree``: fault-tolerant AND of ``flag`` across
+        the comm's LIVE member processes, arbitrated by the HNP
+        coordinator (failed contributors are excused as the epoch
+        marks them). Works on a revoked communicator — it is the one
+        collective ULFM guarantees through failures."""
+        self._check_alive()
+        agent = getattr(self.runtime, "agent", None)
+        if agent is None or not self.spans_processes:
+            return bool(flag)
+        if aseq is None:
+            aseq = self._agree_counter = getattr(
+                self, "_agree_counter", 0) + 1
+        doc = agent.ft_agree(self.cid, aseq, 1 if flag else 0,
+                             self._member_procs(), timeout_ms=timeout_ms)
+        return bool(doc.get("flag", 0))
+
+    def shrink(self, name: str = "", *,
+               timeout_ms: int = 60_000) -> "Communicator":
+        """``MPIX_Comm_shrink``: agree on the surviving group through
+        the coordinator (every survivor receives ONE consistent
+        epoch/failed snapshot), build a new communicator over it with
+        a fresh epoch-derived cid — fresh wire channels, rebuilt
+        hier/leader topology via the normal per-comm coll selection —
+        and barrier the survivors on it to prove the wiring. Valid on
+        a revoked (or failure-poisoned) communicator; the parent is
+        left revoked."""
+        self._check_alive()
+        from ..ft import ulfm as _ulfm
+
+        agent = getattr(self.runtime, "agent", None)
+        if agent is None or not self.spans_processes:
+            # no failure domain beyond this process: ULFM shrink of a
+            # fault-free comm is a plain dup
+            return self.dup(name or f"shrink({self.name})")
+        from ..runtime.wire import proc_topology
+
+        topo = proc_topology(self)
+        aseq = self._agree_counter = getattr(
+            self, "_agree_counter", 0) + 1
+        doc = agent.ft_agree(self._ft_lineage, aseq, 1, topo.procs,
+                             timeout_ms=timeout_ms)
+        epoch = int(doc.get("epoch", 0))
+        # dead FOR THIS COMM, from the agreement's ONE shared
+        # snapshot: the transient failed set PLUS every process whose
+        # failure episode began at/after this comm's birth epoch —
+        # under the restart policy a corpse moves failed->restarted
+        # within milliseconds of promotion, and a shrink that
+        # re-included it would park the survivor barrier on a process
+        # that never builds this cid
+        failed = set(int(p) for p in doc.get("failed", ()))
+        failed |= {p for p, e in _ulfm.failed_at_of(doc).items()
+                   if e >= self._ft_epoch0}
+        survivors = Group([
+            self.group.world_rank(i) for i in range(self.size)
+            if topo.owner[i] not in failed
+        ])
+        if survivors.size == 0:
+            raise MPIError(ErrorCode.ERR_GROUP,
+                           f"shrink({self.name}): no survivors")
+        new = Communicator(
+            self.runtime, survivors,
+            name=name or f"shrink({self.name})", parent=self,
+            cid=_ulfm.ft_cid(epoch, self._ft_lineage),
+        )
+        if new.spans_processes:
+            wire = self.runtime.wire
+            wire.proc_barrier(new, proc_topology(new).procs,
+                              timeout_ms=timeout_ms)
+        _log.verbose(
+            1, f"shrink({self.name}) -> {new.name} cid={new.cid} "
+               f"size={new.size} (epoch {epoch}, "
+               f"failed procs {sorted(failed)})")
+        return new
+
     # -- point-to-point (dispatched through the selected PML engine) -------
     @property
     def pml(self):
@@ -331,23 +541,23 @@ class Communicator:
     def isend(self, data, dest: int, tag: int = 0, *, rank: int, **kw):
         """Nonblocking send issued by ``rank`` (driver mode: the acting
         rank is explicit because one controller plays every rank)."""
-        self._check_alive()
+        self._check_usable()
         return self.pml.isend(data, dest, tag, src=rank, **kw)
 
     def send(self, data, dest: int, tag: int = 0, *, rank: int, **kw):
-        self._check_alive()
+        self._check_usable()
         return self.pml.send(data, dest, tag, src=rank, **kw)
 
     def irecv(self, source: int = -1, tag: int = -1, *, rank: int):
-        self._check_alive()
+        self._check_usable()
         return self.pml.irecv(source, tag, dst=rank)
 
     def recv(self, source: int = -1, tag: int = -1, *, rank: int):
-        self._check_alive()
+        self._check_usable()
         return self.pml.recv(source, tag, dst=rank)
 
     def iprobe(self, source: int = -1, tag: int = -1, *, rank: int):
-        self._check_alive()
+        self._check_usable()
         return self.pml.iprobe(source, tag, dst=rank)
 
     def sendrecv(self, sendbufs, dests, sendtag: int = 0,
@@ -361,7 +571,7 @@ class Communicator:
         sendbufs/dests (and optional sources): sequences of length
         ``size``. Returns (values, statuses) lists.
         """
-        self._check_alive()
+        self._check_usable()
         if self.spans_processes:
             raise MPIError(
                 ErrorCode.ERR_NOT_AVAILABLE,
@@ -394,7 +604,7 @@ class Communicator:
 
     # -- collectives (dispatch through the installed c_coll table) ---------
     def _coll(self, op_name: str) -> Callable:
-        self._check_alive()
+        self._check_usable()
         fn = self.c_coll.get(op_name)
         if fn is None:
             raise MPIError(
@@ -403,6 +613,15 @@ class Communicator:
             )
         if not self.spans_processes:
             return fn
+        # fast ULFM fail: a collective involves every member, so a
+        # known-failed member process fails the op NOW with the typed
+        # error instead of posting a schedule doomed to park
+        from ..ft import ulfm as _ulfm
+
+        _ulfm.state().check_wait(
+            self.cid, self._member_procs(),
+            f"collective {op_name} on {self.name} with member process",
+            epoch0=self._ft_epoch0)
         # spanning comms: EVERY collective — blocking or not — goes
         # through the async progress engine as "post schedule + wait",
         # so blocking and nonblocking calls execute in posting order on
@@ -661,7 +880,7 @@ class Communicator:
         async dispatch path run the blocking barrier on a completion
         thread instead — either way ibarrier returns before the
         barrier completes."""
-        self._check_alive()
+        self._check_usable()
         from ..coll import nbc as _nbc
 
         if self.spans_processes:

@@ -69,6 +69,39 @@ class _Rendezvous:
         self.result: Optional[Tuple[Intercommunicator,
                                     Intercommunicator]] = None
         self.error: Optional[BaseException] = None
+        # ULFM epoch fencing: the port remembers the job epoch it was
+        # opened at; comm_accept rejects joiners carrying a STALE
+        # epoch (a connector that formed its plan before a failure
+        # must re-learn the world, not be paired into it)
+        self.epoch = _ft_epoch()
+
+
+def _ft_epoch() -> int:
+    from ..ft import ulfm
+
+    return ulfm.state().epoch
+
+
+def _check_counterpart(comm: Optional[Communicator],
+                       port: str, side: str) -> None:
+    """Fast-fail instead of burning the caller's whole timeout: a
+    rendezvous whose registered counterpart communicator has been
+    revoked (or belongs to a failed process picture) is DEAD — raise
+    the typed ULFM error now."""
+    if comm is None:
+        return
+    if getattr(comm, "_revoked", False) or getattr(comm, "_freed",
+                                                   False):
+        raise MPIError(
+            ErrorCode.ERR_REVOKED,
+            f"{side} on '{port}': the parked peer's communicator "
+            f"({comm.name}) was revoked/freed — the rendezvous is dead",
+        )
+    from ..ft import ulfm
+
+    ulfm.state().check_wait(comm.cid, comm._member_procs(),
+                            f"{side} on '{port}' awaiting process",
+                            epoch0=getattr(comm, "_ft_epoch0", 0))
 
 
 def _check_disjoint(a: Communicator, b: Communicator) -> None:
@@ -102,12 +135,28 @@ def _build_intercomm(rv: _Rendezvous, runtime, acceptor: Communicator,
 
 
 def _await_result(rv: _Rendezvous, deadline: float, side: str):
-    """Wait under the lock for result/error; caller holds _lock."""
+    """Wait under the lock for result/error; caller holds _lock.
+    Parks in bounded slices so a counterpart communicator revoked (or
+    its process failed) MID-WAIT surfaces as the typed ULFM error
+    within one slice instead of silently burning the deadline."""
     import time
 
     while rv.result is None and rv.error is None:
+        other = rv.connector if side == "accept" else rv.acceptor
+        try:
+            _check_counterpart(other, rv.port, side)
+        except MPIError as err:
+            if side == "accept":
+                rv.acceptor = None
+            else:
+                rv.connector = None
+            rv.error = err
+            _reset_slot(rv)
+            _lock.notify_all()
+            raise
         left = deadline - time.monotonic()
-        if left <= 0 or not _lock.wait(timeout=left):
+        if left <= 0 or (not _lock.wait(timeout=min(left, 0.2))
+                         and deadline - time.monotonic() <= 0):
             if rv.result is not None or rv.error is not None:
                 break
             # the rendezvous is DEAD, not just this side: poison the
@@ -185,7 +234,14 @@ def unpublish_name(service: str) -> None:
 
 def lookup_name(service: str, *, timeout_s: float = 10.0) -> str:
     """``MPI_Lookup_name``: blocks until published (the reference's
-    pubsub lookup spins on the server) or times out."""
+    pubsub lookup spins on the server) or times out. In singleton
+    (in-process) mode, a name resolving to a DEAD port — closed, or
+    with a parked acceptor whose comm was revoked / whose process
+    failed — raises the typed ULFM error immediately instead of
+    handing back a port every connect on which would burn its own
+    timeout. Under tpurun the lookup is served by the HNP name table,
+    which tracks no port liveness — a stale cross-job port surfaces
+    at connect time, not here."""
     import time
 
     agent = _job_agent()
@@ -201,7 +257,19 @@ def lookup_name(service: str, *, timeout_s: float = 10.0) -> str:
                     break
                 raise MPIError(ErrorCode.ERR_NAME,
                                f"service '{service}' not found")
-        return _names[service]
+        port = _names[service]
+        rv = _pending.get(port)
+        if rv is None:
+            if port.startswith("tpu-port:"):
+                raise MPIError(
+                    ErrorCode.ERR_PROC_FAILED,
+                    f"service '{service}' names port '{port}' which "
+                    "has been closed (publisher died or retired the "
+                    "port without unpublishing)",
+                )
+            return port  # opaque non-port payload: hand it through
+        _check_counterpart(rv.acceptor, port, f"lookup '{service}'")
+        return port
 
 
 def _reset_slot(rv: _Rendezvous) -> None:
@@ -214,24 +282,46 @@ def _reset_slot(rv: _Rendezvous) -> None:
 
 
 def _rendezvous(comm: Communicator, port: str, side: str,
-                timeout_s: float) -> Intercommunicator:
+                timeout_s: float,
+                epoch: Optional[int] = None) -> Intercommunicator:
     """The shared accept/connect protocol; ``side`` picks which slot
-    this caller fills and which handle of the pair it receives."""
+    this caller fills and which handle of the pair it receives.
+    ``epoch`` is the epoch the connector's PLAN was formed at
+    (default: the connecting communicator's birth epoch): a joiner
+    whose plan predates the port's world view — the port was opened
+    after a failure the connector's comm has never heard of — is
+    rejected immediately and must re-learn the world before pairing
+    (the comm_accept stale-epoch fence)."""
     import time
 
     mine, theirs = (
         ("acceptor", "connector") if side == "accept"
         else ("connector", "acceptor")
     )
+    if epoch is None:
+        epoch = getattr(comm, "_ft_epoch0", 0)
     deadline = time.monotonic() + timeout_s
     with _lock:
         rv = _pending.get(port)
         if rv is None:
             raise MPIError(ErrorCode.ERR_PORT, f"unknown port '{port}'")
+        if side == "connect" and epoch < rv.epoch:
+            raise MPIError(
+                ErrorCode.ERR_REVOKED,
+                f"connect on '{port}': joiner epoch {epoch} is stale "
+                f"(port opened at epoch {rv.epoch}) — rebuild the "
+                "communicator against the current failure picture "
+                "and retry",
+            )
         if getattr(rv, mine) is not None:
             raise MPIError(ErrorCode.ERR_PORT,
                            f"port '{port}' already has an {mine}")
         other = getattr(rv, theirs)
+        # fast-fail on a DEAD rendezvous before registering: a parked
+        # peer whose comm was revoked / whose process failed means
+        # this pairing can never complete — return the error class
+        # now instead of burning the caller's whole timeout
+        _check_counterpart(other, port, side)
         if other is not None:
             _check_disjoint(comm, other)  # before registering
         setattr(rv, mine, comm)
@@ -253,15 +343,23 @@ def comm_accept(comm: Communicator, port: str, *,
     """``MPI_Comm_accept``: block on ``port`` until a connector
     arrives; returns this (server) side's intercomm handle. The port
     remains valid afterwards — a server can loop accept on one
-    published port (dpm_orte server pattern)."""
+    published port (dpm_orte server pattern). Joiners carrying a
+    stale job epoch are rejected (see :func:`_rendezvous`), and a
+    parked accept whose connector's comm gets revoked fails within
+    one bounded slice with the typed ULFM error."""
     return _rendezvous(comm, port, "accept", timeout_s)
 
 
 def comm_connect(comm: Communicator, port: str, *,
-                 timeout_s: float = 30.0) -> Intercommunicator:
+                 timeout_s: float = 30.0,
+                 epoch: Optional[int] = None) -> Intercommunicator:
     """``MPI_Comm_connect``: rendezvous with the acceptor on ``port``;
-    returns this (client) side's intercomm handle."""
-    return _rendezvous(comm, port, "connect", timeout_s)
+    returns this (client) side's intercomm handle. A connect to a
+    dead/revoked port (parked acceptor's comm revoked or owned by a
+    failed process) raises ERR_REVOKED/ERR_PROC_FAILED immediately
+    instead of burning the full timeout; ``epoch`` (default: current)
+    is fenced against the port's epoch."""
+    return _rendezvous(comm, port, "connect", timeout_s, epoch=epoch)
 
 
 def clear() -> None:

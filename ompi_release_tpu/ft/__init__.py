@@ -9,4 +9,7 @@ injection).
 
 from .checkpoint import Checkpointer  # noqa: F401
 from .sensor import Heartbeat, FtTester, resource_usage  # noqa: F401
-from .errmgr import ErrMgr, run_with_restart  # noqa: F401
+from .errmgr import (  # noqa: F401
+    ErrMgr, recover, run_with_restart, spawn_replacements,
+)
+from . import ulfm  # noqa: F401

@@ -1,0 +1,312 @@
+"""ULFM-style fault-tolerance state — the process-local view of the
+job epoch, the known-failed process set, and revoked communicators.
+
+The MPI User-Level Failure Mitigation model (MPIX_Comm_revoke /
+_shrink / _agree / _failure_ack) hangs off two pieces of shared
+state, and this module is both for the TPU runtime:
+
+- the **job epoch**: a monotone counter owned by the HNP coordinator,
+  bumped every time the failure picture changes (a worker promoted to
+  failed, a replacement respawned, a replacement rejoined). Workers
+  learn bumps through ``TAG_PROC_FAILED`` notices pushed over the
+  lifeline (see :meth:`~..runtime.coordinator.WorkerAgent
+  .start_ft_watcher`) and through ``TAG_FT`` queries/agreements.
+- the **failed/restarted/rejoined sets** (process indices): the
+  authoritative copy lives at the HNP; this module caches the last
+  notice so hot-path waits (``runtime/wire.py`` reaps, ctl waits) can
+  consult it with one lock-free-ish read per bounded slice instead of
+  an RPC.
+
+Revocation is comm-scoped poison: :meth:`Communicator.revoke` marks
+the cid here and pushes ``TAG_FT_REVOKE`` frames to the comm's peer
+processes, whose FT watchers call :func:`state`.``apply_revoke`` —
+every bounded wire wait on that cid then raises ``ERR_REVOKED``
+within one slice, and queued progress-engine schedules on the cid are
+completed in error without running (the "interrupt peers' pending
+ops" half of ULFM revoke).
+
+No jax imports here: this module sits under the wire router's hot
+path and must stay import-light.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Callable, Dict, List, Optional, Set
+
+from .. import obs as _obs
+from ..mca import pvar
+from ..utils import output
+from ..utils.errors import ErrorCode, MPIError
+
+_log = output.stream("ulfm")
+
+#: failures learned by THIS process (first time a pidx appears in a
+#: notice's failed set) — the "exactly one failure" witness of the
+#: recovery job test
+failures_detected = pvar.counter(
+    "ft_failures_detected",
+    "process failures learned from coordinator TAG_PROC_FAILED "
+    "notices (counted once per failed process index)",
+)
+revokes = pvar.counter(
+    "ft_revokes",
+    "communicator revocations observed (local revoke() calls plus "
+    "TAG_FT_REVOKE poison frames from peers)",
+)
+
+#: rebuilt communicators draw their cid from this base so every
+#: participant — survivors and a respawned replacement whose local
+#: cid counter restarted from zero — derives the SAME cid from the
+#: HNP-agreed epoch instead of a per-process counter.  Must stay
+#: below the wire tag space bound (cid < 1<<20).
+FT_CID_BASE = 1 << 19
+
+
+def ft_cid(epoch: int, parent_cid: int) -> int:
+    """Deterministic cid for a shrink/rebuild communicator: derived
+    from the agreed epoch plus the parent comm's (SPMD-agreed) cid, so
+    no process-local counter is involved. The FT band (1<<19 ids) is
+    split 32 epochs x 16384 parent slots: the shrink-every-comm ULFM
+    recovery pattern needs DISTINCT cids for distinct parents at one
+    epoch (16384 slots cover any realistic comm count), while the
+    epoch wraps — a wrap collision can only hit the same parent 32
+    recovery epochs later, where the occupant is that lineage's old
+    REVOKED comm, which Communicator evicts on explicit-cid rebuild."""
+    cid = (FT_CID_BASE + (int(epoch) % 32) * 16384
+           + (abs(int(parent_cid)) % 16384))
+    if cid >= (1 << 20):
+        raise MPIError(
+            ErrorCode.ERR_INTERN,
+            f"ft cid {cid} (epoch {epoch}) exceeds the wire tag space",
+        )
+    return cid
+
+
+def failed_at_of(doc: Optional[dict]) -> Dict[int, int]:
+    """THE parser for a failure document's ``failed_at`` wire map
+    (JSON stringifies the pidx keys): pidx -> epoch its current
+    failure episode began. Malformed entries are dropped — one
+    place, one behavior, for every consumer (apply_notice, shrink,
+    errmgr.recover)."""
+    out: Dict[int, int] = {}
+    for k, e in ((doc or {}).get("failed_at") or {}).items():
+        try:
+            out[int(k)] = int(e)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+class FtState:
+    """Process-local cache of the job's failure picture."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.epoch = 0
+        self.failed: Set[int] = set()      # process indices
+        self.restarted: Set[int] = set()   # respawn granted
+        self.rejoined: Set[int] = set()    # replacement re-wired
+        self._ever_failed: Set[int] = set()
+        #: pidx -> epoch at which its CURRENT failure episode began.
+        #: ULFM failures are permanent PER COMMUNICATOR: a comm
+        #: created at epoch E0 treats a peer as dead forever once it
+        #: failed at any epoch >= E0, even after a replacement with
+        #: the same process index rejoins (the replacement is a NEW
+        #: incarnation, visible only to comms built at later epochs —
+        #: the rebuild path). Without this, the failed->restarted
+        #: transition is a milliseconds-wide window bounded waits
+        #: could miss entirely.
+        self.failed_at: Dict[int, int] = {}
+        self.revoked: Dict[int, int] = {}  # cid -> epoch at revoke
+        self._listeners: List[Callable[[dict], None]] = []
+
+    # -- notices (coordinator -> worker) -----------------------------------
+    def apply_notice(self, doc: dict) -> None:
+        """Fold one TAG_PROC_FAILED / TAG_FT document into the local
+        view. Documents are authoritative snapshots (epoch + full
+        sets); stale epochs are ignored so a reordered notice can
+        never roll the picture backwards."""
+        try:
+            epoch = int(doc.get("epoch", 0))
+            failed = set(int(p) for p in doc.get("failed", ()))
+            restarted = set(int(p) for p in doc.get("restarted", ()))
+            rejoined = set(int(p) for p in doc.get("rejoined", ()))
+        except (TypeError, ValueError):
+            return  # malformed notice: never poison the cache
+        new_failures: Set[int] = set()
+        with self._lock:
+            if epoch < self.epoch:
+                return
+            self.epoch = epoch
+            new_failures = failed - self._ever_failed
+            self._ever_failed |= failed
+            for p in failed - self.failed:
+                # a NEW failure episode for this pidx starts now
+                self.failed_at[p] = epoch
+            # the coordinator's authoritative episode record (carried
+            # by TAG_FT replies and newer notices) overrides the
+            # locally-derived first-seen epochs: a worker that missed
+            # the promotion notice still learns WHEN each episode
+            # began, which per-comm deadness depends on
+            for p, e in failed_at_of(doc).items():
+                self._ever_failed.add(p)
+                if e > self.failed_at.get(p, -1):
+                    self.failed_at[p] = e
+            self.failed = failed
+            self.restarted = restarted
+            self.rejoined = rejoined
+        for _ in new_failures:
+            failures_detected.add()
+        if new_failures:
+            _log.verbose(1, f"epoch {epoch}: process(es) "
+                            f"{sorted(new_failures)} failed")
+            if _obs.enabled:
+                # the failure event lands in the span journal so the
+                # doctor's merged timeline shows WHEN each rank
+                # learned of the death relative to its stalled round
+                for p in sorted(new_failures):
+                    _obs.record("ft_failure", "ft",
+                                _time.perf_counter(), 0.0,
+                                peer=int(p), comm_id=epoch)
+        for cb in list(self._listeners):
+            try:
+                cb(doc)
+            except Exception as e:  # a listener must not kill the watcher
+                _log.verbose(1, f"ft notice listener failed: {e}")
+
+    def add_listener(self, cb: Callable[[dict], None]) -> None:
+        self._listeners.append(cb)
+
+    # -- revocation --------------------------------------------------------
+    def apply_revoke(self, cid: int, epoch: int = -1) -> bool:
+        """Mark ``cid`` revoked (idempotent). Returns True when this
+        call was the first to poison the cid. Also completes queued
+        progress-engine schedules on the cid in error — the revoke
+        must interrupt pending ops, not only future ones."""
+        with self._lock:
+            first = cid not in self.revoked
+            if first:
+                self.revoked[cid] = (epoch if epoch >= 0 else self.epoch)
+        if first:
+            revokes.add()
+            _log.verbose(1, f"cid {cid} revoked")
+            # queued (not yet running) schedules on the revoked comm
+            # complete in error without running: their wire exchanges
+            # would only park peers on a poisoned channel
+            try:
+                from ..runtime import progress as _progress
+
+                _progress.engine().fail_queued(
+                    ("comm", cid),
+                    lambda: MPIError(
+                        ErrorCode.ERR_REVOKED,
+                        f"communicator cid {cid} was revoked with this "
+                        "schedule still queued",
+                    ),
+                )
+            except Exception as e:
+                _log.verbose(1, f"revoke: queued-schedule sweep "
+                                f"failed: {e}")
+            # mirror onto the live comm object for the cheap
+            # _check_usable() flag test on every op entry
+            try:
+                from ..comm.communicator import _comm_registry
+
+                c = _comm_registry.get(cid)
+                if c is not None:
+                    c._revoked = True
+            except Exception:
+                pass
+        return first
+
+    def is_revoked(self, cid: int) -> bool:
+        return cid in self.revoked
+
+    def clear_revoked(self, cid: int) -> None:
+        """Forget a cid's revocation record — the rebuild path's
+        epoch-wrapped slot reuse: the record belonged to the evicted
+        ancestor, and keeping it would poison the fresh comm minted
+        at the same cid."""
+        with self._lock:
+            self.revoked.pop(cid, None)
+
+    # -- hot-path checks (wire waits) --------------------------------------
+    def dead_for(self, peers, epoch0: int = 0) -> List[int]:
+        """The subset of ``peers`` dead for a communicator created at
+        ``epoch0``: currently failed, or failed at ANY epoch since the
+        comm existed (permanence — a same-pidx replacement is a new
+        incarnation only comms built at later epochs may talk to)."""
+        if not self.failed and not self.failed_at:
+            return []
+        return sorted(
+            p for p in peers
+            if p in self.failed or self.failed_at.get(p, -1) >= epoch0)
+
+    def check_wait(self, cid: int, peers, what: str = "wait",
+                   epoch0: int = 0) -> None:
+        """Raise if ``cid`` is revoked or any process in ``peers`` is
+        dead for a comm created at ``epoch0`` — the bounded-slice
+        check that turns a would-be indefinite hang on a dead peer
+        into ERR_PROC_FAILED within one detection interval."""
+        if cid in self.revoked:
+            raise MPIError(
+                ErrorCode.ERR_REVOKED,
+                f"{what} interrupted: communicator cid {cid} revoked",
+            )
+        dead = self.dead_for(peers, epoch0)
+        if dead:
+            raise MPIError(
+                ErrorCode.ERR_PROC_FAILED,
+                f"{what} on process(es) {dead} which the job epoch "
+                f"({self.epoch}) marks failed (comm epoch {epoch0})",
+            )
+
+    def check_peer(self, pidx: int, what: str = "send",
+                   epoch0: int = 0) -> None:
+        if self.dead_for((pidx,), epoch0):
+            raise MPIError(
+                ErrorCode.ERR_PROC_FAILED,
+                f"{what} to process {pidx} which the job epoch "
+                f"({self.epoch}) marks failed (comm epoch {epoch0})",
+            )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "epoch": self.epoch,
+                "failed": sorted(self.failed),
+                "restarted": sorted(self.restarted),
+                "rejoined": sorted(self.rejoined),
+                "revoked_cids": sorted(self.revoked),
+                "failed_at": dict(self.failed_at),
+            }
+
+    def reset(self) -> None:
+        """Test hook: wipe the process-local picture."""
+        with self._lock:
+            self.epoch = 0
+            self.failed.clear()
+            self.restarted.clear()
+            self.rejoined.clear()
+            self._ever_failed.clear()
+            self.failed_at.clear()
+            self.revoked.clear()
+            self._listeners.clear()
+
+
+#: THE process-local FT state (the failure picture is per controller
+#: process, like opal's process-global error manager)
+STATE = FtState()
+
+
+def state() -> FtState:
+    return STATE
+
+
+# postmortems must name known-failed ranks rather than listing them as
+# merely "awaiting": the flight recorder gets the whole picture
+from ..obs import watchdog as _watchdog  # noqa: E402
+
+_watchdog.add_contributor("ft_state", lambda: STATE.snapshot())

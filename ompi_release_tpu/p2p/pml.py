@@ -578,10 +578,26 @@ class WirePmlEngine(PmlEngine):
                         info={"source": source, "tag": tag, "dst": dst},
                     )
                 try:
+                    from ..ft import ulfm as _ulfm
+                    from ..runtime.wire import proc_topology
+
+                    comm = engine.comm
+                    if source == ANY_SOURCE:
+                        ft_peers = list(proc_topology(comm).peers)
+                    else:
+                        ft_peers = [proc_topology(comm).owner[source]]
                     limit = float(mca_var.get("pml_wire_timeout", 30.0))
                     deadline = _time.monotonic() + limit
                     while (not req.is_complete
                            and _time.monotonic() < deadline):
+                        # ULFM bound: a recv whose (possible) sender
+                        # died — or whose comm was revoked — raises
+                        # the typed error within one drain slice, not
+                        # after the full pml_wire_timeout
+                        _ulfm.state().check_wait(
+                            comm.cid, ft_peers,
+                            f"p2p recv(source={source}) awaiting",
+                            epoch0=getattr(comm, "_ft_epoch0", 0))
                         engine._drain(dst, timeout_ms=100)
                     if not req.is_complete:
                         raise MPIError(

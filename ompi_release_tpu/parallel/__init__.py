@@ -20,3 +20,4 @@ from .mesh_axes import (  # noqa: F401
     build_parallel_mesh, axis_size_or_1,
 )
 from . import dp, tp, pp, sp, cp, ep, zero  # noqa: F401
+from .elastic import ElasticStep  # noqa: F401

@@ -416,6 +416,52 @@ class ProgressEngine:
             with self._lock:
                 self._kicked.discard(op.key)
 
+    def fail_queued(self, key: Any, exc_factory: Callable[[], BaseException]
+                    ) -> int:
+        """Complete every still-QUEUED op on ``key`` in error WITHOUT
+        running it — the ULFM revoke interrupt: schedules posted on a
+        revoked communicator must complete in error promptly, and
+        running them would only park this process on a poisoned wire
+        channel. A RUNNING op is left alone (it owns wire state; its
+        own bounded waits surface the revocation within a slice).
+        Returns how many ops were failed."""
+        failed: List[ScheduledOp] = []
+        with self._lock:
+            q = self._queues.get(key)
+            if not q:
+                return 0
+            for op in list(q):
+                if op.state != QUEUED:
+                    continue
+                op.state = DONE
+                op.error = exc_factory()
+                q.remove(op)
+                self._inflight.pop(op.seq, None)
+                ledger = self._posted.get(op.poster)
+                if ledger is not None:
+                    try:
+                        ledger.remove(op)
+                    except ValueError:
+                        pass
+                    if not ledger:
+                        self._posted.pop(op.poster, None)
+                failed.append(op)
+            if not q:
+                self._queues.pop(key, None)
+            self._cond.notify_all()
+        for op in failed:
+            # same completion contract as _execute: callbacks BEFORE
+            # the event, so a woken waiter observes the bound request
+            # already failed
+            for cb in list(op.callbacks):
+                try:
+                    cb(op)
+                except Exception as e:
+                    _log.verbose(1, f"nbc completion callback "
+                                    f"failed: {e}")
+            op.done.set()
+        return len(failed)
+
     def drain_key(self, key: Any) -> None:
         """Complete every posted op on one key, in order (comm free /
         shutdown path: peers participate in the queued collectives, so

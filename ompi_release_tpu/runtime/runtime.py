@@ -134,6 +134,18 @@ class Runtime:
             self.agent = self.bootstrap.get("agent")  # tpurun WorkerAgent
             self.job_state.activate(JobState.ALLOCATE, self.bootstrap)
 
+            if self.agent is not None:
+                # ULFM detection plane: TAG_PROC_FAILED epoch notices
+                # and TAG_FT_REVOKE poison frames feed the process-
+                # local failure picture the wire router's bounded
+                # waits consult — armed before the first collective so
+                # a failure during bring-up is already visible
+                from ..ft import ulfm as _ulfm
+
+                _ft = _ulfm.state()
+                self.agent.start_ft_watcher(_ft.apply_notice,
+                                            _ft.apply_revoke)
+
             if _obs.enabled and self.agent is not None:
                 # estimate the clock offset NOW, not only at finalize:
                 # a hung job killed mid-run leaves postmortems as its

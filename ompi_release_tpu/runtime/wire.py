@@ -106,6 +106,37 @@ _coll_pumped = pvar.counter(
     "nonblocking wire pump (reaped before any reap parked on them)",
 )
 
+#: bounded-wait slice: every blocking collective/ctl wait re-checks
+#: the ULFM failure picture (revoked cid, known-failed peers) at this
+#: period, so a dead peer turns a would-be indefinite hang into
+#: ERR_PROC_FAILED within one detection interval
+_FT_SLICE_S = 0.1
+
+_ft_singleton = None
+
+
+def _ft():
+    """The process-local ULFM state (lazy: ft.ulfm must not be pulled
+    through the package __init__ — and its jax deps — at wire import
+    time)."""
+    global _ft_singleton
+    if _ft_singleton is None:
+        from ..ft import ulfm
+
+        _ft_singleton = ulfm.state()
+    return _ft_singleton
+
+
+def _ft_split_awaiting(procs) -> Dict[str, List[int]]:
+    """Watchdog postmortem annotation: known-failed peers are NAMED
+    as failed instead of listed as merely 'awaiting'."""
+    procs = list(procs)
+    dead = set(_ft().dead_for(procs))
+    return {
+        "awaiting_procs": sorted(q for q in procs if q not in dead),
+        "known_failed_procs": sorted(dead),
+    }
+
 
 def register_vars() -> None:
     from ..btl.components import register_pipeline_vars
@@ -275,22 +306,30 @@ class WireRouter:
         return WIRE_P2P_BASE + lane * _LANE_STRIDE + dst_world
 
     # -- payload channel ---------------------------------------------------
-    def _retry(self, fn, what: str):
+    def _retry(self, fn, what: str, peer: Optional[int] = None,
+               epoch0: int = 0):
         """First contact over an accepted fd can race the peer's
         announce processing on our reader thread (the same window
         recv_xcast retries around) — back off briefly before treating
-        the link as dead."""
+        the link as dead. A peer the job epoch marks FAILED is not
+        retried: the send fails fast with ERR_PROC_FAILED instead of
+        burning the whole backoff against a corpse."""
         last = None
         for attempt in range(5):
+            if peer is not None and attempt:
+                _ft().check_peer(peer, what, epoch0)
             try:
                 return fn()
             except MPIError as e:
                 last = e
                 time.sleep(0.05 * (attempt + 1))
+        if peer is not None:
+            _ft().check_peer(peer, what, epoch0)
         raise MPIError(ErrorCode.ERR_UNREACH,
                        f"{what} failed after retries: {last}")
 
-    def _send_payload(self, peer_pidx: int, tag: int, arr) -> None:
+    def _send_payload(self, peer_pidx: int, tag: int, arr,
+                      epoch0: int = 0) -> None:
         btl = self._btl_for(peer_pidx)
         arr = np.asarray(arr)
         if btl is self._shm:
@@ -298,12 +337,14 @@ class WireRouter:
                 lambda: btl.send_shm(self.ep, self._nid(peer_pidx), tag,
                                      arr),
                 f"shm handoff to process {peer_pidx}",
+                peer=peer_pidx, epoch0=epoch0,
             )
         else:
             self._retry(
                 lambda: btl.send_staged(self.ep, self._nid(peer_pidx),
                                         tag, arr),
                 f"staged transfer to process {peer_pidx}",
+                peer=peer_pidx, epoch0=epoch0,
             )
 
     def _recv_payload(self, tag: int, src_pidx: int,
@@ -332,6 +373,8 @@ class WireRouter:
         still shares the per-destination delivery order."""
         dst_world = comm.group.world_rank(dst_rank)
         peer = self.owner_of(dst_world)
+        _ft().check_wait(comm.cid, (peer,), "p2p send",
+                         epoch0=getattr(comm, "_ft_epoch0", 0))
         seq = next(self._seq)
         lane = self._lane_of(user_tag)
         tag = self._p2p_tag(dst_world, lane)
@@ -373,7 +416,8 @@ class WireRouter:
                         # later slot while we hold the order chan lock
                         self._order[dst_world] = order - 1
                     raise
-            self._send_payload(peer, tag, arr)
+            self._send_payload(peer, tag, arr,
+                               epoch0=getattr(comm, "_ft_epoch0", 0))
         finally:
             lock.release()
         if rec and _obs.enabled:
@@ -625,7 +669,11 @@ class WireRouter:
         return None
 
     def coll_send(self, comm, peer_pidx: int, arr) -> None:
-        self._send_payload(peer_pidx, self._coll_tag(comm), arr)
+        epoch0 = getattr(comm, "_ft_epoch0", 0)
+        _ft().check_wait(comm.cid, (peer_pidx,), "collective send",
+                         epoch0=epoch0)
+        self._send_payload(peer_pidx, self._coll_tag(comm), arr,
+                           epoch0=epoch0)
 
     def coll_recv(self, comm, src_pidx: int, timeout_ms: int = 60_000):
         early = self._coll_early_pop(comm.cid, src_pidx)
@@ -636,6 +684,7 @@ class WireRouter:
         # The caller's timeout budget covers the lock wait too — a
         # pump mid-transfer must not silently extend a bounded reap.
         deadline = time.monotonic() + timeout_ms / 1000
+        tag = self._coll_tag(comm)
         lk = self._chan_lock("collrx", comm.cid)
         if not lk.acquire(timeout=max(0.001,
                                       deadline - time.monotonic())):
@@ -649,9 +698,17 @@ class WireRouter:
             early = self._coll_early_pop(comm.cid, src_pidx)
             if early is not None:
                 return early
-            left_ms = max(1, int((deadline - time.monotonic()) * 1000))
-            return self._recv_payload(self._coll_tag(comm), src_pidx,
-                                      timeout_ms=left_ms)
+            # bounded-slice wait for the FIRST frame; once one
+            # landed, the transfer is committed to completion against
+            # the caller's full deadline
+            _, raw = self._sliced_recv(
+                self._nid(src_pidx), tag, deadline, comm,
+                lambda: (src_pidx,), "collective receive from",
+                f"collective receive from process {src_pidx} timed "
+                f"out after {timeout_ms} ms")
+            return self._finish_checked(
+                src_pidx, tag, raw, deadline,
+                epoch0=getattr(comm, "_ft_epoch0", 0))
         finally:
             lk.release()
 
@@ -714,7 +771,8 @@ class WireRouter:
                 lk.release()
         return n
 
-    def _peer_frames(self, peer: int, tag: int, arrs: List):
+    def _peer_frames(self, peer: int, tag: int, arrs: List,
+                     epoch0: int = 0):
         """Side-effecting generator: each ``next()`` puts ONE wire
         frame of this peer's transfer queue on the OOB. DCN transfers
         above the pipeline segsize stream as zero-copy fragments; shm
@@ -733,7 +791,7 @@ class WireRouter:
                     )
                     yield
             else:
-                self._send_payload(peer, tag, a)
+                self._send_payload(peer, tag, a, epoch0=epoch0)
                 yield
 
     def coll_send_all(self, comm, arrs_for: Dict[int, List]) -> None:
@@ -744,7 +802,8 @@ class WireRouter:
         instead of peer P+1 waiting for peer P's full payload."""
         tag = self._coll_tag(comm)
         depth = max(1, int(mca_var.get("wire_pipeline_depth", 4) or 1))
-        streams = [self._peer_frames(p, tag, arrs_for[p])
+        epoch0 = getattr(comm, "_ft_epoch0", 0)
+        streams = [self._peer_frames(p, tag, arrs_for[p], epoch0)
                    for p in sorted(arrs_for) if arrs_for[p]]
         while streams:
             keep = []
@@ -769,8 +828,6 @@ class WireRouter:
         outstanding count belongs to a FUTURE round (that peer raced
         ahead) and is queued for its own round's receive instead of
         being returned out of context."""
-        from ..btl.components import stashed_recv
-
         for p in list(pending):
             if pending.get(p, 0) > 0:
                 early = self._coll_early_pop(comm.cid, p)
@@ -782,9 +839,8 @@ class WireRouter:
         if _watchdog.enabled:
             tok = _watchdog.arm(
                 "coll_recv_any", comm_id=comm.cid,
-                info=lambda p=pending: {
-                    "awaiting_procs": sorted(
-                        q for q, c in p.items() if c > 0)},
+                info=lambda p=pending: _ft_split_awaiting(
+                    q for q, c in p.items() if c > 0),
             )
         # serialize against the progress engine's pump (coll_pump):
         # two consumers popping frames of one multi-frame transfer
@@ -810,10 +866,20 @@ class WireRouter:
                             early = self._coll_early_pop(comm.cid, p)
                             if early is not None:
                                 return p, early
-                    src_nid, raw = stashed_recv(self.ep, None, tag,
-                                                deadline)
+                    # bounded-slice wait (holding the channel lock,
+                    # so the pump cannot add early transfers behind
+                    # our back mid-wait)
+                    src_nid, raw = self._sliced_recv(
+                        None, tag, deadline, comm,
+                        lambda: [q for q, c in pending.items()
+                                 if c > 0],
+                        "collective reap awaiting",
+                        f"collective any-source receive on "
+                        f"{comm.name} timed out")
                     src = src_nid - 1
-                    arr = self._finish_transfer(src, tag, raw, deadline)
+                    arr = self._finish_checked(
+                        src, tag, raw, deadline,
+                        epoch0=getattr(comm, "_ft_epoch0", 0))
                     if pending.get(src, 0) > 0:
                         return src, arr
                     with self._coll_early_lock:
@@ -838,26 +904,83 @@ class WireRouter:
         return btl.recv_staged(self.ep, tag, src=self._nid(src_pidx),
                                timeout_ms=left_ms, first=first)
 
+    def _sliced_recv(self, want_src, tag: int, deadline: float,
+                     comm, peers_fn, what: str, timeout_msg: str):
+        """THE bounded-slice wait shared by every blocking wire
+        consumer (collective reaps, peer-specific receives, ctl
+        tokens): each ~100 ms slice re-checks the ULFM failure
+        picture — revoked cid, peers dead for this comm's birth
+        epoch — so a dead peer or a revoke interrupts the wait with
+        the typed error within one detection interval; deadline
+        expiry raises ERR_PENDING with ``timeout_msg``. Returns the
+        ``(src_nid, raw)`` of the first matching frame."""
+        from ..btl.components import stashed_recv
+
+        epoch0 = getattr(comm, "_ft_epoch0", 0)
+        while True:
+            _ft().check_wait(comm.cid, peers_fn(), what, epoch0=epoch0)
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise MPIError(ErrorCode.ERR_PENDING, timeout_msg)
+            try:
+                return stashed_recv(
+                    self.ep, want_src, tag,
+                    time.monotonic() + min(left, _FT_SLICE_S))
+            except MPIError as e:
+                if e.code != ErrorCode.ERR_PENDING:
+                    raise  # endpoint torn down: surface it
+                # slice expired: re-check the picture and re-park
+
+    def _finish_checked(self, src_pidx: int, tag: int, first_raw,
+                        deadline: float, epoch0: int = 0):
+        """`_finish_transfer` with the ULFM mapping: a transfer whose
+        tail never completes because the SENDER is (or becomes) dead
+        FOR THIS COMM (its failure episode started at/after the comm's
+        birth epoch) surfaces as ERR_PROC_FAILED — the typed error
+        recovery policies key on — instead of a generic truncation.
+        The epoch comparison matters: a rejoined replacement's flaky
+        transfer on a post-recovery comm must stay a flake, not be
+        escalated into a (confirmed) process failure."""
+        try:
+            return self._finish_transfer(src_pidx, tag, first_raw,
+                                         deadline)
+        except MPIError as e:
+            if _ft().dead_for((src_pidx,), epoch0):
+                raise MPIError(
+                    ErrorCode.ERR_PROC_FAILED,
+                    f"collective transfer from process {src_pidx} "
+                    f"broke off mid-stream and the job epoch "
+                    f"({_ft().epoch}) marks that process failed ({e})",
+                )
+            raise
+
     def ctl_send(self, comm, peer_pidx: int, payload: bytes = b"") -> None:
+        _ft().check_wait(comm.cid, (peer_pidx,), "ctl send",
+                         epoch0=getattr(comm, "_ft_epoch0", 0))
         self._retry(
             lambda: self.ep.send(self._nid(peer_pidx),
                                  WIRE_CTL_BASE + comm.cid, payload),
             f"ctl token to process {peer_pidx}",
+            peer=peer_pidx, epoch0=getattr(comm, "_ft_epoch0", 0),
         )
 
     def ctl_recv(self, comm, src_pidx: int,
                  timeout_ms: int = 60_000) -> bytes:
-        from ..btl.components import stashed_recv
-
         tok = None
         if _watchdog.enabled:
-            tok = _watchdog.arm("barrier_token", comm_id=comm.cid,
-                                peer=src_pidx,
-                                info={"awaiting_procs": [src_pidx]})
+            tok = _watchdog.arm(
+                "barrier_token", comm_id=comm.cid, peer=src_pidx,
+                info=lambda s=src_pidx: _ft_split_awaiting([s]))
         try:
             deadline = time.monotonic() + timeout_ms / 1000
-            _, raw = stashed_recv(self.ep, self._nid(src_pidx),
-                                  WIRE_CTL_BASE + comm.cid, deadline)
+            # bounded slices, exactly like the collective reaps: a
+            # barrier/ctl wait on a dead peer (or a revoked comm) must
+            # raise within one detection interval, not hang
+            _, raw = self._sliced_recv(
+                self._nid(src_pidx), WIRE_CTL_BASE + comm.cid,
+                deadline, comm, lambda: (src_pidx,), "ctl wait on",
+                f"ctl wait on process {src_pidx} timed out after "
+                f"{timeout_ms} ms")
             return raw
         finally:
             if tok is not None:

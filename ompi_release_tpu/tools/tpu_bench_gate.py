@@ -49,11 +49,15 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 HIGHER_BETTER = {"GB/s", "TFLOP/s", "frac_hidden"}
 #: units where smaller is better (latencies, waits, message counts)
 LOWER_BETTER = {"s", "seconds", "us", "us/hop", "hol_wait_s",
-                "sends_at_root", "device_collectives"}
+                "sends_at_root", "device_collectives", "steps"}
 #: metric-name fallback when the unit alone is ambiguous: the overlap
 #: suite's lines (hidden-comm fraction, overlap speedups) are all
 #: higher-better — less comm time exposed on the critical path
 METRIC_HIGHER_BETTER_PREFIXES = ("overlap_",)
+#: ...and the ft_recovery suite's lines (recovery wall time, steps
+#: recomputed after rollback) are all lower-better — a recovery-time
+#: regression gates exactly like a latency regression
+METRIC_LOWER_BETTER_PREFIXES = ("ft_",)
 
 DEFAULT_SIGMA = 4.0
 #: relative noise floor: the bench's own ceiling docs put single-run
@@ -73,6 +77,9 @@ def _direction(unit: Optional[str],
     if metric and any(metric.startswith(p)
                       for p in METRIC_HIGHER_BETTER_PREFIXES):
         return 1
+    if metric and any(metric.startswith(p)
+                      for p in METRIC_LOWER_BETTER_PREFIXES):
+        return -1
     return None
 
 

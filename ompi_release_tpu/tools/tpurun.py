@@ -313,7 +313,8 @@ class Job:
                  rankfile: Optional[str] = None,
                  launch_agent: str = "ssh",
                  on_failure: str = "abort",
-                 max_restarts: int = 2) -> None:
+                 max_restarts: int = 2,
+                 ft_inject: Optional[tuple] = None) -> None:
         self.n = num_procs
         self.argv = argv
         self.mca = mca
@@ -343,12 +344,33 @@ class Job:
         self.launch_agent = launch_agent
         # errmgr policy: 'abort' = default_hnp teardown; 'restart' =
         # rmaps/resilient respawn of the failed rank on a surviving
-        # slot (the app resumes from its last committed checkpoint)
-        if on_failure not in ("abort", "restart"):
+        # slot (the app resumes from its last committed checkpoint);
+        # 'continue' = the ULFM degraded world — the failed rank is
+        # promoted through the job epoch (TAG_PROC_FAILED) and the
+        # survivors keep running (they shrink and carry on); the job
+        # exits 0 iff every SURVIVOR finished clean
+        if on_failure not in ("abort", "restart", "continue"):
             raise MPIError(ErrorCode.ERR_ARG,
                            f"unknown failure policy '{on_failure}'")
         self.on_failure = on_failure
         self.max_restarts = max_restarts
+        # chaos injection (--ft-inject rank:step): arm the sensor's
+        # hard kill in EXACTLY the chosen child via its env cvars
+        if ft_inject is not None:
+            r, s = int(ft_inject[0]), int(ft_inject[1])
+            if not 0 <= r < num_procs:
+                raise MPIError(ErrorCode.ERR_ARG,
+                               f"--ft-inject rank {r} out of range "
+                               f"for -n {num_procs}")
+            if s < 0:
+                raise MPIError(ErrorCode.ERR_ARG,
+                               f"--ft-inject step {s} must be >= 0")
+            ft_inject = (r, s)
+        self.ft_inject = ft_inject
+        #: node ids promoted to failed under the 'continue' policy:
+        #: their exit codes never fail the job, and the FIN collector
+        #: stops expecting them
+        self._ft_failed_ranks: set = set()
         self._restarts: Dict[int, int] = {}
         self._respawned: List[int] = []  # drained by the waitpid loop
         self._restarting: set = set()    # ranks mid-respawn (dedupe)
@@ -407,6 +429,21 @@ class Job:
             # workers under the resilient policy tolerate unreachable
             # peers at wire-up (a peer may be mid-restart or finished)
             env["OMPITPU_RECOVERY"] = "1"
+        if self._restarts.get(node_id, 0):
+            # authoritative incarnation marker: a RESPAWNED process
+            # knows it is a replacement without racing the failure
+            # picture (the rejoin epoch bump can land before or after
+            # any point the app samples it — the env cannot)
+            env["OMPITPU_INCARNATION"] = str(self._restarts[node_id])
+        if self.ft_inject is not None and node_id - 1 == self.ft_inject[0] \
+                and not self._restarts.get(node_id, 0):
+            # chaos: arm the sensor's SIGKILL at the chosen step in
+            # THIS child only (FtTester.from_cvars reads it) — and
+            # only in the FIRST incarnation: --ft-inject injects ONE
+            # failure, so a respawned replacement must not re-kill
+            # itself at the same step
+            env["OMPITPU_MCA_sensor_ft_kill_step"] = str(
+                self.ft_inject[1])
         for k, v in self.mca:
             env[f"OMPITPU_MCA_{k}"] = str(v)
         return env
@@ -473,6 +510,49 @@ class Job:
         self.proc_state[node_id] = state
         if self._failed.is_set():
             return
+        if self.on_failure == "continue" and self.job_state.visited(
+                JobState.RUNNING):
+            # ULFM degraded world (only once the job is RUNNING — a
+            # child that dies during bring-up must abort the launch
+            # loudly, like the restart policy's guard, or survivors
+            # would park in wire-up masking the real startup error):
+            # promote through the job epoch (the
+            # waitpid loop usually observes the corpse long before the
+            # heartbeat window closes — promote_failed is idempotent
+            # with the monitor's own promotion) and keep running; the
+            # survivors revoke/shrink and carry on
+            with self._fin_lock:
+                first = node_id not in self._ft_failed_ranks
+                if first:
+                    self._ft_failed_ranks.add(node_id)
+            if first:
+                try:
+                    self.hnp.promote_failed(node_id)
+                except MPIError:
+                    pass  # links torn down at job end
+                # a WEDGED worker (heartbeat-promoted, process still
+                # alive) must be reaped or the waitpid loop would spin
+                # to the job timeout: control-plane kill first (the
+                # odls path that reaches ssh-launched workers), then
+                # SIGKILL the local handle — the rc<0 signal death is
+                # exactly what the exit-code policy excuses
+                p = self.procs.get(node_id)
+                if p is not None and p.poll() is None:
+                    try:
+                        self.hnp.kill_worker(node_id)
+                    except MPIError:
+                        pass
+                    try:
+                        p.wait(timeout=1)
+                    except subprocess.TimeoutExpired:
+                        pass
+                    if p.poll() is None:
+                        p.kill()
+                _log.verbose(
+                    0, f"worker {node_id} failed "
+                       f"({ProcState(state).name}); continuing "
+                       "degraded (--ft-continue)")
+            return
         if self.on_failure == "restart" and self.job_state.visited(
                 JobState.RUNNING):
             # one restart per failure: the heartbeat monitor and the
@@ -489,6 +569,15 @@ class Job:
                     self._restarts[node_id] = used + 1
                     self._restarting.add(node_id)
             if granted:
+                # promote through the job epoch FIRST: survivors'
+                # bounded waits must raise ERR_PROC_FAILED and enter
+                # recovery while the (slow) respawn runs; the respawn
+                # path's note_restarted then moves the rank from
+                # failed to restarted at the next epoch
+                try:
+                    self.hnp.promote_failed(node_id)
+                except MPIError:
+                    pass
                 threading.Thread(
                     target=self._restart_rank, args=(node_id, state),
                     daemon=True,
@@ -824,12 +913,21 @@ class Job:
             # fleet series store: workers push continuous pvar deltas
             # (obs_sample_interval), tpu_top --fleet queries them live
             self.hnp.start_series_responder()
+            # ULFM plane: failure-state queries + fault-tolerant
+            # agreements (shrink's survivor-group consensus) — always
+            # on; costs one idle thread when the app never asks
+            self.hnp.start_ft_responder()
             self._write_contact_file()
             if self.on_failure == "restart":
                 # a respawned worker re-runs its full ESS wire-up
                 # against the live job (JOIN + init barrier)
                 self.hnp.start_rejoin_service(cards)
-            while not self._failed.is_set() and len(self._fin) < self.n:
+            def _done_count() -> int:
+                with self._fin_lock:  # _ft_failed_ranks mutates on
+                    #                   the monitor/waitpid threads
+                    return len(self._fin | self._ft_failed_ranks)
+
+            while not self._failed.is_set() and _done_count() < self.n:
                 nid = self.hnp.recv_fin(timeout_ms=200)
                 if nid is not None:
                     with self._fin_lock:
@@ -873,9 +971,16 @@ class Job:
                     continue
                 pending.discard(nid)
                 exit_codes[nid] = rc
-                self.hnp.note_finished(nid)  # no more beats expected
                 with self._fin_lock:
                     clean = nid in self._fin
+                if clean:
+                    # no more beats expected. ONLY once FIN confirmed:
+                    # any death — nonzero, signal, or exit-0 with no
+                    # FIN (lifeline lost) — must reach
+                    # _on_worker_failure BEFORE any finished mark, or
+                    # promote_failed would mistake the corpse for a
+                    # cleanly-finished worker and never bump the epoch
+                    self.hnp.note_finished(nid)
                 if rc == 0 and clean:
                     self.proc_state[nid] = ProcState.TERMINATED
                 elif rc != 0:
@@ -890,6 +995,7 @@ class Job:
                 with self._fin_lock:
                     clean = nid in self._fin
                 if clean:
+                    self.hnp.note_finished(nid)  # FIN confirmed late
                     self.proc_state[nid] = ProcState.TERMINATED
                     del grace[nid]
                 elif time.monotonic() > grace[nid]:
@@ -922,8 +1028,16 @@ class Job:
             return rc
         # a nonzero code can linger without _failed when a restart was
         # granted but its respawn never cleanly completed — that is a
-        # failure, not success
-        leftover = next((c for c in exit_codes.values() if c), 0)
+        # failure, not success. Ranks promoted under the 'continue'
+        # policy are the exception — their death is the EXPECTED event
+        # the survivors recovered from — but ONLY signal deaths (rc<0:
+        # SIGKILL'd by the fault, or job-end terminate of a wedged
+        # proc): a promoted rank that exited with a nonzero CODE is an
+        # app crash (e.g. a survivor whose recovery failed) and must
+        # fail the job.
+        leftover = next(
+            (c for nid, c in exit_codes.items()
+             if c and not (nid in self._ft_failed_ranks and c < 0)), 0)
         if leftover:
             self.job_state.activate(JobState.ABORTED, "restart failed")
             return leftover
@@ -933,7 +1047,8 @@ class Job:
 
 def run_loopback_app(nprocs: int, app_src: str, env: dict,
                      out_path: str, *, timeout_s: int = 300,
-                     mca: Optional[List[tuple]] = None):
+                     mca: Optional[List[tuple]] = None,
+                     job_kw: Optional[Dict] = None):
     """Spawn ``app_src`` as an ``nprocs``-process loopback Job with
     ``env`` exported for the workers, and return the JSON document the
     app wrote to ``out_path`` (or None on failure). The shared harness
@@ -955,8 +1070,10 @@ def run_loopback_app(nprocs: int, app_src: str, env: dict,
         os.environ.update({k: str(v) for k, v in env.items()})
         os.environ["OMPITPU_LOOPBACK_OUT"] = resolved_out
         try:
+            kw = dict(heartbeat_s=0.5, miss_limit=8)
+            kw.update(job_kw or {})
             job = Job(nprocs, [sys.executable, app], list(mca or ()),
-                      heartbeat_s=0.5, miss_limit=8)
+                      **kw)
             rc = job.run(timeout_s=timeout_s)
         finally:
             os.environ.clear()
@@ -1003,6 +1120,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--max-restarts", type=int, default=2,
                     help="per-rank restart budget with "
                          "--enable-recovery")
+    ap.add_argument("--ft-continue", action="store_true",
+                    help="ULFM degraded-world policy: on a rank "
+                         "failure, bump the job epoch and xcast "
+                         "TAG_PROC_FAILED but keep the job running — "
+                         "survivors revoke()/shrink() and continue; "
+                         "exit 0 iff every survivor finishes clean "
+                         "(mutually exclusive with --enable-recovery)")
+    ap.add_argument("--ft-inject", default=None, metavar="RANK:STEP",
+                    help="chaos mode: arm the ft sensor's SIGKILL in "
+                         "worker RANK at training step STEP (exports "
+                         "OMPITPU_MCA_sensor_ft_kill_step into that "
+                         "child only; the app's ElasticStep/FtTester "
+                         ".step() clock fires it) — used by the "
+                         "recovery job tests and chaos runs")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="program and arguments to launch")
     args = ap.parse_args(argv)
@@ -1012,19 +1143,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("-n must be >= 1")
     if args.hostfile and args.host:
         ap.error("--hostfile and --host are mutually exclusive")
+    if args.enable_recovery and args.ft_continue:
+        ap.error("--enable-recovery and --ft-continue are mutually "
+                 "exclusive (respawn vs degraded-world policy)")
+    ft_inject = None
+    if args.ft_inject:
+        try:
+            r, s = args.ft_inject.split(":", 1)
+            ft_inject = (int(r), int(s))
+        except ValueError:
+            ap.error(f"--ft-inject expects RANK:STEP, got "
+                     f"'{args.ft_inject}'")
     hosts = None
     if args.hostfile:
         hosts = parse_hostfile(args.hostfile)
     elif args.host:
         hosts = parse_host_list(args.host)
 
+    on_failure = "abort"
+    if args.enable_recovery:
+        on_failure = "restart"
+    elif args.ft_continue:
+        on_failure = "continue"
     job = Job(args.np, args.command, [tuple(m) for m in args.mca],
               heartbeat_s=args.heartbeat,
               tag_output=not args.no_tag_output,
               hosts=hosts, map_by=args.map_by, rankfile=args.rankfile,
               launch_agent=args.launch_agent,
-              on_failure="restart" if args.enable_recovery else "abort",
-              max_restarts=args.max_restarts)
+              on_failure=on_failure,
+              max_restarts=args.max_restarts,
+              ft_inject=ft_inject)
 
     def on_signal(signum, frame):
         job._failed.set()

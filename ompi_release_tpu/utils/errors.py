@@ -49,6 +49,12 @@ class ErrorCode(enum.IntEnum):
     ERR_SPAWN = 42  # MPI_ERR_SPAWN
     ERR_NOT_AVAILABLE = 100
     ERR_UNREACH = 101  # OMPI_ERR_UNREACH: no transport reaches the peer
+    # ULFM fault-tolerance classes (MPIX_ERR_* of the MPI 4.x FT
+    # chapter): a wait on a peer the job epoch marks dead completes in
+    # error instead of hanging, and operations on a revoked
+    # communicator are interrupted with ERR_REVOKED
+    ERR_PROC_FAILED = 75   # MPIX_ERR_PROC_FAILED
+    ERR_REVOKED = 76       # MPIX_ERR_REVOKED
 
 
 class MPIError(RuntimeError):

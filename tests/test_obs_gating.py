@@ -38,7 +38,9 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/btl/components.py",
            "ompi_release_tpu/obs/sampler.py",
            "ompi_release_tpu/runtime/progress.py",
-           "ompi_release_tpu/coll/nbc.py")
+           "ompi_release_tpu/coll/nbc.py",
+           "ompi_release_tpu/ft/ulfm.py",
+           "ompi_release_tpu/parallel/elastic.py")
 
 #: attribute calls that ARE emit sites when ungated
 EMIT_ATTRS = {"record", "begin", "body", "end", "arm"}
