@@ -904,6 +904,85 @@ def _coll_micro_suite():
     return lines  # main()'s emit() stamps the backend label
 
 
+def _sentinel_micro_suite():
+    """sentinel lines: the SAME 1 MiB allreduce with the collective
+    contract sentinel off (obs_sentinel=0 — one attribute check per
+    collective) and on in post-hoc mode (obs_sentinel=1 — signature
+    hash + journal event per collective), with the
+    ``sentinel_ops_hashed`` pvar delta as the witness that the
+    enabled leg really hashed every call. The obs plane is ON for
+    BOTH legs so the overhead_frac isolates the sentinel's own cost
+    — only the obs_sentinel cvar varies between legs. All three
+    metrics gate lower-better (tpu_bench_gate: ``s`` unit /
+    ``sentinel_`` prefix), so the near-zero-overhead claim is
+    enforced across rounds, not asserted once."""
+    import ompi_release_tpu as mpi
+    import ompi_release_tpu.obs as _obs_pkg
+    from ompi_release_tpu.mca import pvar as _pvar_mod
+    from ompi_release_tpu.mca import var as mca_var
+    from ompi_release_tpu.obs import sentinel as _sentinel
+
+    world = mpi.init()
+    elems = MiB // 4
+    x = np.ones((world.size, elems), np.float32)
+    call = lambda: world.allreduce(x)  # noqa: E731
+    reps = 5
+
+    def timed():
+        _sync(call())  # warm the plan cache outside the timing
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _sync(call())
+        return (time.perf_counter() - t0) / reps
+
+    def _hashed():
+        pv = _pvar_mod.PVARS.lookup("sentinel_ops_hashed")
+        return float(pv.read()) if pv is not None else 0.0
+
+    # the disabled leg must really BE disabled, whatever the operator
+    # passed on the command line — and teardown must hand their
+    # setting back, not strip it for the rest of the round
+    prior = int(mca_var.get("obs_sentinel", 0) or 0)
+    was_enabled = _obs_pkg.enabled
+    try:
+        _obs_pkg.enable()  # same obs state on BOTH legs
+        mca_var.set_value("obs_sentinel", 0)
+        _sentinel.refresh(True)
+        base_dt = timed()  # obs_sentinel=0: the provably-free leg
+        mca_var.set_value("obs_sentinel", 1)
+        _sentinel.refresh(True)
+        h0 = _hashed()
+        sent_dt = timed()
+    finally:
+        if prior:
+            mca_var.set_value("obs_sentinel", prior)
+        else:
+            mca_var.VARS.unset("obs_sentinel")
+        if not was_enabled:
+            _obs_pkg.disable()
+        else:
+            _sentinel.refresh(True)
+    hashed = int(_hashed() - h0)
+    assert hashed >= reps, (
+        f"sentinel witness: expected >= {reps} hashed ops, got {hashed}")
+    return [{
+        "metric": "sentinel_allreduce_1MiB_disabled",
+        "value": round(base_dt, 6), "unit": "s", "vs_baseline": None,
+        "suite": "sentinel",
+    }, {
+        "metric": "sentinel_allreduce_1MiB_posthoc",
+        "value": round(sent_dt, 6), "unit": "s", "vs_baseline": None,
+        "suite": "sentinel", "ops_hashed": hashed,
+    }, {
+        "metric": "sentinel_allreduce_overhead_frac",
+        "value": round(sent_dt / max(base_dt, 1e-9) - 1.0, 4),
+        "unit": "frac_overhead", "vs_baseline": None,
+        "suite": "sentinel", "ops_hashed": hashed,
+        "disabled_seconds": round(base_dt, 6),
+        "enabled_seconds": round(sent_dt, 6),
+    }]
+
+
 #: worker app for the wire micro-suite: a REAL 3-process tpurun job on
 #: the CPU mesh (the wire is host-side regardless of accelerator), so
 #: the emitted numbers exercise the exact envelope/fragment/lane code
@@ -1772,7 +1851,10 @@ def main():
     #            under the async progress engine vs polling fallback
     #   ft_recovery: detect->revoke->shrink->rollback wall time of a
     #            3-proc job whose rank 2 is SIGKILLed mid-run
+    #   sentinel: contract-sentinel overhead, enabled vs disabled,
+    #            with the sentinel_ops_hashed pvar as witness
     _run_suite("coll_micro_suite", _coll_micro_suite, emit, jax)
+    _run_suite("sentinel_suite", _sentinel_micro_suite, emit, jax)
     _run_suite("wire_micro_suite",
                lambda: _wire_micro_suite(backend_label), emit, jax)
     _run_suite("hier_scaling_suite",

@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from .. import obs as _obs
 from ..mca import pvar
+from ..obs import sentinel as _sentinel
 from ..request.request import Request
 from ..runtime import progress as _progress
 from ..utils.errors import ErrorCode, MPIError
@@ -186,11 +187,18 @@ def icoll(comm, name: str, args: Tuple, kw: Optional[Dict] = None
     every family (no ``block_until_ready`` on the dispatch path)."""
     comm._check_usable()
     fn = _resolve(comm, name)
+    # contract sentinel: the call signature is derived at POSTING time
+    # (the user frame is on the stack, the per-comm posting seq is
+    # this slot); inline verification, if any, runs at execution
+    sig = _sentinel.note(comm, name, args, kw) if _sentinel.enabled \
+        else None
     if not comm.spans_processes:
         return async_request(fn(comm, *args, **(kw or {})))
     nested = _nested_inline(comm, fn, (comm,) + tuple(args), kw)
     if nested is not None:
         return nested
+    if sig is not None:
+        fn = _sentinel.wrap_inline(comm, sig, fn)
     op = _make_op(comm, name, fn, (comm,) + tuple(args), kw)
     req = _op_request(op)  # callback wired BEFORE the engine sees it
     _post(comm, op)
@@ -214,6 +222,10 @@ def run_blocking(comm, name: str, fn: Callable, args: Tuple,
     cur = eng.executing()
     if cur is not None and cur.key == _comm_key(comm):
         return fn(*args, **(kw or {}))
+    if _sentinel.enabled:
+        sig = _sentinel.note(comm, name, args, kw)
+        if sig is not None:
+            fn = _sentinel.wrap_inline(comm, sig, fn)
     op = _make_op(comm, name, fn, args, kw)
     _post(comm, op)
     return eng.wait(op)
@@ -228,6 +240,10 @@ def submit(comm, name: str, fn: Callable, args: Tuple,
     nested = _nested_inline(comm, fn, args, kw)
     if nested is not None:
         return nested
+    if _sentinel.enabled:
+        sig = _sentinel.note(comm, name, args, kw)
+        if sig is not None:
+            fn = _sentinel.wrap_inline(comm, sig, fn)
     op = _make_op(comm, name, fn, args, kw)
     req = _op_request(op)
     _post(comm, op)
@@ -257,20 +273,36 @@ def persistent(comm, name: str, args: Tuple, kw: Optional[Dict] = None
     kw = kw or {}
     if name == "barrier" and not comm.spans_processes:
         ifn = comm.c_coll.get("ibarrier")
-        if ifn is not None:
-            fire = lambda: async_request(ifn(comm))  # noqa: E731
-        else:
-            fire = comm.ibarrier  # provider thread fallback
+
+        def fire() -> Request:
+            if ifn is not None:
+                if _sentinel.enabled:
+                    _sentinel.note(comm, "barrier")
+                return async_request(ifn(comm))
+            # provider thread fallback runs comm.barrier(), whose
+            # _coll wrapper notes the signature itself — noting here
+            # too would double-count the one collective
+            return comm.ibarrier()
     else:
         fn = _resolve(comm, name)
         if comm.spans_processes:
             def fire() -> Request:
-                op = _make_op(comm, name, fn, (comm,) + tuple(args), kw)
+                # each start() is one collective round: it takes its
+                # own posting-seq slot in the comm's signature chain
+                run = fn
+                if _sentinel.enabled:
+                    sig = _sentinel.note(comm, name, args, kw)
+                    if sig is not None:
+                        run = _sentinel.wrap_inline(comm, sig, fn)
+                op = _make_op(comm, name, run, (comm,) + tuple(args),
+                              kw)
                 inner = _op_request(op)
                 _post(comm, op)
                 return inner
         else:
             def fire() -> Request:
+                if _sentinel.enabled:
+                    _sentinel.note(comm, name, args, kw)
                 return async_request(fn(comm, *args, **kw))
 
     def start(req) -> None:

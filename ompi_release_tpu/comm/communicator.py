@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..mca import pvar
+from ..obs import sentinel as _sentinel
 from ..utils import output
 from ..utils.errors import Errhandler, ErrorCode, MPIError, ERRORS_ARE_FATAL
 from .group import Group, UNDEFINED
@@ -133,6 +134,10 @@ class Communicator:
             from ..ft import ulfm as _ulfm_slot
 
             _ulfm_slot.state().clear_revoked(cid)
+            # the evicted ancestor's sentinel chain goes with it: a
+            # leftover posting seq would false-mismatch the rebuilt
+            # comm against a restarted-from-zero replacement
+            _sentinel.clear_chain(cid)
             self.cid = cid
         else:
             self.cid = _next_cid(internal)
@@ -351,6 +356,7 @@ class Communicator:
             except MPIError:
                 pass  # already freed
         _comm_registry.pop(self.cid, None)
+        _sentinel.clear_chain(self.cid)
         self._freed = True
         _comm_count.add(-1)
 
@@ -612,6 +618,16 @@ class Communicator:
                 f"no {op_name} implementation installed on {self.name}",
             )
         if not self.spans_processes:
+            if _sentinel.enabled:
+                # contract sentinel: in-process collectives fold into
+                # the comm's signature chain too (chain determinism,
+                # the post-hoc journal record); spanning comms note
+                # inside nbc.run_blocking where the args are bound
+                def noted(comm_, *a, **k):
+                    _sentinel.note(self, op_name, a, k)
+                    return fn(comm_, *a, **k)
+
+                return noted
             return fn
         # fast ULFM fail: a collective involves every member, so a
         # known-failed member process fails the op NOW with the typed
@@ -887,6 +903,11 @@ class Communicator:
             return _nbc.icoll(self, "barrier", ())
         fn = self.c_coll.get("ibarrier")
         if fn is not None:
+            if _sentinel.enabled:
+                # the native async-dispatch branch bypasses both the
+                # _coll wrapper and nbc.icoll — without this note it
+                # would be the one unhashed collective entry
+                _sentinel.note(self, "barrier")
             return _nbc.async_request(fn(self))
 
         import threading

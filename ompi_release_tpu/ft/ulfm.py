@@ -192,6 +192,13 @@ class FtState:
         if first:
             revokes.add()
             _log.verbose(1, f"cid {cid} revoked")
+            if _obs.enabled:
+                # the revoke lands in the span journal (epoch in the
+                # peer slot) so tpu-doctor report's incident timeline
+                # can place it between the failure and the recovery
+                _obs.record("ft_revoke", "ft", _time.perf_counter(),
+                            0.0, peer=(epoch if epoch >= 0
+                                       else self.epoch), comm_id=cid)
             # queued (not yet running) schedules on the revoked comm
             # complete in error without running: their wire exchanges
             # would only park peers on a poisoned channel

@@ -117,9 +117,11 @@ def enable(size: int = None) -> None:
     if int(size) != journal.size:
         journal.resize(int(size))
     enabled = True
+    from . import sentinel as _sentinel
     from . import watchdog as _wd
 
     _wd.refresh(True)
+    _sentinel.refresh(True)
     # obs turned on AFTER mpi.init() (Runtime.init only installs the
     # flight-recorder signal handlers when obs was already on): the
     # documented `kill -USR1` dump must work for mid-run enables too.
@@ -143,9 +145,11 @@ def enable(size: int = None) -> None:
 def disable() -> None:
     global enabled
     enabled = False
+    from . import sentinel as _sentinel
     from . import watchdog as _wd
 
     _wd.refresh(False)
+    _sentinel.refresh(False)
 
 
 def is_enabled() -> bool:
@@ -168,8 +172,10 @@ if (os.environ.get("OMPI_TPU_OBS", "").strip().lower()
     enable()
 
 # convenience: obs.export.dump_chrome_trace(...), obs.skew, the stall
-# watchdog, the continuous sampler, and the doctor merge — imported
-# last so their journal/pvar imports see a fully-initialized package
-# (sampler import also registers the obs_sample_* cvars and the
-# obs_series_points / obs_sample_overhead_seconds pvars)
-from . import export, sampler, skew, watchdog  # noqa: E402,F401
+# watchdog, the continuous sampler, the collective contract sentinel,
+# and the doctor merge — imported last so their journal/pvar imports
+# see a fully-initialized package (sampler import also registers the
+# obs_sample_* cvars and the obs_series_points /
+# obs_sample_overhead_seconds pvars; sentinel registers obs_sentinel
+# and the sentinel_ops_hashed / sentinel_mismatches pvars)
+from . import export, sampler, sentinel, skew, watchdog  # noqa: E402,F401

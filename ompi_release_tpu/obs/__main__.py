@@ -146,7 +146,46 @@ def selftest() -> int:
     print(f"sampler: {len(pts)} points "
           f"(overhead {float(ov.read()) * 1e3:.3f} ms)")
 
-    # 6. coll driver plan-cache statistics (registered at driver
+    # 6. collective contract sentinel: hash-chain determinism across
+    # two identical op sequences, divergence detected on the third,
+    # and the journal-event round-trip the doctor's contracts
+    # alignment parses
+    from ..mca import var as _var
+    from . import sentinel as _sentinel
+
+    _sentinel._reset_for_tests()
+    _var.set_value("obs_sentinel", 1)
+    _sentinel.refresh(True)
+    assert _sentinel.enabled and _sentinel.mode() == 1
+    seqs = (("allreduce", "sum", "float32", 1024, -1),
+            ("bcast", "-", "float32", 1024, 0),
+            ("reduce", "max", "int32", 64, 2))
+    for cid in (101, 102):
+        for fam, op_n, dt, cnt, root in seqs:
+            _sentinel.record_sig(cid, fam, op_n, dt, cnt, root,
+                                 site="selftest.py:1")
+    assert _sentinel.chain_of(101) == _sentinel.chain_of(102) != 0, (
+        "identical op sequences must fold to identical chains")
+    _sentinel.record_sig(101, "allreduce", "sum", "float64", 1024, -1,
+                         site="selftest.py:2")
+    _sentinel.record_sig(102, "allreduce", "sum", "float32", 1024, -1,
+                         site="selftest.py:2")
+    assert _sentinel.chain_of(101) != _sentinel.chain_of(102), (
+        "divergent third op must split the chains")
+    last = [s for s in journal.snapshot() if s.layer == "sentinel"][-1]
+    parsed = _sentinel.parse_op(last.op)
+    assert parsed is not None and parsed["site"] == "selftest.py:2"
+    assert parsed["canon"] == "allreduce|sum|float32|1024|-1", parsed
+    snap = _sentinel.chains_snapshot()
+    assert snap["comms"]["101"]["next_seq"] == 4
+    assert float(pvar.PVARS.lookup("sentinel_ops_hashed").read()) >= 8
+    _var.VARS.unset("obs_sentinel")
+    _sentinel.refresh(True)
+    assert not _sentinel.enabled
+    print("sentinel: chain determinism + divergence detection ok "
+          f"(chain {snap['comms']['101']['chain']})")
+
+    # 7. coll driver plan-cache statistics (registered at driver
     # import; sum = hits, count = invocations → sum/count = hit ratio)
     from ..coll import driver as _coll_driver  # noqa: F401
 

@@ -310,6 +310,289 @@ def series_rates(merged: List[Dict[str, Any]]
     return out
 
 
+# ---------------------------------------------------------------------------
+# collective contract alignment (obs/sentinel.py signature events)
+# ---------------------------------------------------------------------------
+
+
+def sentinel_records(dumps: List[Dict[str, Any]],
+                     directory: Optional[str] = None
+                     ) -> Dict[int, Dict[int, Dict[int, Dict[str, Any]]]]:
+    """Per-comm signature records: cid -> pidx -> posting seq ->
+    ``{"canon", "family", "epoch", "site"}``. Two sources, deduped by
+    (pidx, cid, seq):
+
+    - journal spans with layer ``"sentinel"`` (finalize dumps and
+      postmortem journal tails — ``load_dir`` already folds both);
+    - the per-comm last-N signature rings: the ``"sentinel"``
+      watchdog-contributor block of postmortem files under
+      ``directory`` AND the finalize dump's ``meta["sentinel"]`` —
+      both survive a journal wrap past the divergent round.
+    """
+    from .sentinel import parse_op
+
+    out: Dict[int, Dict[int, Dict[int, Dict[str, Any]]]] = {}
+
+    def put(pidx: int, cid: int, seq: int, rec: Dict[str, Any]) -> None:
+        out.setdefault(cid, {}).setdefault(pidx, {}).setdefault(seq, rec)
+
+    def put_rings(pidx: int, sent: Any) -> None:
+        if not isinstance(sent, dict):
+            return
+        for cid_s, ent in (sent.get("comms") or {}).items():
+            for drec in ent.get("last") or ():
+                canon = str(drec.get("canon", ""))
+                put(pidx, int(cid_s), int(drec.get("seq", -1)),
+                    {"canon": canon,
+                     "family": canon.split("|", 1)[0],
+                     "epoch": int(drec.get("epoch", 0)),
+                     "site": str(drec.get("site", "?"))})
+
+    for d in dumps:
+        pidx = int(d["meta"].get("pidx", 0))
+        for s in d["spans"]:
+            if s.get("layer") != "sentinel":
+                continue
+            parsed = parse_op(str(s.get("op", "")))
+            if parsed is None:
+                continue
+            put(pidx, int(s.get("comm", -1)), int(s.get("peer", -1)),
+                parsed)
+        put_rings(pidx, d["meta"].get("sentinel"))
+    if directory:
+        for p in sorted(glob.glob(os.path.join(directory,
+                                               "postmortem-*.json"))):
+            with open(p) as f:
+                pm = json.load(f)
+            put_rings(int((pm.get("rank") or {}).get("pidx", 0)),
+                      pm.get("sentinel"))
+    return out
+
+
+def _first_divergence(per_pid: Dict[int, Dict[int, Dict[str, Any]]]
+                      ) -> Optional[Dict[str, Any]]:
+    """The first contract divergence of one comm's per-proc signature
+    sequences, or None. Procs are compared only over posting seqs
+    every window can still see (ring journals keep the newest spans;
+    a seq below a proc's window floor is wrap loss, not evidence)."""
+    participants = sorted(per_pid)
+    lo = {p: min(per_pid[p]) for p in participants}
+    hi = {p: max(per_pid[p]) for p in participants}
+    all_seqs = sorted({s for recs in per_pid.values() for s in recs})
+    for seq in all_seqs:
+        present = {p: per_pid[p][seq] for p in participants
+                   if seq in per_pid[p]}
+        # a proc whose whole window sits PAST seq only wrapped; a proc
+        # whose window ENDS before seq never posted it — the missing
+        # participant (the hung-run shape: survivors at seq k+1, the
+        # desynced rank's chain stops at k)
+        missing = [p for p in participants
+                   if seq not in per_pid[p] and hi[p] < seq]
+        gapped = [p for p in participants
+                  if seq not in per_pid[p]
+                  and lo[p] <= seq <= hi[p]]
+        if missing:
+            return {"kind": "missing_participant", "seq": seq,
+                    "missing": missing,
+                    "posted": {p: r for p, r in present.items()},
+                    "last": {p: per_pid[p][hi[p]] for p in missing}}
+        if gapped or len(present) < len(participants):
+            continue  # journal gap / wrap: not comparable at this seq
+        canons = {p: r["canon"] for p, r in present.items()}
+        if len(set(canons.values())) > 1:
+            # the expected signature is the MAJORITY canon (ties break
+            # to the lowest pidx's), so the culprit is attributed even
+            # when proc 0 itself is the desynced rank
+            votes: Dict[str, int] = {}
+            for p in participants:
+                votes[canons[p]] = votes.get(canons[p], 0) + 1
+            expected_canon = max(
+                votes, key=lambda c: (votes[c], -min(
+                    p for p in participants if canons[p] == c)))
+            divergent = next(p for p in participants
+                             if canons[p] != expected_canon)
+            agree = [p for p in participants
+                     if canons[p] == expected_canon]
+            authority = agree[0]
+            nxt_a = per_pid[authority].get(seq + 1)
+            nxt_d = per_pid[divergent].get(seq + 1)
+            swap = (nxt_a is not None and nxt_d is not None
+                    and nxt_d["canon"] == canons[authority]
+                    and nxt_a["canon"] == canons[divergent])
+            return {"kind": ("posting_order_swap" if swap
+                             else "signature_mismatch"),
+                    "seq": seq, "divergent": divergent,
+                    "agreeing": agree,
+                    "expected": present[authority],
+                    "actual": present[divergent]}
+        epochs = {p: int(r.get("epoch", 0)) for p, r in present.items()}
+        if len(set(epochs.values())) > 1:
+            # transient skew is legal: FT notices propagate
+            # asynchronously over lifelines, so a healthy rank can
+            # post one round with a one-behind epoch view. Only a
+            # skew that never converges over the remaining common
+            # window is the stale-epoch-survivor signal.
+            if _epochs_converge_later(per_pid, participants, seq):
+                continue
+            stale = min(epochs, key=lambda p: (epochs[p], p))
+            fresh = max((p for p in participants if p != stale),
+                        key=lambda p: (epochs[p], -p))
+            return {"kind": "epoch_skew", "seq": seq,
+                    "divergent": stale, "epochs": epochs,
+                    "expected": present[fresh],
+                    "actual": present[stale]}
+    return None
+
+
+def _epochs_converge_later(per_pid, participants, seq: int) -> bool:
+    """True when some LATER seq present on every participant shows one
+    agreed epoch — the skew at ``seq`` was notice-propagation lag, not
+    a stale survivor."""
+    later = sorted(s for s in per_pid[participants[0]] if s > seq)
+    for s in later:
+        if any(s not in per_pid[p] for p in participants):
+            continue
+        es = {int(per_pid[p][s].get("epoch", 0)) for p in participants}
+        if len(es) == 1:
+            return True
+    return False
+
+
+def contract_report(dumps: List[Dict[str, Any]],
+                    directory: Optional[str] = None
+                    ) -> Tuple[str, Dict[str, Any]]:
+    """Align per-comm posting sequences across ranks and name the
+    first divergence per comm — the post-hoc half of the collective
+    contract sentinel (``obs_sentinel=1``). Works from finalize-time
+    journals AND from watchdog postmortems of a hung run."""
+    table = sentinel_records(dumps, directory=directory)
+    lines = ["tpu-doctor collective-contract report"]
+    comms: Dict[str, Any] = {}
+    divergences = 0
+    for cid in sorted(table):
+        per_pid = table[cid]
+        participants = sorted(per_pid)
+        n_sigs = sum(len(v) for v in per_pid.values())
+        if len(participants) < 2:
+            comms[str(cid)] = {"participants": participants,
+                               "signatures": n_sigs,
+                               "divergence": None}
+            continue
+        div = _first_divergence(per_pid)
+        comms[str(cid)] = {"participants": participants,
+                           "signatures": n_sigs, "divergence": div}
+        if div is None:
+            lines.append(
+                f"  comm {cid}: {n_sigs} signature(s) aligned across "
+                f"procs {participants} — no divergence")
+            continue
+        divergences += 1
+        seq = div["seq"]
+        if div["kind"] == "missing_participant":
+
+            def fmt_last(p):
+                r = div["last"][p]
+                return f"proc {p} last posted {r['canon']} from " \
+                       f"{r['site']}"
+
+            posted = next(iter(div["posted"].values()), None)
+            lines.append(
+                f"  comm {cid}: DESYNC at seq {seq} — "
+                f"proc(s) {div['missing']} never posted it; "
+                f"procs {sorted(div['posted'])} posted "
+                f"{posted['canon'] if posted else '?'} from "
+                f"{posted['site'] if posted else '?'}; "
+                + "; ".join(fmt_last(p) for p in div["missing"]))
+        elif div["kind"] == "epoch_skew":
+            lines.append(
+                f"  comm {cid}: DESYNC at seq {seq} — epoch skew: "
+                f"proc {div['divergent']} posted at epoch "
+                f"{div['epochs'][div['divergent']]} where others were "
+                f"at {max(div['epochs'].values())} (stale-epoch "
+                f"survivor?)")
+        else:
+            exp, act = div["expected"], div["actual"]
+            tag = (" [posting-order swap: the two procs posted the "
+                   "same ops in opposite order at seq "
+                   f"{seq}/{seq + 1}]"
+                   if div["kind"] == "posting_order_swap" else "")
+            lines.append(
+                f"  comm {cid}: DESYNC at seq {seq} — proc "
+                f"{div['divergent']} posted {act['canon']} from "
+                f"{act['site']} where proc(s) {div['agreeing']} "
+                f"posted {exp['canon']} from {exp['site']}{tag}")
+    if not table:
+        lines.append("  no sentinel signature events found (run with "
+                     "--mca obs_sentinel 1, plus obs_dump_dir or a "
+                     "postmortem dir)")
+    elif not divergences:
+        lines.append("  all collective call streams agree")
+    return "\n".join(lines), {"comms": comms,
+                              "divergences": divergences}
+
+
+# ---------------------------------------------------------------------------
+# incident timeline (ft journal events: failures, revokes, recoveries)
+# ---------------------------------------------------------------------------
+
+
+def incident_timeline(dumps: List[Dict[str, Any]]
+                      ) -> List[Dict[str, Any]]:
+    """The fleet's fault-tolerance story from merged journals: every
+    ``ft_failure`` / ``ft_revoke`` / ``ft_recovery`` span (PR 9
+    records them; this renders them), clock-corrected and sorted.
+    Field use per event kind follows the emitters: failure carries
+    (peer=failed pidx, comm=epoch), revoke (comm=cid, peer=epoch),
+    recovery (comm=new cid, peer=step, dt=duration)."""
+    evs: List[Dict[str, Any]] = []
+    for d in dumps:
+        pidx = int(d["meta"].get("pidx", 0))
+        for s in _corrected(d):
+            if s["layer"] != "ft":
+                continue
+            op = s["op"]
+            ev = {"ts": s["ts"], "pidx": pidx, "op": op}
+            if op == "ft_failure":
+                ev.update(failed_pidx=int(s.get("peer", -1)),
+                          epoch=int(s.get("comm", 0)))
+            elif op == "ft_revoke":
+                ev.update(cid=int(s.get("comm", -1)),
+                          epoch=int(s.get("peer", 0)))
+            elif op == "ft_recovery":
+                ev.update(new_cid=int(s.get("comm", -1)),
+                          step=int(s.get("peer", -1)),
+                          duration_s=float(s.get("dt", 0.0)))
+            evs.append(ev)
+    evs.sort(key=lambda e: e["ts"])
+    return evs
+
+
+def incident_lines(events: List[Dict[str, Any]]) -> List[str]:
+    """Render the timeline for the report (times relative to the
+    first incident)."""
+    if not events:
+        return []
+    t0 = events[0]["ts"]
+    lines = ["  incident timeline (ft events across merged journals):"]
+    for e in events:
+        rel = e["ts"] - t0
+        if e["op"] == "ft_failure":
+            what = (f"learned process {e['failed_pidx']} FAILED "
+                    f"(epoch -> {e['epoch']})")
+        elif e["op"] == "ft_revoke":
+            what = f"revoked cid {e['cid']} (epoch {e['epoch']})"
+        elif e["op"] == "ft_recovery":
+            # the peer slot carries the step the FAILURE hit (the
+            # rollback target is only in the ft_steps_lost pvar)
+            what = (f"recovered in {e['duration_s']:.3f}s (resumed "
+                    f"on cid {e['new_cid']}, failure at step "
+                    f"{e['step']})")
+        else:
+            what = e["op"]
+        lines.append(f"    +{rel:8.3f}s proc {e['pidx']}: {what}")
+    return lines
+
+
 def _coll_rounds(dumps: List[Dict[str, Any]]
                  ) -> Dict[Tuple[int, str], Dict[int, List[Dict]]]:
     """(comm, op) -> pidx -> that pid's coll-layer spans in call
@@ -406,7 +689,11 @@ def skew_report(dumps: List[Dict[str, Any]],
                     f"{r['coll_mb_per_s']:.2f} MB/s, "
                     f"busy {r['coll_busy_frac'] * 100:.1f}% over "
                     f"{r['window_s']:.1f}s sampled")
+    incidents = incident_timeline(dumps)
+    if incidents:
+        lines.extend(incident_lines(incidents))
     return "\n".join(lines), {"rounds": rounds_out,
                               "critical_path": crit_count,
                               "sampled_rates": {str(p): r for p, r
-                                                in rates.items()}}
+                                                in rates.items()},
+                              "incidents": incidents}

@@ -119,6 +119,13 @@ def rank_dump(clock_sync: bool = True) -> Dict[str, Any]:
             pass  # offset stays at its last/None value
     meta["clock_offset_s"] = _obs._clock_state["offset_s"]
     meta["clock_rtt_s"] = _obs._clock_state["rtt_s"]
+    from . import sentinel as _sentinel
+
+    if _sentinel.enabled:
+        # the per-comm signature chains ride the finalize dump: the
+        # doctor's contracts alignment can cross-check chain values
+        # even when the journal ring wrapped past early rounds
+        meta["sentinel"] = _sentinel.chains_snapshot()
     return {"meta": meta,
             "spans": [s.asdict() for s in _JOURNAL.snapshot()]}
 

@@ -954,6 +954,38 @@ class WireRouter:
                 )
             raise
 
+    def sentinel_exchange(self, comm, payload: bytes,
+                          timeout_ms: int = 60_000) -> Dict[int, bytes]:
+        """Collective contract sentinel piggyback path (obs_sentinel=2):
+        exchange one small signature frame with every member process
+        on the comm's ctl channel, strictly BEFORE the round's first
+        payload frame. Safe to interleave with barrier tokens: every
+        process performs this exchange in the same posting-order slot
+        (the progress engine serializes collectives per comm), so the
+        per-(src, tag) FIFO keeps signature frames ahead of the
+        round's own ctl traffic — and a frame that still arrives out
+        of protocol is a loud ERR_INTERN, never silently consumed as
+        a token. Sends go out to every peer before any receive parks,
+        so a desynced-but-present peer always answers (both sides
+        detect the mismatch; neither hangs)."""
+        from ..obs import sentinel as _sentinel
+
+        topo = proc_topology(comm)
+        for p in topo.peers:
+            self.ctl_send(comm, p, _sentinel.SIG_MAGIC + payload)
+        out: Dict[int, bytes] = {}
+        for p in topo.peers:
+            raw = self.ctl_recv(comm, p, timeout_ms=timeout_ms)
+            if not raw.startswith(_sentinel.SIG_MAGIC):
+                raise MPIError(
+                    ErrorCode.ERR_INTERN,
+                    f"sentinel exchange on {comm.name} popped a "
+                    f"non-signature ctl frame from process {p} — "
+                    "collective/ctl ordering diverged",
+                )
+            out[p] = raw[len(_sentinel.SIG_MAGIC):]
+        return out
+
     def ctl_send(self, comm, peer_pidx: int, payload: bytes = b"") -> None:
         _ft().check_wait(comm.cid, (peer_pidx,), "ctl send",
                          epoch0=getattr(comm, "_ft_epoch0", 0))

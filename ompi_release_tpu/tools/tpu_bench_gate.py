@@ -55,9 +55,11 @@ LOWER_BETTER = {"s", "seconds", "us", "us/hop", "hol_wait_s",
 #: higher-better — less comm time exposed on the critical path
 METRIC_HIGHER_BETTER_PREFIXES = ("overlap_",)
 #: ...and the ft_recovery suite's lines (recovery wall time, steps
-#: recomputed after rollback) are all lower-better — a recovery-time
-#: regression gates exactly like a latency regression
-METRIC_LOWER_BETTER_PREFIXES = ("ft_",)
+#: recomputed after rollback) and the contract-sentinel suite's lines
+#: (per-collective overhead, enabled AND disabled legs) are all
+#: lower-better — the sentinel's "near-zero overhead when off" claim
+#: is gate-enforced across rounds, like any latency regression
+METRIC_LOWER_BETTER_PREFIXES = ("ft_", "sentinel_")
 
 DEFAULT_SIGMA = 4.0
 #: relative noise floor: the bench's own ceiling docs put single-run

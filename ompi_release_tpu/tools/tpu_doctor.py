@@ -13,6 +13,10 @@ Usage::
     python -m ompi_release_tpu.tools.tpu_doctor report DIR
     python -m ompi_release_tpu.tools.tpu_doctor postmortem DIR
 
+    # ranks ran with --mca obs_sentinel 1: align per-comm collective
+    # call signatures across ranks and name the first desync
+    python -m ompi_release_tpu.tools.tpu_doctor contracts DIR
+
     # fetch a live process's journal over the tpu-server journal RPC
     python -m ompi_release_tpu.tools.tpu_doctor collect host:port -o DIR
 
@@ -93,6 +97,16 @@ def _cmd_series(args) -> int:
         print(f"tpu-doctor: merged {len(docs)} rank series "
               f"({len(merged)} clock-corrected points) -> {out}")
     return 0
+
+
+def _cmd_contracts(args) -> int:
+    """Collective-contract alignment: per-comm posting sequences of
+    sentinel call signatures, merged across ranks; exit 3 when a
+    divergence was found (0 = all call streams agree)."""
+    dumps = _doctor.load_dir(args.dir)
+    text, data = _doctor.contract_report(dumps, directory=args.dir)
+    print(text)
+    return 3 if data["divergences"] else 0
 
 
 def _cmd_postmortem(args) -> int:
@@ -193,6 +207,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="emit OpenMetrics-with-timestamps text "
                         "instead of JSONL")
     p.set_defaults(fn=_cmd_series)
+
+    p = sub.add_parser(
+        "contracts",
+        help="align per-comm collective call signatures "
+             "(obs_sentinel >= 1) across rank journals or watchdog "
+             "postmortems and name the first desync: missing "
+             "participant, op/dtype/count mismatch, posting-order "
+             "swap, or epoch skew — with both call sites (exit 3 on "
+             "divergence)")
+    p.add_argument("dir", help="directory of journal-p*.json and/or "
+                               "postmortem-*.json dumps")
+    p.set_defaults(fn=_cmd_contracts)
 
     p = sub.add_parser("postmortem", help="summarize flight-recorder "
                                           "dumps: stuck ops + waiting "

@@ -12,7 +12,9 @@ Three modes:
   metrics plane's dashboard. Renders one row per controller process
   from the sampler's time-series points — collective rate, bytes/s,
   latency percentiles (from the ``coll_*_latency`` histogram pvar
-  deltas), mean arrival skew, and inline STALL / STALE flags — either
+  deltas), mean arrival skew, and inline STALL / DESYNC / STALE
+  flags (DESYNC counts the contract sentinel's detected cross-rank
+  collective mismatches, ``sentinel_mismatches`` deltas) — either
   live from a job HNP's TAG_SERIES store (discovered via the session
   dir when no target is given) or offline from ``series-p*.jsonl``
   dumps. The refresh loop reconnects with backoff and marks rows
@@ -45,15 +47,18 @@ def summarize_points(points: List[Dict[str, Any]],
     of them) into the dashboard row: collective ops/s and MB/s from
     the per-cid ``coll_ops``/``coll_bytes`` deltas, p50/p99 latency
     from the ``coll_*_latency`` histogram delta buckets, mean skew
-    from ``coll_*_skew_seconds``, and a stall flag from
-    ``obs_stalls_detected`` deltas. ``now`` defaults to the newest
-    point's time (dump replay); pass the live clock for live feeds."""
+    from ``coll_*_skew_seconds``, a stall flag from
+    ``obs_stalls_detected`` deltas, and a desync flag from the
+    contract sentinel's ``sentinel_mismatches`` deltas. ``now``
+    defaults to the newest point's time (dump replay); pass the live
+    clock for live feeds."""
     from ..obs.sampler import percentile
 
     if not points:
         return {"ops_s": None, "mb_s": None, "p50_ms": None,
                 "p99_ms": None, "skew_ms": None, "stalls": 0,
-                "cids": [], "age_s": None, "window_s": 0.0}
+                "desyncs": 0, "cids": [], "age_s": None,
+                "window_s": 0.0}
     ts = [float(p["t"]) for p in points]
     t_new = max(ts)
     if now is None:
@@ -62,7 +67,7 @@ def summarize_points(points: List[Dict[str, Any]],
     ops = bytes_ = 0.0
     lat_buckets: Dict[float, float] = {}
     skew_sum = skew_count = 0.0
-    stalls = 0.0
+    stalls = desyncs = 0.0
     cids = set()
     t_used = []
     for p in points:
@@ -87,6 +92,8 @@ def summarize_points(points: List[Dict[str, Any]],
             skew_count += float(v.get("count", 0.0))
         elif name == "obs_stalls_detected":
             stalls += float(v or 0)
+        elif name == "sentinel_mismatches":
+            desyncs += float(v or 0)
     # a window holding a single sampler tick has NO measurable span —
     # rates are unknown then, not "whatever 1 ms would imply" (a lone
     # 10-op tick must render '-', never 10000 coll/s)
@@ -102,6 +109,7 @@ def summarize_points(points: List[Dict[str, Any]],
         "p99_ms": p99 * 1e3 if p99 is not None else None,
         "skew_ms": (skew_sum / skew_count * 1e3) if skew_count else None,
         "stalls": int(stalls),
+        "desyncs": int(desyncs),
         "cids": sorted(c for c in cids if c >= 0),
         "age_s": max(now - t_new, 0.0),
         "window_s": window or 0.0,
@@ -133,6 +141,8 @@ def render_fleet(docs: List[Dict[str, Any]], window_s: float = 15.0,
         flags = []
         if s["stalls"]:
             flags.append(f"STALL×{s['stalls']}")
+        if s["desyncs"]:
+            flags.append(f"DESYNC×{s['desyncs']}")
         age = m.get("push_age_s")
         if age is None:
             age = s["age_s"]

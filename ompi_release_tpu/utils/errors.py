@@ -55,6 +55,13 @@ class ErrorCode(enum.IntEnum):
     # communicator are interrupted with ERR_REVOKED
     ERR_PROC_FAILED = 75   # MPIX_ERR_PROC_FAILED
     ERR_REVOKED = 76       # MPIX_ERR_REVOKED
+    # collective contract violation (obs/sentinel.py inline mode): a
+    # peer rank's call signature — family/op/dtype/count/root at the
+    # same per-comm posting seq — diverged from this rank's. MPI has
+    # no class for this (it is erroneous-program territory MUST-style
+    # tools diagnose); raising it typed within the round beats the
+    # alternative, an unexplained hang
+    ERR_COLL_MISMATCH = 77
 
 
 class MPIError(RuntimeError):
