@@ -1496,6 +1496,270 @@ def _overlap_micro_suite(backend_label):
     return lines  # main()'s emit() stamps the backend label
 
 
+#: worker app for the tree_overlap micro-suite: a REAL 3-process
+#: tpurun job training a tiny models/transformer.TpuLM locally per
+#: process (the data-parallel trainer shape) and syncing the WHOLE
+#: gradient pytree through parallel/tree.TreeSync — per-leaf blocking
+#: allreduces vs one planned fused pass overlapped under the next
+#: step's real fwd/bwd, engine vs polling legs; plus a HostPipeline
+#: microbatch leg with blocking vs nonblocking stage-boundary
+#: transfers. Process 0 writes the JSON lines to OMPITPU_LOOPBACK_OUT.
+_TREE_BENCH_APP = r'''
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# distinct shm identity per worker: comm rides the DCN staged path so
+# hidden/exposed splits measure real wire time, not a memcpy
+os.environ["OMPITPU_HOST_ID"] = (
+    "treebench-" + os.environ["OMPITPU_NODE_ID"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import ompi_release_tpu as mpi
+from jax.sharding import Mesh
+from ompi_release_tpu.mca import pvar, var as mca_var
+from ompi_release_tpu.models import transformer as tfm
+from ompi_release_tpu.parallel import pp as pp_mod, tree as tree_mod
+from ompi_release_tpu.runtime.runtime import Runtime
+
+world = mpi.init()
+rt = Runtime.current()
+me = rt.bootstrap["process_index"]
+
+def _pv(name):
+    p = pvar.PVARS.lookup(name)
+    v = p.read() if p is not None else 0.0
+    return float(v) if not isinstance(v, dict) else 0.0
+
+# ---- the trainer: a tiny TpuLM on this process's 1-device mesh ------
+cfg = tfm.ModelConfig(vocab=128, d_model=64, n_layers=2, n_heads=2,
+                      head_dim=16, d_ff=192, max_seq=32,
+                      microbatches=1, dtype=jnp.float32)
+mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+            ("dp", "pp", "sp", "ep", "tp"))
+params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+loss_fn = tfm.make_forward(cfg, mesh)
+grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+rng = np.random.RandomState(me)
+toks = rng.randint(0, cfg.vocab, (4, 32)).astype(np.int32)
+tgts = rng.randint(0, cfg.vocab, (4, 32)).astype(np.int32)
+
+def grad_step():
+    _, g = grad_fn(params, toks, tgts)
+    return jax.block_until_ready(g)
+
+grads = grad_step()  # compile + first real backward
+t0 = time.perf_counter()
+grad_step()
+t_grad = time.perf_counter() - t0
+# driver-mode tree: leading member-slice axis on every leaf
+gtree = jax.tree.map(lambda g: np.asarray(g)[None], grads)
+leaves = jax.tree.leaves(gtree)
+tree_bytes = sum(l.nbytes for l in leaves)
+
+def blocking_perleaf():
+    for l in leaves:
+        world.allreduce(l)
+
+sync = tree_mod.TreeSync(world, mean=False, bucket_bytes=1 << 20)
+blocking_perleaf()          # warm per-leaf programs/channels
+sync.issue(gtree).wait()    # warm the planned pass + plan cache
+
+# comm time alone, both shapes
+world.barrier()
+t_perleaf = t_planned = None
+for _ in range(3):
+    world.barrier()
+    t0 = time.perf_counter()
+    blocking_perleaf()
+    dt = time.perf_counter() - t0
+    t_perleaf = dt if t_perleaf is None else min(t_perleaf, dt)
+    world.barrier()
+    t0 = time.perf_counter()
+    sync.issue(gtree).wait()
+    dt = time.perf_counter() - t0
+    t_planned = dt if t_planned is None else min(t_planned, dt)
+
+def compute(seconds):
+    # REAL trainer compute: fwd/bwd steps until the budget elapses
+    t_end = time.perf_counter() + seconds
+    while time.perf_counter() < t_end:
+        grad_step()
+
+t_compute = max(t_planned, t_grad, 0.02)
+results = {}
+for mode in ("engine", "polling"):
+    if mode == "engine":
+        mca_var.set_value("progress_thread", True)
+    else:
+        mca_var.VARS.unset("progress_thread")
+    world.barrier()
+    t_block = t_ovl = None
+    for _ in range(3):
+        world.barrier()
+        t0 = time.perf_counter()
+        blocking_perleaf()
+        compute(t_compute)
+        dt = time.perf_counter() - t0
+        t_block = dt if t_block is None else min(t_block, dt)
+        world.barrier()
+        h0 = _pv("nbc_hidden_seconds")
+        th0 = _pv("tree_hidden_seconds")
+        t0 = time.perf_counter()
+        pending = sync.issue(gtree)
+        compute(t_compute)
+        out = pending.wait()
+        dt = time.perf_counter() - t0
+        t_ovl = dt if t_ovl is None else min(t_ovl, dt)
+    # parity witness: planned overlapped pass == per-leaf blocking
+    ref = np.asarray(world.allreduce(
+        np.asarray(grads["embed"])[None]))
+    np.testing.assert_array_equal(np.asarray(out["embed"]), ref)
+    hidden_s = _pv("nbc_hidden_seconds") - h0
+    results[mode] = {
+        "t_block": t_block, "t_ovl": t_ovl,
+        # the gated witness: the ENGINE'S own accounting of comm time
+        # that ran under the trainer's fwd/bwd (nbc_hidden_seconds
+        # delta over the last overlapped pass vs the measured planned
+        # comm-alone time); engine ~1, polling exactly 0
+        "hidden_frac": max(0.0, min(1.0,
+                                    hidden_s / max(t_planned, 1e-9))),
+        "tree_hidden_s": _pv("tree_hidden_seconds") - th0,
+        "nbc_hidden_s": hidden_s,
+    }
+mca_var.VARS.unset("progress_thread")
+
+# ---- HostPipeline: microbatch schedule, boundary comm nb vs blocking
+# 512 KiB boundary activations (the trainer-scale shape where the
+# transfer is worth hiding) under the progress thread, so posted-early
+# irecvs/isends complete off the caller while the stage computes
+S = world.size
+m = 6
+W = rng.randn(512, 512).astype(np.float32) * 0.05
+mbs = [np.ones((256, 512), np.float32) * (k + 1) for k in range(m)]
+
+def stage_fn(x):
+    y = np.asarray(x)
+    for _ in range(3):  # one stage's compute per microbatch
+        y = np.tanh(y @ W)
+    return y
+
+mca_var.set_value("progress_thread", True)
+pp_res = {}
+for leg, nb in (("nonblocking", True), ("blocking", False)):
+    pipe = pp_mod.HostPipeline(world, stage_fn, stage=me,
+                               nonblocking=nb)
+    world.barrier()
+    pipe.run(mbs)  # warm channels
+    best = None
+    w0 = _pv("pp_boundary_wait_seconds")
+    for _ in range(3):
+        world.barrier()
+        t0 = time.perf_counter()
+        outs = pipe.run(mbs)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    # fleet-summed EXPOSED boundary wait (stage 0 never receives, so
+    # rank 0's own pvar alone would read 0)
+    mine = _pv("pp_boundary_wait_seconds") - w0
+    total = float(np.asarray(world.allreduce(
+        np.array([[mine]], np.float32)))[0, 0])
+    pp_res[leg] = {"t": best, "exposed_s": total, "out": outs}
+mca_var.VARS.unset("progress_thread")
+# parity witness: both schedules produce identical last-stage outputs
+if me == S - 1:
+    for a, b in zip(pp_res["nonblocking"]["out"],
+                    pp_res["blocking"]["out"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+if me == 0:
+    lines = [{
+        "metric": "tree_planned_pass_speedup",
+        "value": round(t_perleaf / max(t_planned, 1e-9), 4),
+        "unit": "x_vs_blocking", "vs_baseline": None,
+        "suite": "tree_overlap",
+        "t_perleaf_s": round(t_perleaf, 5),
+        "t_planned_s": round(t_planned, 5),
+        "tree_bytes": int(tree_bytes),
+        "leaves": len(leaves),
+        "t_grad_s": round(t_grad, 5),
+    }]
+    for mode, r in results.items():
+        suffix = "" if mode == "engine" else "_polling"
+        lines.append({
+            "metric": "tree_allreduce_hidden_frac" + suffix,
+            "value": round(r["hidden_frac"], 4), "unit": "frac_hidden",
+            "vs_baseline": None, "suite": "tree_overlap",
+            "t_block_s": round(r["t_block"], 5),
+            "t_overlap_s": round(r["t_ovl"], 5),
+            "t_comm_s": round(t_planned, 5),
+            "nbc_hidden_delta_s": round(r["nbc_hidden_s"], 5),
+            "tree_hidden_delta_s": round(r["tree_hidden_s"], 5),
+        })
+    lines.append({
+        "metric": "tree_overlap_speedup",
+        "value": round(results["engine"]["t_block"]
+                       / max(results["engine"]["t_ovl"], 1e-9), 4),
+        "unit": "x_vs_blocking", "vs_baseline": None,
+        "suite": "tree_overlap",
+    })
+    lines.append({
+        "metric": "tree_pp_overlap_speedup",
+        "value": round(pp_res["blocking"]["t"]
+                       / max(pp_res["nonblocking"]["t"], 1e-9), 4),
+        "unit": "x_vs_blocking", "vs_baseline": None,
+        "suite": "tree_overlap",
+        "t_blocking_s": round(pp_res["blocking"]["t"], 5),
+        "t_nonblocking_s": round(pp_res["nonblocking"]["t"], 5),
+        "exposed_blocking_s": round(pp_res["blocking"]["exposed_s"], 5),
+        "exposed_nonblocking_s": round(
+            pp_res["nonblocking"]["exposed_s"], 5),
+        "microbatches": m, "stages": S,
+    })
+    lines.append({
+        "metric": "tree_overlap_pvars", "value": None, "unit": None,
+        "vs_baseline": None, "suite": "tree_overlap",
+        "pvars": {k: v for k, v in pvar.PVARS.read_all().items()
+                  if k.startswith(("tree_", "pp_boundary",
+                                   "nbc_hidden"))},
+        "cumulative": True,
+    })
+    with open(os.environ["OMPITPU_LOOPBACK_OUT"], "w") as f:
+        json.dump(lines, f, default=str)
+world.barrier()
+mpi.finalize()
+'''
+
+
+def _tree_micro_suite(backend_label):
+    """tree_overlap lines: the planned whole-tree gradient pass vs the
+    per-leaf loop at trainer scale — a REAL 3-process tpurun job
+    computing actual models/transformer fwd/bwd gradients per step,
+    syncing the full pytree through parallel/tree.TreeSync. Reports
+    planned-vs-per-leaf comm speedup, exposed-vs-hidden comm fraction
+    (engine vs polling; nbc_hidden_seconds/tree_hidden_seconds deltas
+    are the witnesses), and the HostPipeline microbatch leg with
+    nonblocking vs blocking stage boundaries. Gate direction: tree_*
+    and frac_hidden are higher-better."""
+    import os
+
+    from ompi_release_tpu.tools.tpurun import run_loopback_app
+
+    lines = run_loopback_app(
+        3, _TREE_BENCH_APP % {"repo": os.path.dirname(
+            os.path.abspath(__file__))},
+        {}, "tree_bench.json", timeout_s=420)
+    if lines is None:
+        return [{"metric": "tree_overlap_suite", "value": None,
+                 "unit": None, "vs_baseline": None,
+                 "error": "tree_overlap bench job failed"}]
+    return lines  # main()'s emit() stamps the backend label
+
+
 #: worker app for the ft_recovery micro-suite: a REAL 3-process tpurun
 #: job under the --ft-continue policy driving an ElasticStep training
 #: loop; the sensor SIGKILLs rank 2 mid-run (kill cvars scoped by
@@ -1849,6 +2113,9 @@ def main():
     #   hier: spanning-collective inter schedules at 4 loopback procs
     #   overlap: exposed vs hidden comm time for iallreduce buckets
     #            under the async progress engine vs polling fallback
+    #   tree_overlap: planned whole-tree gradient pass vs per-leaf
+    #            loop on a real transformer trainer, hidden-comm
+    #            fraction + nonblocking pipeline boundaries
     #   ft_recovery: detect->revoke->shrink->rollback wall time of a
     #            3-proc job whose rank 2 is SIGKILLed mid-run
     #   sentinel: contract-sentinel overhead, enabled vs disabled,
@@ -1861,6 +2128,8 @@ def main():
                lambda: _hier_micro_suite(backend_label), emit, jax)
     _run_suite("overlap_suite",
                lambda: _overlap_micro_suite(backend_label), emit, jax)
+    _run_suite("tree_overlap_suite",
+               lambda: _tree_micro_suite(backend_label), emit, jax)
     _run_suite("ft_recovery_suite",
                lambda: _ft_micro_suite(backend_label), emit, jax)
 

@@ -87,7 +87,15 @@ _log = output.Stream("coll")
 
 #: collective name -> algorithms a rule may name (filled by
 #: components.py at import; kept here to avoid a cycle)
-RULE_COLLECTIVES: Dict[str, Tuple[str, ...]] = {}
+RULE_COLLECTIVES: Dict[str, Tuple[str, ...]] = {
+    # parallel/tree planned whole-tree passes register here directly
+    # (no algorithm module to cycle with): min_comm_size is the
+    # participant count, min_msg_bytes the TOTAL tree bytes, and the
+    # 5th (segsize) column the fused bucket capacity in bytes;
+    # "per_leaf" pins bucketing off. Emitted by tpu-tune
+    # --tree-buckets, consumed by parallel.tree.resolve_bucket_bytes.
+    "tree_buckets": ("auto", "fused", "per_leaf"),
+}
 
 # (path, mtime_ns, size) -> parsed rules; a rewritten file is
 # re-parsed, an unchanged one costs a stat per lookup.  mtime_ns +

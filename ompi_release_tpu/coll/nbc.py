@@ -122,6 +122,9 @@ def _op_request(op: _progress.ScheduledOp) -> Request:
         _eng.wait(_op)  # raises the schedule's error
 
     req = Request(progress_fn=prog, block_fn=block)
+    # expose the schedule handle: per-pass consumers (parallel/tree's
+    # hidden-time accounting) read its t_start/t_done/t_first_wait
+    req._sched_op = op
 
     def finish(o, _req=req) -> None:
         if o.error is None:

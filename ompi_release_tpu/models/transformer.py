@@ -37,6 +37,7 @@ from ..utils import jaxcompat as _jaxcompat
 _jaxcompat.install()  # jax.shard_map/typeof on 0.4.x jaxlibs
 
 from ..parallel import cp, ep as ep_mod, pp as pp_mod, tp as tp_mod
+from ..parallel import tree as tree_mod
 
 
 @dataclasses.dataclass(frozen=True)
@@ -113,29 +114,33 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Dict:
     return params
 
 
+#: regex partition rules, first match wins — the user-facing sharding
+#: interface (``parallel.tree.match_partition_rules``): which mesh
+#: axis owns which tensor dimension, keyed by parameter path name.
+#: Scalar/single-element leaves are never partitioned (the planner's
+#: fmengine rule), so the table only needs the real tensors.
+PARTITION_RULES = (
+    (r"^embed$", P("tp", None)),
+    (r"^ln_f$", P()),
+    (r"layers/ln[12]$", P("pp", None)),
+    (r"layers/w[qkv]$", P("pp", None, "tp")),
+    (r"layers/wo$", P("pp", "tp", None)),
+    (r"layers/router$", P("pp", None, None)),
+    (r"layers/we[12]$", P("pp", "ep", None, None)),
+    (r"layers/w1$", P("pp", None, "tp")),
+    (r"layers/w2$", P("pp", "tp", None)),
+)
+
+
 def param_specs(cfg: ModelConfig) -> Dict:
-    """PartitionSpecs matching init_params' structure (the rmaps of the
-    model: which mesh axis owns which tensor dimension)."""
-    specs = {
-        "embed": P("tp", None),
-        "ln_f": P(),
-        "layers": {
-            "ln1": P("pp", None),
-            "wq": P("pp", None, "tp"),
-            "wk": P("pp", None, "tp"),
-            "wv": P("pp", None, "tp"),
-            "wo": P("pp", "tp", None),
-            "ln2": P("pp", None),
-        },
-    }
-    if cfg.n_experts:
-        specs["layers"]["router"] = P("pp", None, None)
-        specs["layers"]["we1"] = P("pp", "ep", None, None)
-        specs["layers"]["we2"] = P("pp", "ep", None, None)
-    else:
-        specs["layers"]["w1"] = P("pp", None, "tp")
-        specs["layers"]["w2"] = P("pp", "tp", None)
-    return specs
+    """PartitionSpecs matching init_params' structure, derived by
+    matching :data:`PARTITION_RULES` against an abstract parameter
+    skeleton (``jax.eval_shape`` — no arrays materialize). An
+    unmatched leaf raises at build time, so adding a parameter without
+    a rule cannot silently default to replicated."""
+    skeleton = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg))
+    return tree_mod.match_partition_rules(PARTITION_RULES, skeleton)
 
 
 def batch_spec() -> P:

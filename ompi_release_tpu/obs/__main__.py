@@ -200,6 +200,25 @@ def selftest() -> int:
           f"invocations="
           f"{pvar.PVARS.lookup('coll_invocations').read():.0f})")
 
+    # 8. pytree planned-collective plan cache (parallel/tree): an
+    # identical tree signature must fetch the cached plan (1=hit), a
+    # different bucket capacity must build a fresh one (0), and the
+    # counts are operator-visible here
+    from ..parallel import tree as _tree
+
+    sig = [((64, 64), "float32"), ((17,), "float32"), ((8,), "int32")]
+    tp1 = _tree.plan_from_meta(sig, 1 << 20)
+    assert _tree.plan_from_meta(sig, 1 << 20) is tp1, (
+        "identical tree signatures must fetch the cached plan")
+    assert _tree.plan_from_meta(sig, 1 << 4) is not tp1
+    tc = pvar.PVARS.lookup("tree_plan_cache_hits")
+    assert tc is not None, "parallel/tree must register tree_plan_cache_hits"
+    ts = tc.read()
+    assert ts["count"] >= 3 and ts["sum"] >= 1, ts
+    print(f"tree plan cache: {int(ts['sum'])}/{int(ts['count'])} hits "
+          f"({pvar.PVARS.lookup('tree_buckets_planned').read():.0f} "
+          f"buckets planned)")
+
     disable()
     print("obs selftest: ok")
     return 0

@@ -19,5 +19,9 @@ from .mesh_axes import (  # noqa: F401
     AXIS_DP, AXIS_PP, AXIS_TP, AXIS_SP, AXIS_EP,
     build_parallel_mesh, axis_size_or_1,
 )
-from . import dp, tp, pp, sp, cp, ep, zero  # noqa: F401
+from . import dp, tp, pp, sp, cp, ep, tree, zero  # noqa: F401
 from .elastic import ElasticStep  # noqa: F401
+from .tree import (  # noqa: F401
+    TreeSync, match_partition_rules, named_tree_map, tree_allgather,
+    tree_allreduce, tree_reduce_scatter,
+)

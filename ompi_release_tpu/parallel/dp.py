@@ -34,14 +34,14 @@ Two execution modes:
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 from ..mca import var as mca_var
+from . import tree as _tree_mod
 
 
 def register_vars() -> None:
@@ -57,98 +57,24 @@ def allreduce_gradients(grads: Any, axis_name: str, *, mean: bool = True,
     """Allreduce a gradient pytree over the dp axis.
 
     Leaves smaller than ``bucket_bytes`` (default: the dp_bucket_bytes
-    config variable) are packed into flat buckets so each bucket is ONE
-    psum; large leaves go through psum individually (XLA already
-    tiles/pipelines a single large collective well).
+    config variable / tree_buckets tuned rules) are packed into flat
+    buckets so each bucket is ONE psum; large leaves go through psum
+    individually (XLA already tiles/pipelines a single large
+    collective well). The planned pass itself is
+    :func:`parallel.tree.tree_allreduce` — one planner, one plan
+    cache, one packing layout for every tree-shaped collective.
     """
-    if bucket_bytes is None:
-        bucket_bytes = mca_var.get("dp_bucket_bytes", 4 * 1024 * 1024)
-    leaves, treedef = jax.tree.flatten(grads)
-    n = lax.psum(1, axis_name)
-
-    big, small = [], []  # (index, leaf)
-    for i, leaf in enumerate(leaves):
-        (big if leaf.size * leaf.dtype.itemsize >= bucket_bytes
-         else small).append((i, leaf))
-
-    out = [None] * len(leaves)
-    for i, leaf in big:
-        r = lax.psum(leaf, axis_name)
-        out[i] = r / n if mean and jnp.issubdtype(leaf.dtype, jnp.inexact) else r
-
-    # pack small leaves into flat buckets, one psum per bucket — the
-    # bucket plan comes from the shared fusion planner
-    from ..coll.fusion import plan_buckets
-
-    buckets = plan_buckets(
-        (((i, leaf), leaf.size * leaf.dtype.itemsize, leaf.dtype)
-         for i, leaf in small),
-        bucket_bytes,
-    )
-    for bucket in buckets:
-        flat = jnp.concatenate([l.reshape(-1) for _, l in bucket])
-        red = lax.psum(flat, axis_name)
-        off = 0
-        for i, l in bucket:
-            piece = red[off:off + l.size].reshape(l.shape)
-            if mean and jnp.issubdtype(l.dtype, jnp.inexact):
-                piece = piece / n
-            out[i] = piece
-            off += l.size
-
-    return jax.tree.unflatten(treedef, out)
+    # bucket_bytes=None resolves inside the tree pass through the
+    # shared precedence (tree_buckets tuned rules > tree_bucket_bytes
+    # > dp_bucket_bytes) — resolving here would bypass the rules
+    return _tree_mod.tree_allreduce(grads, axis_name, mean=mean,
+                                    bucket_bytes=bucket_bytes)
 
 
-class PendingGradSync:
-    """In-flight overlapped gradient sync: ``wait()`` at the step
-    boundary completes every bucket (one shared engine tick advances
-    them all) and returns the reduced pytree. Holds only leaf
-    METADATA (shape, dtype) — not the gradient copies — so issue()'s
-    host staging is released for the whole overlap window."""
-
-    def __init__(self, sync: "GradientSync", treedef,
-                 meta: List[Tuple], reqs: Dict[Any, Any], plan) -> None:
-        self._sync = sync
-        self._treedef = treedef
-        self._meta = meta  # [(shape, dtype)] per leaf
-        self._reqs = reqs  # {("big", i) | ("bucket", k): Request}
-        self._plan = plan
-
-    def wait(self) -> Any:
-        from ..request import request as _req
-
-        _req.wait_all(list(self._reqs.values()))
-        big, buckets = self._plan
-        comm = self._sync.comm
-        n = comm.size
-        mean = self._sync.mean
-        out: List[Any] = [None] * len(self._meta)
-
-        def finish(i, red):
-            shape, dtype = self._meta[i]
-            red = np.asarray(red).reshape(shape)
-            if mean and np.issubdtype(dtype, np.inexact):
-                red = red / n
-            out[i] = jnp.asarray(red)
-
-        for i in big:
-            finish(i, self._reqs[("big", i)].value)
-        for k, bucket in enumerate(buckets):
-            flat = np.asarray(self._reqs[("bucket", k)].value)
-            lead = flat.shape[0]
-            flat = flat.reshape(lead, -1)
-            off = 0
-            for i in bucket:
-                shape, _ = self._meta[i]
-                w = int(np.prod(shape[1:], dtype=np.int64)) \
-                    if len(shape) > 1 else 1
-                finish(i, flat[:, off:off + w])
-                off += w
-        return jax.tree.unflatten(self._treedef, out)
-
-
-class GradientSync:
-    """Overlapped gradient-bucket allreduce for the host-driver path.
+class GradientSync(_tree_mod.TreeSync):
+    """Overlapped gradient-bucket allreduce for the host-driver path —
+    the ALLREDUCE specialization of :class:`parallel.tree.TreeSync`
+    (which also drives whole-tree reduce-scatter and allgather).
 
     Buffers follow the communicator's driver convention (leading axis
     = this process's member slices). Usage per step::
@@ -164,67 +90,14 @@ class GradientSync:
 
     def __init__(self, comm, *, mean: bool = True,
                  bucket_bytes: Optional[int] = None) -> None:
-        self.comm = comm
-        self.mean = mean
-        self._bucket_bytes = bucket_bytes
-        # (shapes/dtypes signature, bucket_bytes) -> (big, buckets);
-        # the plan is built once and fired every step
-        self._plans: Dict[Tuple, Tuple[List[int], List[List[int]]]] = {}
+        # bucket_bytes=None resolves per issue() through the shared
+        # precedence (tree_buckets rules > tree_bucket_bytes >
+        # dp_bucket_bytes), so runtime cvar tuning still applies
+        super().__init__(comm, mean=mean, bucket_bytes=bucket_bytes)
 
-    def _plan(self, leaves: List[np.ndarray],
-              bucket_bytes: int) -> Tuple[List[int], List[List[int]]]:
-        key = (tuple((l.shape, str(l.dtype)) for l in leaves),
-               bucket_bytes)
-        plan = self._plans.get(key)
-        if plan is None:
-            from ..coll.fusion import plan_buckets
 
-            big: List[int] = []
-            small = []
-            for i, leaf in enumerate(leaves):
-                nbytes = int(leaf.size) * int(leaf.dtype.itemsize)
-                if nbytes >= bucket_bytes:
-                    big.append(i)
-                else:
-                    small.append((i, nbytes, leaf.dtype))
-            buckets = plan_buckets(
-                ((i, nb, str(dt)) for i, nb, dt in small),
-                bucket_bytes)
-            plan = self._plans[key] = (big, buckets)
-        return plan
-
-    def issue(self, grads: Any) -> PendingGradSync:
-        """Issue one nonblocking allreduce per plan bucket; returns
-        without completing any of them (dispatch never blocks)."""
-        bucket_bytes = self._bucket_bytes
-        if bucket_bytes is None:
-            bucket_bytes = int(
-                mca_var.get("dp_bucket_bytes", 4 * 1024 * 1024))
-        leaves_raw, treedef = jax.tree.flatten(grads)
-        leaves = [np.asarray(l) for l in leaves_raw]
-        if not leaves or any(l.ndim == 0 for l in leaves):
-            raise ValueError(
-                "GradientSync needs non-empty driver-mode leaves, "
-                "each with a leading (member-slice) axis — 0-d scalar "
-                "leaves cannot carry the per-member axis; reshape "
-                "them to (lead, 1) or drop them from the pytree")
-        leads = {l.shape[0] for l in leaves}
-        if len(leads) != 1:
-            raise ValueError(
-                "GradientSync leaves must share one leading "
-                f"(member-slice) axis; got leading axes {sorted(leads)}")
-        lead = leads.pop()
-        big, buckets = self._plan(leaves, bucket_bytes)
-        reqs: Dict[Any, Any] = {}
-        for i in big:
-            reqs[("big", i)] = self.comm.iallreduce(leaves[i])
-        for k, bucket in enumerate(buckets):
-            flat = np.concatenate(
-                [leaves[i].reshape(lead, -1) for i in bucket], axis=1)
-            reqs[("bucket", k)] = self.comm.iallreduce(flat)
-        meta = [(l.shape, l.dtype) for l in leaves]
-        return PendingGradSync(self, treedef, meta, reqs,
-                               (big, buckets))
+#: back-compat alias: the pending handle is the shared tree-pass one
+PendingGradSync = _tree_mod.PendingTreePass
 
 
 def replicate_check(x: jax.Array, axis_name: str) -> jax.Array:

@@ -15,11 +15,23 @@ bubble is (S-1)/(M+S-1), so callers pick M >= 4*S.
 
 from __future__ import annotations
 
-from typing import Callable
+import time as _time
+from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..mca import pvar
+
+_boundary_msgs = pvar.counter(
+    "pp_boundary_msgs", "host-pipeline stage-boundary activations sent"
+)
+_boundary_wait = pvar.timer(
+    "pp_boundary_wait_seconds",
+    "EXPOSED host-pipeline boundary-transfer time (recv wait the "
+    "stage could not hide in its microbatch compute)",
+)
 
 
 def pipeline(stage_fn: Callable, stage_params, x_microbatches: jax.Array, *,
@@ -107,3 +119,86 @@ def pipeline_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
     masked = jnp.where(stage == n - 1, local, jnp.zeros_like(local))
     bcast = lax.psum(masked, axis_name)
     return masked + lax.stop_gradient(bcast - masked)
+
+
+# ---------------------------------------------------------------------------
+# host-driver microbatch schedule (spanning comms; nonblocking boundaries)
+# ---------------------------------------------------------------------------
+
+class HostPipeline:
+    """GPipe microbatch schedule driven from the host over a
+    communicator: each member rank is one stage, boundary activations
+    ride rank-to-rank messages instead of a compiled ppermute ring
+    (the multi-process trainer shape, where stages live in different
+    controller processes).
+
+    With ``nonblocking=True`` (default) every boundary transfer is an
+    ``irecv`` posted UP FRONT and an ``isend`` never waited mid-
+    schedule — the PR 7 progress engine moves the bytes while the
+    stage computes its next microbatch, so the pipeline bubble hides
+    the communication (exposed remainder witnessed by the
+    ``pp_boundary_wait_seconds`` pvar; with the ``progress_thread``
+    cvar on, spanning transfers complete off the caller entirely).
+    ``nonblocking=False`` is the blocking reference leg: every
+    boundary send+recv runs exposed between two computes — the shape
+    the bench's ``tree_pp`` lines compare against.
+
+    The schedule is the same M+S-1-tick GPipe wavefront as
+    :func:`pipeline`; results are bitwise-identical between the two
+    legs (same stage_fn calls in the same order, comm is pure data
+    movement).
+    """
+
+    def __init__(self, comm, stage_fn: Callable, *,
+                 stage: Optional[int] = None, tag: int = 71,
+                 nonblocking: bool = True) -> None:
+        self.comm = comm
+        self.stage_fn = stage_fn
+        if stage is None:
+            ranks = getattr(comm, "local_comm_ranks", None)
+            stage = ranks[0] if ranks else 0
+        self.stage = int(stage)
+        self.tag = tag
+        self.nonblocking = nonblocking
+
+    def run(self, microbatches: Sequence[Any]) -> List[Any]:
+        """Stream ``microbatches`` through this process's stage.
+        Stage 0 consumes the inputs; the last stage returns the list
+        of outputs (other stages return [])."""
+        comm, s, tag = self.comm, self.stage, self.tag
+        n_stages = comm.size
+        m = len(microbatches)
+        nb = self.nonblocking
+        recvs: List[Any] = []
+        if s > 0 and nb:
+            # every boundary irecv posts before the first compute:
+            # upstream activations land during our earlier-microbatch
+            # computes (the bubble), not in an exposed wait
+            recvs = [comm.irecv(s - 1, tag, rank=s) for _ in range(m)]
+        outs: List[Any] = []
+        sends: List[Any] = []
+        for k in range(m):
+            if s == 0:
+                x = microbatches[k]
+            else:
+                t0 = _time.perf_counter()
+                if nb:
+                    req = recvs[k]
+                    req.wait()
+                    x = req.value
+                else:
+                    x, _st = comm.recv(s - 1, tag, rank=s)
+                _boundary_wait.add(_time.perf_counter() - t0)
+            y = self.stage_fn(x)
+            if s < n_stages - 1:
+                _boundary_msgs.add()
+                if nb:
+                    # fire and keep computing; drained at schedule end
+                    sends.append(comm.isend(y, s + 1, tag, rank=s))
+                else:
+                    comm.send(y, s + 1, tag, rank=s)
+            else:
+                outs.append(y)
+        for req in sends:
+            req.wait()
+        return outs

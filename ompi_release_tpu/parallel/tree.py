@@ -1,0 +1,649 @@
+"""Pytree-native planned collectives: one fused, overlapped pass over
+whole parameter trees.
+
+A training step at scale is not one collective — it is thousands of
+per-leaf calls. The coll/tuned discipline picks one good algorithm per
+call; this layer plans the whole TREE once (the ZeRO / DDP-bucketing
+shape: Rajbhandari et al. 2020, Li et al. VLDB 2020) and then drives
+allreduce / allgather / reduce-scatter over every leaf through a
+handful of fused, overlappable transfers:
+
+rules → plan
+    :func:`match_partition_rules` turns regex rules into a
+    PartitionSpec pytree (the fmengine/alpa interface: name-matched
+    specs, scalar leaves never partitioned). :func:`plan_tree` buckets
+    the leaves per (op, dtype) through the ONE shared fusion planner
+    (:func:`coll.fusion.plan_buckets`) and caches the plan per tree
+    signature — plan once, fire every step.
+
+SPMD pass (inside ``shard_map``)
+    :func:`tree_allreduce` / :func:`tree_reduce_scatter` /
+    :func:`tree_allgather`: one ``lax.psum`` / ``psum_scatter`` /
+    ``all_gather`` per bucket instead of one per leaf.
+    ``parallel/zero.py`` and ``parallel/dp.py`` are thin wrappers over
+    these. ``bucket_bytes=0`` selects the per-leaf reference path; the
+    planned path is bitwise-identical to it (buckets pack a rank-major
+    interleaved layout, so every element is reduced/scattered across
+    exactly the same participants in the same slot).
+
+driver pass (host-driver comms, the progress-engine payoff)
+    :class:`TreeSync`: one nonblocking collective per bucket issued up
+    front, caller compute overlaps the wire traffic, ``wait()`` lands
+    at the step boundary (``parallel/dp.GradientSync`` is now the
+    allreduce specialization). Hidden comm time is witnessed by the
+    ``tree_hidden_seconds`` pvar (the per-schedule accounting of
+    ``runtime/progress.py``, summed per pass).
+
+Bucket sizing is tunable: explicit argument > ``tree_buckets`` dynamic
+rule lines (``tpu-tune --tree-buckets`` emits them; the 5th column is
+the bucket size, the algorithm column is ``fused``/``per_leaf``) >
+``tree_bucket_bytes`` cvar > ``dp_bucket_bytes``.
+
+pvars: ``tree_buckets_planned``, ``tree_plan_cache_hits`` (1=hit,
+0=build; sum/count = hit ratio, printed by ``obs --selftest``),
+``tree_passes``, ``tree_hidden_seconds``. Journal spans are gated on
+``_obs.enabled`` so the hot path stays one attribute check.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time as _time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs as _obs
+from ..coll.fusion import plan_buckets
+from ..mca import pvar
+from ..mca import var as mca_var
+
+_buckets_planned = pvar.counter(
+    "tree_buckets_planned",
+    "fused buckets produced by tree-collective plan builds "
+    "(big per-leaf transfers count as their own bucket)",
+)
+_plan_hits = pvar.aggregate(
+    "tree_plan_cache_hits",
+    "tree-plan cache outcome per planned pass (1=hit, 0=build); "
+    "sum/count = hit ratio",
+)
+_passes = pvar.counter(
+    "tree_passes",
+    "whole-tree planned DRIVER passes issued (TreeSync; the SPMD "
+    "passes trace into a compiled program, so they count plan builds "
+    "and cache hits instead — per-execution Python counters cannot "
+    "exist inside a jitted body)",
+)
+_hidden = pvar.timer(
+    "tree_hidden_seconds",
+    "tree-pass collective time that ran while the caller computed "
+    "(per-schedule progress-engine accounting, summed at wait())",
+)
+
+
+def register_vars() -> None:
+    mca_var.register(
+        "tree_bucket_bytes", "size", 0,
+        "Bucket capacity in bytes for planned whole-tree collectives "
+        "(leaves below it fuse per dtype, at/above it transfer "
+        "individually); 0 = defer to tree_buckets dynamic rules, "
+        "then dp_bucket_bytes",
+    )
+
+
+register_vars()  # idempotent; cvars must exist before the first plan
+
+
+# ---------------------------------------------------------------------------
+# regex partition rules -> PartitionSpec pytree (the fmengine interface)
+# ---------------------------------------------------------------------------
+
+def tree_path_str(path, sep: str = "/") -> str:
+    """Render a jax key path as a ``sep``-joined name usable in regex
+    partition rules."""
+    import jax
+
+    tu = jax.tree_util
+    keys: List[str] = []
+    for k in path:
+        if isinstance(k, tu.SequenceKey):
+            keys.append(str(k.idx))
+        elif isinstance(k, tu.DictKey):
+            keys.append(str(k.key))
+        elif isinstance(k, tu.GetAttrKey):
+            keys.append(str(k.name))
+        elif isinstance(k, tu.FlattenedIndexKey):
+            keys.append(str(k.key))
+        else:
+            keys.append(str(k))
+    return sep.join(keys)
+
+
+def named_tree_map(f, tree, *, sep: str = "/", is_leaf=None):
+    """``jax.tree.map`` with the leaf's path name as first argument."""
+    import jax
+
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: f(tree_path_str(p, sep), x), tree, is_leaf=is_leaf
+    )
+
+
+def is_scalar_leaf(leaf) -> bool:
+    """Scalar (or single-element) leaves are never partitioned — there
+    is no axis to shard."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    return len(shape) == 0 or int(np.prod(shape, dtype=np.int64)) == 1
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, Any]], tree, *,
+                          sep: str = "/"):
+    """PartitionSpec pytree from ``[(regex, spec)]`` rules matched
+    against each leaf's path name (first match wins; scalar leaves are
+    unpartitioned regardless of rules). Raises ``ValueError`` naming
+    the leaf when no rule matches — a silent default would desync the
+    sharding the operator thinks they configured."""
+    from jax.sharding import PartitionSpec
+
+    def pick(name, leaf):
+        if is_scalar_leaf(leaf):
+            return PartitionSpec()
+        for pat, spec in rules:
+            if re.search(pat, name) is not None:
+                return spec
+        raise ValueError(f"no partition rule matches leaf {name!r}")
+
+    return named_tree_map(pick, tree, sep=sep)
+
+
+# ---------------------------------------------------------------------------
+# the plan: per-(dtype) buckets over leaf metadata, cached per signature
+# ---------------------------------------------------------------------------
+
+class TreePlan:
+    """One planned pass over a tree signature: which leaves transfer
+    alone (``big``) and which fuse into which bucket (``buckets``,
+    index lists in leaf order, one dtype per bucket)."""
+
+    __slots__ = ("meta", "big", "buckets", "bucket_bytes", "total_bytes")
+
+    def __init__(self, meta, big, buckets, bucket_bytes, total_bytes):
+        self.meta = meta  # ((shape, dtype_str, size, nbytes), ...)
+        self.big = big
+        self.buckets = buckets
+        self.bucket_bytes = bucket_bytes
+        self.total_bytes = total_bytes
+
+    def n_transfers(self) -> int:
+        return len(self.big) + len(self.buckets)
+
+
+_plans: Dict[Tuple, TreePlan] = {}
+_plans_lock = threading.Lock()
+
+
+def _meta_of(shapes_dtypes) -> Tuple:
+    meta = []
+    for shape, dt in shapes_dtypes:
+        shape = tuple(int(d) for d in shape)
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        nbytes = size * int(np.dtype(dt).itemsize)
+        meta.append((shape, str(dt), size, nbytes))
+    return tuple(meta)
+
+
+def plan_from_meta(shapes_dtypes: Sequence[Tuple[Tuple, Any]],
+                   bucket_bytes: int) -> TreePlan:
+    """Build (or fetch) the plan for a sequence of ``(shape, dtype)``
+    leaf signatures. Pure metadata — no arrays, no jax — so the plan
+    cache can be exercised device-free (``obs --selftest``)."""
+    meta = _meta_of(shapes_dtypes)
+    key = (meta, int(bucket_bytes))
+    with _plans_lock:
+        plan = _plans.get(key)
+    if plan is not None:
+        _plan_hits.observe(1)
+        return plan
+    _plan_hits.observe(0)
+    big: List[int] = []
+    small: List[Tuple[int, int, str]] = []
+    for i, (_shape, dt, _size, nbytes) in enumerate(meta):
+        if bucket_bytes > 0 and nbytes < bucket_bytes:
+            small.append((i, nbytes, dt))
+        else:
+            big.append(i)
+    buckets = plan_buckets(iter(small), bucket_bytes)
+    _buckets_planned.add(len(big) + len(buckets))
+    plan = TreePlan(meta, big, buckets, int(bucket_bytes),
+                    sum(m[3] for m in meta))
+    with _plans_lock:
+        _plans[key] = plan
+    return plan
+
+
+def plan_tree(tree_, bucket_bytes: Optional[int] = None,
+              comm_size: int = 0) -> Tuple[TreePlan, Any, List[Any]]:
+    """Flatten ``tree_`` and plan it; returns (plan, treedef, leaves).
+    ``bucket_bytes=None`` resolves through rules/cvars (see
+    :func:`resolve_bucket_bytes`); ``0`` forces the per-leaf path."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree_)
+    if bucket_bytes is None:
+        total = sum(
+            int(np.prod(tuple(l.shape), dtype=np.int64))
+            * int(np.dtype(l.dtype).itemsize) if tuple(l.shape)
+            else int(np.dtype(l.dtype).itemsize)
+            for l in leaves
+        )
+        bucket_bytes = resolve_bucket_bytes(comm_size, total)
+    plan = plan_from_meta([(l.shape, l.dtype) for l in leaves],
+                          bucket_bytes)
+    return plan, treedef, leaves
+
+
+def resolve_bucket_bytes(comm_size: int, tree_bytes: int) -> int:
+    """Bucket capacity for a planned pass, in tuned precedence order:
+    ``tree_buckets`` dynamic rule (algorithm ``per_leaf`` -> 0, else
+    the rule's 5th column) > ``tree_bucket_bytes`` cvar >
+    ``dp_bucket_bytes`` cvar. ``comm_size``/``tree_bytes`` are the
+    rule-match keys (min_comm_size / min_msg_bytes)."""
+    from ..coll import dynamic_rules
+
+    alg = dynamic_rules.lookup("tree_buckets", comm_size, tree_bytes)
+    if alg == "per_leaf":
+        return 0
+    if alg == "fused":
+        seg = dynamic_rules.lookup_segsize("tree_buckets", comm_size,
+                                           tree_bytes)
+        if seg is not None:
+            return int(seg)
+    v = int(mca_var.get("tree_bucket_bytes", 0))
+    if v > 0:
+        return v
+    return int(mca_var.get("dp_bucket_bytes", 4 * 1024 * 1024))
+
+
+def _record_pass(kind: str, plan: TreePlan, t0: float,
+                 comm_id: int = -1) -> None:
+    """Driver-pass accounting (issue/wait run per step on the host)."""
+    _passes.add()
+    if _obs.enabled:
+        _obs.record("tree_" + kind, "tree", t0,
+                    _time.perf_counter() - t0, nbytes=plan.total_bytes,
+                    comm_id=comm_id)
+
+
+def _record_plan(kind: str, plan: TreePlan, t0: float) -> None:
+    """SPMD-pass accounting: the body runs at TRACE time only (the
+    executed pass is the compiled program), so what is countable here
+    is the plan/trace construction — named tree_plan_* to say so, and
+    deliberately NOT bumping tree_passes."""
+    if _obs.enabled:
+        _obs.record("tree_plan_" + kind, "tree", t0,
+                    _time.perf_counter() - t0,
+                    nbytes=plan.total_bytes)
+
+
+# ---------------------------------------------------------------------------
+# SPMD planned passes (inside shard_map; XLA pipelines the buckets)
+# ---------------------------------------------------------------------------
+
+def _chunk(size: int, n: int) -> int:
+    return -(-size // n)  # ceil(size / n)
+
+
+def _maybe_mean(x, dtype, n, mean: bool):
+    import jax.numpy as jnp
+
+    return x / n if mean and jnp.issubdtype(dtype, jnp.inexact) else x
+
+
+def tree_allreduce(tree_, axis_name: str, *, mean: bool = False,
+                   bucket_bytes: Optional[int] = None):
+    """Allreduce every leaf over ``axis_name`` in one planned pass:
+    one ``lax.psum`` per bucket / big leaf. Bitwise-identical to the
+    per-leaf loop (``bucket_bytes=0``) — packing is pure layout."""
+    import jax
+    from jax import lax
+
+    t0 = _time.perf_counter()
+    n = lax.psum(1, axis_name)  # static under shard_map
+    plan, treedef, leaves = plan_tree(tree_, bucket_bytes, int(n))
+    out: List[Any] = [None] * len(leaves)
+    for i in plan.big:
+        out[i] = _maybe_mean(lax.psum(leaves[i], axis_name),
+                             leaves[i].dtype, n, mean)
+    import jax.numpy as jnp
+
+    for bucket in plan.buckets:
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in bucket])
+        red = lax.psum(flat, axis_name)
+        off = 0
+        for i in bucket:
+            size = plan.meta[i][2]
+            out[i] = _maybe_mean(
+                red[off:off + size].reshape(plan.meta[i][0]),
+                leaves[i].dtype, n, mean)
+            off += size
+    _record_plan("allreduce", plan, t0)
+    return jax.tree.unflatten(treedef, out)
+
+
+def _padded_rows(leaf, n: int):
+    """Leaf flattened and zero-padded to a (n, chunk) rank-major view:
+    row r is the slice rank r owns after a tiled scatter."""
+    import jax.numpy as jnp
+
+    flat = leaf.reshape(-1)
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad,), leaf.dtype)])
+    return flat.reshape(n, -1)
+
+
+def tree_reduce_scatter(tree_, axis_name: str, *, mean: bool = True,
+                        bucket_bytes: Optional[int] = None):
+    """reduce_scatter every leaf over ``axis_name`` in one planned
+    pass; returns the per-leaf flat shard pytree (leaf i -> 1-D array
+    of ceil(size/n) elements — the same contract as the per-leaf
+    path). Buckets pack the RANK-MAJOR interleaved layout (rank r's
+    slice of the packed buffer is the concatenation of each member
+    leaf's own shard r), so the fused ``psum_scatter`` hands every
+    element to the same rank the per-leaf scatter would — bitwise."""
+    import jax
+    from jax import lax
+
+    t0 = _time.perf_counter()
+    n = lax.psum(1, axis_name)
+    plan, treedef, leaves = plan_tree(tree_, bucket_bytes, int(n))
+    out: List[Any] = [None] * len(leaves)
+
+    def rs(flat):
+        return lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                                tiled=True)
+
+    for i in plan.big:
+        red = rs(_padded_rows(leaves[i], int(n)).reshape(-1))
+        out[i] = _maybe_mean(red, leaves[i].dtype, n, mean)
+    import jax.numpy as jnp
+
+    for bucket in plan.buckets:
+        packed = jnp.concatenate(
+            [_padded_rows(leaves[i], int(n)) for i in bucket], axis=1)
+        red = rs(packed.reshape(-1))  # (sum chunks,) for this rank
+        off = 0
+        for i in bucket:
+            c = _chunk(plan.meta[i][2], int(n))
+            out[i] = _maybe_mean(red[off:off + c], leaves[i].dtype, n,
+                                 mean)
+            off += c
+    _record_plan("reduce_scatter", plan, t0)
+    return jax.tree.unflatten(treedef, out)
+
+
+def tree_allgather(shards, shapes, axis_name: str, *,
+                   bucket_bytes: Optional[int] = None):
+    """all_gather every flat shard back to its full (reshaped) leaf in
+    one planned pass. ``shapes`` mirrors ``shards``' structure with
+    target shapes as leaves. Pure data movement — bitwise by
+    construction."""
+    import jax
+    from jax import lax
+
+    t0 = _time.perf_counter()
+    n = int(lax.psum(1, axis_name))
+    plan, treedef, leaves = plan_tree(shards, bucket_bytes, n)
+    shape_list = treedef.flatten_up_to(shapes)
+    out: List[Any] = [None] * len(leaves)
+
+    def ag(shard):
+        return lax.all_gather(shard, axis_name, axis=0, tiled=True)
+
+    def finish(i, full_flat):
+        shape = tuple(shape_list[i])
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        out[i] = full_flat[:size].reshape(shape)
+
+    for i in plan.big:
+        finish(i, ag(leaves[i]))
+    import jax.numpy as jnp
+
+    for bucket in plan.buckets:
+        packed = jnp.concatenate([leaves[i] for i in bucket])  # (C,)
+        rows = ag(packed).reshape(n, -1)  # (n, C)
+        off = 0
+        for i in bucket:
+            c = leaves[i].shape[0]
+            finish(i, rows[:, off:off + c].reshape(-1))
+            off += c
+    _record_plan("allgather", plan, t0)
+    return jax.tree.unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# driver pass: one nonblocking collective per bucket, overlapped
+# ---------------------------------------------------------------------------
+
+def _op_hidden_seconds(req) -> float:
+    """The progress engine's own accounting of how much of this
+    schedule's run the caller spent elsewhere (0 for polling-mode and
+    in-process requests) — ScheduledOp.hidden_seconds is the ONE
+    definition, shared with the engine's nbc_hidden_seconds fold."""
+    op = getattr(req, "_sched_op", None)
+    return op.hidden_seconds() if op is not None else 0.0
+
+
+class PendingTreePass:
+    """In-flight overlapped tree pass: ``wait()`` completes every
+    bucket, folds the engine's hidden-time accounting into
+    ``tree_hidden_seconds``, and returns the reassembled pytree.
+    Holds leaf METADATA only — issue()'s host staging is released for
+    the whole overlap window."""
+
+    def __init__(self, sync: "TreeSync", kind: str, treedef,
+                 plan: TreePlan, reqs: Dict[Any, Any], lead: int,
+                 shapes: Optional[List[Tuple]] = None) -> None:
+        self._sync = sync
+        self._kind = kind  # allreduce | reduce_scatter | allgather
+        self._treedef = treedef
+        self._plan = plan
+        self._reqs = reqs
+        self._lead = lead
+        self._shapes = shapes  # allgather: target shapes per leaf
+
+    def hidden_seconds(self) -> float:
+        return sum(_op_hidden_seconds(r) for r in self._reqs.values())
+
+    def wait(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..request import request as _req
+
+        t0 = _time.perf_counter()
+        _req.wait_all(list(self._reqs.values()))
+        hidden = self.hidden_seconds()
+        if hidden > 0:
+            _hidden.add(hidden)
+        plan, reqs = self._plan, self._reqs
+        comm = self._sync.comm
+        n, lead = comm.size, self._lead
+        mean = self._sync.mean
+        out: List[Any] = [None] * len(plan.meta)
+
+        def fin(i, arr, shape):
+            arr = np.asarray(arr).reshape(shape)
+            if mean and self._kind != "allgather" \
+                    and np.issubdtype(np.dtype(plan.meta[i][1]),
+                                      np.inexact):
+                arr = arr / n
+            out[i] = jnp.asarray(arr)
+
+        if self._kind == "allreduce":
+            for i in plan.big:
+                fin(i, reqs[("big", i)].value, plan.meta[i][0])
+            for k, bucket in enumerate(plan.buckets):
+                flat = np.asarray(reqs[("bucket", k)].value)
+                flat = flat.reshape(lead, -1)
+                off = 0
+                for i in bucket:
+                    w = plan.meta[i][2] // lead
+                    fin(i, flat[:, off:off + w], plan.meta[i][0])
+                    off += w
+        elif self._kind == "reduce_scatter":
+            # values are this member-rank's blocks: (lead, chunk_i)
+            for i in plan.big:
+                c = _chunk(plan.meta[i][2] // lead, n)
+                fin(i, reqs[("big", i)].value, (lead, c))
+            for k, bucket in enumerate(plan.buckets):
+                flat = np.asarray(reqs[("bucket", k)].value)
+                flat = flat.reshape(lead, -1)
+                off = 0
+                for i in bucket:
+                    c = _chunk(plan.meta[i][2] // lead, n)
+                    fin(i, flat[:, off:off + c], (lead, c))
+                    off += c
+        else:  # allgather: rows are (lead, n * C) concatenations
+            shapes = self._shapes
+            for i in plan.big:
+                full = np.asarray(reqs[("big", i)].value)
+                full = full.reshape(lead, -1)
+                size = int(np.prod(shapes[i], dtype=np.int64))
+                fin(i, full[:, :size], (lead,) + tuple(shapes[i]))
+            for k, bucket in enumerate(plan.buckets):
+                flat = np.asarray(reqs[("bucket", k)].value)
+                bc = sum(plan.meta[i][2] // lead for i in bucket)
+                rows = flat.reshape(lead, n, bc)
+                off = 0
+                for i in bucket:
+                    c = plan.meta[i][2] // lead
+                    size = int(np.prod(shapes[i], dtype=np.int64))
+                    piece = rows[:, :, off:off + c].reshape(lead, -1)
+                    fin(i, piece[:, :size],
+                        (lead,) + tuple(shapes[i]))
+                    off += c
+        if _obs.enabled:
+            _obs.record("tree_wait_" + self._kind, "tree", t0,
+                        _time.perf_counter() - t0,
+                        nbytes=plan.total_bytes, comm_id=comm.cid)
+        return jax.tree.unflatten(self._treedef, out)
+
+
+class TreeSync:
+    """Overlapped whole-tree collectives for the host-driver path.
+
+    Buffers follow the communicator's driver convention (leading axis
+    = this process's member slices). One nonblocking collective per
+    plan bucket issues up front; the caller computes; ``wait()`` at
+    the step boundary reassembles the tree. With the
+    ``progress_thread`` cvar on, the engine runs the bucket schedules
+    off the caller (true overlap, witnessed by ``tree_hidden_seconds``
+    / ``nbc_hidden_seconds``); in polling mode the buckets drain at
+    ``wait()``. Bitwise parity with the per-leaf blocking path is
+    structural: each bucket runs the identical collective the blocking
+    call would, via the progress engine.
+    """
+
+    def __init__(self, comm, *, mean: bool = False,
+                 bucket_bytes: Optional[int] = None) -> None:
+        self.comm = comm
+        self.mean = mean
+        self._bucket_bytes = bucket_bytes
+
+    def _resolve(self, leaves: List[np.ndarray]) -> int:
+        if self._bucket_bytes is not None:
+            return int(self._bucket_bytes)
+        total = sum(int(l.nbytes) for l in leaves)
+        return resolve_bucket_bytes(self.comm.size, total)
+
+    def _flatten(self, tree_) -> Tuple[Any, List[np.ndarray], int]:
+        import jax
+
+        leaves_raw, treedef = jax.tree.flatten(tree_)
+        leaves = [np.asarray(l) for l in leaves_raw]
+        if not leaves or any(l.ndim == 0 for l in leaves):
+            raise ValueError(
+                "TreeSync needs non-empty driver-mode leaves, each "
+                "with a leading (member-slice) axis — 0-d scalar "
+                "leaves cannot carry the per-member axis; reshape "
+                "them to (lead, 1) or drop them from the pytree")
+        leads = {l.shape[0] for l in leaves}
+        if len(leads) != 1:
+            raise ValueError(
+                "TreeSync leaves must share one leading "
+                f"(member-slice) axis; got leading axes {sorted(leads)}")
+        return treedef, leaves, leads.pop()
+
+    def issue(self, tree_) -> PendingTreePass:
+        """Overlapped tree ALLREDUCE: one ``iallreduce`` per bucket;
+        returns without completing any of them."""
+        t0 = _time.perf_counter()
+        treedef, leaves, lead = self._flatten(tree_)
+        plan = plan_from_meta([(l.shape, l.dtype) for l in leaves],
+                              self._resolve(leaves))
+        reqs: Dict[Any, Any] = {}
+        for i in plan.big:
+            reqs[("big", i)] = self.comm.iallreduce(leaves[i])
+        for k, bucket in enumerate(plan.buckets):
+            flat = np.concatenate(
+                [leaves[i].reshape(lead, -1) for i in bucket], axis=1)
+            reqs[("bucket", k)] = self.comm.iallreduce(flat)
+        _record_pass("issue_allreduce", plan, t0, self.comm.cid)
+        return PendingTreePass(self, "allreduce", treedef, plan, reqs,
+                               lead)
+
+    def issue_reduce_scatter(self, tree_) -> PendingTreePass:
+        """Overlapped tree REDUCE_SCATTER: each leaf's row is padded
+        to ``n`` chunks and packed rank-major, one
+        ``ireduce_scatter_block`` per bucket; ``wait()`` returns the
+        per-leaf shard tree (leaf i -> (lead, ceil(row/n)))."""
+        t0 = _time.perf_counter()
+        n = self.comm.size
+        treedef, leaves, lead = self._flatten(tree_)
+
+        def rows(l: np.ndarray) -> np.ndarray:
+            flat = l.reshape(lead, -1)
+            pad = (-flat.shape[1]) % n
+            if pad:
+                flat = np.concatenate(
+                    [flat, np.zeros((lead, pad), flat.dtype)], axis=1)
+            return flat.reshape(lead, n, -1)
+
+        plan = plan_from_meta([(l.shape, l.dtype) for l in leaves],
+                              self._resolve(leaves))
+        reqs: Dict[Any, Any] = {}
+        for i in plan.big:
+            reqs[("big", i)] = self.comm.ireduce_scatter_block(
+                rows(leaves[i]).reshape(lead, -1))
+        for k, bucket in enumerate(plan.buckets):
+            packed = np.concatenate([rows(leaves[i]) for i in bucket],
+                                    axis=2)
+            reqs[("bucket", k)] = self.comm.ireduce_scatter_block(
+                packed.reshape(lead, -1))
+        _record_pass("issue_reduce_scatter", plan, t0, self.comm.cid)
+        return PendingTreePass(self, "reduce_scatter", treedef, plan,
+                               reqs, lead)
+
+    def issue_allgather(self, shards, shapes) -> PendingTreePass:
+        """Overlapped tree ALLGATHER of flat shards back to full
+        leaves: one ``iallgather`` per bucket; ``wait()`` returns
+        leaves of shape ``(lead,) + shapes[leaf]``."""
+        t0 = _time.perf_counter()
+        treedef, leaves, lead = self._flatten(shards)
+        shape_list = [tuple(s) for s in treedef.flatten_up_to(shapes)]
+        plan = plan_from_meta([(l.shape, l.dtype) for l in leaves],
+                              self._resolve(leaves))
+        reqs: Dict[Any, Any] = {}
+        for i in plan.big:
+            reqs[("big", i)] = self.comm.iallgather(
+                leaves[i].reshape(lead, -1))
+        for k, bucket in enumerate(plan.buckets):
+            packed = np.concatenate(
+                [leaves[i].reshape(lead, -1) for i in bucket], axis=1)
+            reqs[("bucket", k)] = self.comm.iallgather(packed)
+        _record_pass("issue_allgather", plan, t0, self.comm.cid)
+        return PendingTreePass(self, "allgather", treedef, plan, reqs,
+                               lead, shapes=shape_list)

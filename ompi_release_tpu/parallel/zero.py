@@ -7,48 +7,45 @@ gradients are reduce_scattered so each replica owns 1/n of them,
 updates its shard, and all_gathers fresh params — same total ICI bytes
 as allreduce (reduce_scatter + allgather IS the ring allreduce), but
 optimizer memory drops by n.
+
+Both legs now run as PLANNED whole-tree passes through
+:mod:`parallel.tree` (one fused ``psum_scatter`` / ``all_gather`` per
+bucket instead of one per leaf), bitwise-identical to the per-leaf
+loop (``bucket_bytes=0``) — the fused buffers pack a rank-major
+interleaved layout, so every element lands on the same rank in the
+same slot as the per-leaf scatter.
 """
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from . import tree as _tree_mod
 
 
 def _pad_len(size: int, n: int) -> int:
     return (-size) % n
 
 
-def shard_gradients(grads: Any, axis_name: str, *, mean: bool = True) -> Any:
+def shard_gradients(grads: Any, axis_name: str, *, mean: bool = True,
+                    bucket_bytes: Optional[int] = None) -> Any:
     """reduce_scatter every leaf over dp: returns rank's flat shard pytree
-    (leaf i -> 1-D array of ceil(size/n) elements)."""
-    n = lax.psum(1, axis_name)
-
-    def rs(g):
-        flat = g.reshape(-1)
-        pad = _pad_len(flat.size, n)
-        if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((pad,), g.dtype)])
-        out = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
-                               tiled=True)
-        return out / n if mean and jnp.issubdtype(g.dtype, jnp.inexact) else out
-
-    return jax.tree.map(rs, grads)
+    (leaf i -> 1-D array of ceil(size/n) elements), one planned fused
+    pass over the whole tree."""
+    return _tree_mod.tree_reduce_scatter(grads, axis_name, mean=mean,
+                                         bucket_bytes=bucket_bytes)
 
 
-def unshard_params(param_shards: Any, shapes: Any, axis_name: str) -> Any:
-    """all_gather each flat shard back to the full (reshaped) leaf."""
-    def ag(shard, shape):
-        full = lax.all_gather(shard, axis_name, axis=0, tiled=True)
-        size = 1
-        for d in shape:
-            size *= d
-        return full[:size].reshape(shape)
-
-    return jax.tree.map(ag, param_shards, shapes)
+def unshard_params(param_shards: Any, shapes: Any, axis_name: str, *,
+                   bucket_bytes: Optional[int] = None) -> Any:
+    """all_gather each flat shard back to the full (reshaped) leaf, one
+    planned fused pass over the whole tree."""
+    return _tree_mod.tree_allgather(param_shards, shapes, axis_name,
+                                    bucket_bytes=bucket_bytes)
 
 
 def shard_like(params: Any, axis_name: str) -> Any:
@@ -69,15 +66,19 @@ def shard_like(params: Any, axis_name: str) -> Any:
 
 
 def zero_step(params: Any, grads: Any, opt_state_shards: Any, opt_update,
-              axis_name: str) -> Tuple[Any, Any]:
-    """One ZeRO-1 step: shard grads, update the owned shard, regather.
+              axis_name: str, *,
+              bucket_bytes: Optional[int] = None) -> Tuple[Any, Any]:
+    """One ZeRO-1 step: shard grads, update the owned shard, regather —
+    both collective legs ride the planned tree pass.
 
     ``opt_update(grad_shard_tree, state_shards, param_shard_tree)`` must
     follow optax's transform signature over the flat-shard pytrees.
     """
-    gshards = shard_gradients(grads, axis_name)
+    gshards = shard_gradients(grads, axis_name,
+                              bucket_bytes=bucket_bytes)
     pshards = shard_like(params, axis_name)
     updates, new_state = opt_update(gshards, opt_state_shards, pshards)
     new_pshards = jax.tree.map(lambda p, u: p + u, pshards, updates)
     shapes = jax.tree.map(lambda p: p.shape, params)
-    return unshard_params(new_pshards, shapes, axis_name), new_state
+    return unshard_params(new_pshards, shapes, axis_name,
+                          bucket_bytes=bucket_bytes), new_state

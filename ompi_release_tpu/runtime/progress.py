@@ -157,6 +157,21 @@ class ScheduledOp:
         self.t_done = 0.0
         self.t_first_wait: Optional[float] = None
 
+    def hidden_seconds(self) -> float:
+        """The part of this schedule's run the poster spent elsewhere
+        (THE overlap accounting — one definition, used by the engine's
+        ``nbc_hidden_seconds`` fold and per-pass consumers like
+        ``parallel/tree``). Polling mode waits before the run starts
+        -> 0; a run finished before the first wait hides its whole
+        duration. Meaningful once the op is DONE; 0 before."""
+        if not self.t_done:
+            return 0.0
+        tw = self.t_first_wait
+        if tw is not None and tw <= self.t_start:
+            return 0.0
+        end = self.t_done if tw is None else min(self.t_done, tw)
+        return max(0.0, end - self.t_start)
+
     def describe(self) -> Dict[str, Any]:
         """Postmortem line: THE answer to "which NBC schedule is
         stuck" in a flight-recorder dump."""
@@ -265,16 +280,11 @@ class ProgressEngine:
                     if not ledger:
                         self._posted.pop(op.poster, None)
                 self._cond.notify_all()
-            # hidden time: the part of [t_start, t_done] the caller
-            # spent elsewhere. Polling mode runs inside wait() (first
-            # wait precedes the run) -> 0; an engine-thread run that
-            # finished before the first wait hides its whole duration.
-            tw = op.t_first_wait
-            if tw is None or tw > op.t_start:
-                hidden = (t_done if tw is None else min(t_done, tw)) \
-                    - op.t_start
-                if hidden > 0:
-                    _hidden.add(hidden)
+            # hidden time: the op's own accounting (the ONE definition
+            # of overlap — see ScheduledOp.hidden_seconds)
+            hidden = op.hidden_seconds()
+            if hidden > 0:
+                _hidden.add(hidden)
             if rec and _obs.enabled:
                 _obs.record("nbc_" + op.name, "nbc", op.t_start,
                             t_done - op.t_start, comm_id=op.cid)

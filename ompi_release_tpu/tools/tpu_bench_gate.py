@@ -51,9 +51,11 @@ HIGHER_BETTER = {"GB/s", "TFLOP/s", "frac_hidden"}
 LOWER_BETTER = {"s", "seconds", "us", "us/hop", "hol_wait_s",
                 "sends_at_root", "device_collectives", "steps"}
 #: metric-name fallback when the unit alone is ambiguous: the overlap
-#: suite's lines (hidden-comm fraction, overlap speedups) are all
+#: suite's lines (hidden-comm fraction, overlap speedups) and the
+#: tree_overlap suite's lines (planned-pass speedup, whole-tree
+#: hidden-comm fraction, nonblocking-pipeline speedup) are all
 #: higher-better — less comm time exposed on the critical path
-METRIC_HIGHER_BETTER_PREFIXES = ("overlap_",)
+METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_")
 #: ...and the ft_recovery suite's lines (recovery wall time, steps
 #: recomputed after rollback) and the contract-sentinel suite's lines
 #: (per-collective overhead, enabled AND disabled legs) are all

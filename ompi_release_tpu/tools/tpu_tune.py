@@ -301,6 +301,134 @@ mpi.finalize()
 '''
 
 
+#: worker app for the tree-bucket sweep: a REAL loopback tpurun job
+#: driving parallel/tree.TreeSync whole-tree allreduce passes over a
+#: trainer-shaped mixed-size gradient tree at each candidate bucket
+#: capacity (0 = the per-leaf path). Process 0 writes the rows to
+#: OMPITPU_LOOPBACK_OUT.
+_TREE_TUNE_APP = r'''
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# distinct shm identity per worker: the pass rides the DCN staged
+# path, so the sweep times real wire traffic, not a memcpy
+os.environ["OMPITPU_HOST_ID"] = (
+    "treetune-" + os.environ["OMPITPU_NODE_ID"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.parallel import tree as tree_mod
+from ompi_release_tpu.runtime.runtime import Runtime
+
+BUCKETS = json.loads(os.environ["OMPITPU_TREE_TUNE_BUCKETS"])
+REPEATS = int(os.environ.get("OMPITPU_TREE_TUNE_REPEATS", "3"))
+world = mpi.init()
+rt = Runtime.current()
+me = rt.bootstrap["process_index"]
+ln = len(world.local_comm_ranks)
+
+# a trainer-shaped tree: many small leaves (biases/norms), a medium
+# band (projections), a couple of large ones (embeddings)
+rng = np.random.RandomState(7)
+grads = {}
+for k in range(16):
+    grads["small%%02d" %% k] = rng.randn(ln, 1024).astype(np.float32)
+for k in range(6):
+    grads["mid%%d" %% k] = rng.randn(ln, 16384).astype(np.float32)
+for k in range(2):
+    grads["big%%d" %% k] = rng.randn(ln, 131072).astype(np.float32)
+metas = [(g.shape, g.dtype) for g in
+         (grads[k] for k in sorted(grads))]
+total = sum(g.nbytes for g in grads.values())
+
+rows = []
+for b in BUCKETS:
+    sync = tree_mod.TreeSync(world, mean=False, bucket_bytes=b)
+    world.barrier()
+    sync.issue(grads).wait()  # warm programs + plan cache + channels
+    best = None
+    for _ in range(REPEATS):
+        world.barrier()
+        t0 = time.perf_counter()
+        sync.issue(grads).wait()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    rows.append({"bucket": b, "seconds": best,
+                 "transfers": tree_mod.plan_from_meta(
+                     metas, b).n_transfers()})
+world.barrier()
+if me == 0:
+    with open(os.environ["OMPITPU_LOOPBACK_OUT"], "w") as f:
+        json.dump({"nprocs": world.size, "tree_bytes": int(total),
+                   "leaves": len(grads), "rows": rows}, f)
+mpi.finalize()
+'''
+
+
+def sweep_tree_buckets(nprocs: int, buckets: Sequence[int],
+                       repeats: int = 3,
+                       timeout_s: int = 600) -> Optional[Dict]:
+    """Time the planned whole-tree allreduce pass
+    (``parallel/tree.TreeSync``) at each bucket capacity through a
+    real ``nprocs``-process loopback ``tpurun`` job — the bucket size
+    IS the tree planner's fusion threshold, so this sweep measures the
+    fewer-collectives vs bigger-staging tradeoff on the exact wire
+    path a job runs. ``0`` is always included (the per-leaf path the
+    rules can pin with ``per_leaf``)."""
+    import json as _json
+    import os as _os
+
+    from ..tools.tpurun import run_loopback_app
+
+    cand = sorted({int(b) for b in buckets if int(b) > 0})
+    out = run_loopback_app(
+        nprocs,
+        _TREE_TUNE_APP % {
+            "repo": _os.path.dirname(_os.path.dirname(
+                _os.path.dirname(_os.path.abspath(__file__))))},
+        {"OMPITPU_TREE_TUNE_BUCKETS": _json.dumps([0] + cand),
+         "OMPITPU_TREE_TUNE_REPEATS": str(repeats)},
+        "tree_tune.json", timeout_s=timeout_s)
+    if out is None:
+        _log.verbose(1, "tree-bucket sweep job failed")
+    return out
+
+
+def emit_tree_rules(sweep: Dict) -> str:
+    """Render a tree-bucket sweep as a ``tree_buckets`` rule line the
+    planner auto-selects (``parallel/tree.resolve_bucket_bytes``):
+    algorithm ``fused`` with the winning capacity in the 5th column,
+    or ``per_leaf`` when bucketing lost. Measurements (time and
+    transfer count per candidate) ride in the justification comment,
+    the same treatment as every other emitted rule."""
+    if not sweep or not sweep.get("rows"):
+        return ""
+    rows = sweep["rows"]
+    pts = ", ".join(
+        f"{('per_leaf' if r['bucket'] == 0 else r['bucket'])}="
+        f"{r['seconds'] * 1e3:.1f}ms/{r['transfers']}xfers"
+        for r in sorted(rows, key=lambda r: r["seconds"]))
+    best = min(rows, key=lambda r: r["seconds"])
+    lines = [
+        "",
+        f"# tree_buckets: planned whole-tree pass, measured on a "
+        f"{sweep['nprocs']}-process loopback job "
+        f"({sweep['leaves']}-leaf {sweep['tree_bytes'] >> 10} KiB "
+        f"tree, tpu-tune --tree-buckets); min_msg_bytes is TOTAL "
+        f"tree bytes",
+        f"#   {pts}",
+    ]
+    if best["bucket"] == 0:
+        lines.append("tree_buckets  0  0  per_leaf")
+    else:
+        lines.append(f"tree_buckets  0  0  fused  {best['bucket']}")
+    return "\n".join(lines) + "\n"
+
+
 def sweep_hier(nprocs: int, ops: Sequence[str], sizes: Sequence[int],
                repeats: int = 3,
                timeout_s: int = 600) -> Optional[Dict]:
@@ -581,6 +709,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--hier-sizes", default="1024,65536,1048576",
                     help="per-rank buffer sizes (bytes) for the hier "
                          "sweep")
+    ap.add_argument("--tree-buckets", default="",
+                    help="comma-separated bucket capacities (bytes) to "
+                         "sweep for the planned whole-tree pass "
+                         "(parallel/tree) through a loopback tpurun "
+                         "job; emits a tree_buckets rule line the "
+                         "planner auto-selects; empty disables")
+    ap.add_argument("--tree-procs", type=int, default=3,
+                    help="process count for the tree-bucket sweep job")
     args = ap.parse_args(argv)
 
     import ompi_release_tpu as mpi
@@ -607,6 +743,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                            repeats=args.repeats)
         if sweep:
             text += emit_hier_rules(sweep)
+    tree_buckets = [int(s) for s in args.tree_buckets.split(",")
+                    if s.strip()]
+    if tree_buckets:
+        tsweep = sweep_tree_buckets(args.tree_procs, tree_buckets,
+                                    repeats=args.repeats)
+        if tsweep:
+            text += emit_tree_rules(tsweep)
     with open(args.output, "w") as f:
         f.write(text)
     # validate what we just wrote parses (a typo'd generator must not
