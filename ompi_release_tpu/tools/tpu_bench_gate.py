@@ -60,13 +60,27 @@ METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_")
 #: recomputed after rollback) and the contract-sentinel suite's lines
 #: (per-collective overhead, enabled AND disabled legs) are all
 #: lower-better — the sentinel's "near-zero overhead when off" claim
-#: is gate-enforced across rounds, like any latency regression
-METRIC_LOWER_BETTER_PREFIXES = ("ft_", "sentinel_")
+#: is gate-enforced across rounds, like any latency regression.
+#: The fleet_scaling suite's sim_* lines (simulated-fleet schedule
+#: round counts, bytes per rank, virtual-clock makespan) are
+#: lower-better too: they are DETERMINISTIC functions of the schedule
+#: code over the fabric model (tier_label "sim" keeps them out of the
+#: wall-clock tiers' fits), so a tripped bound is a real scaling
+#: regression — a schedule doing more rounds or shipping more bytes
+#: at the same P — not measurement noise
+METRIC_LOWER_BETTER_PREFIXES = ("ft_", "sentinel_", "sim_")
 
 DEFAULT_SIGMA = 4.0
 #: relative noise floor: the bench's own ceiling docs put single-run
 #: wobble at ±20%, so no fit may claim a tighter band than this
 DEFAULT_REL_FLOOR = 0.25
+#: ...except the "sim" tier: fleet-simulator lines are deterministic
+#: replays (bit-identical history, MAD = 0), so the wall-clock wobble
+#: floor would silently pass schedule regressions up to 25% (8 -> 10
+#: recursive-doubling rounds). A 2% floor tolerates float drift
+#: across numpy versions while tripping on any real round/byte change
+SIM_TIER = "sim"
+SIM_REL_FLOOR = 0.02
 DEFAULT_MIN_ROUNDS = 3
 
 
@@ -199,7 +213,10 @@ def evaluate(history_rounds: List[List[Dict[str, Any]]],
                            "status": "no-history",
                            "rounds": len(series)})
             continue
-        med, dev = fit_bound(series, sigma=sigma, rel_floor=rel_floor)
+        med, dev = fit_bound(
+            series, sigma=sigma,
+            rel_floor=min(rel_floor, SIM_REL_FLOOR)
+            if key[1] == SIM_TIER else rel_floor)
         v = float(ln["value"])
         direction = _direction(ln.get("unit"), ln.get("metric"))
         checked += 1

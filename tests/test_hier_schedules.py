@@ -4,11 +4,13 @@ algorithms of ``coll/hier_schedules.py`` and their integration in
 
 Three layers:
 
-1. A LOCKSTEP SIMULATOR drives the pure schedules with P threads and
-   per-(src, dst) FIFO queues — the exact transport contract the real
-   ``_XchgAdapter`` provides — so the bitwise-parity matrix runs the
-   whole (P, op, dtype, algorithm) cross product in milliseconds,
-   device- and process-free.
+1. The LOCKSTEP SIMULATOR (``ompi_release_tpu.testing.lockstep`` —
+   first-class since the fleet-sim PR; the fleet-scale harness in
+   ``testing/fleet_sim.py`` shares its adapter contract) drives the
+   pure schedules with P threads and per-(src, dst) FIFO queues — the
+   exact transport contract the real ``_XchgAdapter`` provides — so
+   the bitwise-parity matrix runs the whole (P, op, dtype, algorithm)
+   cross product in milliseconds, device- and process-free.
 2. Selection-unit tests for ``pick`` (forcing > rules > fixed
    constants, the non-commutative downgrades) and the pair-op payload
    packing.
@@ -29,10 +31,8 @@ tolerance; everything else in the matrix is bitwise.
 
 import json
 import os
-import queue
 import sys
 import textwrap
-import threading
 
 import numpy as np
 import pytest
@@ -44,60 +44,11 @@ import ompi_release_tpu.coll.components  # noqa: F401  (registers the
 from ompi_release_tpu.coll import hier_schedules as hs
 from ompi_release_tpu.mca import var as mca_var
 from ompi_release_tpu.runtime.state import JobState
+from ompi_release_tpu.testing.lockstep import simulate
 from ompi_release_tpu.tools.tpurun import Job
 from ompi_release_tpu.utils.errors import MPIError
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-# ---------------------------------------------------------------------------
-# the lockstep simulator
-# ---------------------------------------------------------------------------
-
-class SimWorld:
-    def __init__(self, procs):
-        self.q = {(s, d): queue.Queue() for s in procs for d in procs}
-
-
-class SimXchg:
-    """In-memory exchange adapter: per-(src, dst) FIFO, all sends
-    posted before any receive parks — the wire adapter's contract."""
-
-    def __init__(self, world, me):
-        self.world, self.me = world, me
-
-    def exchange(self, sends, recvs):
-        for dst, arrs in sends.items():
-            for a in arrs:
-                self.world.q[(self.me, dst)].put(np.asarray(a))
-        return {
-            src: [self.world.q[(src, self.me)].get(timeout=30)
-                  for _ in range(c)]
-            for src, c in recvs.items()
-        }
-
-
-def simulate(procs, fn, timeout=60):
-    """Run ``fn(xchg, pidx)`` on one thread per process; returns
-    {pidx: result}; any thread's exception fails the test."""
-    world = SimWorld(procs)
-    out, errs = {}, {}
-
-    def worker(p):
-        try:
-            out[p] = fn(SimXchg(world, p), p)
-        except Exception as e:  # pragma: no cover - failure path
-            errs[p] = e
-
-    ts = [threading.Thread(target=worker, args=(p,), daemon=True)
-          for p in procs]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(timeout)
-    assert not errs, errs
-    assert len(out) == len(procs), f"threads hung: {sorted(out)}"
-    return out
 
 
 def _linear_fold(parts, op):

@@ -576,6 +576,84 @@ class TestBenchGate:
         assert line_tier({"tier_label": "loopback-cpu"}) \
             == "loopback-cpu"
 
+    def test_sim_metrics_are_lower_better_in_their_own_tier(self,
+                                                            tmp_path):
+        """The fleet_scaling suite's sim_* lines: the sim_ prefix is
+        registered lower-better (more schedule rounds / more bytes
+        per rank / longer simulated makespan = regression), and the
+        "sim" tier label keeps the deterministic simulator numbers
+        out of the wall-clock tiers' noise fits."""
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        def sim(metric, v, unit):
+            return {"metric": metric, "value": v, "unit": unit,
+                    "vs_baseline": None, "tier_label": "sim"}
+
+        assert gate._direction("rounds", "sim_rd_rounds_p256") == -1
+        assert gate._direction("bytes",
+                               "sim_rab_bytes_per_rank_p256") == -1
+        assert gate._direction("sim_ms",
+                               "sim_allreduce_makespan_p256") == -1
+        hist = [_round_file(
+            tmp_path / f"BENCH_r{k:02d}.json",
+            [sim("sim_rd_rounds_p256", 8, "rounds"),
+             sim("sim_rab_bytes_per_rank_p256", 4080, "bytes")])
+            for k in range(4)]
+        # a schedule regression (log-round schedule degrading toward
+        # linear: 8 -> 16 rounds) trips the gate...
+        cand = _round_file(
+            tmp_path / "cand.json",
+            [sim("sim_rd_rounds_p256", 16, "rounds"),
+             sim("sim_rab_bytes_per_rank_p256", 4080, "bytes")])
+        rc = gate.main(hist + ["--candidate", str(cand)])
+        assert rc == 1
+        verdict = gate.evaluate(
+            [gate.parse_round_file(p) for p in hist],
+            gate.parse_round_file(cand))
+        assert [r["metric"] for r in verdict["regressions"]] \
+            == ["sim_rd_rounds_p256"]
+        assert verdict["regressions"][0]["tier"] == "sim"
+        # ...the identical deterministic replay does not...
+        ok = _round_file(
+            tmp_path / "ok.json",
+            [sim("sim_rd_rounds_p256", 8, "rounds"),
+             sim("sim_rab_bytes_per_rank_p256", 4080, "bytes")])
+        assert gate.main(hist + ["--candidate", str(ok)]) == 0
+        # ...and a same-named line in ANOTHER tier is never judged
+        # against the sim history
+        other = gate.evaluate(
+            [gate.parse_round_file(p) for p in hist],
+            [{"metric": "sim_rd_rounds_p256", "value": 99,
+              "unit": "rounds", "vs_baseline": None,
+              "tier_label": "loopback-cpu"}])
+        assert other["checked"] == 0 and not other["regressions"]
+
+    def test_sim_tier_band_is_tight_not_wall_clock_wobble(self,
+                                                          tmp_path):
+        """Sim lines are deterministic replays: the ±25% wall-clock
+        noise floor must NOT apply, or a 8 -> 10 round schedule
+        regression (+25%) would pass silently. The sim tier's floor
+        is 2%."""
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        def sim(v, tier="sim"):
+            return {"metric": "sim_rd_rounds_p256", "value": v,
+                    "unit": "rounds", "vs_baseline": None,
+                    "tier_label": tier}
+
+        hist = [[sim(8)] for _ in range(4)]      # bit-identical
+        verdict = gate.evaluate(hist, [sim(10)])  # +25%: a real
+        assert len(verdict["regressions"]) == 1   # regression, trips
+        assert gate.evaluate(hist, [sim(8)])["regressions"] == []
+        # the wall-clock tiers keep the wobble floor: +25% on a quiet
+        # tpu-tier history stays inside the band
+        thist = [[{"metric": "steps_used", "value": 8.0, "unit":
+                   "steps", "vs_baseline": None, "tier_label": "tpu"}]
+                 for _ in range(4)]
+        tcand = [{"metric": "steps_used", "value": 9.9, "unit":
+                  "steps", "vs_baseline": None, "tier_label": "tpu"}]
+        assert gate.evaluate(thist, tcand)["regressions"] == []
+
 
 # ---------------------------------------------------------------------------
 # the real thing: 3-process job with the sampler armed
