@@ -760,7 +760,8 @@ _MICRO_PVARS = (
     "coll_pipeline_segments", "coll_fusion_batched",
     "coll_fusion_flushes", "coll_fusion_bytes_saved",
     "coll_programs_compiled", "coll_invocations",
-    "coll_plan_cache_hits",
+    "coll_plan_cache_hits", "coll_compiled_cache_hits",
+    "coll_orchestration_seconds",
     "obs_sample_overhead_seconds", "obs_series_points",
     "obs_sample_ticks",
 )
@@ -906,6 +907,231 @@ def _coll_micro_suite():
         "pvars": _micro_pvars(), "cumulative": True,
     })
     return lines  # main()'s emit() stamps the backend label
+
+
+def _steady_state_micro_suite():
+    """Interpreted-vs-compiled steady state (the compiled whole-
+    schedule plan layer, coll/plan): the SAME collective at 4 KiB–
+    1 MiB run through the fully interpreted per-call dispatch
+    (``coll_compiled=0``) and through frozen compiled plans, one-shot
+    blocking AND MPI-4 persistent. Python-orchestration time is
+    separated from device/wire time two ways that must agree: the
+    ``coll_orchestration_seconds`` pvar delta (the dispatch path's own
+    accounting, the acceptance witness) and wall − (wall − orch).
+    Every compiled leg asserts BITWISE parity against its interpreted
+    twin in-app before a single line is emitted — the plans fire the
+    very programs the interpreted path compiled, so this is a
+    structural identity being spot-checked, not a tolerance."""
+    import ompi_release_tpu as mpi
+    from ompi_release_tpu.mca import pvar as _pvar_mod
+    from ompi_release_tpu.mca import var as mca_var
+
+    world = mpi.init()
+    lines = []
+    KiB = 1024
+    # the tuned component's pipelined/segmented schedules are the
+    # documented per-call Python overhead (ring segments, binomial
+    # segment trees, per-dispatch decision rules) — the comparison the
+    # compiled plans exist to win. Force them for both legs.
+    mca_var.set_value("coll", "tuned")
+    try:
+        tuned_i = world.dup(name="steady_interp")
+        tuned_c = world.dup(name="steady_comp")
+    finally:
+        mca_var.VARS.unset("coll")
+    mca_var.set_value("coll_tuned_allreduce_algorithm", "ring")
+    mca_var.set_value("coll_tuned_bcast_algorithm", "binomial")
+    mca_var.set_value("coll_pipeline_segsize", 64 * KiB)
+
+    def _orch():
+        pv = _pvar_mod.PVARS.lookup("coll_orchestration_seconds")
+        return float(pv.read()) if pv is not None else 0.0
+
+    def _hits():
+        pv = _pvar_mod.PVARS.lookup("coll_compiled_cache_hits")
+        return pv.read() if pv is not None else {"sum": 0, "count": 0}
+
+    reps = 30
+    cases = [("allreduce", 4 * KiB), ("allreduce", 256 * KiB),
+             ("allreduce", MiB), ("bcast", 256 * KiB),
+             ("allgather", 256 * KiB)]
+    try:
+        _steady_cases(cases, reps, world, tuned_i, tuned_c, lines,
+                      _orch, _hits, mca_var)
+    finally:
+        mca_var.VARS.unset("coll_tuned_allreduce_algorithm")
+        mca_var.VARS.unset("coll_tuned_bcast_algorithm")
+        mca_var.VARS.unset("coll_pipeline_segsize")
+        tuned_i.free()
+        tuned_c.free()
+
+    # spanning leg: a real 3-process loopback job fires the SAME
+    # 256 KiB allreduce interpreted vs through frozen wire plans
+    # (precomposed round structure + frame headers); orchestration is
+    # the posting+dispatch pvar delta, parity asserted in-app
+    import os
+
+    from ompi_release_tpu.tools.tpurun import run_loopback_app
+
+    doc = run_loopback_app(
+        3, _STEADY_SPAN_APP % {"repo": os.path.dirname(
+            os.path.abspath(__file__))}, {},
+        "steady_span.json", timeout_s=280)
+    if doc is None:
+        lines.append({
+            "metric": "steady_spanning_suite", "value": None,
+            "unit": None, "vs_baseline": None,
+            "error": "loopback job failed"})
+    else:
+        for ln in doc["lines"]:
+            ln.setdefault("suite", "steady_state")
+            ln.setdefault("vs_baseline", None)
+            lines.append(ln)
+    return lines
+
+
+def _steady_cases(cases, reps, world, tuned_i, tuned_c, lines,
+                  _orch, _hits, mca_var):
+    for coll, nbytes in cases:
+        elems = max(1, nbytes // 4)
+        x = (np.arange(world.size * elems, dtype=np.float32)
+             .reshape(world.size, elems) * 0.5)
+        label = f"{coll}_{_human(nbytes)}"
+
+        def call(comm, _c=coll, _x=x):
+            if _c == "allreduce":
+                return comm.allreduce(_x)
+            if _c == "bcast":
+                return comm.bcast(_x, root=0)
+            return comm.allgather(_x)
+
+        def timed_leg(comm):
+            _sync(call(comm))  # warm: compile / freeze the plan
+            o0 = _orch()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                _sync(call(comm))
+            wall = (time.perf_counter() - t0) / reps
+            orch = (_orch() - o0) / reps
+            return wall, orch, np.asarray(call(comm))
+
+        mca_var.set_value("coll_compiled", 0)
+        try:
+            wall_i, orch_i, want = timed_leg(tuned_i)
+        finally:
+            mca_var.VARS.unset("coll_compiled")
+
+        h0 = _hits()
+        wall_c, orch_c, got = timed_leg(tuned_c)
+        h1 = _hits()
+        np.testing.assert_array_equal(got, want)  # BITWISE in-app
+        assert h1["sum"] - h0["sum"] >= reps, (
+            "compiled leg did not fire frozen plans")
+        wall_p = orch_p = None
+        if coll == "allreduce":
+            # MPI-4 persistent: start() re-fires the same frozen
+            # plan the blocking calls froze (signature memoized at
+            # *_init — start() builds nothing)
+            req = tuned_c.allreduce_init(x)
+            req.start(); req.wait()
+            o0 = _orch()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                req.start()
+                req.wait()
+            wall_p = (time.perf_counter() - t0) / reps
+            orch_p = (_orch() - o0) / reps
+            np.testing.assert_array_equal(np.asarray(req.value), want)
+
+        common = {
+            "suite": "steady_state", "vs_baseline": None,
+            "reps": reps, "bytes": nbytes,
+        }
+        lines.append({
+            "metric": f"steady_orch_{label}_interpreted",
+            "value": round(orch_i, 9), "unit": "s",
+            "wall_seconds": round(wall_i, 9),
+            "comm_alone_seconds": round(wall_i - orch_i, 9), **common,
+        })
+        lines.append({
+            "metric": f"steady_orch_{label}_compiled",
+            "value": round(orch_c, 9), "unit": "s",
+            "wall_seconds": round(wall_c, 9),
+            "comm_alone_seconds": round(wall_c - orch_c, 9), **common,
+        })
+        lines.append({
+            "metric": f"compiled_{label}_orch_speedup",
+            "value": round(orch_i / max(orch_c, 1e-12), 3),
+            "unit": "x_orchestration",
+            "interpreted_orch_s": round(orch_i, 9),
+            "compiled_orch_s": round(orch_c, 9),
+            "wall_speedup": round(wall_i / max(wall_c, 1e-12), 3),
+            **common,
+        })
+        if wall_p is not None:
+            lines.append({
+                "metric": f"steady_orch_{label}_persistent",
+                "value": round(orch_p, 9), "unit": "s",
+                "wall_seconds": round(wall_p, 9), **common,
+            })
+
+
+_STEADY_SPAN_APP = r"""
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.mca import pvar, var as mca_var
+from ompi_release_tpu.runtime.runtime import Runtime
+
+def _pv(name):
+    p = pvar.PVARS.lookup(name)
+    return float(p.read()) if p is not None else 0.0
+
+world = mpi.init()
+elems = (256 * 1024) // 4
+x = np.stack([np.arange(elems, dtype=np.float32) * 0.25
+              for _ in range(len(world.local_comm_ranks))])
+reps = 10
+
+def leg():
+    np.asarray(world.allreduce(x))  # warm: record/freeze or compile
+    o0 = _pv("coll_orchestration_seconds")
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = np.asarray(world.allreduce(x))
+    wall = (time.perf_counter() - t0) / reps
+    orch = (_pv("coll_orchestration_seconds") - o0) / reps
+    return wall, orch, out
+
+mca_var.set_value("coll_compiled", 0)
+wall_i, orch_i, want = leg()
+mca_var.VARS.unset("coll_compiled")
+wall_c, orch_c, got = leg()
+np.testing.assert_array_equal(got, want)  # BITWISE in-app
+pidx = int(Runtime.current().bootstrap["process_index"])
+if pidx == 0:
+    with open(os.environ["OMPITPU_LOOPBACK_OUT"], "w") as f:
+        json.dump({"lines": [
+            {"metric": "steady_orch_spanning_allreduce_256KiB_interpreted",
+             "value": round(orch_i, 9), "unit": "s",
+             "wall_seconds": round(wall_i, 9), "reps": reps},
+            {"metric": "steady_orch_spanning_allreduce_256KiB_compiled",
+             "value": round(orch_c, 9), "unit": "s",
+             "wall_seconds": round(wall_c, 9), "reps": reps},
+            {"metric": "compiled_spanning_allreduce_orch_speedup",
+             "value": round(orch_i / max(orch_c, 1e-12), 3),
+             "unit": "x_orchestration",
+             "wall_speedup": round(wall_i / max(wall_c, 1e-12), 3)},
+        ]}, f)
+mpi.finalize()
+"""
 
 
 def _sentinel_micro_suite():
@@ -2199,7 +2425,12 @@ def main():
     #            hier_schedules at P=256/1024 virtual ranks and emits
     #            sim_* scaling observables (rounds, bytes/rank,
     #            makespan), tier_label "sim", all gate-guarded
+    #   steady_state: interpreted-vs-compiled Python-orchestration
+    #            time (frozen schedule plans, coll/plan) for one-shot,
+    #            persistent, and 3-proc spanning allreduce legs
     _run_suite("coll_micro_suite", _coll_micro_suite, emit, jax)
+    _run_suite("steady_state_suite", _steady_state_micro_suite, emit,
+               jax)
     _run_suite("sentinel_suite", _sentinel_micro_suite, emit, jax)
     _run_suite("wire_micro_suite",
                lambda: _wire_micro_suite(backend_label), emit, jax)

@@ -102,13 +102,20 @@ def _check_user_tag(tag: int) -> None:
         )
 
 
+def _pack_dtype_shape(buf, dtype, shape) -> None:
+    """THE array-metadata wire format (dtype string, comma-joined
+    shape) — single definition, so staged/shm headers and the
+    plan-time :class:`FrameTemplate` can never desynchronize."""
+    buf.pack_string(str(dtype))
+    buf.pack_string(",".join(str(d) for d in shape))
+
+
 def _pack_array_header(buf, arr: np.ndarray, *extra_front) -> None:
     """Array-metadata wire format shared by the staged (DCN) and shm
     transports: [*extra_front,] dtype, comma-joined shape."""
     for f in extra_front:
         buf.pack_string(f)
-    buf.pack_string(str(arr.dtype))
-    buf.pack_string(",".join(str(d) for d in arr.shape))
+    _pack_dtype_shape(buf, arr.dtype, arr.shape)
 
 
 def _unpack_array_header(buf):
@@ -117,6 +124,65 @@ def _unpack_array_header(buf):
     shape_s = buf.unpack_string()
     shape = tuple(int(d) for d in shape_s.split(",")) if shape_s else ()
     return dtype, shape
+
+
+class FrameTemplate:
+    """Plan-time precomposed SGH2/SGC2 framing for ONE fixed
+    ``(shape, dtype, segsize)`` transfer slot — the frozen-plan send
+    path of :mod:`coll.plan`.
+
+    Everything a header needs that does not depend on the send
+    instant is packed ONCE here: the magic/dtype/shape/chunk-count
+    records as raw DSS byte strings (DSS records are self-delimiting,
+    so concatenated record strings unpack exactly like one
+    sequentially-packed buffer) and the per-fragment slice offsets.
+    A steady-state send then composes ``pre + xfer + mid + crc`` from
+    four byte strings and slices the source memoryview at the stored
+    offsets — no per-message dtype/shape stringification, no repeated
+    DSS packing, no cvar reads. The transfer id and payload CRC are
+    genuinely per-send (receiver resync and end-to-end integrity) and
+    stay live. The wire format is BYTE-IDENTICAL to
+    :meth:`DcnBtl.staged_frames`'s, so receivers need no changes and
+    bitwise parity with the interpreted path is structural."""
+
+    __slots__ = ("shape", "dtype", "nbytes", "nchunks", "chunk",
+                 "offsets", "pre", "mid", "idx_tails")
+
+    def __init__(self, shape, dtype, segsize: int) -> None:
+        from ..native import DssBuffer
+
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+        size = int(np.prod(self.shape, dtype=np.int64)) if self.shape \
+            else 1
+        self.nbytes = size * self.dtype.itemsize
+        self.chunk = max(1, int(segsize))
+        self.nchunks = max(1, -(-self.nbytes // self.chunk))
+        self.offsets = tuple(i * self.chunk for i in range(self.nchunks))
+        self.pre = DssBuffer().pack_string(_HDR2_MAGIC).tobytes()
+        mid = DssBuffer()
+        _pack_dtype_shape(mid, self.dtype, self.shape)
+        mid.pack_int64([self.nchunks, self.chunk])
+        self.mid = mid.tobytes()
+        self.idx_tails = tuple(int(i).to_bytes(8, "big")
+                               for i in range(self.nchunks))
+
+    def matches(self, arr: np.ndarray) -> bool:
+        return arr.shape == self.shape and arr.dtype == self.dtype
+
+    def header(self, xfer: int, crc: int) -> bytes:
+        from ..native import DssBuffer
+
+        return b"".join((
+            self.pre, DssBuffer().pack_int64(int(xfer)).tobytes(),
+            self.mid, DssBuffer().pack_int64(int(crc)).tobytes(),
+        ))
+
+
+def plan_frame_template(shape, dtype, segsize: int) -> FrameTemplate:
+    """Build the frozen framing for one planned transfer slot (see
+    :class:`FrameTemplate`)."""
+    return FrameTemplate(shape, dtype, segsize)
 
 
 _stash_guard = threading.Lock()
@@ -291,14 +357,33 @@ class DcnBtl(base.BtlModule):
     # -- cross-process staged path (the honest multi-controller route) ----
     _recv_from = staticmethod(stashed_recv)  # kept as the historical name
 
+    #: (generation, value) stamp for the resolved segsize — the cvar
+    #: used to be re-read through the registry lock on EVERY staged
+    #: send; now a stale write-generation is the only thing that
+    #: triggers a re-resolve (one attr read + int compare per send)
+    _segsize_cache = (-1, 0)
+
     def pipeline_segsize(self) -> int:
         """Effective pipelined-fragment size: the ``wire_pipeline_segsize``
         cvar clamped to this btl's max frame size; 0 = the legacy
-        monolithic ``tobytes()`` framing (exact pre-pipeline path)."""
+        monolithic ``tobytes()`` framing (exact pre-pipeline path).
+        Resolved once per registry write generation, not per message."""
+        gen, val = self._segsize_cache
+        now = mca_var.VARS.generation
+        if gen == now:
+            return val
+        # gen captured BEFORE the value read: a concurrent cvar write
+        # that lands between the two bumps the generation past `now`,
+        # so the possibly-stale value cached here can never be served
+        # once the writer is done (stamping the generation read AFTER
+        # would mask that write until an unrelated one)
         seg = int(mca_var.get("wire_pipeline_segsize", 0) or 0)
         if seg <= 0:
-            return 0
-        return min(seg, max(1, self.max_send_size))
+            seg = 0
+        else:
+            seg = min(seg, max(1, self.max_send_size))
+        self._segsize_cache = (now, seg)
+        return seg
 
     def staged_frames(self, data, *, segsize: int):
         """Yield the wire frames of ONE pipelined staged transfer:
@@ -342,6 +427,39 @@ class DcnBtl(base.BtlModule):
             yield b"".join((xb, int(i).to_bytes(8, "big"), sl))
             self.staged_chunks_pvar.add()
         self.staged_bytes_pvar.add(nbytes)
+
+    def planned_frames(self, data, tpl: FrameTemplate):
+        """Yield the wire frames of one staged transfer from a frozen
+        :class:`FrameTemplate` — the steady-state send path of a
+        compiled schedule plan: precomposed header byte strings plus
+        memoryview slices at plan-time offsets. Byte-identical to
+        :meth:`staged_frames` for the same array, with the same pvar
+        accounting; only the per-send transfer id and payload CRC are
+        computed live. A shape/dtype mismatch is a loud plan-integrity
+        error, never a silently wrong header."""
+        import zlib
+
+        arr = np.ascontiguousarray(np.asarray(data))
+        if not tpl.matches(arr):
+            raise MPIError(
+                ErrorCode.ERR_INTERN,
+                f"planned staged transfer: buffer {arr.shape}/"
+                f"{arr.dtype} does not match the frozen frame template "
+                f"{tpl.shape}/{tpl.dtype} — schedule diverged from its "
+                "plan (rebuild the persistent request)",
+            )
+        mv = memoryview(arr.reshape(-1).view(np.uint8)) if arr.size \
+            else memoryview(b"")
+        xfer = next(_xfer_ids)
+        yield tpl.header(xfer, zlib.crc32(mv))
+        xb = _CHUNK2_MAGIC + int(xfer).to_bytes(8, "big")
+        chunk = tpl.chunk
+        for off, tail in zip(tpl.offsets, tpl.idx_tails):
+            sl = mv[off:off + chunk]
+            _zero_copy_bytes.add(len(sl))
+            yield b"".join((xb, tail, sl))
+            self.staged_chunks_pvar.add()
+        self.staged_bytes_pvar.add(tpl.nbytes)
 
     def send_staged(self, oob_ep, peer_nid: int, tag: int, data) -> int:
         """Stream ``data`` to ``peer_nid`` over the OOB in chunks.

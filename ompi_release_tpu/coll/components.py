@@ -39,9 +39,9 @@ def _resolve_op(op: Op, x) -> Op:
     """Accelerated-kernel resolution for the local-reduction step of a
     hand-scheduled algorithm (the ``ompi/mca/op`` select): the pallas
     component claims large contiguous f32/bf16 SUMs, everything else
-    stays on the XLA combiner. Resolved op names differ (``sum`` vs
-    ``sum[pallas]``), so the compiled-program cache keys — which embed
-    the op name — never mix the two kernels."""
+    stays on the XLA combiner. Resolution returns a DISTINCT op object
+    (``sum[pallas]``), so the compiled-program cache keys — which embed
+    the op itself — never mix the two kernels."""
     from ..ops import op as op_mod
 
     if op.is_pair_op or not hasattr(x, "dtype"):
@@ -82,17 +82,19 @@ class _XlaModule:
         }
 
     # each driver fn: key identifies the compiled program; all static
-    # parameters (op name, root) must be part of the key
+    # parameters must be part of the key — the op as an OBJECT (frozen,
+    # hashable): keying by name would hand a same-named user op another
+    # op's baked-in combiner
     def allreduce(self, comm, x, op: Op):
         if op.is_pair_op:
             vals, idxs = x
             return run_sharded(
-                comm, ("xla", "allreduce_pair", op.name),
+                comm, ("xla", "allreduce_pair", op),
                 lambda v, i: spmd.allreduce_pair_lax(v, i, op, AXIS),
                 vals, extra_arrays=(idxs,),
             )
         return run_sharded(
-            comm, ("xla", "allreduce", op.name),
+            comm, ("xla", "allreduce", op),
             lambda xb: spmd.allreduce_lax(xb, op, AXIS), x,
         )
 
@@ -109,7 +111,7 @@ class _XlaModule:
                         jnp.where(rank == root, ri, jnp.zeros_like(ri)))
 
             return run_sharded(
-                comm, ("xla", "reduce_pair", op.name, root),
+                comm, ("xla", "reduce_pair", op, root),
                 pair_body, vals, extra_arrays=(idxs,),
             )
 
@@ -118,7 +120,7 @@ class _XlaModule:
             rank = lax.axis_index(AXIS)
             return jnp.where(rank == root, red, jnp.zeros_like(red))
 
-        return run_sharded(comm, ("xla", "reduce", op.name, root), body, x)
+        return run_sharded(comm, ("xla", "reduce", op, root), body, x)
 
     def bcast(self, comm, x, root: int):
         return run_sharded(
@@ -160,11 +162,11 @@ class _XlaModule:
                         jnp.take(ci, rank, axis=0))
 
             return run_sharded(
-                comm, ("xla", "rsb_pair", op.name),
+                comm, ("xla", "rsb_pair", op),
                 pair_body, vals, extra_arrays=(idxs,),
             )
         return run_sharded(
-            comm, ("xla", "reduce_scatter_block", op.name),
+            comm, ("xla", "reduce_scatter_block", op),
             lambda xb: spmd.reduce_scatter_lax(xb, op, AXIS, n), x,
         )
 
@@ -201,7 +203,7 @@ class _XlaModule:
                         jnp.take(si, rank, axis=0))
 
             return run_sharded(
-                comm, ("xla", "scan_pair", op.name, exclusive),
+                comm, ("xla", "scan_pair", op, exclusive),
                 pair_body, vals, extra_arrays=(idxs,),
             )
         # the gather-based scan stages the WHOLE comm's buffers on
@@ -226,7 +228,7 @@ class _XlaModule:
             return jnp.take(s, rank, axis=0)
 
         return run_sharded(
-            comm, ("xla", "scan", op.name, exclusive), body, x
+            comm, ("xla", "scan", op, exclusive), body, x
         )
 
     def exscan(self, comm, x, op: Op):
@@ -459,7 +461,7 @@ class _TunedModule:
                 _log.verbose(3, f"{comm.name}: tuned allreduce -> "
                                 f"ring pipelined x{nseg}")
                 return pipeline.run_pipelined(
-                    comm, ("tuned", "allreduce", "ring", op.name),
+                    comm, ("tuned", "allreduce", "ring", op),
                     lambda xb: pipeline.allreduce_ring_pipelined(
                         xb, op, AXIS, n, nseg),
                     x, nseg=nseg, nbytes=block_dsize,
@@ -469,7 +471,7 @@ class _TunedModule:
         # the segment size is baked into the compiled program, so it
         # must be part of the cache key or later var changes would be
         # silently ignored
-        key = ("tuned", "allreduce", alg, op.name) + (
+        key = ("tuned", "allreduce", alg, op) + (
             (seg_elems,) if alg == "segmented_ring" else ()
         )
         return run_sharded(comm, key, bodies[alg], x)
@@ -610,11 +612,11 @@ class _TunedModule:
                                      jnp.zeros_like(red))
 
                 return pipeline.run_pipelined(
-                    comm, ("tuned", "reduce", "binomial", op.name, root),
+                    comm, ("tuned", "reduce", "binomial", op, root),
                     pipe_binom, x, nseg=nseg, nbytes=msg,
                     opname="reduce",
                 )
-        return run_sharded(comm, ("tuned", "reduce", alg, op.name, root),
+        return run_sharded(comm, ("tuned", "reduce", alg, op, root),
                            bodies[alg], x)
 
     def _pick_allgather(self, x) -> str:
@@ -684,7 +686,7 @@ class _TunedModule:
             return spmd.reduce_scatter_ring(xb, op, AXIS, n)
 
         return run_sharded(
-            comm, ("tuned", "reduce_scatter_block", op.name), body, x
+            comm, ("tuned", "reduce_scatter_block", op), body, x
         )
 
     # -- gather / scatter (coll_tuned_{gather,scatter}.c) -----------------
@@ -789,7 +791,7 @@ class _TunedModule:
             return None  # pair scans stay with xla's gather path
         n = comm.size
         return run_sharded(
-            comm, ("tuned", "scan", op.name),
+            comm, ("tuned", "scan", op),
             lambda xb: spmd.scan_recursive_doubling(xb, op, AXIS, n), x,
         )
 
@@ -798,7 +800,7 @@ class _TunedModule:
             return None  # pair scans stay with xla's gather path
         n = comm.size
         return run_sharded(
-            comm, ("tuned", "exscan", op.name),
+            comm, ("tuned", "exscan", op),
             lambda xb: spmd.scan_recursive_doubling(
                 xb, op, AXIS, n, exclusive=True
             ), x,
@@ -949,7 +951,7 @@ class _BasicModule:
         n = comm.size
         op = _resolve_op(op, x)
         return run_sharded(
-            comm, ("basic", "allreduce", op.name),
+            comm, ("basic", "allreduce", op),
             lambda xb: spmd.allreduce_basic_linear(xb, op, AXIS, n), x,
         )
 
@@ -962,7 +964,7 @@ class _BasicModule:
             rank = lax.axis_index(AXIS)
             return jnp.where(rank == root, red, jnp.zeros_like(red))
 
-        return run_sharded(comm, ("basic", "reduce", op.name, root), body, x)
+        return run_sharded(comm, ("basic", "reduce", op, root), body, x)
 
     def scatter(self, comm, x, root: int):
         n = comm.size
@@ -1177,7 +1179,7 @@ class _MlModule:
             xb, op, "local", "node", self.intra
         )
         return run_sharded2d(
-            comm, ("ml", "allreduce", op.name, self.inter, self.intra),
+            comm, ("ml", "allreduce", op, self.inter, self.intra),
             body, x, inter=self.inter, intra=self.intra,
         )
 
@@ -1191,7 +1193,7 @@ class _MlModule:
             xb, op, "local", "node", root, self.intra
         )
         return run_sharded2d(
-            comm, ("ml", "reduce", op.name, root, self.inter, self.intra),
+            comm, ("ml", "reduce", op, root, self.inter, self.intra),
             body, x, inter=self.inter, intra=self.intra,
         )
 
@@ -1219,7 +1221,7 @@ class _MlModule:
         )
         return run_sharded2d(
             comm,
-            ("ml", "reduce_scatter_block", op.name, self.inter,
+            ("ml", "reduce_scatter_block", op, self.inter,
              self.intra),
             body, x, inter=self.inter, intra=self.intra,
         )

@@ -10,6 +10,8 @@ requirement from SURVEY §6's north star).
 
 from __future__ import annotations
 
+import threading
+import time as _time
 from typing import Any, Callable, Dict, Tuple
 
 import jax
@@ -39,6 +41,57 @@ _plan_cache = pvar.aggregate(
     "plan-cache outcome per driver invocation (1=hit, 0=compile); "
     "sum/count = hit ratio",
 )
+#: Python time on the collective DISPATCH path — everything between a
+#: collective's dispatch entry and the moment the compiled program (or
+#: the wire transport) takes over: decision logic, plan/cache lookups,
+#: validation, schedule posting. THE witness for the interpreted-vs-
+#: compiled steady-state claim (bench.py ``steady_state``): the delta
+#: of this timer across a run isolates orchestration from device/wire
+#: time. Two clock reads per dispatch — measurement, not policy.
+_orch = pvar.timer(
+    "coll_orchestration_seconds",
+    "Python orchestration seconds on the collective dispatch path "
+    "(decision, planning, validation, posting — before the compiled "
+    "program or wire transport takes over)",
+)
+
+#: capture/attribution state for :mod:`coll.plan` (the compiled
+#: whole-schedule layer): ``entries`` records each program dispatch
+#: (prog handle, input object, output object) while a capture is
+#: active; ``t0`` re-bases the orchestration timer at the OUTER
+#: dispatch entry so interpreted and compiled fires time the same span.
+_capture_tls = threading.local()
+
+
+def begin_capture() -> list:
+    """Arm program-dispatch capture on this thread; returns the live
+    entry list (one dict per ``run_sharded`` program launch)."""
+    entries: list = []
+    _capture_tls.entries = entries
+    return entries
+
+
+def end_capture() -> None:
+    _capture_tls.entries = None
+
+
+def orch_mark(t0: float) -> None:
+    """Re-base the next ``run_sharded`` orchestration interval at
+    ``t0`` (the outer dispatch entry), so the timer covers the
+    component decision path too, not just the driver prologue."""
+    _capture_tls.t0 = t0
+
+
+def orch_clear() -> None:
+    _capture_tls.t0 = None
+
+
+def _orch_t0(default: float) -> float:
+    t0 = getattr(_capture_tls, "t0", None)
+    if t0 is None:
+        return default
+    _capture_tls.t0 = None  # one-shot: consumed by this dispatch
+    return t0
 
 
 def _op_name(key: Tuple) -> str:
@@ -72,6 +125,7 @@ def run_sharded2d(comm, key: Tuple, body: Callable, x, *,
     import numpy as _np
     from jax.sharding import Mesh
 
+    t_in = _time.perf_counter()
     _invoke_count.add()
     tok = (_skew.begin(_op_name(key), getattr(comm, "cid", -1))
            if _obs.enabled else None)
@@ -104,6 +158,7 @@ def run_sharded2d(comm, key: Tuple, body: Callable, x, *,
             )
         )
         cache[key] = prog
+    _orch.add(_time.perf_counter() - t_in)
     if tok is None:
         return prog(jnp.asarray(x))
     _skew.body(tok)
@@ -135,6 +190,7 @@ def run_sharded_spmd(comm, key: Tuple, body: Callable, local_x) -> Any:
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as _P
 
+    t_in = _time.perf_counter()
     _invoke_count.add()
     tok = (_skew.begin(_op_name(key), getattr(comm, "cid", -1))
            if _obs.enabled else None)
@@ -160,6 +216,7 @@ def run_sharded_spmd(comm, key: Tuple, body: Callable, local_x) -> Any:
                           out_specs=P("rank"))
         )
         cache[key] = prog
+    _orch.add(_time.perf_counter() - t_in)
     if tok is not None:
         _skew.body(tok)
     out = prog(garr)
@@ -212,6 +269,7 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
     per-process shards out) — the single-controller convention cannot
     apply there because no controller holds every rank's slice.
     """
+    t_in = _orch_t0(_time.perf_counter())
     _invoke_count.add()
     tok = (_skew.begin(_op_name(key), getattr(comm, "cid", -1))
            if _obs.enabled else None)
@@ -276,11 +334,24 @@ def run_sharded(comm, key: Tuple, body: Callable, x, *,
             )
         )
         cache[key] = prog
+    cap = getattr(_capture_tls, "entries", None)
+    if cap is not None:
+        # coll/plan capture: record the program handle plus the exact
+        # input/output OBJECTS — identity against the collective's own
+        # argument and return value proves the dispatch was pre/post-
+        # processing-free, i.e. safe to re-fire as the program alone
+        cap.append({"prog": prog, "x": x, "extra": bool(extra_arrays),
+                    "out": None})
+    _orch.add(_time.perf_counter() - t_in)
     if tok is None:
-        return prog(jnp.asarray(x), *[jnp.asarray(e) for e in extra_arrays])
-    # skew emit point: wait = arrival -> program launch (cache lookup /
-    # compile / validation), body = the dispatch itself
-    _skew.body(tok)
-    out = prog(jnp.asarray(x), *[jnp.asarray(e) for e in extra_arrays])
-    _skew.end(tok, _arr_nbytes(x))
+        out = prog(jnp.asarray(x),
+                   *[jnp.asarray(e) for e in extra_arrays])
+    else:
+        # skew emit point: wait = arrival -> program launch (cache
+        # lookup / compile / validation), body = the dispatch itself
+        _skew.body(tok)
+        out = prog(jnp.asarray(x), *[jnp.asarray(e) for e in extra_arrays])
+        _skew.end(tok, _arr_nbytes(x))
+    if cap is not None:
+        cap[-1]["out"] = out
     return out

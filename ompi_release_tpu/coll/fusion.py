@@ -174,9 +174,10 @@ class FusionBuffer:
         self._capacity = capacity
         self._max_delay_us = max_delay_us
         self._lock = threading.Lock()
-        # (op.name, dtype_str) -> [_Pending]; op identity kept per queue
-        self._queues: Dict[Tuple[str, str], List[_Pending]] = {}
-        self._ops: Dict[Tuple[str, str], Any] = {}
+        # (op, dtype_str) -> [_Pending]; keyed by the op OBJECT so two
+        # same-named ops with different combiners never share a queue
+        # (and the key itself carries the op for flush)
+        self._queues: Dict[Tuple[Any, str], List[_Pending]] = {}
         self._pending_bytes = 0  # running total (capacity check is O(1))
 
     # -- config (cvars re-read per call so runtime tuning applies) ---------
@@ -236,9 +237,8 @@ class FusionBuffer:
             # tensor queues, so no tensor waits past max_delay + one
             # submission gap
             self.flush()
-        key = (op.name, str(arr.dtype))
+        key = (op, str(arr.dtype))
         with self._lock:
-            self._ops[key] = op
             self._queues.setdefault(key, []).append(
                 _Pending(handle, arr.reshape(self.comm.size, -1),
                          arr.shape, per_rank)
@@ -255,9 +255,7 @@ class FusionBuffer:
         returns how many collectives were issued."""
         with self._lock:
             queues = self._queues
-            ops = self._ops
             self._queues = {}
-            self._ops = {}
             self._pending_bytes = 0
         issued = 0
         t0 = time.perf_counter()
@@ -267,7 +265,7 @@ class FusionBuffer:
             for key, pendings in queues.items():
                 if not pendings:
                     continue
-                op = ops[key]
+                op = key[0]
                 # plan_buckets gives an oversize item its own bucket,
                 # so the cvar capacity needs no inflation here
                 buckets = plan_buckets(

@@ -214,6 +214,11 @@ class _HierModule:
         self.leaders: List[int] = sorted(
             min(g) for g in self.host_groups.values())
         self._xchg = _XchgAdapter(self)
+        # handle for coll/plan's frozen-schedule record/replay: the
+        # plan layer swaps _xchg for the duration of ONE schedule run
+        # (ops on a comm are engine-serialized, so the swap is
+        # race-free) — it needs the module, which only closures hold
+        comm._hier_module = self
 
     # -- plumbing ----------------------------------------------------------
     @property
@@ -348,11 +353,29 @@ class _HierModule:
                                 flow=self._flow(self.my_pidx, p),
                                 flow_side="s")
 
+    def _send_all_planned(self, rnd, sends: Dict[int, list]) -> None:
+        """Steady-state planned round send (coll/plan frozen
+        schedules): channel tag, striping depth, and per-message frame
+        headers were precomposed at plan time, so this path is ONE
+        ULFM check + memoryview slicing behind precomposed header
+        bytes. Inter-process pvar accounting matches :meth:`_send_all`
+        exactly; obs-enabled rounds never reach here (the plan layer
+        falls back to the interpreted path so flow-id spans stay
+        complete)."""
+        self.router.coll_send_planned(self.comm, rnd, sends)
+        for arrs in sends.values():
+            for a in arrs:
+                _inter_msgs_sent.add()
+                _inter_bytes.add(int(a.nbytes))
+
     def _reap(self, pending: Dict[int, int],
-              on_arrival: Callable[[int, np.ndarray], None]) -> None:
+              on_arrival: Callable[[int, np.ndarray], None],
+              timeout_ms: Optional[int] = None) -> None:
         """Reap ``pending[p]`` messages per peer in ARRIVAL order —
         a slow peer never blocks the reap of one whose data already
-        landed (the posted-sends overlap the module docstring pins)."""
+        landed (the posted-sends overlap the module docstring pins).
+        ``timeout_ms``: explicit wait bound (frozen-plan replays pass
+        their plan-time snapshot); None = the live cvar."""
         left = sum(pending.values())
         tok = None
         if _watchdog.enabled:
@@ -363,7 +386,8 @@ class _HierModule:
             while left:
                 rec = _obs.enabled
                 t0 = _time.perf_counter() if rec else 0.0
-                src, arr = self.router.coll_recv_any(self.comm, pending)
+                src, arr = self.router.coll_recv_any(self.comm, pending,
+                                                     timeout_ms)
                 if tok is not None:
                     # progress resets the stall clock (and re-arms a
                     # wait that already dumped): a slow but ARRIVING

@@ -31,10 +31,13 @@ Blocking spanning collectives are expressed through the SAME machinery
 exactly one round-advancing code path (the old per-comm worker
 executor is gone). Persistent collectives build their plan once at
 ``*_init`` (the dispatch closure: resolved c_coll entry, op object,
-bound buffers — compiled programs and fusion/pipeline plans are cached
-per (op, shape, dtype), so every start after the first fires cached
-plans) and ``Request.start()`` re-fires it against the CURRENT buffer
-contents, the MPI persistent buffer-reuse contract.
+bound buffers, memoized plan signature) and ``Request.start()``
+re-fires it against the CURRENT buffer contents, the MPI persistent
+buffer-reuse contract — through :mod:`coll.plan`'s frozen schedule
+plans: in-process starts launch ONE cached compiled XLA program,
+spanning starts replay precomposed wire rounds (peer lists, frame
+headers, fragment offsets resolved at plan time). Blocking and
+i-family collectives ride the same per-(cid, signature) plan cache.
 
 Bitwise parity is structural: the nonblocking path runs the identical
 collective function the blocking path runs, only later and possibly on
@@ -53,6 +56,7 @@ from ..obs import sentinel as _sentinel
 from ..request.request import Request
 from ..runtime import progress as _progress
 from ..utils.errors import ErrorCode, MPIError
+from . import plan as _plan
 
 _ops_posted = pvar.counter(
     "nbc_ops_posted",
@@ -62,6 +66,16 @@ _ops_posted = pvar.counter(
 _persistent_starts = pvar.counter(
     "nbc_persistent_starts",
     "persistent-collective start() fires (plans built once at *_init)",
+)
+# the SAME registered timer coll/driver feeds (registration is
+# idempotent): here it covers the spanning POSTING prelude — sentinel
+# note, op construction, engine enqueue — the Python-orchestration
+# segment before the schedule/wire takes over
+_orch = pvar.timer(
+    "coll_orchestration_seconds",
+    "Python orchestration seconds on the collective dispatch path "
+    "(decision, planning, validation, posting — before the compiled "
+    "program or wire transport takes over)",
 )
 
 
@@ -188,6 +202,7 @@ def icoll(comm, name: str, args: Tuple, kw: Optional[Dict] = None
           ) -> Request:
     """Nonblocking collective: dispatch returns before completion for
     every family (no ``block_until_ready`` on the dispatch path)."""
+    t0 = _time.perf_counter()
     comm._check_usable()
     fn = _resolve(comm, name)
     # contract sentinel: the call signature is derived at POSTING time
@@ -196,15 +211,22 @@ def icoll(comm, name: str, args: Tuple, kw: Optional[Dict] = None
     sig = _sentinel.note(comm, name, args, kw) if _sentinel.enabled \
         else None
     if not comm.spans_processes:
-        return async_request(fn(comm, *args, **(kw or {})))
+        # steady state: a previously-seen signature fires its frozen
+        # compiled program through coll/plan instead of re-running the
+        # interpreted decision path
+        return async_request(
+            _plan.dispatch(comm, name, fn, tuple(args), kw))
     nested = _nested_inline(comm, fn, (comm,) + tuple(args), kw)
     if nested is not None:
         return nested
     if sig is not None:
         fn = _sentinel.wrap_inline(comm, sig, fn)
-    op = _make_op(comm, name, fn, (comm,) + tuple(args), kw)
+    run = _plan.spanning_wrap(
+        _plan.spanning_state_for(comm, name, args, kw), fn)
+    op = _make_op(comm, name, run, (comm,) + tuple(args), kw)
     req = _op_request(op)  # callback wired BEFORE the engine sees it
     _post(comm, op)
+    _orch.add(_time.perf_counter() - t0)
     return req
 
 
@@ -221,16 +243,24 @@ def run_blocking(comm, name: str, fn: Callable, args: Tuple,
     progress-thread/kick claim of another schedule on the same cid);
     the drain ledger skips ops running beneath this thread, so the
     nested wait cannot self-deadlock on its own outer op."""
+    t0 = _time.perf_counter()
     eng = _progress.engine()
     cur = eng.executing()
     if cur is not None and cur.key == _comm_key(comm):
         return fn(*args, **(kw or {}))
+    # the sentinel notes against the USER-FACING args (args[0] is the
+    # comm for c_coll entries; note() strips it), and the plan state
+    # keys on the same signature the i-family/persistent paths use
+    user_args = args[1:] if args and args[0] is comm else args
     if _sentinel.enabled:
-        sig = _sentinel.note(comm, name, args, kw)
+        sig = _sentinel.note(comm, name, user_args, kw)
         if sig is not None:
             fn = _sentinel.wrap_inline(comm, sig, fn)
-    op = _make_op(comm, name, fn, args, kw)
+    run = _plan.spanning_wrap(
+        _plan.spanning_state_for(comm, name, user_args, kw), fn)
+    op = _make_op(comm, name, run, args, kw)
     _post(comm, op)
+    _orch.add(_time.perf_counter() - t0)
     return eng.wait(op)
 
 
@@ -289,7 +319,13 @@ def persistent(comm, name: str, args: Tuple, kw: Optional[Dict] = None
     else:
         fn = _resolve(comm, name)
         if comm.spans_processes:
+            # the frozen wire plan is built ONCE per (cid, signature):
+            # the first start() records the round structure, every
+            # later start() replays precomposed frames (coll/plan)
+            state = _plan.spanning_state_for(comm, name, args, kw)
+
             def fire() -> Request:
+                t0 = _time.perf_counter()
                 # each start() is one collective round: it takes its
                 # own posting-seq slot in the comm's signature chain
                 run = fn
@@ -297,16 +333,26 @@ def persistent(comm, name: str, args: Tuple, kw: Optional[Dict] = None
                     sig = _sentinel.note(comm, name, args, kw)
                     if sig is not None:
                         run = _sentinel.wrap_inline(comm, sig, fn)
+                run = _plan.spanning_wrap(state, run)
                 op = _make_op(comm, name, run, (comm,) + tuple(args),
                               kw)
                 inner = _op_request(op)
                 _post(comm, op)
+                _orch.add(_time.perf_counter() - t0)
                 return inner
         else:
+            sig_box: list = []  # signature computed once, not per start
+
             def fire() -> Request:
                 if _sentinel.enabled:
                     _sentinel.note(comm, name, args, kw)
-                return async_request(fn(comm, *args, **kw))
+                # start() fires the signature's frozen compiled
+                # program (the MPI-4 "plan built once" promise made
+                # literal: one XLA program per plan, cached across
+                # starts via coll/plan)
+                return async_request(
+                    _plan.dispatch(comm, name, fn, tuple(args), kw,
+                                   sig_box=sig_box))
 
     def start(req) -> None:
         _persistent_starts.add()

@@ -376,7 +376,7 @@ def reduce_scatter(comm, x, recvcounts: Sequence[int], op: Op, *,
             return jnp.take(red, rank, axis=0)
 
     out = run_sharded(
-        comm, (kernel, "reduce_scatter", op.name, n, cmax, str(dtype)),
+        comm, (kernel, "reduce_scatter", op, n, cmax, str(dtype)),
         body, jnp.asarray(padded),
     )
     out = np.asarray(out).reshape(n, cmax)

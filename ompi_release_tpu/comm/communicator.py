@@ -357,6 +357,11 @@ class Communicator:
                 pass  # already freed
         _comm_registry.pop(self.cid, None)
         _sentinel.clear_chain(self.cid)
+        from ..coll import plan as _coll_plan
+
+        # frozen schedule plans die with their comm: a reused cid must
+        # never fire a dead comm's compiled programs or wire rounds
+        _coll_plan.clear_comm(self.cid)
         self._freed = True
         _comm_count.add(-1)
 
@@ -618,6 +623,12 @@ class Communicator:
                 f"no {op_name} implementation installed on {self.name}",
             )
         if not self.spans_processes:
+            # steady-state compiled dispatch (coll/plan): a signature
+            # seen before fires its frozen compiled program — the
+            # interpreted decision path runs once per (signature,
+            # cvar generation), not once per call
+            from ..coll import plan as _plan
+
             if _sentinel.enabled:
                 # contract sentinel: in-process collectives fold into
                 # the comm's signature chain too (chain determinism,
@@ -625,10 +636,11 @@ class Communicator:
                 # inside nbc.run_blocking where the args are bound
                 def noted(comm_, *a, **k):
                     _sentinel.note(self, op_name, a, k)
-                    return fn(comm_, *a, **k)
+                    return _plan.dispatch(comm_, op_name, fn, a, k)
 
                 return noted
-            return fn
+            return lambda comm_, *a, **k: _plan.dispatch(
+                comm_, op_name, fn, a, k)
         # fast ULFM fail: a collective involves every member, so a
         # known-failed member process fails the op NOW with the typed
         # error instead of posting a schedule doomed to park

@@ -166,6 +166,14 @@ class VarRegistry:
         self._file_values: Dict[str, str] = {}
         self._overrides: Dict[str, str] = {}
         self._files_loaded: List[str] = []
+        #: monotone write generation: bumped on every successful value
+        #: change (set_value/unset/apply_cli/param file/env refresh and
+        #: first-time registrations). Hot paths cache resolved values
+        #: stamped with this integer — one plain attribute read and an
+        #: int compare replaces a per-message lock + dict lookup, and a
+        #: stale stamp says exactly when to re-resolve (the "cvar
+        #: writes take effect at the next plan" contract).
+        self.generation: int = 0
 
     # -- registration -----------------------------------------------------
     def register(
@@ -210,6 +218,7 @@ class VarRegistry:
             # leave a half-initialized var in the registry
             self._resolve(var)
             self._vars[name] = var
+            self.generation += 1  # a NEW var changes get() results
             return var
 
     # -- value resolution (precedence) ------------------------------------
@@ -267,6 +276,7 @@ class VarRegistry:
             had_prev = name in self._overrides
             prev = self._overrides.get(name)
             self._overrides[name] = value
+            self.generation += 1
             if var is not None:
                 try:
                     self._resolve(var)
@@ -288,6 +298,7 @@ class VarRegistry:
     def unset(self, name: str) -> None:
         with self._lock:
             self._overrides.pop(name, None)
+            self.generation += 1
             var = self._vars.get(name)
             if var is not None:
                 self._resolve(var)
@@ -306,6 +317,7 @@ class VarRegistry:
         with self._lock:
             self._file_values.update(parsed)
             self._files_loaded.append(path)
+            self.generation += 1
             self._resolve_all()
         return len(parsed)
 
@@ -328,11 +340,13 @@ class VarRegistry:
                     )
                     continue
                 self._overrides[key] = val
+            self.generation += 1
             self._resolve_all()
 
     def refresh_from_env(self) -> None:
         """Re-read environment (tests mutate os.environ)."""
         with self._lock:
+            self.generation += 1
             self._resolve_all()
 
     def describe_all(self, max_level: VarLevel = VarLevel.DEV_ALL) -> List[Dict]:
@@ -354,6 +368,7 @@ class VarRegistry:
             self._file_values.clear()
             self._overrides.clear()
             self._files_loaded.clear()
+            self.generation += 1
 
 
 #: process-global registry — the single config mechanism (SURVEY §5).

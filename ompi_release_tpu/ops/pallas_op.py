@@ -165,11 +165,21 @@ def _pallas_sum_fn(a, b):
     return _apply_blocked(kernel, 2, AXPY_BLOCK, a, b)
 
 
-def make_pallas_sum():
-    from .op import Op
+_pallas_sum_op = None
 
-    return Op("sum[pallas]", _pallas_sum_fn, commutative=True,
-              identity=lambda d: 0, lax_collective=None)
+
+def make_pallas_sum():
+    # ONE Op instance for the component's lifetime: program caches key
+    # compiled collectives by the op OBJECT, so a fresh Op per lookup
+    # would recompile on every resolved call
+    global _pallas_sum_op
+    if _pallas_sum_op is None:
+        from .op import Op
+
+        _pallas_sum_op = Op("sum[pallas]", _pallas_sum_fn,
+                            commutative=True, identity=lambda d: 0,
+                            lax_collective=None)
+    return _pallas_sum_op
 
 
 class PallasOpComponent(mca_component.Component):

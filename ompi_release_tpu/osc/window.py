@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import enum
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -495,14 +495,17 @@ class Window:
 
     # -- application -------------------------------------------------------
     @staticmethod
-    def _branch_key(p: _PendingOp) -> Tuple[str, str, bool]:
+    def _branch_key(p: _PendingOp) -> Tuple[str, Any, bool]:
         indexed = p.index is not None
         if p.kind in ("acc", "get_acc"):
-            return ("acc", p.op.name, indexed)
+            # the op OBJECT (frozen, hashable), not its name: branch
+            # keys feed the epoch program cache sig, and a same-named
+            # op with a different combiner must get its own branch
+            return ("acc", p.op, indexed)
         return (p.kind, "", indexed)
 
     @staticmethod
-    def _branch_fn(key: Tuple[str, str, bool], op: Optional[Op]):
+    def _branch_fn(key: Tuple[str, Any, bool], op: Optional[Op]):
         """One lax.switch branch: (cur, payload, compare, idx) ->
         (new_slice, pre_op_read). ``payload``/``compare`` may be
         scalars (scalar-payload epochs) or full slices; indexed
@@ -608,7 +611,7 @@ class Window:
             for p in todo
         ) and block != ()
 
-        branch_keys: List[Tuple[str, str, bool]] = []
+        branch_keys: List[Tuple[str, Any, bool]] = []
         branch_fns = []
         codes: List[int] = []
         for p in todo:

@@ -424,6 +424,63 @@ def tree_allgather(shards, shapes, axis_name: str, *,
 
 
 # ---------------------------------------------------------------------------
+# compiled whole-tree pass: ONE jitted program for the whole schedule
+# ---------------------------------------------------------------------------
+
+def run_tree_pass(comm, tree_, *, kind: str = "allreduce",
+                  mean: bool = False,
+                  bucket_bytes: Optional[int] = None):
+    """Run a whole planned tree pass as ONE compiled XLA program on a
+    host-driver communicator (leaves follow the driver convention:
+    leading axis == comm.size). Every bucket's pack / collective /
+    unpack — the entire fused schedule — traces into a single jitted
+    ``shard_map`` program cached per (kind, plan signature) in the
+    driver's per-comm plan cache, so steady-state steps launch one
+    program with zero per-bucket Python work (the coll/plan
+    discipline applied to trees). ``kind``: ``allreduce`` returns the
+    reduced tree; ``reduce_scatter`` returns the per-leaf flat shard
+    tree (same contract as :func:`tree_reduce_scatter`).
+
+    Bitwise-identical to the per-leaf and planned SPMD paths — the
+    body IS :func:`tree_allreduce` / :func:`tree_reduce_scatter`."""
+    import jax
+
+    from ..coll import driver as _driver
+
+    if kind not in ("allreduce", "reduce_scatter"):
+        raise ValueError(f"run_tree_pass kind {kind!r} not in "
+                         "('allreduce', 'reduce_scatter')")
+    leaves, treedef = jax.tree.flatten(tree_)
+    if not leaves:
+        return tree_
+    if bucket_bytes is None:
+        total = sum(
+            int(np.prod(tuple(l.shape[1:]), dtype=np.int64))
+            * int(np.dtype(l.dtype).itemsize) for l in leaves
+        )
+        bucket_bytes = resolve_bucket_bytes(comm.size, total)
+    # plan over the PER-RANK leaf signatures (leading axis stripped:
+    # inside shard_map each block is one rank's slice)
+    plan = plan_from_meta([(l.shape[1:], l.dtype) for l in leaves],
+                          int(bucket_bytes))
+    key = ("tree", kind, bool(mean), int(bucket_bytes), plan.meta)
+
+    def body(*blocks):
+        sub = jax.tree.unflatten(treedef, list(blocks))
+        if kind == "allreduce":
+            out = tree_allreduce(sub, "rank", mean=mean,
+                                 bucket_bytes=int(bucket_bytes))
+        else:
+            out = tree_reduce_scatter(sub, "rank", mean=mean,
+                                      bucket_bytes=int(bucket_bytes))
+        return tuple(jax.tree.flatten(out)[0])
+
+    outs = _driver.run_sharded(comm, key, body, leaves[0],
+                               extra_arrays=tuple(leaves[1:]))
+    return jax.tree.unflatten(treedef, list(outs))
+
+
+# ---------------------------------------------------------------------------
 # driver pass: one nonblocking collective per bucket, overlapped
 # ---------------------------------------------------------------------------
 

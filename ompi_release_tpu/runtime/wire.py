@@ -154,9 +154,41 @@ def register_vars() -> None:
         "(posted-sends overlap) instead of fixed process order; false "
         "restores the sequential per-peer receive loop",
     )
+    mca_var.register(
+        "wire_coll_timeout_ms", "int", 60_000,
+        "Default bound in milliseconds for blocking collective/ctl "
+        "wire waits (coll_recv, coll_recv_any, ctl_recv, barrier "
+        "tokens). Compiled-schedule waits and chaos tests tune this; "
+        "explicit per-call timeouts still win",
+    )
 
 
 register_vars()  # idempotent; cvars must exist before the first router
+
+
+class WireTuning:
+    """One immutable snapshot of the wire's hot-path cvars, resolved
+    through the registry ONCE and stamped with the registry write
+    generation. Per-message sends used to pay a registry lock + dict
+    lookup each for ``wire_p2p_lanes`` / ``wire_pipeline_depth`` /
+    ``wire_pipeline_segsize``; the router now reads attributes off the
+    current snapshot and re-resolves only when the generation moved —
+    so a mid-job cvar write takes effect at the next snapshot refresh
+    (and, for frozen schedule plans, at the next PLAN, which captures
+    the snapshot at freeze time — never mid-schedule)."""
+
+    __slots__ = ("gen", "lanes", "depth", "segsize", "coll_timeout_ms")
+
+    def __init__(self) -> None:
+        self.gen = mca_var.VARS.generation
+        self.lanes = max(1, min(_MAX_LANES,
+                                int(mca_var.get("wire_p2p_lanes", 4)
+                                    or 1)))
+        self.depth = max(1, int(mca_var.get("wire_pipeline_depth", 4)
+                                or 1))
+        self.segsize = int(mca_var.get("wire_pipeline_segsize", 0) or 0)
+        self.coll_timeout_ms = int(
+            mca_var.get("wire_coll_timeout_ms", 60_000) or 60_000)
 
 
 class ProcTopology:
@@ -253,6 +285,25 @@ class WireRouter:
         #: busy endpoint cannot turn the progress thread into a
         #: continuous blocking-recv loop
         self._pump_idle: Dict[int, float] = {}
+        #: hot-path cvars resolved once at init (satellite of the
+        #: compiled-schedule PR): refreshed only when the registry
+        #: write generation moves — see WireTuning
+        self._tuning = WireTuning()
+
+    def tuning(self) -> WireTuning:
+        """Current wire-tuning snapshot (generation-checked: one int
+        compare on the hot path; a cvar write re-resolves lazily)."""
+        t = self._tuning
+        if t.gen != mca_var.VARS.generation:
+            t = self._tuning = WireTuning()
+        return t
+
+    def refresh_tuning(self) -> WireTuning:
+        """Force a fresh snapshot NOW (plan-freeze entry: a frozen
+        schedule plan must capture post-write values even if the
+        generation bookkeeping ever lagged)."""
+        t = self._tuning = WireTuning()
+        return t
 
     def _chan_lock(self, kind: str, key) -> threading.Lock:
         with self._chan_guard:
@@ -286,14 +337,11 @@ class WireRouter:
         return self._shm if same_host else self._dcn
 
     # -- lanes -------------------------------------------------------------
-    @staticmethod
-    def _lanes() -> int:
-        return max(1, min(_MAX_LANES,
-                          int(mca_var.get("wire_p2p_lanes", 4) or 1)))
-
-    @staticmethod
-    def _lane_of(user_tag: int) -> int:
-        return int(user_tag) % WireRouter._lanes()
+    def _lane_of(self, user_tag: int) -> int:
+        """THE lane-selection rule (single definition — send and any
+        future drain/debug site must agree), reading the
+        generation-cached ``tuning()`` snapshot, never the registry."""
+        return int(user_tag) % self.tuning().lanes
 
     @staticmethod
     def _p2p_tag(dst_world: int, lane: int) -> int:
@@ -456,7 +504,7 @@ class WireRouter:
         if timeout_ms <= 1 and self.ep.pending() == 0:
             return False
         deadline = time.monotonic() + timeout_ms / 1000
-        nlanes = self._lanes()
+        nlanes = self.tuning().lanes
         # lanes beyond the local cvar get ONE cheap probe per blocking
         # drain call: a sender configured with MORE lanes
         # (heterogeneous MCA env, or the cvar flipped mid-flight) must
@@ -675,10 +723,13 @@ class WireRouter:
         self._send_payload(peer_pidx, self._coll_tag(comm), arr,
                            epoch0=epoch0)
 
-    def coll_recv(self, comm, src_pidx: int, timeout_ms: int = 60_000):
+    def coll_recv(self, comm, src_pidx: int,
+                  timeout_ms: Optional[int] = None):
         early = self._coll_early_pop(comm.cid, src_pidx)
         if early is not None:
             return early
+        if timeout_ms is None:  # wire_coll_timeout_ms cvar (tunable)
+            timeout_ms = self.tuning().coll_timeout_ms
         # serialize against the progress engine's pump: two consumers
         # popping frames of ONE multi-frame transfer would split it.
         # The caller's timeout budget covers the lock wait too — a
@@ -772,14 +823,26 @@ class WireRouter:
         return n
 
     def _peer_frames(self, peer: int, tag: int, arrs: List,
-                     epoch0: int = 0):
+                     epoch0: int = 0, templates=None):
         """Side-effecting generator: each ``next()`` puts ONE wire
         frame of this peer's transfer queue on the OOB. DCN transfers
         above the pipeline segsize stream as zero-copy fragments; shm
-        handoffs and legacy/small transfers count as one frame."""
+        handoffs and legacy/small transfers count as one frame.
+        ``templates`` (a frozen plan's per-array FrameTemplates, None
+        entries = generic path) selects the precomposed-header send:
+        no per-message cvar read or header packing."""
         btl = self._btl_for(peer)
         nid = self._nid(peer)
-        for a in arrs:
+        for k, a in enumerate(arrs):
+            tpl = templates[k] if templates is not None else None
+            if tpl is not None and btl is self._dcn:
+                for frame in self._dcn.planned_frames(a, tpl):
+                    self._retry(
+                        lambda f=frame: self.ep.send(nid, tag, f),
+                        f"pipelined fragment to process {peer}",
+                    )
+                    yield
+                continue
             seg = self._dcn.pipeline_segsize() if btl is self._dcn else 0
             if seg > 0:
                 # pvar accounting happens inside staged_frames — the
@@ -801,10 +864,35 @@ class WireRouter:
         side starts reassembling while the round is still being sent,
         instead of peer P+1 waiting for peer P's full payload."""
         tag = self._coll_tag(comm)
-        depth = max(1, int(mca_var.get("wire_pipeline_depth", 4) or 1))
+        depth = self.tuning().depth
         epoch0 = getattr(comm, "_ft_epoch0", 0)
         streams = [self._peer_frames(p, tag, arrs_for[p], epoch0)
                    for p in sorted(arrs_for) if arrs_for[p]]
+        self._stripe(streams, depth)
+
+    def coll_send_planned(self, comm, rnd, sends: Dict[int, List]) -> None:
+        """Steady-state round send from a frozen schedule plan
+        (:mod:`coll.plan`): the round's peer list, per-peer templates
+        (precomposed SGH2 headers + fragment offsets), striping depth
+        and channel tag were all resolved at plan time — this path
+        does ONE ULFM check for the round and then streams memoryview
+        slices behind precomposed header bytes. Same frames, same
+        striping discipline, same FIFO-per-peer ordering as
+        :meth:`coll_send_all`."""
+        epoch0 = getattr(comm, "_ft_epoch0", 0)
+        _ft().check_wait(comm.cid, rnd.peers, "collective send",
+                         epoch0=epoch0)
+        streams = [
+            self._peer_frames(p, rnd.tag, sends[p], epoch0,
+                              templates=tpls)
+            for p, tpls in rnd.peer_slots
+        ]
+        self._stripe(streams, rnd.depth)
+
+    @staticmethod
+    def _stripe(streams: List, depth: int) -> None:
+        """Round-robin the per-peer frame generators in depth-sized
+        bursts (the sliding in-flight window)."""
         while streams:
             keep = []
             for it in streams:
@@ -820,14 +908,17 @@ class WireRouter:
             streams = keep
 
     def coll_recv_any(self, comm, pending: Dict[int, int],
-                      timeout_ms: int = 60_000):
+                      timeout_ms: Optional[int] = None):
         """Complete the NEXT transfer on ``comm``'s payload channel
         from whichever peer's frames arrive first; returns
         ``(src_pidx, array)``. ``pending`` maps peer -> messages still
         expected this round; a completed transfer from a peer with no
         outstanding count belongs to a FUTURE round (that peer raced
         ahead) and is queued for its own round's receive instead of
-        being returned out of context."""
+        being returned out of context. The default wait bound is the
+        ``wire_coll_timeout_ms`` cvar."""
+        if timeout_ms is None:
+            timeout_ms = self.tuning().coll_timeout_ms
         for p in list(pending):
             if pending.get(p, 0) > 0:
                 early = self._coll_early_pop(comm.cid, p)
@@ -955,7 +1046,7 @@ class WireRouter:
             raise
 
     def sentinel_exchange(self, comm, payload: bytes,
-                          timeout_ms: int = 60_000) -> Dict[int, bytes]:
+                          timeout_ms: Optional[int] = None) -> Dict[int, bytes]:
         """Collective contract sentinel piggyback path (obs_sentinel=2):
         exchange one small signature frame with every member process
         on the comm's ctl channel, strictly BEFORE the round's first
@@ -997,7 +1088,9 @@ class WireRouter:
         )
 
     def ctl_recv(self, comm, src_pidx: int,
-                 timeout_ms: int = 60_000) -> bytes:
+                 timeout_ms: Optional[int] = None) -> bytes:
+        if timeout_ms is None:  # wire_coll_timeout_ms cvar (tunable)
+            timeout_ms = self.tuning().coll_timeout_ms
         tok = None
         if _watchdog.enabled:
             tok = _watchdog.arm(
@@ -1019,7 +1112,7 @@ class WireRouter:
                 _watchdog.disarm(tok)
 
     def proc_barrier(self, comm, procs: List[int],
-                     timeout_ms: int = 60_000) -> None:
+                     timeout_ms: Optional[int] = None) -> None:
         """Dissemination barrier among the participating processes
         (log2 rounds of token exchange on the comm's control channel)."""
         p = len(procs)

@@ -51,11 +51,13 @@ HIGHER_BETTER = {"GB/s", "TFLOP/s", "frac_hidden"}
 LOWER_BETTER = {"s", "seconds", "us", "us/hop", "hol_wait_s",
                 "sends_at_root", "device_collectives", "steps"}
 #: metric-name fallback when the unit alone is ambiguous: the overlap
-#: suite's lines (hidden-comm fraction, overlap speedups) and the
+#: suite's lines (hidden-comm fraction, overlap speedups), the
 #: tree_overlap suite's lines (planned-pass speedup, whole-tree
-#: hidden-comm fraction, nonblocking-pipeline speedup) are all
-#: higher-better — less comm time exposed on the critical path
-METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_")
+#: hidden-comm fraction, nonblocking-pipeline speedup), and the
+#: steady_state suite's compiled_* lines (interpreted-vs-compiled
+#: orchestration speedups) are all higher-better — less comm or
+#: Python time exposed on the critical path
+METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_", "compiled_")
 #: ...and the ft_recovery suite's lines (recovery wall time, steps
 #: recomputed after rollback) and the contract-sentinel suite's lines
 #: (per-collective overhead, enabled AND disabled legs) are all
@@ -67,8 +69,10 @@ METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_")
 #: code over the fabric model (tier_label "sim" keeps them out of the
 #: wall-clock tiers' fits), so a tripped bound is a real scaling
 #: regression — a schedule doing more rounds or shipping more bytes
-#: at the same P — not measurement noise
-METRIC_LOWER_BETTER_PREFIXES = ("ft_", "sentinel_", "sim_")
+#: at the same P — not measurement noise. The steady_state suite's
+#: steady_* lines (per-op wall and Python-orchestration seconds for
+#: interpreted and compiled legs) are lower-better latencies.
+METRIC_LOWER_BETTER_PREFIXES = ("ft_", "sentinel_", "sim_", "steady_")
 
 DEFAULT_SIGMA = 4.0
 #: relative noise floor: the bench's own ceiling docs put single-run

@@ -389,7 +389,7 @@ def test_reduce_scatter_ring_parity(tuned):
     n = tuned.size
     x = _per_rank(tuned, n * 25, seed=24)
     out = tuned.reduce_scatter_block(x, ops.SUM)
-    assert ("tuned", "reduce_scatter_block", "sum") in tuned._coll_programs
+    assert ("tuned", "reduce_scatter_block", ops.SUM) in tuned._coll_programs
     full = x.sum(axis=0)
     for r in range(n):
         np.testing.assert_allclose(
@@ -579,7 +579,7 @@ def test_general_reduce_scatter_pair_op(world):
 def test_scan_tuned(tuned):
     x = _per_rank(tuned, 20, seed=38)
     out = tuned.scan(x, ops.SUM)
-    assert ("tuned", "scan", "sum") in tuned._coll_programs
+    assert ("tuned", "scan", ops.SUM) in tuned._coll_programs
     np.testing.assert_allclose(
         np.asarray(out), np.cumsum(x, axis=0), rtol=2e-5
     )

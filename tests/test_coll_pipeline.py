@@ -91,7 +91,7 @@ class TestPipelineBitwiseParity:
         np.testing.assert_array_equal(mono, pipe)  # BITWISE
         # the pipelined program is its own plan-cache entry, keyed by
         # the segment count
-        assert ("tuned", "allreduce", "ring", "sum", "pipelined", 3) \
+        assert ("tuned", "allreduce", "ring", ops.SUM, "pipelined", 3) \
             in tuned._coll_programs
         assert seg["sum"] - seg_sum0 == 3
 

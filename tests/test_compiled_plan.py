@@ -1,0 +1,741 @@
+"""Compiled whole-schedule collectives (coll/plan): frozen device
+programs, frozen wire rounds, plan-time frame precomposition, and the
+hot-path cvar caching satellites.
+
+Five layers:
+
+1. Device-free plan metadata: signatures (stable across identical
+   calls, distinct across shapes, None for ragged/unplannable calls),
+   frozen frame templates whose byte stream is IDENTICAL to the
+   interpreted ``staged_frames`` path, and the wire-tuning snapshot
+   (resolve once; a mid-job cvar write takes effect at the NEXT
+   snapshot/plan, never mid-schedule).
+2. Device-free wire-plan record/replay over fakes: structure
+   verification, loud divergence errors, generation invalidation.
+3. In-process compiled plans on the real 8-device world: steady-state
+   blocking fires, the MPI-4 persistent 10x re-fire satellite
+   (exactly one compile, ``coll_compiled_cache_hits`` == 9, bitwise
+   parity vs the interpreted leg on every fire, progress thread on),
+   obs fallback, and the compiled whole-tree pass.
+4. Fleet-scale determinism: the recorded round schedule of a P=256
+   recursive-doubling allreduce replays bit-identically against the
+   interpreted ``hier_schedules`` rounds on the simulators.
+5. One real 3-process job: spanning persistent allreduce records a
+   wire plan at first start and replays precomposed frames after,
+   bitwise-equal, with the pvar witnesses.
+"""
+
+import itertools
+import os
+import sys
+import textwrap
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu import ops
+from ompi_release_tpu.btl import components as btlc
+from ompi_release_tpu.coll import plan
+from ompi_release_tpu.mca import pvar
+from ompi_release_tpu.mca import var as mca_var
+from ompi_release_tpu.native import DssBuffer
+from ompi_release_tpu.runtime import wire
+from ompi_release_tpu.runtime.state import JobState
+from ompi_release_tpu.testing import lockstep
+from ompi_release_tpu.tools.tpurun import Job
+from ompi_release_tpu.utils.errors import ErrorCode, MPIError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pv(name):
+    p = pvar.PVARS.lookup(name)
+    assert p is not None, name
+    return p.read()
+
+
+@pytest.fixture(scope="module")
+def world():
+    return mpi.init()
+
+
+# ---------------------------------------------------------------------------
+# 1. device-free plan metadata
+# ---------------------------------------------------------------------------
+
+
+class TestSignatures:
+    def test_identical_calls_share_a_signature(self):
+        a = np.zeros((8, 16), np.float32)
+        b = np.zeros((8, 16), np.float32)
+        s1 = plan.signature_of("allreduce", (a, ops.SUM), {})
+        s2 = plan.signature_of("allreduce", (b, ops.SUM), {})
+        assert s1 == s2 and s1 is not None
+
+    def test_shape_dtype_root_op_distinguish(self):
+        a = np.zeros((8, 16), np.float32)
+        base = plan.signature_of("bcast", (a, 0), {})
+        assert base != plan.signature_of("bcast", (a, 1), {})
+        assert base != plan.signature_of(
+            "bcast", (np.zeros((8, 17), np.float32), 0), {})
+        assert base != plan.signature_of(
+            "bcast", (a.astype(np.int32), 0), {})
+        # two distinct Op OBJECTS must not share a plan (a user op
+        # named "sum" with a different fn would corrupt results)
+        s_sum = plan.signature_of("allreduce", (a, ops.SUM), {})
+        s_max = plan.signature_of("allreduce", (a, ops.MAX), {})
+        assert s_sum != s_max
+
+    def test_unplannable_calls_return_none(self):
+        # ragged buffer lists (v-variants) and pair-op tuples carry
+        # data-dependent structure
+        assert plan.signature_of(
+            "allgatherv", ([np.zeros(3)],), {}) is None
+        vals = np.zeros((8, 4), np.float32)
+        idxs = np.zeros((8, 4), np.int32)
+        assert plan.signature_of(
+            "allreduce", ((vals, idxs), ops.MINLOC), {}) is None
+
+    def test_scalar_sequences_are_plannable(self):
+        x = np.zeros((8, 16), np.float32)
+        s = plan.signature_of("reduce_scatter", (x, [2] * 8, ops.SUM),
+                              {})
+        assert s is not None
+        assert s != plan.signature_of(
+            "reduce_scatter", (x, [4] * 4, ops.SUM), {})
+
+
+class TestFrameTemplates:
+    def test_planned_frames_byte_identical_to_staged(self):
+        """The frozen-template send path must put the EXACT bytes of
+        the interpreted ``staged_frames`` path on the wire — the
+        receiver is unchanged, so byte identity IS the parity proof."""
+        b = btlc.DcnBtl()
+        arr = np.arange(3000, dtype=np.float32).reshape(30, 100)
+        saved = btlc._xfer_ids
+        try:
+            btlc._xfer_ids = itertools.count(42)
+            legacy = [bytes(f) for f in b.staged_frames(arr,
+                                                        segsize=1024)]
+            btlc._xfer_ids = itertools.count(42)
+            tpl = btlc.plan_frame_template(arr.shape, arr.dtype, 1024)
+            planned = [bytes(f) for f in b.planned_frames(arr, tpl)]
+        finally:
+            btlc._xfer_ids = saved
+        assert planned == legacy
+        assert len(planned) == tpl.nchunks + 1  # header + fragments
+
+    def test_template_header_parses(self):
+        tpl = btlc.plan_frame_template((4, 4), "int32", 32)
+        hdr = DssBuffer(tpl.header(xfer=7, crc=99))
+        assert hdr.unpack_string() == "SGH2"
+        assert hdr.unpack_int64() == [7]
+        assert np.dtype(hdr.unpack_string()) == np.dtype("int32")
+        assert hdr.unpack_string() == "4,4"
+        assert hdr.unpack_int64(2) == [tpl.nchunks, tpl.chunk]
+        assert hdr.unpack_int64() == [99]
+
+    def test_template_mismatch_raises(self):
+        b = btlc.DcnBtl()
+        tpl = btlc.plan_frame_template((8,), "float32", 16)
+        with pytest.raises(MPIError) as ei:
+            list(b.planned_frames(np.zeros(9, np.float32), tpl))
+        assert "frozen frame template" in str(ei.value)
+
+
+class TestWireTuning:
+    def test_snapshot_resolves_and_freezes(self):
+        mca_var.set_value("wire_p2p_lanes", 2)
+        mca_var.set_value("wire_coll_timeout_ms", 1234)
+        try:
+            t = wire.WireTuning()
+            assert t.lanes == 2 and t.coll_timeout_ms == 1234
+            # a later write does NOT change the frozen snapshot (a
+            # plan holding it never sees mid-schedule changes)...
+            mca_var.set_value("wire_p2p_lanes", 3)
+            assert t.lanes == 2
+            # ...but a FRESH snapshot (the next plan) picks it up
+            assert wire.WireTuning().lanes == 3
+        finally:
+            mca_var.VARS.unset("wire_p2p_lanes")
+            mca_var.VARS.unset("wire_coll_timeout_ms")
+
+    def test_router_tuning_is_generation_cached(self, monkeypatch):
+        r = wire.WireRouter.__new__(wire.WireRouter)
+        r._tuning = wire.WireTuning()
+        first = r.tuning()
+        assert r.tuning() is first  # no write -> same snapshot object
+        calls = []
+        real_get = mca_var.VARS.get
+        monkeypatch.setattr(mca_var.VARS, "get",
+                            lambda *a, **k: (calls.append(a),
+                                             real_get(*a, **k))[1])
+        for _ in range(50):
+            r.tuning()
+        assert not calls, "steady-state tuning() must not hit the " \
+                          "registry"
+        mca_var.set_value("wire_pipeline_depth", 7)
+        try:
+            t2 = r.tuning()
+            assert t2 is not first and t2.depth == 7
+        finally:
+            mca_var.VARS.unset("wire_pipeline_depth")
+
+    def test_coll_timeout_cvar_bounds_waits(self, monkeypatch):
+        """Satellite: the hard-coded 60 s collective/ctl wait default
+        is now the ``wire_coll_timeout_ms`` cvar."""
+        r = wire.WireRouter.__new__(wire.WireRouter)
+        r._tuning = wire.WireTuning()
+        r._coll_early = {}
+        r._coll_early_lock = threading.Lock()
+        r._chan_locks = {}
+        r._chan_guard = threading.Lock()
+        captured = {}
+
+        def fake_sliced(want_src, tag, deadline, comm, peers_fn, what,
+                        msg):
+            captured["deadline"] = deadline
+            raise MPIError(ErrorCode.ERR_PENDING, msg)
+
+        monkeypatch.setattr(r, "_sliced_recv", fake_sliced)
+        comm = SimpleNamespace(cid=5, name="c", _ft_epoch0=0)
+        mca_var.set_value("wire_coll_timeout_ms", 1500)
+        try:
+            for fn in (lambda: r.coll_recv(comm, 1),
+                       lambda: r.ctl_recv(comm, 1)):
+                t0 = time.monotonic()
+                with pytest.raises(MPIError):
+                    fn()
+                waited = captured.pop("deadline") - t0
+                assert 1.2 < waited < 1.8, waited
+        finally:
+            mca_var.VARS.unset("wire_coll_timeout_ms")
+
+    def test_dcn_segsize_is_generation_cached(self, monkeypatch):
+        b = btlc.DcnBtl()
+        mca_var.set_value("wire_pipeline_segsize", 4096)
+        try:
+            assert b.pipeline_segsize() == 4096
+            calls = []
+            real_get = mca_var.VARS.get
+            monkeypatch.setattr(mca_var.VARS, "get",
+                                lambda *a, **k: (calls.append(a),
+                                                 real_get(*a, **k))[1])
+            for _ in range(50):
+                assert b.pipeline_segsize() == 4096
+            assert not calls, "per-message segsize reads must be " \
+                              "generation-cached"
+            mca_var.set_value("wire_pipeline_segsize", 8192)
+            assert b.pipeline_segsize() == 8192
+        finally:
+            mca_var.VARS.unset("wire_pipeline_segsize")
+
+
+# ---------------------------------------------------------------------------
+# 2. device-free wire-plan record/replay
+# ---------------------------------------------------------------------------
+
+
+class _FakeInner:
+    def __init__(self):
+        self.rounds = 0
+
+    def exchange(self, sends, recvs):
+        self.rounds += 1
+        return {p: [np.zeros(4, np.float32)] * int(c)
+                for p, c in recvs.items() if int(c) > 0}
+
+
+class _FakeModule:
+    def __init__(self, comm):
+        self.comm = comm
+        self._xchg = _FakeInner()
+        self.planned_rounds = []
+        self.reap_timeouts = []
+
+    def _send_all_planned(self, rnd, sends):
+        self.planned_rounds.append(rnd)
+
+    def _reap(self, pending, on_arrival, timeout_ms=None):
+        self.reap_timeouts.append(timeout_ms)
+        for p, c in pending.items():
+            for _ in range(c):
+                on_arrival(p, np.zeros(4, np.float32))
+
+
+def _fake_comm(cid=900):
+    comm = SimpleNamespace(cid=cid, name=f"fake{cid}",
+                           runtime=SimpleNamespace(wire=None))
+    comm._hier_module = _FakeModule(comm)
+    return comm
+
+
+def _schedule(m, payload):
+    """A two-round fixed schedule driven through m._xchg."""
+    got1 = m._xchg.exchange({1: [payload]}, {1: 1})
+    got2 = m._xchg.exchange({2: [payload, payload]}, {2: 2})
+    return got1[1][0] + got2[2][0]
+
+
+def _manual_plan(recorded, gen, cid):
+    rounds = [plan.WireRound(meta, rec, tuple(
+        (p, tuple(None for _ in arrs)) for p, arrs in meta),
+        tag=0, depth=1) for meta, rec in recorded]
+    return plan.WirePlan(gen, cid, rounds, 60_000)
+
+
+class TestWirePlanReplay:
+    def test_record_then_replay_uses_planned_sends(self, monkeypatch):
+        comm = _fake_comm()
+        m = comm._hier_module
+        state = plan.SpanningPlanState(comm, "allreduce")
+        monkeypatch.setattr(
+            plan, "freeze_wire_plan",
+            lambda c, rec, gen: _manual_plan(rec, gen, c.cid))
+        payload = np.ones(4, np.float32)
+        h0 = _pv("coll_compiled_cache_hits")
+        state.run(lambda: _schedule(m, payload), (), {})  # records
+        assert state.plan is not None
+        assert len(state.plan.rounds) == 2
+        assert m._xchg.rounds == 2 and not m.planned_rounds
+        state.run(lambda: _schedule(m, payload), (), {})  # replays
+        assert m._xchg.rounds == 2, "replay must not use the " \
+                                    "interpreted transport"
+        assert len(m.planned_rounds) == 2
+        # replay waits are bounded by the PLAN-TIME timeout snapshot
+        assert m.reap_timeouts == [60_000, 60_000]
+        h1 = _pv("coll_compiled_cache_hits")
+        assert h1["sum"] - h0["sum"] == 1
+        assert h1["count"] - h0["count"] == 2
+
+    def test_divergence_is_a_loud_typed_error(self, monkeypatch):
+        comm = _fake_comm(901)
+        m = comm._hier_module
+        state = plan.SpanningPlanState(comm, "allreduce")
+        monkeypatch.setattr(
+            plan, "freeze_wire_plan",
+            lambda c, rec, gen: _manual_plan(rec, gen, c.cid))
+        state.run(lambda: _schedule(m, np.ones(4, np.float32)), (), {})
+        with pytest.raises(MPIError) as ei:
+            state.run(lambda: _schedule(m, np.ones(5, np.float32)),
+                      (), {})
+        assert ei.value.code == ErrorCode.ERR_INTERN
+        assert "diverged" in str(ei.value)
+        # the raise must DROP the stale plan so the error's own advice
+        # ("re-issue the collective") works: the next fire re-records
+        # instead of replaying the same frozen rounds forever
+        assert state.plan is None
+        state.run(lambda: _schedule(m, np.ones(5, np.float32)), (), {})
+        assert state.plan is not None
+        assert state.plan.rounds[0].sends_meta[0][1][0][0] == (5,)
+
+    def test_overlap_opt_out_stays_interpreted(self, monkeypatch):
+        """wire_overlap_exchange=False serializes sends in the
+        interpreted adapter; the planned replay path is striped by
+        construction, so the opt-out must bypass planning entirely."""
+        comm = _fake_comm(903)
+        m = comm._hier_module
+        state = plan.SpanningPlanState(comm, "allreduce")
+        monkeypatch.setattr(
+            plan, "freeze_wire_plan",
+            lambda c, rec, gen: _manual_plan(rec, gen, c.cid))
+        pay = np.ones(4, np.float32)
+        mca_var.set_value("wire_overlap_exchange", False)
+        try:
+            state.run(lambda: _schedule(m, pay), (), {})
+            state.run(lambda: _schedule(m, pay), (), {})
+            assert state.plan is None, \
+                "overlap opt-out must not freeze a striped plan"
+            assert m._xchg.rounds == 4 and not m.planned_rounds
+        finally:
+            mca_var.VARS.unset("wire_overlap_exchange")
+        state.run(lambda: _schedule(m, pay), (), {})  # re-enabled
+        assert state.plan is not None
+
+    def test_cvar_write_takes_effect_at_next_plan(self, monkeypatch):
+        """Satellite: a mid-job cvar write re-plans at the NEXT fire
+        — the stale frozen plan is dropped, never half-applied."""
+        comm = _fake_comm(902)
+        m = comm._hier_module
+        state = plan.SpanningPlanState(comm, "allreduce")
+        monkeypatch.setattr(
+            plan, "freeze_wire_plan",
+            lambda c, rec, gen: _manual_plan(rec, gen, c.cid))
+        pay = np.ones(4, np.float32)
+        state.run(lambda: _schedule(m, pay), (), {})
+        frozen = state.plan
+        state.run(lambda: _schedule(m, pay), (), {})  # replay
+        assert state.plan is frozen
+        mca_var.set_value("wire_pipeline_depth", 9)  # generation bump
+        try:
+            state.run(lambda: _schedule(m, pay), (), {})
+            assert state.plan is not frozen, \
+                "cvar write must re-plan at the next fire"
+            # 2 recorded + 0 replayed + 2 re-recorded interpreted
+            assert m._xchg.rounds == 4
+        finally:
+            mca_var.VARS.unset("wire_pipeline_depth")
+
+
+# ---------------------------------------------------------------------------
+# 3. in-process compiled plans (real 8-device world)
+# ---------------------------------------------------------------------------
+
+
+class TestDevicePlans:
+    def test_steady_state_blocking_fires_frozen_program(self, world):
+        x = np.arange(world.size * 32,
+                      dtype=np.float32).reshape(world.size, 32)
+        comm = world.dup(name="plan_blk")
+        try:
+            first = np.asarray(comm.allreduce(x))  # capture
+            h0 = _pv("coll_compiled_cache_hits")
+            c0 = _pv("coll_programs_compiled")
+            for _ in range(3):
+                np.testing.assert_array_equal(
+                    np.asarray(comm.allreduce(x)), first)
+            h1 = _pv("coll_compiled_cache_hits")
+            assert h1["sum"] - h0["sum"] == 3
+            assert h1["count"] - h0["count"] == 3
+            assert _pv("coll_programs_compiled") == c0
+        finally:
+            comm.free()
+
+    def test_persistent_ten_fires_one_compile(self, world):
+        """THE satellite: a compiled ``allreduce_init`` request fired
+        10x with mutated buffers compiles exactly once
+        (``coll_compiled_cache_hits`` == 9 over the 10 fires) and is
+        bitwise-identical to the interpreted leg on EVERY fire —
+        under the dedicated progress thread."""
+        base = np.arange(world.size * 64,
+                         dtype=np.float32).reshape(world.size, 64)
+        # interpreted references for all ten buffer states, computed
+        # with the plan layer OFF (the interpreted leg)
+        mca_var.set_value("coll_compiled", 0)
+        try:
+            refs = [np.asarray(world.allreduce(base + k))
+                    for k in range(10)]
+        finally:
+            mca_var.VARS.unset("coll_compiled")
+        mca_var.set_value("progress_thread", 1)
+        comm = world.dup(name="plan_pers")
+        try:
+            buf = base.copy()
+            req = comm.allreduce_init(buf)
+            h0 = _pv("coll_compiled_cache_hits")
+            c0 = _pv("coll_programs_compiled")
+            for k in range(10):
+                req.start()
+                req.wait()
+                np.testing.assert_array_equal(
+                    np.asarray(req.value), refs[k])  # BITWISE
+                buf += 1  # start() must read the CURRENT bytes
+            h1 = _pv("coll_compiled_cache_hits")
+            assert h1["count"] - h0["count"] == 10
+            assert h1["sum"] - h0["sum"] == 9, \
+                "exactly the first start may capture"
+            assert _pv("coll_programs_compiled") - c0 == 1, \
+                "exactly one compile across ten fires"
+        finally:
+            mca_var.VARS.unset("progress_thread")
+            comm.free()
+
+    def test_cvar_write_invalidates_device_plan(self, world):
+        x = np.ones((world.size, 16), np.float32)
+        comm = world.dup(name="plan_inval")
+        try:
+            comm.allreduce(x)  # capture
+            h0 = _pv("coll_compiled_cache_hits")
+            comm.allreduce(x)  # hit
+            mca_var.set_value("coll_pipeline_segsize", 4096)
+            try:
+                comm.allreduce(x)  # generation moved: re-capture
+            finally:
+                mca_var.VARS.unset("coll_pipeline_segsize")
+            h1 = _pv("coll_compiled_cache_hits")
+            assert h1["count"] - h0["count"] == 2
+            assert h1["sum"] - h0["sum"] == 1
+        finally:
+            comm.free()
+
+    def test_obs_on_falls_back_to_interpreted(self, world):
+        import ompi_release_tpu.obs as obs_pkg
+
+        x = np.ones((world.size, 8), np.float32)
+        comm = world.dup(name="plan_obs")
+        try:
+            want = np.asarray(comm.allreduce(x))  # capture (obs off)
+            was = obs_pkg.enabled
+            obs_pkg.enable()
+            try:
+                h0 = _pv("coll_compiled_cache_hits")
+                got = np.asarray(comm.allreduce(x))
+                h1 = _pv("coll_compiled_cache_hits")
+            finally:
+                if not was:
+                    obs_pkg.disable()
+            np.testing.assert_array_equal(got, want)
+            assert h1["count"] == h0["count"], \
+                "observed runs must ride the interpreted path"
+        finally:
+            comm.free()
+
+    def test_same_named_ops_get_distinct_programs(self, world):
+        """Program caches (driver AND plan layer) key reductions by
+        the op OBJECT: two user ops sharing a name but carrying
+        different combiners must never share a compiled program —
+        name keying silently fired the first op's baked-in combiner
+        for the second (wrong numbers, no error)."""
+        import jax.numpy as jnp
+
+        from ompi_release_tpu.ops import Op
+
+        op_add = Op("custom", lambda a, b: a + b, commutative=True)
+        op_max = Op("custom", lambda a, b: jnp.maximum(a, b),
+                    commutative=True)
+        x = np.random.default_rng(7).standard_normal(
+            (world.size, 32)).astype(np.float32)
+        comm = world.dup(name="plan_opkey")
+        try:
+            ra = np.asarray(comm.allreduce(x, op=op_add))
+            rb = np.asarray(comm.allreduce(x, op=op_max))
+            np.testing.assert_allclose(ra[0], x.sum(axis=0), rtol=1e-5)
+            np.testing.assert_array_equal(rb[0], x.max(axis=0))
+            # steady state: each op replays ITS own frozen program
+            np.testing.assert_array_equal(
+                np.asarray(comm.allreduce(x, op=op_add)), ra)
+            np.testing.assert_array_equal(
+                np.asarray(comm.allreduce(x, op=op_max)), rb)
+        finally:
+            comm.free()
+
+    def test_plan_cache_cleared_on_comm_free(self, world):
+        x = np.ones((world.size, 8), np.float32)
+        comm = world.dup(name="plan_free")
+        cid = comm.cid
+        comm.allreduce(x)
+        assert any(k[0] == cid for k in plan._device_plans)
+        comm.free()
+        assert not any(k[0] == cid for k in plan._device_plans)
+
+    def test_compiled_whole_tree_pass(self, world):
+        """One jitted program for a whole planned tree pass, parity
+        vs the per-leaf blocking collectives, cached per signature."""
+        from ompi_release_tpu.parallel import tree
+
+        n = world.size
+        t = {"w": np.arange(n * 48,
+                            dtype=np.float32).reshape(n, 48) * 0.5,
+             "b": np.ones((n, 5), np.float32),
+             "i": np.arange(n * 6, dtype=np.int32).reshape(n, 6)}
+        out = tree.run_tree_pass(world, t, kind="allreduce",
+                                 bucket_bytes=1 << 20)
+        for k in t:
+            np.testing.assert_array_equal(
+                np.asarray(out[k]), np.asarray(world.allreduce(t[k])))
+        c0 = _pv("coll_programs_compiled")
+        tree.run_tree_pass(world, t, kind="allreduce",
+                           bucket_bytes=1 << 20)
+        assert _pv("coll_programs_compiled") == c0  # cached program
+        with pytest.raises(ValueError):
+            tree.run_tree_pass(world, t, kind="alltoall")
+
+
+# ---------------------------------------------------------------------------
+# 4. fleet-scale determinism (P=256, simulator)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetDeterminism:
+    def test_recorded_schedule_replays_bit_identically_p256(self):
+        """Satellite: the frozen plan's round schedule is a pure
+        function of (procs, me, sizes) — two recordings of the P=256
+        recursive-doubling allreduce are IDENTICAL per rank, and a
+        replay that verifies every round against the recorded plan
+        (the PlannedXchg check) reproduces the interpreted result
+        bit-for-bit."""
+        from ompi_release_tpu.coll import hier_schedules as hs
+
+        P = 256
+        procs = list(range(P))
+        data = {p: np.arange(8, dtype=np.int64) * (p + 1)
+                for p in procs}
+
+        def run_recorded(rounds_by_rank):
+            def fn(x, p):
+                rec = plan.RoundRecorder(x)
+                flats = hs.allgather_bruck(rec, procs, p, data[p],
+                                           [8] * P)
+                rounds_by_rank[p] = tuple(rec.rounds)
+                return np.sum(np.stack(flats), axis=0)
+            return lockstep.simulate(procs, fn, timeout=120)
+
+        r1, r2 = {}, {}
+        out1 = run_recorded(r1)
+        out2 = run_recorded(r2)
+        assert r1 == r2, "round schedule must be deterministic"
+        want = sum(np.arange(8, dtype=np.int64) * (p + 1)
+                   for p in procs)
+        for p in (0, 41, 137, P - 1):
+            np.testing.assert_array_equal(out1[p], want)
+            np.testing.assert_array_equal(out2[p], want)
+            assert len(r1[p]) == 8  # ceil(log2 256) rounds
+
+        class Verify:
+            def __init__(self, inner, rounds):
+                self.inner, self.rounds, self.i = inner, rounds, 0
+
+            def exchange(self, sends, recvs):
+                meta = plan._round_meta(
+                    {q: [np.asarray(a) for a in arrs]
+                     for q, arrs in sends.items() if arrs})
+                rec = tuple(sorted((int(q), int(c))
+                            for q, c in recvs.items() if int(c) > 0))
+                assert (meta, rec) == self.rounds[self.i], \
+                    f"round {self.i} diverged from the frozen plan"
+                self.i += 1
+                return self.inner.exchange(sends, recvs)
+
+        def replay(x, p):
+            v = Verify(x, r1[p])
+            flats = hs.allgather_bruck(v, procs, p, data[p], [8] * P)
+            assert v.i == len(r1[p])
+            return np.sum(np.stack(flats), axis=0)
+
+        out3 = lockstep.simulate(procs, replay, timeout=120)
+        for p in procs:
+            np.testing.assert_array_equal(out3[p], out1[p])  # BITWISE
+
+    def test_fleet_sim_records_identically(self):
+        """Same determinism through the PR 12 fleet simulator's
+        virtual wire (fabric latencies must not perturb structure)."""
+        from ompi_release_tpu.coll import hier_schedules as hs
+        from ompi_release_tpu.testing import fleet_sim as fs
+
+        P = 64
+        stories = []
+        for _ in range(2):
+            fleet = fs.FleetSim(P, hosts_per=8)
+            procs = fleet.procs
+            data = {p: np.full(4, p + 1, np.int64) for p in procs}
+            rounds_by_rank = {}
+
+            def fn(x, p):
+                rec = plan.RoundRecorder(x)
+                flats = hs.allgather_bruck(rec, procs, p, data[p],
+                                           [4] * P)
+                rounds_by_rank[p] = tuple(rec.rounds)
+                return np.sum(np.stack(flats), axis=0)
+
+            rep = fleet.run(fn, timeout_s=120)
+            assert len(rep.ok()) == P
+            stories.append(dict(rounds_by_rank))
+        assert stories[0] == stories[1]
+
+
+# ---------------------------------------------------------------------------
+# 5. the real 3-process job
+# ---------------------------------------------------------------------------
+
+
+APP_PRELUDE = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, %r)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_release_tpu as mpi
+    from ompi_release_tpu.mca import pvar, var as mca_var
+    from ompi_release_tpu.runtime.runtime import Runtime
+
+    def _pv(name):
+        p = pvar.PVARS.lookup(name)
+        return p.read() if p is not None else None
+""" % REPO)
+
+
+class TestCompiledPlanJob:
+    def test_spanning_persistent_replays_frozen_wire_plan(
+            self, tmp_path, capfd):
+        """3-process world: the first blocking allreduce records and
+        freezes the wire plan (round structure + frame headers);
+        later blocking fires AND persistent start()s replay it —
+        bitwise-equal results, ``coll_compiled_cache_hits`` counting
+        every replay, ``coll_wire_rounds_frozen`` counting the frozen
+        rounds — and a mid-job cvar write re-plans at the next fire
+        instead of corrupting the running schedule."""
+        app = tmp_path / "app.py"
+        app.write_text(APP_PRELUDE + textwrap.dedent("""
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            x = np.stack([np.arange(512, dtype=np.float32)
+                          * (off + i + 1) for i in range(2)])
+            want = sum(np.arange(512, dtype=np.float32) * (r + 1)
+                       for r in range(n))
+            first = np.asarray(world.allreduce(x))  # record + freeze
+            np.testing.assert_array_equal(first[0], want)
+            assert _pv("coll_wire_rounds_frozen") >= 1
+            st0 = _pv("coll_compiled_cache_hits")
+            for _ in range(3):
+                got = np.asarray(world.allreduce(x))  # replay
+                np.testing.assert_array_equal(got, first)  # BITWISE
+            st1 = _pv("coll_compiled_cache_hits")
+            assert st1["sum"] - st0["sum"] == 3, (st0, st1)
+
+            pr = world.allreduce_init(x)
+            st0 = _pv("coll_compiled_cache_hits")
+            for k in range(3):
+                pr.start(); pr.wait()
+                np.testing.assert_array_equal(
+                    np.asarray(pr.value), first)
+                # same (cid, signature) as the blocking fires: every
+                # start replays the already-frozen plan
+            st1 = _pv("coll_compiled_cache_hits")
+            assert st1["sum"] - st0["sum"] == 3, (st0, st1)
+
+            # a cvar write re-plans at the NEXT fire (one capturing
+            # run, then replays resume) — never mid-schedule
+            mca_var.set_value("wire_pipeline_depth", 2)
+            st0 = _pv("coll_compiled_cache_hits")
+            got = np.asarray(world.allreduce(x))
+            np.testing.assert_array_equal(got, first)
+            st1 = _pv("coll_compiled_cache_hits")
+            assert st1["sum"] - st0["sum"] == 0, (st0, st1)
+            assert st1["count"] - st0["count"] == 1, (st0, st1)
+            got = np.asarray(world.allreduce(x))
+            np.testing.assert_array_equal(got, first)
+            st2 = _pv("coll_compiled_cache_hits")
+            assert st2["sum"] - st1["sum"] == 1, (st1, st2)
+            print("PLAN-JOB-OK", flush=True)
+            mpi.finalize()
+        """))
+        job = Job(3, [sys.executable, str(app)], [],
+                  heartbeat_s=0.5, miss_limit=8)
+        rc = job.run(timeout_s=240)
+        out = capfd.readouterr()
+        assert rc == 0, out.out + out.err
+        assert job.job_state.visited(JobState.TERMINATED)
+        assert out.out.count("PLAN-JOB-OK") == 3
+
+
+# ---------------------------------------------------------------------------
+# cache stats / selftest surface
+# ---------------------------------------------------------------------------
+
+
+def test_cache_stats_shape():
+    st = plan.cache_stats()
+    assert set(st) == {"device_plans", "spanning_plans", "fires",
+                       "hits"}
+    assert st["fires"] >= st["hits"] >= 0

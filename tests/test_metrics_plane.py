@@ -628,6 +628,58 @@ class TestBenchGate:
               "tier_label": "loopback-cpu"}])
         assert other["checked"] == 0 and not other["regressions"]
 
+    def test_steady_state_metric_directions(self, tmp_path):
+        """The steady_state suite's lines: steady_* (per-op wall /
+        Python-orchestration seconds) are registered lower-better,
+        compiled_* (interpreted-vs-compiled orchestration speedups)
+        higher-better — a slower orchestration OR a shrunk speedup is
+        a regression, never an improvement."""
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        assert gate._direction(
+            "s", "steady_orch_allreduce_256KiB_compiled") == -1
+        assert gate._direction(
+            None, "steady_orch_allreduce_256KiB_interpreted") == -1
+        assert gate._direction(
+            "x_orchestration",
+            "compiled_allreduce_256KiB_orch_speedup") == 1
+        assert gate._direction(
+            None, "compiled_spanning_allreduce_orch_speedup") == 1
+
+        def ln(metric, v, unit):
+            return {"metric": metric, "value": v, "unit": unit,
+                    "vs_baseline": None, "tier_label": "loopback-cpu"}
+
+        hist = [_round_file(
+            tmp_path / f"BENCH_r{k:02d}.json",
+            [ln("steady_orch_allreduce_256KiB_compiled",
+                6.6e-5 + k * 1e-6, "s"),
+             ln("compiled_allreduce_256KiB_orch_speedup",
+                2.4 + 0.02 * k, "x_orchestration")])
+            for k in range(4)]
+        # orchestration doubling or the speedup collapsing trips it
+        bad = _round_file(
+            tmp_path / "cand.json",
+            [ln("steady_orch_allreduce_256KiB_compiled", 2.0e-4, "s"),
+             ln("compiled_allreduce_256KiB_orch_speedup", 1.0,
+                "x_orchestration")])
+        from ompi_release_tpu.tools import tpu_bench_gate as gate2
+
+        verdict = gate2.evaluate(
+            [gate2.parse_round_file(p) for p in hist],
+            gate2.parse_round_file(bad))
+        regressed = {r["metric"] for r in verdict["regressions"]}
+        assert regressed == {
+            "steady_orch_allreduce_256KiB_compiled",
+            "compiled_allreduce_256KiB_orch_speedup"}
+        # ...an in-band round passes
+        ok = _round_file(
+            tmp_path / "ok.json",
+            [ln("steady_orch_allreduce_256KiB_compiled", 6.7e-5, "s"),
+             ln("compiled_allreduce_256KiB_orch_speedup", 2.42,
+                "x_orchestration")])
+        assert gate2.main(hist + ["--candidate", str(ok)]) == 0
+
     def test_sim_tier_band_is_tight_not_wall_clock_wobble(self,
                                                           tmp_path):
         """Sim lines are deterministic replays: the ±25% wall-clock

@@ -42,7 +42,8 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/ft/ulfm.py",
            "ompi_release_tpu/parallel/elastic.py",
            "ompi_release_tpu/obs/sentinel.py",
-           "ompi_release_tpu/parallel/tree.py")
+           "ompi_release_tpu/parallel/tree.py",
+           "ompi_release_tpu/coll/plan.py")
 
 #: attribute calls that ARE emit sites when ungated
 EMIT_ATTRS = {"record", "begin", "body", "end", "arm"}
