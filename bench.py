@@ -2102,8 +2102,9 @@ def _fleet_micro_suite(sizes=(256, 1024)):
     of (schedule, fabric model), so the gate's per-(metric, tier) fit
     must never mix them with loopback-cpu/tpu wall-clock history —
     and within the sim tier a tripped bound IS a schedule regression
-    (more rounds / more bytes), not noise. All metrics are
-    lower-better (tpu_bench_gate registers the sim_ prefix).
+    (more rounds / more bytes), not noise. sim_* metrics are
+    lower-better, topo_* (torus/multiring speedups over the flat
+    ring) higher-better (tpu_bench_gate registers both prefixes).
     Device-free: no backend involved, jax never imported."""
     import math
 
@@ -2159,6 +2160,69 @@ def _fleet_micro_suite(sizes=(256, 1024)):
              expect=2 * logp)
         line("sim_allreduce_makespan", round(rep.makespan * 1e3, 6),
              "sim_ms")
+
+        # 2D-torus allreduce on the hosts_per=8 grid: DCN carries only
+        # the 1/d0-sized partials — measured inter-host bytes equal
+        # the closed form exactly, and the flat-ring baseline (also
+        # closed form: H boundary NICs each shipping every chunk) is
+        # strictly above it; topo_* = higher-better speedup ratios
+        from ompi_release_tpu.coll import topo_schedules as ts
+
+        d0, d1 = 8, P // 8
+        n_t = 8 * P  # divisible by P, d0, d1: exact closed forms
+        tdata = {p: np.arange(n_t, dtype=np.float32) * ((p % 5) + 1)
+                 for p in procs}
+        tfleet = fs.FleetSim(P, hosts_per=8, seed=1)
+        host_of = tfleet.fabric.host_of
+        rep_t = tfleet.run(
+            lambda x, p: ts.allreduce_torus2d(
+                x, procs, p, tdata[p], np.add, 0.0, host_of),
+            label="allreduce_torus")
+        torus_total = sum(rep_t.inter_bytes_sent.values())
+        flat_total = ts.flat_ring_inter_bytes_total(n_t, 4, P, d1)
+        line("sim_torus_inter_bytes_per_rank",
+             max(rep_t.inter_bytes_sent.values()), "bytes",
+             expect=ts.torus_inter_bytes_per_rank(n_t, 4, d0, d1),
+             payload_bytes=n_t * 4)
+        line("sim_torus_rounds", rep_t.max_rounds(), "rounds",
+             expect=ts.torus_rounds(d0, d1))
+        line("sim_torus_makespan", round(rep_t.makespan * 1e3, 6),
+             "sim_ms")
+        line("topo_torus_inter_bytes_x",
+             round(flat_total / torus_total, 6), "x_inter_bytes")
+        if P <= 256:
+            # the flat-ring ACTUAL run (2(P-1) rounds — affordable at
+            # this P) anchors the virtual-makespan speedup
+            rfleet = fs.FleetSim(P, hosts_per=8, seed=1)
+            rep_r = rfleet.run(
+                lambda x, p: hs.allreduce_ring(
+                    x, procs, p, tdata[p], np.add, 0.0),
+                label="allreduce_ring")
+            line("topo_torus_makespan_x",
+                 round(rep_r.makespan / rep_t.makespan, 6),
+                 "x_makespan")
+            # multiring: k disjoint stride rings driven in parallel —
+            # the k× ring-bandwidth claim, on a bandwidth-bound
+            # UNIFORM wire (striping is topology-oblivious; the torus
+            # is the hierarchy answer)
+            def bw_fleet():
+                return fs.FleetSim(P, fabric=fs.Fabric(
+                    P, hosts_per=P, intra=fs.LinkSpec(1e-7, 0.1),
+                    seed=1))
+
+            f_r = bw_fleet()
+            rep_br = f_r.run(
+                lambda x, p: hs.allreduce_ring(
+                    x, procs, p, tdata[p], np.add, 0.0),
+                label="allreduce_ring_bw")
+            f_m = bw_fleet()
+            rep_bm = f_m.run(
+                lambda x, p: ts.allreduce_multiring(
+                    x, procs, p, tdata[p], np.add, 0.0, 4),
+                label="allreduce_multiring_bw")
+            line("topo_multiring_makespan_x",
+                 round(rep_br.makespan / rep_bm.makespan, 6),
+                 "x_makespan")
     return lines
 
 

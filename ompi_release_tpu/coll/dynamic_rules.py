@@ -71,6 +71,20 @@ table, then to the fixed decisions).
 Unknown collectives or algorithms fail at LOAD time with the file and
 line number: a typo'd rule silently reverting to defaults would defeat
 the operator's tuning run.
+
+Rule files may carry an optional topology-fingerprint header stanza::
+
+    # fingerprint: hosts=8;ppn=8;links=shm+dcn;P=64
+    # version: 2
+
+— parsed (malformed stanzas fail at load time), exposed through
+:func:`load_rules_doc` / :func:`rules_source`, and used by the tuning
+database (:mod:`..tuning.db`) to key versioned entries. When
+``coll_tuning_db_dir`` is set and NO explicit rules filename is, the
+best-matching database entry for the job's topology fingerprint is
+selected automatically at comm construction; precedence is unchanged
+(forcing > rules — explicit file > DB entry — > fixed constants).
+Files without the stanza keep the exact legacy semantics.
 """
 
 from __future__ import annotations
@@ -97,29 +111,50 @@ RULE_COLLECTIVES: Dict[str, Tuple[str, ...]] = {
     "tree_buckets": ("auto", "fused", "per_leaf"),
 }
 
-# (path, mtime_ns, size) -> parsed rules; a rewritten file is
-# re-parsed, an unchanged one costs a stat per lookup.  mtime_ns +
-# size (not float mtime): some filesystems round mtime to 1 s, so a
-# rewrite landing within the same second as the first parse would
-# otherwise keep serving stale rules.  Collectives may run from
+# (path, mtime_ns, size) -> (parsed rules, header meta); a rewritten
+# file is re-parsed, an unchanged one costs a stat per lookup.
+# mtime_ns + size (not float mtime): some filesystems round mtime to
+# 1 s, so a rewrite landing within the same second as the first parse
+# would otherwise keep serving stale rules.  Collectives may run from
 # multiple threads; _cache_lock guards every _cache access.
-_cache: Dict[Tuple[str, int, int],
-             Dict[str, List[Tuple[int, int, str, Optional[int]]]]] = {}
+_cache: Dict[Tuple[str, int, int], Tuple[Dict, Dict]] = {}
 _cache_lock = threading.Lock()
 
 
-def load_rules(path: str) -> Dict[str, List[Tuple[int, int, str,
-                                                  Optional[int]]]]:
-    """Parse a rule file into {collective: [(min_n, min_bytes, alg,
-    segsize)]} preserving file order; ``segsize`` is None when the
-    fifth column is absent or ``auto`` (defer to the cvar)."""
+def load_rules_doc(path: str) -> Tuple[
+        Dict[str, List[Tuple[int, int, str, Optional[int]]]], Dict]:
+    """Parse a rule file into ``(rules, meta)``: rules is
+    {collective: [(min_n, min_bytes, alg, segsize)]} preserving file
+    order (``segsize`` None when the fifth column is absent or
+    ``auto``); meta carries the optional topology-fingerprint header
+    stanza — ``{"fingerprint": canonical str | None, "version":
+    int | None}``. The stanza is PARSED, not skipped as a comment: a
+    malformed ``# fingerprint:`` line fails at load time (a tuning-db
+    entry with an unreadable key would be silently unselectable).
+    Files without the stanza keep the exact legacy semantics."""
     try:
         lines = open(path).read().splitlines()
     except OSError as e:
         raise MPIError(ErrorCode.ERR_FILE,
                        f"cannot read dynamic rules file {path}: {e}")
+    from ..tuning import db as _tuning_db
+
     rules: Dict[str, List[Tuple[int, int, str, Optional[int]]]] = {}
+    meta: Dict = {"fingerprint": None, "version": None}
     for lineno, line in enumerate(lines, 1):
+        m = _tuning_db.FP_LINE_RE.match(line)
+        if m:
+            try:
+                fp = _tuning_db.Fingerprint.parse(m.group(1))
+            except ValueError as e:
+                raise MPIError(ErrorCode.ERR_ARG,
+                               f"{path}:{lineno}: {e}")
+            meta["fingerprint"] = fp.canon()
+            continue
+        m = _tuning_db.VERSION_LINE_RE.match(line)
+        if m:
+            meta["version"] = int(m.group(1))
+            continue
         line = line.split("#", 1)[0].strip()
         if not line:
             continue
@@ -164,19 +199,44 @@ def load_rules(path: str) -> Dict[str, List[Tuple[int, int, str,
                     f"K/M/G ok) or 'auto', got '{parts[4]}'",
                 )
         rules.setdefault(coll, []).append((min_n, min_bytes, alg, segsize))
-    return rules
+    return rules, meta
 
 
-def _active_rules() -> Optional[Dict[str, List[Tuple[int, int, str,
-                                                     Optional[int]]]]]:
-    """The currently configured rule table, or None when dynamic rules
-    are off / no file is configured. Handles the stat-based cache and
-    the vanished-mid-run fallback (see the comments inline)."""
+def load_rules(path: str) -> Dict[str, List[Tuple[int, int, str,
+                                                  Optional[int]]]]:
+    """Back-compat view of :func:`load_rules_doc`: the rule table
+    alone."""
+    return load_rules_doc(path)[0]
+
+
+def _db_selected_path() -> Optional[str]:
+    """The tuning database's entry for the active topology
+    fingerprint, or None (no ``coll_tuning_db_dir`` configured / no
+    matching entry — fall through to the fixed constants, exactly as
+    if no file were named)."""
+    if not mca_var.get("coll_tuning_db_dir", ""):
+        return None
+    from ..tuning import db as _tuning_db
+
+    return _tuning_db.select_rules_path()
+
+
+def _active_doc() -> Tuple[Optional[Dict], Optional[Dict],
+                           Optional[str], str]:
+    """(rules, meta, path, mode) of the currently configured rule
+    table; (None, None, None, "off") when dynamic rules are off or
+    nothing is configured. The explicit filename outranks the
+    database (an operator pinning ONE file means that file); the
+    stat-based cache and the vanished-mid-run fallback are as before."""
     if not mca_var.get("coll_tuned_use_dynamic_rules", False):
-        return None
+        return None, None, None, "off"
     path = mca_var.get("coll_tuned_dynamic_rules_filename", "")
+    mode = "file"
     if not path:
-        return None
+        path = _db_selected_path()
+        mode = "db"
+        if not path:
+            return None, None, None, "off"
     try:
         st = os.stat(path)
         key = (path, st.st_mtime_ns, st.st_size)
@@ -186,10 +246,10 @@ def _active_rules() -> Optional[Dict[str, List[Tuple[int, int, str,
         # turning a config deletion into a crash inside the
         # collective hot path; only a file that never parsed is fatal
         with _cache_lock:
-            rules_for_path = next(
-                (r for (p, _, _), r in _cache.items() if p == path), None
+            doc = next(
+                (d for (p, _, _), d in _cache.items() if p == path), None
             )
-        if rules_for_path is None:
+        if doc is None:
             raise MPIError(ErrorCode.ERR_FILE,
                            f"dynamic rules file {path} unreadable: {e}")
         _log.verbose(1, f"dynamic rules file {path} vanished; "
@@ -197,18 +257,33 @@ def _active_rules() -> Optional[Dict[str, List[Tuple[int, int, str,
         key = None
     if key is not None:
         with _cache_lock:
-            rules_for_path = _cache.get(key)
-        if rules_for_path is None:
+            doc = _cache.get(key)
+        if doc is None:
             # parse BEFORE dropping the old copy (and outside the
             # lock: load_rules may raise on a mid-run rewrite with a
             # syntax error, and the last-good rules must stay cached
             # so deleting the broken file falls back to them)
-            parsed = load_rules(path)
+            parsed = load_rules_doc(path)
             with _cache_lock:
                 _cache.clear()  # at most one live file; drop stale keys
                 _cache[key] = parsed
-            rules_for_path = parsed
-    return rules_for_path
+            doc = parsed
+    return doc[0], doc[1], path, mode
+
+
+def _active_rules() -> Optional[Dict[str, List[Tuple[int, int, str,
+                                                     Optional[int]]]]]:
+    return _active_doc()[0]
+
+
+def rules_source() -> Dict[str, Optional[str]]:
+    """Where the live rule table comes from — what ``obs --selftest``
+    and tpu-doctor print: ``{"mode": "off" | "file" | "db", "path",
+    "fingerprint"}`` (fingerprint = the loaded file's stamped header,
+    None for legacy files)."""
+    rules, meta, path, mode = _active_doc()
+    return {"mode": mode, "path": path,
+            "fingerprint": (meta or {}).get("fingerprint")}
 
 
 def lookup(coll: str, comm_size: int, msg_bytes: int) -> Optional[str]:

@@ -69,6 +69,7 @@ from ..ops.op import Op
 from ..utils import output
 from ..utils.errors import ErrorCode, MPIError
 from . import hier_schedules as _hs
+from . import topo_schedules as _topo
 
 _log = output.stream("coll")
 
@@ -213,6 +214,18 @@ class _HierModule:
         }
         self.leaders: List[int] = sorted(
             min(g) for g in self.host_groups.values())
+        # uniform (d0, d1) host grid, if one exists: what the fixed
+        # decision's torus pick and the topo schedules key off
+        self.torus_dims = _topo.grid_dims(self.procs, self.host_of)
+        # publish the topology fingerprint the tuning database selects
+        # rule files by — (hosts, procs-per-host, link classes, P).
+        # force=False: the WIDEST comm (the world) owns the global
+        # selection; a narrower subcomm must not displace it
+        from ..tuning import db as _tuning_db
+
+        _tuning_db.set_active(
+            _tuning_db.fingerprint_for(self.host_of, len(self.procs)),
+            force=False)
         self._xchg = _XchgAdapter(self)
         # handle for coll/plan's frozen-schedule record/replay: the
         # plan layer swaps _xchg for the duration of ONE schedule run
@@ -534,6 +547,21 @@ class _HierModule:
             return False
         return bool(mca_var.get("hier_leader_tier", True))
 
+    def _pick_allreduce(self, procs: List[int], nbytes: int,
+                        op: Op) -> str:
+        """The inter allreduce pick for ``procs`` — one call site so
+        the leader-tier stand-aside and the combine itself can never
+        disagree. The topo hint describes THIS process set (the
+        leader set is one-per-host, so its grid is never uniform)."""
+        dims = self.torus_dims if procs is self.procs \
+            else _topo.grid_dims(procs, self.host_of)
+        return _hs.pick(
+            "allreduce", len(procs), nbytes,
+            commutative=op.commutative,
+            has_identity=op.identity is not None,
+            pair_op=op.is_pair_op, topo=dims,
+        )
+
     def _combine_partials(self, partial, op: Op):
         """Inter-process combine of per-process partials; identical on
         every process (fixed, process-index-derived order per
@@ -543,33 +571,53 @@ class _HierModule:
                 return (jnp.asarray(partial[0]), jnp.asarray(partial[1]))
             return jnp.asarray(partial)
         if self._leader_tier_active(op):
-            return self._combine_leader(partial, op)
+            # a topology-aware pick over the FULL process set is
+            # host-aware itself: the leader tier stands aside instead
+            # of regrouping the torus/multiring schedule away. The
+            # pack+pick feed straight into _combine_flat when it runs
+            # — never computed twice on this hot path.
+            packed = self._pack_partial(partial, op)
+            alg = self._pick_allreduce(self.procs, int(packed.nbytes),
+                                       op)
+            if alg not in _topo.TOPO_ALGS:
+                return self._combine_leader(partial, op)
+            return self._combine_flat(self.procs, partial, op,
+                                      packed=packed, alg=alg)
         return self._combine_flat(self.procs, partial, op)
 
-    def _combine_flat(self, procs: List[int], partial, op: Op):
+    def _combine_flat(self, procs: List[int], partial, op: Op,
+                      packed=None, alg: Optional[str] = None):
         """Run the selected allreduce schedule over ``procs`` (the
-        whole process set, or the leader set under the leader tier)."""
+        whole process set, or the leader set under the leader tier).
+        ``packed``/``alg`` let a caller that already packed and picked
+        (the leader-tier stand-aside) hand both through."""
         P = len(procs)
         if P == 1:
             if op.is_pair_op:
                 return (jnp.asarray(partial[0]), jnp.asarray(partial[1]))
             return jnp.asarray(partial)
-        packed = self._pack_partial(partial, op)
-        alg = _hs.pick(
-            "allreduce", P, int(packed.nbytes),
-            commutative=op.commutative,
-            has_identity=op.identity is not None,
-            pair_op=op.is_pair_op,
-        )
+        if packed is None:
+            packed = self._pack_partial(partial, op)
+        if alg is None:
+            alg = self._pick_allreduce(procs, int(packed.nbytes), op)
         self._note_alg(alg)
         me = self.my_pidx
         if alg in _hs.ORDER_WAIVING:
             arr = np.asarray(partial)
-            fn = (_hs.allreduce_ring if alg == "ring"
-                  else _hs.allreduce_rabenseifner)
-            out = fn(self._xchg, procs, me, arr,
-                     lambda a, b: np.asarray(op(a, b)),
-                     op.identity_for(arr.dtype))
+            npop = lambda a, b: np.asarray(op(a, b))  # noqa: E731
+            ident = op.identity_for(arr.dtype)
+            if alg == "multiring":
+                out = _topo.allreduce_multiring(
+                    self._xchg, procs, me, arr, npop, ident,
+                    int(mca_var.get("hier_multiring_k", 4)))
+            elif alg == "torus2d":
+                out = _topo.allreduce_torus2d(
+                    self._xchg, procs, me, arr, npop, ident,
+                    self.host_of)
+            else:
+                fn = (_hs.allreduce_ring if alg == "ring"
+                      else _hs.allreduce_rabenseifner)
+                out = fn(self._xchg, procs, me, arr, npop, ident)
             return jnp.asarray(np.asarray(out).reshape(arr.shape))
         if alg == "recursive_doubling":
             flats = _hs.allgather_bruck(
@@ -762,9 +810,15 @@ class _HierModule:
         # derivable symmetrically off-root too
         xa = np.asarray(x)
         slice_bytes = int(xa.nbytes // xa.shape[0]) if xa.ndim else 0
-        alg = _hs.pick("bcast", len(self.procs), slice_bytes)
+        alg = _hs.pick("bcast", len(self.procs), slice_bytes,
+                       topo=self.torus_dims)
         self._note_alg(alg)
-        if alg == "binomial" and len(self.procs) > 1:
+        if alg == "torus2d" and len(self.procs) > 1:
+            # host-aware by construction: the torus bcast subsumes the
+            # leader tier's fan-out (one DCN copy per host)
+            val = _topo.bcast_torus2d(self._xchg, self.procs, me,
+                                      owner, val, self.host_of)
+        elif alg == "binomial" and len(self.procs) > 1:
             if self._leader_tier_active():
                 val = self._bcast_leader(owner, val)
             else:
@@ -807,13 +861,19 @@ class _HierModule:
         chunk_elems = int(np.prod(chunk_shape, dtype=np.int64)) \
             if chunk_shape else 1
         total_bytes = int(self.comm.size * chunk_elems * block.itemsize)
-        alg = _hs.pick("allgather", P, total_bytes) if P > 1 else "linear"
+        alg = _hs.pick("allgather", P, total_bytes,
+                       topo=self.torus_dims) if P > 1 else "linear"
         self._note_alg(alg)
         blocks: Dict[int, np.ndarray] = {}
         if P == 1 or alg == "linear":
             got = self._exchange({p: [block] for p in self.peers})
             for p in self.procs:
                 blocks[p] = block if p == me else np.asarray(got[p][0])
+        elif alg == "torus2d":
+            parts = _topo.allgather_torus2d(self._xchg, self.procs,
+                                            me, block, self.host_of)
+            for i, p in enumerate(self.procs):
+                blocks[p] = np.asarray(parts[i])
         elif alg == "bruck":
             counts = [len(self.members_of[p]) * chunk_elems
                       for p in self.procs]

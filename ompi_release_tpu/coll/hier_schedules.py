@@ -26,8 +26,12 @@ allreduce   ``linear`` (all-pairs partial exchange, the historic
             ``ring`` (ring reduce-scatter + ring allgather, ~2n bytes
             per process), ``rabenseifner`` (recursive-halving
             reduce-scatter + recursive-doubling allgather; power-of-
-            two process counts, else it degrades to ring)
-bcast       ``linear``, ``binomial`` (ceil(log2 P)-depth tree)
+            two process counts, else it degrades to ring),
+            ``multiring`` / ``torus2d`` (topology-aware striped /
+            2D-torus variants, :mod:`coll.topo_schedules`)
+bcast       ``linear``, ``binomial`` (ceil(log2 P)-depth tree),
+            ``torus2d`` (host-representative tree, DCN ships d1-1
+            copies)
 reduce      ``linear`` (direct partial gather to the root's owner),
             ``binomial`` (tree gather of per-process partials; the
             fold happens ONCE at the root in process-index order, so
@@ -77,17 +81,19 @@ _sched_rounds = pvar.counter(
 #: (registered into dynamic_rules.RULE_COLLECTIVES by coll/components)
 ALGORITHMS: Dict[str, tuple] = {
     "allreduce": ("auto", "linear", "recursive_doubling", "ring",
-                  "rabenseifner"),
-    "bcast": ("auto", "linear", "binomial"),
+                  "rabenseifner", "multiring", "torus2d"),
+    "bcast": ("auto", "linear", "binomial", "torus2d"),
     "reduce": ("auto", "linear", "binomial"),
-    "allgather": ("auto", "linear", "bruck", "ring"),
+    "allgather": ("auto", "linear", "bruck", "ring", "torus2d"),
     "alltoall": ("auto", "linear", "bruck", "pairwise"),
     "gather": ("auto", "linear", "binomial"),
     "scatter": ("auto", "linear", "binomial"),
 }
 
-#: allreduce algorithms that reorder the fold and pad with the identity
-ORDER_WAIVING = ("ring", "rabenseifner")
+#: allreduce algorithms that reorder the fold and pad with the
+#: identity (the topology-aware variants stripe/decompose the buffer,
+#: so they inherit the exact same commutative-only guard semantics)
+ORDER_WAIVING = ("ring", "rabenseifner", "multiring", "torus2d")
 
 
 def _register_rule_namespaces() -> None:
@@ -132,6 +138,21 @@ def register_vars() -> None:
         "Active only when the job spans >1 host with >1 process on "
         "some host; commutative ops only.",
     )
+    mca_var.register(
+        "hier_topo_schedules", "bool", True,
+        "Let the fixed decision constants pick the topology-aware "
+        "schedules (2D-torus allreduce/allgather/bcast) when the job "
+        "spans a uniform multi-host grid — DCN then carries only the "
+        "1/dim0-sized partials. False restores the flat decisions; "
+        "forcing and dynamic rules can still name the variants.",
+    )
+    mca_var.register(
+        "hier_multiring_k", "int", 4,
+        "Ring count for the multiring striped allreduce (disjoint "
+        "stride-coprime neighbor permutations; the effective count is "
+        "capped by the units available mod P). Selected via forcing "
+        "or a hier_allreduce dynamic rule naming 'multiring'.",
+    )
 
 
 register_vars()  # idempotent; cvars must exist before the first pick
@@ -141,9 +162,17 @@ register_vars()  # idempotent; cvars must exist before the first pick
 # selection: forcing > dynamic rules > fixed decision constants
 # ---------------------------------------------------------------------------
 
+def _topo_ok(topo: Optional[tuple]) -> bool:
+    """A (d0, d1) uniform grid worth exploiting: both dims non-trivial
+    and the operator has not opted out."""
+    return (topo is not None and int(topo[0]) > 1 and int(topo[1]) > 1
+            and bool(mca_var.get("hier_topo_schedules", True)))
+
+
 def pick(coll: str, nprocs: int, nbytes: int, *,
          commutative: bool = True, has_identity: bool = True,
-         pair_op: bool = False) -> str:
+         pair_op: bool = False,
+         topo: Optional[tuple] = None) -> str:
     """The inter algorithm for this call. ``nprocs`` is the PROCESS
     count of the spanning comm (what a ``hier_<coll>`` rule's
     min_comm_size column matches against — the inter step never sees
@@ -154,7 +183,10 @@ def pick(coll: str, nprocs: int, nbytes: int, *,
     the chunked schedules have no (value, index) variant, so an
     order-waiving pick quietly becomes ``recursive_doubling`` even
     when forced — whereas forcing ring/rabenseifner for a
-    NON-COMMUTATIVE op is a semantics violation and raises."""
+    NON-COMMUTATIVE op is a semantics violation and raises. ``topo``
+    is the comm's uniform (d0, d1) host grid or None: the fixed
+    decision prefers the 2D-torus variants when one exists (DCN
+    carries 1/d0-sized partials), gated by ``hier_topo_schedules``."""
     from . import dynamic_rules
 
     menu = ALGORITHMS[coll]
@@ -196,14 +228,21 @@ def pick(coll: str, nprocs: int, nbytes: int, *,
         if nbytes < small or pair_op \
                 or not (commutative and has_identity):
             return "recursive_doubling"
+        if _topo_ok(topo):
+            return "torus2d"
         return "rabenseifner" if nprocs & (nprocs - 1) == 0 else "ring"
     if coll == "bcast":
-        return "binomial"
+        # the torus bcast's DCN cost is d1-1 copies at log-depth for
+        # any size, strictly below the flat binomial's host-oblivious
+        # edge set — no size threshold needed
+        return "torus2d" if _topo_ok(topo) else "binomial"
     if coll in ("reduce", "gather", "scatter"):
         return "binomial" if nbytes < small else "linear"
     if coll == "allgather":
         cutoff = int(mca_var.get("hier_bruck_cutoff", 262144))
-        return "bruck" if nbytes < cutoff else "linear"
+        if nbytes < cutoff:
+            return "bruck"
+        return "torus2d" if _topo_ok(topo) else "linear"
     if coll == "alltoall":
         return "bruck" if nbytes < small else "pairwise"
     return "linear"

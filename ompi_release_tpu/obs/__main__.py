@@ -259,6 +259,43 @@ def selftest() -> int:
           f"{cs['spanning_plans']} spanning plans; frame template "
           f"{tpl.nchunks}x{tpl.chunk}B precomposed)")
 
+    # 10. tuning plane: topology fingerprint round-trip, the versioned
+    # tuning-db register/select cycle, dynamic-rules auto-selection
+    # from the DB, and the active fingerprint + rules source printed
+    # for the operator — all device-free
+    from ..coll import components as _coll_components  # noqa: F401
+    from ..coll import dynamic_rules as _dyn
+    from ..coll.base import COLL_FRAMEWORK
+    from ..tuning import db as _tdb
+
+    COLL_FRAMEWORK.lookup("tuned").register_vars()  # the rules cvars
+    fp = _tdb.active()
+    assert _tdb.Fingerprint.parse(fp.canon()) == fp, fp
+    with tempfile.TemporaryDirectory() as td:
+        tdb = _tdb.TuningDb(td)
+        p1 = tdb.register("hier_allreduce  0  0  recursive_doubling\n",
+                          fp)
+        p2 = tdb.register("hier_allreduce  0  0  torus2d\n", fp)
+        assert p1 != p2 and tdb.best_match(fp) == p2, (p1, p2)
+        fp2, v2 = _tdb.read_header(p2)
+        assert fp2 == fp and v2 == 2, (fp2, v2)
+        _var.set_value("coll_tuned_use_dynamic_rules", True)
+        _var.set_value("coll_tuning_db_dir", td)
+        try:
+            assert _dyn.lookup("hier_allreduce", 8, 1 << 20) \
+                == "torus2d", "db auto-selection failed"
+            src = _dyn.rules_source()
+            assert src["mode"] == "db" and src["path"] == p2, src
+            assert src["fingerprint"] == fp.canon(), src
+        finally:
+            _var.VARS.unset("coll_tuned_use_dynamic_rules")
+            _var.VARS.unset("coll_tuning_db_dir")
+    src = _dyn.rules_source()
+    print(f"tuning: fingerprint {fp.canon()}; rules source "
+          f"{src['mode']}"
+          + (f" ({src['path']})" if src.get("path") else "")
+          + "; db register/select round-trip ok")
+
     disable()
     print("obs selftest: ok")
     return 0

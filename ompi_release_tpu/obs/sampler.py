@@ -37,7 +37,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..mca import pvar as _pvar
 from ..mca import var as _var
@@ -219,6 +219,12 @@ class SeriesRing:
 #: the tpu_server series RPC and finalize dump read one store)
 RING = SeriesRing()
 
+#: post-tick hooks, invoked (no arguments) after every delta snapshot
+#: — the online re-tuner (:mod:`..tuning.retune`) registers here when
+#: armed. Empty by default: one tuple() per tick when nothing consumes
+#: the plane, and a raising hook never kills the sampler.
+TICK_HOOKS: List[Callable[[], None]] = []
+
 
 # ---------------------------------------------------------------------------
 # the sampler
@@ -285,6 +291,11 @@ class Sampler:
         # (quiet-series skip, empty push) can never converge
         if _obs.enabled and n:
             _obs.record("sample", "obs", t0, dt, nbytes=n)
+        for hook in tuple(TICK_HOOKS):
+            try:
+                hook()
+            except Exception:
+                pass  # a broken consumer must not kill the plane
         return n
 
     # -- fleet push --------------------------------------------------------
@@ -386,6 +397,7 @@ def snapshot() -> List[Dict[str, Any]]:
 
 
 def _reset_for_tests() -> None:
+    del TICK_HOOKS[:]
     SAMPLER._stop.set()
     t = SAMPLER._thread
     if t is not None:

@@ -169,6 +169,15 @@ class Runtime:
                     _obs_sampler.maybe_start(self)
                 except Exception as e:
                     _log.verbose(1, f"obs sampler start skipped: {e}")
+                # arm the online re-tuner on the sampler's tick hook
+                # (no-op unless tune_online is set): sustained slow
+                # links -> bounded micro-probe -> cvar-applied rule
+                try:
+                    from ..tuning import retune as _retune
+
+                    _retune.maybe_start(self)
+                except Exception as e:
+                    _log.verbose(1, f"online retune arm skipped: {e}")
 
             # 3. mesh mapping
             self.mesh = mesh_mod.build_mesh(
@@ -329,6 +338,12 @@ class Runtime:
                 # journal + series dumps (obs_dump_dir) BEFORE the
                 # agent closes: the clock-offset estimate in their
                 # meta needs the live HNP link
+                try:
+                    from ..tuning import retune as _retune
+
+                    _retune.stop()
+                except Exception as e:
+                    _log.verbose(1, f"online retune stop failed: {e}")
                 try:
                     from ..obs import sampler as _obs_sampler
 

@@ -56,8 +56,13 @@ LOWER_BETTER = {"s", "seconds", "us", "us/hop", "hol_wait_s",
 #: hidden-comm fraction, nonblocking-pipeline speedup), and the
 #: steady_state suite's compiled_* lines (interpreted-vs-compiled
 #: orchestration speedups) are all higher-better — less comm or
-#: Python time exposed on the critical path
-METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_", "compiled_")
+#: Python time exposed on the critical path. The fleet_scaling
+#: suite's topo_* lines (topology-aware schedule speedups over the
+#: flat ring: inter-host byte ratio, virtual-makespan ratio) are
+#: higher-better too — a shrunk ratio means the torus/multiring
+#: advantage regressed.
+METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_", "compiled_",
+                                 "topo_")
 #: ...and the ft_recovery suite's lines (recovery wall time, steps
 #: recomputed after rollback) and the contract-sentinel suite's lines
 #: (per-collective overhead, enabled AND disabled legs) are all

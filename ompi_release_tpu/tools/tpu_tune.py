@@ -49,9 +49,28 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..mca import var as mca_var
+from ..tuning import db as tuning_db
 from ..utils import output
 
 _log = output.stream("tune")
+
+
+def measured_fingerprint(hier_procs: int = 0,
+                         hosts_per: int = 0) -> tuning_db.Fingerprint:
+    """The topology fingerprint a tpu-tune run actually measured: the
+    hier sweep's process/host layout when one ran (that is what the
+    hier_* rules are valid for), else the single-process in-process
+    mesh (:data:`..tuning.db.LOCAL`)."""
+    if hier_procs >= 2:
+        hp = int(hosts_per) if hosts_per and hosts_per > 0 \
+            else int(hier_procs)
+        hosts = -(-int(hier_procs) // hp)
+        return tuning_db.Fingerprint(
+            hosts=hosts, procs_per_host=hp if hier_procs % hp == 0
+            else 0,
+            link_classes=("shm", "dcn") if hosts > 1 else ("shm",),
+            P=int(hier_procs))
+    return tuning_db.LOCAL
 
 #: op -> (runner(comm, x), decision-unit bytes for per-rank bytes b
 #: and comm size n)
@@ -215,6 +234,17 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=1"
                            ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# --hier-hosts-per: group processes into fake hosts of that size so
+# the sweep times the topology-aware schedules (multiring/torus2d)
+# over a real shm/DCN split instead of one flat host. NODE_ID is
+# 1-BASED (tpurun): subtract 1 or the groups come out ragged and
+# torus_grid() would silently degrade every torus leg to the flat
+# ring while the sweep labels the timings torus2d.
+_hp = int(os.environ.get("OMPITPU_HIER_TUNE_HOSTS_PER", "0"))
+if _hp > 0:
+    os.environ["OMPITPU_HOST_ID"] = (
+        "tunehost-%%d"
+        %% ((int(os.environ["OMPITPU_NODE_ID"]) - 1) // _hp))
 import jax
 jax.config.update("jax_platforms", "cpu")
 import numpy as np
@@ -295,8 +325,14 @@ for op in OPS:
     results[op] = rows
 world.barrier()
 if me == 0:
+    # witness that the topo family actually ran (a ragged fake-host
+    # grouping would silently degrade torus2d to the flat ring and
+    # this would read 0 — the hosts-per sweep test pins it > 0)
+    from ompi_release_tpu.mca import pvar as _pvar
+    _tr = _pvar.PVARS.lookup("hier_topo_schedule_runs")
     with open(os.environ["OMPITPU_LOOPBACK_OUT"], "w") as f:
-        json.dump({"nprocs": n, "results": results}, f)
+        json.dump({"nprocs": n, "results": results,
+                   "topo_runs": float(_tr.read()) if _tr else 0.0}, f)
 mpi.finalize()
 '''
 
@@ -430,13 +466,18 @@ def emit_tree_rules(sweep: Dict) -> str:
 
 
 def sweep_hier(nprocs: int, ops: Sequence[str], sizes: Sequence[int],
-               repeats: int = 3,
-               timeout_s: int = 600) -> Optional[Dict]:
+               repeats: int = 3, timeout_s: int = 600,
+               hosts_per: int = 0) -> Optional[Dict]:
     """Measure the spanning collectives' INTER schedules through a
     real ``nprocs``-process loopback ``tpurun`` job (the schedules
     only exist across process boundaries — a single-process sweep
-    cannot time them). Returns ``{"nprocs", "results"}`` in
-    :func:`measure`'s row shape, or None if the job failed."""
+    cannot time them). The menu comes from
+    ``hier_schedules.ALGORITHMS``, so the topology-aware variants
+    (multiring/torus2d) are swept too; ``hosts_per`` > 0 groups the
+    processes into fake hosts of that size (distinct shm identities
+    per group) so those variants see a real shm/DCN split. Returns
+    ``{"nprocs", "hosts_per", "results"}`` in :func:`measure`'s row
+    shape, or None if the job failed."""
     import json as _json
     import os as _os
 
@@ -450,10 +491,13 @@ def sweep_hier(nprocs: int, ops: Sequence[str], sizes: Sequence[int],
         {"OMPITPU_HIER_TUNE_OPS": _json.dumps(list(ops)),
          "OMPITPU_HIER_TUNE_SIZES": _json.dumps(
              sorted(int(s) for s in sizes)),
-         "OMPITPU_HIER_TUNE_REPEATS": str(repeats)},
+         "OMPITPU_HIER_TUNE_REPEATS": str(repeats),
+         "OMPITPU_HIER_TUNE_HOSTS_PER": str(int(hosts_per))},
         "hier_tune.json", timeout_s=timeout_s)
     if out is None:
         _log.verbose(1, "hier sweep job failed")
+    elif isinstance(out, dict):
+        out.setdefault("hosts_per", int(hosts_per))
     return out
 
 
@@ -709,6 +753,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--hier-sizes", default="1024,65536,1048576",
                     help="per-rank buffer sizes (bytes) for the hier "
                          "sweep")
+    ap.add_argument("--hier-hosts-per", type=int, default=0,
+                    help="group the hier sweep's processes into fake "
+                         "hosts of this size (distinct shm identities) "
+                         "so the topology-aware schedules (multiring/"
+                         "torus2d) measure over a real shm/DCN split; "
+                         "0 keeps the machine's own host identity")
+    ap.add_argument("--db", default="",
+                    help="register the emitted rules file into this "
+                         "tuning-database directory (a new versioned, "
+                         "fingerprint-stamped entry jobs auto-select "
+                         "via --mca coll_tuning_db_dir); empty "
+                         "disables")
     ap.add_argument("--tree-buckets", default="",
                     help="comma-separated bucket capacities (bytes) to "
                          "sweep for the planned whole-tree pass "
@@ -740,7 +796,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         hier_sizes = sorted(int(s) for s in args.hier_sizes.split(",")
                             if s.strip())
         sweep = sweep_hier(args.hier_procs, hier_ops, hier_sizes,
-                           repeats=args.repeats)
+                           repeats=args.repeats,
+                           hosts_per=args.hier_hosts_per)
         if sweep:
             text += emit_hier_rules(sweep)
     tree_buckets = [int(s) for s in args.tree_buckets.split(",")
@@ -750,6 +807,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                                     repeats=args.repeats)
         if tsweep:
             text += emit_tree_rules(tsweep)
+    # every emitted file is stamped with the MEASURED topology
+    # fingerprint — the tuning-db selection key, and honest provenance
+    # even for hand-pointed files
+    fp = measured_fingerprint(args.hier_procs, args.hier_hosts_per)
+    text = tuning_db.stamp(text, fp)
     with open(args.output, "w") as f:
         f.write(text)
     # validate what we just wrote parses (a typo'd generator must not
@@ -759,7 +821,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     dynamic_rules.load_rules(args.output)
     n_rules = sum(1 for ln in text.splitlines()
                   if ln and not ln.startswith("#"))
-    print(f"tpu-tune: wrote {n_rules} rule(s) to {args.output}")
+    print(f"tpu-tune: wrote {n_rules} rule(s) to {args.output} "
+          f"[fingerprint {fp.canon()}]")
+    if args.db:
+        path = tuning_db.TuningDb(args.db).register(text, fp)
+        print(f"tpu-tune: registered into tuning db: {path}")
     return 0
 
 

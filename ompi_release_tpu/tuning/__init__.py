@@ -1,0 +1,12 @@
+"""Persistent per-topology tuning — the coll/tuned + coll/ml decision
+tables made fleet-durable.
+
+:mod:`.db` stores versioned dynamic-rule files keyed by a topology
+fingerprint (hosts, procs-per-host, link classes, P) so a fleet never
+re-pays a tuning sweep; :mod:`.retune` watches the PR 6 series plane
+for sustained slow links and applies re-measured rules through a
+cvar write (which bumps the MCA write generation, so PR 13 frozen
+``SchedulePlan``s re-plan at the next fire, never mid-schedule).
+"""
+
+from . import db  # noqa: F401  (registers the coll_tuning_db_dir cvar)

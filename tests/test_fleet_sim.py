@@ -465,13 +465,17 @@ class TestBenchWiring:
         lines = bench._fleet_micro_suite(sizes=(64,))
         assert lines
         for ln in lines:
-            assert ln["metric"].startswith("sim_")
+            # sim_* = closed-form observables (lower-better), topo_* =
+            # topology-aware speedup ratios over the flat ring
+            # (higher-better)
+            assert ln["metric"].startswith(("sim_", "topo_"))
             # satellite: distinct tier label so the gate NEVER fits
             # sim numbers against loopback-cpu/tpu history
             assert ln["tier_label"] == "sim"
             assert gate.line_tier(ln) == "sim"
             assert gate.gateable(ln)
-            assert gate._direction(ln.get("unit"), ln["metric"]) == -1
+            want = 1 if ln["metric"].startswith("topo_") else -1
+            assert gate._direction(ln.get("unit"), ln["metric"]) == want
         metrics = {ln["metric"] for ln in lines}
         assert "sim_bcast_root_sends_p64" in metrics
         assert "sim_rab_bytes_per_rank_p64" in metrics

@@ -680,6 +680,44 @@ class TestBenchGate:
                 "x_orchestration")])
         assert gate2.main(hist + ["--candidate", str(ok)]) == 0
 
+    def test_topo_metric_directions(self, tmp_path):
+        """The fleet_scaling suite's topo_* lines (topology-aware
+        schedule speedups over the flat ring: inter-host byte ratio,
+        virtual-makespan ratio) are registered higher-better in the
+        sim tier — a shrunk ratio means the torus/multiring advantage
+        regressed, and it must trip the gate."""
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        assert gate._direction(
+            "x_inter_bytes", "topo_torus_inter_bytes_x_p1024") == 1
+        assert gate._direction(
+            "x_makespan", "topo_torus_makespan_x_p256") == 1
+        assert gate._direction(
+            None, "topo_multiring_makespan_x_p256") == 1
+        # ...while the sim_torus_* observables stay lower-better
+        assert gate._direction(
+            "bytes", "sim_torus_inter_bytes_per_rank_p1024") == -1
+        assert gate._direction("rounds", "sim_torus_rounds_p256") == -1
+
+        def ln(metric, v, unit):
+            return {"metric": metric, "value": v, "unit": unit,
+                    "vs_baseline": None, "tier_label": "sim"}
+
+        hist = [_round_file(
+            tmp_path / f"BENCH_r{k:02d}.json",
+            [ln("topo_torus_inter_bytes_x_p1024", 8.0, "x_inter_bytes")])
+            for k in range(4)]
+        bad = _round_file(
+            tmp_path / "cand.json",
+            [ln("topo_torus_inter_bytes_x_p1024", 1.0,
+                "x_inter_bytes")])
+        assert gate.main(hist + ["--candidate", str(bad)]) == 1
+        ok = _round_file(
+            tmp_path / "ok.json",
+            [ln("topo_torus_inter_bytes_x_p1024", 8.0,
+                "x_inter_bytes")])
+        assert gate.main(hist + ["--candidate", str(ok)]) == 0
+
     def test_sim_tier_band_is_tight_not_wall_clock_wobble(self,
                                                           tmp_path):
         """Sim lines are deterministic replays: the ±25% wall-clock

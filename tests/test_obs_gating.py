@@ -43,7 +43,10 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/parallel/elastic.py",
            "ompi_release_tpu/obs/sentinel.py",
            "ompi_release_tpu/parallel/tree.py",
-           "ompi_release_tpu/coll/plan.py")
+           "ompi_release_tpu/coll/plan.py",
+           "ompi_release_tpu/coll/topo_schedules.py",
+           "ompi_release_tpu/tuning/db.py",
+           "ompi_release_tpu/tuning/retune.py")
 
 #: attribute calls that ARE emit sites when ungated
 EMIT_ATTRS = {"record", "begin", "body", "end", "arm"}

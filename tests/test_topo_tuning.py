@@ -1,0 +1,931 @@
+"""Topology-aware torus/multiring schedules, the persistent
+per-topology tuning database, and online re-tuning.
+
+Four planes, mirroring the PR's layers:
+
+1. SCHEDULE CORRECTNESS — the lockstep simulator runs the real
+   multiring / 2D-torus round code; every op/dtype must match the
+   ``recursive_doubling`` reference BITWISE (the data is integer-
+   valued, so every fold order is exact even in float32 — parity is
+   bit-for-bit, not within-tolerance).
+
+2. FLEET SCALING — the PR 12 simulator at P ∈ {256, 1024} on a
+   ``hosts_per=8`` topology: measured host-crossing bytes equal the
+   closed forms exactly, and the 2D torus moves STRICTLY fewer total
+   inter-host bytes (and ~d0× fewer per NIC) than the flat ring.
+
+3. TUNING DATABASE — fingerprint round-trips, the optional
+   ``# fingerprint:`` header stanza (legacy files pinned unchanged),
+   versioned register/select, nearest-match rules, and the
+   dynamic-rules precedence: forcing > explicit file > DB entry >
+   fixed constants.
+
+4. ONLINE RE-TUNING — a seeded slow-NIC straggler degrades the
+   per-comm MB/s series; the sustained-slow detector triggers a
+   bounded fleet-sim micro-probe whose verdict registers a NEW db
+   version and lands via the cvar write that bumps the MCA write
+   generation — so PR 13 frozen plans provably re-freeze at the next
+   fire (unit + real-job test).
+"""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from ompi_release_tpu import ops
+import ompi_release_tpu.coll.components  # noqa: F401  (registers the
+# coll_tuned_* cvars and the plain rule namespaces)
+from ompi_release_tpu.coll import dynamic_rules
+from ompi_release_tpu.coll import hier_schedules as hs
+from ompi_release_tpu.coll import topo_schedules as ts
+from ompi_release_tpu.coll.base import COLL_FRAMEWORK
+from ompi_release_tpu.mca import pvar
+from ompi_release_tpu.mca import var as mca_var
+from ompi_release_tpu.runtime.state import JobState
+from ompi_release_tpu.testing import fleet_sim as fs
+from ompi_release_tpu.testing.lockstep import simulate
+from ompi_release_tpu.tools.tpurun import Job
+from ompi_release_tpu.tuning import db as tdb
+from ompi_release_tpu.tuning import retune
+from ompi_release_tpu.utils.errors import MPIError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+slow = pytest.mark.slow
+
+COLL_FRAMEWORK.lookup("tuned").register_vars()  # device-free cvar reg
+
+
+def _host_of(procs, per):
+    """Uniform fake host map: ``per`` consecutive procs per host."""
+    return {p: f"h{i // per}" for i, p in enumerate(procs)}
+
+
+def _linear_fold(parts, op):
+    acc = parts[0]
+    for nxt in parts[1:]:
+        acc = np.asarray(op(acc, nxt))
+    return acc
+
+
+@pytest.fixture(autouse=True)
+def _clean_tuning_state():
+    yield
+    for v in ("coll_tuned_use_dynamic_rules",
+              "coll_tuned_dynamic_rules_filename",
+              "coll_tuning_db_dir", "hier_topo_schedules",
+              "hier_multiring_k", "hier_inter_algorithm",
+              "tune_online", "tune_online_window",
+              "tune_online_sustain", "tune_online_slow_factor",
+              "tune_online_cooldown_s"):
+        mca_var.VARS.unset(v)
+    tdb._reset_for_tests()
+    retune._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# 1. grids, strides, closed forms
+# ---------------------------------------------------------------------------
+
+class TestGridsAndClosedForms:
+    def test_uniform_grid(self):
+        procs = [3, 1, 4, 1 + 8, 5 + 8, 9 + 16]  # deliberately unsorted
+        procs = [1, 3, 4, 9, 13, 25]
+        host_of = {1: "a", 3: "a", 4: "b", 9: "b", 13: "c", 25: "c"}
+        d0, d1, groups = ts.torus_grid(procs, host_of)
+        assert (d0, d1) == (2, 3)
+        # groups ordered by lowest member, members sorted
+        assert groups == [[1, 3], [4, 9], [13, 25]]
+        assert ts.grid_dims(procs, host_of) == (2, 3)
+
+    def test_ragged_and_single_host_are_none(self):
+        procs = [0, 1, 2]
+        assert ts.torus_grid(procs, {0: "a", 1: "a", 2: "b"}) is None
+        assert ts.torus_grid(procs, {0: "a", 1: "a", 2: "a"}) is None
+        # missing host entries degrade to per-proc pseudo-hosts
+        assert ts.grid_dims([0, 1], {}) == (1, 2)
+
+    def test_ring_strides_are_coprime_and_distinct(self):
+        for P in (4, 6, 8, 12, 16, 7):
+            strides = ts.ring_strides(P, 4)
+            assert strides[0] == 1
+            assert len(set(strides)) == len(strides)
+            import math
+            for s in strides:
+                assert math.gcd(s, P) == 1
+            # distinct strides => pairwise-distinct successors
+            for me in range(P):
+                succ = [(me + s) % P for s in strides]
+                assert len(set(succ)) == len(strides)
+
+    def test_closed_forms(self):
+        assert ts.torus_rounds(8, 32) == 2 * 7 + 2 * 31
+        # n=2048 f32 over d0=8,d1=32: per0=256, per1=8 elems
+        assert ts.torus_inter_bytes_per_rank(2048, 4, 8, 32) \
+            == 2 * 31 * 8 * 4
+        assert ts.torus_inter_bytes_total(2048, 4, 8, 32) \
+            == 256 * 2 * 31 * 8 * 4
+        assert ts.flat_ring_inter_bytes_total(2048, 4, 256, 32) \
+            == 32 * 2 * 255 * 8 * 4
+
+
+# ---------------------------------------------------------------------------
+# 2. lockstep parity: bitwise vs the recursive_doubling reference
+# ---------------------------------------------------------------------------
+
+GRIDS = [(4, 2), (8, 2), (8, 4), (12, 4), (6, 3)]
+
+
+class TestTopoParityMatrix:
+    """multiring/torus2d vs recursive_doubling, bitwise for EVERY
+    op/dtype: integer-valued data keeps every f32 fold order exact."""
+
+    OPS = [(ops.SUM, "sum"), (ops.PROD, "prod"), (ops.MAX, "max"),
+           (ops.MIN, "min"), (ops.BAND, "band")]
+
+    @pytest.mark.parametrize("P,per", GRIDS,
+                             ids=lambda g: "x".join(map(str, g))
+                             if isinstance(g, tuple) else str(g))
+    @pytest.mark.parametrize("dtype", [np.int32, np.float32])
+    def test_allreduce_bitwise(self, P, per, dtype):
+        procs = [3 * i + 1 for i in range(P)]
+        host_of = _host_of(procs, per)
+        rng = np.random.RandomState(P * per)
+        for op, opname in self.OPS:
+            if opname == "band" and dtype is np.float32:
+                continue
+            lo, hi = (1, 3) if opname == "prod" else (1, 50)
+            data = {p: rng.randint(lo, hi, 13).astype(dtype)
+                    for p in procs}
+            npop = lambda a, b: np.asarray(op(a, b))  # noqa: E731
+            ident = op.identity_for(dtype)
+            # the recursive_doubling reference: doubling allgather +
+            # the ordered index fold (the exact-order schedule)
+            ref = simulate(procs, lambda x, p: _linear_fold(
+                hs.allgather_bruck(x, procs, p, data[p], [13] * P),
+                op))
+            want = np.asarray(ref[procs[0]])
+            out = simulate(procs, lambda x, p: ts.allreduce_torus2d(
+                x, procs, p, data[p], npop, ident, host_of))
+            for p in procs:
+                np.testing.assert_array_equal(
+                    np.asarray(out[p]).ravel().astype(dtype), want,
+                    err_msg=f"torus2d/{opname}/{P}x{per}")
+            for k in (2, 4):
+                out = simulate(
+                    procs, lambda x, p: ts.allreduce_multiring(
+                        x, procs, p, data[p], npop, ident, k))
+                for p in procs:
+                    np.testing.assert_array_equal(
+                        np.asarray(out[p]).ravel().astype(dtype),
+                        want,
+                        err_msg=f"multiring(k={k})/{opname}/{P}")
+
+    def test_ragged_layout_falls_back_and_stays_correct(self):
+        procs = list(range(5))
+        host_of = {0: "a", 1: "a", 2: "a", 3: "b", 4: "b"}  # ragged
+        data = {p: np.arange(11, dtype=np.int64) * (p + 1)
+                for p in procs}
+        want = sum(data[p] for p in procs)
+        out = simulate(procs, lambda x, p: ts.allreduce_torus2d(
+            x, procs, p, data[p], np.add, 0, host_of))
+        for p in procs:
+            np.testing.assert_array_equal(
+                np.asarray(out[p]).ravel(), want)
+
+    def test_multiring_degrades_to_single_ring_when_p_small(self):
+        procs = [0, 1]  # only stride 1 is coprime: single ring
+        data = {0: np.arange(7, dtype=np.int32),
+                1: np.arange(7, dtype=np.int32) * 3}
+        out = simulate(procs, lambda x, p: ts.allreduce_multiring(
+            x, procs, p, data[p], np.add, 0, 8))
+        for p in procs:
+            np.testing.assert_array_equal(
+                np.asarray(out[p]).ravel(), data[0] + data[1])
+
+    @pytest.mark.parametrize("P,per", GRIDS,
+                             ids=lambda g: "x".join(map(str, g))
+                             if isinstance(g, tuple) else str(g))
+    def test_allgather_torus_heterogeneous_blocks(self, P, per):
+        procs = [2 * i + 1 for i in range(P)]
+        host_of = _host_of(procs, per)
+        rng = np.random.RandomState(P + per)
+        blocks = {p: rng.randint(0, 99, ((i % 2) + 1, 5))
+                  .astype(np.int32) for i, p in enumerate(procs)}
+        out = simulate(procs, lambda x, p: ts.allgather_torus2d(
+            x, procs, p, blocks[p], host_of))
+        for p in procs:
+            for i, q in enumerate(procs):
+                np.testing.assert_array_equal(out[p][i], blocks[q])
+
+    @pytest.mark.parametrize("P,per", GRIDS,
+                             ids=lambda g: "x".join(map(str, g))
+                             if isinstance(g, tuple) else str(g))
+    def test_bcast_torus_every_root(self, P, per):
+        procs = [2 * i for i in range(P)]
+        host_of = _host_of(procs, per)
+        rng = np.random.RandomState(P)
+        val = rng.randint(0, 99, (4, 3)).astype(np.int32)
+        for root in (procs[0], procs[-1], procs[P // 2]):
+            out = simulate(procs, lambda x, p: ts.bcast_torus2d(
+                x, procs, p, root, val if p == root else None,
+                host_of))
+            for p in procs:
+                np.testing.assert_array_equal(np.asarray(out[p]), val)
+
+    def test_torus_bcast_dcn_copies_are_d1_minus_1(self):
+        """The torus bcast's inter-host traffic is exactly d1-1
+        copies — counted on the fleet fabric."""
+        P, per = 16, 4
+        val = np.arange(64, dtype=np.int32)
+        fleet = fs.FleetSim(P, hosts_per=per, seed=2)
+        procs = fleet.procs
+        host_of = fleet.fabric.host_of
+        rep = fleet.run(lambda x, p: ts.bcast_torus2d(
+            x, procs, p, 0, val if p == 0 else None, host_of),
+            label="bcast_torus")
+        d1 = P // per
+        total_inter = sum(rep.inter_bytes_sent.values())
+        assert total_inter == (d1 - 1) * val.nbytes
+        for p in procs:
+            np.testing.assert_array_equal(np.asarray(rep.value(p)),
+                                          val)
+
+
+# ---------------------------------------------------------------------------
+# 3. fleet scaling: closed-form inter-host bytes at P ∈ {256, 1024}
+# ---------------------------------------------------------------------------
+
+def _torus_run(P, hosts_per=8):
+    procs = list(range(P))
+    n = 8 * P  # divisible by P, d0 and d1: the closed forms are exact
+    data = {p: np.arange(n, dtype=np.float32) * ((p % 5) + 1)
+            for p in procs}
+    fleet = fs.FleetSim(P, hosts_per=hosts_per, seed=1)
+    host_of = fleet.fabric.host_of
+    rep = fleet.run(lambda x, p: ts.allreduce_torus2d(
+        x, procs, p, data[p], np.add, 0.0, host_of),
+        label="allreduce_torus")
+    want = np.arange(n, dtype=np.float32) * sum(
+        (p % 5) + 1 for p in procs)
+    return rep, n, want
+
+
+class TestFleetScaling:
+    @pytest.mark.parametrize("P", [256, 1024])
+    def test_torus_closed_form_and_strictly_fewer_inter_bytes(self, P):
+        d0, d1 = 8, P // 8
+        rep, n, want = _torus_run(P)
+        assert len(rep.ok()) == P
+        # measured host-crossing bytes == the closed form, EVERY rank
+        per_rank = ts.torus_inter_bytes_per_rank(n, 4, d0, d1)
+        assert set(rep.inter_bytes_sent.values()) == {per_rank}
+        assert rep.max_rounds() == ts.torus_rounds(d0, d1)
+        # strictly fewer TOTAL inter-host bytes than the flat ring...
+        torus_total = sum(rep.inter_bytes_sent.values())
+        flat_total = ts.flat_ring_inter_bytes_total(n, 4, P, d1)
+        assert torus_total < flat_total
+        # ...and a ~d0× cut at the busiest NIC (the flat ring's
+        # boundary ranks each ship every chunk across DCN)
+        flat_per_nic = flat_total // d1
+        assert flat_per_nic >= (d0 - 1) * per_rank
+        # results are right at scale, not just cheap
+        np.testing.assert_allclose(
+            np.asarray(rep.value(0)).ravel(), want, rtol=1e-5)
+        np.testing.assert_array_equal(
+            np.asarray(rep.value(0)), np.asarray(rep.value(P - 1)))
+
+    def test_flat_ring_baseline_closed_form_is_measured(self):
+        """flat_ring_inter_bytes_total is not a guess: the actual
+        flat ring on the same fabric measures exactly it."""
+        P, hosts = 64, 8
+        procs = list(range(P))
+        n = 8 * P
+        data = {p: np.arange(n, dtype=np.float32) for p in procs}
+        fleet = fs.FleetSim(P, hosts_per=P // hosts, seed=1)
+        rep = fleet.run(lambda x, p: hs.allreduce_ring(
+            x, procs, p, data[p], np.add, 0.0), label="ring")
+        assert sum(rep.inter_bytes_sent.values()) \
+            == ts.flat_ring_inter_bytes_total(n, 4, P, hosts)
+
+    def test_torus_beats_flat_ring_makespan(self):
+        """On the hierarchical fabric the torus's virtual makespan
+        beats the flat ring's (the topo_torus_makespan_x bench line's
+        law, pinned here at P=64)."""
+        P = 64
+        procs = list(range(P))
+        n = 8 * P
+        data = {p: np.arange(n, dtype=np.float32) * ((p % 5) + 1)
+                for p in procs}
+
+        def run(fn, label):
+            fleet = fs.FleetSim(P, hosts_per=8, seed=1)
+            host_of = fleet.fabric.host_of
+            return fleet.run(
+                lambda x, p: fn(x, p, host_of), label=label)
+
+        rep_t = run(lambda x, p, h: ts.allreduce_torus2d(
+            x, procs, p, data[p], np.add, 0.0, h), "torus")
+        rep_r = run(lambda x, p, h: hs.allreduce_ring(
+            x, procs, p, data[p], np.add, 0.0), "ring")
+        assert rep_t.makespan < rep_r.makespan
+        np.testing.assert_array_equal(
+            np.asarray(rep_t.value(3)), np.asarray(rep_r.value(3)))
+
+    def test_multiring_beats_single_ring_on_bandwidth_bound_wire(self):
+        """k disjoint rings driven in parallel on a uniform
+        bandwidth-bound fabric: the k× ring-bandwidth claim."""
+        P = 32
+        procs = list(range(P))
+        n = 16 * P
+        data = {p: np.arange(n, dtype=np.float32) for p in procs}
+
+        def run(fn, label):
+            fleet = fs.FleetSim(P, fabric=fs.Fabric(
+                P, hosts_per=P, intra=fs.LinkSpec(1e-7, 0.1), seed=1))
+            return fleet.run(fn, label=label)
+
+        rep_m = run(lambda x, p: ts.allreduce_multiring(
+            x, procs, p, data[p], np.add, 0.0, 4), "multiring")
+        rep_r = run(lambda x, p: hs.allreduce_ring(
+            x, procs, p, data[p], np.add, 0.0), "ring")
+        assert rep_m.makespan < rep_r.makespan
+
+
+# ---------------------------------------------------------------------------
+# 4. selection: fixed constants, gating, forcing, rules
+# ---------------------------------------------------------------------------
+
+class TestTopoSelection:
+    def test_fixed_decision_prefers_torus_on_a_grid(self):
+        # large commutative allreduce on a uniform grid: torus2d
+        assert hs.pick("allreduce", 64, 1 << 20,
+                       topo=(8, 8)) == "torus2d"
+        # no grid (flat/ragged/single-host): the flat decisions hold
+        assert hs.pick("allreduce", 64, 1 << 20) == "rabenseifner"
+        assert hs.pick("allreduce", 64, 1 << 20,
+                       topo=(1, 64)) == "rabenseifner"
+        # small messages keep the latency-optimal flat schedule
+        assert hs.pick("allreduce", 64, 64,
+                       topo=(8, 8)) == "recursive_doubling"
+        # non-commutative ops never get an order-waiving schedule
+        assert hs.pick("allreduce", 64, 1 << 20, topo=(8, 8),
+                       commutative=False) == "recursive_doubling"
+        assert hs.pick("bcast", 64, 1 << 20, topo=(8, 8)) == "torus2d"
+        assert hs.pick("allgather", 64, 1 << 20,
+                       topo=(8, 8)) == "torus2d"
+        # small allgather stays bruck even on a grid
+        assert hs.pick("allgather", 64, 1024, topo=(8, 8)) == "bruck"
+
+    def test_operator_opt_out_restores_flat_decisions(self):
+        mca_var.set_value("hier_topo_schedules", False)
+        assert hs.pick("allreduce", 64, 1 << 20,
+                       topo=(8, 8)) == "rabenseifner"
+        assert hs.pick("bcast", 64, 1 << 20, topo=(8, 8)) == "binomial"
+        assert hs.pick("allgather", 64, 1 << 20,
+                       topo=(8, 8)) == "linear"
+
+    def test_forcing_and_noncommutative_guard(self):
+        mca_var.set_value("hier_inter_algorithm", "multiring")
+        assert hs.pick("allreduce", 8, 64) == "multiring"
+        # forcing an order-waiving schedule for a non-commutative op
+        # is an ERROR, exactly like ring/rabenseifner
+        with pytest.raises(MPIError):
+            hs.pick("allreduce", 8, 64, commutative=False)
+        mca_var.set_value("hier_inter_algorithm", "torus2d")
+        assert hs.pick("allreduce", 8, 64) == "torus2d"
+        with pytest.raises(MPIError):
+            hs.pick("allreduce", 8, 64, has_identity=False)
+        # bcast has a torus2d variant; reduce does not -> auto
+        assert hs.pick("bcast", 8, 64) == "torus2d"
+        assert hs.pick("reduce", 8, 64) == "binomial"
+
+    def test_dynamic_rule_names_the_topo_variants(self, tmp_path):
+        rules = tmp_path / "topo.conf"
+        rules.write_text(textwrap.dedent("""
+            hier_allreduce  0  0      multiring
+            hier_allreduce  0  65536  torus2d
+        """))
+        mca_var.set_value("coll_tuned_use_dynamic_rules", True)
+        mca_var.set_value("coll_tuned_dynamic_rules_filename",
+                          str(rules))
+        assert hs.pick("allreduce", 8, 100) == "multiring"
+        assert hs.pick("allreduce", 8, 1 << 20) == "torus2d"
+        # rules cannot waive MPI semantics: silent downgrade
+        assert hs.pick("allreduce", 8, 1 << 20,
+                       commutative=False) == "recursive_doubling"
+
+    def test_order_waiving_covers_the_topo_family(self):
+        assert "multiring" in hs.ORDER_WAIVING
+        assert "torus2d" in hs.ORDER_WAIVING
+        for alg in ts.TOPO_ALGS:
+            assert alg in hs.ALGORITHMS["allreduce"]
+
+
+# ---------------------------------------------------------------------------
+# 5. fingerprints, the header stanza, legacy files pinned
+# ---------------------------------------------------------------------------
+
+class TestFingerprint:
+    def test_canon_round_trip(self):
+        fp = tdb.Fingerprint(hosts=8, procs_per_host=8,
+                             link_classes=("shm", "dcn"), P=64)
+        assert fp.canon() == "hosts=8;ppn=8;links=shm+dcn;P=64"
+        assert tdb.Fingerprint.parse(fp.canon()) == fp
+        assert tdb.Fingerprint.parse(tdb.LOCAL.canon()) == tdb.LOCAL
+
+    def test_malformed_raises(self):
+        for bad in ("hosts=8;ppn=8", "8/8/64", "",
+                    "hosts=x;ppn=1;links=shm;P=4"):
+            with pytest.raises(ValueError):
+                tdb.Fingerprint.parse(bad)
+
+    def test_fingerprint_for_layouts(self):
+        fp = tdb.fingerprint_for({0: "a", 1: "a", 2: "b", 3: "b"}, 4)
+        assert fp == tdb.Fingerprint(2, 2, ("shm", "dcn"), 4)
+        # ragged: ppn pins to 0 so it never exact-matches uniform
+        fp = tdb.fingerprint_for({0: "a", 1: "a", 2: "b"}, 3)
+        assert fp.procs_per_host == 0 and fp.hosts == 2
+        fp = tdb.fingerprint_for({0: "a", 1: "a"}, 2)
+        assert fp.link_classes == ("shm",)
+
+    def test_stamp_and_read_header(self, tmp_path):
+        fp = tdb.Fingerprint(4, 2, ("shm", "dcn"), 8)
+        text = tdb.stamp("hier_allreduce  0  0  ring\n", fp, version=3)
+        p = tmp_path / "x.conf"
+        p.write_text(text)
+        got_fp, got_v = tdb.read_header(str(p))
+        assert got_fp == fp and got_v == 3
+        # re-stamping replaces, never duplicates
+        text2 = tdb.stamp(text, tdb.LOCAL, version=1)
+        assert text2.count("# fingerprint:") == 1
+
+    def test_legacy_file_reads_none(self, tmp_path):
+        p = tmp_path / "legacy.conf"
+        p.write_text("allreduce  0  0  ring\n")
+        assert tdb.read_header(str(p)) == (None, None)
+
+
+class TestHeaderStanzaInRules:
+    def test_stanza_is_parsed_not_skipped(self, tmp_path):
+        p = tmp_path / "fp.conf"
+        p.write_text("# fingerprint: hosts=2;ppn=4;links=shm+dcn;P=8\n"
+                     "# version: 2\n"
+                     "hier_allreduce  0  0  torus2d\n")
+        rules, meta = dynamic_rules.load_rules_doc(str(p))
+        assert meta["fingerprint"] == "hosts=2;ppn=4;links=shm+dcn;P=8"
+        assert meta["version"] == 2
+        assert rules["hier_allreduce"] == [(0, 0, "torus2d", None)]
+
+    def test_malformed_stanza_fails_at_load_with_lineno(self, tmp_path):
+        p = tmp_path / "bad.conf"
+        p.write_text("# fingerprint: hosts=two;ppn=1;links=shm;P=4\n"
+                     "allreduce  0  0  ring\n")
+        with pytest.raises(MPIError) as ei:
+            dynamic_rules.load_rules_doc(str(p))
+        assert "bad.conf:1" in str(ei.value)
+
+    def test_shipped_cpu8_rules_load_unchanged(self):
+        """The satellite pin: tuning/cpu8_rules.conf (no stanza) keeps
+        the exact legacy semantics — same tables through both entry
+        points, no fingerprint, and the known entries intact."""
+        path = os.path.join(REPO, "tuning", "cpu8_rules.conf")
+        rules, meta = dynamic_rules.load_rules_doc(path)
+        assert meta == {"fingerprint": None, "version": None}
+        assert dynamic_rules.load_rules(path) == rules
+        # exact legacy entries, pinned
+        assert rules["allreduce"][0] == (0, 0, "nonoverlapping", None)
+        assert (0, 65536, "segmented_ring", None) in rules["allreduce"]
+        assert rules["tree_buckets"] == [(0, 0, "fused", 1048576)]
+        assert (4, 65536, "rabenseifner", None) \
+            in rules["hier_allreduce"]
+
+
+# ---------------------------------------------------------------------------
+# 6. the tuning database: register / version / select
+# ---------------------------------------------------------------------------
+
+FP_A = tdb.Fingerprint(8, 8, ("shm", "dcn"), 64)
+FP_B = tdb.Fingerprint(16, 8, ("shm", "dcn"), 128)
+FP_L = tdb.Fingerprint(1, 4, ("shm",), 4)
+
+
+class TestTuningDb:
+    def test_register_versions_and_never_overwrites(self, tmp_path):
+        db = tdb.TuningDb(str(tmp_path))
+        p1 = db.register("hier_allreduce  0  0  ring\n", FP_A)
+        p2 = db.register("hier_allreduce  0  0  torus2d\n", FP_A)
+        assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+        assert tdb.read_header(p1)[1] == 1
+        assert tdb.read_header(p2)[1] == 2
+        # highest version wins the exact match
+        assert db.best_match(FP_A) == p2
+        assert dynamic_rules.load_rules(p2)["hier_allreduce"] \
+            == [(0, 0, "torus2d", None)]
+
+    def test_register_validates_through_the_real_loader(self, tmp_path):
+        db = tdb.TuningDb(str(tmp_path))
+        with pytest.raises(MPIError):
+            db.register("hier_allreduce  0  0  no_such_alg\n", FP_A)
+        # nothing published, not even a temp file
+        assert [f for f in os.listdir(tmp_path)] == []
+
+    def test_nearest_match_and_link_class_isolation(self, tmp_path):
+        db = tdb.TuningDb(str(tmp_path))
+        pa = db.register("hier_allreduce  0  0  torus2d\n", FP_A)
+        db.register("allreduce  0  0  ring\n", FP_L)
+        # no spanning entry for FP_B: the nearest same-link entry
+        assert db.best_match(FP_B) == pa
+        # ...but a local table must NEVER steer a spanning job and
+        # vice versa
+        assert db.best_match(
+            tdb.Fingerprint(1, 2, ("shm",), 2)) is not None
+        only_spanning = tdb.TuningDb(str(tmp_path / "sub"))
+        only_spanning.register("hier_allreduce  0  0  ring\n", FP_A)
+        assert only_spanning.best_match(tdb.LOCAL) is None
+
+    def test_select_cache_invalidated_by_register(self, tmp_path):
+        tdb.set_active(FP_A)
+        db = tdb.TuningDb(str(tmp_path))
+        p1 = db.register("hier_allreduce  0  0  ring\n", FP_A)
+        assert tdb.select_rules_path(str(tmp_path), FP_A) == p1
+        p2 = db.register("hier_allreduce  0  0  torus2d\n", FP_A)
+        # register created a NEW file -> dir mtime moved -> re-resolve
+        assert tdb.select_rules_path(str(tmp_path), FP_A) == p2
+
+
+class TestDbAutoSelection:
+    def test_db_serves_rules_when_no_file_is_pointed(self, tmp_path):
+        tdb.set_active(FP_A)
+        tdb.TuningDb(str(tmp_path)).register(
+            "hier_allreduce  0  0  torus2d\n", FP_A)
+        mca_var.set_value("coll_tuned_use_dynamic_rules", True)
+        mca_var.set_value("coll_tuning_db_dir", str(tmp_path))
+        assert dynamic_rules.lookup("hier_allreduce", 64, 1 << 20) \
+            == "torus2d"
+        src = dynamic_rules.rules_source()
+        assert src["mode"] == "db"
+        assert src["fingerprint"] == FP_A.canon()
+
+    def test_explicit_file_outranks_the_db(self, tmp_path):
+        tdb.set_active(FP_A)
+        tdb.TuningDb(str(tmp_path / "db")).register(
+            "hier_allreduce  0  0  torus2d\n", FP_A)
+        pinned = tmp_path / "pinned.conf"
+        pinned.write_text("hier_allreduce  0  0  ring\n")
+        mca_var.set_value("coll_tuned_use_dynamic_rules", True)
+        mca_var.set_value("coll_tuning_db_dir", str(tmp_path / "db"))
+        mca_var.set_value("coll_tuned_dynamic_rules_filename",
+                          str(pinned))
+        assert dynamic_rules.lookup("hier_allreduce", 64, 1 << 20) \
+            == "ring"
+        assert dynamic_rules.rules_source()["mode"] == "file"
+
+    def test_no_matching_entry_falls_to_fixed_constants(self, tmp_path):
+        tdb.set_active(FP_A)  # spanning job, but the db only has LOCAL
+        tdb.TuningDb(str(tmp_path)).register(
+            "allreduce  0  0  ring\n", FP_L)
+        mca_var.set_value("coll_tuned_use_dynamic_rules", True)
+        mca_var.set_value("coll_tuning_db_dir", str(tmp_path))
+        assert dynamic_rules.lookup("hier_allreduce", 64, 1 << 20) \
+            is None
+        assert dynamic_rules.rules_source()["mode"] == "off"
+        # and pick() falls through to the fixed decision
+        assert hs.pick("allreduce", 64, 1 << 20) == "rabenseifner"
+
+
+# ---------------------------------------------------------------------------
+# 7. online re-tuning
+# ---------------------------------------------------------------------------
+
+class TestOnlineRetuneDetector:
+    def _cfg(self):
+        mca_var.set_value("tune_online_window", 4)
+        mca_var.set_value("tune_online_sustain", 2)
+        mca_var.set_value("tune_online_slow_factor", 2.0)
+        mca_var.set_value("tune_online_cooldown_s", 0.0)
+
+    def test_sustained_slow_triggers_one_hiccup_does_not(self):
+        self._cfg()
+        clk = [0.0]
+        rt = retune.OnlineRetuner(clock=lambda: clk[0])
+        for _ in range(4):
+            assert not rt.observe_rate(1, 100.0)
+        # one hiccup: below threshold once, then recovery — no trigger
+        assert not rt.observe_rate(1, 10.0)
+        assert not rt.observe_rate(1, 100.0)
+        # sustained: two consecutive slow ticks -> trigger
+        assert not rt.observe_rate(1, 10.0)
+        assert rt.observe_rate(1, 10.0)
+
+    def test_cooldown_blocks_a_probe_storm(self):
+        self._cfg()
+        mca_var.set_value("tune_online_cooldown_s", 100.0)
+        clk = [0.0]
+        rt = retune.OnlineRetuner(clock=lambda: clk[0])
+        for _ in range(4):
+            rt.observe_rate(1, 100.0)
+        rt.observe_rate(1, 10.0)
+        assert rt.observe_rate(1, 10.0)
+        rt._last_apply[1] = clk[0]  # an apply happened "now"
+        rt.observe_rate(1, 10.0)
+        assert not rt.observe_rate(1, 10.0)  # cooled down: suppressed
+        clk[0] = 200.0  # cooldown expired; baseline recovers...
+        for _ in range(4):
+            assert not rt.observe_rate(1, 100.0)
+        rt.observe_rate(1, 10.0)  # ...then the link goes slow again
+        assert rt.observe_rate(1, 10.0)
+
+    def test_observe_points_folds_bytes_over_seconds(self):
+        self._cfg()
+        rt = retune.OnlineRetuner()
+
+        def tick(t, cid, mbps):
+            return [{"name": "coll_bytes", "t": t, "cid": cid,
+                     "v": mbps * 1e6},
+                    {"name": "coll_seconds", "t": t, "cid": cid,
+                     "v": 1.0}]
+
+        pts = []
+        for k in range(4):
+            pts += tick(float(k), 7, 100.0)
+        assert rt.observe_points(pts) == []
+        pts = tick(4.0, 7, 10.0) + tick(5.0, 7, 10.0)
+        assert rt.observe_points(pts) == [7]
+
+    def test_maybe_start_is_gated(self):
+        # tune_online off (default): nothing arms
+        assert retune.maybe_start() is False
+        assert retune.RETUNER is None
+
+    def test_default_probe_mirrors_the_active_fingerprint(self):
+        """A production arm (no injected probe) must still close the
+        loop: the built-in probe mirrors the active fingerprint, and
+        declines layouts the fleet model cannot mirror."""
+        tdb.set_active(tdb.LOCAL)
+        assert retune.default_probe(1) is None  # single process
+        tdb.set_active(tdb.Fingerprint(2, 0, ("shm", "dcn"), 5))
+        assert retune.default_probe(1) is None  # ragged: no mirror
+        tdb.set_active(tdb.Fingerprint(4, 4, ("shm", "dcn"), 16))
+        text = retune.default_probe(1)
+        assert text and "hier_allreduce" in text
+        assert "P=16, hosts_per=4" in text
+
+    def test_widest_comm_owns_the_active_fingerprint(self):
+        """Comm construction publishes with force=False: a narrower
+        subcomm built after the world must NOT steer the process-
+        global DB selection away from the world's rules."""
+        world_fp = tdb.Fingerprint(16, 8, ("shm", "dcn"), 128)
+        sub_fp = tdb.Fingerprint(2, 8, ("shm", "dcn"), 16)
+        tdb.set_active(world_fp, force=False)
+        tdb.set_active(sub_fp, force=False)  # the subcomm: ignored
+        assert tdb.active() == world_fp
+        tdb.set_active(sub_fp)  # force (tests/operator): replaces
+        assert tdb.active() == sub_fp
+
+
+class TestRetuneApply:
+    def test_apply_registers_new_version_and_bumps_generation(
+            self, tmp_path):
+        """THE re-freeze contract, unit leg: the cvar write that
+        applies a re-tuned rule moves VARS.generation, which is what
+        every frozen PR 13 SchedulePlan is stamped with — the next
+        fire re-plans."""
+        tdb.set_active(FP_A)
+        mca_var.set_value("coll_tuning_db_dir", str(tmp_path))
+        rt = retune.OnlineRetuner()
+        g0 = mca_var.VARS.generation
+        path = rt.apply("hier_allreduce  0  0  ring\n", cid=5)
+        assert mca_var.VARS.generation > g0
+        assert mca_var.get("coll_tuned_dynamic_rules_filename", "") \
+            == path
+        assert mca_var.get("coll_tuned_use_dynamic_rules", False)
+        assert tdb.read_header(path)[0] == FP_A
+        assert rt.applied and rt.applied[-1]["cid"] == 5
+        assert dynamic_rules.lookup("hier_allreduce", 64, 1 << 20) \
+            == "ring"
+
+    def test_apply_without_a_db_is_a_loud_error(self):
+        with pytest.raises(ValueError):
+            retune.OnlineRetuner().apply("hier_allreduce 0 0 ring\n")
+
+    def test_slow_nic_straggler_ends_with_a_retuned_rule(
+            self, tmp_path):
+        """The seeded end-to-end scenario: a job running the torus
+        schedule (the clean-fabric winner) sees a 10× slow NIC; the
+        sustained-slow streak triggers the bounded fleet-sim
+        micro-probe over a straggler mirror, and the re-tuned rule —
+        the straggler flips the winner back to the flat ring, whose
+        DCN edges avoid the sick NIC — registers as v2 and is
+        selected at the next plan."""
+        P, hosts_per = 64, 8
+        fp = tdb.Fingerprint(P // hosts_per, hosts_per,
+                             ("shm", "dcn"), P)
+        tdb.set_active(fp)
+        db = tdb.TuningDb(str(tmp_path))
+        db.register("hier_allreduce  0  0  torus2d\n", fp)
+        mca_var.set_value("coll_tuned_use_dynamic_rules", True)
+        mca_var.set_value("coll_tuning_db_dir", str(tmp_path))
+        assert dynamic_rules.lookup("hier_allreduce", P, 1 << 20) \
+            == "torus2d"  # the baseline the fleet tuned in
+
+        def straggler_fabric():
+            f = fs.Fabric(P, hosts_per=hosts_per, seed=3)
+            f.slow_nic(5, 10.0)
+            return f
+
+        # sanity: the clean-fabric probe keeps torus2d; only the
+        # straggler flips it (the probe really reads the fabric)
+        clean = retune.fleet_probe(P, hosts_per, n_elems=512, seed=3)
+        assert clean.splitlines()[-1].split()[-1] == "torus2d"
+
+        mca_var.set_value("tune_online_window", 4)
+        mca_var.set_value("tune_online_sustain", 2)
+        mca_var.set_value("tune_online_cooldown_s", 0.0)
+        rt = retune.OnlineRetuner(
+            probe=lambda cid: retune.fleet_probe(
+                P, hosts_per, n_elems=512, seed=3,
+                fabric_factory=straggler_fabric),
+            clock=lambda: 0.0)
+        cid = 3
+        for _ in range(4):
+            assert not rt.observe_rate(cid, 120.0)  # healthy baseline
+        rt.observe_rate(cid, 11.0)      # the NIC went slow...
+        assert rt.observe_rate(cid, 9.0)  # ...and stayed slow
+        path = rt.retune(cid)
+        assert path is not None and tdb.read_header(path)[1] == 2
+        # the applied rule IS selected now — and it names the flat
+        # ring, away from the straggler-poisoned torus column
+        assert dynamic_rules.lookup("hier_allreduce", P, 1 << 20) \
+            == "ring"
+        src = dynamic_rules.rules_source()
+        assert src["mode"] == "file" and src["path"] == path
+
+    def test_fleet_probe_output_loads_and_is_bounded(self, tmp_path):
+        text = retune.fleet_probe(16, 4, n_elems=256, seed=1)
+        p = tmp_path / "probe.conf"
+        p.write_text(text)
+        rules = dynamic_rules.load_rules(str(p))
+        assert len(rules["hier_allreduce"]) == 1
+        alg = rules["hier_allreduce"][0][2]
+        assert alg in ("ring", "multiring", "torus2d")
+
+    def test_tick_hook_never_kills_the_sampler(self):
+        from ompi_release_tpu.obs import sampler as _sampler
+
+        mca_var.set_value("tune_online", True)
+        rt = retune.OnlineRetuner()
+        rt.tick()  # drains an empty ring: no points, no crash
+        assert rt._cursor >= 0
+        # a broken hook is swallowed by the sampler's dispatch loop
+        _sampler.TICK_HOOKS.append(lambda: 1 / 0)
+        try:
+            for hook in tuple(_sampler.TICK_HOOKS):
+                try:
+                    hook()
+                except Exception:
+                    pass
+        finally:
+            del _sampler.TICK_HOOKS[-1]
+
+
+# ---------------------------------------------------------------------------
+# 8. the re-freeze, in-process: compiled plans re-capture after apply
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def world():
+    import ompi_release_tpu as mpi
+
+    return mpi.init()
+
+
+def _hits():
+    p = pvar.PVARS.lookup("coll_compiled_cache_hits")
+    return p.read()
+
+
+class TestPlanRefreeze:
+    def test_retune_apply_refreezes_the_plan_at_the_next_fire(
+            self, world, tmp_path):
+        """Unit leg of the acceptance criterion: capture, hit, APPLY
+        (the cvar write), then the next fire is a re-capture — never
+        a stale frozen plan, never a mid-schedule switch."""
+        x = np.ones((world.size, 16), np.float32)
+        comm = world.dup(name="retune_refreeze")
+        tdb.set_active(tdb.LOCAL)
+        try:
+            comm.allreduce(x)           # capture
+            h0 = _hits()
+            comm.allreduce(x)           # frozen-plan hit
+            rt = retune.OnlineRetuner(db_dir=str(tmp_path))
+            rt.apply("hier_allreduce  0  0  recursive_doubling\n",
+                     cid=int(comm.cid))
+            comm.allreduce(x)           # generation moved: re-capture
+            comm.allreduce(x)           # ...and freezes again
+            h1 = _hits()
+            assert h1["count"] - h0["count"] == 3
+            assert h1["sum"] - h0["sum"] == 2
+        finally:
+            comm.free()
+            for v in ("coll_tuned_use_dynamic_rules",
+                      "coll_tuned_dynamic_rules_filename"):
+                mca_var.VARS.unset(v)
+
+
+# ---------------------------------------------------------------------------
+# 9. the re-freeze + topo schedules in a REAL job
+# ---------------------------------------------------------------------------
+
+APP_PRELUDE = textwrap.dedent("""
+    import os, sys, tempfile
+    sys.path.insert(0, %r)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # fake 2x2 grid: procs {0,1} on one host, {2,3} on the other
+    # (OMPITPU_NODE_ID is 1-based)
+    nid = int(os.environ["OMPITPU_NODE_ID"])
+    os.environ["OMPITPU_HOST_ID"] = "hostA" if nid <= 2 else "hostB"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_release_tpu as mpi
+    from ompi_release_tpu.mca import pvar, var as mca_var
+    from ompi_release_tpu.runtime.runtime import Runtime
+    from ompi_release_tpu.tuning import retune as _retune
+
+    def _pv(name):
+        p = pvar.PVARS.lookup(name)
+        return float(p.read()) if p is not None else 0.0
+
+    def _agg(name):
+        return pvar.PVARS.lookup(name).read()
+""" % REPO)
+
+
+def _run(tmp_path, capfd, body, n=4, timeout=240, mca=()):
+    app = tmp_path / "app.py"
+    app.write_text(APP_PRELUDE + textwrap.dedent(body))
+    job = Job(n, [sys.executable, str(app)], list(mca),
+              heartbeat_s=0.5, miss_limit=8)
+    rc = job.run(timeout_s=timeout)
+    out = capfd.readouterr()
+    assert rc == 0, out.out + out.err
+    assert job.job_state.visited(JobState.TERMINATED)
+    return out.out
+
+
+class TestRetuneJob:
+    def test_torus_runs_then_retune_flips_it_at_the_next_fire(
+            self, tmp_path, capfd):
+        """A 4-process 2-host job: the fixed decision picks torus2d on
+        the uniform grid (hier_topo_schedule_runs bumps, parity
+        holds); a cvar-applied re-tune (the retuner's apply path, per
+        process) flips the rule to ring — the NEXT fire re-plans and
+        runs ring (no more topo runs), and parity still holds. The
+        job-test leg of the acceptance criterion."""
+        out = _run(tmp_path, capfd, """
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            me = rt.bootstrap["process_index"]
+            n = world.size
+            # past hier_small_message (64 KiB partials), so the fixed
+            # decision leaves the small-message regime: torus2d on
+            # the 2x2 grid
+            x = np.stack([np.arange(32768, dtype=np.int32)
+                          * (off + i + 1) for i in range(2)])
+            want = sum(np.arange(32768, dtype=np.int32) * (r + 1)
+                       for r in range(n))
+            t0 = _pv("hier_topo_schedule_runs")
+            got = np.asarray(world.allreduce(x))
+            np.testing.assert_array_equal(got[0], want)
+            d1 = _pv("hier_topo_schedule_runs") - t0
+            assert d1 >= 1, d1   # the torus family actually engaged
+
+            # cvar-applied re-tune on every process (the cvar plane
+            # is per-process; same rule text everywhere keeps the
+            # selection consistent across ranks)
+            g0 = mca_var.VARS.generation
+            td = tempfile.mkdtemp(prefix="tunedb-")
+            _retune.OnlineRetuner(db_dir=td).apply(
+                "hier_allreduce  0  0  ring\\n", cid=1)
+            assert mca_var.VARS.generation > g0
+
+            t1 = _pv("hier_topo_schedule_runs")
+            got = np.asarray(world.allreduce(x))
+            np.testing.assert_array_equal(got[0], want)
+            # the re-tuned rule took effect AT THE NEXT FIRE: the
+            # ring schedule ran, the torus family did not
+            assert _pv("hier_topo_schedule_runs") == t1
+            world.barrier()
+            print(f"RETUNE-JOB-OK {me}")
+            mpi.finalize()
+        """)
+        for me in range(4):
+            assert f"RETUNE-JOB-OK {me}" in out

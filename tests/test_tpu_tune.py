@@ -116,3 +116,68 @@ class TestHierSweep:
         p = tmp_path / "swept.conf"
         p.write_text(tpu_tune.emit_hier_rules(sweep))
         assert dynamic_rules.load_rules(str(p))["hier_allreduce"]
+
+
+class TestFingerprintStamping:
+    def test_measured_fingerprint_shapes(self):
+        from ompi_release_tpu.tuning import db as tdb
+
+        # no hier sweep: the single-process in-process mesh
+        assert tpu_tune.measured_fingerprint() == tdb.LOCAL
+        # a hier sweep without host grouping: one fake host per proc
+        fp = tpu_tune.measured_fingerprint(4, 0)
+        assert fp == tdb.Fingerprint(1, 4, ("shm",), 4)
+        # grouped into hosts of 2: a spanning shm+dcn layout
+        fp = tpu_tune.measured_fingerprint(4, 2)
+        assert fp == tdb.Fingerprint(2, 2, ("shm", "dcn"), 4)
+        # ragged grouping pins ppn to 0
+        fp = tpu_tune.measured_fingerprint(5, 2)
+        assert fp.procs_per_host == 0 and fp.hosts == 3
+
+    def test_stamped_rules_round_trip_through_the_db(self, tmp_path):
+        """What `tpu-tune --db DIR` does: stamp the emitted text with
+        the measured fingerprint, register it as a versioned entry,
+        and the entry both loads and is selected for that topology."""
+        from ompi_release_tpu.tuning import db as tdb
+
+        fp = tpu_tune.measured_fingerprint(4, 2)
+        text = tdb.stamp("hier_allreduce  0  0  multiring\n", fp)
+        assert text.startswith("# fingerprint: " + fp.canon())
+        path = tdb.TuningDb(str(tmp_path)).register(text, fp)
+        got_fp, version = tdb.read_header(path)
+        assert got_fp == fp and version == 1
+        assert dynamic_rules.load_rules(path)["hier_allreduce"] \
+            == [(0, 0, "multiring", None)]
+        assert tdb.TuningDb(str(tmp_path)).best_match(fp) == path
+
+    def test_hier_sweep_menu_includes_the_topo_family(self):
+        """The --hier-procs sweep times whatever ALGORITHMS lists, so
+        the topology-aware variants are swept (and legal rule names)."""
+        from ompi_release_tpu.coll import hier_schedules as hs
+
+        assert {"multiring", "torus2d"} \
+            <= set(hs.ALGORITHMS["allreduce"])
+        assert "torus2d" in hs.ALGORITHMS["bcast"]
+        assert "torus2d" in hs.ALGORITHMS["allgather"]
+        legal = set(dynamic_rules.RULE_COLLECTIVES["hier_allreduce"])
+        assert {"multiring", "torus2d"} <= legal
+        # the worker app literally iterates ALGORITHMS and the
+        # hosts-per grouping knob reaches it via the env plumbing
+        assert "ALGORITHMS[op]" in tpu_tune._HIER_TUNE_APP
+        assert "OMPITPU_HIER_TUNE_HOSTS_PER" in tpu_tune._HIER_TUNE_APP
+
+    def test_sweep_hier_hosts_per_times_topo_schedules(self, tmp_path):
+        """A real 4-process sweep grouped into fake hosts of 2: the
+        multiring/torus2d schedules run over an actual shm/DCN split
+        and land in the timed menu."""
+        sweep = tpu_tune.sweep_hier(4, ["allreduce"], [262144],
+                                    repeats=1, hosts_per=2)
+        assert sweep is not None and sweep["hosts_per"] == 2
+        rows = sweep["results"]["allreduce"]
+        assert rows, sweep
+        timed = set().union(*(row["times"] for row in rows))
+        assert {"multiring", "torus2d"} <= timed, timed
+        # ...and the torus family ACTUALLY ran: a ragged fake-host
+        # grouping (e.g. the 1-based NODE_ID taken as 0-based) would
+        # degrade torus2d to the flat ring while still "timing" it
+        assert sweep.get("topo_runs", 0) > 0, sweep
