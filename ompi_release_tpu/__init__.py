@@ -27,7 +27,7 @@ __version__ = "0.1.0"
 
 _LAZY = {
     "runtime", "datatype", "ops", "comm", "coll", "p2p", "osc", "shmem",
-    "io", "parallel", "models", "tools", "obs", "testing",
+    "io", "parallel", "models", "tools", "obs", "testing", "service",
 }
 
 

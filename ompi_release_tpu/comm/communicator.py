@@ -162,6 +162,11 @@ class Communicator:
         from ..ft import ulfm as _ulfm_mod
 
         self._ft_epoch0 = _ulfm_mod.state().epoch
+        # multi-tenant QoS class (service plane): children inherit the
+        # parent's stamp so a tenant's whole comm tree rides its lane
+        # class; None defers to the process-wide wire_qos_class cvar
+        self._qos_class: Optional[str] = getattr(parent, "_qos_class",
+                                                 None)
         self.name = name or f"comm{self.cid}"
         self.errhandler: Errhandler = (
             parent.errhandler if parent else ERRORS_ARE_FATAL
@@ -380,6 +385,20 @@ class Communicator:
         v = self._attrs.pop(keyval.id, _MISSING)
         if v is not _MISSING and keyval.delete_fn:
             keyval.delete_fn(self, keyval, v, keyval.extra_state)
+
+    # -- QoS (multi-tenant service plane) ----------------------------------
+    @property
+    def qos_class(self) -> Optional[str]:
+        return self._qos_class
+
+    def set_qos_class(self, cls: Optional[str]) -> None:
+        """Stamp this communicator's QoS class (``wire_qos_classes``
+        lane class + fair-share weight): a tenant job stamps its
+        comms at admission, overriding the process-wide
+        ``wire_qos_class`` cvar for exactly this comm tree (children
+        created afterwards inherit). None reverts to the cvar."""
+        self._check_alive()
+        self._qos_class = str(cls) if cls else None
 
     # -- errors ------------------------------------------------------------
     def set_errhandler(self, handler: Errhandler) -> None:

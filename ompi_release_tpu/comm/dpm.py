@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..utils import output
 from ..utils.errors import ErrorCode, MPIError
@@ -58,17 +58,52 @@ _pending: Dict[str, "_Rendezvous"] = {}
 _names: Dict[str, str] = {}
 
 
-class _Rendezvous:
-    """One port's accept/connect meeting point."""
+class _Party:
+    """One parked accept/connect caller. ``pairing`` is set when the
+    matchmaker pairs it; ``error`` fails it individually (dead-peer
+    fast-fail, close_port, finalize teardown)."""
 
-    def __init__(self, port: str) -> None:
+    __slots__ = ("comm", "side", "pairing", "error")
+
+    def __init__(self, comm: Communicator, side: str) -> None:
+        self.comm = comm
+        self.side = side
+        self.pairing: Optional["_Pairing"] = None
+        self.error: Optional[BaseException] = None
+
+
+class _Pairing:
+    """One matched (acceptor, connector) pair mid-construction. Each
+    pairing carries its OWN result/error — the multi-tenant fix: a
+    port is a meeting point for MANY concurrent pairings, so one slow
+    or failed construction can never serialize or poison another
+    tenant's rendezvous on the same port."""
+
+    __slots__ = ("port", "acceptor", "connector", "result", "error")
+
+    def __init__(self, port: str, acceptor: _Party,
+                 connector: _Party) -> None:
         self.port = port
-        self.acceptor: Optional[Communicator] = None
-        self.connector: Optional[Communicator] = None
-        self.building = False  # one side claimed the construction
+        self.acceptor = acceptor
+        self.connector = connector
         self.result: Optional[Tuple[Intercommunicator,
                                     Intercommunicator]] = None
         self.error: Optional[BaseException] = None
+
+
+class _Rendezvous:
+    """One port's accept/connect meeting point: FIFO queues of parked
+    parties per side. Arrivals pair with the head of the opposite
+    queue (skipping none — a dead parked head fast-fails the arrival,
+    the ULFM contract below); unmatched arrivals park in their own
+    queue, so concurrent connectors from different tenants are each
+    served as soon as an acceptor shows up instead of the second one
+    bouncing off a single occupied slot."""
+
+    def __init__(self, port: str) -> None:
+        self.port = port
+        self.acceptors: List[_Party] = []
+        self.connectors: List[_Party] = []
         # ULFM epoch fencing: the port remembers the job epoch it was
         # opened at; comm_accept rejects joiners carrying a STALE
         # epoch (a connector that formed its plan before a failure
@@ -110,74 +145,78 @@ def _check_disjoint(a: Communicator, b: Communicator) -> None:
                        "connect/accept groups must be disjoint")
 
 
-def _build_intercomm(rv: _Rendezvous, runtime, acceptor: Communicator,
-                     connector: Communicator) -> None:
-    """Construct the mirrored pair OUTSIDE the lock (submesh build +
-    coll selection can be slow — unrelated ports must not stall), then
-    publish result/error under the lock. ``acceptor``/``connector``
-    are snapshots taken under the lock: the parked side may withdraw
-    (timeout) while we build."""
+def _build_intercomm(pr: _Pairing, runtime) -> None:
+    """Construct one pairing's mirrored pair OUTSIDE the lock
+    (submesh build + coll selection can be slow — OTHER pairings on
+    the same port, and unrelated ports, must not stall), then publish
+    result/error on the pairing under the lock."""
     try:
         pair = Intercommunicator.create(
-            runtime, acceptor.group, connector.group,
-            name=f"accept({rv.port})",
+            runtime, pr.acceptor.comm.group, pr.connector.comm.group,
+            name=f"accept({pr.port})",
         )
     except BaseException as exc:
         with _lock:
-            rv.error = exc
-            rv.acceptor = None
-            rv.connector = None
+            pr.error = exc
             _lock.notify_all()
-        raise
+        return
     with _lock:
-        rv.result = pair
+        pr.result = pair
         _lock.notify_all()
 
 
-def _await_result(rv: _Rendezvous, deadline: float, side: str):
-    """Wait under the lock for result/error; caller holds _lock.
+def _withdraw(rv: _Rendezvous, me: _Party) -> None:
+    """Remove a parked party from its queue (timeout path). Caller
+    holds _lock."""
+    q = rv.acceptors if me.side == "accept" else rv.connectors
+    try:
+        q.remove(me)
+    except ValueError:
+        pass  # already matched or evicted
+
+
+def _await_party(rv: _Rendezvous, me: _Party, deadline: float):
+    """Wait under the lock until this party's pairing completes.
     Parks in bounded slices so a counterpart communicator revoked (or
-    its process failed) MID-WAIT surfaces as the typed ULFM error
-    within one slice instead of silently burning the deadline."""
+    its process failed) MID-BUILD surfaces as the typed ULFM error
+    within one slice instead of silently burning the deadline; the
+    timeout of an UNMATCHED party withdraws only itself — other
+    parties parked on the port are untouched. Caller holds _lock."""
     import time
 
-    while rv.result is None and rv.error is None:
-        other = rv.connector if side == "accept" else rv.acceptor
-        try:
-            _check_counterpart(other, rv.port, side)
-        except MPIError as err:
-            if side == "accept":
-                rv.acceptor = None
-            else:
-                rv.connector = None
-            rv.error = err
-            _reset_slot(rv)
-            _lock.notify_all()
-            raise
+    while True:
+        if me.error is not None:
+            raise me.error
+        pr = me.pairing
+        if pr is not None:
+            if pr.error is not None:
+                raise pr.error
+            if pr.result is not None:
+                server_side, client_side = pr.result
+                return (server_side if me.side == "accept"
+                        else client_side)
+            other = (pr.connector if me.side == "accept"
+                     else pr.acceptor).comm
+            try:
+                _check_counterpart(other, rv.port, me.side)
+            except MPIError as err:
+                pr.error = err
+                _lock.notify_all()
+                raise
         left = deadline - time.monotonic()
-        if left <= 0 or (not _lock.wait(timeout=min(left, 0.2))
-                         and deadline - time.monotonic() <= 0):
-            if rv.result is not None or rv.error is not None:
-                break
-            # the rendezvous is DEAD, not just this side: poison the
-            # slot and retire the port, else a build completing after
-            # our withdrawal would publish a result carrying OUR group
-            # into a later retry with a different communicator
-            if side == "accept":
-                rv.acceptor = None
-            else:
-                rv.connector = None
+        if left <= 0:
             err = MPIError(ErrorCode.ERR_PORT,
-                           f"{side} on '{rv.port}' timed out")
-            rv.error = err
-            _reset_slot(rv)  # port stays valid for later attempts
+                           f"{me.side} on '{rv.port}' timed out")
+            if pr is None:
+                _withdraw(rv, me)
+            else:
+                # matched but the build never finished: poison THIS
+                # pairing (its counterpart must not inherit a result
+                # built against a withdrawn group), not the port
+                pr.error = err
             _lock.notify_all()
             raise err
-    if rv.error is not None:
-        err = rv.error
-        _reset_slot(rv)
-        raise err
-    return rv.result
+        _lock.wait(timeout=min(left, 0.2))
 
 
 def open_port() -> str:
@@ -189,8 +228,20 @@ def open_port() -> str:
 
 
 def close_port(port: str) -> None:
+    """``MPI_Close_port``: retire the port and fail every parked
+    party promptly (they must not sleep out their deadlines against a
+    port that can never pair them)."""
     with _lock:
-        _pending.pop(port, None)
+        rv = _pending.pop(port, None)
+        if rv is not None:
+            err = MPIError(ErrorCode.ERR_PORT,
+                           f"port '{port}' closed")
+            for party in rv.acceptors + rv.connectors:
+                if party.error is None and party.pairing is None:
+                    party.error = err
+            rv.acceptors.clear()
+            rv.connectors.clear()
+            _lock.notify_all()
 
 
 def _job_agent():
@@ -268,39 +319,32 @@ def lookup_name(service: str, *, timeout_s: float = 10.0) -> str:
                     "port without unpublishing)",
                 )
             return port  # opaque non-port payload: hand it through
-        _check_counterpart(rv.acceptor, port, f"lookup '{service}'")
+        _check_counterpart(rv.acceptors[0].comm if rv.acceptors
+                           else None, port, f"lookup '{service}'")
         return port
-
-
-def _reset_slot(rv: _Rendezvous) -> None:
-    """Replace a consumed/dead rendezvous with a fresh slot so the
-    PORT stays valid (MPI keeps a port open until MPI_Close_port — a
-    server loops accept on one published port). Only replaces if the
-    port still maps to ``rv`` (close_port may have retired it)."""
-    if _pending.get(rv.port) is rv:
-        _pending[rv.port] = _Rendezvous(rv.port)
 
 
 def _rendezvous(comm: Communicator, port: str, side: str,
                 timeout_s: float,
                 epoch: Optional[int] = None) -> Intercommunicator:
-    """The shared accept/connect protocol; ``side`` picks which slot
-    this caller fills and which handle of the pair it receives.
-    ``epoch`` is the epoch the connector's PLAN was formed at
-    (default: the connecting communicator's birth epoch): a joiner
-    whose plan predates the port's world view — the port was opened
-    after a failure the connector's comm has never heard of — is
-    rejected immediately and must re-learn the world before pairing
-    (the comm_accept stale-epoch fence)."""
+    """The shared accept/connect protocol; ``side`` picks which queue
+    this caller parks in and which handle of the pair it receives.
+    Arrivals pair FIFO with the opposite queue's head, each pairing
+    built and completed independently — concurrent connectors from
+    different tenants are served concurrently, never serialized
+    behind (or bounced off) one parked rendezvous slot. ``epoch`` is
+    the epoch the connector's PLAN was formed at (default: the
+    connecting communicator's birth epoch): a joiner whose plan
+    predates the port's world view — the port was opened after a
+    failure the connector's comm has never heard of — is rejected
+    immediately and must re-learn the world before pairing (the
+    comm_accept stale-epoch fence)."""
     import time
 
-    mine, theirs = (
-        ("acceptor", "connector") if side == "accept"
-        else ("connector", "acceptor")
-    )
     if epoch is None:
         epoch = getattr(comm, "_ft_epoch0", 0)
     deadline = time.monotonic() + timeout_s
+    me = _Party(comm, side)
     with _lock:
         rv = _pending.get(port)
         if rv is None:
@@ -313,29 +357,39 @@ def _rendezvous(comm: Communicator, port: str, side: str,
                 "communicator against the current failure picture "
                 "and retry",
             )
-        if getattr(rv, mine) is not None:
-            raise MPIError(ErrorCode.ERR_PORT,
-                           f"port '{port}' already has an {mine}")
-        other = getattr(rv, theirs)
-        # fast-fail on a DEAD rendezvous before registering: a parked
-        # peer whose comm was revoked / whose process failed means
-        # this pairing can never complete — return the error class
-        # now instead of burning the caller's whole timeout
-        _check_counterpart(other, port, side)
-        if other is not None:
-            _check_disjoint(comm, other)  # before registering
-        setattr(rv, mine, comm)
+        theirs = rv.connectors if side == "accept" else rv.acceptors
+        pairing = None
+        if theirs:
+            cand = theirs[0]
+            # fast-fail on a DEAD parked head before pairing: a peer
+            # whose comm was revoked / whose process failed can never
+            # complete a pairing — return the error class NOW instead
+            # of burning the caller's whole timeout, and retire the
+            # corpse with the same error so its own wait wakes typed
+            try:
+                _check_counterpart(cand.comm, port, side)
+            except MPIError as err:
+                theirs.pop(0)
+                cand.error = err
+                _lock.notify_all()
+                raise
+            _check_disjoint(comm, cand.comm)  # before dequeuing
+            theirs.pop(0)
+            if side == "accept":
+                pairing = _Pairing(port, me, cand)
+            else:
+                pairing = _Pairing(port, cand, me)
+            me.pairing = cand.pairing = pairing
+        else:
+            (rv.acceptors if side == "accept"
+             else rv.connectors).append(me)
         _lock.notify_all()
-        build = other is not None and not rv.building
-        if build:
-            rv.building = True
-            acceptor, connector = rv.acceptor, rv.connector
-    if build:
-        _build_intercomm(rv, comm.runtime, acceptor, connector)
+    if pairing is not None:
+        # the matchmaker builds its own pairing outside the lock;
+        # other pairings on this port build in their own callers
+        _build_intercomm(pairing, comm.runtime)
     with _lock:
-        server_side, client_side = _await_result(rv, deadline, side)
-        _reset_slot(rv)  # port stays valid for the next accept
-        return server_side if side == "accept" else client_side
+        return _await_party(rv, me, deadline)
 
 
 def comm_accept(comm: Communicator, port: str, *,
@@ -369,8 +423,9 @@ def clear() -> None:
     with _lock:
         err = MPIError(ErrorCode.ERR_PORT, "dpm torn down (finalize)")
         for rv in _pending.values():
-            if rv.result is None and rv.error is None:
-                rv.error = err
+            for party in rv.acceptors + rv.connectors:
+                if party.error is None:
+                    party.error = err
         _pending.clear()
         _names.clear()
         _lock.notify_all()

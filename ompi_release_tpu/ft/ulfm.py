@@ -64,7 +64,53 @@ revokes = pvar.counter(
 FT_CID_BASE = 1 << 19
 
 
-def ft_cid(epoch: int, parent_cid: int) -> int:
+#: multi-tenant cid banding (the service plane, ROADMAP item 2):
+#: tenant t's communicators draw cids from the band
+#: [TENANT_CID_BASE + t*TENANT_CID_SLOT, +TENANT_CID_SLOT), which sits
+#: directly below the FT band and above every per-process counter a
+#: realistic job reaches — so revoking ONE tenant's comms is a range
+#: operation that can never touch another tenant or the daemon's own
+#: communicators. Each 4096-cid slot is split in half: app comms use
+#: the lower 2048 ids, shrink/rebuild comms (:func:`ft_cid` with a
+#: tenant) the upper 2048 (8 epochs x 256 parent slots — the PR 9
+#: wrap-eviction discipline, scoped per tenant).
+TENANT_CID_BASE = 1 << 18
+TENANT_CID_SLOT = 4096
+MAX_TENANTS = (FT_CID_BASE - TENANT_CID_BASE) // TENANT_CID_SLOT  # 64
+_TENANT_APP_SLOTS = TENANT_CID_SLOT // 2
+
+
+def tenant_band(tenant: int) -> tuple:
+    """``[lo, hi)`` cid range owned by ``tenant`` — THE range every
+    band-scoped operation (revoke, sentinel clear, sampler scoping)
+    keys on."""
+    t = int(tenant)
+    if not 0 <= t < MAX_TENANTS:
+        raise MPIError(ErrorCode.ERR_ARG,
+                       f"tenant id {t} outside [0, {MAX_TENANTS})")
+    lo = TENANT_CID_BASE + t * TENANT_CID_SLOT
+    return lo, lo + TENANT_CID_SLOT
+
+
+def tenant_cid(tenant: int, k: int) -> int:
+    """The ``k``-th application cid of ``tenant``'s band (the lower
+    half of the slot; rebuild cids live in the upper half via
+    :func:`ft_cid`)."""
+    lo, _hi = tenant_band(tenant)
+    return lo + int(k) % _TENANT_APP_SLOTS
+
+
+def tenant_of_cid(cid: int) -> int:
+    """Which tenant's band ``cid`` falls in, or -1 for every cid
+    outside the tenant band (process-wide comms, the FT band, internal
+    negative cids) — pure math, safe on any hot path."""
+    c = int(cid)
+    if TENANT_CID_BASE <= c < FT_CID_BASE:
+        return (c - TENANT_CID_BASE) // TENANT_CID_SLOT
+    return -1
+
+
+def ft_cid(epoch: int, parent_cid: int, tenant: int = -1) -> int:
     """Deterministic cid for a shrink/rebuild communicator: derived
     from the agreed epoch plus the parent comm's (SPMD-agreed) cid, so
     no process-local counter is involved. The FT band (1<<19 ids) is
@@ -73,7 +119,23 @@ def ft_cid(epoch: int, parent_cid: int) -> int:
     epoch (16384 slots cover any realistic comm count), while the
     epoch wraps — a wrap collision can only hit the same parent 32
     recovery epochs later, where the occupant is that lineage's old
-    REVOKED comm, which Communicator evicts on explicit-cid rebuild."""
+    REVOKED comm, which Communicator evicts on explicit-cid rebuild.
+
+    ``tenant >= 0`` scopes the rebuild to that tenant's cid band (the
+    upper half of its slot, 8 epochs x 256 parent slots): a tenant's
+    recovered comms stay inside its band, so the tenant-wide revoke
+    sweep covers rebuilds too and two tenants recovering at the same
+    epoch can never collide."""
+    if tenant >= 0:
+        lo, hi = tenant_band(tenant)
+        cid = (lo + _TENANT_APP_SLOTS + (int(epoch) % 8) * 256
+               + (abs(int(parent_cid)) % 256))
+        if cid >= hi:  # pragma: no cover - arithmetic bound
+            raise MPIError(
+                ErrorCode.ERR_INTERN,
+                f"tenant ft cid {cid} escapes band [{lo}, {hi})",
+            )
+        return cid
     cid = (FT_CID_BASE + (int(epoch) % 32) * 16384
            + (abs(int(parent_cid)) % 16384))
     if cid >= (1 << 20):
@@ -120,6 +182,12 @@ class FtState:
         #: could miss entirely.
         self.failed_at: Dict[int, int] = {}
         self.revoked: Dict[int, int] = {}  # cid -> epoch at revoke
+        #: (lo, hi) -> epoch: whole revoked cid BANDS (a tenant's
+        #: eviction poisons its entire range, including cids not yet
+        #: minted — a dead tenant's future rebuild attempt must fail
+        #: typed, not silently reuse the namespace). Empty for every
+        #: single-job process: one falsy-dict check on the hot path.
+        self.revoked_bands: Dict[tuple, int] = {}
         self._listeners: List[Callable[[dict], None]] = []
 
     # -- notices (coordinator -> worker) -----------------------------------
@@ -229,7 +297,62 @@ class FtState:
         return first
 
     def is_revoked(self, cid: int) -> bool:
-        return cid in self.revoked
+        return (cid in self.revoked
+                or (bool(self.revoked_bands)
+                    and self._band_of(cid) is not None))
+
+    def _band_of(self, cid: int):
+        for band in self.revoked_bands:
+            if band[0] <= cid < band[1]:
+                return band
+        return None
+
+    # -- tenant-band revocation (service plane) ----------------------------
+    def revoke_band(self, lo: int, hi: int, epoch: int = -1) -> int:
+        """Poison every cid in ``[lo, hi)`` — the tenant-eviction
+        sweep: live communicators in the band are revoked through the
+        normal :meth:`apply_revoke` path (queued schedules fail,
+        mirror flags set), and the band itself is recorded so any
+        FUTURE cid a dead tenant's straggler mints in the range fails
+        typed at its first bounded wait. Returns the number of LIVE
+        communicators revoked. Idempotent."""
+        with self._lock:
+            first = (lo, hi) not in self.revoked_bands
+            if first:
+                self.revoked_bands[(lo, hi)] = (
+                    epoch if epoch >= 0 else self.epoch)
+        n = 0
+        try:
+            from ..comm.communicator import _comm_registry
+
+            live = [c for c in list(_comm_registry)
+                    if lo <= c < hi]
+        except Exception:
+            live = []
+        for cid in live:
+            if self.apply_revoke(cid, epoch):
+                n += 1
+        if first:
+            _log.verbose(1, f"cid band [{lo}, {hi}) revoked "
+                            f"({n} live comm(s))")
+            if _obs.enabled:
+                # one band-level incident event (per-cid revokes
+                # journal themselves through apply_revoke)
+                _obs.record("ft_revoke_band", "ft",
+                            _time.perf_counter(), 0.0,
+                            peer=(epoch if epoch >= 0 else self.epoch),
+                            comm_id=lo, nbytes=hi - lo)
+        return n
+
+    def clear_band(self, lo: int, hi: int) -> None:
+        """Forget a band's revocation record plus every per-cid record
+        inside it — the tenant-slot reuse path (a freed tenant id
+        re-admitted later must start with a clean namespace, exactly
+        like the explicit-cid rebuild's ``clear_revoked``)."""
+        with self._lock:
+            self.revoked_bands.pop((lo, hi), None)
+            for cid in [c for c in self.revoked if lo <= c < hi]:
+                self.revoked.pop(cid, None)
 
     def clear_revoked(self, cid: int) -> None:
         """Forget a cid's revocation record — the rebuild path's
@@ -262,6 +385,12 @@ class FtState:
                 ErrorCode.ERR_REVOKED,
                 f"{what} interrupted: communicator cid {cid} revoked",
             )
+        if self.revoked_bands and self._band_of(cid) is not None:
+            raise MPIError(
+                ErrorCode.ERR_REVOKED,
+                f"{what} interrupted: cid {cid} falls in a revoked "
+                f"tenant band (tenant {tenant_of_cid(cid)} evicted)",
+            )
         dead = self.dead_for(peers, epoch0)
         if dead:
             raise MPIError(
@@ -287,6 +416,8 @@ class FtState:
                 "restarted": sorted(self.restarted),
                 "rejoined": sorted(self.rejoined),
                 "revoked_cids": sorted(self.revoked),
+                "revoked_bands": sorted(list(b)
+                                        for b in self.revoked_bands),
                 "failed_at": dict(self.failed_at),
             }
 
@@ -300,6 +431,7 @@ class FtState:
             self._ever_failed.clear()
             self.failed_at.clear()
             self.revoked.clear()
+            self.revoked_bands.clear()
             self._listeners.clear()
 
 

@@ -186,10 +186,18 @@ class SeriesRing:
         with self._lock:
             return self._next_i
 
-    def append(self, t: float, cid: int, name: str, value: Any) -> None:
+    def append(self, t: float, cid: int, name: str, value: Any,
+               tenant: int = -1) -> None:
         with self._lock:
-            self._buf.append({"i": self._next_i, "t": t, "cid": cid,
-                              "name": name, "v": value})
+            pt = {"i": self._next_i, "t": t, "cid": cid,
+                  "name": name, "v": value}
+            if tenant >= 0:
+                # the multi-tenant dimension (service plane): points
+                # whose cid falls in a tenant band carry the tenant
+                # id, so fleet/daemon consumers can aggregate "who is
+                # burning the fabric" without re-deriving band math
+                pt["tenant"] = tenant
+            self._buf.append(pt)
             self._next_i += 1
 
     def snapshot(self) -> List[Dict[str, Any]]:
@@ -277,10 +285,13 @@ class Sampler:
             acc[1] += float(s.nbytes)
             acc[2] += float(s.dt)
         self._last_seq = _obs.journal.total_recorded
+        if by_cid:
+            from ..ft.ulfm import tenant_of_cid  # import-light
         for cid, (ops, nbytes, secs) in sorted(by_cid.items()):
-            self.ring.append(t0, cid, "coll_ops", ops)
-            self.ring.append(t0, cid, "coll_bytes", nbytes)
-            self.ring.append(t0, cid, "coll_seconds", secs)
+            tid = tenant_of_cid(cid)
+            self.ring.append(t0, cid, "coll_ops", ops, tenant=tid)
+            self.ring.append(t0, cid, "coll_bytes", nbytes, tenant=tid)
+            self.ring.append(t0, cid, "coll_seconds", secs, tenant=tid)
             n += 3
         dt = time.perf_counter() - t0
         _ticks.add(1)

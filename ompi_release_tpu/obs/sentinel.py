@@ -455,6 +455,19 @@ def clear_chain(cid: int) -> None:
         _chains.pop(cid, None)
 
 
+def clear_band(lo: int, hi: int) -> None:
+    """Drop every chain with ``lo <= cid < hi`` — the tenant-eviction
+    / tenant-slot-reuse sweep (service plane): a dead tenant's
+    leftover posting seqs must not false-mismatch the NEXT tenant
+    admitted into the same cid band. Cheap when the sentinel never
+    ran (one falsy dict check, no lock)."""
+    if not _chains:
+        return
+    with _lock:
+        for cid in [c for c in _chains if lo <= c < hi]:
+            _chains.pop(cid, None)
+
+
 def chain_of(cid: int) -> int:
     """Current rolling chain value for ``cid`` (0 = no calls seen)."""
     with _lock:

@@ -366,6 +366,16 @@ class HnpCoordinator:
                 self._ft_failed_at[failed_nid] = self._ft_epoch
             live = [n for n in self._worker_ids
                     if n not in self._failed and n not in self._finished]
+        if failed_nid is not None:
+            # lifeline loss evicts the dead worker's published names:
+            # a stale name must never be looked up by a later joiner
+            # (the pubsub owner/TTL hygiene rule)
+            tbl = getattr(self, "_ns_table", None)
+            if tbl is not None:
+                try:
+                    tbl.evict_owner(failed_nid)
+                except Exception:
+                    pass  # name hygiene must not block the FT notice
         doc = self._ft_doc()
         payload = json.dumps(doc).encode()
         for nid in live:

@@ -30,14 +30,39 @@ TAG_UNPUBLISH = 12    # client->server: unpublish service name
 SERVE_TAGS = (TAG_PUBLISH, TAG_LOOKUP, TAG_UNPUBLISH)
 
 
+class PubEntry:
+    """One published name: value + OWNER identity (the publishing
+    client's node id — the handle evictions key on) + optional
+    expiry. Owner/TTL are the multi-tenant hygiene additions: a dead
+    tenant's stale names must never be looked up by the next tenant,
+    so entries die with their owner's lifeline/lease or with their
+    TTL, whichever comes first."""
+
+    __slots__ = ("value", "owner", "expire_at")
+
+    def __init__(self, value: str, owner: Optional[int] = None,
+                 expire_at: Optional[float] = None) -> None:
+        self.value = value
+        self.owner = owner
+        self.expire_at = expire_at
+
+    def expired(self, now: float) -> bool:
+        return self.expire_at is not None and now >= self.expire_at
+
+
 class PubsubTable:
     """Server-side name table + parked lookups (pubsub_orte core)."""
 
     def __init__(self, ep) -> None:
         self.ep = ep
-        self.names: Dict[str, str] = {}
+        self.names: Dict[str, PubEntry] = {}
         # service -> [(client_id, seq, expire_at)]
         self.waiters: Dict[str, List[Tuple[int, int, float]]] = {}
+        #: guards names/waiters: the serve thread owns almost every
+        #: access, but ``evict_owner`` is called cross-thread (the
+        #: HNP's FT path on worker lifeline loss, a daemon eviction
+        #: listener) and must not race prune()/handle() mid-mutation
+        self._table_lock = threading.RLock()
         # per-instance so subclasses can serve extra RPCs (the
         # tpu_server metrics page) without widening every host
         self.serve_tags: List[int] = list(SERVE_TAGS)
@@ -55,41 +80,107 @@ class PubsubTable:
     def prune(self) -> None:
         """Drop parked lookups whose client gave up (the lookup frame
         carries the client's deadline, so abandoned waiters cannot
-        accumulate)."""
+        accumulate) AND published entries past their TTL — prune runs
+        every serve iteration, so expiry is enforced continuously,
+        not only at the next lookup."""
         now = time.monotonic()
-        for service in list(self.waiters):
-            alive = [w for w in self.waiters[service] if w[2] > now]
-            if alive:
-                self.waiters[service] = alive
-            else:
-                del self.waiters[service]
+        with self._table_lock:
+            for service in list(self.waiters):
+                alive = [w for w in self.waiters[service]
+                         if w[2] > now]
+                if alive:
+                    self.waiters[service] = alive
+                else:
+                    del self.waiters[service]
+            for service in [s for s, e in self.names.items()
+                            if e.expired(now)]:
+                del self.names[service]
+                _log.verbose(1, f"pruned expired name '{service}'")
+
+    def evict_owner(self, owner: int) -> List[str]:
+        """Drop every name published by ``owner`` — the lifeline-loss
+        / lease-expiry hook (HNP worker death, daemon tenant
+        eviction). Returns the evicted service names. Parked waiters
+        on those names stay parked: their own TTLs bound them, and a
+        re-publish by a live owner still unparks them."""
+        with self._table_lock:
+            gone = [s for s, e in self.names.items()
+                    if e.owner == owner]
+            for service in gone:
+                del self.names[service]
+        if gone:
+            _log.verbose(1, f"evicted {len(gone)} name(s) of dead "
+                            f"owner {owner}: {gone}")
+        return gone
+
+    def publish_local(self, service: str, value: str,
+                      owner: Optional[int] = None,
+                      ttl_s: Optional[float] = None) -> bool:
+        """Server-side publish (the daemon's own entries). False on a
+        live duplicate."""
+        now = time.monotonic()
+        with self._table_lock:
+            existing = self.names.get(service)
+            if existing is not None and not existing.expired(now):
+                return False
+            self.names[service] = PubEntry(
+                value, owner,
+                now + float(ttl_s) if ttl_s is not None else None)
+            unpark = self.waiters.pop(service, [])
+        for wnid, wseq, _exp in unpark:
+            self._reply(wnid, wseq, True, value)
+        return True
 
     def handle(self, tag: int, src: int, raw: bytes) -> None:
         b = DssBuffer(raw)
         (seq,) = b.unpack_int64()
         service = b.unpack_string()
+        now = time.monotonic()
         if tag == TAG_PUBLISH:
             port = b.unpack_string()
-            if service in self.names:
-                self._reply(src, seq, False, "already published")
-                return
-            self.names[service] = port
+            # optional trailing TTL field (newer clients); absence —
+            # an exhausted buffer — is the legacy no-TTL publish
+            ttl_s = None
+            try:
+                ttl_ms = int(b.unpack_string())
+                if ttl_ms > 0:
+                    ttl_s = ttl_ms / 1000
+            except (MPIError, ValueError):
+                pass
+            with self._table_lock:
+                existing = self.names.get(service)
+                if existing is not None and not existing.expired(now):
+                    self._reply(src, seq, False, "already published")
+                    return
+                # the publisher's node id IS the owner identity:
+                # evictions (owner lifeline loss, tenant lease
+                # expiry) key on it
+                self.names[service] = PubEntry(
+                    port, src,
+                    now + ttl_s if ttl_s is not None else None)
+                unpark = self.waiters.pop(service, [])
             self._reply(src, seq, True, port)
-            for wnid, wseq, _exp in self.waiters.pop(service, []):
+            for wnid, wseq, _exp in unpark:
                 self._reply(wnid, wseq, True, port)
         elif tag == TAG_UNPUBLISH:
-            ok = self.names.pop(service, None) is not None
+            with self._table_lock:
+                ok = self.names.pop(service, None) is not None
             self._reply(src, seq, ok, service)
         else:  # TAG_LOOKUP
             ttl_ms = int(b.unpack_string())
-            port = self.names.get(service)
-            if port is not None:
-                self._reply(src, seq, True, port)
-            else:
-                expire = time.monotonic() + ttl_ms / 1000
-                self.waiters.setdefault(service, []).append(
-                    (src, seq, expire)
-                )
+            with self._table_lock:
+                entry = self.names.get(service)
+                if entry is not None and entry.expired(now):
+                    # lazy expiry backstop
+                    self.names.pop(service, None)
+                    entry = None
+                if entry is None:
+                    expire = time.monotonic() + ttl_ms / 1000
+                    self.waiters.setdefault(service, []).append(
+                        (src, seq, expire)
+                    )
+            if entry is not None:
+                self._reply(src, seq, True, entry.value)
 
     def serve_once(self, timeout_ms: int = 50) -> None:
         """One serve iteration: prune, then drain one frame per tag.

@@ -161,6 +161,12 @@ def register_vars() -> None:
         "tokens). Compiled-schedule waits and chaos tests tune this; "
         "explicit per-call timeouts still win",
     )
+    # wire_qos_classes / wire_qos_class (the multi-tenant service
+    # plane's lane classes + weighted-fair fragment scheduling) are
+    # registered by service.qos — import-light, no jax
+    from ..service import qos as _qos_vars
+
+    _qos_vars.register_vars()
 
 
 register_vars()  # idempotent; cvars must exist before the first router
@@ -177,7 +183,8 @@ class WireTuning:
     (and, for frozen schedule plans, at the next PLAN, which captures
     the snapshot at freeze time — never mid-schedule)."""
 
-    __slots__ = ("gen", "lanes", "depth", "segsize", "coll_timeout_ms")
+    __slots__ = ("gen", "lanes", "depth", "segsize", "coll_timeout_ms",
+                 "qos_ranges", "qos_class", "arbiter")
 
     def __init__(self) -> None:
         self.gen = mca_var.VARS.generation
@@ -189,6 +196,20 @@ class WireTuning:
         self.segsize = int(mca_var.get("wire_pipeline_segsize", 0) or 0)
         self.coll_timeout_ms = int(
             mca_var.get("wire_coll_timeout_ms", 60_000) or 60_000)
+        # multi-tenant QoS (service plane): with wire_qos_classes
+        # unset every field is None and no hot path changes — the
+        # zero-config wire is the PR 3 wire
+        spec = str(mca_var.get("wire_qos_classes", "") or "")
+        self.qos_class = str(mca_var.get("wire_qos_class", "") or "")
+        if spec:
+            from ..service import qos as _qos
+
+            self.qos_ranges = _qos.lane_ranges(_qos.parse_classes(spec),
+                                               self.lanes)
+            self.arbiter = _qos.arbiter_for(spec)
+        else:
+            self.qos_ranges = None
+            self.arbiter = None
 
 
 class ProcTopology:
@@ -337,11 +358,30 @@ class WireRouter:
         return self._shm if same_host else self._dcn
 
     # -- lanes -------------------------------------------------------------
-    def _lane_of(self, user_tag: int) -> int:
+    @staticmethod
+    def _class_of(comm, t: WireTuning) -> Optional[str]:
+        """The sender's QoS class for ``comm`` under tuning snapshot
+        ``t``: the comm's stamped class (tenant comms) wins over the
+        process-wide ``wire_qos_class`` cvar; None when QoS is off."""
+        if t.qos_ranges is None:
+            return None
+        return getattr(comm, "_qos_class", None) or t.qos_class
+
+    def _lane_of(self, user_tag: int, comm=None) -> int:
         """THE lane-selection rule (single definition — send and any
         future drain/debug site must agree), reading the
-        generation-cached ``tuning()`` snapshot, never the registry."""
-        return int(user_tag) % self.tuning().lanes
+        generation-cached ``tuning()`` snapshot, never the registry.
+        Under ``wire_qos_classes`` the comm's class selects its lane
+        sub-range, so one class's transfers never queue behind
+        another's channel lock; unknown/empty classes (and QoS off)
+        ride the legacy full range."""
+        t = self.tuning()
+        if t.qos_ranges is not None:
+            rng = t.qos_ranges.get(self._class_of(comm, t))
+            if rng is not None:
+                start, count = rng
+                return start + int(user_tag) % count
+        return int(user_tag) % t.lanes
 
     @staticmethod
     def _p2p_tag(dst_world: int, lane: int) -> int:
@@ -424,7 +464,7 @@ class WireRouter:
         _ft().check_wait(comm.cid, (peer,), "p2p send",
                          epoch0=getattr(comm, "_ft_epoch0", 0))
         seq = next(self._seq)
-        lane = self._lane_of(user_tag)
+        lane = self._lane_of(user_tag, comm)
         tag = self._p2p_tag(dst_world, lane)
         arr = np.asarray(data)
         rec = _obs.enabled  # capture once: flag may flip mid-send
@@ -864,11 +904,12 @@ class WireRouter:
         side starts reassembling while the round is still being sent,
         instead of peer P+1 waiting for peer P's full payload."""
         tag = self._coll_tag(comm)
-        depth = self.tuning().depth
+        t = self.tuning()
         epoch0 = getattr(comm, "_ft_epoch0", 0)
         streams = [self._peer_frames(p, tag, arrs_for[p], epoch0)
                    for p in sorted(arrs_for) if arrs_for[p]]
-        self._stripe(streams, depth)
+        self._stripe(streams, t.depth, arbiter=t.arbiter,
+                     cls=self._class_of(comm, t))
 
     def coll_send_planned(self, comm, rnd, sends: Dict[int, List]) -> None:
         """Steady-state round send from a frozen schedule plan
@@ -887,25 +928,41 @@ class WireRouter:
                               templates=tpls)
             for p, tpls in rnd.peer_slots
         ]
-        self._stripe(streams, rnd.depth)
+        t = self.tuning()
+        self._stripe(streams, rnd.depth, arbiter=t.arbiter,
+                     cls=self._class_of(comm, t))
 
     @staticmethod
-    def _stripe(streams: List, depth: int) -> None:
+    def _stripe(streams: List, depth: int, arbiter=None,
+                cls: Optional[str] = None) -> None:
         """Round-robin the per-peer frame generators in depth-sized
-        bursts (the sliding in-flight window)."""
-        while streams:
-            keep = []
-            for it in streams:
-                alive = True
-                for _ in range(depth):
-                    try:
-                        next(it)
-                    except StopIteration:
-                        alive = False
-                        break
-                if alive:
-                    keep.append(it)
-            streams = keep
+        bursts (the sliding in-flight window). With a QoS ``arbiter``
+        (``wire_qos_classes`` set) every burst first passes the
+        weighted-fair gate for this sender's class, so a bulk
+        tenant's long fragment streams yield to a latency tenant's
+        bursts at the class weight ratio instead of FIFO-hogging the
+        endpoint."""
+        if arbiter is not None:
+            arbiter.enter(cls)
+        try:
+            while streams:
+                keep = []
+                for it in streams:
+                    if arbiter is not None:
+                        arbiter.gate(cls, cost=depth)
+                    alive = True
+                    for _ in range(depth):
+                        try:
+                            next(it)
+                        except StopIteration:
+                            alive = False
+                            break
+                    if alive:
+                        keep.append(it)
+                streams = keep
+        finally:
+            if arbiter is not None:
+                arbiter.leave(cls)
 
     def coll_recv_any(self, comm, pending: Dict[int, int],
                       timeout_ms: Optional[int] = None):

@@ -156,6 +156,7 @@ class Fabric:
         self.rto_s = float(rto_s)
         self._overrides: Dict[Tuple[int, int], LinkSpec] = {}
         self._nic: Dict[int, float] = {}
+        self._bw_share: Dict[int, float] = {}
         #: (ranks_a, ranks_b, t0, t1-or-None) partition windows
         self._partitions: List[Tuple[frozenset, frozenset,
                                      float, Optional[float]]] = []
@@ -182,6 +183,16 @@ class Fabric:
         ``factor``x the latency and 1/``factor`` the bandwidth."""
         self._nic[p] = float(factor)
 
+    def bandwidth_share(self, p: int, share: float) -> None:
+        """QoS contention model: rank ``p``'s sends see ``share`` of
+        the link bandwidth (latency untouched). This is how the
+        multi-tenant scenarios model a saturated shared wire under
+        the weighted-fair arbiter: each class's ranks get exactly
+        their fair-share fraction (``service.qos.fair_share``) of
+        every link they send on — deterministic, so virtual clocks
+        stay replayable."""
+        self._bw_share[p] = max(1e-6, float(share))
+
     def partition(self, ranks_a, ranks_b, t0: float,
                   t1: Optional[float] = None) -> None:
         """Sever the (a <-> b) links for sends departing in
@@ -200,7 +211,9 @@ class Fabric:
             spec = self.intra if not self.crosses_host(s, d) else \
                 self.inter
         f = self._nic.get(s, 1.0) * self._nic.get(d, 1.0)
-        return (spec.latency_s * f, spec.bytes_per_s / f, spec.loss)
+        share = self._bw_share.get(s, 1.0)
+        return (spec.latency_s * f, spec.bytes_per_s / f * share,
+                spec.loss)
 
     def delivery(self, s: int, d: int, nbytes: int, t_send: float,
                  k: int) -> Tuple[Optional[float], int]:
@@ -635,7 +648,7 @@ class FleetSim:
 
     # -- running -----------------------------------------------------------
     def run(self, fn: Callable, *, ranks: Optional[Sequence[int]] = None,
-            cid: int = 1, epoch0: int = 0, label: Optional[str] = None,
+            cid=1, epoch0: int = 0, label: Optional[str] = None,
             sig=None, timeout_s: Optional[float] = None) -> RunReport:
         """Run ``fn(xchg, p)`` on every participating rank (one thread
         each) and return the per-run :class:`RunReport`.
@@ -646,40 +659,48 @@ class FleetSim:
         ``label`` journals one coll-layer span per completing rank
         (skew-report food). Queues are scoped by ``cid``: recovery
         reruns on a fresh cid never see a chaotic run's orphans.
+
+        ``cid`` may be a callable ``cid(p) -> int`` — the multi-tenant
+        shape: disjoint tenant rank sets run their own schedules on
+        their own (band-scoped) cids inside ONE run, and a death's
+        exit markers ripple only through the dead rank's cid queues —
+        one tenant's failure storm never touches another's wire.
         """
+        cid_of = cid if callable(cid) else (lambda _p, _c=cid: _c)
         parts = list(self.procs if ranks is None else ranks)
         for p in parts:
             if not self.ranks[p].alive:
                 raise ValueError(f"rank {p} is dead; exclude it")
             info = self._exit.pop(p, None)  # (re)joining this run
-            if info is not None and info.get("cid") == cid:
+            if info is not None and info.get("cid") == cid_of(p):
                 # its exit markers (and possibly undrained payloads)
                 # still sit on this cid's queues; replaying over them
                 # would fail spuriously. Production ULFM has the same
                 # rule: a comm that saw a failure is revoked and
                 # REBUILT — rejoin on a fresh cid (ft_cid).
                 raise ValueError(
-                    f"rank {p} exited the previous run on cid {cid} "
-                    f"({info['kind']}); rerun survivors on a fresh "
-                    "cid (the ULFM revoke -> rebuild shape)")
+                    f"rank {p} exited the previous run on cid "
+                    f"{cid_of(p)} ({info['kind']}); rerun survivors "
+                    "on a fresh cid (the ULFM revoke -> rebuild shape)")
         start = {p: self.ranks[p].snap() for p in parts}
         out: Dict[int, Tuple[str, object]] = {}
 
         def worker(p):
             r = self.ranks[p]
-            x = FleetXchg(self, p, cid, epoch0)
+            pcid = cid_of(p)
+            x = FleetXchg(self, p, pcid, epoch0)
             try:
                 if sig is not None:
                     s = sig(p) if callable(sig) else sig
                     if s is not None:
-                        self.note_collective(p, cid, *s)
+                        self.note_collective(p, pcid, *s)
                 t0 = r.now
                 val = fn(x, p)
                 if label:
                     r.spans.append({"seq": len(r.spans), "op": label,
                                     "layer": "coll", "t": t0,
                                     "dt": r.now - t0, "bytes": 0,
-                                    "peer": -1, "comm": int(cid)})
+                                    "peer": -1, "comm": int(pcid)})
                 self._event(r, "done", op=label or "run")
                 out[p] = ("ok", val)
             except _RankKilled:
@@ -688,12 +709,12 @@ class FleetSim:
                 self._event(r, "died", epoch=epoch)
                 self._register_exit(p, {"kind": "dead", "vt": r.now,
                                         "notice": doc, "revoked": (),
-                                        "epoch": epoch}, cid)
+                                        "epoch": epoch}, pcid)
                 out[p] = ("killed", r.now)
             except MPIError as e:
                 # the ULFM errhandler pattern: the detector revokes
                 # the comm, and the revoke cascades via exit records
-                self._apply_revoke(r, cid, int(r.ft.epoch), r.now)
+                self._apply_revoke(r, pcid, int(r.ft.epoch), r.now)
                 self._event(r, "error", code=e.code.name)
                 self._register_exit(
                     p, {"kind": "error", "vt": r.now,
@@ -704,22 +725,22 @@ class FleetSim:
                             "failed_at": {str(q): e2 for q, e2
                                           in r.ft.failed_at.items()},
                         },
-                        "revoked": (cid,), "epoch": int(r.ft.epoch)},
-                    cid)
+                        "revoked": (pcid,), "epoch": int(r.ft.epoch)},
+                    pcid)
                 out[p] = ("error", e)
             except SimHang as e:
                 self._event(r, "hang", detail=str(e)[:120])
                 self._register_exit(p, {"kind": "hang", "vt": r.now,
                                         "notice": None, "revoked": (),
                                         "epoch": int(r.ft.epoch)},
-                                    cid)
+                                    pcid)
                 out[p] = ("hang", e)
             except Exception as e:  # pragma: no cover - harness bug
                 self._event(r, "crash", detail=str(e)[:120])
                 self._register_exit(p, {"kind": "crash", "vt": r.now,
                                         "notice": None, "revoked": (),
                                         "epoch": int(r.ft.epoch)},
-                                    cid)
+                                    pcid)
                 out[p] = ("crash", e)
 
         old_stack = threading.stack_size()

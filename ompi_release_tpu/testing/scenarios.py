@@ -145,6 +145,141 @@ def cascading_failure(P: int = 64, *, seed: int = 0,
                        fleet=fleet)
 
 
+class MultiTenantResult:
+    """Everything the fairness/isolation tests and the bench
+    ``multi_tenant`` suite need from one scenario run."""
+
+    __slots__ = ("P", "seed", "classes", "share_lat", "fifo_share",
+                 "lat_ranks", "bulk_ranks", "lat_cid", "bulk_cid",
+                 "solo_durations", "qos_durations", "fifo_durations",
+                 "bulk_durations", "solo_makespan", "qos_makespan",
+                 "fifo_makespan", "killed_rank", "outcomes_lat",
+                 "outcomes_bulk", "qos_fleet")
+
+    def __init__(self, **kv) -> None:
+        for k in self.__slots__:
+            setattr(self, k, kv.get(k))
+
+    @staticmethod
+    def p99(durations: Dict[int, float]) -> float:
+        return float(np.percentile(
+            np.asarray(sorted(durations.values())), 99.0))
+
+
+def multi_tenant(P: int = 256, *, seed: int = 0, hosts_per: int = 8,
+                 classes: str = "latency:8,bulk:2",
+                 lat_elems: int = 131072, bulk_elems: int = 131072,
+                 kill_bulk: bool = False,
+                 detect_s: float = 2e-3) -> MultiTenantResult:
+    """N tenants x small fleets over ONE shared fabric — the service
+    plane's fairness + FT-isolation scenario.
+
+    Two tenants share every host NIC: the **latency** tenant owns one
+    rank per host (P/hosts_per ranks, its own band cid via the real
+    :func:`~..ft.ulfm.tenant_cid`), the **bulk** tenant the rest.
+    Three deterministic legs on the real ``hier_schedules`` code:
+
+    1. **solo** — the latency tenant's allgather alone on a fresh
+       fabric (full wire);
+    2. **qos** — both tenants concurrently, each rank's send
+       bandwidth scaled to its class's weighted-fair share
+       (``service.qos.fair_share`` over the REAL parsed class
+       weights — the steady-state guarantee of the WireArbiter,
+       modeled deterministically so virtual clocks stay replayable);
+    3. **fifo** — the same contention WITHOUT QoS: every sender gets
+       1/ranks-per-host of its NIC (the head-of-line share a
+       saturating bulk tenant leaves a latency tenant on a fair-less
+       wire).
+
+    The fairness claim is two assertions the tests pin: the QoS leg's
+    latency makespan stays within ``1/share`` (+margin) of solo, and
+    beats the FIFO leg. ``kill_bulk=True`` stages a bulk rank's death
+    mid-schedule in the qos leg: the bulk tenant's ranks raise typed
+    ``ERR_PROC_FAILED``/``ERR_REVOKED`` on exactly the bulk tenant's
+    band cid while every latency rank finishes clean — one tenant's
+    failure storm never crosses the band boundary.
+    """
+    from ..service import qos as _qos
+
+    parsed = _qos.parse_classes(classes)
+    share_lat = _qos.fair_share("latency", parsed)
+    share_bulk = _qos.fair_share("bulk", parsed)
+    fifo_share = 1.0 / hosts_per
+    lat_ranks = [p for p in range(P) if p % hosts_per == 0]
+    bulk_ranks = [p for p in range(P) if p % hosts_per != 0]
+    lat_cid = _ulfm.tenant_cid(0, 0)
+    bulk_cid = _ulfm.tenant_cid(1, 0)
+    lat_data = {p: np.full(lat_elems, p + 1, np.int64)
+                for p in lat_ranks}
+    bulk_data = {p: np.arange(bulk_elems, dtype=np.float32)
+                 * ((p % 7) + 1) for p in bulk_ranks}
+    lat_counts = [lat_elems] * len(lat_ranks)
+
+    def lat_fn(x, p):
+        return _fold_sum(hs.allgather_bruck(x, lat_ranks, p,
+                                            lat_data[p], lat_counts))
+
+    def bulk_fn(x, p):
+        return hs.allreduce_rabenseifner(x, bulk_ranks, p,
+                                         bulk_data[p], np.add, 0.0)
+
+    def durations(fleet: FleetSim, ranks) -> Dict[int, float]:
+        return {p: fleet.ranks[p].now for p in ranks}
+
+    # -- leg 1: latency tenant solo ---------------------------------------
+    solo = FleetSim(P, hosts_per=hosts_per, seed=seed,
+                    detect_s=detect_s)
+    solo.run(lat_fn, ranks=lat_ranks, cid=lat_cid, label="allgather")
+    solo_dur = durations(solo, lat_ranks)
+
+    def contended(shares: Dict[str, float],
+                  kill: bool) -> tuple:
+        fleet = FleetSim(P, hosts_per=hosts_per, seed=seed,
+                         detect_s=detect_s)
+        for p in lat_ranks:
+            fleet.fabric.bandwidth_share(p, shares["latency"])
+        for p in bulk_ranks:
+            fleet.fabric.bandwidth_share(p, shares["bulk"])
+        if kill:
+            fleet.kill(bulk_ranks[1], at_round=2)
+        rep = fleet.run(
+            lambda x, p: (lat_fn(x, p) if p in lat_data
+                          else bulk_fn(x, p)),
+            cid=lambda p: lat_cid if p % hosts_per == 0 else bulk_cid,
+            label="multi_tenant",
+            sig=lambda p: (("allgather", "-", "int64", lat_elems, -1)
+                           if p % hosts_per == 0 else
+                           ("allreduce", "add", "float32", bulk_elems,
+                            -1)))
+        return fleet, rep
+
+    # -- leg 2: contended under weighted-fair QoS -------------------------
+    qos_fleet, qos_rep = contended(
+        {"latency": share_lat, "bulk": share_bulk}, kill_bulk)
+    # -- leg 3: contended FIFO (no QoS): per-sender NIC share -------------
+    _fifo_fleet, fifo_rep = contended(
+        {"latency": fifo_share, "bulk": 1.0 - fifo_share}, False)
+
+    return MultiTenantResult(
+        P=P, seed=seed, classes=parsed, share_lat=share_lat,
+        fifo_share=fifo_share, lat_ranks=lat_ranks,
+        bulk_ranks=bulk_ranks, lat_cid=lat_cid, bulk_cid=bulk_cid,
+        solo_durations=solo_dur,
+        qos_durations=durations(qos_fleet, lat_ranks),
+        fifo_durations=durations(_fifo_fleet, lat_ranks),
+        # the bulk tenant's clocks in the SAME contended-QoS leg the
+        # lat tenant's qos_durations come from — one leg, both classes
+        bulk_durations=durations(qos_fleet, bulk_ranks),
+        solo_makespan=max(solo_dur.values()),
+        qos_makespan=max(qos_fleet.ranks[p].now for p in lat_ranks),
+        fifo_makespan=max(_fifo_fleet.ranks[p].now
+                          for p in lat_ranks),
+        killed_rank=bulk_ranks[1] if kill_bulk else None,
+        outcomes_lat={p: qos_rep.outcomes[p] for p in lat_ranks},
+        outcomes_bulk={p: qos_rep.outcomes[p] for p in bulk_ranks},
+        qos_fleet=qos_fleet)
+
+
 def sentinel_desync(P: int = 256, *, divergent_rank: int = 137,
                     divergent_seq: int = 2, seed: int = 0,
                     hosts_per: int = 8) -> FleetSim:

@@ -76,8 +76,14 @@ METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_", "compiled_",
 #: regression — a schedule doing more rounds or shipping more bytes
 #: at the same P — not measurement noise. The steady_state suite's
 #: steady_* lines (per-op wall and Python-orchestration seconds for
-#: interpreted and compiled legs) are lower-better latencies.
-METRIC_LOWER_BETTER_PREFIXES = ("ft_", "sentinel_", "sim_", "steady_")
+#: interpreted and compiled legs) are lower-better latencies. The
+#: multi_tenant suite's tenant_* lines (latency-tenant p99 solo /
+#: contended / FIFO, and the tenant_latency_isolation degradation
+#: ratio — THE service-plane acceptance factor) are lower-better on
+#: the same sim tier: a grown isolation ratio means the weighted-fair
+#: wire lets a bulk tenant degrade a latency tenant further.
+METRIC_LOWER_BETTER_PREFIXES = ("ft_", "sentinel_", "sim_", "steady_",
+                                "tenant_")
 
 DEFAULT_SIGMA = 4.0
 #: relative noise floor: the bench's own ceiling docs put single-run

@@ -86,11 +86,16 @@ class MetricsPubsubTable(PubsubTable):
 
 class NameServer:
     """Standalone name-table server: the shared runtime/pubsub.py
-    protocol on its own endpoint (no job attached)."""
+    protocol on its own endpoint (no job attached). ``table_factory``
+    lets richer residents (``service.daemon.ServiceDaemon``, the
+    tenant-multiplexing ``tpu_serviced``) reuse the endpoint/serve
+    plumbing with a wider RPC table."""
 
-    def __init__(self, port: int = 0, bind_addr: str = "127.0.0.1") -> None:
-        self.ep = OobEndpoint(0, port, bind_addr)
-        self._table = MetricsPubsubTable(self.ep)
+    def __init__(self, port: int = 0, bind_addr: str = "127.0.0.1",
+                 table_factory=None,
+                 secret: Optional[bytes] = None) -> None:
+        self.ep = OobEndpoint(0, port, bind_addr, secret=secret)
+        self._table = (table_factory or MetricsPubsubTable)(self.ep)
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._table.serve_loop, args=(self._stop,),
@@ -117,9 +122,10 @@ class NameClient:
     runtime/pubsub.py helper (same as WorkerAgent's in-job client).
     """
 
-    def __init__(self, host: str, port: int) -> None:
+    def __init__(self, host: str, port: int,
+                 secret: Optional[bytes] = None) -> None:
         self.client_id = random.randrange(1 << 20, 1 << 30)
-        self.ep = OobEndpoint(self.client_id)
+        self.ep = OobEndpoint(self.client_id, secret=secret)
         self.ep.connect(0, host, port)
         self._lock = threading.Lock()
 
@@ -130,8 +136,17 @@ class NameClient:
         return pubsub_rpc(self.ep, self._lock, self, tag, *fields,
                           timeout_ms=timeout_ms)
 
-    def publish(self, service: str, port: str) -> None:
-        ok, msg = self._rpc(TAG_PUBLISH, service, port)
+    def publish(self, service: str, port: str,
+                ttl_s: Optional[float] = None) -> None:
+        """Publish a name; ``ttl_s`` bounds its lifetime server-side
+        (the entry is pruned by the serve loop after expiry — a crashy
+        client's names cannot outlive it by more than the TTL). The
+        TTL rides as an optional trailing frame field, so old servers
+        simply ignore it."""
+        fields = [service, port]
+        if ttl_s is not None:
+            fields.append(str(int(float(ttl_s) * 1000)))
+        ok, msg = self._rpc(TAG_PUBLISH, *fields)
         if not ok:
             raise MPIError(ErrorCode.ERR_NAME,
                            f"publish '{service}': {msg}")

@@ -1,6 +1,12 @@
 """tpu-top — live fleet dashboard (``orte-top`` analogue, grown up).
 
-Three modes:
+Four modes:
+
+- ``--tenants HOST:PORT``: the multi-tenant service plane's view —
+  poll a ``tpu_serviced`` daemon's TAG_TENANTS RPC and render who is
+  burning the fabric: per-tenant coll/s, MB/s, lane share, HOL wait
+  (self-reported via lease renewals), lease/beat ages, capacity in
+  use, and recent evictions with their reasons.
 
 - default: tpu_ps's per-rank process snapshot on a refresh loop
   (``python -m ompi_release_tpu.tools.tpu_top [-d SECS]``).
@@ -164,6 +170,70 @@ def render_fleet(docs: List[Dict[str, Any]], window_s: float = 15.0,
 
 
 # ---------------------------------------------------------------------------
+# tenant view (TAG_TENANTS against a tpu-serviced daemon)
+# ---------------------------------------------------------------------------
+
+
+def render_tenants(doc: Dict[str, Any]) -> str:
+    """The per-tenant fabric table from a daemon's TAG_TENANTS doc
+    (``service.daemon.ServiceClient.tenants()``): who is burning the
+    fabric — per-tenant collective rate, MB/s, lane share, HOL wait
+    (all self-reported via lease-renewal stats), plus lease age and
+    state. Evicted tenants render below the live ones with the
+    eviction reason — the FT-isolation episode stays visible."""
+    cap = doc.get("capacity") or {}
+    head = (f"  {'tid':>3} {'tenant':>14} {'qos':>11} {'ranks':>5} "
+            f"{'lanes':>5} {'coll/s':>8} {'MB/s':>9} {'lane%':>6} "
+            f"{'hol ms':>7} {'beat s':>6} state")
+    lines = [
+        f"  capacity: {cap.get('used_ranks', 0)}/{cap.get('ranks', '?')}"
+        f" ranks, {cap.get('used_lanes', 0)}/{cap.get('lanes', '?')}"
+        " lanes in use",
+        head,
+    ]
+
+    def row(t: Dict[str, Any]) -> str:
+        s = t.get("stats") or {}
+        share = s.get("lane_share")
+        hol = s.get("hol_wait_s")
+        state = t.get("state", "?")
+        if state == "evicted" and t.get("evict_reason"):
+            state = f"evicted ({t['evict_reason']})"
+        return (f"  {t.get('tid', '?'):>3} "
+                f"{str(t.get('name', '?'))[:14]:>14} "
+                f"{str(t.get('qos', '-'))[:11]:>11} "
+                f"{t.get('ranks', 0):>5} {t.get('lanes', 0):>5} "
+                f"{_fmt(s.get('coll_s'), '8.1f'):>8} "
+                f"{_fmt(s.get('mb_s'), '9.2f'):>9} "
+                f"{_fmt(share * 100 if share is not None else None, '5.1f'):>6} "
+                f"{_fmt(hol * 1e3 if hol is not None else None, '7.2f'):>7} "
+                f"{_fmt(t.get('beat_age_s'), '6.1f'):>6} {state}")
+
+    tenants = list(doc.get("tenants") or ())
+    for t in tenants:
+        lines.append(row(t))
+    if not tenants:
+        lines.append("  (no live tenants)")
+    evicted = list(doc.get("evicted") or ())
+    if evicted:
+        lines.append("  -- recent evictions --")
+        for t in evicted:
+            lines.append(row(t))
+    return "\n".join(lines)
+
+
+def _tenants_loop(target: str, delay: float, iterations: int) -> int:
+    """Poll a tpu-serviced daemon's TAG_TENANTS view on a loop, with
+    the shared reconnect-with-backoff contract (see
+    :func:`_client_poll_loop`)."""
+    from ..service.daemon import ServiceClient
+
+    return _client_poll_loop(
+        "tenants", "tenants", target, delay, iterations,
+        ServiceClient, lambda c: render_tenants(c.tenants()))
+
+
+# ---------------------------------------------------------------------------
 # live fleet query (TAG_SERIES against a job HNP)
 # ---------------------------------------------------------------------------
 
@@ -271,34 +341,40 @@ def fleet_from_dir(directory: str, window_s: float = 1e18) -> str:
 # ---------------------------------------------------------------------------
 
 
-def _metrics_loop(target: str, delay: float, iterations: int) -> int:
-    """Poll a tpu_server's Prometheus page on a loop. A dead/restarted
-    server does NOT end the loop: the last page re-renders with a
-    stale marker and the client reconnects with bounded backoff."""
+def _client_poll_loop(flag: str, label: str, target: str,
+                      delay: float, iterations: int, make_client,
+                      fetch) -> int:
+    """THE shared poll/render driver for the client-backed modes
+    (``--metrics``, ``--tenants``): parse HOST:PORT, connect on
+    demand, render ``fetch(client)`` each refresh; a dead/restarted
+    server does NOT end the loop — the last frame re-renders with a
+    stale marker and the client reconnects with bounded exponential
+    backoff. With ``iterations`` set, exits 0 iff any frame was ever
+    fetched. One contract, one implementation — a backoff/exit-code
+    fix lands in every mode at once."""
     from ..utils.errors import MPIError
-    from .tpu_server import NameClient
 
     try:
         host, port_s = target.rsplit(":", 1)
         port = int(port_s)
     except ValueError:
-        print(f"tpu-top: --metrics wants HOST:PORT, got {target!r}",
+        print(f"tpu-top: --{flag} wants HOST:PORT, got {target!r}",
               file=sys.stderr)
         return 2
-    client: Optional[NameClient] = None
-    last_page: Optional[str] = None
+    client = None
+    last_frame: Optional[str] = None
     last_ok: Optional[float] = None
     backoff = delay
     i = 0
     try:
         while True:
-            page = None
+            frame = None
             err = None
             try:
                 if client is None:
-                    client = NameClient(host, port)
-                page = client.metrics()
-            except (MPIError, OSError) as e:
+                    client = make_client(host, port)
+                frame = fetch(client)
+            except (MPIError, OSError, ValueError) as e:
                 err = e
                 if client is not None:
                     try:
@@ -310,12 +386,12 @@ def _metrics_loop(target: str, delay: float, iterations: int) -> int:
                              else "")
             # target stays out of the strftime format: a '%' in it
             # (IPv6 zone-id hosts) would expand or raise
-            print("tpu-top pvars @ " + target + "  "
+            print(f"tpu-top {label} @ " + target + "  "
                   + time.strftime("%H:%M:%S"))
-            if page is not None:
-                last_page, last_ok = page, time.monotonic()
+            if frame is not None:
+                last_frame, last_ok = frame, time.monotonic()
                 backoff = delay
-                print(page, end="" if page.endswith("\n") else "\n")
+                print(frame, end="" if frame.endswith("\n") else "\n")
             else:
                 age = (time.monotonic() - last_ok
                        if last_ok is not None else None)
@@ -323,22 +399,33 @@ def _metrics_loop(target: str, delay: float, iterations: int) -> int:
                       + (f"showing data from {age:.0f}s ago; "
                          if age is not None else "no data yet; ")
                       + f"reconnecting in {backoff:.0f}s]")
-                if last_page is not None:
-                    print(last_page,
-                          end="" if last_page.endswith("\n") else "\n")
+                if last_frame is not None:
+                    print(last_frame,
+                          end="" if last_frame.endswith("\n")
+                          else "\n")
             sys.stdout.flush()
             i += 1
             if iterations and i >= iterations:
-                return 0 if page is not None or last_page is not None \
-                    else 1
-            time.sleep(backoff if page is None else delay)
-            if page is None:
+                return 0 if frame is not None \
+                    or last_frame is not None else 1
+            time.sleep(backoff if frame is None else delay)
+            if frame is None:
                 backoff = min(backoff * 2, 30.0)
     except KeyboardInterrupt:
         return 0
     finally:
         if client is not None:
             client.close()
+
+
+def _metrics_loop(target: str, delay: float, iterations: int) -> int:
+    """Poll a tpu_server's Prometheus page on the shared
+    reconnect-with-backoff driver."""
+    from .tpu_server import NameClient
+
+    return _client_poll_loop("metrics", "pvars", target, delay,
+                             iterations, NameClient,
+                             lambda c: c.metrics())
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -353,16 +440,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--fleet-from", default=None, metavar="DIR",
                     help="render one fleet frame from series-p*.jsonl "
                          "dumps in DIR (post-run view)")
+    ap.add_argument("--tenants", default=None, metavar="HOST:PORT",
+                    help="render a tpu-serviced daemon's per-tenant "
+                         "fabric view (who is burning the fabric: "
+                         "coll/s, MB/s, lane share, HOL wait, leases)")
     args, rest = ap.parse_known_args(argv)
     if args.fleet_from is not None:
         print(fleet_from_dir(args.fleet_from))
         return 0
-    if args.metrics is None and args.fleet is None:
+    if args.metrics is None and args.fleet is None \
+            and args.tenants is None:
         from .tpu_ps import main_top
 
         return main_top(rest)
     mp = argparse.ArgumentParser(
-        prog="tpu-top --metrics/--fleet")
+        prog="tpu-top --metrics/--fleet/--tenants")
     mp.add_argument("-d", "--delay", type=float, default=2.0,
                     help="refresh interval in seconds")
     mp.add_argument("--iterations", type=int, default=0,
@@ -370,6 +462,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     mp.add_argument("--window", type=float, default=15.0,
                     help="rate window in seconds (fleet mode)")
     ma = mp.parse_args(rest)
+    if args.tenants is not None:
+        return _tenants_loop(args.tenants, ma.delay, ma.iterations)
     if args.fleet is not None:
         return _fleet_loop(args.fleet or None, ma.delay,
                            ma.iterations, ma.window)

@@ -502,3 +502,133 @@ class TestBenchWiring:
             return rep.makespan
 
         assert makespan(8) < makespan(1)
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant: N tenants x small fleets over one shared fabric
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mt_result():
+    """ONE P=256 multi_tenant scenario run (solo + contended-QoS with
+    a staged bulk-rank kill + contended-FIFO legs) shared by the
+    fairness and FT-isolation assertions — the kill is staged in the
+    bulk tenant only, so the latency tenant's virtual clocks are
+    identical to a kill-free run (contention is the deterministic
+    bandwidth-share model, not the bulk schedule's fate)."""
+    return sc.multi_tenant(P=256, seed=1, kill_bulk=True)
+
+
+class TestMultiTenant:
+    def test_tenant_cid_banding_units(self):
+        from ompi_release_tpu.ft import ulfm
+
+        lo0, hi0 = ulfm.tenant_band(0)
+        lo1, hi1 = ulfm.tenant_band(1)
+        assert hi0 == lo1 and hi0 - lo0 == ulfm.TENANT_CID_SLOT
+        assert ulfm.tenant_band(ulfm.MAX_TENANTS - 1)[1] == FT_CID_BASE
+        # app cids and tenant-scoped rebuild cids stay in-band
+        c = ulfm.tenant_cid(3, 7)
+        assert ulfm.tenant_of_cid(c) == 3
+        r = ulfm.ft_cid(5, c, tenant=3)
+        assert ulfm.tenant_of_cid(r) == 3
+        assert r != c
+        # distinct tenants recovering at one epoch never collide
+        assert ulfm.ft_cid(5, c, tenant=3) != ulfm.ft_cid(5, c, tenant=4)
+        # legacy (tenant-less) rebuilds stay in the FT band
+        assert ulfm.ft_cid(5, c) >= FT_CID_BASE
+        assert ulfm.tenant_of_cid(ulfm.ft_cid(5, c)) == -1
+        assert ulfm.tenant_of_cid(17) == -1
+        with pytest.raises(Exception):
+            ulfm.tenant_band(ulfm.MAX_TENANTS)
+
+    def test_bandwidth_share_scales_only_bandwidth(self):
+        fab = fs.Fabric(4, hosts_per=2)
+        lat0, bps0, _ = fab.link(0, 2)
+        fab.bandwidth_share(0, 0.25)
+        lat1, bps1, _ = fab.link(0, 2)
+        assert lat1 == lat0                    # latency untouched
+        assert bps1 == pytest.approx(bps0 * 0.25)
+        # receiver-side share does not apply (sender egress model)
+        assert fab.link(2, 1)[1] == pytest.approx(bps0)
+
+    def test_fairness_bound_at_p256(self, mt_result):
+        """Bulk tenant saturating the wire leaves the latency
+        tenant's virtual-clock makespan within the weighted-fair
+        bound of its solo run — while the FIFO (no-QoS) model of the
+        same contention blows far past it."""
+        r = mt_result
+        assert len(r.lat_ranks) == 32 and len(r.bulk_ranks) == 224
+        bound = r.solo_makespan / r.share_lat * 1.10
+        assert r.qos_makespan <= bound
+        assert r.p99(r.qos_durations) <= \
+            r.p99(r.solo_durations) / r.share_lat * 1.10
+        # the QoS win over head-of-line FIFO is large and measurable
+        assert r.fifo_makespan > 2.0 * r.qos_makespan
+
+    def test_ft_isolation_at_p256(self, mt_result):
+        """SIGKILLing a bulk-tenant rank mid-allreduce revokes ONLY
+        the bulk tenant's band cids: every latency rank finishes ok,
+        every bulk survivor raises a typed ULFM error, and no
+        latency-rank FtState ever saw a revocation."""
+        from ompi_release_tpu.ft import ulfm
+
+        r = mt_result
+        assert r.killed_rank in r.bulk_ranks
+        assert all(k == "ok" for k, _ in r.outcomes_lat.values())
+        kinds = {}
+        for p, (k, v) in r.outcomes_bulk.items():
+            kinds.setdefault(k, []).append(p)
+            if k == "error":
+                assert v.code in (ErrorCode.ERR_PROC_FAILED,
+                                  ErrorCode.ERR_REVOKED)
+        assert kinds["killed"] == [r.killed_rank]
+        assert len(kinds["error"]) == len(r.bulk_ranks) - 1
+        # revocations confined to the bulk tenant's band
+        for p in r.bulk_ranks:
+            for c in r.qos_fleet.ranks[p].ft.revoked:
+                assert ulfm.tenant_of_cid(c) == 1
+        for p in r.lat_ranks:
+            assert not r.qos_fleet.ranks[p].ft.revoked
+
+    def test_band_revoke_poisons_future_cids_and_clear_band_heals(self):
+        from ompi_release_tpu.ft import ulfm
+        from ompi_release_tpu.utils.errors import MPIError
+
+        st = ulfm.FtState()
+        lo, hi = ulfm.tenant_band(2)
+        st.revoke_band(lo, hi)
+        assert st.is_revoked(ulfm.tenant_cid(2, 9))  # never minted
+        with pytest.raises(MPIError) as ei:
+            st.check_wait(ulfm.tenant_cid(2, 9), (), "wait")
+        assert ei.value.code == ErrorCode.ERR_REVOKED
+        assert "tenant 2" in str(ei.value)
+        # the neighbor band is untouched
+        st.check_wait(ulfm.tenant_cid(3, 9), (), "wait")
+        assert [lo, hi] in st.snapshot()["revoked_bands"]
+        st.clear_band(lo, hi)
+        st.check_wait(ulfm.tenant_cid(2, 9), (), "wait")
+
+    def test_per_rank_cid_scopes_exit_markers_small(self):
+        """Two tenants in ONE run at small P: a death in tenant B's
+        cid never wakes tenant A's queues (the cid(p) callable run
+        shape, fast version of the P=256 episode)."""
+        from ompi_release_tpu.ft import ulfm
+
+        fleet = fs.FleetSim(8, hosts_per=4, seed=0)
+        a_ranks, b_ranks = [0, 2, 4, 6], [1, 3, 5, 7]
+        a_cid, b_cid = ulfm.tenant_cid(0, 0), ulfm.tenant_cid(1, 0)
+        fleet.kill(3, at_round=1)
+        data = {p: np.full(4, p + 1, np.int64) for p in range(8)}
+
+        def fn(x, p):
+            grp = a_ranks if p in a_ranks else b_ranks
+            return hs.allgather_bruck(x, grp, p, data[p], [4] * 4)
+
+        rep = fleet.run(fn, cid=lambda p: a_cid if p in a_ranks
+                        else b_cid, label="mt")
+        assert all(rep.outcomes[p][0] == "ok" for p in a_ranks)
+        assert rep.outcomes[3][0] == "killed"
+        assert all(rep.outcomes[p][0] == "error" for p in b_ranks
+                   if p != 3)

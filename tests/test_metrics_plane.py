@@ -718,6 +718,74 @@ class TestBenchGate:
                 "x_inter_bytes")])
         assert gate.main(hist + ["--candidate", str(ok)]) == 0
 
+    def test_tenant_metric_directions(self, tmp_path):
+        """The multi_tenant suite's tenant_* lines (service plane):
+        latency-tenant p99s and the tenant_latency_isolation
+        degradation ratio are registered lower-better in the sim tier
+        — a GROWN isolation ratio means the weighted-fair wire lets a
+        bulk tenant degrade a latency tenant further, and it must
+        trip the gate at the sim tier's tight floor."""
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        assert gate._direction(
+            "p99_ratio", "tenant_latency_isolation_p256") == -1
+        assert gate._direction(
+            "sim_ms", "tenant_lat_contended_p99_p256") == -1
+        assert gate._direction(
+            None, "tenant_fifo_hol_ratio_p256") == -1
+
+        def ln(metric, v, unit):
+            return {"metric": metric, "value": v, "unit": unit,
+                    "vs_baseline": None, "tier_label": "sim"}
+
+        hist = [_round_file(
+            tmp_path / f"BENCH_r{k:02d}.json",
+            [ln("tenant_latency_isolation_p256", 1.22, "p99_ratio"),
+             ln("tenant_lat_contended_p99_p256", 0.81, "sim_ms")])
+            for k in range(4)]
+        # fairness eroding (1.22 -> 1.9, still under the FIFO blowup)
+        # IS a regression at the 2% sim floor...
+        bad = _round_file(
+            tmp_path / "cand.json",
+            [ln("tenant_latency_isolation_p256", 1.9, "p99_ratio"),
+             ln("tenant_lat_contended_p99_p256", 0.81, "sim_ms")])
+        verdict = gate.evaluate(
+            [gate.parse_round_file(p) for p in hist],
+            gate.parse_round_file(bad))
+        assert [r["metric"] for r in verdict["regressions"]] \
+            == ["tenant_latency_isolation_p256"]
+        assert verdict["regressions"][0]["tier"] == "sim"
+        # ...the deterministic replay passes
+        ok = _round_file(
+            tmp_path / "ok.json",
+            [ln("tenant_latency_isolation_p256", 1.22, "p99_ratio"),
+             ln("tenant_lat_contended_p99_p256", 0.81, "sim_ms")])
+        assert gate.main(hist + ["--candidate", str(ok)]) == 0
+
+    def test_multi_tenant_bench_lines_are_gateable(self):
+        """The bench suite itself (small P for speed): emits the
+        solo/contended/FIFO p99 legs per QoS class + the isolation
+        ratio, sim-tiered, with the in-band fairness bound holding."""
+        import bench
+
+        lines = bench._multi_tenant_micro_suite(sizes=(64,))
+        by_metric = {l["metric"]: l for l in lines}
+        iso = by_metric["tenant_latency_isolation_p64"]
+        assert iso["tier_label"] == "sim"
+        assert 1.0 <= iso["value"] <= iso["bound"] * 1.10
+        assert by_metric["tenant_fifo_hol_ratio_p64"]["value"] \
+            > 2.0 * iso["value"]
+        solo = by_metric["tenant_lat_solo_p99_p64"]
+        cont = by_metric["tenant_lat_contended_p99_p64"]
+        assert solo["qos"] == "latency" and cont["value"] \
+            >= solo["value"]
+        assert by_metric["tenant_bulk_contended_p99_p64"]["qos"] \
+            == "bulk"
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        for l in lines:
+            assert gate._direction(l["unit"], l["metric"]) == -1
+
     def test_sim_tier_band_is_tight_not_wall_clock_wobble(self,
                                                           tmp_path):
         """Sim lines are deterministic replays: the ±25% wall-clock

@@ -46,7 +46,9 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/coll/plan.py",
            "ompi_release_tpu/coll/topo_schedules.py",
            "ompi_release_tpu/tuning/db.py",
-           "ompi_release_tpu/tuning/retune.py")
+           "ompi_release_tpu/tuning/retune.py",
+           "ompi_release_tpu/service/qos.py",
+           "ompi_release_tpu/service/tenant.py")
 
 #: attribute calls that ARE emit sites when ungated
 EMIT_ATTRS = {"record", "begin", "body", "end", "arm"}

@@ -1,0 +1,959 @@
+"""Multi-tenant service plane tests (PR 15, ROADMAP item 2).
+
+Covers the resident daemon stack end to end:
+
+- ``service/tenant.py``: admission control (rank/lane capacity, typed
+  denials), leases + heartbeat sweep, scoped eviction (cid-band
+  revoke + sentinel clear + pubsub name pruning via listeners).
+- ``service/qos.py``: class-spec parsing, weight-proportional lane
+  partitioning, and the weighted-fair :class:`WireArbiter` (solo fast
+  path, no banked idle credit, bulk-parks-for-latency convergence).
+- ``service/daemon.py``: the TAG_TENANT/TAG_TENANTS RPC plane over a
+  live in-process daemon, including lease-expiry eviction by the
+  serve loop and stale-name hygiene.
+- ``ft/ulfm.py`` band revocation against REAL registered
+  communicators, plus ``comm.set_qos_class`` inheritance.
+- ``runtime/wire.py`` QoS lane-class selection through the
+  generation-cached ``WireTuning`` snapshot (zero-config = legacy).
+- ``runtime/pubsub.py`` owner identity + TTL (satellite 1) over a
+  real NameServer.
+- ``comm/dpm.py`` concurrent multi-tenant accept/connect (satellite
+  2): two parked connectors from different tenants are both served,
+  never bounced off or serialized behind one rendezvous slot.
+- ``tools/tpu_top.py`` ``--tenants`` rendering + CLI.
+- THE acceptance episode: two REAL tpurun jobs attached to one
+  in-process ``tpu_serviced`` — a bulk tenant whose rank is SIGKILLed
+  mid-allreduce is evicted with only ITS band revoked while the
+  latency tenant's collectives and the daemon finish clean, with
+  ``tpu_top --tenants`` showing both episodes.
+"""
+
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ompi_release_tpu as mpi
+from ompi_release_tpu.ft import ulfm
+from ompi_release_tpu.mca import pvar, var as mca_var
+from ompi_release_tpu.service import qos as qos_mod
+from ompi_release_tpu.service.daemon import ServiceClient, ServiceDaemon
+from ompi_release_tpu.service.tenant import TenantRegistry
+from ompi_release_tpu.utils.errors import ErrorCode, MPIError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pv(name):
+    p = pvar.PVARS.lookup(name)
+    return float(p.read()) if p is not None else 0.0
+
+
+# ---------------------------------------------------------------------------
+# tenant registry: admission control, leases, scoped eviction
+# ---------------------------------------------------------------------------
+
+
+class TestTenantRegistry:
+    def test_admit_grants_band_and_lease(self):
+        reg = TenantRegistry(capacity_ranks=16, capacity_lanes=8,
+                             lease_s=5.0)
+        t = reg.admit("a", 4, qos="latency", lanes=2, owner=77)
+        assert t.band == ulfm.tenant_band(t.tid)
+        assert t.qos == "latency" and t.owner == 77
+        assert t.token and t.expires_at > time.monotonic()
+        assert reg.used_ranks() == 4 and reg.used_lanes() == 2
+        doc = reg.doc()
+        assert doc["tenants"][0]["name"] == "a"
+        assert "token" not in doc["tenants"][0]  # secret never listed
+        assert doc["capacity"]["used_ranks"] == 4
+        reg.release(t.tid, t.token)
+
+    def test_typed_denials(self):
+        reg = TenantRegistry(capacity_ranks=8, capacity_lanes=2)
+        base = _pv("service_admissions_denied")
+        with pytest.raises(MPIError) as ei:
+            reg.admit("", 4)
+        assert ei.value.code == ErrorCode.ERR_ARG
+        with pytest.raises(MPIError) as ei:
+            reg.admit("x", 0)
+        assert ei.value.code == ErrorCode.ERR_ARG
+        t = reg.admit("x", 4)
+        with pytest.raises(MPIError) as ei:
+            reg.admit("x", 2)  # duplicate live name
+        assert ei.value.code == ErrorCode.ERR_NAME
+        with pytest.raises(MPIError) as ei:
+            reg.admit("y", 8)  # 4 + 8 > 8 ranks
+        assert ei.value.code == ErrorCode.ERR_NO_MEM
+        with pytest.raises(MPIError) as ei:
+            reg.admit("z", 1, lanes=2)  # 1 + 2 > 2 lanes
+        assert ei.value.code == ErrorCode.ERR_NO_MEM
+        assert _pv("service_admissions_denied") == base + 5
+        reg.release(t.tid, t.token)
+
+    def test_tenant_id_space_exhaustion(self):
+        reg = TenantRegistry(capacity_ranks=1 << 20,
+                             capacity_lanes=1 << 20, max_tenants=2)
+        a = reg.admit("a", 1)
+        b = reg.admit("b", 1)
+        with pytest.raises(MPIError) as ei:
+            reg.admit("c", 1)
+        assert ei.value.code == ErrorCode.ERR_NO_MEM
+        # release frees the tid for re-admission (slot reuse)
+        reg.release(a.tid, a.token)
+        c = reg.admit("c", 1)
+        assert c.tid == a.tid
+        reg.release(b.tid, b.token)
+        reg.release(c.tid, c.token)
+
+    def test_lease_renew_auth_and_stats(self):
+        reg = TenantRegistry(lease_s=5.0)
+        t = reg.admit("a", 1)
+        with pytest.raises(MPIError) as ei:
+            reg.renew(t.tid, "wrong-token")
+        assert ei.value.code == ErrorCode.ERR_ARG
+        with pytest.raises(MPIError) as ei:
+            reg.renew(99, t.token)
+        assert ei.value.code == ErrorCode.ERR_NAME
+        before = t.expires_at
+        time.sleep(0.01)
+        reg.renew(t.tid, t.token, stats={"coll_s": 12.5})
+        assert t.expires_at > before
+        assert reg.doc()["tenants"][0]["stats"]["coll_s"] == 12.5
+        with pytest.raises(MPIError):
+            reg.release(t.tid, "wrong-token")
+        reg.release(t.tid, t.token)
+
+    def test_sweep_evicts_expired_leases_only(self):
+        reg = TenantRegistry(lease_s=10.0)
+        a = reg.admit("a", 1)
+        b = reg.admit("b", 1, lease_s=1000.0)
+        gone = reg.sweep(now=time.monotonic() + 20.0)
+        assert [t.tid for t in gone] == [a.tid]
+        assert a.state == "evicted"
+        assert "lease expired" in a.evict_reason
+        assert [t.tid for t in reg.live()] == [b.tid]
+        # the eviction is idempotent and listed for forensics
+        assert reg.evict(a.tid, "again") is None
+        assert reg.doc()["evicted"][0]["tid"] == a.tid
+        reg.release(b.tid, b.token)
+
+    def test_evict_listener_runs_and_raising_listener_is_contained(self):
+        reg = TenantRegistry()
+        seen = []
+        reg.add_evict_listener(
+            lambda t, r: (_ for _ in ()).throw(RuntimeError("boom")))
+        reg.add_evict_listener(lambda t, r: seen.append((t.tid, r)))
+        t = reg.admit("a", 1)
+        reg.fail(t.tid, t.token, reason="rank 3 died")
+        assert seen == [(t.tid, "rank 3 died")]
+
+    def test_note_owner_lost_evicts_only_that_owner(self):
+        reg = TenantRegistry()
+        a = reg.admit("a", 1, owner=10)
+        b = reg.admit("b", 1, owner=20)
+        gone = reg.note_owner_lost(10)
+        assert [t.tid for t in gone] == [a.tid]
+        assert gone[0].evict_reason == "owner lifeline lost"
+        assert [t.tid for t in reg.live()] == [b.tid]
+        reg.release(b.tid, b.token)
+
+    def test_eviction_revokes_band_and_readmission_heals(self):
+        reg = TenantRegistry()
+        t = reg.admit("a", 1)
+        tid = t.tid
+        cid = ulfm.tenant_cid(tid, 3)
+        ulfm.state().clear_band(*t.band)  # pristine starting point
+        reg.fail(t.tid, t.token)
+        assert ulfm.state().is_revoked(cid)
+        # re-admission into the freed slot clears the poison (the
+        # explicit-cid rebuild discipline, band-wide)
+        t2 = reg.admit("fresh", 1)
+        assert t2.tid == tid
+        assert not ulfm.state().is_revoked(cid)
+        reg.release(t2.tid, t2.token)
+        ulfm.state().clear_band(*t2.band)
+
+
+# ---------------------------------------------------------------------------
+# QoS: class parsing, lane partitioning, weighted-fair arbiter
+# ---------------------------------------------------------------------------
+
+
+class TestQosClasses:
+    def test_parse_classes(self):
+        assert qos_mod.parse_classes("latency:8,bulk:2,best_effort:1") \
+            == {"latency": 8.0, "bulk": 2.0, "best_effort": 1.0}
+        assert qos_mod.parse_classes("solo") == {"solo": 1.0}
+        assert qos_mod.parse_classes("") == {}
+        for bad in (":3", "a:x", "a:-1", "a:0"):
+            with pytest.raises(MPIError) as ei:
+                qos_mod.parse_classes(bad)
+            assert ei.value.code == ErrorCode.ERR_ARG
+
+    def test_fair_share(self):
+        classes = qos_mod.parse_classes("latency:8,bulk:2")
+        assert qos_mod.fair_share("latency", classes) == 0.8
+        assert qos_mod.fair_share("bulk", classes) == 0.2
+        assert qos_mod.fair_share("unknown", classes) == 1.0
+        assert qos_mod.fair_share("x", {}) == 1.0
+
+    def test_lane_ranges_weight_proportional_disjoint(self):
+        classes = {"latency": 3.0, "bulk": 1.0}
+        ranges = qos_mod.lane_ranges(classes, 8)
+        assert ranges == {"latency": (0, 6), "bulk": (6, 2)}
+        # every lane covered exactly once, in spec order
+        covered = []
+        for start, count in ranges.values():
+            covered.extend(range(start, start + count))
+        assert covered == list(range(8))
+
+    def test_lane_ranges_one_lane_minimum(self):
+        ranges = qos_mod.lane_ranges({"a": 100.0, "b": 1.0}, 4)
+        assert ranges["b"][1] >= 1
+        assert sum(c for _, c in ranges.values()) == 4
+
+    def test_lane_ranges_more_classes_than_lanes(self):
+        ranges = qos_mod.lane_ranges(
+            {"a": 1.0, "b": 1.0, "c": 1.0}, 2)
+        assert ranges == {"a": (0, 1), "b": (1, 1), "c": (0, 1)}
+
+
+class TestWireArbiter:
+    def test_solo_class_never_waits(self):
+        arb = qos_mod.WireArbiter({"a": 1.0})
+        base = _pv("wire_qos_gate_waits")
+        arb.enter("a")
+        t0 = time.perf_counter()
+        for _ in range(50):
+            arb.gate("a")
+        arb.leave("a")
+        assert time.perf_counter() - t0 < 0.5
+        assert arb.spend("a") == pytest.approx(50.0)
+        assert _pv("wire_qos_gate_waits") == base
+
+    def test_idle_class_banks_no_credit(self):
+        arb = qos_mod.WireArbiter({"a": 1.0, "b": 1.0})
+        arb.enter("a")
+        for _ in range(30):
+            arb.gate("a")
+        # b enters from idle: its clock catches up to the active
+        # minimum instead of spending 30 banked frames instantly
+        arb.enter("b")
+        assert arb.spend("b") == pytest.approx(30.0)
+        arb.leave("a")
+        arb.leave("b")
+
+    def test_bulk_parks_for_latency_at_weight_ratio(self):
+        """Under contention the bulk class's frame count tracks the
+        latency class's at the weight ratio (within one quantum), and
+        the parked time is witnessed by the wire_qos_gate pvars."""
+        arb = qos_mod.WireArbiter({"latency": 4.0, "bulk": 1.0},
+                                  quantum=4.0)
+        waits0 = _pv("wire_qos_gate_waits")
+        lat_done = threading.Event()
+        bulk_frames = [0]
+
+        def bulk():
+            arb.enter("bulk")
+            for _ in range(400):
+                if lat_done.is_set():
+                    break
+                arb.gate("bulk")
+                bulk_frames[0] += 1
+            arb.leave("bulk")
+
+        th = threading.Thread(target=bulk)
+        arb.enter("latency")
+        th.start()
+        for _ in range(80):
+            arb.gate("latency")
+            time.sleep(0.0005)  # a paced latency sender
+        # snapshot while latency is still active: bulk's normalized
+        # spend may lead latency's by at most quantum/weight (+ one
+        # in-flight gate)
+        lat_vt = arb.spend("latency")        # 80 / 4 = 20
+        bulk_vt = arb.spend("bulk")
+        assert bulk_vt <= lat_vt + 4.0 + 1.0
+        # bulk's FRAME count == its vt (weight 1): weight-ratio
+        # service, ~20 frames against latency's 80
+        lat_done.set()
+        arb.leave("latency")
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert bulk_frames[0] >= 1  # never starved either
+        assert _pv("wire_qos_gate_waits") > waits0
+        assert _pv("wire_qos_gate_wait_seconds") > 0.0
+
+    def test_arbiter_shared_per_spec(self):
+        qos_mod._reset_for_tests()
+        a1 = qos_mod.arbiter_for("latency:8,bulk:2")
+        a2 = qos_mod.arbiter_for("latency:8,bulk:2")
+        a3 = qos_mod.arbiter_for("latency:4,bulk:2")
+        assert a1 is a2 and a1 is not a3
+        qos_mod._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# wire integration: lane classes through the WireTuning snapshot
+# ---------------------------------------------------------------------------
+
+
+class _StubRouter:
+    """Just enough router for the _lane_of rule: the real WireTuning
+    snapshot + the real class/lane selection methods."""
+
+    def __init__(self, t):
+        from ompi_release_tpu.runtime.wire import WireRouter
+
+        self._t = t
+        self._class_of = WireRouter._class_of
+
+    def tuning(self):
+        return self._t
+
+
+class _StubComm:
+    def __init__(self, cls=None):
+        if cls is not None:
+            self._qos_class = cls
+
+
+class TestWireLaneClasses:
+    @pytest.fixture()
+    def qos_vars(self):
+        from ompi_release_tpu.runtime.wire import WireTuning
+
+        mca_var.set_value("wire_p2p_lanes", 8)
+        mca_var.set_value("wire_qos_classes", "latency:3,bulk:1")
+        try:
+            yield WireTuning()
+        finally:
+            mca_var.VARS.unset("wire_qos_classes")
+            mca_var.VARS.unset("wire_qos_class")
+            mca_var.VARS.unset("wire_p2p_lanes")
+            qos_mod._reset_for_tests()
+
+    def test_zero_config_is_legacy(self):
+        from ompi_release_tpu.runtime.wire import WireRouter, WireTuning
+
+        t = WireTuning()
+        assert t.qos_ranges is None and t.arbiter is None
+        r = _StubRouter(t)
+        for tag in (0, 5, 123):
+            assert WireRouter._lane_of(r, tag, _StubComm("bulk")) \
+                == tag % t.lanes
+
+    def test_comm_class_selects_lane_subrange(self, qos_vars):
+        from ompi_release_tpu.runtime.wire import WireRouter
+
+        t = qos_vars
+        assert t.qos_ranges == {"latency": (0, 6), "bulk": (6, 2)}
+        assert t.arbiter is not None
+        r = _StubRouter(t)
+        for tag in range(16):
+            lane = WireRouter._lane_of(r, tag, _StubComm("bulk"))
+            assert lane in (6, 7)
+            lane = WireRouter._lane_of(r, tag, _StubComm("latency"))
+            assert 0 <= lane < 6
+        # unknown class (and no process default): legacy full range
+        assert WireRouter._lane_of(r, 13, _StubComm("mystery")) \
+            == 13 % 8
+
+    def test_process_default_class_cvar(self):
+        from ompi_release_tpu.runtime.wire import WireRouter, WireTuning
+
+        mca_var.set_value("wire_p2p_lanes", 8)
+        mca_var.set_value("wire_qos_classes", "latency:3,bulk:1")
+        mca_var.set_value("wire_qos_class", "bulk")
+        try:
+            r = _StubRouter(WireTuning())
+            # unstamped comm rides the process-wide class...
+            assert WireRouter._lane_of(r, 1, _StubComm()) in (6, 7)
+            # ...a stamped comm overrides it
+            assert WireRouter._lane_of(r, 1, _StubComm("latency")) < 6
+        finally:
+            mca_var.VARS.unset("wire_qos_classes")
+            mca_var.VARS.unset("wire_qos_class")
+            mca_var.VARS.unset("wire_p2p_lanes")
+            qos_mod._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# cid-band revocation against real communicators + QoS stamping
+# ---------------------------------------------------------------------------
+
+
+class TestBandsOnRealComms:
+    def test_band_revoke_hits_only_that_tenants_comms(self):
+        from ompi_release_tpu.comm.communicator import Communicator
+
+        world = mpi.init()
+        st = ulfm.state()
+        a = Communicator(world.runtime, world.group, name="tenant-a",
+                         cid=ulfm.tenant_cid(5, 0))
+        b = Communicator(world.runtime, world.group, name="tenant-b",
+                         cid=ulfm.tenant_cid(6, 0))
+        try:
+            st.revoke_band(*ulfm.tenant_band(5))
+            with pytest.raises(MPIError) as ei:
+                a.allreduce(np.ones((8, 2), np.float32))
+            assert ei.value.code == ErrorCode.ERR_REVOKED
+            # the neighbor tenant's comm still works
+            out = np.asarray(b.allreduce(np.ones((8, 2), np.float32)))
+            np.testing.assert_array_equal(out, np.full((8, 2), 8.0))
+            # ...and so does the daemon's own (non-tenant) world
+            np.testing.assert_array_equal(
+                np.asarray(world.allreduce(np.ones((8, 1), np.int32))),
+                np.full((8, 1), 8))
+        finally:
+            st.clear_band(*ulfm.tenant_band(5))
+            st.clear_band(*ulfm.tenant_band(6))
+            a._revoked = False
+            a.free()
+            b.free()
+
+    def test_band_clear_on_sentinel(self):
+        from ompi_release_tpu.obs import sentinel
+
+        mca_var.set_value("obs_sentinel", 1)
+        sentinel.refresh(True)
+        try:
+            cid = ulfm.tenant_cid(7, 1)
+            neighbor = ulfm.tenant_cid(8, 1)
+            sentinel.record_sig(cid, "allreduce", "add")
+            sentinel.record_sig(neighbor, "allreduce", "add")
+            assert sentinel.chain_of(cid) != 0
+            sentinel.clear_band(*ulfm.tenant_band(7))
+            assert sentinel.chain_of(cid) == 0
+            assert sentinel.chain_of(neighbor) != 0  # out of band
+            sentinel.clear_band(*ulfm.tenant_band(8))
+        finally:
+            mca_var.VARS.unset("obs_sentinel")
+            sentinel.refresh()
+
+    def test_qos_class_stamp_inherited_by_children(self):
+        world = mpi.init()
+        c = world.dup("qos-parent")
+        assert c.qos_class is None
+        c.set_qos_class("bulk")
+        child = c.dup("qos-child")
+        assert child.qos_class == "bulk"
+        child.set_qos_class(None)
+        assert child.qos_class is None and c.qos_class == "bulk"
+        child.free()
+        c.free()
+
+    def test_sampler_points_carry_tenant_dimension(self):
+        from ompi_release_tpu.obs.sampler import SeriesRing
+
+        ring = SeriesRing(16)
+        ring.append(0.0, ulfm.tenant_cid(3, 0), "coll_ops", 5,
+                    tenant=3)
+        ring.append(0.0, 1, "coll_ops", 7, tenant=-1)
+        pts = ring.snapshot()
+        assert pts[0]["tenant"] == 3
+        assert "tenant" not in pts[1]  # non-tenant cids stay compact
+
+
+# ---------------------------------------------------------------------------
+# pubsub owner identity + TTL (satellite 1) over a real server
+# ---------------------------------------------------------------------------
+
+
+class TestPubsubHygiene:
+    def test_ttl_expiry_prunes_server_side(self):
+        from ompi_release_tpu.tools.tpu_server import (NameClient,
+                                                       NameServer)
+
+        srv = NameServer()
+        c = NameClient("127.0.0.1", srv.port)
+        try:
+            c.publish("ttl-svc", "tpu-port:7", ttl_s=0.4)
+            assert c.lookup("ttl-svc", timeout_ms=2000) == "tpu-port:7"
+            time.sleep(1.0)  # serve loop prunes every iteration
+            with pytest.raises(MPIError):
+                c.lookup("ttl-svc", timeout_ms=200)
+            # the name is re-publishable after expiry (not a dup)
+            c.publish("ttl-svc", "tpu-port:8")
+            assert c.lookup("ttl-svc", timeout_ms=2000) == "tpu-port:8"
+        finally:
+            c.close()
+            srv.shutdown()
+
+    def test_evict_owner_drops_only_that_owners_names(self):
+        from ompi_release_tpu.tools.tpu_server import (NameClient,
+                                                       NameServer)
+
+        srv = NameServer()
+        ca = NameClient("127.0.0.1", srv.port)
+        cb = NameClient("127.0.0.1", srv.port)
+        try:
+            ca.publish("a-one", "pa1")
+            ca.publish("a-two", "pa2")
+            cb.publish("b-one", "pb1")
+            gone = srv._table.evict_owner(ca.client_id)
+            assert sorted(gone) == ["a-one", "a-two"]
+            with pytest.raises(MPIError):
+                cb.lookup("a-one", timeout_ms=200)
+            assert cb.lookup("b-one", timeout_ms=2000) == "pb1"
+            # legacy publish (no TTL) still works and never expires
+            assert srv._table.names["b-one"].expire_at is None
+            assert srv._table.names["b-one"].owner == cb.client_id
+        finally:
+            ca.close()
+            cb.close()
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# dpm: concurrent multi-tenant accept/connect (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def world():
+    return mpi.init()
+
+
+class TestDpmConcurrency:
+    def test_two_parked_connectors_both_served(self, world):
+        """THE satellite-2 regression: two connectors from different
+        tenants park on one port BEFORE any acceptor exists. The old
+        single-slot rendezvous bounced the second with 'port already
+        has a connector'; the queue serves both, FIFO."""
+        from ompi_release_tpu.comm import (close_port, comm_accept,
+                                           comm_connect, open_port)
+
+        srv = world.create(world.group.incl([0, 1]), name="mt-srv")
+        c1 = world.create(world.group.incl([2, 3]), name="mt-c1")
+        c2 = world.create(world.group.incl([4, 5]), name="mt-c2")
+        port = open_port()
+        results = {}
+        errors = {}
+
+        def connect(name, comm):
+            try:
+                results[name] = comm_connect(comm, port, timeout_s=20)
+            except BaseException as e:  # pragma: no cover
+                errors[name] = e
+
+        t1 = threading.Thread(target=connect, args=("c1", c1))
+        t1.start()
+        time.sleep(0.3)  # c1 parks first (FIFO order pinned below)
+        t2 = threading.Thread(target=connect, args=("c2", c2))
+        t2.start()
+        time.sleep(0.3)  # both parked, no acceptor yet
+        ic1 = comm_accept(srv, port, timeout_s=20)
+        ic2 = comm_accept(srv, port, timeout_s=20)
+        t1.join(timeout=20)
+        t2.join(timeout=20)
+        assert not errors, errors
+        assert ic1.remote_group.world_ranks == (2, 3)   # FIFO: c1 first
+        assert ic2.remote_group.world_ranks == (4, 5)
+        assert results["c1"].remote_group.world_ranks == (0, 1)
+        assert results["c2"].remote_group.world_ranks == (0, 1)
+        assert results["c1"].mirror is ic1
+        assert results["c2"].mirror is ic2
+        close_port(port)
+
+    def test_one_partys_timeout_leaves_others_parked(self, world):
+        """A parked connector timing out withdraws only itself: a
+        second tenant parked on the same port is still served by the
+        next accept (the old code poisoned the whole rendezvous)."""
+        from ompi_release_tpu.comm import (close_port, comm_accept,
+                                           comm_connect, open_port)
+
+        c1 = world.create(world.group.incl([2, 3]), name="to-c1")
+        c2 = world.create(world.group.incl([4, 5]), name="to-c2")
+        srv = world.create(world.group.incl([0, 1]), name="to-srv")
+        port = open_port()
+        with pytest.raises(MPIError) as ei:
+            comm_connect(c1, port, timeout_s=0.3)  # nobody accepts
+        assert ei.value.code == ErrorCode.ERR_PORT
+        results = {}
+
+        def connect():
+            results["ic"] = comm_connect(c2, port, timeout_s=20)
+
+        t = threading.Thread(target=connect)
+        t.start()
+        time.sleep(0.2)
+        ic = comm_accept(srv, port, timeout_s=20)
+        t.join(timeout=20)
+        assert ic.remote_group.world_ranks == (4, 5)
+        assert results["ic"].remote_group.world_ranks == (0, 1)
+        close_port(port)
+
+    def test_close_port_wakes_parked_parties_promptly(self, world):
+        from ompi_release_tpu.comm import (close_port, comm_connect,
+                                           open_port)
+
+        c1 = world.create(world.group.incl([2, 3]), name="cp-c1")
+        port = open_port()
+        caught = {}
+
+        def connect():
+            t0 = time.monotonic()
+            try:
+                comm_connect(c1, port, timeout_s=30)
+            except MPIError as e:
+                caught["err"] = e
+                caught["dt"] = time.monotonic() - t0
+
+        t = threading.Thread(target=connect)
+        t.start()
+        time.sleep(0.3)
+        close_port(port)
+        t.join(timeout=10)
+        assert caught["err"].code == ErrorCode.ERR_PORT
+        assert "closed" in str(caught["err"])
+        assert caught["dt"] < 5.0  # woke on close, not on deadline
+
+
+# ---------------------------------------------------------------------------
+# daemon RPC plane (in-process tpu-serviced)
+# ---------------------------------------------------------------------------
+
+
+class TestServiceDaemon:
+    @pytest.fixture()
+    def daemon(self):
+        srv = ServiceDaemon(capacity_ranks=16, capacity_lanes=8,
+                            lease_s=30.0)
+        client = ServiceClient("127.0.0.1", srv.port)
+        admitted = []
+        yield srv, client, admitted
+        for tid, token in admitted:
+            try:
+                client.release(tid, token)
+            except Exception:
+                pass
+        for t in srv.registry.live():
+            srv.registry.evict(t.tid, "test teardown")
+            ulfm.state().clear_band(*t.band)
+        client.close()
+        srv.shutdown()
+
+    def test_admit_renew_release_roundtrip(self, daemon):
+        srv, client, admitted = daemon
+        g = client.admit("trainer-a", ranks=8, qos="latency", lanes=2)
+        assert g["band"] == list(ulfm.tenant_band(g["tid"]))
+        assert g["qos"] == "latency"
+        r = client.renew(g["tid"], g["token"],
+                         stats={"coll_s": 120.0, "mb_s": 85.0})
+        assert r["expires_in_s"] > 0
+        view = client.tenants()
+        assert view["tenants"][0]["stats"]["mb_s"] == 85.0
+        assert view["capacity"]["used_ranks"] == 8
+        out = client.release(g["tid"], g["token"])
+        assert out["state"] == "evicted"
+        assert client.tenants()["tenants"] == []
+        ulfm.state().clear_band(*ulfm.tenant_band(g["tid"]))
+
+    def test_typed_denials_cross_the_wire(self, daemon):
+        srv, client, admitted = daemon
+        g = client.admit("a", ranks=8)
+        admitted.append((g["tid"], g["token"]))
+        with pytest.raises(MPIError) as ei:
+            client.admit("a", ranks=1)
+        assert ei.value.code == ErrorCode.ERR_NAME
+        with pytest.raises(MPIError) as ei:
+            client.admit("b", ranks=16)  # 8 + 16 > 16
+        assert ei.value.code == ErrorCode.ERR_NO_MEM
+        with pytest.raises(MPIError) as ei:
+            client.renew(g["tid"], "stolen-token")
+        assert ei.value.code == ErrorCode.ERR_ARG
+
+    def test_eviction_drops_tenant_pubsub_names(self, daemon):
+        srv, client, admitted = daemon
+        g = client.admit("crashy", ranks=1)
+        client.publish("crashy-port", "tpu-port:9")
+        assert client.lookup("crashy-port", timeout_ms=2000) \
+            == "tpu-port:9"
+        client.fail(g["tid"], g["token"], reason="rank died")
+        with pytest.raises(MPIError):
+            client.lookup("crashy-port", timeout_ms=200)
+        view = client.tenants()
+        assert view["evicted"][-1]["evict_reason"] == "rank died"
+        ulfm.state().clear_band(*ulfm.tenant_band(g["tid"]))
+
+    def test_lease_expiry_evicted_by_serve_loop(self, daemon):
+        """No heartbeat -> the serve loop's sweep evicts within ~a
+        lease: silent job death is detected by the very loop serving
+        live tenants (no reaper thread to lose)."""
+        srv, client, admitted = daemon
+        g = client.admit("silent", ranks=1, lease_s=0.5)
+        deadline = time.monotonic() + 10.0
+        while srv.registry.get(g["tid"]) is not None:
+            assert time.monotonic() < deadline, "sweep never evicted"
+            time.sleep(0.1)
+        view = client.tenants()
+        assert "lease expired" in view["evicted"][-1]["evict_reason"]
+        ulfm.state().clear_band(*ulfm.tenant_band(g["tid"]))
+
+    def test_malformed_rpc_is_contained(self, daemon):
+        srv, client, admitted = daemon
+        with pytest.raises(MPIError):
+            client._tenant_rpc({"op": "explode"})
+        # the daemon survives to serve the next request
+        assert client.tenants()["capacity"]["ranks"] == 16
+
+
+# ---------------------------------------------------------------------------
+# tpu_top --tenants rendering
+# ---------------------------------------------------------------------------
+
+
+class TestTenantView:
+    DOC = {
+        "capacity": {"ranks": 64, "lanes": 16, "used_ranks": 10,
+                     "used_lanes": 3},
+        "tenants": [
+            {"tid": 0, "name": "trainer-a", "qos": "latency",
+             "ranks": 8, "lanes": 2, "state": "live",
+             "beat_age_s": 0.8,
+             "stats": {"coll_s": 120.0, "mb_s": 85.5,
+                       "lane_share": 0.8, "hol_wait_s": 0.0012}},
+            {"tid": 1, "name": "inference-b", "qos": "bulk",
+             "ranks": 2, "lanes": 1, "state": "live",
+             "beat_age_s": 2.0, "stats": {}},
+        ],
+        "evicted": [
+            {"tid": 2, "name": "crashy", "qos": "best_effort",
+             "ranks": 4, "lanes": 1, "state": "evicted",
+             "evict_reason": "rank 3 died", "beat_age_s": 31.0,
+             "stats": {}},
+        ],
+    }
+
+    def test_render_tenants(self):
+        from ompi_release_tpu.tools.tpu_top import render_tenants
+
+        out = render_tenants(self.DOC)
+        assert "10/64 ranks" in out and "3/16 lanes" in out
+        assert "trainer-a" in out and "latency" in out
+        assert "120.0" in out and "85.50" in out
+        assert "80.0" in out          # lane_share as percent
+        assert "1.20" in out          # hol_wait_s as ms
+        assert "evicted (rank 3 died)" in out
+        # stat-less tenants render placeholders, not crashes
+        assert "inference-b" in out
+
+    def test_render_empty(self):
+        from ompi_release_tpu.tools.tpu_top import render_tenants
+
+        out = render_tenants({"capacity": {}, "tenants": [],
+                              "evicted": []})
+        assert "(no live tenants)" in out
+
+    def test_cli_one_frame_against_live_daemon(self, capsys):
+        from ompi_release_tpu.tools import tpu_top
+
+        srv = ServiceDaemon()
+        try:
+            t = srv.registry.admit("cli-t", 2, qos="latency")
+            rc = tpu_top.main(["--tenants", f"127.0.0.1:{srv.port}",
+                               "--iterations", "1", "-d", "0.1"])
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "cli-t" in out and "latency" in out
+            srv.registry.release(t.tid, t.token)
+        finally:
+            for t in srv.registry.live():
+                srv.registry.evict(t.tid, "teardown")
+            srv.shutdown()
+            for tid in range(2):
+                ulfm.state().clear_band(*ulfm.tenant_band(tid))
+
+    def test_cli_bad_target(self):
+        from ompi_release_tpu.tools import tpu_top
+
+        assert tpu_top.main(["--tenants", "nonsense",
+                             "--iterations", "1"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance episode: two real tpurun jobs, one daemon
+# ---------------------------------------------------------------------------
+
+APP_PRELUDE = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, %r)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_release_tpu as mpi
+    from ompi_release_tpu.comm.communicator import Communicator
+    from ompi_release_tpu.ft import ulfm as _ulfm
+    from ompi_release_tpu.runtime.runtime import Runtime
+    from ompi_release_tpu.service.daemon import ServiceClient
+
+    world = mpi.init()
+    rt = Runtime.current()
+    me = rt.bootstrap["process_index"]
+    _h, _p = os.environ["OMPITPU_SERVICED"].rsplit(":", 1)
+
+    def attach(name, qos):
+        # controller admits; the grant's tid reaches every process
+        # via the job's own world comm (sum of tid+1 from rank 0)
+        cl = tid = None
+        contrib = np.zeros((2, 1), np.int32)
+        if me == 0:
+            cl = ServiceClient(_h, int(_p))
+            g = cl.admit(name, ranks=world.size, qos=qos)
+            tid = g["tid"]
+            contrib[0, 0] = tid + 1
+            token = g["token"]
+        else:
+            token = None
+        tid = int(np.asarray(world.allreduce(contrib))[0, 0]) - 1
+        tcomm = Communicator(rt, world.group, name=f"t{tid}",
+                             cid=_ulfm.tenant_cid(tid, 0))
+        tcomm.set_qos_class(qos)
+        return cl, tid, (token if me == 0 else None), tcomm
+""" % REPO)
+
+
+def _write_app(tmp_path, name, body):
+    app = tmp_path / name
+    app.write_text(APP_PRELUDE + textwrap.dedent(body))
+    return app
+
+
+class TestServiceJobs:
+    def test_two_jobs_one_daemon_kill_isolation(self, tmp_path, capfd):
+        """THE acceptance criterion, isolation leg: two independently
+        launched tpurun jobs attach to ONE resident daemon as tenants
+        of one fabric. A bulk-tenant rank is SIGKILLed mid-allreduce:
+        its survivors get the typed ULFM error on exactly their
+        tenant-band cid and report the failure; the latency tenant's
+        collectives, lease renewals, and graceful release — and the
+        daemon itself — finish clean; ``tpu_top --tenants`` shows
+        both episodes."""
+        from ompi_release_tpu.tools.tpu_top import render_tenants
+        from ompi_release_tpu.tools.tpurun import Job
+
+        bulk_app = _write_app(tmp_path, "bulk_app.py", """
+            cl, tid, token, tcomm = attach("bulk-job", "bulk")
+            # fence + drain: the attach-phase WORLD frames must all
+            # land before the kill, so the death lands mid-allreduce
+            # on the TENANT-band cid (the episode under test)
+            world.barrier()
+            time.sleep(0.5)
+            x = np.stack([np.full(256, me * 2 + i + 1.0, np.float32)
+                          for i in range(2)])
+            err = None
+            for step in range(40):
+                if me == 2 and step == 10:
+                    import signal
+                    os.kill(os.getpid(), signal.SIGKILL)
+                try:
+                    tcomm.allreduce(x)
+                    time.sleep(0.02)
+                except mpi.MPIError as e:
+                    err = e
+                    break
+            assert err is not None, "kill never surfaced"
+            assert err.code in (mpi.ErrorCode.ERR_PROC_FAILED,
+                                mpi.ErrorCode.ERR_REVOKED), err
+            assert _ulfm.tenant_of_cid(tcomm.cid) == tid
+            if me == 0:
+                cl.fail(tid, token,
+                        reason="rank 2 died mid-allreduce")
+                cl.close()
+            print(f"BULK_TYPED_OK rank{me}", flush=True)
+            mpi.finalize()
+        """)
+        lat_app = _write_app(tmp_path, "lat_app.py", """
+            cl, tid, token, tcomm = attach("lat-job", "latency")
+            x = np.stack([np.arange(64, dtype=np.float32) * (me + i + 1)
+                          for i in range(2)])
+            want = None
+            t0 = time.monotonic()
+            for step in range(40):
+                out = np.asarray(tcomm.allreduce(x))
+                if want is None:
+                    want = out.copy()
+                assert np.array_equal(out, want)
+                if me == 0 and step % 10 == 0:
+                    cl.renew(tid, token, stats={
+                        "coll_s": (step + 1)
+                        / max(time.monotonic() - t0, 1e-9)})
+            assert _ulfm.tenant_of_cid(tcomm.cid) == tid
+            if me == 0:
+                cl.release(tid, token)
+                cl.close()
+            print(f"LAT_CLEAN_OK rank{me}", flush=True)
+            mpi.finalize()
+        """)
+        srv = ServiceDaemon(capacity_ranks=32, capacity_lanes=16,
+                            lease_s=60.0)
+        os.environ["OMPITPU_SERVICED"] = f"127.0.0.1:{srv.port}"
+        qos_mca = [("wire_qos_classes", "latency:8,bulk:2")]
+        results = {}
+        try:
+            def run(name, app, n, **kw):
+                job = Job(n, [sys.executable, str(app)], list(qos_mca),
+                          heartbeat_s=0.3, miss_limit=3, **kw)
+                results[name] = (job.run(timeout_s=300), job)
+
+            tb = threading.Thread(target=run, args=(
+                "bulk", bulk_app, 3), kwargs={"on_failure": "continue"})
+            tl = threading.Thread(target=run, args=("lat", lat_app, 2))
+            tb.start()
+            tl.start()
+            tb.join(timeout=320)
+            tl.join(timeout=320)
+            assert not tb.is_alive() and not tl.is_alive()
+            out = capfd.readouterr()
+            text = out.out + out.err
+            rc_bulk, job_bulk = results["bulk"]
+            rc_lat, _job_lat = results["lat"]
+            assert rc_bulk == 0, text      # survivors clean, death forgiven
+            assert rc_lat == 0, text       # the latency tenant never noticed
+            assert text.count("BULK_TYPED_OK") == 2, text  # both survivors
+            assert "BULK_TYPED_OK rank2" not in text
+            assert text.count("LAT_CLEAN_OK") == 2, text
+            assert job_bulk._ft_failed_ranks, "no promoted corpse"
+
+            # the daemon outlived the episode and shows both stories
+            client = ServiceClient("127.0.0.1", srv.port)
+            try:
+                view = client.tenants()
+            finally:
+                client.close()
+            assert view["tenants"] == []  # both tenants gone
+            by_name = {t["name"]: t for t in view["evicted"]}
+            assert by_name["bulk-job"]["evict_reason"] \
+                == "rank 2 died mid-allreduce"
+            assert by_name["lat-job"]["evict_reason"] == "released"
+            assert by_name["lat-job"]["stats"].get("coll_s", 0) > 0
+            frame = render_tenants(view)
+            assert "rank 2 died mid-allreduce" in frame
+            assert "released" in frame
+
+            # daemon-side scoping: the failed tenant's band is
+            # revoked in the daemon process, the clean tenant's too
+            # (release also retires its band) — but ONLY tenant bands,
+            # never the daemon's own cid space
+            st = ulfm.state()
+            bulk_tid = by_name["bulk-job"]["tid"]
+            assert st.is_revoked(ulfm.tenant_cid(bulk_tid, 0))
+            assert not st.is_revoked(0)
+        finally:
+            os.environ.pop("OMPITPU_SERVICED", None)
+            for t in srv.registry.live():
+                srv.registry.evict(t.tid, "teardown")
+            srv.shutdown()
+            for tid in range(4):
+                ulfm.state().clear_band(*ulfm.tenant_band(tid))
