@@ -372,9 +372,11 @@ class _HierModule:
         headers were precomposed at plan time, so this path is ONE
         ULFM check + memoryview slicing behind precomposed header
         bytes. Inter-process pvar accounting matches :meth:`_send_all`
-        exactly; obs-enabled rounds never reach here (the plan layer
-        falls back to the interpreted path so flow-id spans stay
-        complete)."""
+        exactly; per-message spans are NOT journaled here — observed
+        replays append one fixed-size record per fire to the obs
+        ledger, and tpu-doctor expands it against the frozen plan
+        structure into the same flow-id spans the interpreted path
+        emits."""
         self.router.coll_send_planned(self.comm, rnd, sends)
         for arrs in sends.values():
             for a in arrs:
@@ -383,12 +385,17 @@ class _HierModule:
 
     def _reap(self, pending: Dict[int, int],
               on_arrival: Callable[[int, np.ndarray], None],
-              timeout_ms: Optional[int] = None) -> None:
+              timeout_ms: Optional[int] = None,
+              record: bool = True) -> None:
         """Reap ``pending[p]`` messages per peer in ARRIVAL order —
         a slow peer never blocks the reap of one whose data already
         landed (the posted-sends overlap the module docstring pins).
         ``timeout_ms``: explicit wait bound (frozen-plan replays pass
-        their plan-time snapshot); None = the live cvar."""
+        their plan-time snapshot); None = the live cvar.
+        ``record=False`` (frozen-plan replays): skip per-arrival span
+        emission and the flow-k advance — the obs ledger's expansion
+        re-derives both from the frozen plan structure, and journal
+        spans here would double them."""
         left = sum(pending.values())
         tok = None
         if _watchdog.enabled:
@@ -397,7 +404,7 @@ class _HierModule:
                                 info=self._awaiting_info(pending))
         try:
             while left:
-                rec = _obs.enabled
+                rec = record and _obs.enabled
                 t0 = _time.perf_counter() if rec else 0.0
                 src, arr = self.router.coll_recv_any(self.comm, pending,
                                                      timeout_ms)

@@ -148,6 +148,21 @@ def _op_request(op: _progress.ScheduledOp) -> Request:
     return req
 
 
+def _inline_tpl(state, sig):
+    """Sentinel level 2's precomposed ctl-frame payload, cached on
+    the frozen-plan state (one JSON encode per plan signature, not
+    per fire) — None when the call is unplannable or unsigned, where
+    wrap_inline falls back to the per-fire encoding."""
+    if state is None or sig is None:
+        return None
+    key = (sig.canon, sig.site)
+    tpl = state.sentinel_tpl
+    if tpl is None or tpl[0] != key:
+        state.sentinel_tpl = tpl = (
+            key, _sentinel.InlineFrameTemplate(sig.canon, sig.site))
+    return tpl[1]
+
+
 def _resolve(comm, name: str) -> Callable:
     fn = comm.c_coll.get(name)
     if fn is None:
@@ -219,10 +234,11 @@ def icoll(comm, name: str, args: Tuple, kw: Optional[Dict] = None
     nested = _nested_inline(comm, fn, (comm,) + tuple(args), kw)
     if nested is not None:
         return nested
+    state = _plan.spanning_state_for(comm, name, args, kw)
     if sig is not None:
-        fn = _sentinel.wrap_inline(comm, sig, fn)
-    run = _plan.spanning_wrap(
-        _plan.spanning_state_for(comm, name, args, kw), fn)
+        fn = _sentinel.wrap_inline(comm, sig, fn,
+                                   _inline_tpl(state, sig))
+    run = _plan.spanning_wrap(state, fn)
     op = _make_op(comm, name, run, (comm,) + tuple(args), kw)
     req = _op_request(op)  # callback wired BEFORE the engine sees it
     _post(comm, op)
@@ -252,12 +268,13 @@ def run_blocking(comm, name: str, fn: Callable, args: Tuple,
     # comm for c_coll entries; note() strips it), and the plan state
     # keys on the same signature the i-family/persistent paths use
     user_args = args[1:] if args and args[0] is comm else args
+    state = _plan.spanning_state_for(comm, name, user_args, kw)
     if _sentinel.enabled:
         sig = _sentinel.note(comm, name, user_args, kw)
         if sig is not None:
-            fn = _sentinel.wrap_inline(comm, sig, fn)
-    run = _plan.spanning_wrap(
-        _plan.spanning_state_for(comm, name, user_args, kw), fn)
+            fn = _sentinel.wrap_inline(comm, sig, fn,
+                                       _inline_tpl(state, sig))
+    run = _plan.spanning_wrap(state, fn)
     op = _make_op(comm, name, run, args, kw)
     _post(comm, op)
     _orch.add(_time.perf_counter() - t0)
@@ -332,7 +349,8 @@ def persistent(comm, name: str, args: Tuple, kw: Optional[Dict] = None
                 if _sentinel.enabled:
                     sig = _sentinel.note(comm, name, args, kw)
                     if sig is not None:
-                        run = _sentinel.wrap_inline(comm, sig, fn)
+                        run = _sentinel.wrap_inline(
+                            comm, sig, fn, _inline_tpl(state, sig))
                 run = _plan.spanning_wrap(state, run)
                 op = _make_op(comm, name, run, (comm,) + tuple(args),
                               kw)

@@ -45,17 +45,30 @@ at the next plan, never mid-schedule. A schedule that still diverges
 from its frozen plan mid-run (structure mismatch) is a loud typed
 error naming the fix, never a silently wrong frame.
 
-Scope guards: plans engage only while obs is OFF (an observed run
-must keep its full span/flow/skew record, so it falls back to the
-interpreted path), only for the fixed-signature collective families
-(``_PLANNABLE``), and only when the call signature is hashable
-metadata (:func:`signature_of` returns None for ragged v-variants and
-pair ops, which stay interpreted).
+Observability is a property of the steady state, not a mode that
+replaces it: an observed run KEEPS firing frozen plans. Each observed
+compiled fire appends one fixed-size binary record — plan id, posting
+seq, fire start/end, and one clock read per planned wire round — to
+the plan-relative flight recorder (:mod:`~..obs.ledger`), which
+registered the plan's full round/flow structure once at freeze time;
+``tpu-doctor`` expands the records back into synthetic spans with the
+interpreted path's exact flow ids. The ``obs_trace_sample`` cvar runs
+1-in-N observed fires through the fully interpreted path for
+ground-truth deep traces (the frozen plan survives), and inline
+sentinel checking (level 2) rides the planned path over ctl frames —
+neither tracing nor contract checking de-optimizes the hot path.
+
+Scope guards: plans engage only for the fixed-signature collective
+families (``_PLANNABLE``), and only when the call signature is
+hashable metadata (:func:`signature_of` returns None for ragged
+v-variants and pair ops, which stay interpreted).
 
 pvars: ``coll_compiled_cache_hits`` (1 = fired a frozen plan, 0 = a
 capturing run froze one; sum/count = steady-state hit ratio, printed
-by ``obs --selftest``). Orchestration time is witnessed by the
-driver's ``coll_orchestration_seconds`` timer, which both legs feed.
+by ``obs --selftest``) — identical with obs on and off, the satellite
+contract tpu_top's compiled-fire ratio column reads. Orchestration
+time is witnessed by the driver's ``coll_orchestration_seconds``
+timer, which both legs feed.
 """
 
 from __future__ import annotations
@@ -69,6 +82,8 @@ import numpy as np
 from .. import obs as _obs
 from ..mca import pvar
 from ..mca import var as mca_var
+from ..obs import ledger as _ledger
+from ..obs import watchdog as _watchdog
 from ..utils.errors import ErrorCode, MPIError
 
 #: plan-cache outcome per plannable collective fire: 1 = a frozen plan
@@ -94,6 +109,14 @@ def register_vars() -> None:
         "blocking, and i-family collectives in steady state; false "
         "restores the fully interpreted per-call dispatch",
     )
+    mca_var.register(
+        "obs_trace_sample", "int", 0,
+        "With obs on, run every Nth compiled-plan fire through the "
+        "fully interpreted path for a ground-truth deep trace (full "
+        "span/flow record); 0 = never — compiled fires are always "
+        "flight-recorded in the obs ledger. Set identically on every "
+        "rank (fire counters advance in lockstep)",
+    )
 
 
 register_vars()  # idempotent; the cvar must exist before first dispatch
@@ -115,10 +138,10 @@ _PLANNABLE = frozenset({
 _driver = None
 _jnp = None
 
-#: (gen, enabled, overlap) snapshot of the coll_compiled /
-#: wire_overlap_exchange cvars — re-resolved only when the registry
-#: write generation moves
-_conf = (-1, True, True)
+#: (gen, enabled, overlap, trace_sample) snapshot of the
+#: coll_compiled / wire_overlap_exchange / obs_trace_sample cvars —
+#: re-resolved only when the registry write generation moves
+_conf = (-1, True, True, 0)
 
 _lock = threading.Lock()
 #: (cid, signature) -> device-plan entry {"gen", "prog"|"bad"}
@@ -138,12 +161,13 @@ def _lazy_driver():
     return _driver
 
 
-def _refresh_conf() -> Tuple[int, bool, bool]:
+def _refresh_conf() -> Tuple[int, bool, bool, int]:
     global _conf
     gen = mca_var.VARS.generation
     if _conf[0] != gen:
         _conf = (gen, bool(mca_var.get("coll_compiled", True)),
-                 bool(mca_var.get("wire_overlap_exchange", True)))
+                 bool(mca_var.get("wire_overlap_exchange", True)),
+                 int(mca_var.get("obs_trace_sample", 0) or 0))
     return _conf
 
 
@@ -157,6 +181,50 @@ def _overlap_on() -> bool:
     # sends, e.g. around a flaky fabric) must keep spanning fires
     # fully interpreted, where _XchgAdapter honors the flag
     return _refresh_conf()[2]
+
+
+def _trace_sample() -> int:
+    return _refresh_conf()[3]
+
+
+#: live planned replays, keyed by plan-state identity: the watchdog's
+#: "frozen_plans" contributor names the plan (id, signature, round
+#: index) a rank is stuck inside, instead of just raw wire waits.
+#: Mutated only under an ``_obs.enabled`` gate (postmortems only fire
+#: with obs on), so the unobserved hot path never touches it.
+_active_replays: Dict[int, Tuple["SpanningPlanState",
+                                 "PlannedXchg"]] = {}
+
+
+def _frozen_plans_snapshot() -> Dict[str, Any]:
+    out = []
+    for st, px in list(_active_replays.values()):
+        plan = px.plan
+        out.append({
+            "plan": plan.ledger_id, "name": st.name,
+            "comm": getattr(st.comm, "name", "?"), "cid": plan.cid,
+            "signature": _ledger._sig_summary(st.sig),
+            "round": px.i, "rounds_total": len(plan.rounds),
+        })
+    return {"active_replays": out, **cache_stats()}
+
+
+_watchdog.add_contributor("frozen_plans", _frozen_plans_snapshot)
+
+
+def _sig_nbytes(sig: Tuple) -> int:
+    """Payload bytes of a plan signature's first array argument (the
+    flight recorder's per-fire byte accounting for device plans)."""
+    for d in sig[1:]:
+        if isinstance(d, tuple) and d and d[0] == "arr":
+            n = 1
+            for s in d[1]:
+                n *= int(s)
+            try:
+                return n * int(np.dtype(d[2]).itemsize)
+            except TypeError:
+                return 0
+    return 0
 
 
 def clear_comm(cid: int) -> None:
@@ -184,6 +252,7 @@ def _reset_for_tests() -> None:
     with _lock:
         _device_plans.clear()
         _span_states.clear()
+        _active_replays.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -275,11 +344,24 @@ def dispatch(comm, name: str, fn: Callable, args: Tuple,
     e = _device_plans.get(key)
     if e is not None and e["gen"] == gen:
         prog = e.get("prog")
-        if prog is not None and not _obs.enabled:
-            # the steady state: an OBSERVED run falls through to the
-            # interpreted path instead (its spans/skew record must
-            # stay complete), but the plan survives for the next
-            # unobserved fire
+        if prog is not None:
+            # the steady state — observed or not. An observed fire is
+            # flight-recorded (one fixed-size ledger record, no span
+            # objects); obs_trace_sample=N diverts every Nth observed
+            # fire through the interpreted path for a ground-truth
+            # deep trace, plan intact.
+            obs_on = _obs.enabled
+            if obs_on:
+                n = _trace_sample()
+                if n > 0:
+                    f = e["fires"] = e.get("fires", 0) + 1
+                    if f % n == 0:
+                        d = _lazy_driver()
+                        d.orch_mark(t0)
+                        try:
+                            return fn(comm, *args, **(kw or {}))
+                        finally:
+                            d.orch_clear()
             d = _lazy_driver()
             # pvar continuity: a frozen-plan fire IS an invocation and
             # a (deeper) plan-cache hit — MPI_T series must not dip
@@ -295,8 +377,17 @@ def dispatch(comm, name: str, fn: Callable, args: Tuple,
             # exactly where run_sharded closes it on the interpreted
             # leg — the two legs time the identical span
             d._orch.add(_time.perf_counter() - t0)
-            return prog(_jnp.asarray(args[0]))
-        if prog is not None or "bad" in e:
+            if not obs_on:
+                return prog(_jnp.asarray(args[0]))
+            out = prog(_jnp.asarray(args[0]))
+            lid = e.get("lid")
+            if lid is None:
+                lid = e["lid"] = _ledger.register_device_plan(
+                    comm.cid, name, _sig_nbytes(sig), sig)
+            _ledger.record_fire(_ledger.KIND_DEVICE, lid, comm.cid,
+                                t0, _time.perf_counter())
+            return out
+        if "bad" in e:
             return fn(comm, *args, **(kw or {}))
     # capture attempt: interpreted run with program-dispatch recording
     d = _lazy_driver()
@@ -384,7 +475,7 @@ class WirePlan:
     :class:`~..btl.components.FrameTemplate`), plus the plan-time
     ``wire_coll_timeout_ms`` snapshot replay waits are bounded by."""
 
-    __slots__ = ("gen", "cid", "rounds", "timeout_ms")
+    __slots__ = ("gen", "cid", "rounds", "timeout_ms", "ledger_id")
 
     def __init__(self, gen: int, cid: int, rounds: List[WireRound],
                  timeout_ms: int) -> None:
@@ -392,6 +483,10 @@ class WirePlan:
         self.cid = cid
         self.rounds = rounds
         self.timeout_ms = timeout_ms
+        #: flight-recorder plan id — registered lazily at the first
+        #: OBSERVED fire (obs/ledger holds the frozen round/flow
+        #: structure; fires then append fixed-size records only)
+        self.ledger_id: Optional[int] = None
 
 
 def freeze_wire_plan(comm, recorded: List[Tuple[Tuple, Tuple]],
@@ -434,12 +529,16 @@ class PlannedXchg:
     in arrival order. Divergence is a loud typed error — frames from
     a wrong header would corrupt the peer's reassembly."""
 
-    __slots__ = ("m", "plan", "i")
+    __slots__ = ("m", "plan", "i", "ts")
 
     def __init__(self, module, plan: WirePlan) -> None:
         self.m = module
         self.plan = plan
         self.i = 0
+        #: round-end clock reads for the flight recorder (one
+        #: perf_counter per planned round); None = unobserved fire,
+        #: zero clock reads
+        self.ts: Optional[List[float]] = None
 
     def _mismatch(self, detail: str) -> MPIError:
         return MPIError(
@@ -472,9 +571,17 @@ class PlannedXchg:
             m._send_all_planned(rnd, sends_f)
         got: Dict[int, list] = {p: [] for p in rnd.recvs}
         if rnd.recvs:
+            # record=False: the flight recorder owns this fire's
+            # span/flow story (expanded from the plan structure at
+            # doctor time) — per-arrival journal spans here would
+            # duplicate the synthetic ones and advance the hier
+            # flow-k counters the expansion re-derives from zero
             m._reap(dict(rnd.recvs),
                     lambda src, arr: got[src].append(arr),
-                    plan.timeout_ms)
+                    plan.timeout_ms, record=False)
+        ts = self.ts
+        if ts is not None:
+            ts.append(_time.perf_counter())
         return got
 
 
@@ -484,12 +591,22 @@ class SpanningPlanState:
     generation bump quietly re-records (cvar writes take effect at
     the next plan, never mid-schedule)."""
 
-    __slots__ = ("comm", "name", "plan")
+    __slots__ = ("comm", "name", "plan", "sig", "fires",
+                 "sentinel_tpl")
 
-    def __init__(self, comm, name: str) -> None:
+    def __init__(self, comm, name: str, sig: Optional[Tuple] = None
+                 ) -> None:
         self.comm = comm
         self.name = name
         self.plan: Optional[WirePlan] = None
+        self.sig = sig
+        #: observed-fire counter driving obs_trace_sample (advances in
+        #: lockstep across ranks: collectives are, by definition,
+        #: fired the same number of times everywhere)
+        self.fires = 0
+        #: (key, InlineFrameTemplate) cache — sentinel level 2's
+        #: precomposed ctl-frame payload for this plan's call shape
+        self.sentinel_tpl: Optional[Tuple] = None
 
     def run(self, fn: Callable, args: Tuple,
             kw: Optional[Dict]) -> Any:
@@ -520,11 +637,26 @@ class SpanningPlanState:
                                 t0, _time.perf_counter() - t0,
                                 comm_id=self.comm.cid)
             return out
-        if _obs.enabled:
-            # observed fires keep the complete interpreted span/flow
-            # record; the frozen plan survives for the next one
-            return fn(*args, **kw)
-        m._xchg = PlannedXchg(m, plan)
+        rec = _obs.enabled
+        if rec:
+            n = _trace_sample()
+            self.fires += 1
+            if n > 0 and self.fires % n == 0:
+                # ground-truth deep trace: every Nth observed fire
+                # runs fully interpreted (complete span/flow record);
+                # the frozen plan survives for the next fire
+                return fn(*args, **kw)
+        px = PlannedXchg(m, plan)
+        t0 = 0.0
+        if rec:
+            if plan.ledger_id is None:
+                plan.ledger_id = _ledger.register_spanning_plan(
+                    self.comm.cid, self.name, m.my_pidx, plan.rounds,
+                    self.sig)
+            px.ts = []
+            _active_replays[id(self)] = (self, px)
+            t0 = _time.perf_counter()
+        m._xchg = px
         try:
             out = fn(*args, **kw)
         except BaseException:
@@ -537,7 +669,18 @@ class SpanningPlanState:
             raise
         finally:
             m._xchg = old
+            if rec:
+                _active_replays.pop(id(self), None)
         _compiled_hits.observe(1)
+        if rec and _obs.enabled:
+            # one fixed-size binary record; round0 is the hier round
+            # counter _wrap advanced for this fire (synchronized
+            # across ranks under obs), the flow-id base the doctor's
+            # expansion shares with the interpreted path
+            _ledger.record_fire(_ledger.KIND_SPANNING, plan.ledger_id,
+                                self.comm.cid, t0,
+                                _time.perf_counter(),
+                                round0=m._round, round_ts=px.ts)
         return out
 
 
@@ -554,8 +697,8 @@ def spanning_state_for(comm, name: str, args: Tuple,
     st = _span_states.get(key)
     if st is None:
         with _lock:
-            st = _span_states.setdefault(key,
-                                         SpanningPlanState(comm, name))
+            st = _span_states.setdefault(
+                key, SpanningPlanState(comm, name, sig))
     return st
 
 

@@ -173,9 +173,12 @@ if (os.environ.get("OMPI_TPU_OBS", "").strip().lower()
 
 # convenience: obs.export.dump_chrome_trace(...), obs.skew, the stall
 # watchdog, the continuous sampler, the collective contract sentinel,
-# and the doctor merge — imported last so their journal/pvar imports
-# see a fully-initialized package (sampler import also registers the
-# obs_sample_* cvars and the obs_series_points /
-# obs_sample_overhead_seconds pvars; sentinel registers obs_sentinel
-# and the sentinel_ops_hashed / sentinel_mismatches pvars)
-from . import export, sampler, sentinel, skew, watchdog  # noqa: E402,F401
+# the compiled-fire flight recorder, and the doctor merge — imported
+# last so their journal/pvar imports see a fully-initialized package
+# (sampler import also registers the obs_sample_* cvars and the
+# obs_series_points / obs_sample_overhead_seconds pvars; sentinel
+# registers obs_sentinel and the sentinel_ops_hashed /
+# sentinel_mismatches pvars; ledger registers obs_ledger_size and the
+# ledger_records / ledger_dropped pvars)
+from . import export, ledger, sampler, sentinel  # noqa: E402,F401
+from . import skew, watchdog  # noqa: E402,F401

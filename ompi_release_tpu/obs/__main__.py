@@ -296,6 +296,38 @@ def selftest() -> int:
           + (f" ({src['path']})" if src.get("path") else "")
           + "; db register/select round-trip ok")
 
+    # 11. plan-relative flight recorder (obs/ledger): a spanning fire
+    # record encodes fixed-size, decodes losslessly, and expands
+    # against its frozen plan metadata into synthetic spans whose
+    # flow ids pair with the complementary rank's expansion — all
+    # device-free (no plan ever fires here)
+    from types import SimpleNamespace as _NS
+
+    from . import ledger as _ledger
+
+    _ledger._reset_for_tests()
+    arrs = [((64,), "float32")]
+    lp0 = _ledger.register_spanning_plan(
+        7, "allreduce", 0, [_NS(sends_meta=[(1, arrs)], recvs_t=[])])
+    lp1 = _ledger.register_spanning_plan(
+        7, "allreduce", 1, [_NS(sends_meta=[], recvs_t=[(0, 1)])])
+    seq = _ledger.record_fire(_ledger.KIND_SPANNING, lp0, 7,
+                              1.0, 2.0, round0=5, round_ts=(1.5,))
+    rec = _ledger.records()[-1]
+    assert rec["seq"] == seq and rec["round_ts"] == [1.5], rec
+    assert rec["plan"] == lp0 and rec["round0"] == 5, rec
+    docs = {str(k): v for k, v in _ledger.plans().items()}
+    send_spans = _ledger.expand_record(rec, docs)
+    recv_spans = _ledger.expand_record(dict(rec, plan=lp1), docs)
+    s_flows = [s["flow"] for s in send_spans if s.get("fs") == "s"]
+    t_flows = [s["flow"] for s in recv_spans if s.get("fs") == "t"]
+    assert s_flows and s_flows == t_flows, (s_flows, t_flows)
+    assert any(s["op"] == "allreduce_wire_round0" for s in send_spans)
+    rb = _ledger.snapshot()["record_bytes"] + 8 * len(rec["round_ts"])
+    print(f"flight recorder: {rb}B/record, "
+          f"{len(send_spans)} spans expanded, flow ids pair "
+          f"({s_flows[0]:#x})")
+
     disable()
     print("obs selftest: ok")
     return 0

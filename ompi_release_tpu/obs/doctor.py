@@ -45,7 +45,11 @@ def load_dir(directory: str) -> List[Dict[str, Any]]:
     """Every ``journal-p*.json`` under ``directory``, plus — for ranks
     that never finalized (a hung rank killed mid-job leaves ONLY
     postmortems) — the journal tail of that rank's newest
-    ``postmortem-*.json``."""
+    ``postmortem-*.json``. ``ledger-p*.json`` flight-recorder dumps
+    are expanded against their frozen-plan metadata into synthetic
+    spans and merged into the matching rank's span list (compiled
+    fires carry the interpreted path's flow ids, so flow arrows and
+    skew rounds include compiled traffic)."""
     dumps = []
     for p in sorted(glob.glob(os.path.join(directory, "journal-p*.json"))):
         dumps.append(load_dump(p))
@@ -84,13 +88,64 @@ def load_dir(directory: str) -> List[Dict[str, Any]]:
             "spans": tail,
         })
     dumps.extend(d for _, (_, d) in sorted(newest.items()))
+    attach_ledgers(dumps, directory)
     dumps.sort(key=lambda d: int(d["meta"].get("pidx", 0)))
     if not dumps:
         raise FileNotFoundError(
-            f"no journal-p*.json or postmortem-*.json dumps under "
-            f"{directory} (set --mca obs_dump_dir, or send SIGUSR1 to "
-            "the ranks first)")
+            f"no journal-p*.json, postmortem-*.json, or "
+            f"ledger-p*.json dumps under {directory} (set --mca "
+            "obs_dump_dir, or send SIGUSR1 to the ranks first)")
     return dumps
+
+
+def load_ledger_dump(path: str) -> Dict[str, Any]:
+    from . import ledger as _ledger
+
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("format") != _ledger.FORMAT:
+        raise ValueError(f"{path}: not a flight-recorder ledger dump "
+                         f"(format != {_ledger.FORMAT})")
+    return doc
+
+
+def attach_ledgers(dumps: List[Dict[str, Any]],
+                   directory: str) -> None:
+    """Expand every ``ledger-p*.json`` under ``directory`` into
+    synthetic spans and merge them into the matching rank's dump (a
+    rank with no journal dump gets a fresh one from the ledger's own
+    identity). The combined span list is re-sorted by start time so
+    the skew report's call-order round alignment holds across real
+    and synthetic spans."""
+    from . import ledger as _ledger
+
+    by_pidx = {int(d["meta"].get("pidx", 0)): d for d in dumps}
+    for p in sorted(glob.glob(os.path.join(directory,
+                                           "ledger-p*.json"))):
+        try:
+            doc = load_ledger_dump(p)
+        except (ValueError, OSError):
+            continue
+        spans = _ledger.expand_dump(doc)
+        if not spans:
+            continue
+        meta = doc.get("meta") or {}
+        pidx = int(meta.get("pidx", 0))
+        host = by_pidx.get(pidx)
+        if host is None:
+            host = by_pidx[pidx] = {
+                "meta": {"pidx": pidx,
+                         "rank_offset": meta.get("rank_offset", 0),
+                         "local_size": meta.get("local_size", 0),
+                         "pid": meta.get("pid"),
+                         "clock_offset_s": doc.get("clock_offset_s"),
+                         "clock_rtt_s": None},
+                "spans": []}
+            dumps.append(host)
+        host["spans"] = sorted(
+            list(host["spans"]) + spans,
+            key=lambda s: float(s.get("t", 0.0)))
+        host.pop("_corrected_spans", None)
 
 
 def _offset(meta: Dict[str, Any]) -> float:

@@ -206,6 +206,27 @@ def maybe_dump_series(runtime=None) -> Optional[str]:
     return dump_series_jsonl(os.path.join(d, f"series-p{pidx}.jsonl"))
 
 
+def maybe_dump_ledger(runtime=None) -> Optional[str]:
+    """Finalize hook: when ``obs_dump_dir`` is set, write this rank's
+    compiled-fire flight recorder there as ``ledger-p<pidx>.json``
+    (frozen-plan metadata + fixed-size fire records; tpu-doctor
+    expands it into synthetic spans next to the journal dump). Empty
+    rings write nothing (no compiled fire was observed)."""
+    import os
+
+    from ..mca import var as _var
+    from . import ledger as _ledger
+
+    d = str(_var.get("obs_dump_dir", "") or "")
+    if not d or not _ledger.records():
+        return None
+    os.makedirs(d, exist_ok=True)
+    pidx = 0
+    if runtime is not None and runtime.bootstrap:
+        pidx = int(runtime.bootstrap.get("process_index", 0))
+    return _ledger.dump(os.path.join(d, f"ledger-p{pidx}.json"))
+
+
 # ---------------------------------------------------------------------------
 # Prometheus text exposition
 # ---------------------------------------------------------------------------

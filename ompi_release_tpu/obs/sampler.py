@@ -244,6 +244,7 @@ class Sampler:
         self.ring = ring
         self._prev: Dict[str, Any] = {}
         self._last_seq = 0   # journal cursor for per-cid folding
+        self._ledger_seq = -1  # flight-recorder cursor (same folding)
         self._push_cursor = 0
         self._push_failures = 0
         self._agent = None   # tpurun WorkerAgent (fleet push target)
@@ -285,6 +286,26 @@ class Sampler:
             acc[1] += float(s.nbytes)
             acc[2] += float(s.dt)
         self._last_seq = _obs.journal.total_recorded
+        # 2b. flight-recorder fold: compiled DEVICE fires never touch
+        # the journal (one fixed-size binary ledger record each), so
+        # their per-cid series fold from the ledger's delta since the
+        # last tick. Spanning compiled fires already stamp one
+        # coll-layer journal span per round (hier's _round_end runs
+        # under planned replay too), so only device records fold here
+        # — the series never double count.
+        from . import ledger as _ledger
+
+        new_recs = _ledger.records(self._ledger_seq)
+        if new_recs:
+            plan_meta = _ledger.plans()
+            for r in new_recs:
+                if r["kind"] == _ledger.KIND_DEVICE:
+                    acc = by_cid.setdefault(r["cid"], [0.0, 0.0, 0.0])
+                    acc[0] += 1
+                    acc[1] += float((plan_meta.get(r["plan"]) or {})
+                                    .get("nbytes", 0))
+                    acc[2] += max(0.0, r["t_end"] - r["t_start"])
+            self._ledger_seq = new_recs[-1]["seq"]
         if by_cid:
             from ..ft.ulfm import tenant_of_cid  # import-light
         for cid, (ops, nbytes, secs) in sorted(by_cid.items()):
@@ -418,6 +439,7 @@ def _reset_for_tests() -> None:
     SAMPLER._armed = False
     SAMPLER._prev = {}
     SAMPLER._last_seq = 0
+    SAMPLER._ledger_seq = -1
     SAMPLER._push_cursor = 0
     SAMPLER._push_failures = 0
     RING.clear()

@@ -368,16 +368,50 @@ def note(comm, family: str, args: Tuple = (),
 # ---------------------------------------------------------------------------
 
 
-def wrap_inline(comm, sig: Optional[CallSig], fn):
+class InlineFrameTemplate:
+    """FrameTemplate-style precomposed inline-check payload (the ctl
+    frame :func:`inline_check` exchanges): the constant descriptor
+    fragments — canonical signature text and call site — are
+    JSON-encoded ONCE (at plan time, cached on the frozen plan
+    state), and :meth:`render` splices only the per-fire fields
+    (digest, posting seq, epoch, sig hash). The bytes are IDENTICAL
+    to the interpreted ``digest + json.dumps(descriptor())`` payload,
+    so receivers need no changes and templated/untemplated ranks
+    interoperate — this is what lets sentinel level 2 ride the
+    compiled planned path instead of forcing interpretation."""
+
+    __slots__ = ("key", "_pre_seq", "_pre_epoch", "_pre_sig")
+
+    def __init__(self, canon: str, site: str) -> None:
+        self.key = (canon, site)
+        self._pre_seq = b'{"seq": '
+        self._pre_epoch = (', "canon": %s, "epoch": '
+                           % json.dumps(canon)).encode()
+        self._pre_sig = (', "site": %s, "sig": '
+                         % json.dumps(site)).encode()
+
+    def render(self, sig: CallSig) -> bytes:
+        # json.dumps of an int IS str(int), and descriptor() insertion
+        # order is (seq, canon, epoch, site, sig) — splicing here is
+        # byte-for-byte the interpreted payload
+        return (sig.digest() + self._pre_seq + str(sig.seq).encode()
+                + self._pre_epoch + str(sig.epoch).encode()
+                + self._pre_sig + str(sig.sig_hash).encode() + b"}")
+
+
+def wrap_inline(comm, sig: Optional[CallSig], fn,
+                template: Optional[InlineFrameTemplate] = None):
     """Wrap a spanning round's schedule fn so the signature exchange
     runs at EXECUTION start — strictly before the round's first
     payload frame, in the comm's posting order on every process. A
-    no-op (returns ``fn``) outside inline mode."""
+    no-op (returns ``fn``) outside inline mode. ``template``: a
+    plan-cached :class:`InlineFrameTemplate` so the steady state
+    skips per-fire JSON encoding."""
     if sig is None or _mode < 2 or not comm.spans_processes:
         return fn
 
     def checked(*a, **k):
-        inline_check(comm, sig)
+        inline_check(comm, sig, template)
         return fn(*a, **k)
 
     return checked
@@ -394,7 +428,9 @@ def _rank_of(comm, pidx: int) -> int:
         return -1
 
 
-def inline_check(comm, sig: CallSig) -> None:
+def inline_check(comm, sig: CallSig,
+                 template: Optional[InlineFrameTemplate] = None
+                 ) -> None:
     """Exchange ``sig`` with every member process of ``comm`` and
     raise ``ERR_COLL_MISMATCH`` naming the first divergent process
     when any peer's signature differs. Site hashes are excluded from
@@ -404,7 +440,8 @@ def inline_check(comm, sig: CallSig) -> None:
     router = getattr(comm.runtime, "wire", None)
     if router is None:
         return
-    payload = sig.digest() + json.dumps(sig.descriptor()).encode()
+    payload = (template.render(sig) if template is not None
+               else sig.digest() + json.dumps(sig.descriptor()).encode())
     frames = router.sentinel_exchange(comm, payload)
     for p in sorted(frames):
         raw = frames[p]

@@ -372,6 +372,19 @@ def dump_postmortem(reason: str,
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1, default=str)
         os.replace(tmp, path)
+        try:
+            # the full compiled-fire flight recorder rides beside the
+            # postmortem (the inline ledger_tail contributor carries
+            # only the newest records): tpu-doctor expands it into
+            # synthetic spans for the stalled rank's compiled traffic
+            from . import ledger as _ledger
+
+            if _ledger.records():
+                pidx = ident.get("pidx", 0)
+                _ledger.dump(os.path.join(
+                    os.path.dirname(path), f"ledger-p{pidx}.json"))
+        except Exception:
+            pass  # best-effort, like every other dump section
         if counts_against_cap:
             # budget counts dumps that REACHED disk: a failed write
             # (raised above) must not spend it, or a transient full

@@ -82,8 +82,15 @@ METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_", "compiled_",
 #: ratio — THE service-plane acceptance factor) are lower-better on
 #: the same sim tier: a grown isolation ratio means the weighted-fair
 #: wire lets a bulk tenant degrade a latency tenant further.
-METRIC_LOWER_BETTER_PREFIXES = ("ft_", "sentinel_", "sim_", "steady_",
-                                "tenant_")
+#: The flight-recorder lines are lower-better on the same logic:
+#: ``steady_obs_*`` (obs-ON compiled orchestration seconds and the
+#: obs-ON/obs-OFF overhead ratio — THE "tracing never de-optimizes
+#: the hot path" acceptance factor, already covered by ``steady_``)
+#: and ``ledger_*`` (bytes appended to the per-rank binary ring per
+#: observed compiled fire — a grown record means the fixed-size
+#: fire-path write got heavier).
+METRIC_LOWER_BETTER_PREFIXES = ("ft_", "ledger_", "sentinel_", "sim_",
+                                "steady_", "tenant_")
 
 DEFAULT_SIGMA = 4.0
 #: relative noise floor: the bench's own ceiling docs put single-run

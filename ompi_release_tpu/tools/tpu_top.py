@@ -18,9 +18,14 @@ Four modes:
   metrics plane's dashboard. Renders one row per controller process
   from the sampler's time-series points — collective rate, bytes/s,
   latency percentiles (from the ``coll_*_latency`` histogram pvar
-  deltas), mean arrival skew, and inline STALL / DESYNC / STALE
-  flags (DESYNC counts the contract sentinel's detected cross-rank
-  collective mismatches, ``sentinel_mismatches`` deltas) — either
+  deltas), mean arrival skew, the compiled-fire ratio (``comp%``,
+  from the ``coll_compiled_cache_hits`` aggregate deltas — how much
+  of the window's traffic replayed frozen plans), and inline STALL /
+  DESYNC / DARK / STALE flags (DESYNC counts the contract sentinel's
+  detected cross-rank collective mismatches, ``sentinel_mismatches``
+  deltas; DARK marks a rank whose compiled fires emitted neither
+  spans nor flight-recorder ledger records — observed traffic that
+  tracing cannot see) — either
   live from a job HNP's TAG_SERIES store (discovered via the session
   dir when no target is given) or offline from ``series-p*.jsonl``
   dumps. The refresh loop reconnects with backoff and marks rows
@@ -55,16 +60,26 @@ def summarize_points(points: List[Dict[str, Any]],
     from the ``coll_*_latency`` histogram delta buckets, mean skew
     from ``coll_*_skew_seconds``, a stall flag from
     ``obs_stalls_detected`` deltas, and a desync flag from the
-    contract sentinel's ``sentinel_mismatches`` deltas. ``now``
-    defaults to the newest point's time (dump replay); pass the live
-    clock for live feeds."""
+    contract sentinel's ``sentinel_mismatches`` deltas.
+
+    The compiled steady state is first-class: the compiled-fire ratio
+    folds from the ``coll_compiled_cache_hits`` aggregate deltas
+    (sum = frozen-plan replays, count = total fires through the plan
+    layer), and a rank whose compiled traffic left NO trace — plan
+    replays in the window but neither per-cid ``coll_ops`` span folds
+    nor flight-recorder ``ledger_records`` — comes back ``dark``:
+    obs is on (the sampler only runs under obs) yet the hot path is
+    invisible, exactly the de-optimization regression this plane
+    exists to catch. ``now`` defaults to the newest point's time
+    (dump replay); pass the live clock for live feeds."""
     from ..obs.sampler import percentile
 
     if not points:
         return {"ops_s": None, "mb_s": None, "p50_ms": None,
                 "p99_ms": None, "skew_ms": None, "stalls": 0,
                 "desyncs": 0, "cids": [], "age_s": None,
-                "window_s": 0.0}
+                "window_s": 0.0, "compiled_frac": None,
+                "ledger_records": 0, "dark": False}
     ts = [float(p["t"]) for p in points]
     t_new = max(ts)
     if now is None:
@@ -74,6 +89,7 @@ def summarize_points(points: List[Dict[str, Any]],
     lat_buckets: Dict[float, float] = {}
     skew_sum = skew_count = 0.0
     stalls = desyncs = 0.0
+    plan_hits = plan_fires = ledger_recs = 0.0
     cids = set()
     t_used = []
     for p in points:
@@ -100,6 +116,11 @@ def summarize_points(points: List[Dict[str, Any]],
             stalls += float(v or 0)
         elif name == "sentinel_mismatches":
             desyncs += float(v or 0)
+        elif name == "coll_compiled_cache_hits" and isinstance(v, dict):
+            plan_hits += float(v.get("sum", 0.0) or 0.0)
+            plan_fires += float(v.get("count", 0.0) or 0.0)
+        elif name == "ledger_records":
+            ledger_recs += float(v or 0)
     # a window holding a single sampler tick has NO measurable span —
     # rates are unknown then, not "whatever 1 ms would imply" (a lone
     # 10-op tick must render '-', never 10000 coll/s)
@@ -119,6 +140,11 @@ def summarize_points(points: List[Dict[str, Any]],
         "cids": sorted(c for c in cids if c >= 0),
         "age_s": max(now - t_new, 0.0),
         "window_s": window or 0.0,
+        "compiled_frac": (plan_hits / plan_fires
+                          if plan_fires else None),
+        "ledger_records": int(ledger_recs),
+        "dark": bool(plan_hits > 0 and ops == 0
+                     and ledger_recs == 0),
     }
 
 
@@ -134,7 +160,7 @@ def render_fleet(docs: List[Dict[str, Any]], window_s: float = 15.0,
     ``obs.doctor.fleet_to_series_docs``)."""
     head = (f"  {'proc':>4} {'ranks':>9} {'coll/s':>8} {'MB/s':>9} "
             f"{'p50 ms':>8} {'p99 ms':>8} {'skew ms':>8} "
-            f"{'cids':>6} flags")
+            f"{'comp%':>6} {'cids':>6} flags")
     lines = [head]
     for d in docs:
         m = d.get("meta") or {}
@@ -149,6 +175,10 @@ def render_fleet(docs: List[Dict[str, Any]], window_s: float = 15.0,
             flags.append(f"STALL×{s['stalls']}")
         if s["desyncs"]:
             flags.append(f"DESYNC×{s['desyncs']}")
+        if s["dark"]:
+            # compiled fires in the window but zero spans AND zero
+            # flight-recorder records: the hot path went invisible
+            flags.append("DARK")
         age = m.get("push_age_s")
         if age is None:
             age = s["age_s"]
@@ -162,6 +192,7 @@ def render_fleet(docs: List[Dict[str, Any]], window_s: float = 15.0,
             f"{_fmt(s['p50_ms'], '8.3f'):>8} "
             f"{_fmt(s['p99_ms'], '8.3f'):>8} "
             f"{_fmt(s['skew_ms'], '8.3f'):>8} "
+            f"{_fmt(s['compiled_frac'] * 100 if s['compiled_frac'] is not None else None, '5.1f'):>6} "
             f"{len(s['cids']):>6} {' '.join(flags)}".rstrip())
     if len(lines) == 1:
         lines.append("  (no series points yet — is obs_sample_interval "

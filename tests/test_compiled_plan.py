@@ -251,6 +251,9 @@ class _FakeInner:
 
 
 class _FakeModule:
+    my_pidx = 0   # the observed replay path registers the
+    _round = 0    # spanning plan (pidx) and stamps round0
+
     def __init__(self, comm):
         self.comm = comm
         self._xchg = _FakeInner()
@@ -260,7 +263,7 @@ class _FakeModule:
     def _send_all_planned(self, rnd, sends):
         self.planned_rounds.append(rnd)
 
-    def _reap(self, pending, on_arrival, timeout_ms=None):
+    def _reap(self, pending, on_arrival, timeout_ms=None, record=True):
         self.reap_timeouts.append(timeout_ms)
         for p, c in pending.items():
             for _ in range(c):
@@ -380,6 +383,51 @@ class TestWirePlanReplay:
         finally:
             mca_var.VARS.unset("wire_pipeline_depth")
 
+    def test_watchdog_contributor_names_active_replay(self,
+                                                      monkeypatch):
+        """Satellite: a rank stuck mid-``PlannedXchg`` gets a
+        postmortem that names the frozen plan it is inside — ledger
+        plan id, collective, signature, round index — via the
+        watchdog's ``frozen_plans`` contributor, and the entry clears
+        once the fire completes."""
+        import ompi_release_tpu.obs as obs
+
+        comm = _fake_comm(911)
+        m = comm._hier_module
+        state = plan.SpanningPlanState(comm, "allreduce")
+        monkeypatch.setattr(
+            plan, "freeze_wire_plan",
+            lambda c, rec, gen: _manual_plan(rec, gen, c.cid))
+        pay = np.ones(4, np.float32)
+        state.run(lambda: _schedule(m, pay), (), {})  # record
+        seen = {}
+        orig = _FakeModule._reap
+
+        def spy(self, pending, on_arrival, timeout_ms=None,
+                record=True):
+            # what the watchdog would dump while we wait in _reap
+            seen.setdefault("snap", plan._frozen_plans_snapshot())
+            return orig(self, pending, on_arrival, timeout_ms, record)
+
+        monkeypatch.setattr(_FakeModule, "_reap", spy)
+        obs.enable()
+        try:
+            state.run(lambda: _schedule(m, pay), (), {})  # replay
+        finally:
+            obs.disable()
+        snap = seen["snap"]
+        active = [a for a in snap["active_replays"]
+                  if a["cid"] == 911]
+        assert active, snap
+        a = active[0]
+        assert a["name"] == "allreduce"
+        assert a["rounds_total"] == 2 and 1 <= a["round"] <= 2
+        assert a["plan"] is not None, "ledger plan id not registered"
+        assert "fires" in snap and "hits" in snap  # cache stats ride
+        # ...and the live entry clears when the fire completes
+        after = plan._frozen_plans_snapshot()["active_replays"]
+        assert not any(x["cid"] == 911 for x in after)
+
 
 # ---------------------------------------------------------------------------
 # 3. in-process compiled plans (real 8-device world)
@@ -462,8 +510,14 @@ class TestDevicePlans:
         finally:
             comm.free()
 
-    def test_obs_on_falls_back_to_interpreted(self, world):
+    def test_obs_on_rides_the_compiled_plan(self, world):
+        """Observability is a property of the steady state: enabling
+        obs must NOT bounce frozen plans back to the interpreted
+        path.  An observed fire replays the compiled program (hit
+        counter advances), stays bitwise-identical, and appends a
+        fixed-size flight-recorder record to the obs ledger."""
         import ompi_release_tpu.obs as obs_pkg
+        from ompi_release_tpu.obs import ledger as obs_ledger
 
         x = np.ones((world.size, 8), np.float32)
         comm = world.dup(name="plan_obs")
@@ -473,14 +527,24 @@ class TestDevicePlans:
             obs_pkg.enable()
             try:
                 h0 = _pv("coll_compiled_cache_hits")
+                r0 = len(obs_ledger.records())
                 got = np.asarray(comm.allreduce(x))
                 h1 = _pv("coll_compiled_cache_hits")
+                recs = obs_ledger.records()
             finally:
                 if not was:
                     obs_pkg.disable()
             np.testing.assert_array_equal(got, want)
-            assert h1["count"] == h0["count"], \
-                "observed runs must ride the interpreted path"
+            assert h1["count"] == h0["count"] + 1
+            assert h1["sum"] == h0["sum"] + 1, \
+                "the observed fire must replay the frozen plan"
+            new = recs[r0:]
+            assert any(r["cid"] == comm.cid for r in new), \
+                "observed compiled fire must land in the ledger"
+            pid = [r["plan"] for r in new if r["cid"] == comm.cid][-1]
+            meta = obs_ledger.plans()[pid]
+            assert meta["kind"] == "device"
+            assert meta["name"] == "allreduce"
         finally:
             comm.free()
 
@@ -727,6 +791,86 @@ class TestCompiledPlanJob:
         assert rc == 0, out.out + out.err
         assert job.job_state.visited(JobState.TERMINATED)
         assert out.out.count("PLAN-JOB-OK") == 3
+
+    def test_obs_on_job_matches_obs_off_and_reconstructs_flows(
+            self, tmp_path, capfd):
+        """THE regression satellite: a 3-process job fires the same
+        spanning allreduce with obs OFF then ON. The obs-ON fires must
+        replay the SAME frozen wire plan (identical
+        ``coll_compiled_cache_hits`` deltas — observability no longer
+        bounces plans to the interpreted path), stay bitwise-identical,
+        and land fixed-size flight-recorder records whose doctor
+        expansion reconstructs cross-process flow arrows in the merged
+        trace."""
+        dump_dir = tmp_path / "obs"
+        dump_dir.mkdir()
+        app = tmp_path / "app.py"
+        app.write_text(APP_PRELUDE + textwrap.dedent("""
+            world = mpi.init()
+            rt = Runtime.current()
+            off = rt.local_rank_offset
+            n = world.size
+            # set BEFORE the first freeze: a cvar write bumps the
+            # tuning generation and would re-plan the next fire
+            mca_var.set_value("obs_dump_dir", DUMP_DIR)
+            x = np.stack([np.arange(512, dtype=np.float32)
+                          * (off + i + 1) for i in range(2)])
+            first = np.asarray(world.allreduce(x))  # record + freeze
+
+            h0 = _pv("coll_compiled_cache_hits")
+            for _ in range(3):
+                got = np.asarray(world.allreduce(x))   # obs OFF
+                np.testing.assert_array_equal(got, first)  # BITWISE
+            h1 = _pv("coll_compiled_cache_hits")
+            d_off = (h1["sum"] - h0["sum"], h1["count"] - h0["count"])
+
+            import ompi_release_tpu.obs as obs
+            from ompi_release_tpu.obs import ledger
+            obs.enable()
+            h0 = _pv("coll_compiled_cache_hits")
+            for _ in range(3):
+                got = np.asarray(world.allreduce(x))   # obs ON
+                np.testing.assert_array_equal(got, first)  # BITWISE
+            h1 = _pv("coll_compiled_cache_hits")
+            d_on = (h1["sum"] - h0["sum"], h1["count"] - h0["count"])
+            assert d_on == d_off == (3, 3), (d_off, d_on)
+
+            recs = [r for r in ledger.records()
+                    if r["kind"] == ledger.KIND_SPANNING]
+            assert len(recs) == 3, recs
+            assert all(len(r["round_ts"]) >= 1 for r in recs)
+            meta = ledger.plans()[recs[0]["plan"]]
+            assert meta["name"] == "allreduce"
+            assert len(meta["rounds"]) == len(recs[0]["round_ts"])
+            print("OBS-PLAN-JOB-OK", flush=True)
+            mpi.finalize()  # dumps journal-p*.json + ledger-p*.json
+        """).replace("DUMP_DIR", repr(str(dump_dir))))
+        job = Job(3, [sys.executable, str(app)], [],
+                  heartbeat_s=0.5, miss_limit=8)
+        rc = job.run(timeout_s=240)
+        out = capfd.readouterr()
+        assert rc == 0, out.out + out.err
+        assert out.out.count("OBS-PLAN-JOB-OK") == 3
+
+        # every rank dumped its flight-recorder ring at finalize...
+        from ompi_release_tpu.obs import doctor
+        ledgers = sorted(dump_dir.glob("ledger-p*.json"))
+        assert len(ledgers) == 3, list(dump_dir.iterdir())
+        # ...and the doctor merge expands them into synthetic spans
+        # that name the compiled collective's wire rounds and pair
+        # into cross-process flow arrows
+        dumps = doctor.load_dir(str(dump_dir))
+        led = [s for d in dumps for s in d["spans"] if s.get("ledger")]
+        assert led, "no ledger-reconstructed spans in the merge"
+        assert any(str(s["op"]).startswith("allreduce_wire_round")
+                   for s in led)
+        pairs = [p for p in doctor.flow_pairs(dumps)
+                 if p["src"].get("ledger") and p["cross_process"]]
+        assert pairs, "ledger flows did not pair into arrows"
+        trace = doctor.merge(dumps)
+        assert trace["otherData"]["cross_process_flows"] > 0
+        assert any(e.get("cat") == "flow" for e in
+                   trace["traceEvents"]), "merged trace lost the flows"
 
 
 # ---------------------------------------------------------------------------

@@ -449,6 +449,48 @@ class TestTpuTop:
         out = capsys.readouterr().out
         assert rc == 0 and "ompitpu_" in out
 
+    def test_compiled_fire_ratio_column(self):
+        """comp% folds from the coll_compiled_cache_hits AGGREGATE
+        deltas: sum = frozen-plan replays, count = fires through the
+        plan layer."""
+        from ompi_release_tpu.tools.tpu_top import (render_fleet,
+                                                    summarize_points)
+
+        pts = [_pt(0, 1.0, -1, "coll_compiled_cache_hits",
+                   {"sum": 9.0, "count": 10.0}),
+               _pt(1, 2.0, 0, "coll_ops", 10.0),
+               _pt(2, 2.0, -1, "ledger_records", 9.0)]
+        s = summarize_points(pts)
+        assert s["compiled_frac"] == pytest.approx(0.9)
+        assert s["ledger_records"] == 9
+        assert s["dark"] is False
+        table = render_fleet([{"meta": {"pidx": 0}, "points": pts}])
+        assert "comp%" in table and " 90.0" in table
+        assert "DARK" not in table
+        # no plan traffic in the window: the column renders '-'
+        s2 = summarize_points([_pt(0, 1.0, 0, "coll_ops", 1.0)])
+        assert s2["compiled_frac"] is None
+
+    def test_dark_rank_flagged(self):
+        """A rank replaying frozen plans whose window shows NEITHER
+        journal-derived coll_ops points NOR flight-recorder records is
+        DARK: obs is on (the sampler only runs under obs) but the
+        compiled hot path left no trace — the exact de-optimization
+        regression the flight recorder exists to prevent."""
+        from ompi_release_tpu.tools.tpu_top import (render_fleet,
+                                                    summarize_points)
+
+        pts = [_pt(0, 1.0, -1, "coll_compiled_cache_hits",
+                   {"sum": 5.0, "count": 5.0}),
+               _pt(1, 2.0, -1, "obs_sample_overhead_pad", 1.0)]
+        s = summarize_points(pts)
+        assert s["dark"] is True
+        table = render_fleet([{"meta": {"pidx": 2}, "points": pts}])
+        assert "DARK" in table
+        # one ledger record in the window clears the flag
+        lit = pts + [_pt(2, 2.0, -1, "ledger_records", 5.0)]
+        assert summarize_points(lit)["dark"] is False
+
     def test_server_series_rpc(self, obs_sampling):
         from ompi_release_tpu.tools.tpu_server import (NameClient,
                                                        NameServer)
@@ -679,6 +721,58 @@ class TestBenchGate:
              ln("compiled_allreduce_256KiB_orch_speedup", 2.42,
                 "x_orchestration")])
         assert gate2.main(hist + ["--candidate", str(ok)]) == 0
+
+    def test_flight_recorder_metric_directions(self, tmp_path):
+        """The flight-recorder lines: steady_obs_* (obs-ON compiled
+        orchestration seconds and the obs-ON/obs-OFF overhead ratio —
+        the "tracing never de-optimizes the hot path" budget) and
+        ledger_* (bytes per fire record) are all lower-better, so the
+        gate trips when enabling obs gets more expensive or the
+        fixed-size record grows."""
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        assert gate._direction(
+            "s", "steady_obs_orch_spanning_allreduce_256KiB_compiled"
+        ) == -1
+        assert gate._direction(
+            "ratio", "steady_obs_overhead_spanning_allreduce_256KiB"
+        ) == -1
+        assert gate._direction(
+            "bytes", "ledger_record_bytes_spanning_allreduce_256KiB"
+        ) == -1
+
+        def ln(metric, v, unit):
+            return {"metric": metric, "value": v, "unit": unit,
+                    "vs_baseline": None, "tier_label": "loopback-cpu"}
+
+        hist = [_round_file(
+            tmp_path / f"BENCH_r{k:02d}.json",
+            [ln("steady_obs_overhead_spanning_allreduce_256KiB",
+                1.05 + 0.01 * k, "ratio"),
+             ln("ledger_record_bytes_spanning_allreduce_256KiB",
+                55, "bytes")]) for k in range(4)]
+        # the obs-ON leg blowing past its 1.15x budget (tracing
+        # de-optimized the hot path again) or a fattened record trips
+        bad = _round_file(
+            tmp_path / "cand.json",
+            [ln("steady_obs_overhead_spanning_allreduce_256KiB",
+                4.0, "ratio"),
+             ln("ledger_record_bytes_spanning_allreduce_256KiB",
+                2048, "bytes")])
+        verdict = gate.evaluate(
+            [gate.parse_round_file(p) for p in hist],
+            gate.parse_round_file(bad))
+        regressed = {r["metric"] for r in verdict["regressions"]}
+        assert regressed == {
+            "steady_obs_overhead_spanning_allreduce_256KiB",
+            "ledger_record_bytes_spanning_allreduce_256KiB"}
+        ok = _round_file(
+            tmp_path / "ok.json",
+            [ln("steady_obs_overhead_spanning_allreduce_256KiB",
+                1.06, "ratio"),
+             ln("ledger_record_bytes_spanning_allreduce_256KiB",
+                55, "bytes")])
+        assert gate.main(hist + ["--candidate", str(ok)]) == 0
 
     def test_topo_metric_directions(self, tmp_path):
         """The fleet_scaling suite's topo_* lines (topology-aware

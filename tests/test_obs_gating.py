@@ -48,7 +48,8 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/tuning/db.py",
            "ompi_release_tpu/tuning/retune.py",
            "ompi_release_tpu/service/qos.py",
-           "ompi_release_tpu/service/tenant.py")
+           "ompi_release_tpu/service/tenant.py",
+           "ompi_release_tpu/obs/ledger.py")
 
 #: attribute calls that ARE emit sites when ungated
 EMIT_ATTRS = {"record", "begin", "body", "end", "arm"}

@@ -424,6 +424,26 @@ def test_bench_gate_sentinel_metrics_are_lower_better():
     assert not evaluate(hist, ok)["regressions"]
 
 
+def test_inline_frame_template_renders_byte_identical_payload():
+    """The planned path's precomposed ctl frame: for any (seq, epoch)
+    the template's render must be byte-for-byte what the interpreted
+    inline check serializes — digest + json.dumps(descriptor()) — or
+    planned and interpreted ranks would flag each other as desynced."""
+    canon = sentinel.make_canon("allreduce", "sum", "float32", 512, -1)
+    site = "app.py:42"
+    tpl = sentinel.InlineFrameTemplate(canon, site)
+    for seq, epoch, chain_prev in ((0, 0, 0), (7, 2, 12345),
+                                   (2**31, 9, 2**60)):
+        sig = sentinel.CallSig(3, seq, "allreduce", canon, epoch,
+                               site, chain_prev)
+        want = sig.digest() + json.dumps(sig.descriptor()).encode()
+        assert tpl.render(sig) == want
+    # a template is keyed by (canon, site): rendering a different
+    # call stream through it would ship the wrong canon — the cache
+    # in coll/nbc keys on exactly this pair
+    assert tpl.key == (canon, site)
+
+
 def test_err_coll_mismatch_is_a_distinct_class():
     assert ErrorCode.ERR_COLL_MISMATCH.value == 77
     assert ErrorCode.ERR_COLL_MISMATCH != ErrorCode.ERR_PROC_FAILED
