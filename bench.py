@@ -1632,6 +1632,255 @@ def _wire_micro_suite(backend_label):
     return lines  # main()'s emit() stamps the backend label
 
 
+#: worker app for the native_wire micro-suite: 2-process tpurun jobs
+#: on the CPU mesh driving the SAME p2p ping-pong through three byte
+#: paths — the shm ring (co-hosted, the headline numbers), the
+#: vectored socket (forced cross-host via OMPITPU_HOST_ID), and the
+#: portable staged frames (capability cards stripped LIVE mid-job,
+#: proving the per-peer fallback reassembles the byte-identical
+#: framing) — plus HOL-lane and QoS legs over the native BTL and the
+#: wire_native_copies_per_mib zero-copy witness. Process 0 writes its
+#: JSON lines to OMPITPU_LOOPBACK_OUT.
+_NATIVE_WIRE_BENCH_APP = r'''
+import json, os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+MODE = os.environ["OMPITPU_NW_BENCH_MODE"]  # shm | tcp | qos
+if MODE == "tcp":
+    # distinct shm identity: fragments ride the vectored socket path
+    os.environ["OMPITPU_HOST_ID"] = (
+        "nwbench-" + os.environ["OMPITPU_NODE_ID"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.mca import pvar, var as mca_var
+from ompi_release_tpu.runtime.runtime import Runtime
+
+if MODE == "qos":
+    # QoS lane partitioning must exist before the router comes up
+    mca_var.set_value("wire_qos_classes", "latency:3,bulk:1")
+    mca_var.set_value("wire_qos_class", "latency")
+SIZES = json.loads(os.environ.get("OMPITPU_NW_BENCH_SIZES", "[]"))
+HOL_MIB = int(os.environ.get("OMPITPU_NW_BENCH_HOL_MIB", "8"))
+world = mpi.init()
+rt = Runtime.current()
+me = rt.bootstrap["process_index"]
+peer = 1 - me
+assert rt.wire._nw is not None, "native datapath did not come up"
+assert rt.wire._btl_for(peer).NAME == "nativewire"
+lines = []
+
+def _pv(name):
+    p = pvar.PVARS.lookup(name)
+    return float(p.read()) if p is not None else 0.0
+
+def pingpong_rtt(size, tag):
+    """Best-of-3 round trip of `size` bytes each way; seconds."""
+    x = np.ones(max(1, size // 4), np.float32)
+    best = None
+    for _ in range(3):
+        world.barrier()
+        if me == 0:
+            t0 = time.perf_counter()
+            world.send(x, 2, tag=tag, rank=0)
+            v, _st = world.recv(source=2, tag=tag + 1, rank=0)
+            dt = time.perf_counter() - t0
+            assert np.asarray(v).shape == x.shape
+            best = dt if best is None else min(best, dt)
+        else:
+            v, _st = world.recv(source=0, tag=tag, rank=2)
+            world.send(np.asarray(v), 0, tag=tag + 1, rank=2)
+    return best
+
+if MODE in ("shm", "tcp"):
+    suffix = "" if MODE == "shm" else "tcp_"
+    for size in SIZES:
+        rtt = pingpong_rtt(size, 11)
+        if me == 0:
+            lines.append({
+                "metric": "wire_native_p2p_%%s%%dMiB" %% (suffix,
+                                                          size >> 20),
+                "value": round(2 * size / rtt / 1e9, 4), "unit": "GB/s",
+                "vs_baseline": None, "suite": "native_wire",
+                "rtt_s": round(rtt, 5)})
+
+if MODE == "tcp":
+    # live per-peer fallback: strip the capability cards and the SAME
+    # transfers ride the portable staged frames — receivers that race
+    # the strip still reassemble (the framing is byte-identical)
+    for c in rt.bootstrap["peer_cards"]:
+        if isinstance(c, dict):
+            c.pop("nativewire", None)
+    world.barrier()
+    assert rt.wire._btl_for(peer).NAME == "dcn"
+    for size in SIZES:
+        rtt = pingpong_rtt(size, 31)
+        if me == 0:
+            lines.append({
+                "metric": "wire_staged_p2p_%%dMiB" %% (size >> 20),
+                "value": round(2 * size / rtt / 1e9, 4), "unit": "GB/s",
+                "vs_baseline": None, "suite": "native_wire",
+                "rtt_s": round(rtt, 5)})
+
+if MODE == "shm":
+    # HOL leg: two concurrent distinct-tag transfers over the native
+    # rings, 4 lanes vs 1 — the head-of-line pvar is the metric,
+    # mirroring the portable wire suite's leg on the native BTL
+    xh = np.ones((HOL_MIB << 20) // 4, np.float32)
+    for lanes in (4, 1):
+        mca_var.set_value("wire_p2p_lanes", lanes)
+        world.barrier()
+        h0 = _pv("wire_hol_wait_seconds")
+        if me == 0:
+            ts = [threading.Thread(target=lambda t=t: world.send(
+                      xh, 2, tag=t, rank=0)) for t in (51, 52)]
+            for t in ts: t.start()
+            for t in ts: t.join()
+        else:
+            world.recv(source=0, tag=52, rank=2)
+            world.recv(source=0, tag=51, rank=2)
+        world.barrier()
+        if me == 0:
+            lines.append({
+                "metric": "wire_native_hol_2x%%dMiB_lanes%%d"
+                          %% (HOL_MIB, lanes),
+                "value": round(_pv("wire_hol_wait_seconds") - h0, 4),
+                "unit": "hol_wait_s", "vs_baseline": None,
+                "suite": "native_wire"})
+    mca_var.VARS.unset("wire_p2p_lanes")
+    if me == 0:
+        lines.append({
+            "metric": "wire_native_copies_per_mib",
+            "value": round(_pv("wire_native_copies_per_mib"), 5),
+            "unit": "copies/MiB", "vs_baseline": None,
+            "suite": "native_wire",
+            "native_bytes": _pv("wire_native_bytes"),
+            "native_frames": _pv("wire_native_frames"),
+            "fallback_copies": _pv("wire_native_fallback_copies")})
+
+if MODE == "qos":
+    # QoS leg on the native BTL: with the lane space partitioned by
+    # class, a small latency-probe pingpong is timed solo and then
+    # under a concurrent 6 x 16 MiB bulk stream on its own tag
+    def lat_round(tag, reps):
+        xs = np.ones((64 << 10) // 4, np.float32)
+        ts = []
+        for _i in range(reps):
+            if me == 0:
+                t0 = time.perf_counter()
+                world.send(xs, 2, tag=tag, rank=0)
+                world.recv(source=2, tag=tag + 1, rank=0)
+                ts.append(time.perf_counter() - t0)
+            else:
+                world.recv(source=0, tag=tag, rank=2)
+                world.send(xs, 0, tag=tag + 1, rank=2)
+        return ts
+
+    world.barrier()
+    solo = lat_round(81, 10)
+    world.barrier()
+    xb = np.ones((16 << 20) // 4, np.float32)
+
+    def _bulk():
+        # its own rank pair (1 -> 3): the latency probe's 0 <-> 2
+        # envelopes never share a queue with the bulk stream
+        for _k in range(6):
+            if me == 0:
+                world.send(xb, 3, tag=71, rank=1)
+            else:
+                world.recv(source=1, tag=71, rank=3)
+
+    th = threading.Thread(target=_bulk)
+    th.start()
+    under = lat_round(91, 10)
+    th.join(timeout=180)
+    assert not th.is_alive(), "bulk stream wedged"
+    world.barrier()
+    if me == 0:
+        lines.append({
+            "metric": "wire_native_qos_latency_solo_s",
+            "value": round(sum(solo) / len(solo), 6), "unit": "s",
+            "vs_baseline": None, "suite": "native_wire",
+            "qos_classes": "latency:3,bulk:1"})
+        lines.append({
+            "metric": "wire_native_qos_latency_under_bulk_s",
+            "value": round(sum(under) / len(under), 6), "unit": "s",
+            "vs_baseline": None, "suite": "native_wire",
+            "qos_classes": "latency:3,bulk:1"})
+
+if me == 0:
+    with open(os.environ["OMPITPU_LOOPBACK_OUT"], "w") as f:
+        json.dump(lines, f)
+world.barrier()
+mpi.finalize()
+'''
+
+
+def _native_wire_micro_suite(backend_label):
+    """native_wire lines: the zero-copy datapath's p2p ping-pong
+    through all three byte paths (native shm ring / native vectored
+    socket / portable staged frames via a LIVE per-peer capability
+    strip), the headline ``wire_native_p2p_256MiB`` GB/s line on full
+    machines, the ``wire_native_copies_per_mib`` zero-copy witness,
+    HOL-lane and QoS legs over the native BTL, and the derived
+    ``wire_native_shm_speedup_vs_staged`` acceptance factor. Withdraws
+    with an informational line when the native symbols are absent —
+    the portable-only build is a supported configuration, not a bench
+    failure."""
+    import os
+
+    from ompi_release_tpu.tools.tpurun import run_loopback_app
+
+    try:
+        from ompi_release_tpu.native import wire_symbols_available
+        have = bool(wire_symbols_available())
+    except Exception:
+        have = False
+    if not have:
+        return [{"metric": "native_wire_suite", "value": None,
+                 "unit": None, "vs_baseline": None,
+                 "error": "native wire symbols unavailable "
+                          "(portable staged path in force)"}]
+    full = backend_label is None
+    sizes = [1 << 20, 16 << 20, 64 << 20, 256 << 20] if full else \
+        [1 << 20, 4 << 20, 16 << 20]
+    repo = os.path.dirname(os.path.abspath(__file__))
+    app = _NATIVE_WIRE_BENCH_APP % {"repo": repo}
+    lines = []
+    for mode, timeout in (("shm", 420 if full else 240),
+                          ("tcp", 420 if full else 240),
+                          ("qos", 240)):
+        got = run_loopback_app(
+            2, app,
+            {"OMPITPU_NW_BENCH_MODE": mode,
+             "OMPITPU_NW_BENCH_SIZES": json.dumps(sizes),
+             "OMPITPU_NW_BENCH_HOL_MIB": "32" if full else "8"},
+            "native_wire_%s.json" % mode, timeout_s=timeout)
+        if got is None:
+            lines.append({"metric": "native_wire_%s_leg" % mode,
+                          "value": None, "unit": None,
+                          "vs_baseline": None,
+                          "error": "native wire bench job failed"})
+            continue
+        lines.extend(got)
+    by = {ln["metric"]: ln for ln in lines
+          if ln.get("value") is not None}
+    top = sizes[-1] >> 20
+    nat = by.get("wire_native_p2p_%dMiB" % top)
+    stg = by.get("wire_staged_p2p_%dMiB" % top)
+    if nat and stg and stg["value"]:
+        lines.append({
+            "metric": "wire_native_shm_speedup_vs_staged",
+            "value": round(nat["value"] / stg["value"], 4),
+            "unit": "x_vs_staged", "vs_baseline": None,
+            "suite": "native_wire", "size_mib": top})
+    return lines
+
+
 #: worker app for the overlap micro-suite: a REAL 3-process tpurun job
 #: measuring exposed vs hidden comm time — blocking allreduce-per-
 #: bucket followed by compute, vs overlapped iallreduce buckets
@@ -2627,6 +2876,9 @@ def main():
     _run_suite("sentinel_suite", _sentinel_micro_suite, emit, jax)
     _run_suite("wire_micro_suite",
                lambda: _wire_micro_suite(backend_label), emit, jax)
+    _run_suite("native_wire_suite",
+               lambda: _native_wire_micro_suite(backend_label), emit,
+               jax)
     _run_suite("hier_scaling_suite",
                lambda: _hier_micro_suite(backend_label), emit, jax)
     _run_suite("overlap_suite",

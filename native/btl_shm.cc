@@ -1,0 +1,337 @@
+// nativewire shared-memory datapath — single-producer single-consumer
+// byte rings over POSIX shm for co-hosted ranks.
+//
+// The reference's btl/sm moves eager fragments through per-peer FIFOs
+// in a mapped segment instead of the loopback TCP stack; this is that
+// idea for the TPU framework's tpurun worker processes. Each DIRECTED
+// (producer -> consumer) pair gets its own ring, and a peer pair
+// stripes lanes across a small slot set (slot = tag % nslots), so one
+// bulk lane can never head-of-line-block another lane's ring — the
+// shm analogue of the QoS lane striping the TCP path already does.
+//
+// Ring layout (one shm object):
+//   [64-byte header][capacity bytes of ring data]
+//   header: u64 magic, u64 capacity, u64 widx, u64 ridx,
+//           i64 producer_pid, i64 consumer_pid
+// widx/ridx are MONOTONIC byte counters (offset = idx % capacity);
+// they are only ever written by their owning side, with release
+// stores paired against acquire loads on the other side — the
+// classic SPSC discipline, no locks in the byte path.
+//
+// Records: [u32 payload_len][i32 tag][payload], byte-wrapped (no
+// padding); the payload of a fragment record is EXACTLY the frame
+// payload the TCP path would carry (SGC2 prefix + bytes), so the
+// byte-identity contract holds across both native transports.
+//
+// Fault model: same-host liveness is authoritative — kill(pid, 0)
+// answering ESRCH means the peer is GONE, not slow. Both blocking
+// entry points poll the counterpart pid and return -3 so Python can
+// raise the PR 9 typed error (ERR_PROC_FAILED) instead of wedging on
+// a ring that will never drain.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+
+namespace {
+
+constexpr uint64_t kRingMagic = 0x6f6d707473687231ULL;  // "omptshr1"
+constexpr size_t kHdrSize = 64;
+constexpr size_t kRecHdr = 8;  // u32 len + i32 tag
+constexpr size_t kSgPrefix = 4 + 8 + 8;  // "SGC2" + xfer + idx
+
+struct RingHdr {
+  uint64_t magic;
+  uint64_t capacity;
+  uint64_t widx;
+  uint64_t ridx;
+  int64_t producer_pid;
+  int64_t consumer_pid;
+};
+static_assert(sizeof(RingHdr) <= kHdrSize, "ring header grew");
+
+struct ShmRing {
+  uint8_t* map = nullptr;
+  uint64_t cap = 0;
+  bool creator = false;
+};
+
+inline RingHdr* hdr(ShmRing* r) {
+  return reinterpret_cast<RingHdr*>(r->map);
+}
+inline uint8_t* data(ShmRing* r) { return r->map + kHdrSize; }
+
+inline uint64_t load_acq(uint64_t* p) {
+  return __atomic_load_n(p, __ATOMIC_ACQUIRE);
+}
+inline void store_rel(uint64_t* p, uint64_t v) {
+  __atomic_store_n(p, v, __ATOMIC_RELEASE);
+}
+
+inline bool pid_dead(int64_t pid) {
+  // pid 0 = counterpart not attached yet: still coming up, not dead
+  return pid > 0 && ::kill(static_cast<pid_t>(pid), 0) != 0 &&
+         errno == ESRCH;
+}
+
+// modular copies between the ring and linear buffers
+void ring_put(ShmRing* r, uint64_t pos, const uint8_t* src, size_t n) {
+  uint64_t off = pos % r->cap;
+  size_t first = static_cast<size_t>(
+      n < r->cap - off ? n : r->cap - off);
+  std::memcpy(data(r) + off, src, first);
+  if (n > first) std::memcpy(data(r), src + first, n - first);
+}
+
+void ring_get(ShmRing* r, uint64_t pos, uint8_t* dst, size_t n) {
+  uint64_t off = pos % r->cap;
+  size_t first = static_cast<size_t>(
+      n < r->cap - off ? n : r->cap - off);
+  std::memcpy(dst, data(r) + off, first);
+  if (n > first) std::memcpy(dst + first, data(r), n - first);
+}
+
+inline uint64_t be64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | p[i];
+  return v;
+}
+
+struct Deadline {
+  std::chrono::steady_clock::time_point t;
+  explicit Deadline(int timeout_ms)
+      : t(std::chrono::steady_clock::now() +
+          std::chrono::milliseconds(timeout_ms)) {}
+  bool expired() const { return std::chrono::steady_clock::now() >= t; }
+};
+
+inline void ring_nap() {
+  // short sleep, not sched_yield: rings pair with device work, a
+  // spinning consumer would steal the XLA threads' cores
+  std::this_thread::sleep_for(std::chrono::microseconds(50));
+}
+
+ShmRing* map_ring(int fd, uint64_t total, bool creator) {
+  void* m = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  ::close(fd);  // mapping keeps the object alive
+  if (m == MAP_FAILED) return nullptr;
+  auto* r = new ShmRing();
+  r->map = static_cast<uint8_t*>(m);
+  r->cap = total - kHdrSize;
+  r->creator = creator;
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (O_CREAT|O_EXCL) a ring named `name` (leading '/', per
+// shm_open) with `capacity` data bytes and stamp ourselves producer.
+// NULL when the name exists already or the mapping failed.
+void* shmring_create(const char* name, int64_t capacity,
+                     int64_t producer_pid) {
+  if (capacity < static_cast<int64_t>(kRecHdr) * 2) return nullptr;
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t total = kHdrSize + static_cast<uint64_t>(capacity);
+  if (::ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  ShmRing* r = map_ring(fd, total, true);
+  if (!r) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  RingHdr* h = hdr(r);
+  h->capacity = static_cast<uint64_t>(capacity);
+  h->widx = 0;
+  h->ridx = 0;
+  h->producer_pid = producer_pid;
+  h->consumer_pid = 0;
+  // magic LAST (release): an attacher seeing the magic sees a fully
+  // initialized header
+  __atomic_store_n(&h->magic, kRingMagic, __ATOMIC_RELEASE);
+  return r;
+}
+
+// Attach an existing ring; stamp ourselves consumer when
+// consumer_pid > 0. NULL when absent / not yet initialized.
+void* shmring_attach(const char* name, int64_t consumer_pid) {
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st{};
+  if (::fstat(fd, &st) != 0 ||
+      static_cast<size_t>(st.st_size) <= kHdrSize) {
+    ::close(fd);
+    return nullptr;
+  }
+  ShmRing* r = map_ring(fd, static_cast<uint64_t>(st.st_size), false);
+  if (!r) return nullptr;
+  RingHdr* h = hdr(r);
+  if (__atomic_load_n(&h->magic, __ATOMIC_ACQUIRE) != kRingMagic ||
+      h->capacity != r->cap) {
+    ::munmap(r->map, r->cap + kHdrSize);
+    delete r;
+    return nullptr;
+  }
+  if (consumer_pid > 0) h->consumer_pid = consumer_pid;
+  return r;
+}
+
+int shmring_unlink(const char* name) { return ::shm_unlink(name); }
+
+void shmring_close(void* vr) {
+  auto* r = static_cast<ShmRing*>(vr);
+  ::munmap(r->map, r->cap + kHdrSize);
+  delete r;
+}
+
+int64_t shmring_capacity(void* vr) {
+  return static_cast<int64_t>(static_cast<ShmRing*>(vr)->cap);
+}
+
+int64_t shmring_producer_pid(void* vr) {
+  return hdr(static_cast<ShmRing*>(vr))->producer_pid;
+}
+
+int64_t shmring_consumer_pid(void* vr) {
+  return hdr(static_cast<ShmRing*>(vr))->consumer_pid;
+}
+
+// Bytes currently queued (tests/observability).
+int64_t shmring_pending(void* vr) {
+  auto* r = static_cast<ShmRing*>(vr);
+  RingHdr* h = hdr(r);
+  return static_cast<int64_t>(load_acq(&h->widx) - load_acq(&h->ridx));
+}
+
+// Producer side: append one record whose payload is the concatenation
+// of the scatter-gather parts. 0 on success, -1 timeout (ring full),
+// -2 record can never fit (caller must route via TCP), -3 consumer
+// process is gone.
+int shmring_writev(void* vr, int32_t tag, const uint8_t** parts,
+                   const int64_t* lens, int32_t nparts,
+                   int timeout_ms) {
+  auto* r = static_cast<ShmRing*>(vr);
+  RingHdr* h = hdr(r);
+  uint64_t plen = 0;
+  for (int32_t i = 0; i < nparts; ++i)
+    plen += static_cast<uint64_t>(lens[i]);
+  uint64_t total = kRecHdr + plen;
+  if (total > r->cap) return -2;
+  Deadline dl(timeout_ms);
+  uint64_t w = h->widx;  // we are the only writer
+  for (;;) {
+    uint64_t used = w - load_acq(&h->ridx);
+    if (r->cap - used >= total) break;
+    if (pid_dead(h->consumer_pid)) return -3;
+    if (dl.expired()) return -1;
+    ring_nap();
+  }
+  uint8_t rec[kRecHdr];
+  uint32_t l32 = static_cast<uint32_t>(plen);
+  std::memcpy(rec, &l32, 4);
+  std::memcpy(rec + 4, &tag, 4);
+  ring_put(r, w, rec, kRecHdr);
+  uint64_t pos = w + kRecHdr;
+  for (int32_t i = 0; i < nparts; ++i) {
+    ring_put(r, pos, parts[i], static_cast<size_t>(lens[i]));
+    pos += static_cast<uint64_t>(lens[i]);
+  }
+  store_rel(&h->widx, w + total);
+  return 0;
+}
+
+// Consumer side, fragment fast path: pop the head record IF it is an
+// SGC2 fragment of transfer `xfer` on `tag`, copying its payload
+// straight into the reassembly buffer. Returns the fragment index, or
+//   -1 timeout   -2 malformed/overrun (consumed)   -3 producer dead
+//   -4 same-tag stale fragment (consumed + dropped, like the portable
+//      path's want-prefix filter)
+//   -5 head record carries a DIFFERENT tag (left; pop via
+//      shmring_read_into and stash it)
+int64_t shmring_read_frag(void* vr, int32_t tag, int64_t xfer,
+                          int64_t nchunks, int64_t chunk, uint8_t* base,
+                          int64_t nbytes, int timeout_ms) {
+  auto* r = static_cast<ShmRing*>(vr);
+  RingHdr* h = hdr(r);
+  Deadline dl(timeout_ms);
+  uint64_t rd = h->ridx;  // we are the only reader
+  for (;;) {
+    if (load_acq(&h->widx) != rd) break;
+    if (pid_dead(h->producer_pid)) return -3;
+    if (dl.expired()) return -1;
+    ring_nap();
+  }
+  uint8_t rec[kRecHdr];
+  ring_get(r, rd, rec, kRecHdr);
+  uint32_t plen;
+  int32_t rtag;
+  std::memcpy(&plen, rec, 4);
+  std::memcpy(&rtag, rec + 4, 4);
+  if (rtag != tag) return -5;
+  uint64_t next = rd + kRecHdr + plen;
+  if (plen < kSgPrefix) {
+    store_rel(&h->ridx, next);
+    return -4;
+  }
+  uint8_t pre[kSgPrefix];
+  ring_get(r, rd + kRecHdr, pre, kSgPrefix);
+  if (std::memcmp(pre, "SGC2", 4) != 0 ||
+      be64(pre + 4) != static_cast<uint64_t>(xfer)) {
+    store_rel(&h->ridx, next);
+    return -4;
+  }
+  int64_t idx = static_cast<int64_t>(be64(pre + 12));
+  int64_t flen = static_cast<int64_t>(plen - kSgPrefix);
+  if (idx < 0 || idx >= nchunks || idx * chunk + flen > nbytes) {
+    store_rel(&h->ridx, next);
+    return -2;
+  }
+  if (flen)
+    ring_get(r, rd + kRecHdr + kSgPrefix, base + idx * chunk,
+             static_cast<size_t>(flen));
+  store_rel(&h->ridx, next);
+  return idx;
+}
+
+// Consumer side, generic pop: copy the head record's payload into
+// `out` and report its tag. Returns payload length, -1 timeout,
+// -2 out buffer too small (record stays), -3 producer dead.
+int64_t shmring_read_into(void* vr, int32_t* tag, uint8_t* out,
+                          int64_t maxlen, int timeout_ms) {
+  auto* r = static_cast<ShmRing*>(vr);
+  RingHdr* h = hdr(r);
+  Deadline dl(timeout_ms);
+  uint64_t rd = h->ridx;
+  for (;;) {
+    if (load_acq(&h->widx) != rd) break;
+    if (pid_dead(h->producer_pid)) return -3;
+    if (dl.expired()) return -1;
+    ring_nap();
+  }
+  uint8_t rec[kRecHdr];
+  ring_get(r, rd, rec, kRecHdr);
+  uint32_t plen;
+  std::memcpy(&plen, rec, 4);
+  std::memcpy(tag, rec + 4, 4);
+  if (static_cast<int64_t>(plen) > maxlen) return -2;
+  if (plen) ring_get(r, rd + kRecHdr, out, plen);
+  store_rel(&h->ridx, rd + kRecHdr + plen);
+  return static_cast<int64_t>(plen);
+}
+
+}  // extern "C"

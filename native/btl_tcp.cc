@@ -1,0 +1,157 @@
+// nativewire TCP datapath — vectored, zero-copy fragment IO over the
+// SAME sockets (and the SAME frame format) as the OOB control plane.
+//
+// The reference's btl/tcp moves user bytes with writev over the
+// endpoint's socket while the OOB keeps its own connection; here the
+// footprint is smaller — one authenticated TCP mesh — so the datapath
+// shares it. That sharing is what makes nativewire's wire format
+// byte-identical BY CONSTRUCTION: wire_sendv emits an ordinary OOB
+// Header followed by the scatter-gather parts, indistinguishable on
+// the wire from ``ep.send(dst, tag, b"".join(parts))`` — except the
+// join (one full payload copy into a Python bytes) never happens, and
+// neither do the per-part ctypes staging copies.
+//
+// Receive side: wire_recv_frag scans the endpoint's frame queue for
+// the next SGC2 fragment of one specific transfer and memcpys its
+// payload STRAIGHT into the caller's preallocated reassembly buffer
+// (recv_into discipline) — the fragment never surfaces as a Python
+// bytes object. Sentinel frames, headers, stale fragments and
+// anything else stay queued for the portable Python path (return -4),
+// so all any-source/stash/ULFM machinery keeps working unchanged.
+
+#include <limits.h>
+#include <sys/uio.h>
+
+#include "oob_endpoint.h"
+
+namespace {
+
+using ompitpu::Endpoint;
+using ompitpu::Frame;
+using ompitpu::Header;
+using ompitpu::kMagic;
+
+#ifndef IOV_MAX
+#define IOV_MAX 1024
+#endif
+
+// writev with partial-write recovery and IOV_MAX batching. Mutates
+// the iovec array in place (already-sent entries zeroed) — callers
+// pass a scratch copy.
+bool writev_full(int fd, struct iovec* iov, size_t cnt) {
+  size_t i = 0;
+  while (i < cnt) {
+    size_t batch = cnt - i;
+    if (batch > IOV_MAX) batch = IOV_MAX;
+    ssize_t w = ::writev(fd, iov + i, static_cast<int>(batch));
+    if (w <= 0) return false;
+    size_t left = static_cast<size_t>(w);
+    while (i < cnt && left >= iov[i].iov_len) {
+      left -= iov[i].iov_len;
+      ++i;
+    }
+    if (left) {  // partial write inside entry i: advance its base
+      iov[i].iov_base = static_cast<uint8_t*>(iov[i].iov_base) + left;
+      iov[i].iov_len -= left;
+    }
+  }
+  return true;
+}
+
+// SGC2 fragment layout (pinned by btl/components.py staged_frames):
+//   b"SGC2" + xfer u64 BE + idx u64 BE + payload
+constexpr size_t kSgPrefix = 4 + 8 + 8;
+
+inline uint64_t be64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | p[i];
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Send one frame whose payload is the concatenation of `nparts`
+// scatter-gather parts, without materializing the concatenation.
+// Returns 0, or -1 when no route to dst exists / the write failed
+// (same contract as oob_send — caller falls back or raises).
+int wire_sendv(void* h, int32_t dst, int32_t tag,
+               const uint8_t** parts, const int64_t* lens,
+               int32_t nparts) {
+  auto* ep = static_cast<Endpoint*>(h);
+  uint64_t total = 0;
+  for (int32_t i = 0; i < nparts; ++i)
+    total += static_cast<uint64_t>(lens[i]);
+  if (dst == ep->id) {
+    // self-send lands in our own queue; the copy into the queued
+    // frame is the delivery itself, not wire overhead
+    Frame f;
+    f.src = ep->id;
+    f.dst = dst;
+    f.tag = tag;
+    f.payload.reserve(total);
+    for (int32_t i = 0; i < nparts; ++i)
+      f.payload.insert(f.payload.end(), parts[i], parts[i] + lens[i]);
+    ep->deliver_or_forward(std::move(f));
+    return 0;
+  }
+  int fd = ep->next_hop_fd(dst);
+  if (fd < 0) return -1;
+  Header hdr{kMagic, ep->id, dst, tag, ompitpu::kMaxTtl,
+             static_cast<uint32_t>(total)};
+  std::vector<struct iovec> iov(static_cast<size_t>(nparts) + 1);
+  iov[0].iov_base = &hdr;
+  iov[0].iov_len = sizeof hdr;
+  for (int32_t i = 0; i < nparts; ++i) {
+    iov[i + 1].iov_base = const_cast<uint8_t*>(parts[i]);
+    iov[i + 1].iov_len = static_cast<size_t>(lens[i]);
+  }
+  // same wmu discipline as send_frame: frames on a shared socket must
+  // not interleave, and the control plane writes on this fd too
+  std::lock_guard<std::mutex> l(ep->wmu);
+  return writev_full(fd, iov.data(), iov.size()) ? 0 : -1;
+}
+
+// Pop the next SGC2 fragment of transfer `xfer` from (src, tag) and
+// copy its payload straight into `base` (an nbytes reassembly buffer
+// laid out as nchunks fragments of `chunk` bytes, last one short).
+// src == -1 matches any source. Returns the fragment index (>= 0), or:
+//   -1  timeout — nothing matching arrived
+//   -2  malformed/overrun fragment (CONSUMED; caller raises truncate)
+//   -4  the next (src, tag) frame is not an SGC2 fragment of this
+//       transfer (LEFT QUEUED; caller drains it via the portable path
+//       — stale-transfer drop, stash, sentinel handling all live there)
+int64_t wire_recv_frag(void* h, int32_t src, int32_t tag, int64_t xfer,
+                       int64_t nchunks, int64_t chunk, uint8_t* base,
+                       int64_t nbytes, int timeout_ms) {
+  auto* ep = static_cast<Endpoint*>(h);
+  std::unique_lock<std::mutex> l(ep->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    for (auto it = ep->queue.begin(); it != ep->queue.end(); ++it) {
+      if (it->tag != tag || (src != -1 && it->src != src)) continue;
+      const auto& p = it->payload;
+      if (p.size() < kSgPrefix || std::memcmp(p.data(), "SGC2", 4) != 0 ||
+          be64(p.data() + 4) != static_cast<uint64_t>(xfer))
+        return -4;
+      int64_t idx = static_cast<int64_t>(be64(p.data() + 12));
+      int64_t flen = static_cast<int64_t>(p.size() - kSgPrefix);
+      if (idx < 0 || idx >= nchunks || idx * chunk + flen > nbytes) {
+        ep->queue.erase(it);  // poisoned fragment: consume, report
+        return -2;
+      }
+      if (flen)
+        std::memcpy(base + idx * chunk, p.data() + kSgPrefix,
+                    static_cast<size_t>(flen));
+      ep->queue.erase(it);
+      return idx;
+    }
+    if (ep->stopping ||
+        ep->cv.wait_until(l, deadline) == std::cv_status::timeout)
+      return -1;
+  }
+}
+
+}  // extern "C"

@@ -2,5 +2,6 @@
 
 from .base import BTL_FRAMEWORK, BmlEndpoint, BmlR2, BtlModule
 from . import components as _components  # noqa: F401  (self-register)
+from . import nativewire as _nativewire  # noqa: F401  (self-register)
 
 __all__ = ["BTL_FRAMEWORK", "BmlEndpoint", "BmlR2", "BtlModule"]

@@ -509,10 +509,18 @@ def freeze_wire_plan(comm, recorded: List[Tuple[Tuple, Tuple]],
             tpls = []
             for shape, dtype in arrs:
                 tpl = None
-                if tuning.segsize > 0 and router._btl_for(p) \
-                        is router._dcn:
+                btl = router._btl_for(p)
+                # every segsize-framed transport precomposes: dcn's
+                # interpreted SGH2 stream and nativewire's
+                # scatter-gather stream share the FrameTemplate (the
+                # byte-identity authority), each clamped to its OWN
+                # max frame size cvar
+                if tuning.segsize > 0 and (
+                        btl is router._dcn
+                        or (router._nw is not None
+                            and btl is router._nw)):
                     seg = min(tuning.segsize,
-                              max(1, router._dcn.max_send_size))
+                              max(1, btl.max_send_size))
                     tpl = _btl.plan_frame_template(shape, dtype, seg)
                 tpls.append(tpl)
             peer_slots.append((p, tuple(tpls)))

@@ -34,7 +34,10 @@ def load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        srcs = [os.path.join(_NATIVE_DIR, f) for f in ("dss.cc", "oob.cc")]
+        srcs = [os.path.join(_NATIVE_DIR, f)
+                for f in ("dss.cc", "oob.cc", "oob_endpoint.h",
+                          "btl_tcp.cc", "btl_shm.cc")
+                if os.path.exists(os.path.join(_NATIVE_DIR, f))]
         if (not os.path.exists(_SO_PATH)
                 or any(os.path.getmtime(s) > os.path.getmtime(_SO_PATH)
                        for s in srcs)):
@@ -111,12 +114,104 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.oob_next_len.restype = ctypes.c_int
     lib.oob_destroy.argtypes = [P]
 
+    # nativewire datapath symbols are OPTIONAL: a stale .so built from
+    # pre-nativewire sources simply lacks them, and the component
+    # withdraws from selection (wire_symbols_available) — declaring
+    # them is therefore guarded, never assumed
+    vpp = ctypes.POINTER(ctypes.c_void_p)
+    if hasattr(lib, "wire_sendv"):
+        lib.wire_sendv.argtypes = [P, ctypes.c_int32, ctypes.c_int32,
+                                   vpp, i64p, ctypes.c_int32]
+        lib.wire_sendv.restype = ctypes.c_int
+        lib.wire_recv_frag.argtypes = [
+            P, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, P, ctypes.c_int64,
+            ctypes.c_int,
+        ]
+        lib.wire_recv_frag.restype = ctypes.c_int64
+    if hasattr(lib, "shmring_create"):
+        lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                       ctypes.c_int64]
+        lib.shmring_create.restype = P
+        lib.shmring_attach.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.shmring_attach.restype = P
+        lib.shmring_unlink.argtypes = [ctypes.c_char_p]
+        lib.shmring_unlink.restype = ctypes.c_int
+        lib.shmring_close.argtypes = [P]
+        for f in ("shmring_capacity", "shmring_producer_pid",
+                  "shmring_consumer_pid", "shmring_pending"):
+            getattr(lib, f).argtypes = [P]
+            getattr(lib, f).restype = ctypes.c_int64
+        lib.shmring_writev.argtypes = [P, ctypes.c_int32, vpp, i64p,
+                                       ctypes.c_int32, ctypes.c_int]
+        lib.shmring_writev.restype = ctypes.c_int
+        lib.shmring_read_frag.argtypes = [
+            P, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, P, ctypes.c_int64, ctypes.c_int,
+        ]
+        lib.shmring_read_frag.restype = ctypes.c_int64
+        lib.shmring_read_into.argtypes = [P, i32p, P, ctypes.c_int64,
+                                          ctypes.c_int]
+        lib.shmring_read_into.restype = ctypes.c_int64
+
+
+def wire_symbols_available() -> bool:
+    """True when the loaded .so carries the nativewire datapath ABI
+    (wire_sendv/shmring_*). False — never an exception — when the
+    library is stale, unbuildable, or the build toolchain is absent:
+    callers treat that as 'capability not present' and stay on the
+    portable staged path."""
+    try:
+        lib = load_library()
+    except Exception:
+        return False
+    return hasattr(lib, "wire_sendv") and hasattr(lib, "shmring_create")
+
 
 def _u8(data: bytes):
     return ctypes.cast(
         ctypes.create_string_buffer(data, len(data)),
         ctypes.POINTER(ctypes.c_uint8),
     )
+
+
+def _sg_arrays(parts):
+    """(void* array, int64 array, keepalive list) for a scatter-gather
+    list of bytes/bytearray/memoryview/ndarray parts — pointers into
+    the callers' existing buffers, NO staging copies (the whole point
+    of the native wire). The keepalive list must stay referenced until
+    the C call returns."""
+    import numpy as _np
+
+    n = len(parts)
+    ptrs = (ctypes.c_void_p * n)()
+    lens = (ctypes.c_int64 * n)()
+    keep = []
+    for i, p in enumerate(parts):
+        if isinstance(p, bytes):
+            # c_char_p aliases the bytes object's internal buffer
+            ptrs[i] = ctypes.cast(ctypes.c_char_p(p),
+                                  ctypes.c_void_p)
+            lens[i] = len(p)
+            keep.append(p)
+        else:
+            a = _np.frombuffer(p, dtype=_np.uint8)  # zero-copy view
+            ptrs[i] = ctypes.c_void_p(a.ctypes.data)
+            lens[i] = a.nbytes
+            keep.append(a)
+    return ptrs, lens, keep
+
+
+def _wbuf_ptr(buf):
+    """(void* base, nbytes, keepalive) for a WRITABLE reassembly
+    buffer (bytearray / writable memoryview / ndarray)."""
+    import numpy as _np
+
+    a = _np.frombuffer(buf, dtype=_np.uint8)
+    if not a.flags.writeable:
+        raise MPIError(ErrorCode.ERR_OTHER,
+                       "recv_into target buffer is read-only")
+    return ctypes.c_void_p(a.ctypes.data), a.nbytes, a
 
 
 class DssBuffer:
@@ -332,6 +427,36 @@ class OobEndpoint:
                                f"oob recv timeout (tag {tag})")
             return src.value, tg.value, ctypes.string_at(arr, got)
 
+    # -- nativewire datapath (optional capability) ------------------------
+
+    def sendv(self, dst: int, tag: int, parts) -> None:
+        """Vectored send: one frame whose payload is the concatenation
+        of `parts`, written with writev straight from the parts'
+        buffers — no b"".join, no ctypes staging copy. Byte-identical
+        on the wire to ``send(dst, tag, b"".join(parts))``."""
+        ptrs, lens, keep = _sg_arrays(parts)
+        rc = self._lib.wire_sendv(self._handle(), dst, tag, ptrs, lens,
+                                  len(ptrs))
+        del keep
+        if rc != 0:
+            raise MPIError(
+                ErrorCode.ERR_OTHER,
+                f"wire sendv to {dst} failed (no connection or route)",
+            )
+
+    def recv_frag(self, src: int, tag: int, xfer: int, nchunks: int,
+                  chunk: int, buf, timeout_ms: int) -> int:
+        """Pop the next SGC2 fragment of (src, tag, xfer) straight
+        into writable `buf`. Returns the fragment index >= 0, or the
+        C status: -1 timeout, -2 malformed (consumed), -4 the next
+        matching frame belongs to the portable path (left queued)."""
+        base, nbytes, keep = _wbuf_ptr(buf)
+        rc = self._lib.wire_recv_frag(self._handle(), src, tag, xfer,
+                                      nchunks, chunk, base, nbytes,
+                                      timeout_ms)
+        del keep
+        return int(rc)
+
     def ttl_dropped(self) -> int:
         """Frames dropped by the routing-cycle ttl guard."""
         return self._lib.oob_ttl_dropped(self._handle())
@@ -342,6 +467,103 @@ class OobEndpoint:
     def close(self) -> None:
         if self._h:
             self._lib.oob_destroy(self._h)
+            self._h = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ShmRing:
+    """SPSC shared-memory byte ring (btl/sm FIFO analogue).
+
+    Mechanical wrapper: status ints pass through unchanged; mapping
+    -3 (peer process gone) onto the typed fault-tolerance error is the
+    btl component's job, not the binding's. Ring protocol status codes
+    (native/btl_shm.cc): writev 0/-1 timeout/-2 never-fits/-3 dead;
+    read_frag idx/-1/-2 consumed-bad/-3 dead/-4 stale-dropped/-5
+    other-tag-left; read_into len/-1/-2 too-small/-3 dead."""
+
+    def __init__(self, handle, name: str) -> None:
+        self._lib = load_library()
+        self._h = handle
+        self.name = name
+
+    @classmethod
+    def create(cls, name: str, capacity: int,
+               producer_pid: int) -> Optional["ShmRing"]:
+        """O_CREAT|O_EXCL producer-side create; None when the name
+        already exists (another sender won the race) or shm failed."""
+        lib = load_library()
+        h = lib.shmring_create(name.encode(), capacity, producer_pid)
+        return cls(h, name) if h else None
+
+    @classmethod
+    def attach(cls, name: str,
+               consumer_pid: int = 0) -> Optional["ShmRing"]:
+        """Consumer-side attach; None while the ring does not exist
+        yet (callers retry — the producer creates lazily)."""
+        lib = load_library()
+        h = lib.shmring_attach(name.encode(), consumer_pid)
+        return cls(h, name) if h else None
+
+    @staticmethod
+    def unlink(name: str) -> None:
+        try:
+            load_library().shmring_unlink(name.encode())
+        except Exception:
+            pass  # best-effort cleanup
+
+    def _handle(self):
+        h = self._h
+        if not h:
+            raise MPIError(ErrorCode.ERR_OTHER, "shm ring is closed")
+        return h
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.shmring_capacity(self._handle())
+
+    def pending(self) -> int:
+        return self._lib.shmring_pending(self._handle())
+
+    def producer_pid(self) -> int:
+        return self._lib.shmring_producer_pid(self._handle())
+
+    def consumer_pid(self) -> int:
+        return self._lib.shmring_consumer_pid(self._handle())
+
+    def writev(self, tag: int, parts, timeout_ms: int) -> int:
+        ptrs, lens, keep = _sg_arrays(parts)
+        rc = self._lib.shmring_writev(self._handle(), tag, ptrs, lens,
+                                      len(ptrs), timeout_ms)
+        del keep
+        return int(rc)
+
+    def read_frag(self, tag: int, xfer: int, nchunks: int, chunk: int,
+                  buf, timeout_ms: int) -> int:
+        base, nbytes, keep = _wbuf_ptr(buf)
+        rc = self._lib.shmring_read_frag(self._handle(), tag, xfer,
+                                         nchunks, chunk, base, nbytes,
+                                         timeout_ms)
+        del keep
+        return int(rc)
+
+    def read_into(self, buf, timeout_ms: int):
+        """Generic pop of the head record: (status_or_len, tag)."""
+        base, nbytes, keep = _wbuf_ptr(buf)
+        tag = ctypes.c_int32()
+        rc = self._lib.shmring_read_into(self._handle(),
+                                         ctypes.byref(tag), base,
+                                         nbytes, timeout_ms)
+        del keep
+        return int(rc), tag.value
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.shmring_close(self._h)
             self._h = None
 
     def __del__(self) -> None:

@@ -172,6 +172,14 @@ class TpurunEss(mca_component.Component):
             "local_device_count": jax.local_device_count(),
             "platform": jax.local_devices()[0].platform,
         }
+        try:
+            # nativewire capability advertisement (ring token/geometry):
+            # a probe failure just means the card stays portable-only
+            from ..btl import nativewire as _nativewire
+
+            card.update(_nativewire.modex_entry())
+        except Exception:
+            pass
         cards = agent.run_modex(card)  # launcher mode: workers only
         agent.setup_tree(num_workers + 1, cards)
         # FULL wire-up (superset of the tree edges): connect to every

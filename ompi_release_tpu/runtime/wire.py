@@ -265,6 +265,13 @@ class WireRouter:
         self.spans: List[Tuple[int, int]] = runtime.proc_spans
         self._shm = ShmBtl()
         self._dcn = DcnBtl()
+        # the zero-copy native datapath (btl/nativewire): None when the
+        # native library lacks the wire_*/shmring_* symbols or the
+        # component is disabled — every routing site below then falls
+        # back to the portable shm/dcn transports structurally
+        from ..btl import nativewire as _nativewire
+
+        self._nw = _nativewire.module_for(self.cards, self.my_pidx)
         self._seq = itertools.count(1)
         self._acks: set = set()
         self._ack_lock = threading.Lock()
@@ -346,10 +353,16 @@ class WireRouter:
                        f"world rank {world_rank} outside every span")
 
     def _btl_for(self, peer_pidx: int):
-        """Transport choice, deterministic on BOTH sides: same machine
-        (modex card host identity) -> shm handoff, else DCN staging —
-        exactly the per-peer eligibility add_procs computes from
-        business cards (``btl.h:810-816``)."""
+        """Transport choice, deterministic on BOTH sides: when both
+        ends' modex cards advertise the native datapath, nativewire
+        carries the payload (shm rings co-hosted, vectored sockets
+        cross-host); otherwise same machine (modex card host identity)
+        -> shm handoff, else DCN staging — exactly the per-peer
+        eligibility add_procs computes from business cards
+        (``btl.h:810-816``)."""
+        nw = self._nw
+        if nw is not None and nw.peer_capable(peer_pidx):
+            return nw
         same_host = (
             self.cards[self.my_pidx].get("host")
             and self.cards[self.my_pidx].get("host")
@@ -409,6 +422,8 @@ class WireRouter:
             try:
                 return fn()
             except MPIError as e:
+                if e.code == ErrorCode.ERR_PROC_FAILED:
+                    raise  # a confirmed process failure is not transient
                 last = e
                 time.sleep(0.05 * (attempt + 1))
         if peer is not None:
@@ -623,6 +638,12 @@ class WireRouter:
         try:
             data = self._recv_payload(tag, src_pidx)
         except MPIError as e:
+            if e.code == ErrorCode.ERR_PROC_FAILED:
+                # the transport already issued the typed ULFM verdict
+                # (the shm ring's pid-liveness check is authoritative
+                # on one host) — recovery policies key on the code, so
+                # it must not be laundered into a generic truncation
+                raise
             raise MPIError(
                 ErrorCode.ERR_TRUNCATE,
                 f"wire message from process {src_pidx} (comm cid "
@@ -875,6 +896,15 @@ class WireRouter:
         nid = self._nid(peer)
         for k, a in enumerate(arrs):
             tpl = templates[k] if templates is not None else None
+            if btl is self._nw and btl is not None:
+                # native datapath: the stream does its own sends (ring
+                # writev / vectored sockets) with its own retry + typed
+                # fault mapping; frames and yields stay 1:1 with the
+                # portable stream so striping/QoS see the same shape
+                for _ in btl.frame_stream(self.ep, peer, tag, a,
+                                          tpl=tpl):
+                    yield
+                continue
             if tpl is not None and btl is self._dcn:
                 for frame in self._dcn.planned_frames(a, tpl):
                     self._retry(

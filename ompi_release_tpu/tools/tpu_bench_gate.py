@@ -49,7 +49,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 HIGHER_BETTER = {"GB/s", "TFLOP/s", "frac_hidden"}
 #: units where smaller is better (latencies, waits, message counts)
 LOWER_BETTER = {"s", "seconds", "us", "us/hop", "hol_wait_s",
-                "sends_at_root", "device_collectives", "steps"}
+                "sends_at_root", "device_collectives", "steps",
+                "copies/MiB"}
 #: metric-name fallback when the unit alone is ambiguous: the overlap
 #: suite's lines (hidden-comm fraction, overlap speedups), the
 #: tree_overlap suite's lines (planned-pass speedup, whole-tree
@@ -89,8 +90,15 @@ METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_", "compiled_",
 #: and ``ledger_*`` (bytes appended to the per-rank binary ring per
 #: observed compiled fire — a grown record means the fixed-size
 #: fire-path write got heavier).
+#: The native_wire suite's lines split by unit: ``wire_native_p2p_*``
+#: bandwidths carry "GB/s" (higher-better via the unit table, which
+#: is checked first), while ``wire_native_copies*`` witnesses count
+#: byte-path materializations per MiB shipped — lower-better, with
+#: 0.0 the zero-copy acceptance target; a grown count means an array
+#: started taking the staged/fallback copy path again.
 METRIC_LOWER_BETTER_PREFIXES = ("ft_", "ledger_", "sentinel_", "sim_",
-                                "steady_", "tenant_")
+                                "steady_", "tenant_",
+                                "wire_native_copies")
 
 DEFAULT_SIGMA = 4.0
 #: relative noise floor: the bench's own ceiling docs put single-run

@@ -774,6 +774,48 @@ class TestBenchGate:
                 55, "bytes")])
         assert gate.main(hist + ["--candidate", str(ok)]) == 0
 
+    def test_native_wire_metric_directions(self, tmp_path):
+        """The native_wire suite's lines: wire_native_p2p_* bandwidths
+        (GB/s) are higher-better, while the wire_native_copies_per_mib
+        witness (byte-path materializations per MiB shipped — 0.0 is
+        the zero-copy acceptance target) is lower-better: a collapsed
+        bandwidth OR arrays sneaking back onto the copy path must both
+        trip the gate."""
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        assert gate._direction("GB/s", "wire_native_p2p_256MiB") == 1
+        assert gate._direction("GB/s", "wire_native_p2p_shm_256MiB") == 1
+        assert gate._direction(
+            "copies/MiB", "wire_native_copies_per_mib") == -1
+        # ...and the prefix rule covers a unit-less round file too
+        assert gate._direction(None, "wire_native_copies_per_mib") == -1
+
+        def ln(metric, v, unit):
+            return {"metric": metric, "value": v, "unit": unit,
+                    "vs_baseline": None, "tier_label": "loopback-cpu"}
+
+        hist = [_round_file(
+            tmp_path / f"BENCH_r{k:02d}.json",
+            [ln("wire_native_p2p_256MiB", 2.0 + 0.05 * k, "GB/s"),
+             ln("wire_native_copies_per_mib", 0.0, "copies/MiB")])
+            for k in range(4)]
+        # bandwidth collapsing or copies reappearing trips the gate
+        bad = _round_file(
+            tmp_path / "cand.json",
+            [ln("wire_native_p2p_256MiB", 0.4, "GB/s"),
+             ln("wire_native_copies_per_mib", 3.0, "copies/MiB")])
+        verdict = gate.evaluate(
+            [gate.parse_round_file(p) for p in hist],
+            gate.parse_round_file(bad))
+        regressed = {r["metric"] for r in verdict["regressions"]}
+        assert regressed == {"wire_native_p2p_256MiB",
+                             "wire_native_copies_per_mib"}
+        ok = _round_file(
+            tmp_path / "ok.json",
+            [ln("wire_native_p2p_256MiB", 2.1, "GB/s"),
+             ln("wire_native_copies_per_mib", 0.0, "copies/MiB")])
+        assert gate.main(hist + ["--candidate", str(ok)]) == 0
+
     def test_topo_metric_directions(self, tmp_path):
         """The fleet_scaling suite's topo_* lines (topology-aware
         schedule speedups over the flat ring: inter-host byte ratio,

@@ -49,7 +49,8 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/tuning/retune.py",
            "ompi_release_tpu/service/qos.py",
            "ompi_release_tpu/service/tenant.py",
-           "ompi_release_tpu/obs/ledger.py")
+           "ompi_release_tpu/obs/ledger.py",
+           "ompi_release_tpu/btl/nativewire.py")
 
 #: attribute calls that ARE emit sites when ungated
 EMIT_ATTRS = {"record", "begin", "body", "end", "arm"}

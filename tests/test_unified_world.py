@@ -848,9 +848,14 @@ class TestUnifiedWorld:
         out = _run(tmp_path, capfd, """
             import os
             # distinct identity per worker BEFORE bootstrap: forces
-            # the cross-host transport choice
+            # the cross-host transport choice. The nativewire datapath
+            # is pinned OFF so this test keeps covering the portable
+            # DCN staging path (the graceful-degradation target the
+            # native component falls back to); test_nativewire.py
+            # covers the native cross-host mode
             os.environ["OMPITPU_HOST_ID"] = (
                 "fakehost-" + os.environ["OMPITPU_NODE_ID"])
+            os.environ["OMPITPU_NATIVEWIRE"] = "0"
             from ompi_release_tpu.mca import pvar
             from ompi_release_tpu.osc.window import win_allocate
             world = mpi.init()
