@@ -1113,6 +1113,177 @@ def _steady_cases(cases, reps, world, tuned_i, tuned_c, lines,
             })
 
 
+def _rma_steady_micro_suite():
+    """Interpreted-vs-planned steady state for the one-sided plane
+    (the RMA analogue of the coll steady-state suite, osc/plan): the
+    SAME fence epoch — put + accumulate + get on a driver window — run
+    through the fully interpreted per-epoch dispatch
+    (``osc_compiled=0``) and through frozen access plans whose single
+    fused XLA program replays per epoch. Python-orchestration time is
+    the ``osc_orchestration_seconds`` pvar delta (both paths feed it);
+    the planned leg asserts BITWISE parity against its interpreted
+    twin in-app (same branch lambdas, so structural identity) and that
+    ``osc_plan_cache_hits`` recorded >= reps replays. A second block
+    does the same for the planned symmetric-heap bulk path
+    (``shmem_bulk``): batched puts/AMOs drained as one window epoch
+    per quiet vs the per-call epochs, wall-time compared with parity
+    on every PE's final heap contents."""
+    import jax.numpy as jnp
+
+    import ompi_release_tpu as mpi
+    from ompi_release_tpu import ops
+    # eager: osc/plan is lazily imported by the window close path, and
+    # its pvars only exist after module import — baseline reads below
+    # need them registered NOW
+    import ompi_release_tpu.osc.plan  # noqa: F401
+    from ompi_release_tpu.mca import pvar as _pvar_mod
+    from ompi_release_tpu.mca import var as mca_var
+    from ompi_release_tpu.osc import win_allocate
+    from ompi_release_tpu.oshmem import shmem as _shmem_mod
+
+    world = mpi.init()
+    lines = []
+    KiB = 1024
+    reps = 30
+
+    def _orch():
+        pv = _pvar_mod.PVARS.lookup("osc_orchestration_seconds")
+        return float(pv.read()) if pv is not None else 0.0
+
+    def _hits():
+        pv = _pvar_mod.PVARS.lookup("osc_plan_cache_hits")
+        return pv.read() if pv is not None else {"sum": 0, "count": 0}
+
+    for nbytes in (4 * KiB, 64 * KiB, 256 * KiB):
+        elems = max(1, nbytes // 4)
+        label = f"rma_fence_{_human(nbytes)}"
+        pay = np.arange(elems, dtype=np.float32) * 0.5
+        acc = np.full(elems, 0.25, np.float32)
+
+        def epoch(win, _pay=pay, _acc=acc):
+            win.fence()
+            win.put(_pay, target=1)
+            win.accumulate(_acc, target=1, op=ops.SUM)
+            g = win.get(target=1)
+            win.fence_end()
+            return np.asarray(g.value)
+
+        def leg(win):
+            epoch(win)  # warm: freeze the plan / compile branches
+            o0 = _orch()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = epoch(win)
+            wall = (time.perf_counter() - t0) / reps
+            orch = (_orch() - o0) / reps
+            return wall, orch, out, np.asarray(win.read())
+
+        win_i = win_allocate(world, (elems,), jnp.float32)
+        win_c = win_allocate(world, (elems,), jnp.float32)
+        try:
+            mca_var.set_value("osc_compiled", 0)
+            try:
+                wall_i, orch_i, got_i, data_i = leg(win_i)
+            finally:
+                mca_var.VARS.unset("osc_compiled")
+            h0 = _hits()
+            wall_c, orch_c, got_c, data_c = leg(win_c)
+            h1 = _hits()
+            np.testing.assert_array_equal(got_c, got_i)  # BITWISE
+            np.testing.assert_array_equal(data_c, data_i)
+            assert h1["sum"] - h0["sum"] >= reps, (
+                "planned leg did not replay frozen epoch plans")
+        finally:
+            win_i.free()
+            win_c.free()
+
+        common = {"suite": "steady_state", "vs_baseline": None,
+                  "reps": reps, "bytes": nbytes}
+        lines.append({
+            "metric": f"steady_{label}_interpreted",
+            "value": round(orch_i, 9), "unit": "s",
+            "wall_seconds": round(wall_i, 9),
+            "comm_alone_seconds": round(wall_i - orch_i, 9), **common,
+        })
+        lines.append({
+            "metric": f"steady_{label}_planned",
+            "value": round(orch_c, 9), "unit": "s",
+            "wall_seconds": round(wall_c, 9),
+            "comm_alone_seconds": round(wall_c - orch_c, 9), **common,
+        })
+        lines.append({
+            "metric": f"compiled_{label}_orch_speedup",
+            "value": round(orch_i / max(orch_c, 1e-12), 3),
+            "unit": "x_orchestration",
+            "interpreted_orch_s": round(orch_i, 9),
+            "planned_orch_s": round(orch_c, 9),
+            "wall_speedup": round(wall_i / max(wall_c, 1e-12), 3),
+            **common,
+        })
+
+    # planned symmetric-heap bulk path: per-call epochs vs one drained
+    # window epoch per quiet, same op stream, parity on every PE
+    shmem = _shmem_mod.shmem_init()
+
+    def _bulk_ops():
+        pv = _pvar_mod.PVARS.lookup("shmem_bulk_ops")
+        return float(pv.read()) if pv is not None else 0.0
+
+    for nbytes in (4 * KiB, 64 * KiB):
+        elems = max(1, nbytes // 4)
+        label = f"shmem_put_{_human(nbytes)}"
+        vals = [np.full(elems, float(pe + 1), np.float32)
+                for pe in range(shmem.n_pes)]
+        bump = np.full(elems, 0.5, np.float32)
+
+        def leg():
+            sym = shmem.malloc((elems,), jnp.float32)
+            try:
+                for pe in range(shmem.n_pes):  # warm
+                    shmem.put(sym, vals[pe], pe=pe)
+                shmem.quiet()
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    for pe in range(shmem.n_pes):
+                        shmem.put(sym, vals[pe], pe=pe)
+                        shmem.atomic_add(sym, bump, pe=pe)
+                    shmem.quiet()
+                wall = (time.perf_counter() - t0) / reps
+                out = np.stack([np.asarray(shmem.get(sym, pe=pe))
+                                for pe in range(shmem.n_pes)])
+            finally:
+                sym.free()
+            return wall, out
+
+        mca_var.set_value("shmem_bulk", 0)
+        try:
+            wall_p, want = leg()
+        finally:
+            mca_var.VARS.unset("shmem_bulk")
+        b0 = _bulk_ops()
+        wall_b, got = leg()
+        assert _bulk_ops() - b0 >= reps, (
+            "bulk leg did not route through the planned heap path")
+        np.testing.assert_array_equal(got, want)  # BITWISE in-app
+
+        common = {"suite": "steady_state", "vs_baseline": None,
+                  "reps": reps, "bytes": nbytes}
+        lines.append({
+            "metric": f"steady_{label}_percall",
+            "value": round(wall_p, 9), "unit": "s", **common,
+        })
+        lines.append({
+            "metric": f"steady_{label}_bulk",
+            "value": round(wall_b, 9), "unit": "s", **common,
+        })
+        lines.append({
+            "metric": f"compiled_{label}_bulk_speedup",
+            "value": round(wall_p / max(wall_b, 1e-12), 3),
+            "unit": "x_wall", **common,
+        })
+    return lines
+
+
 _STEADY_SPAN_APP = r"""
 import json, os, sys, time
 sys.path.insert(0, %(repo)r)
@@ -2870,9 +3041,13 @@ def main():
     #   steady_state: interpreted-vs-compiled Python-orchestration
     #            time (frozen schedule plans, coll/plan) for one-shot,
     #            persistent, and 3-proc spanning allreduce legs
+    #   rma_steady: the one-sided twin (frozen epoch plans, osc/plan)
+    #            — interpreted-vs-planned fence epochs plus the
+    #            planned symmetric-heap bulk path vs per-call
     _run_suite("coll_micro_suite", _coll_micro_suite, emit, jax)
     _run_suite("steady_state_suite", _steady_state_micro_suite, emit,
                jax)
+    _run_suite("rma_steady_suite", _rma_steady_micro_suite, emit, jax)
     _run_suite("sentinel_suite", _sentinel_micro_suite, emit, jax)
     _run_suite("wire_micro_suite",
                lambda: _wire_micro_suite(backend_label), emit, jax)

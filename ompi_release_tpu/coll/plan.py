@@ -282,6 +282,12 @@ def _arg_desc(a) -> Optional[Tuple]:
     return None
 
 
+#: public name: osc/plan reuses the same descriptor rules for RMA
+#: epoch signatures — identical Op-OBJECT keying and array metadata,
+#: so the two planes can never drift on what is plannable
+arg_desc = _arg_desc
+
+
 def signature_of(name: str, args: Tuple,
                  kw: Optional[Dict]) -> Optional[Tuple]:
     """Hashable plan signature of one collective call, or None when

@@ -387,6 +387,46 @@ def selftest() -> int:
             os.environ["OMPITPU_NATIVEWIRE"] = prior
     print("nativewire: disable switch withdraws the component cleanly")
 
+    # 13. frozen RMA access plans (osc/plan, device-free): epoch
+    # signatures are stable metadata (identical op sequences share a
+    # plan, a different target does not), the frozen wire BatchTemplate
+    # renders BYTE-identical frames to the interpreted _pack_batch, and
+    # a KIND_RMA ledger fire expands into an "osc"-layer span — no
+    # fused program ever fires here
+    from .. import ops as _ops
+    from ..osc import plan as _osc_plan
+    from ..osc.window import _PendingOp as _POp
+    from ..osc.wire_win import _pack_batch as _pack
+
+    def _rma_todo(tgt=1):
+        return [
+            _POp("put", tgt, data=_np.arange(4, dtype=_np.float32),
+                 op=_ops.REPLACE),
+            _POp("acc", 0, data=_np.full(4, 2.0, _np.float32),
+                 op=_ops.SUM),
+        ]
+
+    rs1 = _osc_plan.epoch_signature(_rma_todo())
+    rs2 = _osc_plan.epoch_signature(_rma_todo())
+    rs3 = _osc_plan.epoch_signature(_rma_todo(tgt=0))
+    assert rs1 == rs2 and rs1 != rs3, (rs1, rs3)
+    todo = _rma_todo()
+    tpl3 = _osc_plan.BatchTemplate(_var.VARS.generation, todo)
+    assert tpl3.render(todo).tobytes() == _pack(todo).tobytes(), (
+        "frozen frame template must render byte-identical to "
+        "_pack_batch")
+    rlid = _ledger.register_rma_plan(9, "epoch[2]", 32, rs1)
+    _ledger.record_fire(_ledger.KIND_RMA, rlid, 9, 3.0, 3.5)
+    rrec = _ledger.records()[-1]
+    rdocs = {str(k): v for k, v in _ledger.plans().items()}
+    rspans = _ledger.expand_record(rrec, rdocs)
+    assert rspans and all(s["layer"] == "osc" for s in rspans), rspans
+    rcs = _osc_plan.cache_stats()
+    print(f"rma plans: signatures stable; frames byte-identical; "
+          f"KIND_RMA expands to osc-layer spans; "
+          f"{rcs['epoch_plans']} plans / {rcs['programs']} programs / "
+          f"{rcs['fires']} fires")
+
     disable()
     print("obs selftest: ok")
     return 0

@@ -12,7 +12,8 @@ fire appends only one fixed-size binary record into a per-rank slot
 ring:
 
     header  ``<BHiQIIdd``  (39 bytes, little-endian, no padding)
-        kind      u8   0 = device (one XLA program), 1 = spanning
+        kind      u8   0 = device (one XLA program), 1 = spanning,
+                       2 = rma (one fused epoch program, osc/plan)
         n_rounds  u16  planned wire rounds timed in this fire
         cid       i32  communicator id
         plan_id   u64  ledger plan id (per-rank registry key)
@@ -62,6 +63,7 @@ DEFAULT_SIZE = 16384
 
 KIND_DEVICE = 0
 KIND_SPANNING = 1
+KIND_RMA = 2
 
 _HDR = struct.Struct("<BHiQIIdd")
 _TAILS: Dict[int, struct.Struct] = {}
@@ -121,6 +123,22 @@ def register_device_plan(cid: int, name: str, nbytes: int,
     """Register one frozen device plan (a single compiled XLA
     program); returns its ledger plan id."""
     meta = {"kind": "device", "cid": int(cid), "name": name,
+            "nbytes": int(nbytes), "sig": _sig_summary(sig),
+            "rounds": []}
+    with _lock:
+        pid = next(_next_plan)
+        _plans[pid] = meta
+    return pid
+
+
+def register_rma_plan(cid: int, name: str, nbytes: int,
+                      sig: Any = "") -> int:
+    """Register one frozen RMA access plan (a single fused epoch
+    program — ``osc/plan``); returns its ledger plan id. Fires expand
+    to ``osc``-layer spans, so the doctor's per-comm series see
+    compiled RMA epochs exactly like interpreted ``win_apply``
+    traffic."""
+    meta = {"kind": "rma", "cid": int(cid), "name": name,
             "nbytes": int(nbytes), "sig": _sig_summary(sig),
             "rounds": []}
     with _lock:
@@ -258,7 +276,8 @@ def expand_record(rec: Dict[str, Any],
 
     Device fires expand to one ``coll``-layer span (the per-comm
     ``coll_*`` series and round alignment see compiled device traffic
-    again). Spanning fires expand to one hier-layer span per planned
+    again); RMA fires to one ``osc``-layer span per epoch replay.
+    Spanning fires expand to one hier-layer span per planned
     wire round plus per-message send/recv instants carrying the
     interpreted path's exact flow ids: ``flow_id("hier", cid, round0,
     src, dst, k)`` with k accumulated per directed pair in posting
@@ -270,8 +289,9 @@ def expand_record(rec: Dict[str, Any],
         return []
     cid = rec["cid"]
     name = meta.get("name", "coll")
-    if meta.get("kind") == "device" or not meta.get("rounds"):
-        return [{"seq": rec["seq"], "op": name, "layer": "coll",
+    if meta.get("kind") in ("device", "rma") or not meta.get("rounds"):
+        layer = "osc" if meta.get("kind") == "rma" else "coll"
+        return [{"seq": rec["seq"], "op": name, "layer": layer,
                  "t": rec["t_start"],
                  "dt": max(0.0, rec["t_end"] - rec["t_start"]),
                  "bytes": int(meta.get("nbytes", 0)), "peer": -1,

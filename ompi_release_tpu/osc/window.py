@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import enum
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -154,6 +155,10 @@ class Window:
         self._freed = False
         self._flavor = FLAVOR_CREATE  # constructors override
         self._attrs: Dict[int, object] = {}  # user keyvals (win_keyval)
+        # frozen per-epoch-signature access plans and precomposed
+        # remote-batch wire frames (osc/plan); evicted at free()
+        self._access_plans: Dict[Tuple, Any] = {}
+        self._batch_templates: Dict[Tuple, Any] = {}
 
     # -- queries -----------------------------------------------------------
     @property
@@ -379,6 +384,10 @@ class Window:
             if kv and kv.delete_fn:
                 kv.delete_fn(self, kv, value, kv.extra_state)
         self._attrs.clear()
+        # a freed window must not pin fused epoch programs or frame
+        # templates (osc/plan eviction contract)
+        self._access_plans.clear()
+        self._batch_templates.clear()
         self._freed = True
 
     # -- RMA operations ----------------------------------------------------
@@ -538,7 +547,12 @@ class Window:
                     new_e = op(flat[idx], elem(pay, idx))
                     return flat.at[idx].set(new_e).reshape(cur.shape), cur
                 return acc_elem
-            return lambda cur, pay, cmp, idx: (op(cur, pay), cur)
+            # ops that ignore cur (REPLACE) return the payload as-is —
+            # a scalar in scalar-payload epochs — so pin the branch
+            # output to the slice shape or lax.switch rejects the
+            # branch set (scalar new vs slice new)
+            return lambda cur, pay, cmp, idx: (
+                jnp.broadcast_to(op(cur, pay), cur.shape), cur)
         # cas
         if indexed:
             def cas_elem(cur, pay, cmp, idx):
@@ -588,12 +602,25 @@ class Window:
         if not self._pending:
             return
         _epoch_count.add()
-        self._run_epoch_program(self._take_pending(only_target))
+        todo = self._take_pending(only_target)
+        if not todo:
+            return
+        t0 = time.perf_counter()
+        from . import plan as _osc_plan
 
-    def _run_epoch_program(self, todo: List[_PendingOp]) -> None:
+        # a repeated epoch replays its frozen access plan (one fused
+        # program, no per-close branch dispatch); the first close of a
+        # new signature captures through the interpreted program below
+        if not _osc_plan.close_epoch(self, todo, t0):
+            self._run_epoch_program(todo, _t0=t0)
+
+    def _run_epoch_program(self, todo: List[_PendingOp],
+                           _t0: Optional[float] = None) -> None:
         """Apply ``todo`` (targets = storage row indices) as one
         compiled program and complete its read requests. Callers hold
-        ``_op_lock``."""
+        ``_op_lock``. ``_t0`` (close-entry clock) feeds the shared
+        orchestration timer so the interpreted and planned paths are
+        measured over identical spans."""
         if not todo:
             return
         from jax import lax
@@ -658,6 +685,10 @@ class Window:
         )
 
         sig = (n_pad, block, str(dtype), tuple(branch_keys), scalar_mode)
+        if _t0 is not None:
+            from . import plan as _osc_plan
+
+            _osc_plan.orch_add(time.perf_counter() - _t0)
         with _dispatch_lock:
             prog = _program_cache.get(sig)
             if prog is None:

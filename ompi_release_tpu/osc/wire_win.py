@@ -47,6 +47,7 @@ import jax.numpy as jnp
 
 from .. import obs as _obs
 from ..mca import pvar
+from ..mca import var as mca_var
 from ..native import DssBuffer
 from ..obs import watchdog as _watchdog
 from ..ops.op import PREDEFINED_OPS
@@ -57,6 +58,60 @@ from .window import (LOCK_EXCLUSIVE, LOCK_SHARED, Window, _EpochKind,
                      _PendingOp)
 
 _log = output.stream("osc")
+
+
+def register_vars() -> None:
+    mca_var.register(
+        "osc_request_timeout_ms", "int", 120_000,
+        "Bound in milliseconds on window-service request/reply waits "
+        "(batches, lock grants — a grant may legitimately be deferred "
+        "behind another holder, hence the generous default). The "
+        "effective bound also honors wire_coll_timeout_ms when that "
+        "is set higher",
+    )
+    mca_var.register(
+        "osc_abandon_timeout_ms", "int", 10_000,
+        "Bound in milliseconds on the best-effort lock-abandon notice "
+        "after a timed-out acquire (the home may be unreachable)",
+    )
+    mca_var.register(
+        "osc_pscw_timeout_s", "float", 0.0,
+        "Bound in seconds on PSCW start()/wait() notice waits; 0 = "
+        "unbounded (MPI's rule — the partner may compute arbitrarily "
+        "long before complete()); set it to turn a hung partner into "
+        "a diagnosable error",
+    )
+
+
+register_vars()
+
+
+class OscTuning:
+    """One immutable snapshot of the window service's hot-path cvars
+    (the ``WireRouter.tuning()`` pattern): per-request registry
+    lookups and hard-coded blocking-wait deadlines become attribute
+    reads off the current snapshot, re-resolved only when the MCA
+    write generation moves — RMA steady state never touches the
+    registry."""
+
+    __slots__ = ("gen", "request_timeout_ms", "abandon_timeout_ms",
+                 "pscw_timeout_s")
+
+    def __init__(self) -> None:
+        self.gen = mca_var.VARS.generation
+        req = int(mca_var.get("osc_request_timeout_ms", 120_000)
+                  or 120_000)
+        wire = int(mca_var.get("wire_coll_timeout_ms", 60_000)
+                   or 60_000)
+        # an operator-raised collective wait bound must not be
+        # undercut by the RMA default: a deferred lock grant can wait
+        # behind a holder for as long as any collective may block
+        self.request_timeout_ms = max(req, wire)
+        self.abandon_timeout_ms = int(
+            mca_var.get("osc_abandon_timeout_ms", 10_000) or 10_000)
+        self.pscw_timeout_s = float(
+            mca_var.get("osc_pscw_timeout_s", 0) or 0)
+
 
 _win_requests = pvar.counter(
     "osc_wire_requests",
@@ -125,12 +180,35 @@ KIND_COMPLETE = 5  # one-way: src process completed its access epoch
 KIND_ERROR = 99   # home-side failure applying a request
 
 
-def _pack_batch(todo: List[_PendingOp]) -> np.ndarray:
-    """Serialize a pending-op batch to one uint8 array (npz form)."""
+def _savez_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    """Deterministic npz writer: ``np.savez`` stamps every zip member
+    with the wall-clock mtime, so two packs of identical ops differ in
+    the member headers. Plan-time frame templates (osc/plan) must
+    render byte-identical output to the interpreted pack, so the zip
+    is written here with a fixed DOS-epoch timestamp — ``np.load``
+    reads it unchanged (same .npy members, same STORED layout)."""
+    import zipfile
+
+    bio = io.BytesIO()
+    with zipfile.ZipFile(bio, "w", zipfile.ZIP_STORED) as zf:
+        for name, val in arrays.items():
+            zi = zipfile.ZipInfo(name + ".npy",
+                                 date_time=(1980, 1, 1, 0, 0, 0))
+            with zf.open(zi, "w", force_zip64=True) as fid:
+                np.lib.format.write_array(fid, np.asanyarray(val),
+                                          allow_pickle=False)
+    return bio.getvalue()
+
+
+def _batch_meta(todo: List[_PendingOp]) -> List[Dict]:
+    """Per-op request records (the wire header metadata). Shared by
+    the per-call pack below and osc/plan's frozen ``BatchTemplate`` so
+    the two can never drift. The predefined check is by IDENTITY, not
+    name: a user op that merely shares a predefined op's name must be
+    refused, or the home would silently apply the predefined one."""
     meta = []
-    arrays: Dict[str, np.ndarray] = {}
-    for i, p in enumerate(todo):
-        if p.op is not None and p.op.name not in PREDEFINED_OPS:
+    for p in todo:
+        if p.op is not None and PREDEFINED_OPS.get(p.op.name) is not p.op:
             raise MPIError(
                 ErrorCode.ERR_OP,
                 f"cross-process RMA requires a predefined op, got "
@@ -143,6 +221,14 @@ def _pack_batch(todo: List[_PendingOp]) -> np.ndarray:
             "i": -1 if p.index is None else int(p.index),
             "r": p.request is not None,
         })
+    return meta
+
+
+def _pack_batch(todo: List[_PendingOp]) -> np.ndarray:
+    """Serialize a pending-op batch to one uint8 array (npz form)."""
+    meta = _batch_meta(todo)
+    arrays: Dict[str, np.ndarray] = {}
+    for i, p in enumerate(todo):
         if p.data is not None:
             arrays[f"d{i}"] = np.asarray(p.data)
         if p.compare is not None:
@@ -150,9 +236,7 @@ def _pack_batch(todo: List[_PendingOp]) -> np.ndarray:
     arrays["meta"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8
     ).copy()
-    bio = io.BytesIO()
-    np.savez(bio, **arrays)
-    return np.frombuffer(bio.getvalue(), dtype=np.uint8).copy()
+    return np.frombuffer(_savez_bytes(arrays), dtype=np.uint8).copy()
 
 
 def _unpack_batch(raw) -> List[_PendingOp]:
@@ -177,10 +261,10 @@ def _unpack_batch(raw) -> List[_PendingOp]:
 
 
 def _pack_reads(values: List[np.ndarray]) -> np.ndarray:
-    bio = io.BytesIO()
-    np.savez(bio, **{f"r{i}": np.asarray(v)
-                     for i, v in enumerate(values)})
-    return np.frombuffer(bio.getvalue(), dtype=np.uint8).copy()
+    return np.frombuffer(
+        _savez_bytes({f"r{i}": np.asarray(v)
+                      for i, v in enumerate(values)}),
+        dtype=np.uint8).copy()
 
 
 def _unpack_reads(raw, n: int) -> List[np.ndarray]:
@@ -229,6 +313,7 @@ class WinService:
         #: LATE reply must not be mistaken for the retry's (same cid/
         #: seq/kind) — tokens make staleness decidable
         self._token = itertools.count(1)
+        self._tuning = OscTuning()
         self._stop = threading.Event()
         _services.add(self)  # flight-recorder lock-table visibility
         self._thread = threading.Thread(
@@ -247,6 +332,19 @@ class WinService:
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=2)
+
+    # -- tuning snapshot ---------------------------------------------------
+    def tuning(self) -> OscTuning:
+        """Current tuning snapshot: one generation compare on the hot
+        path; any cvar write re-resolves at the next call."""
+        t = self._tuning
+        if t.gen != mca_var.VARS.generation:
+            t = self._tuning = OscTuning()
+        return t
+
+    def refresh_tuning(self) -> OscTuning:
+        self._tuning = OscTuning()
+        return self._tuning
 
     def register(self, win: "WireWindow") -> None:
         with self._state_lock:
@@ -432,16 +530,20 @@ class WinService:
     def request(self, win: "WireWindow", owner_pidx: int, kind: int,
                 arg1: int, arg2: int,
                 payload: Optional[np.ndarray] = None,
-                timeout_ms: int = 120_000) -> List[np.ndarray]:
+                timeout_ms: Optional[int] = None) -> List[np.ndarray]:
         """Send one request to ``owner_pidx`` and await its reply
         (lock grants may be deferred behind another holder, hence the
-        generous timeout). Returns the read arrays.
+        generous default bound — ``osc_request_timeout_ms``, read off
+        the tuning snapshot, never the registry). Returns the read
+        arrays.
 
         Concurrency: the reply channel is demultiplexed by token, so
         any number of threads may have requests outstanding — while a
         thread waits for a deferred lock grant, the thread whose
         unlock PRODUCES that grant proceeds through its own
         request/reply unimpeded (the ADVICE r5 two-thread deadlock)."""
+        if timeout_ms is None:
+            timeout_ms = self.tuning().request_timeout_ms
         token = next(self._token)
         _win_requests.add()
         rec = _obs.enabled  # capture once: flag may flip mid-request
@@ -561,12 +663,10 @@ class WinService:
         complete()), so the default is unbounded; operators can bound
         it with ``--mca osc_pscw_timeout_s N`` to turn a hung partner
         into a diagnosable error."""
-        from ..mca import var as mca_var
-
         want = set(procs)
         if not want:  # MPI_GROUP_EMPTY epochs are legal no-ops
             return
-        timeout_s = float(mca_var.get("osc_pscw_timeout_s", 0) or 0)
+        timeout_s = self.tuning().pscw_timeout_s
         deadline = (time.monotonic() + timeout_s) if timeout_s else None
         wd_tok = None
         if _watchdog.enabled:
@@ -669,10 +769,15 @@ class WinService:
             self.release(win, target, origin)
 
     def acquire_blocking(self, win: "WireWindow", target: int,
-                         lock_type: int, timeout_s: float = 120.0) -> None:
+                         lock_type: int,
+                         timeout_s: Optional[float] = None) -> None:
         """Local-origin acquire against the home table (the target is
         owned by THIS process, but remote origins contend through the
-        same table)."""
+        same table). The default wait bound is the snapshot's request
+        timeout — local and remote contenders give up on the same
+        clock."""
+        if timeout_s is None:
+            timeout_s = self.tuning().request_timeout_ms / 1000.0
         ev = threading.Event()
         if self.acquire(win, target, self.my_pidx, lock_type, event=ev):
             return
@@ -751,8 +856,12 @@ class WireWindow(Window):
         """Validate at the CALL SITE what the wire cannot ship: a
         user-defined op bound for a remote home would otherwise raise
         at epoch close, after sibling ops were already dequeued (and a
-        piggybacked lock release lost)."""
-        if (op.op is not None and op.op.name not in PREDEFINED_OPS
+        piggybacked lock release lost). The check is by op-object
+        IDENTITY — a user op that merely shares a predefined name
+        would otherwise ship its name and the home would silently
+        apply the predefined combiner."""
+        if (op.op is not None
+                and PREDEFINED_OPS.get(op.op.name) is not op.op
                 and self.owner[op.target] != self.my_pidx):
             raise MPIError(
                 ErrorCode.ERR_OP,
@@ -793,7 +902,11 @@ class WireWindow(Window):
                                status_rank=p.target)
                     for p in local
                 ]
-                self._run_epoch_program(remapped)
+                t0 = time.perf_counter()
+                from . import plan as _osc_plan
+
+                if not _osc_plan.close_epoch(self, remapped, t0):
+                    self._run_epoch_program(remapped, _t0=t0)
         # ship OUTSIDE _op_lock: holding it while awaiting the peer's
         # ack would deadlock two processes fencing into each other
         # (each service thread needs the lock to apply the other's
@@ -803,9 +916,14 @@ class WireWindow(Window):
 
     def _ship_batch(self, owner_pidx: int, ops: List[_PendingOp],
                     release_target: int) -> None:
+        from . import plan as _osc_plan
+
+        # repeated batches render through the signature's frozen
+        # frame template (meta composed once at freeze time); bytes
+        # are identical to _pack_batch either way
         reads = self.service.request(
             self, owner_pidx, KIND_BATCH, release_target, 0,
-            payload=_pack_batch(ops),
+            payload=_osc_plan.batch_payload(self, ops),
         )
         want = [p for p in ops if p.request is not None]
         if len(want) != len(reads):
@@ -830,8 +948,14 @@ class WireWindow(Window):
                     f"{self.owner[p.target]}, not {self.my_pidx}",
                 )
             p.target = self._local_pos(p.target)
+        t0 = time.perf_counter()
+        from . import plan as _osc_plan
+
         with self._op_lock:
-            self._run_epoch_program(todo)
+            # incoming batches ride the same access-plan cache: a
+            # peer's steady-state epoch replays one fused program here
+            if not _osc_plan.close_epoch(self, todo, t0):
+                self._run_epoch_program(todo, _t0=t0)
         return [np.asarray(p.request.value) for p in todo
                 if p.request is not None]
 
@@ -857,8 +981,9 @@ class WireWindow(Window):
             # (drops our waiter entry, or releases a grant we never
             # saw) so the lock cannot wedge on a ghost holder
             try:
-                self.service.request(self, own, KIND_ABANDON, target, 0,
-                                     timeout_ms=10_000)
+                self.service.request(
+                    self, own, KIND_ABANDON, target, 0,
+                    timeout_ms=self.service.tuning().abandon_timeout_ms)
             except MPIError:
                 pass  # home unreachable; nothing more to clean
             raise

@@ -13,30 +13,77 @@ the delegate-to-MPI ``scoll/mpi`` component). TPU-native recast:
   BTL path, here spml → osc) and complete at ``quiet``/``barrier_all``
   — OpenSHMEM's own completion rule. Fetch AMOs and get are blocking
   (they flush), put/add are posted.
+- the **planned bulk path** (``shmem_bulk``, default on): posted
+  puts/AMOs between ``quiet()``/``fence()`` boundaries are batched
+  per symmetric allocation as light host-side tuples — no per-call
+  ``jnp.asarray``, no per-call window queueing — and drained as ONE
+  window epoch, which the osc access-plan machinery (``osc/plan``)
+  closes as one fused device program per (allocation, signature).
+  Posted ops therefore follow ``shmem_put_nbi`` source-buffer rules:
+  the source is reusable after ``quiet()``. Blocking calls (get,
+  fetch AMOs, ``wait_until``, ``local``) drain first, so per-call
+  ordering is unchanged.
 - scoll delegates to the coll framework over the same communicator
   (exactly what ``scoll/mpi`` does to OMPI).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from .. import obs as _obs
 from .. import ops as ops_mod
 from ..mca import pvar
+from ..mca import var as mca_var
 from ..osc.window import Window
 from ..utils import output
 from ..utils.errors import ErrorCode, MPIError
 
 _log = output.stream("shmem")
 
+
+def register_vars() -> None:
+    mca_var.register(
+        "shmem_bulk", "bool", True,
+        "Batch posted SHMEM puts/AMOs per symmetric allocation "
+        "between quiet()/fence() boundaries and drain them as one "
+        "planned window epoch (one fused device program per "
+        "(allocation, signature) via osc/plan); false restores "
+        "per-call window queueing",
+    )
+
+
+register_vars()
+
 _heap_bytes = pvar.highwatermark(
     "shmem_heap_bytes", "symmetric heap bytes allocated"
 )
+_bulk_ops = pvar.counter(
+    "shmem_bulk_ops",
+    "posted SHMEM ops deferred into the per-allocation bulk queue",
+)
+_bulk_flushes = pvar.counter(
+    "shmem_bulk_flushes",
+    "bulk-queue drains (one planned window epoch per allocation)",
+)
+
+#: generation-cached shmem_bulk snapshot — posted-op hot path reads
+#: one attribute + int compare, never the registry
+_conf: Tuple[int, bool] = (-1, True)
+
+
+def _bulk_on() -> bool:
+    global _conf
+    gen = mca_var.VARS.generation
+    if _conf[0] != gen:
+        _conf = (gen, bool(mca_var.get("shmem_bulk", True)))
+    return _conf[1]
 
 
 class SymmetricArray:
@@ -63,6 +110,7 @@ class SymmetricArray:
         returns NULL for PEs without a load/store path
         (``oshmem/shmem/c/shmem_ptr.c``); use :meth:`ShmemCtx.get`
         for remote PEs."""
+        self._ctx._drain(self)
         self._win.flush_all()
         comm = self._win.comm
         if getattr(comm, "spans_processes", False):
@@ -77,6 +125,7 @@ class SymmetricArray:
         return self._win.read()[pe]
 
     def free(self) -> None:
+        self._ctx._drain(self)  # posted ops must land, not vanish
         self._win.unlock_all()
         self._win.free()
         self._ctx._allocs.discard(self)
@@ -88,6 +137,11 @@ class ShmemCtx:
     def __init__(self, comm) -> None:
         self.comm = comm
         self._allocs: set = set()
+        # planned bulk path: per-allocation queues of light
+        # (kind, pe, data, op, index) tuples — jnp.asarray and window
+        # queueing are deferred to the drain, where the whole batch
+        # closes as ONE planned window epoch
+        self._bulk: Dict["SymmetricArray", List[Tuple]] = {}
 
     # -- setup / query (shmem.h accessors) ---------------------------------
     @property
@@ -107,13 +161,53 @@ class ShmemCtx:
         )
         return arr
 
+    # -- the planned bulk path (shmem_bulk) --------------------------------
+    def _post(self, sym: SymmetricArray, kind: str, pe: int, data,
+              op, index) -> None:
+        """Defer one posted op into ``sym``'s bulk queue (nbi
+        semantics: the source lands at the next drain). The tuple
+        carries the frozen Op OBJECT — the drain replays it through
+        the window queue, so osc/plan keys the fused program by the
+        object, never by an op name."""
+        self._bulk.setdefault(sym, []).append((kind, pe, data, op, index))
+        _bulk_ops.add()
+
+    def _drain(self, sym: SymmetricArray) -> None:
+        """Replay ``sym``'s bulk queue as one window epoch and flush:
+        the whole batch closes as one fused device program per
+        (allocation, signature) via the osc access-plan cache."""
+        q = self._bulk.pop(sym, None)
+        if not q:
+            return
+        rec = _obs.enabled
+        t0 = time.perf_counter() if rec else 0.0
+        win = sym._win
+        for kind, pe, data, op, index in q:
+            if kind == "put":
+                win.put(jnp.asarray(data), pe, index=index)
+            else:  # acc
+                win.accumulate(jnp.asarray(data), pe, op=op, index=index)
+        win.flush_all()
+        _bulk_flushes.add()
+        if rec and _obs.enabled:
+            _obs.record(
+                "shmem_bulk_flush", "osc", t0,
+                time.perf_counter() - t0, nbytes=sum(
+                    int(getattr(d, "nbytes", 0) or 0)
+                    for _, _, d, _, _ in q),
+                comm_id=win.comm.cid)
+
     # -- data movement (spml put/get) --------------------------------------
     def put(self, sym: SymmetricArray, data, pe: int) -> None:
         """shmem_put: posted; completes at quiet/barrier_all."""
+        if _bulk_on():
+            self._post(sym, "put", pe, data, None, None)
+            return
         sym._win.put(jnp.asarray(data), pe)
 
     def get(self, sym: SymmetricArray, pe: int) -> jax.Array:
         """shmem_get: blocking (flushes pending ops first)."""
+        self._drain(sym)
         sym._win.flush_all()
         req = sym._win.get(pe)
         sym._win.flush_all()
@@ -123,19 +217,27 @@ class ShmemCtx:
         """Scalar put at a flat index (shmem_p): a true single-element
         posted put — O(1) staged bytes, no read-modify-write of the
         whole slot."""
+        if _bulk_on():
+            self._post(sym, "put", pe, value, None, int(index))
+            return
         sym._win.put(jnp.asarray(value), pe, index=int(index))
 
     # -- atomics (oshmem/mca/atomic) ---------------------------------------
     def atomic_add(self, sym: SymmetricArray, value, pe: int) -> None:
+        if _bulk_on():
+            self._post(sym, "acc", pe, value, ops_mod.SUM, None)
+            return
         sym._win.accumulate(jnp.asarray(value), pe, op=ops_mod.SUM)
 
     def atomic_fetch_add(self, sym: SymmetricArray, value, pe: int
                          ) -> jax.Array:
+        self._drain(sym)  # fetch observes earlier posted ops
         req = sym._win.fetch_and_op(jnp.asarray(value), pe, op=ops_mod.SUM)
         sym._win.flush(pe)
         return req.value
 
     def atomic_swap(self, sym: SymmetricArray, value, pe: int) -> jax.Array:
+        self._drain(sym)
         req = sym._win.fetch_and_op(jnp.asarray(value), pe,
                                     op=ops_mod.REPLACE)
         sym._win.flush(pe)
@@ -143,6 +245,7 @@ class ShmemCtx:
 
     def atomic_compare_swap(self, sym: SymmetricArray, cond, value, pe: int
                             ) -> jax.Array:
+        self._drain(sym)
         req = sym._win.compare_and_swap(jnp.asarray(value),
                                         jnp.asarray(cond), pe)
         sym._win.flush(pe)
@@ -159,6 +262,9 @@ class ShmemCtx:
 
     def atomic_set(self, sym: SymmetricArray, value, pe: int) -> None:
         """shmem_atomic_set: unconditional replace (no fetch)."""
+        if _bulk_on():
+            self._post(sym, "acc", pe, value, ops_mod.REPLACE, None)
+            return
         sym._win.accumulate(jnp.asarray(value), pe, op=ops_mod.REPLACE)
 
     def atomic_fetch(self, sym: SymmetricArray, pe: int) -> jax.Array:
@@ -217,8 +323,11 @@ class ShmemCtx:
 
     # -- ordering (shmem_quiet / shmem_fence) ------------------------------
     def quiet(self) -> None:
-        """Complete all outstanding puts/AMOs (shmem_quiet)."""
-        for a in self._allocs:
+        """Complete all outstanding puts/AMOs (shmem_quiet): drain
+        every allocation's bulk queue (one planned epoch each) and
+        flush anything queued outside the bulk path."""
+        for a in list(self._allocs):
+            self._drain(a)
             a._win.flush_all()
 
     def fence(self) -> None:

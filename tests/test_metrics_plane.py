@@ -722,6 +722,58 @@ class TestBenchGate:
                 "x_orchestration")])
         assert gate2.main(hist + ["--candidate", str(ok)]) == 0
 
+    def test_rma_steady_metric_directions(self, tmp_path):
+        """The rma_steady suite's lines (frozen RMA access plans,
+        osc/plan): steady_rma_* / steady_shmem_* seconds are
+        lower-better, the compiled_* orchestration and bulk-path
+        speedups higher-better — slower epochs or a collapsed speedup
+        regress, never improve."""
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        assert gate._direction(
+            "s", "steady_rma_fence_4KiB_planned") == -1
+        assert gate._direction(
+            None, "steady_rma_fence_4KiB_interpreted") == -1
+        assert gate._direction(
+            "x_orchestration",
+            "compiled_rma_fence_4KiB_orch_speedup") == 1
+        assert gate._direction(
+            "s", "steady_shmem_put_4KiB_bulk") == -1
+        assert gate._direction(
+            "x_wall", "compiled_shmem_put_4KiB_bulk_speedup") == 1
+
+        def ln(metric, v, unit):
+            return {"metric": metric, "value": v, "unit": unit,
+                    "vs_baseline": None, "tier_label": "loopback-cpu"}
+
+        hist = [_round_file(
+            tmp_path / f"BENCH_r{k:02d}.json",
+            [ln("steady_rma_fence_4KiB_planned",
+                7.0e-5 + k * 1e-6, "s"),
+             ln("compiled_shmem_put_4KiB_bulk_speedup",
+                1.8 + 0.02 * k, "x_wall")])
+            for k in range(4)]
+        # a doubled planned close or a collapsed bulk win trips it
+        bad = _round_file(
+            tmp_path / "cand.json",
+            [ln("steady_rma_fence_4KiB_planned", 2.0e-4, "s"),
+             ln("compiled_shmem_put_4KiB_bulk_speedup", 0.9,
+                "x_wall")])
+        verdict = gate.evaluate(
+            [gate.parse_round_file(p) for p in hist],
+            gate.parse_round_file(bad))
+        regressed = {r["metric"] for r in verdict["regressions"]}
+        assert regressed == {
+            "steady_rma_fence_4KiB_planned",
+            "compiled_shmem_put_4KiB_bulk_speedup"}
+        # ...an in-band round passes
+        ok = _round_file(
+            tmp_path / "ok.json",
+            [ln("steady_rma_fence_4KiB_planned", 7.1e-5, "s"),
+             ln("compiled_shmem_put_4KiB_bulk_speedup", 1.83,
+                "x_wall")])
+        assert gate.main(hist + ["--candidate", str(ok)]) == 0
+
     def test_flight_recorder_metric_directions(self, tmp_path):
         """The flight-recorder lines: steady_obs_* (obs-ON compiled
         orchestration seconds and the obs-ON/obs-OFF overhead ratio —

@@ -50,7 +50,9 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/service/qos.py",
            "ompi_release_tpu/service/tenant.py",
            "ompi_release_tpu/obs/ledger.py",
-           "ompi_release_tpu/btl/nativewire.py")
+           "ompi_release_tpu/btl/nativewire.py",
+           "ompi_release_tpu/osc/plan.py",
+           "ompi_release_tpu/oshmem/shmem.py")
 
 #: attribute calls that ARE emit sites when ungated
 EMIT_ATTRS = {"record", "begin", "body", "end", "arm"}
