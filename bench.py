@@ -2052,6 +2052,225 @@ def _native_wire_micro_suite(backend_label):
     return lines
 
 
+#: worker app for the native_obs micro-suite: the SAME shm-ring p2p
+#: loop with the always-on C counter blocks (every build has them),
+#: once with the optional native event ring OFF (the baseline wall)
+#: and once ON (one 32-byte C-side record per fragment) — the wall
+#: ratio is the observability plane's cost on the zero-copy byte
+#: path. A third 3-proc mode sends a ring of transfers with the event
+#: ring AND obs dumps on, so the parent can doctor-merge the
+#: nativeev-p*.json dumps and count reconstructed cross-process
+#: fragment flows. Process 0 writes JSON lines to
+#: OMPITPU_LOOPBACK_OUT.
+_NATIVE_OBS_BENCH_APP = r'''
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+MODE = os.environ["OMPITPU_NOBS_MODE"]  # counters | events | doctor
+SIZE = int(os.environ.get("OMPITPU_NOBS_SIZE", str(2 << 20)))
+REPS = int(os.environ.get("OMPITPU_NOBS_REPS", "10"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.mca import pvar
+from ompi_release_tpu.obs import nativeev as obs_nativeev
+from ompi_release_tpu.runtime.runtime import Runtime
+
+world = mpi.init()
+rt = Runtime.current()
+me = rt.bootstrap["process_index"]
+assert rt.wire._nw is not None, "native datapath did not come up"
+# the event ring must track its cvar: ON only in the events/doctor legs
+assert (obs_nativeev.get_ring() is not None) == (MODE != "counters"), (
+    "event-ring lifecycle does not match btl_nativewire_events")
+lines = []
+
+def _pv(name):
+    p = pvar.PVARS.lookup(name)
+    return float(p.read()) if p is not None else 0.0
+
+if MODE in ("counters", "events"):
+    x = np.ones(max(1, SIZE // 4), np.float32)
+
+    def _round(reps):
+        t0 = time.perf_counter()
+        for _i in range(reps):
+            if me == 0:
+                world.send(x, 2, tag=13, rank=0)
+                v, _st = world.recv(source=2, tag=14, rank=0)
+            else:
+                v, _st = world.recv(source=0, tag=13, rank=2)
+                world.send(np.asarray(v), 0, tag=14, rank=2)
+        return time.perf_counter() - t0
+
+    world.barrier()
+    _round(1)  # warmup: ring attach + first-touch stay out of walls
+    wall = None
+    for _b in range(3):
+        world.barrier()
+        dt = _round(REPS)
+        wall = dt if wall is None else min(wall, dt)
+    world.barrier()
+    if me == 0:
+        lines.append({
+            "metric": "native_obs_%%s_wall_s" %% MODE,
+            "value": round(wall, 5), "unit": "s",
+            "vs_baseline": None, "suite": "native_obs",
+            "reps": REPS, "size_mib": SIZE >> 20,
+            "native_bytes": _pv("wire_native_bytes")})
+        if MODE == "counters":
+            # the C counter blocks themselves, as gate-tracked lines
+            lines.append({
+                "metric": "wire_native_stall_count",
+                "value": _pv("wire_native_ring_stalls"),
+                "unit": "stalls", "vs_baseline": None,
+                "suite": "native_obs"})
+            lines.append({
+                "metric": "wire_native_stall_seconds",
+                "value": round(_pv("wire_native_stall_seconds"), 5),
+                "unit": "s", "vs_baseline": None,
+                "suite": "native_obs"})
+            lines.append({
+                "metric": "wire_native_ring_hwm_frac",
+                "value": round(_pv("wire_native_ring_hwm_frac"), 5),
+                "unit": "frac", "vs_baseline": None,
+                "suite": "native_obs"})
+        else:
+            lines.append({
+                "metric": "native_obs_event_records",
+                "value": float(obs_nativeev.get_ring().count()),
+                "unit": None, "vs_baseline": None,
+                "suite": "native_obs"})
+
+if MODE == "doctor":
+    # ring of staged transfers: proc i's rank 2i -> proc (i+1)%%3's
+    # rank (2i+2)%%6, sequential with barriers (no deadlock to manage)
+    x = np.ones(max(1, SIZE // 4), np.float32)
+    hops = ((0, 1, 0, 2), (1, 2, 2, 4), (2, 0, 4, 0))
+    for tag_off, (src, dst, srank, drank) in enumerate(hops):
+        world.barrier()
+        if me == src:
+            world.send(x, drank, tag=41 + tag_off, rank=srank)
+        elif me == dst:
+            v, _st = world.recv(source=srank, tag=41 + tag_off,
+                                rank=drank)
+            assert np.asarray(v).shape == x.shape
+    world.barrier()
+    if me == 0:
+        lines.append({"metric": "native_obs_doctor_leg_ok",
+                      "value": 1.0, "unit": None,
+                      "vs_baseline": None, "suite": "native_obs"})
+
+if me == 0:
+    with open(os.environ["OMPITPU_LOOPBACK_OUT"], "w") as f:
+        json.dump(lines, f)
+world.barrier()
+mpi.finalize()
+'''
+
+
+def _native_obs_micro_suite(backend_label):
+    """native_obs lines: the native-wire observability plane's cost
+    and fidelity. ``native_obs_counters_wall_s`` is the p2p wall with
+    ONLY the always-on C counter blocks (every build pays this — the
+    gate trends it across rounds); ``native_obs_events_wall_s`` adds
+    the optional event ring (one 32-byte C record per fragment), and
+    ``native_obs_overhead_ratio`` is events/counters with the 1.05
+    acceptance budget. The doctor leg runs a 3-process job with the
+    event ring and obs dumps on, doctor-merges the ``nativeev-p*``
+    dumps, and reports how many cross-process native fragment flows
+    reconstructed with paired ids. Withdraws with an informational
+    line when the native telemetry symbols are absent."""
+    import os
+    import tempfile
+
+    from ompi_release_tpu.tools.tpurun import run_loopback_app
+
+    try:
+        from ompi_release_tpu.native import (
+            telemetry_symbols_available, wire_symbols_available)
+        have = bool(wire_symbols_available()
+                    and telemetry_symbols_available())
+    except Exception:
+        have = False
+    if not have:
+        return [{"metric": "native_obs_suite", "value": None,
+                 "unit": None, "vs_baseline": None,
+                 "error": "native telemetry symbols unavailable "
+                          "(stale .so or portable-only build)"}]
+    full = backend_label is None
+    size = (8 << 20) if full else (2 << 20)
+    reps = 40 if full else 12
+    repo = os.path.dirname(os.path.abspath(__file__))
+    app = _NATIVE_OBS_BENCH_APP % {"repo": repo}
+    lines = []
+    walls = {}
+    for mode in ("counters", "events"):
+        mca = ([("btl_nativewire_events", "1")]
+               if mode == "events" else [])
+        got = run_loopback_app(
+            2, app,
+            {"OMPITPU_NOBS_MODE": mode,
+             "OMPITPU_NOBS_SIZE": str(size),
+             "OMPITPU_NOBS_REPS": str(reps)},
+            "native_obs_%s.json" % mode, timeout_s=300, mca=mca)
+        if got is None:
+            lines.append({"metric": "native_obs_%s_leg" % mode,
+                          "value": None, "unit": None,
+                          "vs_baseline": None,
+                          "error": "native obs bench job failed"})
+            continue
+        lines.extend(got)
+        for ln in got:
+            if ln.get("metric") == "native_obs_%s_wall_s" % mode:
+                walls[mode] = ln.get("value")
+    if walls.get("counters") and walls.get("events"):
+        lines.append({
+            "metric": "native_obs_overhead_ratio",
+            "value": round(walls["events"] / walls["counters"], 4),
+            "unit": "ratio", "vs_baseline": None,
+            "suite": "native_obs", "budget": 1.05})
+    # doctor-merge fidelity: 3 processes, event ring + obs dumps on
+    with tempfile.TemporaryDirectory() as dump_dir:
+        got = run_loopback_app(
+            3, app,
+            {"OMPITPU_NOBS_MODE": "doctor",
+             "OMPITPU_NOBS_SIZE": str(1 << 20),
+             "OMPITPU_NOBS_REPS": "1"},
+            "native_obs_doctor.json", timeout_s=300,
+            mca=[("btl_nativewire_events", "1"),
+                 ("obs_enable", "1"),
+                 ("obs_dump_dir", dump_dir)])
+        if got is None:
+            lines.append({"metric": "native_obs_doctor_leg",
+                          "value": None, "unit": None,
+                          "vs_baseline": None,
+                          "error": "native obs doctor job failed"})
+        else:
+            from ompi_release_tpu.obs import doctor as _doctor
+
+            dumps = _doctor.load_dir(dump_dir)
+            nw = [s for d in dumps for s in d.get("spans", ())
+                  if s.get("nativeev")]
+            pairs = [p for p in _doctor.flow_pairs(dumps)
+                     if p["cross_process"]
+                     and p["src"].get("nativeev")]
+            lines.append({
+                "metric": "native_obs_doctor_nativeev_spans",
+                "value": float(len(nw)), "unit": None,
+                "vs_baseline": None, "suite": "native_obs",
+                "procs": len(dumps)})
+            lines.append({
+                "metric": "native_obs_doctor_flow_pairs",
+                "value": float(len(pairs)), "unit": None,
+                "vs_baseline": None, "suite": "native_obs"})
+    return lines
+
+
 #: worker app for the overlap micro-suite: a REAL 3-process tpurun job
 #: measuring exposed vs hidden comm time — blocking allreduce-per-
 #: bucket followed by compute, vs overlapped iallreduce buckets
@@ -3053,6 +3272,9 @@ def main():
                lambda: _wire_micro_suite(backend_label), emit, jax)
     _run_suite("native_wire_suite",
                lambda: _native_wire_micro_suite(backend_label), emit,
+               jax)
+    _run_suite("native_obs_suite",
+               lambda: _native_obs_micro_suite(backend_label), emit,
                jax)
     _run_suite("hier_scaling_suite",
                lambda: _hier_micro_suite(backend_label), emit, jax)

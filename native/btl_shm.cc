@@ -10,9 +10,10 @@
 // shm analogue of the QoS lane striping the TCP path already does.
 //
 // Ring layout (one shm object):
-//   [64-byte header][capacity bytes of ring data]
+//   [128-byte header][capacity bytes of ring data]
 //   header: u64 magic, u64 capacity, u64 widx, u64 ridx,
-//           i64 producer_pid, i64 consumer_pid
+//           i64 producer_pid, i64 consumer_pid,
+//           then the telemetry block (see RingHdr)
 // widx/ridx are MONOTONIC byte counters (offset = idx % capacity);
 // they are only ever written by their owning side, with release
 // stores paired against acquire loads on the other side — the
@@ -42,10 +43,16 @@
 #include <cstring>
 #include <thread>
 
+#include "nativeev.h"
+
 namespace {
 
-constexpr uint64_t kRingMagic = 0x6f6d707473687231ULL;  // "omptshr1"
-constexpr size_t kHdrSize = 64;
+// v2: the header grew a telemetry block, which moves the data offset
+// — a v1 peer interpreting v2 bytes would corrupt frames, so the
+// magic changes with the layout. Safe across the fleet because every
+// rank builds the .so from the same sources (bindings stamp-check).
+constexpr uint64_t kRingMagic = 0x6f6d707473687232ULL;  // "omptshr2"
+constexpr size_t kHdrSize = 128;
 constexpr size_t kRecHdr = 8;  // u32 len + i32 tag
 constexpr size_t kSgPrefix = 4 + 8 + 8;  // "SGC2" + xfer + idx
 
@@ -56,6 +63,21 @@ struct RingHdr {
   uint64_t ridx;
   int64_t producer_pid;
   int64_t consumer_pid;
+  // telemetry block — always-on relaxed counters, each field written
+  // by exactly one side (SPSC carries over), read by anyone. w_* and
+  // hwm belong to the producer, r_* to the consumer. Bytes count
+  // record payloads (the fragment bytes Python used to count), hwm is
+  // the occupancy high-water mark in ring bytes, stall_ns accumulates
+  // time spent blocked in the Deadline wait loops.
+  uint64_t w_frames;
+  uint64_t w_bytes;
+  uint64_t w_stalls;
+  uint64_t w_stall_ns;
+  uint64_t hwm;
+  uint64_t r_frames;
+  uint64_t r_bytes;
+  uint64_t r_stalls;
+  uint64_t r_stall_ns;
 };
 static_assert(sizeof(RingHdr) <= kHdrSize, "ring header grew");
 
@@ -76,6 +98,46 @@ inline uint64_t load_acq(uint64_t* p) {
 inline void store_rel(uint64_t* p, uint64_t v) {
   __atomic_store_n(p, v, __ATOMIC_RELEASE);
 }
+
+// telemetry: each counter has a single writer, so load+store relaxed
+// is enough — no RMW, no fence, unmeasurable next to the memcpy
+inline uint64_t load_rlx(uint64_t* p) {
+  return __atomic_load_n(p, __ATOMIC_RELAXED);
+}
+inline void bump_rlx(uint64_t* p, uint64_t v) {
+  __atomic_store_n(p, __atomic_load_n(p, __ATOMIC_RELAXED) + v,
+                   __ATOMIC_RELAXED);
+}
+inline void max_rlx(uint64_t* p, uint64_t v) {
+  if (v > __atomic_load_n(p, __ATOMIC_RELAXED))
+    __atomic_store_n(p, v, __ATOMIC_RELAXED);
+}
+
+// one blocked wait = one stall; construct when the fast check fails,
+// settle() once on the way out (every exit path, including errors)
+struct StallTimer {
+  uint64_t* count;
+  uint64_t* ns;
+  std::chrono::steady_clock::time_point t0;
+  bool armed = false;
+  StallTimer(uint64_t* c, uint64_t* n) : count(c), ns(n) {}
+  void arm() {
+    if (armed) return;
+    armed = true;
+    t0 = std::chrono::steady_clock::now();
+    bump_rlx(count, 1);
+  }
+  uint64_t settle() {
+    if (!armed) return 0;
+    armed = false;
+    auto dt = std::chrono::steady_clock::now() - t0;
+    uint64_t w =
+        static_cast<uint64_t>(std::chrono::duration_cast<
+                              std::chrono::nanoseconds>(dt).count());
+    bump_rlx(ns, w);
+    return w;
+  }
+};
 
 inline bool pid_dead(int64_t pid) {
   // pid 0 = counterpart not attached yet: still coming up, not dead
@@ -104,6 +166,26 @@ inline uint64_t be64(const uint8_t* p) {
   uint64_t v = 0;
   for (int i = 0; i < 8; ++i) v = (v << 8) | p[i];
   return v;
+}
+
+// Peek the SGC2 prefix out of a scatter-gather list (the event ring
+// wants xfer/idx and the producer only has the iovec). True iff the
+// payload starts with a full prefix.
+bool sg_peek(const uint8_t** parts, const int64_t* lens,
+             int32_t nparts, uint64_t* xfer, uint64_t* idx) {
+  uint8_t pre[kSgPrefix];
+  size_t got = 0;
+  for (int32_t i = 0; i < nparts && got < kSgPrefix; ++i) {
+    size_t take = static_cast<size_t>(lens[i]);
+    if (take > kSgPrefix - got) take = kSgPrefix - got;
+    std::memcpy(pre + got, parts[i], take);
+    got += take;
+  }
+  if (got < kSgPrefix || std::memcmp(pre, "SGC2", 4) != 0)
+    return false;
+  *xfer = be64(pre + 4);
+  *idx = be64(pre + 12);
+  return true;
 }
 
 struct Deadline {
@@ -161,6 +243,11 @@ void* shmring_create(const char* name, int64_t capacity,
   h->ridx = 0;
   h->producer_pid = producer_pid;
   h->consumer_pid = 0;
+  // telemetry block starts zeroed (ftruncate guarantees it; be
+  // explicit so a future re-create-in-place stays correct)
+  h->w_frames = h->w_bytes = h->w_stalls = h->w_stall_ns = 0;
+  h->hwm = 0;
+  h->r_frames = h->r_bytes = h->r_stalls = h->r_stall_ns = 0;
   // magic LAST (release): an attacher seeing the magic sees a fully
   // initialized header
   __atomic_store_n(&h->magic, kRingMagic, __ATOMIC_RELEASE);
@@ -218,6 +305,22 @@ int64_t shmring_pending(void* vr) {
   return static_cast<int64_t>(load_acq(&h->widx) - load_acq(&h->ridx));
 }
 
+// Telemetry block reader. Indices:
+//   0 w_frames  1 w_bytes  2 w_stalls  3 w_stall_ns  4 hwm (bytes)
+//   5 r_frames  6 r_bytes  7 r_stalls  8 r_stall_ns
+// -1 for an unknown index. Reads are relaxed — the block is
+// monotonic diagnostics, not synchronization.
+int64_t shmring_stat(void* vr, int32_t which) {
+  RingHdr* h = hdr(static_cast<ShmRing*>(vr));
+  uint64_t* fields[] = {&h->w_frames, &h->w_bytes,   &h->w_stalls,
+                        &h->w_stall_ns, &h->hwm,     &h->r_frames,
+                        &h->r_bytes,  &h->r_stalls,  &h->r_stall_ns};
+  if (which < 0 || which >= static_cast<int32_t>(
+                                sizeof(fields) / sizeof(fields[0])))
+    return -1;
+  return static_cast<int64_t>(load_rlx(fields[which]));
+}
+
 // Producer side: append one record whose payload is the concatenation
 // of the scatter-gather parts. 0 on success, -1 timeout (ring full),
 // -2 record can never fit (caller must route via TCP), -3 consumer
@@ -233,14 +336,23 @@ int shmring_writev(void* vr, int32_t tag, const uint8_t** parts,
   uint64_t total = kRecHdr + plen;
   if (total > r->cap) return -2;
   Deadline dl(timeout_ms);
+  StallTimer stall(&h->w_stalls, &h->w_stall_ns);
   uint64_t w = h->widx;  // we are the only writer
   for (;;) {
     uint64_t used = w - load_acq(&h->ridx);
     if (r->cap - used >= total) break;
-    if (pid_dead(h->consumer_pid)) return -3;
-    if (dl.expired()) return -1;
+    stall.arm();  // ring full: this write is a stall until it drains
+    if (pid_dead(h->consumer_pid)) {
+      stall.settle();
+      return -3;
+    }
+    if (dl.expired()) {
+      stall.settle();
+      return -1;
+    }
     ring_nap();
   }
+  uint64_t waited = stall.settle();
   uint8_t rec[kRecHdr];
   uint32_t l32 = static_cast<uint32_t>(plen);
   std::memcpy(rec, &l32, 4);
@@ -252,6 +364,15 @@ int shmring_writev(void* vr, int32_t tag, const uint8_t** parts,
     pos += static_cast<uint64_t>(lens[i]);
   }
   store_rel(&h->widx, w + total);
+  bump_rlx(&h->w_frames, 1);
+  bump_rlx(&h->w_bytes, plen);
+  max_rlx(&h->hwm, (w + total) - load_acq(&h->ridx));
+  uint64_t xfer, idx;
+  if (sg_peek(parts, lens, nparts, &xfer, &idx))
+    ompitpu::nativeev_emit(
+        tag, xfer,
+        static_cast<uint32_t>(plen - kSgPrefix),
+        static_cast<uint32_t>(idx), /*recv_side=*/false, waited);
   return 0;
 }
 
@@ -269,13 +390,22 @@ int64_t shmring_read_frag(void* vr, int32_t tag, int64_t xfer,
   auto* r = static_cast<ShmRing*>(vr);
   RingHdr* h = hdr(r);
   Deadline dl(timeout_ms);
+  StallTimer stall(&h->r_stalls, &h->r_stall_ns);
   uint64_t rd = h->ridx;  // we are the only reader
   for (;;) {
     if (load_acq(&h->widx) != rd) break;
-    if (pid_dead(h->producer_pid)) return -3;
-    if (dl.expired()) return -1;
+    stall.arm();  // ring empty: this read is a stall until data lands
+    if (pid_dead(h->producer_pid)) {
+      stall.settle();
+      return -3;
+    }
+    if (dl.expired()) {
+      stall.settle();
+      return -1;
+    }
     ring_nap();
   }
+  uint64_t waited = stall.settle();
   uint8_t rec[kRecHdr];
   ring_get(r, rd, rec, kRecHdr);
   uint32_t plen;
@@ -286,6 +416,8 @@ int64_t shmring_read_frag(void* vr, int32_t tag, int64_t xfer,
   uint64_t next = rd + kRecHdr + plen;
   if (plen < kSgPrefix) {
     store_rel(&h->ridx, next);
+    bump_rlx(&h->r_frames, 1);
+    bump_rlx(&h->r_bytes, plen);
     return -4;
   }
   uint8_t pre[kSgPrefix];
@@ -293,18 +425,28 @@ int64_t shmring_read_frag(void* vr, int32_t tag, int64_t xfer,
   if (std::memcmp(pre, "SGC2", 4) != 0 ||
       be64(pre + 4) != static_cast<uint64_t>(xfer)) {
     store_rel(&h->ridx, next);
+    bump_rlx(&h->r_frames, 1);
+    bump_rlx(&h->r_bytes, plen);
     return -4;
   }
   int64_t idx = static_cast<int64_t>(be64(pre + 12));
   int64_t flen = static_cast<int64_t>(plen - kSgPrefix);
   if (idx < 0 || idx >= nchunks || idx * chunk + flen > nbytes) {
     store_rel(&h->ridx, next);
+    bump_rlx(&h->r_frames, 1);
+    bump_rlx(&h->r_bytes, plen);
     return -2;
   }
   if (flen)
     ring_get(r, rd + kRecHdr + kSgPrefix, base + idx * chunk,
              static_cast<size_t>(flen));
   store_rel(&h->ridx, next);
+  bump_rlx(&h->r_frames, 1);
+  bump_rlx(&h->r_bytes, plen);
+  ompitpu::nativeev_emit(tag, static_cast<uint64_t>(xfer),
+                         static_cast<uint32_t>(flen),
+                         static_cast<uint32_t>(idx),
+                         /*recv_side=*/true, waited);
   return idx;
 }
 
@@ -316,13 +458,22 @@ int64_t shmring_read_into(void* vr, int32_t* tag, uint8_t* out,
   auto* r = static_cast<ShmRing*>(vr);
   RingHdr* h = hdr(r);
   Deadline dl(timeout_ms);
+  StallTimer stall(&h->r_stalls, &h->r_stall_ns);
   uint64_t rd = h->ridx;
   for (;;) {
     if (load_acq(&h->widx) != rd) break;
-    if (pid_dead(h->producer_pid)) return -3;
-    if (dl.expired()) return -1;
+    stall.arm();
+    if (pid_dead(h->producer_pid)) {
+      stall.settle();
+      return -3;
+    }
+    if (dl.expired()) {
+      stall.settle();
+      return -1;
+    }
     ring_nap();
   }
+  stall.settle();
   uint8_t rec[kRecHdr];
   ring_get(r, rd, rec, kRecHdr);
   uint32_t plen;
@@ -331,6 +482,8 @@ int64_t shmring_read_into(void* vr, int32_t* tag, uint8_t* out,
   if (static_cast<int64_t>(plen) > maxlen) return -2;
   if (plen) ring_get(r, rd + kRecHdr, out, plen);
   store_rel(&h->ridx, rd + kRecHdr + plen);
+  bump_rlx(&h->r_frames, 1);
+  bump_rlx(&h->r_bytes, plen);
   return static_cast<int64_t>(plen);
 }
 

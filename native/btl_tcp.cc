@@ -22,6 +22,7 @@
 #include <limits.h>
 #include <sys/uio.h>
 
+#include "nativeev.h"
 #include "oob_endpoint.h"
 
 namespace {
@@ -68,6 +69,29 @@ inline uint64_t be64(const uint8_t* p) {
   return v;
 }
 
+// Event-ring peek: gather the SGC2 prefix out of the scatter-gather
+// list (non-fragment frames — headers, sentinels — emit no event).
+bool sg_peek(const uint8_t** parts, const int64_t* lens,
+             int32_t nparts, uint64_t* xfer, uint64_t* idx) {
+  uint8_t pre[kSgPrefix];
+  size_t got = 0;
+  for (int32_t i = 0; i < nparts && got < kSgPrefix; ++i) {
+    size_t take = static_cast<size_t>(lens[i]);
+    if (take > kSgPrefix - got) take = kSgPrefix - got;
+    std::memcpy(pre + got, parts[i], take);
+    got += take;
+  }
+  if (got < kSgPrefix || std::memcmp(pre, "SGC2", 4) != 0)
+    return false;
+  *xfer = be64(pre + 4);
+  *idx = be64(pre + 12);
+  return true;
+}
+
+inline void bump(std::atomic<uint64_t>& c, uint64_t v) {
+  c.fetch_add(v, std::memory_order_relaxed);
+}
+
 }  // namespace
 
 extern "C" {
@@ -94,6 +118,13 @@ int wire_sendv(void* h, int32_t dst, int32_t tag,
     for (int32_t i = 0; i < nparts; ++i)
       f.payload.insert(f.payload.end(), parts[i], parts[i] + lens[i]);
     ep->deliver_or_forward(std::move(f));
+    bump(ep->tx_frames, 1);
+    bump(ep->tx_bytes, total);
+    uint64_t xfer, idx;
+    if (sg_peek(parts, lens, nparts, &xfer, &idx))
+      ompitpu::nativeev_emit(
+          tag, xfer, static_cast<uint32_t>(total - kSgPrefix),
+          static_cast<uint32_t>(idx), /*recv_side=*/false, 0);
     return 0;
   }
   int fd = ep->next_hop_fd(dst);
@@ -109,8 +140,18 @@ int wire_sendv(void* h, int32_t dst, int32_t tag,
   }
   // same wmu discipline as send_frame: frames on a shared socket must
   // not interleave, and the control plane writes on this fd too
-  std::lock_guard<std::mutex> l(ep->wmu);
-  return writev_full(fd, iov.data(), iov.size()) ? 0 : -1;
+  {
+    std::lock_guard<std::mutex> l(ep->wmu);
+    if (!writev_full(fd, iov.data(), iov.size())) return -1;
+  }
+  bump(ep->tx_frames, 1);
+  bump(ep->tx_bytes, total);
+  uint64_t xfer, idx;
+  if (sg_peek(parts, lens, nparts, &xfer, &idx))
+    ompitpu::nativeev_emit(
+        tag, xfer, static_cast<uint32_t>(total - kSgPrefix),
+        static_cast<uint32_t>(idx), /*recv_side=*/false, 0);
+  return 0;
 }
 
 // Pop the next SGC2 fragment of transfer `xfer` from (src, tag) and
@@ -129,29 +170,77 @@ int64_t wire_recv_frag(void* h, int32_t src, int32_t tag, int64_t xfer,
   std::unique_lock<std::mutex> l(ep->mu);
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
+  // empty-queue stall accounting (the cv analogue of the shm ring's
+  // Deadline-loop stall block): armed on the first wait, settled on
+  // every exit path
+  bool stalled = false;
+  std::chrono::steady_clock::time_point stall_t0;
+  auto settle = [&]() -> uint64_t {
+    if (!stalled) return 0;
+    stalled = false;
+    uint64_t w = static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - stall_t0)
+            .count());
+    bump(ep->rx_stall_ns, w);
+    return w;
+  };
   for (;;) {
     for (auto it = ep->queue.begin(); it != ep->queue.end(); ++it) {
       if (it->tag != tag || (src != -1 && it->src != src)) continue;
       const auto& p = it->payload;
       if (p.size() < kSgPrefix || std::memcmp(p.data(), "SGC2", 4) != 0 ||
-          be64(p.data() + 4) != static_cast<uint64_t>(xfer))
+          be64(p.data() + 4) != static_cast<uint64_t>(xfer)) {
+        settle();
         return -4;
+      }
       int64_t idx = static_cast<int64_t>(be64(p.data() + 12));
       int64_t flen = static_cast<int64_t>(p.size() - kSgPrefix);
       if (idx < 0 || idx >= nchunks || idx * chunk + flen > nbytes) {
         ep->queue.erase(it);  // poisoned fragment: consume, report
+        settle();
         return -2;
       }
       if (flen)
         std::memcpy(base + idx * chunk, p.data() + kSgPrefix,
                     static_cast<size_t>(flen));
-      ep->queue.erase(it);
+      ep->queue.erase(it);  // `p` dangles past this point
+      uint64_t waited = settle();
+      bump(ep->rx_frames, 1);
+      bump(ep->rx_bytes, static_cast<uint64_t>(flen) + kSgPrefix);
+      ompitpu::nativeev_emit(tag, static_cast<uint64_t>(xfer),
+                             static_cast<uint32_t>(flen),
+                             static_cast<uint32_t>(idx),
+                             /*recv_side=*/true, waited);
       return idx;
     }
+    if (!stalled) {
+      stalled = true;
+      stall_t0 = std::chrono::steady_clock::now();
+      bump(ep->rx_stalls, 1);
+    }
     if (ep->stopping ||
-        ep->cv.wait_until(l, deadline) == std::cv_status::timeout)
+        ep->cv.wait_until(l, deadline) == std::cv_status::timeout) {
+      settle();
       return -1;
+    }
   }
+}
+
+// Endpoint telemetry block reader. Indices:
+//   0 tx_frames  1 tx_bytes  2 rx_frames  3 rx_bytes
+//   4 rx_stalls  5 rx_stall_ns
+// -1 for an unknown index.
+int64_t wire_stats(void* h, int32_t which) {
+  auto* ep = static_cast<Endpoint*>(h);
+  const std::atomic<uint64_t>* fields[] = {
+      &ep->tx_frames, &ep->tx_bytes,  &ep->rx_frames,
+      &ep->rx_bytes,  &ep->rx_stalls, &ep->rx_stall_ns};
+  if (which < 0 || which >= static_cast<int32_t>(
+                                sizeof(fields) / sizeof(fields[0])))
+    return -1;
+  return static_cast<int64_t>(
+      fields[which]->load(std::memory_order_relaxed));
 }
 
 }  // extern "C"

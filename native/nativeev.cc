@@ -1,0 +1,229 @@
+// native event ring implementation — see nativeev.h for the contract.
+//
+// One ring PER PROCESS (the ledger discipline: each rank owns its own
+// fixed-record store, merged offline), mmap'd over POSIX shm so the
+// bytes survive the emitting process for postmortem attach and so
+// live tools can read without stopping the writer.
+//
+// Ring layout (one shm object):
+//   [64-byte header][nslots * 32-byte records]
+//   header: u64 magic, u64 nslots, u64 widx (monotonic record count)
+// widx only grows; slot = seq % nslots, so the ring drops oldest on
+// wrap and `widx - min(widx, nslots)` is the first still-live seq.
+// Appends from one process can race across threads (main thread plus
+// oob reader threads), so the writer side takes a small mutex — this
+// ring is opt-in diagnostics, not the always-on counter block, and
+// the uncontended lock is noise next to the fragment copy it logs.
+// Readers are lock-free: copy records, then re-check widx and drop
+// anything the writer may have overwritten mid-copy (seqlock style).
+
+#include "nativeev.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <mutex>
+
+namespace {
+
+constexpr uint64_t kEvMagic = 0x6f6d70746e657631ULL;  // "omptnev1"
+constexpr size_t kEvHdrSize = 64;
+constexpr size_t kEvRecSize = 32;
+
+struct EvHdr {
+  uint64_t magic;
+  uint64_t nslots;
+  uint64_t widx;
+};
+static_assert(sizeof(EvHdr) <= kEvHdrSize, "event header grew");
+
+struct EvRec {
+  uint64_t t_ns;
+  uint64_t xfer;
+  int32_t tag;
+  uint32_t bytes;
+  uint32_t idx_dir;
+  uint32_t wait_ns;
+};
+static_assert(sizeof(EvRec) == kEvRecSize, "event record resized");
+
+struct EvRing {
+  uint8_t* map = nullptr;
+  uint64_t nslots = 0;
+  std::mutex wmu;  // writer side only; readers never take it
+};
+
+inline EvHdr* hdr(EvRing* r) { return reinterpret_cast<EvHdr*>(r->map); }
+inline EvRec* slot(EvRing* r, uint64_t seq) {
+  return reinterpret_cast<EvRec*>(r->map + kEvHdrSize +
+                                  (seq % r->nslots) * kEvRecSize);
+}
+
+// process-global sink for nativeev_emit; relaxed is enough — install
+// happens before traffic, and a stale NULL just skips one record
+std::atomic<EvRing*> g_sink{nullptr};
+
+inline uint64_t realtime_ns() {
+  struct timespec ts{};
+  ::clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ULL +
+         static_cast<uint64_t>(ts.tv_nsec);
+}
+
+EvRing* map_ev(int fd, uint64_t total) {
+  void* m = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  ::close(fd);
+  if (m == MAP_FAILED) return nullptr;
+  auto* r = new EvRing();
+  r->map = static_cast<uint8_t*>(m);
+  r->nslots = (total - kEvHdrSize) / kEvRecSize;
+  return r;
+}
+
+}  // namespace
+
+namespace ompitpu {
+
+void nativeev_emit(int32_t tag, uint64_t xfer, uint32_t bytes,
+                   uint32_t idx, bool recv_side, uint64_t wait_ns) {
+  EvRing* r = g_sink.load(std::memory_order_relaxed);
+  if (!r) return;
+  uint32_t w32 = wait_ns > 0xffffffffULL
+                     ? 0xffffffffU
+                     : static_cast<uint32_t>(wait_ns);
+  std::lock_guard<std::mutex> l(r->wmu);
+  EvHdr* h = hdr(r);
+  uint64_t seq = __atomic_load_n(&h->widx, __ATOMIC_RELAXED);
+  EvRec* rec = slot(r, seq);
+  rec->t_ns = realtime_ns();
+  rec->xfer = xfer;
+  rec->tag = tag;
+  rec->bytes = bytes;
+  rec->idx_dir = (idx & 0x7fffffffU) | (recv_side ? 0x80000000U : 0);
+  rec->wait_ns = w32;
+  // publish AFTER the record body (release): a reader seeing seq+1
+  // sees a complete record in that slot
+  __atomic_store_n(&h->widx, seq + 1, __ATOMIC_RELEASE);
+}
+
+}  // namespace ompitpu
+
+extern "C" {
+
+// Create (O_CREAT|O_EXCL) an event ring named `name` with `nslots`
+// 32-byte record slots. NULL when the name exists or mapping failed.
+void* nativeev_create(const char* name, int64_t nslots) {
+  if (nslots < 2) return nullptr;
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t total =
+      kEvHdrSize + static_cast<uint64_t>(nslots) * kEvRecSize;
+  if (::ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  EvRing* r = map_ev(fd, total);
+  if (!r) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  EvHdr* h = hdr(r);
+  h->nslots = static_cast<uint64_t>(nslots);
+  h->widx = 0;
+  __atomic_store_n(&h->magic, kEvMagic, __ATOMIC_RELEASE);
+  return r;
+}
+
+// Attach an existing event ring read-only-in-spirit (the mapping is
+// RW but attachers never write). NULL when absent / uninitialized.
+void* nativeev_attach(const char* name) {
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st{};
+  if (::fstat(fd, &st) != 0 ||
+      static_cast<size_t>(st.st_size) <= kEvHdrSize) {
+    ::close(fd);
+    return nullptr;
+  }
+  EvRing* r = map_ev(fd, static_cast<uint64_t>(st.st_size));
+  if (!r) return nullptr;
+  EvHdr* h = hdr(r);
+  if (__atomic_load_n(&h->magic, __ATOMIC_ACQUIRE) != kEvMagic ||
+      h->nslots != r->nslots) {
+    ::munmap(r->map, kEvHdrSize + r->nslots * kEvRecSize);
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+int nativeev_unlink(const char* name) { return ::shm_unlink(name); }
+
+void nativeev_close(void* vr) {
+  auto* r = static_cast<EvRing*>(vr);
+  if (g_sink.load(std::memory_order_relaxed) == r)
+    g_sink.store(nullptr, std::memory_order_relaxed);
+  ::munmap(r->map, kEvHdrSize + r->nslots * kEvRecSize);
+  delete r;
+}
+
+// Install `vr` as the process-global emit sink (NULL uninstalls).
+void nativeev_install(void* vr) {
+  g_sink.store(static_cast<EvRing*>(vr), std::memory_order_release);
+}
+
+int64_t nativeev_nslots(void* vr) {
+  return static_cast<int64_t>(static_cast<EvRing*>(vr)->nslots);
+}
+
+// Records ever appended (monotonic; wraps drop oldest, not this).
+int64_t nativeev_count(void* vr) {
+  auto* r = static_cast<EvRing*>(vr);
+  return static_cast<int64_t>(
+      __atomic_load_n(&hdr(r)->widx, __ATOMIC_ACQUIRE));
+}
+
+// Copy up to `max` records starting at sequence `start` into `out`
+// (max * 32 bytes). Clamps `start` up to the oldest still-live seq;
+// writes the first copied seq to *first_seq. Returns records copied.
+// Seqlock discipline: records overwritten during the copy are cut off
+// by re-reading widx afterwards.
+int64_t nativeev_read(void* vr, int64_t start, uint8_t* out,
+                      int64_t max, int64_t* first_seq) {
+  auto* r = static_cast<EvRing*>(vr);
+  EvHdr* h = hdr(r);
+  uint64_t w = __atomic_load_n(&h->widx, __ATOMIC_ACQUIRE);
+  uint64_t lo = w > r->nslots ? w - r->nslots : 0;
+  uint64_t s = static_cast<uint64_t>(start < 0 ? 0 : start);
+  if (s < lo) s = lo;
+  uint64_t n = w - s;
+  if (n > static_cast<uint64_t>(max)) n = static_cast<uint64_t>(max);
+  for (uint64_t i = 0; i < n; ++i)
+    std::memcpy(out + i * kEvRecSize, slot(r, s + i), kEvRecSize);
+  // anything the writer lapped while we copied is torn: drop it
+  uint64_t w2 = __atomic_load_n(&h->widx, __ATOMIC_ACQUIRE);
+  uint64_t lo2 = w2 > r->nslots ? w2 - r->nslots : 0;
+  if (s < lo2) {
+    uint64_t skip = lo2 - s;
+    if (skip >= n) {
+      n = 0;
+      s = lo2;
+    } else {
+      std::memmove(out, out + skip * kEvRecSize,
+                   (n - skip) * kEvRecSize);
+      n -= skip;
+      s = lo2;
+    }
+  }
+  if (first_seq) *first_seq = static_cast<int64_t>(s);
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"

@@ -1,0 +1,38 @@
+// native event ring — optional fixed-record telemetry for the
+// zero-copy datapath ("ompitpu-nativeev-v1").
+//
+// The PR 16 ledger kept Python-side tracing from de-optimizing the
+// compiled hot path with fixed-size binary records expanded lazily;
+// this is the same discipline one layer down. When a process installs
+// an event ring (cvar-gated, off by default), the native transports
+// (btl_shm.cc writev/read_frag, btl_tcp.cc sendv/recv_frag) append
+// one 32-byte record per SGC2 fragment — timestamp, tag, transfer id,
+// byte count, fragment index, direction, and how long the call waited
+// — into a process-local mmap'd shm ring with drop-oldest wrap.
+// Python never sees a per-fragment call; it decodes the ring at dump
+// time (finalize / postmortem) and the doctor expands records into
+// wire-layer spans whose flow ids re-derive from (tag, xfer, idx).
+//
+// Record layout (little-endian, 32 bytes):
+//   u64 t_ns      CLOCK_REALTIME nanoseconds at emit
+//   u64 xfer      transfer id from the SGC2 prefix
+//   i32 tag       ring/frame tag
+//   u32 bytes     fragment payload bytes (SGC2 prefix excluded)
+//   u32 idx_dir   fragment index; bit 31 set = receive side
+//   u32 wait_ns   time the emitting call spent blocked (saturating)
+
+#ifndef OMPITPU_NATIVEEV_H_
+#define OMPITPU_NATIVEEV_H_
+
+#include <cstdint>
+
+namespace ompitpu {
+
+// Append one record to the process-installed event ring; no-op (a
+// single relaxed pointer load) when no ring is installed. Thread-safe.
+void nativeev_emit(int32_t tag, uint64_t xfer, uint32_t bytes,
+                   uint32_t idx, bool recv_side, uint64_t wait_ns);
+
+}  // namespace ompitpu
+
+#endif  // OMPITPU_NATIVEEV_H_

@@ -166,6 +166,19 @@ struct Endpoint {
   std::deque<Frame> queue;
   std::deque<Frame> undeliverable;   // forwards awaiting a peer/route
   std::atomic<int> ttl_dropped{0};   // frames dropped at ttl 0
+
+  // native-wire telemetry block (the tcp analogue of the shm ring
+  // header counters): relaxed, always-on, bumped by wire_sendv /
+  // wire_recv_frag in btl_tcp.cc. tx_* counts vectored sends (bytes =
+  // payload, header excluded); rx_* counts fragments copied into a
+  // reassembly buffer; rx_stalls/rx_stall_ns accumulate time
+  // wire_recv_frag spent parked on the queue cv with nothing to match.
+  std::atomic<uint64_t> tx_frames{0};
+  std::atomic<uint64_t> tx_bytes{0};
+  std::atomic<uint64_t> rx_frames{0};
+  std::atomic<uint64_t> rx_bytes{0};
+  std::atomic<uint64_t> rx_stalls{0};
+  std::atomic<uint64_t> rx_stall_ns{0};
   std::condition_variable cv;
   std::vector<std::thread> threads;
   std::thread acceptor;

@@ -42,12 +42,14 @@ import os
 import threading
 import time as _time
 import uuid
+import weakref
 import zlib
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import watchdog as _watchdog
 from ..mca import component as mca_component
 from ..mca import pvar as _pvar
 from ..mca import var as mca_var
@@ -68,6 +70,7 @@ CARD_KEY = "nativewire"
 
 _RING_SLOTS_DEFAULT = 4
 _RING_BYTES_DEFAULT = 8 * 1024 * 1024
+_EVENT_SLOTS_DEFAULT = 1 << 16   # 2 MiB of 32-byte records
 _SEND_TIMEOUT_MS = 30_000
 #: exit-time grace for tx rings holding bytes no consumer mapped yet —
 #: covers a receiver still inside interpreter/jax startup, not a hang
@@ -102,6 +105,131 @@ _copies_per_mib = _pvar.PVARS.register(
                     / max(1.0, _native_bytes.read() / float(1 << 20))),
 )
 
+# ---------------------------------------------------------------------------
+# C-side telemetry fold: the counters block lives IN the ring headers
+# (native/btl_shm.cc RingHdr slack) and the tcp endpoint struct
+# (native/oob_endpoint.h) — relaxed single-writer u64s the transports
+# bump on every writev/read_frag, always on. Python never touches them
+# on the byte path; these getter pvars fold the live blocks on READ
+# (tpu_info snapshot, sampler tick), so the fleet metrics plane sees
+# native stalls without re-adding a Python emit site to the datapath.
+# ---------------------------------------------------------------------------
+
+_tele_lock = threading.Lock()
+#: live producer-side rings: their w_* counters are THIS process's
+#: work (the peer's r_* half belongs to the peer's own fold)
+_live_tx: set = set()
+#: live consumer-side rings: the r_* half is ours
+_live_rx: set = set()
+#: endpoints that carried native frames (tcp-leg wire_stats source);
+#: weak — an endpoint's lifetime belongs to the OOB layer
+_seen_eps: "weakref.WeakSet" = weakref.WeakSet()
+#: [stalls, stall_ns] folded out of rings at retire time, so closing
+#: a ring never makes the process counters go backwards
+_retired = [0, 0]
+#: monotonic floor for the fold (a GC'd endpoint drops its share;
+#: counters still must never decrease between two reads)
+_mono = [0, 0]
+
+
+def _track_ring(ring, tx: bool) -> None:
+    if ring is None:
+        return
+    with _tele_lock:
+        (_live_tx if tx else _live_rx).add(ring)
+
+
+def _retire_ring(ring, tx: bool) -> None:
+    """Fold a closing ring's final stall totals into the retired base
+    and drop it from the live set (stats() reads shared memory — it
+    must run BEFORE close unmaps)."""
+    if ring is None:
+        return
+    try:
+        st = ring.stats()
+    except Exception:
+        st = None
+    with _tele_lock:
+        (_live_tx if tx else _live_rx).discard(ring)
+        if st is not None:
+            pre = "w_" if tx else "r_"
+            _retired[0] += int(st.get(pre + "stalls", 0))
+            _retired[1] += int(st.get(pre + "stall_ns", 0))
+
+
+def _track_ep(ep) -> None:
+    try:
+        _seen_eps.add(ep)
+    except TypeError:
+        pass  # non-weakrefable test double: no tcp stats to fold
+
+
+def _stall_fold() -> Tuple[int, int]:
+    """(stalls, stall_ns) this process has spent blocked on the native
+    datapath: full-ring waits on tx rings, empty-ring waits on rx
+    rings, queue-cv waits on tcp endpoints, plus the retired base."""
+    with _tele_lock:
+        rings = ([(r, "w_") for r in _live_tx]
+                 + [(r, "r_") for r in _live_rx])
+        eps = list(_seen_eps)
+        stalls, ns = _retired[0], _retired[1]
+    for ring, pre in rings:
+        try:
+            st = ring.stats()
+        except Exception:
+            continue
+        stalls += int(st.get(pre + "stalls", 0))
+        ns += int(st.get(pre + "stall_ns", 0))
+    for ep in eps:
+        try:
+            ws = ep.wire_stats()
+        except Exception:
+            continue
+        stalls += int(ws.get("rx_stalls", 0))
+        ns += int(ws.get("rx_stall_ns", 0))
+    with _tele_lock:
+        _mono[0] = stalls = max(_mono[0], stalls)
+        _mono[1] = ns = max(_mono[1], ns)
+    return stalls, ns
+
+
+def _hwm_fold() -> float:
+    """Worst occupancy high-water fraction across live rings (0.0 with
+    no rings): how close the busiest ring ever came to backpressure."""
+    with _tele_lock:
+        rings = list(_live_tx) + list(_live_rx)
+    frac = 0.0
+    for ring in rings:
+        try:
+            cap = float(ring.capacity)
+            if cap > 0:
+                frac = max(frac, float(ring.stats().get("hwm", 0)) / cap)
+        except Exception:
+            continue
+    return min(1.0, frac)
+
+
+_ring_stalls_pvar = _pvar.PVARS.register(
+    "wire_native_ring_stalls", _pvar.PvarClass.COUNTER,
+    "times a native-datapath call sat blocked (full tx ring, empty rx "
+    "ring, empty tcp frame queue) — folded on read from the C-side "
+    "counter blocks, zero Python on the byte path",
+    getter=lambda: _stall_fold()[0],
+)
+_stall_seconds_pvar = _pvar.PVARS.register(
+    "wire_native_stall_seconds", _pvar.PvarClass.TIMER,
+    "cumulative seconds the native datapath spent blocked waiting on "
+    "a peer (the time complement of wire_native_ring_stalls)",
+    getter=lambda: _stall_fold()[1] / 1e9,
+)
+_hwm_frac_pvar = _pvar.PVARS.register(
+    "wire_native_ring_hwm_frac", _pvar.PvarClass.LEVEL,
+    "worst shm-ring occupancy high-water mark as a fraction of ring "
+    "capacity (1.0 = some ring completely filled; sustained highs "
+    "mean the consumer is the bottleneck or rings are undersized)",
+    getter=_hwm_fold,
+)
+
 
 def register_nativewire_vars() -> None:
     """The component's own cvars (its standard ``btl_nativewire_*``
@@ -123,6 +251,20 @@ def register_nativewire_vars() -> None:
         "btl_nativewire_ring_slots", "int", _RING_SLOTS_DEFAULT,
         "Shared-memory rings per co-hosted sender: wire channels hash "
         "across slots so independent lanes do not share one FIFO",
+    )
+    mca_var.register(
+        "btl_nativewire_events", "bool", False,
+        "Mmap one per-process native event ring (ompitpu-nativeev-v1) "
+        "and have the C transports append a 32-byte record per SGC2 "
+        "fragment (t_ns, tag, xfer, bytes, wait_ns; drop-oldest wrap); "
+        "tpu-doctor expands dumps into wire-layer spans with paired "
+        "flow ids. Off (default) = zero event-path work — the ring "
+        "counter blocks stay on either way",
+    )
+    mca_var.register(
+        "btl_nativewire_event_slots", "int", _EVENT_SLOTS_DEFAULT,
+        "Record capacity of the native event ring (32 bytes each; a "
+        "full ring overwrites its oldest records)",
     )
 
 
@@ -155,6 +297,83 @@ def _local_token() -> str:
         if _token is None:
             _token = f"{os.getpid():x}-{uuid.uuid4().hex[:8]}"
         return _token
+
+
+_ev_lock = threading.Lock()
+_ev_ring = None
+_ev_tried = False
+
+
+def _event_ring():
+    """Lazily create + install the per-process native event ring when
+    ``btl_nativewire_events`` turns it on (once per process, however
+    many modules bind). The shm name is unlinked immediately after
+    create — only this process's own mapping matters (records decode
+    in-process at dump time), so nothing can leak in /dev/shm. The
+    ring is handed to ``obs.nativeev`` so finalize dumps and watchdog
+    postmortems read it without importing this module."""
+    global _ev_ring, _ev_tried
+    with _ev_lock:
+        if _ev_tried:
+            return _ev_ring
+        _ev_tried = True
+        if not mca_var.get("btl_nativewire_events", False):
+            return None
+        try:
+            from ..native import NativeEventRing
+
+            slots = int(mca_var.get("btl_nativewire_event_slots",
+                                    _EVENT_SLOTS_DEFAULT)
+                        or _EVENT_SLOTS_DEFAULT)
+            name = f"/onwev-{_local_token()}"
+            ring = NativeEventRing.create(name, max(64, slots))
+            if ring is None:
+                # leftover name from a crashed earlier run (the token
+                # makes a LIVE collision impossible): clear and retry
+                NativeEventRing.unlink(name)
+                ring = NativeEventRing.create(name, max(64, slots))
+            if ring is None:
+                return None  # symbols absent or shm refused: stay off
+            NativeEventRing.unlink(name)
+            ring.install()
+            from ..obs import nativeev as _nativeev
+
+            _nativeev.set_ring(ring)
+            _ev_ring = ring
+            atexit.register(_uninstall_event_ring)
+        except Exception:
+            _ev_ring = None
+        return _ev_ring
+
+
+def _uninstall_event_ring() -> None:
+    """Exit hook: detach the C emit sink before interpreter teardown
+    starts unmapping things under it. The mapping itself stays (the
+    obs finalize dump may still be reading records)."""
+    with _ev_lock:
+        ring = _ev_ring
+    if ring is not None:
+        try:
+            ring.uninstall()
+        except Exception:
+            pass
+
+
+def _reset_event_state_for_tests() -> None:
+    global _ev_ring, _ev_tried
+    from ..obs import nativeev as _nativeev
+
+    with _ev_lock:
+        if _ev_ring is not None:
+            try:
+                if _nativeev.get_ring() is _ev_ring:
+                    _nativeev.set_ring(None)
+                _ev_ring.uninstall()
+                _ev_ring.close()
+            except Exception:
+                pass
+        _ev_ring = None
+        _ev_tried = False
 
 
 def modex_entry() -> Dict[str, str]:
@@ -193,6 +412,26 @@ def module_for(cards, my_pidx: int) -> Optional["NativeWireBtl"]:
 
 def _ring_name(token: str, src_pidx: int, slot: int) -> str:
     return f"/onw-{token}-{src_pidx}-{slot}"
+
+
+def _ring_wait_info(ring, peer_pidx: int, direction: str) -> dict:
+    """Watchdog payload for a blocked ring wait, resolved at DUMP
+    time: which ring (the /onw name carries the owner token), which
+    peer pid sits on the other end, which direction stalled, and the
+    ring's live occupancy — everything a postmortem reader needs to
+    tell 'consumer wedged' from 'producer never wrote'."""
+    info = {"ring": getattr(ring, "name", "?"),
+            "dir": direction, "peer_pidx": int(peer_pidx)}
+    try:
+        info["peer_pid"] = int(ring.consumer_pid() if direction == "send"
+                               else ring.producer_pid())
+        pending, cap = int(ring.pending()), int(ring.capacity)
+        info["pending"] = pending
+        info["capacity"] = cap
+        info["occupancy"] = round(pending / max(1, cap), 4)
+    except Exception:
+        pass  # ring unmapped under us: name + direction still help
+    return info
 
 
 def _slot_of(tag: int, slots: int) -> int:
@@ -279,6 +518,7 @@ class NativeWireBtl(DcnBtl):
         self.cards = cards
         self.my_pidx = int(my_pidx)
         self._caps = {}
+        _event_ring()  # cvar-gated; no-op (and cheap) when off
 
     def _cap(self, pidx: int) -> Optional[Tuple[str, int, int]]:
         """LIVE capability of ``pidx`` from the shared cards list."""
@@ -332,6 +572,7 @@ class NativeWireBtl(DcnBtl):
                     # token makes collisions with a LIVE ring impossible
                     ShmRing.unlink(name)
                     ring = ShmRing.create(name, ring_bytes, os.getpid())
+                _track_ring(ring, tx=True)
                 ent = self._tx[key] = (ring, threading.Lock())
             return ent
 
@@ -356,37 +597,50 @@ class NativeWireBtl(DcnBtl):
             peer_pid = int(self.cards[src_pidx].get("pid", 0) or 0)
         except Exception:
             pass
-        while True:
-            ring = ShmRing.attach(name, os.getpid())
-            if ring is not None:
-                ShmRing.unlink(name)
-                with self._ring_guard:
-                    ent = self._rx.get(key)
-                    if ent is None:
-                        ent = self._rx[key] = (ring, threading.Lock(),
-                                               {})
-                    else:
-                        ring.close()  # lost a benign double-attach race
-                return ent
-            if peer_pid:
-                try:
-                    os.kill(peer_pid, 0)
-                except ProcessLookupError:
+        tok = None
+        if _watchdog.enabled:
+            tok = _watchdog.arm(
+                "nw_ring_attach", peer=src_pidx,
+                info=lambda n=name, p=peer_pid, s=src_pidx: {
+                    "ring": n, "dir": "attach", "peer_pidx": int(s),
+                    "peer_pid": int(p)})
+        try:
+            while True:
+                ring = ShmRing.attach(name, os.getpid())
+                if ring is not None:
+                    ShmRing.unlink(name)
+                    with self._ring_guard:
+                        ent = self._rx.get(key)
+                        if ent is None:
+                            _track_ring(ring, tx=False)
+                            ent = self._rx[key] = (ring,
+                                                   threading.Lock(), {})
+                        else:
+                            ring.close()  # benign double-attach race
+                    return ent
+                if peer_pid:
+                    try:
+                        os.kill(peer_pid, 0)
+                    except ProcessLookupError:
+                        raise MPIError(
+                            ErrorCode.ERR_PROC_FAILED,
+                            f"shm ring from process {src_pidx} never "
+                            f"appeared and its producer (pid "
+                            f"{peer_pid}) is gone — peer died "
+                            "mid-transfer",
+                        )
+                    except PermissionError:
+                        pass  # alive under another uid
+                if _time.monotonic() >= deadline:
                     raise MPIError(
-                        ErrorCode.ERR_PROC_FAILED,
-                        f"shm ring from process {src_pidx} never "
-                        f"appeared and its producer (pid {peer_pid}) "
-                        "is gone — peer died mid-transfer",
+                        ErrorCode.ERR_PENDING,
+                        f"timed out waiting for process {src_pidx}'s "
+                        f"shm ring {name}",
                     )
-                except PermissionError:
-                    pass  # alive under another uid
-            if _time.monotonic() >= deadline:
-                raise MPIError(
-                    ErrorCode.ERR_PENDING,
-                    f"timed out waiting for process {src_pidx}'s shm "
-                    f"ring {name}",
-                )
-            _time.sleep(0.0005)
+                _time.sleep(0.0005)
+        finally:
+            if tok is not None:
+                _watchdog.disarm(tok)
 
     def _shutdown_rings(self) -> None:
         from ..native import ShmRing
@@ -411,38 +665,57 @@ class NativeWireBtl(DcnBtl):
                         _time.sleep(0.001)
                 except Exception:
                     pass
+                _retire_ring(ring, tx=True)
                 ShmRing.unlink(ring.name)  # no-op if consumer unlinked
                 ring.close()
         for ent in rx.values():
+            _retire_ring(ent[0], tx=False)
             ent[0].close()
 
     # -- send side ---------------------------------------------------------
     def _ring_put(self, ring, lk, oob_ep, peer_pidx: int, tag: int,
                   parts) -> None:
         deadline = _time.monotonic() + _SEND_TIMEOUT_MS / 1000
-        with lk:
-            while True:
-                left = max(1, int((deadline - _time.monotonic()) * 1000))
-                rc = ring.writev(tag, parts, min(left, 2000))
-                if rc == 0:
-                    return
-                if rc == -3:
-                    raise MPIError(
-                        ErrorCode.ERR_PROC_FAILED,
-                        f"shm ring to process {peer_pidx} reports its "
-                        "consumer dead — peer died mid-transfer",
-                    )
-                if rc == -2:
-                    # frame can NEVER fit this ring: the vectored
-                    # socket loopback carries it, still zero-copy
-                    oob_ep.sendv(peer_pidx + 1, tag, parts)
-                    return
-                if _time.monotonic() >= deadline:
-                    raise MPIError(
-                        ErrorCode.ERR_PENDING,
-                        f"shm ring to process {peer_pidx} stayed full "
-                        f"for {_SEND_TIMEOUT_MS} ms (consumer stalled)",
-                    )
+        tok = None
+        if _watchdog.enabled:
+            # a full-ring wait blocks INSIDE ring.writev (C slices of
+            # <=2s); the zero-arg info resolves at dump time, so the
+            # postmortem names the ring, its consumer, and the LIVE
+            # occupancy at the moment the watchdog fired
+            tok = _watchdog.arm(
+                "nw_ring_put", peer=peer_pidx,
+                info=lambda r=ring, p=peer_pidx: _ring_wait_info(
+                    r, p, "send"))
+        try:
+            with lk:
+                while True:
+                    left = max(1, int((deadline - _time.monotonic())
+                                      * 1000))
+                    rc = ring.writev(tag, parts, min(left, 2000))
+                    if rc == 0:
+                        return
+                    if rc == -3:
+                        raise MPIError(
+                            ErrorCode.ERR_PROC_FAILED,
+                            f"shm ring to process {peer_pidx} reports "
+                            "its consumer dead — peer died "
+                            "mid-transfer",
+                        )
+                    if rc == -2:
+                        # frame can NEVER fit this ring: the vectored
+                        # socket loopback carries it, still zero-copy
+                        oob_ep.sendv(peer_pidx + 1, tag, parts)
+                        return
+                    if _time.monotonic() >= deadline:
+                        raise MPIError(
+                            ErrorCode.ERR_PENDING,
+                            f"shm ring to process {peer_pidx} stayed "
+                            f"full for {_SEND_TIMEOUT_MS} ms "
+                            "(consumer stalled)",
+                        )
+        finally:
+            if tok is not None:
+                _watchdog.disarm(tok)
 
     def frame_stream(self, oob_ep, peer_pidx: int, tag: int, data,
                      tpl=None):
@@ -465,6 +738,7 @@ class NativeWireBtl(DcnBtl):
             return
         rec = _obs.enabled  # capture once: flag may flip mid-send
         t0 = _time.perf_counter() if rec else 0.0
+        _track_ep(oob_ep)  # tcp-leg counters fold from its C struct
         arr, copied = _host_array(data)
         if copied:
             _fallback_copies.add()
@@ -575,6 +849,7 @@ class NativeWireBtl(DcnBtl):
             return DcnBtl.recv_staged(
                 self, oob_ep, tag, src=src, dst_device=dst_device,
                 timeout_ms=left_ms, first=(src, hraw))
+        _track_ep(oob_ep)  # tcp-leg counters fold from its C struct
         nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
         if nbytes < 0 or any(d < 0 for d in shape):
             raise MPIError(ErrorCode.ERR_TRUNCATE,
@@ -608,89 +883,103 @@ class NativeWireBtl(DcnBtl):
             return True
 
         got = 0
-        while got < nchunks:
-            praw = self._pop_stashed(oob_ep, src, tag)
-            if praw is not None:
-                if place(praw):
-                    got += 1
-                    self.staged_chunks_pvar.add()
-                continue
-            left_ms = int((deadline - _time.monotonic()) * 1000)
-            if left_ms <= 0:
-                raise MPIError(
-                    ErrorCode.ERR_PENDING,
-                    f"native staged transfer {xfer} from process "
-                    f"{src_pidx}: timed out with {got}/{nchunks} "
-                    "fragments",
-                )
-            step = min(left_ms, 200)
-            if ring_ent is not None:
-                ring, rlk, rstash = ring_ent
-                restash = None
-                with rlk:
-                    q = rstash.get(tag)
-                    praw = q.pop(0) if q else None
-                    if praw is None:
-                        rc = ring.read_frag(tag, xfer, nchunks, chunk,
-                                            buf, step)
-                        if rc == -5:
-                            restash = self._pop_other_locked(ring)
+        tok = None
+        if ring_ent is not None and _watchdog.enabled:
+            # the empty-ring wait blocks inside ring.read_frag (C
+            # slices of <=200ms): name the ring, its producer, and the
+            # live occupancy in any stall postmortem
+            tok = _watchdog.arm(
+                "nw_ring_recv", peer=src_pidx,
+                info=lambda r=ring_ent[0], p=src_pidx: _ring_wait_info(
+                    r, p, "recv"))
+        try:
+            while got < nchunks:
+                praw = self._pop_stashed(oob_ep, src, tag)
                 if praw is not None:
                     if place(praw):
                         got += 1
                         self.staged_chunks_pvar.add()
                     continue
-                if restash is not None:
-                    rlen, rtag, raw2 = restash
-                    with rlk:
-                        rstash.setdefault(rtag, []).append(raw2)
-                    _fallback_copies.add()  # the one restash copy
-                    continue
-                if rc >= 0:
-                    got += 1
-                    self.staged_chunks_pvar.add()
-                    continue
-                if rc in (-1, -4, -5):
-                    continue  # slice timeout / stale dropped / raced
-                if rc == -3:
+                left_ms = int((deadline - _time.monotonic()) * 1000)
+                if left_ms <= 0:
                     raise MPIError(
-                        ErrorCode.ERR_PROC_FAILED,
-                        f"shm ring from process {src_pidx} reports its "
-                        f"producer dead with {got}/{nchunks} fragments "
-                        "landed — peer died mid-transfer",
+                        ErrorCode.ERR_PENDING,
+                        f"native staged transfer {xfer} from process "
+                        f"{src_pidx}: timed out with {got}/{nchunks} "
+                        "fragments",
                     )
-                raise MPIError(
-                    ErrorCode.ERR_TRUNCATE,
-                    f"staged transfer {xfer}: malformed ring record "
-                    f"(rc {rc})",
-                )
-            else:
-                rc = oob_ep.recv_frag(src, tag, xfer, nchunks, chunk,
-                                      buf, step)
-                if rc >= 0:
-                    got += 1
-                    self.staged_chunks_pvar.add()
-                    continue
-                if rc == -1:
-                    continue  # slice timeout: re-check the deadline
-                if rc == -4:
-                    # the queue head for (src, tag) is not ours: pop it
-                    # through the shared stash machinery and apply the
-                    # portable stale-drop filter
-                    try:
-                        _, raw2 = stashed_recv(
-                            oob_ep, src, tag, _time.monotonic() + 0.05)
-                    except MPIError:
+                step = min(left_ms, 200)
+                if ring_ent is not None:
+                    ring, rlk, rstash = ring_ent
+                    restash = None
+                    with rlk:
+                        q = rstash.get(tag)
+                        praw = q.pop(0) if q else None
+                        if praw is None:
+                            rc = ring.read_frag(tag, xfer, nchunks,
+                                                chunk, buf, step)
+                            if rc == -5:
+                                restash = self._pop_other_locked(ring)
+                    if praw is not None:
+                        if place(praw):
+                            got += 1
+                            self.staged_chunks_pvar.add()
                         continue
-                    if place(raw2):
+                    if restash is not None:
+                        rlen, rtag, raw2 = restash
+                        with rlk:
+                            rstash.setdefault(rtag, []).append(raw2)
+                        _fallback_copies.add()  # the one restash copy
+                        continue
+                    if rc >= 0:
                         got += 1
                         self.staged_chunks_pvar.add()
-                    continue
-                raise MPIError(
-                    ErrorCode.ERR_TRUNCATE,
-                    f"staged transfer {xfer}: fragment overruns the "
-                    f"{nbytes}-byte buffer (native rc {rc})",
-                )
+                        continue
+                    if rc in (-1, -4, -5):
+                        continue  # slice timeout / stale / raced
+                    if rc == -3:
+                        raise MPIError(
+                            ErrorCode.ERR_PROC_FAILED,
+                            f"shm ring from process {src_pidx} reports "
+                            f"its producer dead with {got}/{nchunks} "
+                            "fragments landed — peer died mid-transfer",
+                        )
+                    raise MPIError(
+                        ErrorCode.ERR_TRUNCATE,
+                        f"staged transfer {xfer}: malformed ring "
+                        f"record (rc {rc})",
+                    )
+                else:
+                    rc = oob_ep.recv_frag(src, tag, xfer, nchunks,
+                                          chunk, buf, step)
+                    if rc >= 0:
+                        got += 1
+                        self.staged_chunks_pvar.add()
+                        continue
+                    if rc == -1:
+                        continue  # slice timeout: re-check deadline
+                    if rc == -4:
+                        # the queue head for (src, tag) is not ours:
+                        # pop it through the shared stash machinery and
+                        # apply the portable stale-drop filter
+                        try:
+                            _, raw2 = stashed_recv(
+                                oob_ep, src, tag,
+                                _time.monotonic() + 0.05)
+                        except MPIError:
+                            continue
+                        if place(raw2):
+                            got += 1
+                            self.staged_chunks_pvar.add()
+                        continue
+                    raise MPIError(
+                        ErrorCode.ERR_TRUNCATE,
+                        f"staged transfer {xfer}: fragment overruns "
+                        f"the {nbytes}-byte buffer (native rc {rc})",
+                    )
+        finally:
+            if tok is not None:
+                _watchdog.disarm(tok)
         if zlib.crc32(bmv) != int(crc):
             raise MPIError(
                 ErrorCode.ERR_TRUNCATE,
@@ -747,3 +1036,31 @@ class NativeWireComponent(mca_component.Component):
 
 
 base.BTL_FRAMEWORK.register(NativeWireComponent())
+
+
+def _native_rings() -> dict:
+    """Watchdog-postmortem contributor: every live native ring's
+    identity, endpoints, occupancy, and counter block — whatever rank
+    is stalled, the postmortem shows which ring sat full/empty and
+    which pid was supposed to drain/fill it."""
+    with _tele_lock:
+        tx = list(_live_tx)
+        rx = list(_live_rx)
+        retired = (_retired[0], _retired[1])
+
+    def row(ring) -> dict:
+        try:
+            return {"name": ring.name, "capacity": int(ring.capacity),
+                    "pending": int(ring.pending()),
+                    "producer_pid": int(ring.producer_pid()),
+                    "consumer_pid": int(ring.consumer_pid()),
+                    "stats": ring.stats()}
+        except Exception:
+            return {"name": getattr(ring, "name", "?")}
+
+    return {"tx": [row(r) for r in tx], "rx": [row(r) for r in rx],
+            "retired_stalls": retired[0],
+            "retired_stall_ns": retired[1]}
+
+
+_watchdog.add_contributor("native_rings", _native_rings)

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import struct
 import subprocess
 import threading
 from typing import List, Optional, Tuple, Union
@@ -27,20 +28,59 @@ _SO_PATH = os.path.join(_NATIVE_DIR, "build", "libompitpu_native.so")
 _lib = None
 _lib_lock = threading.Lock()
 
+#: stamp inputs — must match the Makefile's STAMP_SRCS list (same
+#: files; order is irrelevant, the comparison is by name)
+_STAMP_INPUTS = ("dss.cc", "oob.cc", "btl_tcp.cc", "btl_shm.cc",
+                 "nativeev.cc", "oob_endpoint.h", "nativeev.h",
+                 "Makefile")
+_STAMP_PATH = os.path.join(_NATIVE_DIR, "build", ".srcstamp")
+
+
+def _stamp_current() -> bool:
+    """True when build/.srcstamp matches the sha256 of every stamp
+    input — i.e. the .so was linked from exactly these sources and
+    `make` would be a no-op. Content hashes, not mtimes: fresh git
+    checkouts and build caches produce equal/reordered mtimes where a
+    newer-than check lies in both directions. A missing or short
+    stamp (pre-stamp build tree) just means 'run make once'."""
+    import hashlib
+
+    try:
+        with open(_STAMP_PATH) as f:
+            stamped = {}
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    stamped[parts[-1]] = parts[0]
+    except OSError:
+        return False
+    for name in _STAMP_INPUTS:
+        path = os.path.join(_NATIVE_DIR, name)
+        if not os.path.exists(path):
+            continue  # optional source absent on both sides is fine
+        try:
+            with open(path, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            return False
+        if stamped.get(name) != digest:
+            return False
+    return True
+
 
 def load_library() -> ctypes.CDLL:
-    """Load (building if needed) the native library."""
+    """Load (building if needed) the native library.
+
+    An up-to-date .so skips the compiler entirely: the Makefile stamps
+    each successful link with the sha256 of its inputs, and this check
+    re-hashes them in-process — a few hashlib calls per interpreter
+    instead of a `make -s all` subprocess whose no-op still costs a
+    fork+exec+stat storm (tier-1 job tests pay it once per worker)."""
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        srcs = [os.path.join(_NATIVE_DIR, f)
-                for f in ("dss.cc", "oob.cc", "oob_endpoint.h",
-                          "btl_tcp.cc", "btl_shm.cc")
-                if os.path.exists(os.path.join(_NATIVE_DIR, f))]
-        if (not os.path.exists(_SO_PATH)
-                or any(os.path.getmtime(s) > os.path.getmtime(_SO_PATH)
-                       for s in srcs)):
+        if not os.path.exists(_SO_PATH) or not _stamp_current():
             _log.verbose(1, "building native control-plane library")
             r = subprocess.run(
                 ["make", "-s", "all"], cwd=_NATIVE_DIR,
@@ -129,6 +169,9 @@ def _declare(lib: ctypes.CDLL) -> None:
             ctypes.c_int,
         ]
         lib.wire_recv_frag.restype = ctypes.c_int64
+    if hasattr(lib, "wire_stats"):
+        lib.wire_stats.argtypes = [P, ctypes.c_int32]
+        lib.wire_stats.restype = ctypes.c_int64
     if hasattr(lib, "shmring_create"):
         lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                        ctypes.c_int64]
@@ -153,6 +196,25 @@ def _declare(lib: ctypes.CDLL) -> None:
         lib.shmring_read_into.argtypes = [P, i32p, P, ctypes.c_int64,
                                           ctypes.c_int]
         lib.shmring_read_into.restype = ctypes.c_int64
+    if hasattr(lib, "shmring_stat"):
+        lib.shmring_stat.argtypes = [P, ctypes.c_int32]
+        lib.shmring_stat.restype = ctypes.c_int64
+    if hasattr(lib, "nativeev_create"):
+        lib.nativeev_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.nativeev_create.restype = P
+        lib.nativeev_attach.argtypes = [ctypes.c_char_p]
+        lib.nativeev_attach.restype = P
+        lib.nativeev_unlink.argtypes = [ctypes.c_char_p]
+        lib.nativeev_unlink.restype = ctypes.c_int
+        lib.nativeev_close.argtypes = [P]
+        lib.nativeev_install.argtypes = [P]
+        lib.nativeev_nslots.argtypes = [P]
+        lib.nativeev_nslots.restype = ctypes.c_int64
+        lib.nativeev_count.argtypes = [P]
+        lib.nativeev_count.restype = ctypes.c_int64
+        lib.nativeev_read.argtypes = [P, ctypes.c_int64, P,
+                                      ctypes.c_int64, i64p]
+        lib.nativeev_read.restype = ctypes.c_int64
 
 
 def wire_symbols_available() -> bool:
@@ -166,6 +228,20 @@ def wire_symbols_available() -> bool:
     except Exception:
         return False
     return hasattr(lib, "wire_sendv") and hasattr(lib, "shmring_create")
+
+
+def telemetry_symbols_available() -> bool:
+    """True when the loaded .so carries the native telemetry ABI
+    (shmring_stat / wire_stats / nativeev_*). Same never-raises
+    discipline as :func:`wire_symbols_available`: a stale .so built
+    before the telemetry block means 'capability absent', and the
+    observability layers simply stay dark for the native plane."""
+    try:
+        lib = load_library()
+    except Exception:
+        return False
+    return (hasattr(lib, "shmring_stat") and hasattr(lib, "wire_stats")
+            and hasattr(lib, "nativeev_create"))
 
 
 def _u8(data: bytes):
@@ -461,6 +537,19 @@ class OobEndpoint:
         """Frames dropped by the routing-cycle ttl guard."""
         return self._lib.oob_ttl_dropped(self._handle())
 
+    #: wire_stats index names, in C-side order (native/btl_tcp.cc)
+    WIRE_STATS = ("tx_frames", "tx_bytes", "rx_frames", "rx_bytes",
+                  "rx_stalls", "rx_stall_ns")
+
+    def wire_stats(self) -> dict:
+        """The endpoint's native-wire telemetry block as a dict; all
+        zeros when the loaded .so predates the telemetry ABI."""
+        if not hasattr(self._lib, "wire_stats"):
+            return {k: 0 for k in self.WIRE_STATS}
+        h = self._handle()
+        return {k: int(self._lib.wire_stats(h, i))
+                for i, k in enumerate(self.WIRE_STATS)}
+
     def pending(self) -> int:
         return self._lib.oob_pending(self._handle())
 
@@ -561,9 +650,121 @@ class ShmRing:
         del keep
         return int(rc), tag.value
 
+    #: shmring_stat index names, in C-side order (native/btl_shm.cc)
+    STATS = ("w_frames", "w_bytes", "w_stalls", "w_stall_ns", "hwm",
+             "r_frames", "r_bytes", "r_stalls", "r_stall_ns")
+
+    def stats(self) -> dict:
+        """The ring header's telemetry block as a dict; all zeros when
+        the loaded .so predates the telemetry ABI (pre-v2 rings can't
+        exist then either — the magic changed with the layout)."""
+        if not hasattr(self._lib, "shmring_stat"):
+            return {k: 0 for k in self.STATS}
+        h = self._handle()
+        return {k: int(self._lib.shmring_stat(h, i))
+                for i, k in enumerate(self.STATS)}
+
     def close(self) -> None:
         if self._h:
             self._lib.shmring_close(self._h)
+            self._h = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeEventRing:
+    """mmap'd fixed-record native event ring ("ompitpu-nativeev-v1").
+
+    One per process, created by the nativewire component when the
+    ``wire_native_events`` cvar is on; the C transports append one
+    32-byte record per SGC2 fragment once :meth:`install` makes this
+    ring the process sink. Drop-oldest wrap: :meth:`read` returns the
+    newest ``nslots`` records at most, with the first live sequence so
+    consumers can report the gap."""
+
+    #: one record: t_ns u64, xfer u64, tag i32, bytes u32,
+    #: idx_dir u32 (bit 31 = receive side), wait_ns u32
+    RECORD = struct.Struct("<QQiIII")
+
+    def __init__(self, handle, name: str) -> None:
+        self._lib = load_library()
+        self._h = handle
+        self.name = name
+
+    @classmethod
+    def create(cls, name: str,
+               nslots: int) -> Optional["NativeEventRing"]:
+        lib = load_library()
+        if not hasattr(lib, "nativeev_create"):
+            return None
+        h = lib.nativeev_create(name.encode(), nslots)
+        return cls(h, name) if h else None
+
+    @classmethod
+    def attach(cls, name: str) -> Optional["NativeEventRing"]:
+        lib = load_library()
+        if not hasattr(lib, "nativeev_attach"):
+            return None
+        h = lib.nativeev_attach(name.encode())
+        return cls(h, name) if h else None
+
+    @staticmethod
+    def unlink(name: str) -> None:
+        try:
+            load_library().nativeev_unlink(name.encode())
+        except Exception:
+            pass  # best-effort cleanup
+
+    def _handle(self):
+        h = self._h
+        if not h:
+            raise MPIError(ErrorCode.ERR_OTHER, "event ring is closed")
+        return h
+
+    def install(self) -> None:
+        """Make this ring the process-global emit sink."""
+        self._lib.nativeev_install(self._handle())
+
+    def uninstall(self) -> None:
+        self._lib.nativeev_install(None)
+
+    @property
+    def nslots(self) -> int:
+        return int(self._lib.nativeev_nslots(self._handle()))
+
+    def count(self) -> int:
+        """Records ever appended (monotonic across wraps)."""
+        return int(self._lib.nativeev_count(self._handle()))
+
+    def read(self, start: int = 0,
+             max_records: int = 1 << 16) -> Tuple[int, list]:
+        """(first_seq, records) with records decoded to dicts
+        ``{t_ns, xfer, tag, bytes, idx, recv, wait_ns}``; first_seq is
+        the sequence of records[0] (> start when the ring lapped)."""
+        n = min(max_records, self.nslots)
+        buf = ctypes.create_string_buffer(n * self.RECORD.size)
+        first = ctypes.c_int64(0)
+        got = int(self._lib.nativeev_read(
+            self._handle(), start,
+            ctypes.cast(buf, ctypes.c_void_p), n, ctypes.byref(first)))
+        recs = []
+        for i in range(got):
+            t_ns, xfer, tag, nbytes, idx_dir, wait_ns = \
+                self.RECORD.unpack_from(buf, i * self.RECORD.size)
+            recs.append({
+                "t_ns": t_ns, "xfer": xfer, "tag": tag,
+                "bytes": nbytes, "idx": idx_dir & 0x7FFFFFFF,
+                "recv": bool(idx_dir >> 31), "wait_ns": wait_ns,
+            })
+        return int(first.value), recs
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.nativeev_close(self._h)
             self._h = None
 
     def __del__(self) -> None:

@@ -427,6 +427,91 @@ def selftest() -> int:
           f"{rcs['epoch_plans']} plans / {rcs['programs']} programs / "
           f"{rcs['fires']} fires")
 
+    # 14. native wire telemetry (device-free): the always-on counters
+    # block in the shm ring header observes a writev/read_frag
+    # round-trip (frames, bytes, occupancy high-water, a timed-out
+    # empty read as one stall), and the optional event ring records one
+    # 32-byte record per side whose expansion pairs flow ids across
+    # send and recv — the doctor's cross-process arrows, demonstrated
+    # inside one process. Symbols absent = the leg reduces to the
+    # pvar-presence checks (portable fallback, not a failure).
+    from ..native import telemetry_symbols_available as _tele_ok
+    from . import nativeev as _nativeev
+
+    for nm in ("wire_native_ring_stalls", "wire_native_stall_seconds",
+               "wire_native_ring_hwm_frac"):
+        assert pvar.PVARS.lookup(nm) is not None, nm
+    if _nw.nativewire_ready() and _tele_ok():
+        from ..native import NativeEventRing as _EvRing
+        from ..native import ShmRing as _Ring2
+
+        evname = f"/onwev-selftest-{os.getpid():x}"
+        _EvRing.unlink(evname)
+        ev = _EvRing.create(evname, 256)
+        assert ev is not None, "selftest event ring create failed"
+        _EvRing.unlink(evname)
+        ev.install()
+        try:
+            tpl4 = _btlc.plan_frame_template((64,), "int32", 1 << 10)
+            arr4 = _np.arange(64, dtype=_np.int32)
+            mv4 = memoryview(arr4.view(_np.uint8))
+            frames4 = list(tpl4.sg_lists(mv4, 21, _zlib.crc32(mv4)))
+            name = f"/onwt-selftest-{os.getpid():x}"
+            _Ring2.unlink(name)
+            prod = _Ring2.create(name, 1 << 16, os.getpid())
+            cons = _Ring2.attach(name, os.getpid())
+            _Ring2.unlink(name)
+            assert prod is not None and cons is not None
+            s0 = prod.stats()
+            assert prod.writev(501, frames4[1], 1000) == 0
+            out4 = bytearray(tpl4.nbytes)
+            rc = cons.read_frag(501, 21, tpl4.nchunks, tpl4.chunk,
+                                out4, 1000)
+            assert rc >= 0, f"telemetry leg read_frag rc {rc}"
+            s1 = cons.stats()
+            assert s1["w_frames"] == s0["w_frames"] + 1, (s0, s1)
+            assert s1["w_bytes"] > s0["w_bytes"], (s0, s1)
+            assert s1["r_frames"] == s0["r_frames"] + 1, (s0, s1)
+            assert s1["r_bytes"] == s1["w_bytes"], s1
+            assert s1["hwm"] > 0, s1
+            # a timed-out empty read is ONE stall with measured time
+            rc = cons.read_frag(501, 21, tpl4.nchunks, tpl4.chunk,
+                                out4, 30)
+            assert rc == -1, rc
+            s2 = cons.stats()
+            assert s2["r_stalls"] == s1["r_stalls"] + 1, (s1, s2)
+            assert s2["r_stall_ns"] > s1["r_stall_ns"], (s1, s2)
+            # the event ring saw both sides of the fragment
+            assert ev.count() >= 2, ev.count()
+            doc = _nativeev.snapshot(ev)
+            assert doc["format"] == _nativeev.FORMAT
+            recs = doc["records"]
+            assert any(r["recv"] for r in recs), recs
+            assert any(not r["recv"] for r in recs), recs
+            r0 = recs[0]
+            assert r0["tag"] == 501 and r0["xfer"] == 21, r0
+            assert r0["bytes"] == len(frames4[1][-1]), r0
+            spans4 = _nativeev.expand_dump(doc)
+            assert all(s["layer"] == "wire" for s in spans4), spans4
+            sflow = {s["flow"] for s in spans4 if s["fs"] == "s"}
+            tflow = {s["flow"] for s in spans4 if s["fs"] == "t"}
+            assert sflow and sflow == tflow, (sflow, tflow)
+            assert sflow == {_nativeev.frag_flow_id(501, 21, 0)}
+            prod.close()
+            cons.close()
+            print(f"native telemetry: counters observed "
+                  f"{s1['w_frames'] - s0['w_frames']} frame / "
+                  f"{s1['w_bytes'] - s0['w_bytes']}B, stall "
+                  f"{(s2['r_stall_ns'] - s1['r_stall_ns']) / 1e6:.1f} "
+                  f"ms; {len(recs)} event records expand to paired "
+                  f"wire spans ({next(iter(sflow)):#x})")
+        finally:
+            ev.uninstall()
+            ev.close()
+    else:
+        print("native telemetry: symbols absent — counters fold to "
+              "zero, event ring stays off")
+
     disable()
     print("obs selftest: ok")
     return 0

@@ -89,11 +89,12 @@ def load_dir(directory: str) -> List[Dict[str, Any]]:
         })
     dumps.extend(d for _, (_, d) in sorted(newest.items()))
     attach_ledgers(dumps, directory)
+    attach_native_events(dumps, directory)
     dumps.sort(key=lambda d: int(d["meta"].get("pidx", 0)))
     if not dumps:
         raise FileNotFoundError(
-            f"no journal-p*.json, postmortem-*.json, or "
-            f"ledger-p*.json dumps under {directory} (set --mca "
+            f"no journal-p*.json, postmortem-*.json, ledger-p*.json, "
+            f"or nativeev-p*.json dumps under {directory} (set --mca "
             "obs_dump_dir, or send SIGUSR1 to the ranks first)")
     return dumps
 
@@ -127,6 +128,56 @@ def attach_ledgers(dumps: List[Dict[str, Any]],
         except (ValueError, OSError):
             continue
         spans = _ledger.expand_dump(doc)
+        if not spans:
+            continue
+        meta = doc.get("meta") or {}
+        pidx = int(meta.get("pidx", 0))
+        host = by_pidx.get(pidx)
+        if host is None:
+            host = by_pidx[pidx] = {
+                "meta": {"pidx": pidx,
+                         "rank_offset": meta.get("rank_offset", 0),
+                         "local_size": meta.get("local_size", 0),
+                         "pid": meta.get("pid"),
+                         "clock_offset_s": doc.get("clock_offset_s"),
+                         "clock_rtt_s": None},
+                "spans": []}
+            dumps.append(host)
+        host["spans"] = sorted(
+            list(host["spans"]) + spans,
+            key=lambda s: float(s.get("t", 0.0)))
+        host.pop("_corrected_spans", None)
+
+
+def load_nativeev_dump(path: str) -> Dict[str, Any]:
+    from . import nativeev as _nativeev
+
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("format") != _nativeev.FORMAT:
+        raise ValueError(f"{path}: not a native event-ring dump "
+                         f"(format != {_nativeev.FORMAT})")
+    return doc
+
+
+def attach_native_events(dumps: List[Dict[str, Any]],
+                         directory: str) -> None:
+    """Expand every ``nativeev-p*.json`` under ``directory`` into
+    wire-layer spans and merge them into the matching rank's dump —
+    the :func:`attach_ledgers` discipline for the zero-copy datapath.
+    Send/recv records carry flow ids re-derived from the SGC2 (tag,
+    xfer, idx) triple, so :func:`flow_pairs` and :func:`merge` draw
+    cross-process arrows for fragments Python never touched."""
+    from . import nativeev as _nativeev
+
+    by_pidx = {int(d["meta"].get("pidx", 0)): d for d in dumps}
+    for p in sorted(glob.glob(os.path.join(directory,
+                                           "nativeev-p*.json"))):
+        try:
+            doc = load_nativeev_dump(p)
+        except (ValueError, OSError):
+            continue
+        spans = _nativeev.expand_dump(doc)
         if not spans:
             continue
         meta = doc.get("meta") or {}

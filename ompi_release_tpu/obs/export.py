@@ -227,6 +227,27 @@ def maybe_dump_ledger(runtime=None) -> Optional[str]:
     return _ledger.dump(os.path.join(d, f"ledger-p{pidx}.json"))
 
 
+def maybe_dump_nativeev(runtime=None) -> Optional[str]:
+    """Finalize hook: when ``obs_dump_dir`` is set and the native
+    event ring is installed (``btl_nativewire_events``), write its
+    decoded records there as ``nativeev-p<pidx>.json`` — tpu-doctor
+    expands them into wire-layer spans whose flow ids pair across
+    processes. No ring (the default) writes nothing."""
+    import os
+
+    from ..mca import var as _var
+    from . import nativeev as _nativeev
+
+    d = str(_var.get("obs_dump_dir", "") or "")
+    if not d or _nativeev.get_ring() is None:
+        return None
+    os.makedirs(d, exist_ok=True)
+    pidx = 0
+    if runtime is not None and runtime.bootstrap:
+        pidx = int(runtime.bootstrap.get("process_index", 0))
+    return _nativeev.dump(os.path.join(d, f"nativeev-p{pidx}.json"))
+
+
 # ---------------------------------------------------------------------------
 # Prometheus text exposition
 # ---------------------------------------------------------------------------

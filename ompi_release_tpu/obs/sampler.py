@@ -30,6 +30,15 @@ When the process runs under tpurun, each tick also **pushes** the new
 points to the HNP over the coordinator's TAG_SERIES channel (gated by
 ``obs_sample_push``), giving the job one fleet-wide store that
 ``tpu_top --fleet`` renders live and ``tpu-doctor`` merges offline.
+
+The pvar scan is registry-driven, so counters that live OUTSIDE
+Python fold in with no sampler change: ``btl/nativewire.py`` exposes
+the C-side ring/endpoint telemetry blocks (``wire_native_bytes``
+deltas split native-vs-staged throughput in ``tpu_top``;
+``wire_native_ring_stalls`` / ``wire_native_stall_seconds`` /
+``wire_native_ring_hwm_frac`` are the backpressure series) as getter
+pvars that read shared memory on each tick — the native byte path
+itself never executes a Python emit site.
 """
 
 from __future__ import annotations

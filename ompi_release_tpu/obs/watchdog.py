@@ -385,6 +385,20 @@ def dump_postmortem(reason: str,
                     os.path.dirname(path), f"ledger-p{pidx}.json"))
         except Exception:
             pass  # best-effort, like every other dump section
+        try:
+            # likewise the native event ring (zero-copy datapath
+            # fragments): tpu-doctor expands nativeev-p*.json into
+            # wire-layer spans with paired flow ids — the stalled
+            # rank's byte-path story, even though Python never saw
+            # the bytes
+            from . import nativeev as _nativeev
+
+            if _nativeev.get_ring() is not None:
+                pidx = ident.get("pidx", 0)
+                _nativeev.dump(os.path.join(
+                    os.path.dirname(path), f"nativeev-p{pidx}.json"))
+        except Exception:
+            pass  # best-effort, like every other dump section
         if counts_against_cap:
             # budget counts dumps that REACHED disk: a failed write
             # (raised above) must not spend it, or a transient full

@@ -356,6 +356,7 @@ class Runtime:
                     _obs_export.maybe_dump_rank_journal(self)
                     _obs_export.maybe_dump_series(self)
                     _obs_export.maybe_dump_ledger(self)
+                    _obs_export.maybe_dump_nativeev(self)
                 except Exception as e:
                     _log.verbose(1, f"obs rank-journal dump failed: {e}")
             # stop the async progress engine BEFORE communicators are

@@ -96,9 +96,21 @@ METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_", "compiled_",
 #: byte-path materializations per MiB shipped — lower-better, with
 #: 0.0 the zero-copy acceptance target; a grown count means an array
 #: started taking the staged/fallback copy path again.
+#: The native telemetry lines follow suit: ``wire_native_stall_*``
+#: (full/empty-ring stall counts and cumulative blocked seconds from
+#: the C-side counter blocks) and ``wire_native_ring_hwm_frac`` (the
+#: worst ring occupancy high-water fraction) are lower-better — a
+#: growth means the consumer fell behind or rings shrank into
+#: backpressure. ``native_obs_overhead_*`` is the counters-always-on
+#: acceptance ratio (telemetry-on p2p wall over telemetry-free
+#: baseline, budget 1.05): lower-better, a grown ratio means the
+#: always-on counter block started costing wall time.
 METRIC_LOWER_BETTER_PREFIXES = ("ft_", "ledger_", "sentinel_", "sim_",
                                 "steady_", "tenant_",
-                                "wire_native_copies")
+                                "wire_native_copies",
+                                "wire_native_stall",
+                                "wire_native_ring_hwm_frac",
+                                "native_obs_overhead")
 
 DEFAULT_SIGMA = 4.0
 #: relative noise floor: the bench's own ceiling docs put single-run

@@ -70,7 +70,17 @@ def summarize_points(points: List[Dict[str, Any]],
     nor flight-recorder ``ledger_records`` — comes back ``dark``:
     obs is on (the sampler only runs under obs) yet the hot path is
     invisible, exactly the de-optimization regression this plane
-    exists to catch. ``now`` defaults to the newest point's time
+    exists to catch.
+
+    The native wire gets the same treatment one layer down: staged
+    throughput splits into its native share (``wire_native_bytes``
+    deltas over the ``btl_dcn_staged_bytes`` total), and a rank moving
+    native frames while NONE of the C-side telemetry series
+    (``wire_native_ring_stalls`` / ``wire_native_stall_seconds`` /
+    ``wire_native_ring_hwm_frac``, folded from the ring-header counter
+    blocks) ever produced a point comes back ``dark_native`` — the
+    signature of a stale ``libompitpu_native.so`` predating the
+    telemetry block. ``now`` defaults to the newest point's time
     (dump replay); pass the live clock for live feeds."""
     from ..obs.sampler import percentile
 
@@ -79,7 +89,9 @@ def summarize_points(points: List[Dict[str, Any]],
                 "p99_ms": None, "skew_ms": None, "stalls": 0,
                 "desyncs": 0, "cids": [], "age_s": None,
                 "window_s": 0.0, "compiled_frac": None,
-                "ledger_records": 0, "dark": False}
+                "ledger_records": 0, "dark": False,
+                "native_mb_s": None, "staged_mb_s": None,
+                "native_frac": None, "dark_native": False}
     ts = [float(p["t"]) for p in points]
     t_new = max(ts)
     if now is None:
@@ -90,6 +102,8 @@ def summarize_points(points: List[Dict[str, Any]],
     skew_sum = skew_count = 0.0
     stalls = desyncs = 0.0
     plan_hits = plan_fires = ledger_recs = 0.0
+    native_bytes = native_frames = wire_bytes = 0.0
+    native_tele = 0
     cids = set()
     t_used = []
     for p in points:
@@ -121,6 +135,16 @@ def summarize_points(points: List[Dict[str, Any]],
             plan_fires += float(v.get("count", 0.0) or 0.0)
         elif name == "ledger_records":
             ledger_recs += float(v or 0)
+        elif name == "wire_native_bytes":
+            native_bytes += float(v or 0)
+        elif name == "wire_native_frames":
+            native_frames += float(v or 0)
+        elif name == "btl_dcn_staged_bytes":
+            wire_bytes += float(v or 0)
+        elif name in ("wire_native_ring_stalls",
+                      "wire_native_stall_seconds",
+                      "wire_native_ring_hwm_frac"):
+            native_tele += 1
     # a window holding a single sampler tick has NO measurable span —
     # rates are unknown then, not "whatever 1 ms would imply" (a lone
     # 10-op tick must render '-', never 10000 coll/s)
@@ -145,6 +169,12 @@ def summarize_points(points: List[Dict[str, Any]],
         "ledger_records": int(ledger_recs),
         "dark": bool(plan_hits > 0 and ops == 0
                      and ledger_recs == 0),
+        "native_mb_s": native_bytes / window / 1e6 if window else None,
+        "staged_mb_s": (max(0.0, wire_bytes - native_bytes)
+                        / window / 1e6 if window else None),
+        "native_frac": (min(1.0, native_bytes / wire_bytes)
+                        if wire_bytes else None),
+        "dark_native": bool(native_frames > 0 and native_tele == 0),
     }
 
 
@@ -159,6 +189,7 @@ def render_fleet(docs: List[Dict[str, Any]], window_s: float = 15.0,
     live fleet query share this shape via
     ``obs.doctor.fleet_to_series_docs``)."""
     head = (f"  {'proc':>4} {'ranks':>9} {'coll/s':>8} {'MB/s':>9} "
+            f"{'nwMB/s':>8} {'nat%':>5} "
             f"{'p50 ms':>8} {'p99 ms':>8} {'skew ms':>8} "
             f"{'comp%':>6} {'cids':>6} flags")
     lines = [head]
@@ -179,6 +210,11 @@ def render_fleet(docs: List[Dict[str, Any]], window_s: float = 15.0,
             # compiled fires in the window but zero spans AND zero
             # flight-recorder records: the hot path went invisible
             flags.append("DARK")
+        if s["dark_native"]:
+            # native frames moved but the C-side counter-block series
+            # never produced a point: the zero-copy byte path went
+            # invisible (stale .so predating the telemetry block)
+            flags.append("DARK-NATIVE")
         age = m.get("push_age_s")
         if age is None:
             age = s["age_s"]
@@ -189,6 +225,8 @@ def render_fleet(docs: List[Dict[str, Any]], window_s: float = 15.0,
             f"  {pidx:>4} {ranks:>9} "
             f"{_fmt(s['ops_s'], '8.1f'):>8} "
             f"{_fmt(s['mb_s'], '9.2f'):>9} "
+            f"{_fmt(s['native_mb_s'], '8.2f'):>8} "
+            f"{_fmt(s['native_frac'] * 100 if s['native_frac'] is not None else None, '5.1f'):>5} "
             f"{_fmt(s['p50_ms'], '8.3f'):>8} "
             f"{_fmt(s['p99_ms'], '8.3f'):>8} "
             f"{_fmt(s['skew_ms'], '8.3f'):>8} "

@@ -491,6 +491,52 @@ class TestTpuTop:
         lit = pts + [_pt(2, 2.0, -1, "ledger_records", 5.0)]
         assert summarize_points(lit)["dark"] is False
 
+    def test_native_vs_staged_byte_split(self):
+        """nwMB/s and nat% fold from the wire_native_bytes deltas
+        against btl_dcn_staged_bytes (the whole staged-path volume,
+        native included): staged_mb_s is the remainder that rode the
+        portable copy path."""
+        from ompi_release_tpu.tools.tpu_top import (render_fleet,
+                                                    summarize_points)
+
+        pts = [_pt(0, 1.0, -1, "wire_native_bytes", 3e6),
+               _pt(1, 2.0, -1, "wire_native_bytes", 3e6),
+               _pt(2, 2.0, -1, "btl_dcn_staged_bytes", 8e6),
+               _pt(3, 2.0, -1, "wire_native_frames", 4.0),
+               _pt(4, 2.0, -1, "wire_native_ring_stalls", 0.0),
+               _pt(5, 2.0, -1, "wire_native_ring_hwm_frac", 0.25)]
+        s = summarize_points(pts)
+        assert s["native_mb_s"] == pytest.approx(6.0)  # 6e6 B / 1 s
+        assert s["staged_mb_s"] == pytest.approx(2.0)
+        assert s["native_frac"] == pytest.approx(0.75)
+        assert s["dark_native"] is False
+        table = render_fleet([{"meta": {"pidx": 0}, "points": pts}])
+        assert "nwMB/s" in table and "nat%" in table
+        assert "DARK-NATIVE" not in table
+        # no wire traffic at all: the split renders as absent
+        s2 = summarize_points([_pt(0, 1.0, 0, "coll_ops", 1.0)])
+        assert s2["native_frac"] is None
+        assert s2["dark_native"] is False
+
+    def test_dark_native_rank_flagged(self):
+        """Native frames moved but NONE of the three C-counter series
+        (stalls / stall seconds / hwm) produced a window point: the
+        stale-.so signature — fragments ride a library without the
+        telemetry block. DARK-NATIVE, like DARK, is a heuristic flag
+        on the fleet row."""
+        from ompi_release_tpu.tools.tpu_top import (render_fleet,
+                                                    summarize_points)
+
+        pts = [_pt(0, 1.0, -1, "wire_native_frames", 2.0),
+               _pt(1, 2.0, -1, "wire_native_bytes", 4e6)]
+        s = summarize_points(pts)
+        assert s["dark_native"] is True
+        table = render_fleet([{"meta": {"pidx": 1}, "points": pts}])
+        assert "DARK-NATIVE" in table
+        # any one native telemetry point in the window clears it
+        lit = pts + [_pt(2, 2.0, -1, "wire_native_ring_stalls", 1.0)]
+        assert summarize_points(lit)["dark_native"] is False
+
     def test_server_series_rpc(self, obs_sampling):
         from ompi_release_tpu.tools.tpu_server import (NameClient,
                                                        NameServer)
@@ -866,6 +912,56 @@ class TestBenchGate:
             tmp_path / "ok.json",
             [ln("wire_native_p2p_256MiB", 2.1, "GB/s"),
              ln("wire_native_copies_per_mib", 0.0, "copies/MiB")])
+        assert gate.main(hist + ["--candidate", str(ok)]) == 0
+
+    def test_native_obs_metric_directions(self, tmp_path):
+        """The native_obs suite's lines: the C counter-block series
+        (stall count / cumulative stall seconds / ring occupancy HWM)
+        are LOWER-better — growth is backpressure, not throughput —
+        and native_obs_overhead_ratio (event-ring-on p2p wall over the
+        counters-only baseline, acceptance budget 1.05) is lower-better
+        via its metric prefix: its unit is 'ratio', NOT an 'x_*' unit,
+        which would flip it higher-better in the unit table."""
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        assert gate._direction(
+            "stalls", "wire_native_stall_count") == -1
+        assert gate._direction(
+            "s", "wire_native_stall_seconds") == -1
+        assert gate._direction(
+            "frac", "wire_native_ring_hwm_frac") == -1
+        assert gate._direction(
+            "ratio", "native_obs_overhead_ratio") == -1
+        assert gate._direction("s", "native_obs_counters_wall_s") == -1
+        # the x_* unit family stays higher-better (speedups): the
+        # overhead ratio must never be filed under it
+        assert gate._direction("x_vs_staged", "anything") == 1
+
+        def ln(metric, v, unit):
+            return {"metric": metric, "value": v, "unit": unit,
+                    "vs_baseline": None, "tier_label": "loopback-cpu"}
+
+        hist = [_round_file(
+            tmp_path / f"BENCH_r{k:02d}.json",
+            [ln("native_obs_overhead_ratio", 1.01 + 0.002 * k,
+                "ratio"),
+             ln("wire_native_stall_seconds", 0.02, "s")])
+            for k in range(4)]
+        # observability cost ballooning or stalls growing both trip
+        bad = _round_file(
+            tmp_path / "cand.json",
+            [ln("native_obs_overhead_ratio", 1.8, "ratio"),
+             ln("wire_native_stall_seconds", 4.0, "s")])
+        verdict = gate.evaluate(
+            [gate.parse_round_file(p) for p in hist],
+            gate.parse_round_file(bad))
+        regressed = {r["metric"] for r in verdict["regressions"]}
+        assert regressed == {"native_obs_overhead_ratio",
+                             "wire_native_stall_seconds"}
+        ok = _round_file(
+            tmp_path / "ok.json",
+            [ln("native_obs_overhead_ratio", 1.012, "ratio"),
+             ln("wire_native_stall_seconds", 0.019, "s")])
         assert gate.main(hist + ["--candidate", str(ok)]) == 0
 
     def test_topo_metric_directions(self, tmp_path):

@@ -444,11 +444,12 @@ APP_PRELUDE = textwrap.dedent("""
 """ % REPO)
 
 
-def _run_job(tmp_path, capfd, body, *, n=3, timeout=180, job_kw=None):
+def _run_job(tmp_path, capfd, body, *, n=3, timeout=180, job_kw=None,
+             mca=None):
     app = tmp_path / "nw_app.py"
     app.write_text(APP_PRELUDE + textwrap.dedent(body))
-    job = Job(n, [sys.executable, str(app)], [], heartbeat_s=0.5,
-              miss_limit=8, **(job_kw or {}))
+    kw = {"heartbeat_s": 0.5, "miss_limit": 8, **(job_kw or {})}
+    job = Job(n, [sys.executable, str(app)], list(mca or ()), **kw)
     rc = job.run(timeout_s=timeout)
     out = capfd.readouterr()
     return rc, out.out + out.err, job
@@ -623,3 +624,93 @@ class TestNativeJobs:
 """, n=2, timeout=120, job_kw={"on_failure": "continue"})
         assert rc == 0, out
         assert "NW_KILL_OK 0" in out, out
+
+    def test_sigstop_consumer_postmortem_names_ring(self, tmp_path,
+                                                    capfd):
+        """A consumer SIGSTOPped mid-drain leaves the sender blocked
+        in the armed ``nw_ring_put`` wait; the stall watchdog's
+        postmortem names the blocked ring token (the ``/onw-`` shm
+        name), the frozen peer's pid, the direction, and the live
+        occupancy — and the ``native_rings`` contributor carries every
+        ring's counter block. A third rank SIGCONTs the consumer so
+        the job still finishes clean (the typed-error contract for a
+        DEAD peer is the previous test; a stopped peer is a stall, not
+        a failure)."""
+        import json
+
+        pm_dir = tmp_path / "pm"
+        pidf = tmp_path / "consumer.pid"
+        rc, out, _job = _run_job(tmp_path, capfd, """
+    import signal
+    world = mpi.init()
+    rt = Runtime.current()
+    me = rt.bootstrap["process_index"]
+    off = rt.local_rank_offset
+    pidf = %(pidf)r
+    big = np.zeros(48 << 20, np.uint8)  # 48 MiB >> the 8 MiB ring
+    warm = np.ones(2 << 20, np.uint8)   # rides the native rings
+    world.barrier()
+    if me == 1:
+        # warm transfer first: the consumer ATTACHES the rx ring
+        # (stamping its pid into the shared header) before freezing
+        world.send(warm, 0, tag=25, rank=off)
+        world.recv(source=0, tag=27, rank=off)  # consumer frozen now
+        world.send(big, 0, tag=25, rank=off)  # jams in nw_ring_put
+        v, _st = world.recv(source=0, tag=26, rank=off)
+        assert int(np.asarray(v)[0]) == 7
+    elif me == 0:
+        world.recv(source=rt.local_size, tag=25, rank=0)  # attach
+        world.send(np.full(4, 9, np.int32), rt.local_size, tag=27,
+                   rank=0)
+        with open(pidf + ".tmp", "w") as f:
+            f.write(str(os.getpid()))
+        os.replace(pidf + ".tmp", pidf)  # rank 2's SIGCONT cue
+        os.kill(os.getpid(), signal.SIGSTOP)  # freeze mid-transfer
+        got, _st = world.recv(source=rt.local_size, tag=25, rank=0)
+        assert np.asarray(got).nbytes == big.nbytes
+        world.send(np.full(4, 7, np.int32), rt.local_size, tag=26,
+                   rank=0)
+    else:
+        while not os.path.exists(pidf):
+            time.sleep(0.05)
+        time.sleep(5.0)  # stall timeout 1.5s: postmortem is on disk
+        os.kill(int(open(pidf).read()), signal.SIGCONT)
+    world.barrier()
+    print(f"NW_STALL_OK {me}", flush=True)
+    mpi.finalize()
+""" % {"pidf": str(pidf)}, n=3, timeout=180,
+            mca=[("obs_enable", "1"), ("obs_stall_timeout", "1.5"),
+                 ("obs_postmortem_dir", str(pm_dir))],
+            job_kw={"miss_limit": 40})
+        assert rc == 0, out
+        for me in range(3):
+            assert f"NW_STALL_OK {me}" in out, out
+        consumer_pid = int(pidf.read_text())
+        pms = sorted(pm_dir.glob("postmortem-*.json"))
+        assert pms, f"no postmortems in {pm_dir}: {out}"
+        stalls = []
+        rings_doc = None
+        for p in pms:
+            with open(p) as f:
+                doc = json.load(f)
+            if isinstance(doc.get("native_rings"), dict):
+                rings_doc = doc["native_rings"]
+            for w in doc.get("stalled") or []:
+                if w.get("op") == "nw_ring_put":
+                    stalls.append((doc["rank"], w))
+        assert stalls, f"no nw_ring_put stall in {pms}"
+        (rank, w), = stalls[:1]
+        assert int(rank["pidx"]) == 1, stalls
+        info = w["info"]
+        assert info["ring"].startswith("/onw-"), info
+        assert info["dir"] == "send", info
+        assert int(info["peer_pid"]) == consumer_pid, (
+            info, consumer_pid)
+        assert info["occupancy"] > 0.5, info  # ring jammed full
+        assert info["pending"] > 0 and info["capacity"] > 0, info
+        # the fleet-wide ring table rode along in the same dump
+        assert rings_doc is not None, pms
+        assert rings_doc["tx"], rings_doc
+        tx0 = rings_doc["tx"][0]
+        assert tx0["name"].startswith("/onw-"), tx0
+        assert tx0["stats"]["w_stalls"] >= 1, tx0

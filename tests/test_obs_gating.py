@@ -50,6 +50,7 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/service/qos.py",
            "ompi_release_tpu/service/tenant.py",
            "ompi_release_tpu/obs/ledger.py",
+           "ompi_release_tpu/obs/nativeev.py",
            "ompi_release_tpu/btl/nativewire.py",
            "ompi_release_tpu/osc/plan.py",
            "ompi_release_tpu/oshmem/shmem.py")
