@@ -1377,6 +1377,165 @@ mpi.finalize()
 """
 
 
+def _native_rounds_micro_suite():
+    """Three-way orchestration split for spanning collectives over a
+    REAL 3-process loopback job: the SAME allreduce/bcast/allgather at
+    4 KiB–1 MiB fired (a) fully interpreted (``coll_compiled=0``, the
+    per-call dispatch), (b) through frozen wire plans replayed by the
+    Python PlannedXchg loop (``coll_plan_native=0``), and (c) through
+    the native C plan executor (one ctypes slice loop walks every
+    round). Orchestration is the ``coll_orchestration_seconds`` pvar
+    delta; every leg asserts BITWISE parity against its interpreted
+    twin in-app, the native leg asserts it actually fired C-side
+    (``plan_native_fires`` advanced, zero ``plan_native_fallbacks``),
+    and the app asserts ``wire_native_fallback_copies`` stayed zero —
+    the contiguous path never staged through a bounce buffer. THE
+    acceptance factor rides ``compiled_native_allreduce_*_orch_speedup``
+    (planned-replay orchestration / native orchestration, >= 2x at
+    <= 256 KiB); gate directions come for free from the ``steady_``
+    (lower-better) and ``compiled_`` (higher-better) prefixes."""
+    import os
+
+    from ompi_release_tpu.tools.tpurun import run_loopback_app
+
+    doc = run_loopback_app(
+        3, _NATIVE_ROUNDS_APP % {"repo": os.path.dirname(
+            os.path.abspath(__file__))}, {},
+        "native_rounds.json", timeout_s=420)
+    if doc is None:
+        return [{"metric": "native_rounds_suite", "value": None,
+                 "unit": None, "vs_baseline": None,
+                 "error": "loopback job failed"}]
+    lines = []
+    for ln in doc["lines"]:
+        ln.setdefault("suite", "native_rounds")
+        ln.setdefault("vs_baseline", None)
+        lines.append(ln)
+    return lines
+
+
+_NATIVE_ROUNDS_APP = r"""
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_release_tpu as mpi
+from ompi_release_tpu.mca import pvar, var as mca_var
+from ompi_release_tpu.runtime.runtime import Runtime
+
+def _pv(name):
+    p = pvar.PVARS.lookup(name)
+    return float(p.read()) if p is not None else 0.0
+
+world = mpi.init()
+L = len(world.local_comm_ranks)
+# recursive doubling freezes to a byte-provable plan (the ring
+# algorithm's mid-round partial mutations withdraw to PlannedXchg --
+# that selection is the fallback contract, not a failure)
+mca_var.set_value("hier_inter_algorithm", "recursive_doubling")
+reps = 8
+KiB = 1024
+cases = [("allreduce", 4 * KiB), ("allreduce", 256 * KiB),
+         ("allreduce", 1024 * KiB), ("bcast", 4 * KiB),
+         ("bcast", 256 * KiB), ("allgather", 64 * KiB)]
+lines = []
+
+def call(coll, x):
+    if coll == "allreduce":
+        return np.asarray(world.allreduce(x))
+    if coll == "bcast":
+        return np.asarray(world.bcast(x, root=0))
+    return np.asarray(world.allgather(x))
+
+def leg(coll, x):
+    call(coll, x)  # warm: record + freeze (+ native lowering)
+    o0 = _pv("coll_orchestration_seconds")
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = call(coll, x)
+    wall = (time.perf_counter() - t0) / reps
+    orch = (_pv("coll_orchestration_seconds") - o0) / reps
+    return wall, orch, out
+
+for coll, nbytes in cases:
+    elems = max(1, nbytes // 4)
+    x = np.stack([np.arange(elems, dtype=np.float32) * 0.25 + i
+                  for i in range(L)])
+    hum = ("1MiB" if nbytes >= 1024 * KiB
+           else "%%dKiB" %% (nbytes // KiB))
+    label = coll + "_" + hum
+
+    mca_var.set_value("coll_compiled", 0)
+    wall_i, orch_i, want = leg(coll, x)
+    mca_var.VARS.unset("coll_compiled")
+
+    mca_var.set_value("coll_plan_native", 0)
+    wall_p, orch_p, got_p = leg(coll, x)
+    mca_var.VARS.unset("coll_plan_native")
+
+    f0, fb0 = _pv("plan_native_fires"), _pv("plan_native_fallbacks")
+    wall_n, orch_n, got_n = leg(coll, x)
+    f1, fb1 = _pv("plan_native_fires"), _pv("plan_native_fallbacks")
+
+    np.testing.assert_array_equal(got_p, want)  # BITWISE in-app
+    np.testing.assert_array_equal(got_n, want)  # BITWISE in-app
+    assert f1 - f0 >= reps, (
+        "native leg fell back to interpreted replay: %%s" %% label)
+    assert fb1 - fb0 == 0, (
+        "native leg took per-fire safety fallbacks: %%s" %% label)
+    speed = orch_p / max(orch_n, 1e-12)
+    if coll == "allreduce" and nbytes <= 256 * KiB:
+        # THE acceptance factor: the C slice loop beats the Python
+        # round replay by >= 2x on orchestration at small payloads
+        assert speed >= 2.0, (
+            "native orchestration speedup %%.2fx < 2x at %%s"
+            %% (speed, label))
+
+    common = {"reps": reps, "bytes": nbytes}
+    lines.append({"metric": "steady_native_orch_%%s_interpreted" %% label,
+                  "value": round(orch_i, 9), "unit": "s",
+                  "wall_seconds": round(wall_i, 9),
+                  "comm_alone_seconds": round(wall_i - orch_i, 9),
+                  **common})
+    lines.append({"metric": "steady_native_orch_%%s_planned" %% label,
+                  "value": round(orch_p, 9), "unit": "s",
+                  "wall_seconds": round(wall_p, 9),
+                  "comm_alone_seconds": round(wall_p - orch_p, 9),
+                  **common})
+    lines.append({"metric": "steady_native_orch_%%s_native" %% label,
+                  "value": round(orch_n, 9), "unit": "s",
+                  "wall_seconds": round(wall_n, 9),
+                  "comm_alone_seconds": round(wall_n - orch_n, 9),
+                  **common})
+    lines.append({"metric": "compiled_native_%%s_orch_speedup" %% label,
+                  "value": round(speed, 3), "unit": "x_orchestration",
+                  "planned_orch_s": round(orch_p, 9),
+                  "native_orch_s": round(orch_n, 9),
+                  "vs_interpreted": round(orch_i / max(orch_n, 1e-12), 3),
+                  "wall_speedup": round(wall_p / max(wall_n, 1e-12), 3),
+                  **common})
+
+assert _pv("wire_native_fallback_copies") == 0, (
+    "contiguous native fires must not stage through bounce buffers")
+lines.append({"metric": "native_rounds_pool",
+              "value": _pv("plan_pool_hits"), "unit": None,
+              "pool_bytes": _pv("plan_pool_bytes"),
+              "native_fires": _pv("plan_native_fires"),
+              "native_fallbacks": _pv("plan_native_fallbacks")})
+
+pidx = int(Runtime.current().bootstrap["process_index"])
+if pidx == 0:
+    with open(os.environ["OMPITPU_LOOPBACK_OUT"], "w") as f:
+        json.dump({"lines": lines}, f)
+mpi.finalize()
+"""
+
+
 def _sentinel_micro_suite():
     """sentinel lines: the SAME 1 MiB allreduce with the collective
     contract sentinel off (obs_sentinel=0 — one attribute check per
@@ -3260,12 +3419,18 @@ def main():
     #   steady_state: interpreted-vs-compiled Python-orchestration
     #            time (frozen schedule plans, coll/plan) for one-shot,
     #            persistent, and 3-proc spanning allreduce legs
+    #   native_rounds: the native C plan executor vs the PlannedXchg
+    #            Python replay vs interpreted, 3-proc loopback:
+    #            orchestration split per leg, bitwise parity in-app,
+    #            the >= 2x orch-speedup acceptance at <= 256 KiB
     #   rma_steady: the one-sided twin (frozen epoch plans, osc/plan)
     #            — interpreted-vs-planned fence epochs plus the
     #            planned symmetric-heap bulk path vs per-call
     _run_suite("coll_micro_suite", _coll_micro_suite, emit, jax)
     _run_suite("steady_state_suite", _steady_state_micro_suite, emit,
                jax)
+    _run_suite("native_rounds_suite", _native_rounds_micro_suite,
+               emit, jax)
     _run_suite("rma_steady_suite", _rma_steady_micro_suite, emit, jax)
     _run_suite("sentinel_suite", _sentinel_micro_suite, emit, jax)
     _run_suite("wire_micro_suite",

@@ -642,6 +642,33 @@ class NativeWireBtl(DcnBtl):
             if tok is not None:
                 _watchdog.disarm(tok)
 
+    def plan_endpoints(self, tag: int, send_peers, recv_srcs):
+        """Per-peer native handles for a frozen-plan executor
+        (coll/native_exec): ``{pidx: (tx, rx)}`` where tx is the
+        producer-side ``(ring, lock)`` toward the peer (None =
+        cross-host or ring creation failed → the executor uses the
+        vectored-socket leg, exactly like the interpreted path) and
+        rx is the consumer-side ``(ring, lock, cross-tag stash)``
+        entry for frames FROM the peer (None = cross-host). The
+        executor holds both locks for the whole fire — the rings are
+        SPSC, so concurrent Python senders/receivers must stay out
+        precisely as long as C owns the cursors."""
+        out = {}
+        for p in sorted(set(send_peers) | set(recv_srcs)):
+            tx = rx = None
+            if self._same_host(p):
+                if p in send_peers:
+                    ent = self._tx_ring(
+                        p, _slot_of(tag, self._cap(p)[1]))
+                    if ent[0] is not None:
+                        tx = ent
+                if p in recv_srcs:
+                    slot = _slot_of(tag, self._cap(self.my_pidx)[1])
+                    rx = self._rx_ring(p, slot,
+                                       _time.monotonic() + 5.0)
+            out[p] = (tx, rx)
+        return out
+
     def _shutdown_rings(self) -> None:
         from ..native import ShmRing
 

@@ -86,6 +86,18 @@ def orch_clear() -> None:
     _capture_tls.t0 = None
 
 
+def orch_add(dt: float) -> None:
+    """Credit ``dt`` seconds of Python orchestration directly. The
+    wire-replay adapters (PlannedXchg's per-round Python loop, the
+    native executor's ctypes entry/exit + pool copies) run BETWEEN
+    driver dispatches, where the ``run_sharded`` interval can't see
+    them — they self-report here so ``coll_orchestration_seconds``
+    keeps meaning "Python time before the compiled program or wire
+    transport takes over" on every leg of the steady state."""
+    if dt > 0.0:
+        _orch.add(dt)
+
+
 def _orch_t0(default: float) -> float:
     t0 = getattr(_capture_tls, "t0", None)
     if t0 is None:

@@ -110,6 +110,15 @@ def register_vars() -> None:
         "restores the fully interpreted per-call dispatch",
     )
     mca_var.register(
+        "coll_plan_native", "bool", True,
+        "Fire eligible frozen wire plans through the native C plan "
+        "executor (one ctypes slice loop walks every round: striped "
+        "sends, pooled reassembly, FT fault-word polling). Requires "
+        "the native .so and a nativewire card on every round peer; "
+        "anything else — and false — replays through the interpreted "
+        "PlannedXchg path, bitwise-identical",
+    )
+    mca_var.register(
         "obs_trace_sample", "int", 0,
         "With obs on, run every Nth compiled-plan fire through the "
         "fully interpreted path for a ground-truth deep trace (full "
@@ -424,10 +433,20 @@ def dispatch(comm, name: str, fn: Callable, args: Tuple,
 # spanning: record the round structure, freeze the wire frames
 # ---------------------------------------------------------------------------
 
+#: module-level alias so tests can monkeypatch-count conversions:
+#: the planned replay path must NOT pay np.asarray for inputs that
+#: already are ndarrays (the overwhelmingly common steady state)
+_np_asarray = np.asarray
+
+
+def _as_nd(a):
+    return a if isinstance(a, np.ndarray) else _np_asarray(a)
+
+
 def _round_meta(sends: Dict[int, list]) -> Tuple:
     return tuple(
-        (p, tuple((np.asarray(a).shape, str(np.asarray(a).dtype))
-                  for a in sends[p]))
+        (p, tuple((a.shape, str(a.dtype))
+                  for a in map(_as_nd, sends[p])))
         for p in sorted(sends) if sends[p]
     )
 
@@ -435,15 +454,19 @@ def _round_meta(sends: Dict[int, list]) -> Tuple:
 class RoundRecorder:
     """Exchange-adapter wrapper: delegates every round to the real
     transport and records its structure — (peer, shape, dtype) per
-    send, receive counts per peer. Works over the production
-    :class:`~.hier._XchgAdapter` and the fleet simulator's
-    ``FleetXchg`` alike (anything honoring the exchange contract)."""
+    send, receive counts per peer, and the per-source arrival
+    shapes/dtypes (the native executor's reassembly-pool layout;
+    per-source order is deterministic: the wire is FIFO per peer).
+    Works over the production :class:`~.hier._XchgAdapter` and the
+    fleet simulator's ``FleetXchg`` alike (anything honoring the
+    exchange contract)."""
 
-    __slots__ = ("inner", "rounds")
+    __slots__ = ("inner", "rounds", "recv_metas")
 
     def __init__(self, inner) -> None:
         self.inner = inner
         self.rounds: List[Tuple[Tuple, Tuple]] = []
+        self.recv_metas: List[Tuple] = []
 
     def exchange(self, sends: Dict[int, list],
                  recvs: Dict[int, int]) -> Dict[int, list]:
@@ -453,19 +476,31 @@ class RoundRecorder:
             tuple(sorted((int(p), int(c)) for p, c in recvs.items()
                          if int(c) > 0)),
         ))
+        self.recv_metas.append(tuple(sorted(
+            (int(src), tuple((_as_nd(a).shape, str(_as_nd(a).dtype))
+                             for a in arrs))
+            for src, arrs in got.items() if arrs)))
         return got
 
 
 class WireRound:
     """One frozen schedule round: verification metadata plus the
     resolved send slots (peer -> per-message FrameTemplates or None
-    for shm/legacy sends), channel tag, and striping depth."""
+    for shm/legacy sends), channel tag, and striping depth.
+
+    ``recvs_meta`` (per-source arrival shapes/dtypes) sizes the
+    native executor's reassembly pool; ``frame_counts`` (frames per
+    peer stream, header included) lets the striper skip QoS gating on
+    exhausted streams. Both default None: manually-built rounds and
+    pre-upgrade plans replay exactly as before."""
 
     __slots__ = ("sends_meta", "recvs_t", "recvs", "peers",
-                 "peer_slots", "tag", "depth")
+                 "peer_slots", "tag", "depth", "recvs_meta",
+                 "frame_counts")
 
     def __init__(self, sends_meta: Tuple, recvs_t: Tuple, peer_slots,
-                 tag: int, depth: int) -> None:
+                 tag: int, depth: int, recvs_meta: Optional[Tuple] = None,
+                 frame_counts: Optional[Tuple] = None) -> None:
         self.sends_meta = sends_meta
         self.recvs_t = recvs_t
         self.recvs = dict(recvs_t)
@@ -473,6 +508,8 @@ class WireRound:
         self.peer_slots = peer_slots
         self.tag = tag
         self.depth = depth
+        self.recvs_meta = recvs_meta
+        self.frame_counts = frame_counts
 
 
 class WirePlan:
@@ -496,11 +533,18 @@ class WirePlan:
 
 
 def freeze_wire_plan(comm, recorded: List[Tuple[Tuple, Tuple]],
-                     gen: int) -> Optional[WirePlan]:
+                     gen: int,
+                     recv_metas: Optional[List[Tuple]] = None,
+                     ) -> Optional[WirePlan]:
     """Resolve one recorded round structure into a frozen
     :class:`WirePlan`: wire tuning cvars snapshot once (the satellite
     contract — a mid-job cvar write lands here, at the NEXT plan),
-    SGH2 headers and fragment offsets precomposed per send slot."""
+    SGH2 headers and fragment offsets precomposed per send slot.
+
+    ``recv_metas`` (parallel to ``recorded``, the recorder's
+    per-source arrival shapes/dtypes) is optional: plans frozen
+    without it stay fully replayable, they just never graduate to the
+    native executor (which needs arrival metas to size its pool)."""
     router = getattr(comm.runtime, "wire", None)
     if router is None:
         return None
@@ -509,8 +553,12 @@ def freeze_wire_plan(comm, recorded: List[Tuple[Tuple, Tuple]],
     tuning = router.refresh_tuning()
     tag = router._coll_tag(comm)
     rounds: List[WireRound] = []
-    for sends_meta, recvs_t in recorded:
+    for i, item in enumerate(recorded):
+        sends_meta, recvs_t = item[0], item[1]
+        recvs_meta = (recv_metas[i] if recv_metas is not None
+                      and i < len(recv_metas) else None)
         peer_slots = []
+        frame_counts = []
         for p, arrs in sends_meta:
             tpls = []
             for shape, dtype in arrs:
@@ -530,8 +578,16 @@ def freeze_wire_plan(comm, recorded: List[Tuple[Tuple, Tuple]],
                     tpl = _btl.plan_frame_template(shape, dtype, seg)
                 tpls.append(tpl)
             peer_slots.append((p, tuple(tpls)))
+            # frames a stream will emit: header + fragments for a
+            # templated message, one frame otherwise — exact, so the
+            # striper can drop a drained stream without gating it
+            frame_counts.append(sum(
+                (int(t.nchunks) + 1) if t is not None else 1
+                for t in tpls))
         rounds.append(WireRound(sends_meta, recvs_t, tuple(peer_slots),
-                                tag, tuning.depth))
+                                tag, tuning.depth,
+                                recvs_meta=recvs_meta,
+                                frame_counts=tuple(frame_counts)))
     _wire_rounds_frozen.add(len(rounds))
     return WirePlan(gen, comm.cid, rounds, tuning.coll_timeout_ms)
 
@@ -566,20 +622,38 @@ class PlannedXchg:
 
     def exchange(self, sends: Dict[int, list],
                  recvs: Dict[int, int]) -> Dict[int, list]:
+        # the whole replay round is Python orchestration (posting,
+        # striping, reap polling) — self-report it so the steady-state
+        # orchestration split sees the replay loop the native executor
+        # exists to eliminate
+        t0 = _time.perf_counter()
+        try:
+            return self._exchange(sends, recvs)
+        finally:
+            _lazy_driver().orch_add(_time.perf_counter() - t0)
+
+    def _exchange(self, sends: Dict[int, list],
+                  recvs: Dict[int, int]) -> Dict[int, list]:
         plan = self.plan
         if self.i >= len(plan.rounds):
             raise self._mismatch("more rounds than the plan recorded")
         rnd = plan.rounds[self.i]
         self.i += 1
-        sends_f = {p: [np.asarray(a) for a in arrs]
+        # comparison forms were precomputed at freeze time
+        # (rnd.sends_meta / rnd.recvs): no re-sort of the recv list,
+        # no np.asarray for inputs that already are ndarrays, and the
+        # metadata tuple is built from the once-converted arrays
+        sends_f = {p: [_as_nd(a) for a in arrs]
                    for p, arrs in sends.items() if arrs}
-        recvs_t = tuple(sorted((int(p), int(c))
-                               for p, c in recvs.items() if int(c) > 0))
-        meta = _round_meta(sends_f)
-        if meta != rnd.sends_meta or recvs_t != rnd.recvs_t:
+        meta = tuple(
+            (p, tuple((a.shape, str(a.dtype)) for a in sends_f[p]))
+            for p in sorted(sends_f))
+        recvs_l = {int(p): int(c)
+                   for p, c in recvs.items() if int(c) > 0}
+        if meta != rnd.sends_meta or recvs_l != rnd.recvs:
             raise self._mismatch(
-                f"sends/recvs {meta}/{recvs_t} != frozen "
-                f"{rnd.sends_meta}/{rnd.recvs_t}")
+                f"sends/recvs {meta}/{recvs_l} != frozen "
+                f"{rnd.sends_meta}/{rnd.recvs}")
         m = self.m
         if sends_f:
             m._send_all_planned(rnd, sends_f)
@@ -606,13 +680,16 @@ class SpanningPlanState:
     the next plan, never mid-schedule)."""
 
     __slots__ = ("comm", "name", "plan", "sig", "fires",
-                 "sentinel_tpl")
+                 "sentinel_tpl", "native")
 
     def __init__(self, comm, name: str, sig: Optional[Tuple] = None
                  ) -> None:
         self.comm = comm
         self.name = name
         self.plan: Optional[WirePlan] = None
+        #: the plan lowered into the C executor (coll/native_exec) —
+        #: None when ineligible; lives and dies with ``plan``
+        self.native = None
         self.sig = sig
         #: observed-fire counter driving obs_trace_sample (advances in
         #: lockstep across ranks: collectives are, by definition,
@@ -621,6 +698,14 @@ class SpanningPlanState:
         #: (key, InlineFrameTemplate) cache — sentinel level 2's
         #: precomposed ctl-frame payload for this plan's call shape
         self.sentinel_tpl: Optional[Tuple] = None
+
+    def _drop_native(self) -> None:
+        nx, self.native = self.native, None
+        if nx is not None:
+            try:
+                nx.close()
+            except Exception:
+                pass
 
     def run(self, fn: Callable, args: Tuple,
             kw: Optional[Dict]) -> Any:
@@ -632,6 +717,7 @@ class SpanningPlanState:
         plan = self.plan
         if plan is not None and plan.gen != gen:
             plan = self.plan = None  # cvars moved: re-plan
+            self._drop_native()
         old = m._xchg
         if plan is None:
             # recording rides the fully-interpreted transport (spans,
@@ -644,12 +730,29 @@ class SpanningPlanState:
             finally:
                 m._xchg = old
             self.plan = freeze_wire_plan(self.comm, rec.rounds, gen)
+            if (self.plan is not None
+                    and len(self.plan.rounds) == len(rec.recv_metas)):
+                # graft the recorder's arrival metas onto the frozen
+                # rounds: only the native executor reads them (pool
+                # sizing), interpreted replay never looks
+                for rnd, rmeta in zip(self.plan.rounds,
+                                      rec.recv_metas):
+                    try:
+                        rnd.recvs_meta = rmeta
+                    except (AttributeError, TypeError):
+                        break
             if self.plan is not None:
                 _compiled_hits.observe(0)
                 if _obs.enabled:
                     _obs.record("plan_freeze_" + self.name, "plan",
                                 t0, _time.perf_counter() - t0,
                                 comm_id=self.comm.cid)
+                # lower the fresh plan into the C executor (two
+                # wire-free probe runs + descriptor compile + ring
+                # bind); None = ineligible, replay stays interpreted
+                from . import native_exec as _native
+                self.native = _native.try_compile(
+                    self, m, fn, args, kw)
             return out
         rec = _obs.enabled
         if rec:
@@ -660,7 +763,12 @@ class SpanningPlanState:
                 # runs fully interpreted (complete span/flow record);
                 # the frozen plan survives for the next fire
                 return fn(*args, **kw)
-        px = PlannedXchg(m, plan)
+        nx = self.native
+        if nx is not None and nx.gen == plan.gen:
+            from . import native_exec as _native
+            px = _native.NativeXchg(m, plan, nx, args)
+        else:
+            px = PlannedXchg(m, plan)
         t0 = 0.0
         if rec:
             if plan.ledger_id is None:
@@ -680,6 +788,7 @@ class SpanningPlanState:
             # forever (the divergence error's own advice, "re-issue
             # the collective", must actually work)
             self.plan = None
+            self._drop_native()
             raise
         finally:
             m._xchg = old

@@ -31,8 +31,8 @@ _lib_lock = threading.Lock()
 #: stamp inputs — must match the Makefile's STAMP_SRCS list (same
 #: files; order is irrelevant, the comparison is by name)
 _STAMP_INPUTS = ("dss.cc", "oob.cc", "btl_tcp.cc", "btl_shm.cc",
-                 "nativeev.cc", "oob_endpoint.h", "nativeev.h",
-                 "Makefile")
+                 "nativeev.cc", "planexec.cc", "oob_endpoint.h",
+                 "nativeev.h", "Makefile")
 _STAMP_PATH = os.path.join(_NATIVE_DIR, "build", ".srcstamp")
 
 
@@ -215,6 +215,37 @@ def _declare(lib: ctypes.CDLL) -> None:
         lib.nativeev_read.argtypes = [P, ctypes.c_int64, P,
                                       ctypes.c_int64, i64p]
         lib.nativeev_read.restype = ctypes.c_int64
+    if hasattr(lib, "planexec_create"):
+        lib.planexec_create.argtypes = [u8p, ctypes.c_int64]
+        lib.planexec_create.restype = P
+        lib.planexec_destroy.argtypes = [P]
+        lib.planexec_bind.argtypes = [P, P, ctypes.c_int64, i64p,
+                                      vpp, vpp, ctypes.c_int64]
+        lib.planexec_bind.restype = ctypes.c_int
+        lib.planexec_set_ftword.argtypes = [P, i64p]
+        lib.planexec_fire_begin.argtypes = [P, vpp, i64p,
+                                            ctypes.c_int64,
+                                            ctypes.c_int64,
+                                            ctypes.c_int64]
+        lib.planexec_fire_begin.restype = ctypes.c_int
+        lib.planexec_fire_step.argtypes = [P, ctypes.c_int64]
+        lib.planexec_fire_step.restype = ctypes.c_int
+        lib.planexec_pool_ptr.argtypes = [P]
+        lib.planexec_pool_ptr.restype = P
+        lib.planexec_ts_ptr.argtypes = [P]
+        lib.planexec_ts_ptr.restype = ctypes.POINTER(ctypes.c_double)
+        for f in ("planexec_pool_total", "planexec_pool_count",
+                  "planexec_round_count", "planexec_input_count",
+                  "planexec_err_peer", "planexec_err_round",
+                  "planexec_stash_count"):
+            getattr(lib, f).argtypes = [P]
+            getattr(lib, f).restype = ctypes.c_int64
+        lib.planexec_stash_info.argtypes = [P, ctypes.c_int64, i64p,
+                                            i64p, i64p]
+        lib.planexec_stash_info.restype = ctypes.c_int64
+        lib.planexec_stash_data.argtypes = [P, ctypes.c_int64]
+        lib.planexec_stash_data.restype = P
+        lib.planexec_stash_clear.argtypes = [P]
 
 
 def wire_symbols_available() -> bool:
@@ -242,6 +273,21 @@ def telemetry_symbols_available() -> bool:
         return False
     return (hasattr(lib, "shmring_stat") and hasattr(lib, "wire_stats")
             and hasattr(lib, "nativeev_create"))
+
+
+def planexec_symbols_available() -> bool:
+    """True when the loaded .so carries the native plan-executor ABI
+    (planexec_*). Same never-raises discipline as
+    :func:`wire_symbols_available`: a stale .so means 'capability
+    absent' and compiled plans keep firing through the interpreted
+    PlannedXchg replay."""
+    try:
+        lib = load_library()
+    except Exception:
+        return False
+    return (hasattr(lib, "planexec_create")
+            and hasattr(lib, "wire_sendv")
+            and hasattr(lib, "shmring_create"))
 
 
 def _u8(data: bytes):
@@ -765,6 +811,173 @@ class NativeEventRing:
     def close(self) -> None:
         if self._h:
             self._lib.nativeev_close(self._h)
+            self._h = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PlanExec:
+    """Native executor for ONE frozen wire plan (coll/plan analogue of
+    the reference's posted-descriptor progress loop).
+
+    coll/native_exec.py compiles a WirePlan into a flat descriptor
+    blob (rounds, peers, precomposed header bytes, scatter-gather
+    payload maps, pool placements), creates a PlanExec once, binds the
+    live endpoint/ring handles once, and then every steady-state fire
+    is ``fire_begin`` + a ``fire_step`` loop: all rounds walk C-side,
+    Python re-enters only between ~100 ms slices (to run the ULFM
+    failure detector) and at completion or typed error.
+
+    Return codes (native/planexec.cc): 0 done, 1 slice expired
+    (call ``fire_step`` again), 2 fault-word stop (run check_wait,
+    then resume), -1 bad call, -2 peer dead (``err_peer`` names the
+    pidx), -3 plan timeout, -4 inbound header diverged from the
+    frozen expectation, -5 reassembled payload failed CRC."""
+
+    RC_DONE = 0
+    RC_AGAIN = 1
+    RC_FTSTOP = 2
+    RC_BADARG = -1
+    RC_PEERDEAD = -2
+    RC_TIMEOUT = -3
+    RC_DIVERGED = -4
+    RC_TRUNCATED = -5
+
+    def __init__(self, blob: bytes) -> None:
+        lib = load_library()
+        if not hasattr(lib, "planexec_create"):
+            raise MPIError(ErrorCode.ERR_OTHER,
+                           "planexec symbols not available")
+        self._lib = lib
+        self._h = lib.planexec_create(_u8(blob), len(blob))
+        if not self._h:
+            raise MPIError(ErrorCode.ERR_OTHER,
+                           "plan descriptor blob rejected")
+        self._ftword = None  # keepalive for the fault-word buffer
+
+    def _handle(self):
+        h = self._h
+        if not h:
+            raise MPIError(ErrorCode.ERR_OTHER, "plan executor closed")
+        return h
+
+    def bind(self, ep_handle, my_nid: int, peer_nids,
+             tx_ring_handles, rx_ring_handles) -> None:
+        """Attach the live endpoint + per-peer ring handles (entries
+        may be None → that peer uses the vectored-socket leg)."""
+        n = len(peer_nids)
+        nids = (ctypes.c_int64 * n)(*[int(v) for v in peer_nids])
+        tx = (ctypes.c_void_p * n)(*[h or None
+                                     for h in tx_ring_handles])
+        rx = (ctypes.c_void_p * n)(*[h or None
+                                     for h in rx_ring_handles])
+        rc = self._lib.planexec_bind(self._handle(), ep_handle,
+                                     my_nid, nids, tx, rx, n)
+        if rc != 0:
+            raise MPIError(ErrorCode.ERR_OTHER,
+                           "plan executor bind rejected")
+
+    def set_ftword(self, word_buf) -> None:
+        """Point the executor at a 1-element int64 fault word (a
+        ctypes int64 array owned by the caller; nonzero aborts waits
+        with RC_FTSTOP within the polling interval)."""
+        self._ftword = word_buf
+        self._lib.planexec_set_ftword(
+            self._handle(),
+            ctypes.cast(word_buf, ctypes.POINTER(ctypes.c_int64)))
+
+    def fire_begin(self, input_arrays, xfer_base: int,
+                   timeout_ms: int) -> int:
+        """Arm a fire with the round-0 input regions (contiguous
+        ndarrays, pointers live until the fire completes)."""
+        n = len(input_arrays)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_int64 * n)()
+        for i, a in enumerate(input_arrays):
+            ptrs[i] = ctypes.c_void_p(a.ctypes.data)
+            lens[i] = int(a.nbytes)
+        self._fire_keep = input_arrays
+        return int(self._lib.planexec_fire_begin(
+            self._handle(), ptrs, lens, n, xfer_base, timeout_ms))
+
+    def fire_step(self, slice_ms: int) -> int:
+        return int(self._lib.planexec_fire_step(self._handle(),
+                                                slice_ms))
+
+    @property
+    def pool_total(self) -> int:
+        return int(self._lib.planexec_pool_total(self._handle()))
+
+    @property
+    def pool_count(self) -> int:
+        return int(self._lib.planexec_pool_count(self._handle()))
+
+    @property
+    def round_count(self) -> int:
+        return int(self._lib.planexec_round_count(self._handle()))
+
+    @property
+    def input_count(self) -> int:
+        return int(self._lib.planexec_input_count(self._handle()))
+
+    def pool_view(self):
+        """Zero-copy uint8 ndarray over the reassembly slab (valid
+        until close; reused across fires — consumers copy out)."""
+        import numpy as _np
+
+        total = self.pool_total
+        if total == 0:
+            return _np.empty(0, dtype=_np.uint8)
+        ptr = self._lib.planexec_pool_ptr(self._handle())
+        buf = (ctypes.c_uint8 * total).from_address(ptr)
+        return _np.frombuffer(buf, dtype=_np.uint8)
+
+    def round_ts(self):
+        """Per-round CLOCK_MONOTONIC end stamps from the last fire —
+        the same clock as time.perf_counter, so the obs ledger record
+        consumes them unchanged."""
+        n = self.round_count
+        p = self._lib.planexec_ts_ptr(self._handle())
+        return [float(p[i]) for i in range(n)]
+
+    def err_peer(self) -> int:
+        return int(self._lib.planexec_err_peer(self._handle()))
+
+    def err_round(self) -> int:
+        return int(self._lib.planexec_err_round(self._handle()))
+
+    def drain_stash(self):
+        """Pop any foreign frames the executor met on the coll
+        channel: list of (kind, peer_pidx, tag, bytes) with kind 0 =
+        endpoint-queue frame, 1 = shm-ring record. The caller
+        re-injects them into the btl stashes so cross-channel traffic
+        survives a native fire untouched."""
+        h = self._handle()
+        out = []
+        n = int(self._lib.planexec_stash_count(h))
+        kind = ctypes.c_int64()
+        peer = ctypes.c_int64()
+        tag = ctypes.c_int64()
+        for i in range(n):
+            ln = int(self._lib.planexec_stash_info(
+                h, i, ctypes.byref(kind), ctypes.byref(peer),
+                ctypes.byref(tag)))
+            if ln < 0:
+                continue
+            ptr = self._lib.planexec_stash_data(h, i)
+            data = ctypes.string_at(ptr, ln) if ln else b""
+            out.append((int(kind.value), int(peer.value),
+                        int(tag.value), data))
+        self._lib.planexec_stash_clear(h)
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.planexec_destroy(self._h)
             self._h = None
 
     def __del__(self) -> None:

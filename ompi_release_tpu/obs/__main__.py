@@ -512,6 +512,64 @@ def selftest() -> int:
         print("native telemetry: symbols absent — counters fold to "
               "zero, event ring stays off")
 
+    # 15. native plan executor (device-free): a frozen two-round wire
+    # plan compiles into the flat descriptor table the C executor
+    # walks (build_blob -> planexec_create introspection, no wire, no
+    # peers), and a spanning-plan ledger fire carrying C-stamped round
+    # boundaries round-trips: the timestamps come back through the
+    # binary ring record exactly as the executor wrote them. Symbols
+    # absent = the compile leg reduces to the graceful-withdrawal
+    # check (try_compile returns None, never raises).
+    from ..coll import native_exec as _nx
+    from ..coll import plan as _cplan
+
+    for nm in ("plan_pool_bytes", "plan_pool_hits",
+               "plan_native_fires", "plan_native_fallbacks"):
+        assert pvar.PVARS.lookup(nm) is not None, nm
+    if _nx.available():
+        from ..native.bindings import PlanExec as _PlanExec
+
+        blob = _nx.build_blob(
+            600, [256], [128, 256], [1, 2],
+            [{"depth": 2,
+              "streams": [(0, [(b"P0", b"M0", 256, 0, 256,
+                                ((0, 0, 0, 256),))])],
+              "rsrcs": [(1, [(0, 128, 0, 128, b"P1", b"M1")])]},
+             {"depth": 2,
+              "streams": [(1, [(b"P2", b"M2", 128, 0, 128,
+                                ((1, 0, 0, 128),))])],
+              "rsrcs": [(0, [(1, 256, 0, 256, b"P3", b"M3")])]}])
+        pxn = _PlanExec(blob)
+        try:
+            assert pxn.round_count == 2 and pxn.input_count == 1
+            assert pxn.pool_count == 2 and pxn.pool_total == 384
+        finally:
+            pxn.close()
+        print("native plan executor: 2-round descriptor table "
+              f"({len(blob)}B) compiled and introspected device-free")
+    else:
+        assert _nx.try_compile(
+            type("S", (), {"plan": None})(), object(), None, (), {}) \
+            is None
+        print("native plan executor: symbols absent — try_compile "
+              "withdraws, interpreted replay in force")
+    rnd_n = _cplan.WireRound(((1, (((64,), "int32"),)),), ((1, 1),),
+                             ((1, (None,)),), 600, 2)
+    lpn = _ledger.register_spanning_plan(62, "native_selftest", 0,
+                                         [rnd_n, rnd_n])
+    tsn = (time.perf_counter(), time.perf_counter() + 1e-4)
+    seqn = _ledger.record_fire(_ledger.KIND_SPANNING, lpn, 62,
+                               tsn[0] - 1e-4, tsn[1], round0=4,
+                               round_ts=tsn)
+    recn = [r for r in _ledger.records() if r["seq"] == seqn][0]
+    assert recn["plan"] == lpn and recn["round0"] == 4
+    assert tuple(recn["round_ts"]) == tsn, recn
+    spans_n = _ledger.expand_record(recn, _ledger.plans())
+    assert any(s["op"].endswith("wire_round1") for s in spans_n), \
+        spans_n
+    print("native plan executor: C-stamped round boundaries "
+          f"round-trip the ledger ({len(spans_n)} spans)")
+
     disable()
     print("obs selftest: ok")
     return 0

@@ -960,36 +960,59 @@ class WireRouter:
         ]
         t = self.tuning()
         self._stripe(streams, rnd.depth, arbiter=t.arbiter,
-                     cls=self._class_of(comm, t))
+                     cls=self._class_of(comm, t),
+                     counts=getattr(rnd, "frame_counts", None))
 
     @staticmethod
     def _stripe(streams: List, depth: int, arbiter=None,
-                cls: Optional[str] = None) -> None:
+                cls: Optional[str] = None, counts=None) -> None:
         """Round-robin the per-peer frame generators in depth-sized
         bursts (the sliding in-flight window). With a QoS ``arbiter``
         (``wire_qos_classes`` set) every burst first passes the
         weighted-fair gate for this sender's class, so a bulk
         tenant's long fragment streams yield to a latency tenant's
         bursts at the class weight ratio instead of FIFO-hogging the
-        endpoint."""
+        endpoint.
+
+        ``counts`` (frozen plans only): exact frames left per stream.
+        A drained stream is dropped WITHOUT passing the gate — a
+        solo-class short tail must not buy window it will never use —
+        and a final partial burst is gated at its real cost, not the
+        full depth."""
         if arbiter is not None:
             arbiter.enter(cls)
         try:
+            remaining = list(counts) if counts is not None else None
             while streams:
                 keep = []
-                for it in streams:
+                keep_left = []
+                for j, it in enumerate(streams):
+                    left = remaining[j] if remaining is not None \
+                        else None
+                    if left is not None and left <= 0:
+                        continue  # exhausted: no gate, no next()
+                    burst = depth if left is None \
+                        else min(depth, left)
                     if arbiter is not None:
-                        arbiter.gate(cls, cost=depth)
+                        arbiter.gate(cls, cost=burst)
                     alive = True
-                    for _ in range(depth):
+                    done = 0
+                    for _ in range(burst):
                         try:
                             next(it)
                         except StopIteration:
                             alive = False
                             break
+                        done += 1
+                    if left is not None:
+                        left -= done
+                        alive = alive and left > 0
                     if alive:
                         keep.append(it)
+                        keep_left.append(left)
                 streams = keep
+                remaining = keep_left if remaining is not None \
+                    else None
         finally:
             if arbiter is not None:
                 arbiter.leave(cls)

@@ -105,6 +105,15 @@ METRIC_HIGHER_BETTER_PREFIXES = ("overlap_", "tree_", "compiled_",
 #: acceptance ratio (telemetry-on p2p wall over telemetry-free
 #: baseline, budget 1.05): lower-better, a grown ratio means the
 #: always-on counter block started costing wall time.
+#: The native_rounds suite (frozen plans lowered into the C plan
+#: executor) rides the SAME two prefixes by construction:
+#: ``steady_native_orch_*`` seconds (whole-fire orchestration with
+#: the descriptor loop running C-side) are lower-better via
+#: ``steady_``, and ``compiled_native_*`` speedups (native executor
+#: over the interpreted PlannedXchg replay — THE >= 2x tentpole
+#: acceptance factor at <= 256 KiB) are higher-better via
+#: ``compiled_``; a shrunk ratio means Python crept back into the
+#: per-round byte path.
 METRIC_LOWER_BETTER_PREFIXES = ("ft_", "ledger_", "sentinel_", "sim_",
                                 "steady_", "tenant_",
                                 "wire_native_copies",

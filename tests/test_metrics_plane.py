@@ -768,6 +768,55 @@ class TestBenchGate:
                 "x_orchestration")])
         assert gate2.main(hist + ["--candidate", str(ok)]) == 0
 
+    def test_native_rounds_metric_directions(self, tmp_path):
+        """The native_rounds suite's lines (frozen plans lowered into
+        the C plan executor): steady_native_orch_* seconds are
+        lower-better, compiled_native_* speedups (native over the
+        interpreted PlannedXchg replay — the executor's acceptance
+        factor) higher-better, and a drift in either direction trips
+        the gate against the fitted history."""
+        from ompi_release_tpu.tools import tpu_bench_gate as gate
+
+        assert gate._direction(
+            "s", "steady_native_orch_allreduce_256KiB") == -1
+        assert gate._direction(
+            None, "steady_native_orch_bcast_4KiB") == -1
+        assert gate._direction(
+            "x_orchestration",
+            "compiled_native_allreduce_256KiB_orch_speedup") == 1
+        assert gate._direction(
+            None, "compiled_native_allgather_64KiB_orch_speedup") == 1
+
+        def ln(metric, v, unit):
+            return {"metric": metric, "value": v, "unit": unit,
+                    "vs_baseline": None, "tier_label": "loopback-cpu"}
+
+        hist = [_round_file(
+            tmp_path / f"BENCH_r{k:02d}.json",
+            [ln("steady_native_orch_allreduce_256KiB",
+                3.1e-5 + k * 1e-6, "s"),
+             ln("compiled_native_allreduce_256KiB_orch_speedup",
+                2.6 + 0.02 * k, "x_orchestration")])
+            for k in range(4)]
+        bad = _round_file(
+            tmp_path / "cand.json",
+            [ln("steady_native_orch_allreduce_256KiB", 1.5e-4, "s"),
+             ln("compiled_native_allreduce_256KiB_orch_speedup", 0.9,
+                "x_orchestration")])
+        verdict = gate.evaluate(
+            [gate.parse_round_file(p) for p in hist],
+            gate.parse_round_file(bad))
+        regressed = {r["metric"] for r in verdict["regressions"]}
+        assert regressed == {
+            "steady_native_orch_allreduce_256KiB",
+            "compiled_native_allreduce_256KiB_orch_speedup"}
+        ok = _round_file(
+            tmp_path / "ok.json",
+            [ln("steady_native_orch_allreduce_256KiB", 3.2e-5, "s"),
+             ln("compiled_native_allreduce_256KiB_orch_speedup",
+                2.63, "x_orchestration")])
+        assert gate.main(hist + ["--candidate", str(ok)]) == 0
+
     def test_rma_steady_metric_directions(self, tmp_path):
         """The rma_steady suite's lines (frozen RMA access plans,
         osc/plan): steady_rma_* / steady_shmem_* seconds are

@@ -1,0 +1,522 @@
+"""Native plan executor: ``coll/native_exec.py`` + ``native/planexec.cc``.
+
+Four layers:
+
+1. DEVICE-FREE units — the descriptor blob round-trips through the C
+   parser (``build_blob`` -> ``PlanExec``), the byte-provenance
+   matcher (``_match_payload``) decomposes payloads over an arena and
+   refuses every ambiguous case loudly, and ``try_compile`` withdraws
+   gracefully (returns None, touches nothing) when the cvar is off or
+   the .so lacks the symbols.
+2. SATELLITE units — ``PlannedXchg.exchange``'s per-fire fast path
+   never calls ``np.asarray`` for inputs that already are ndarrays
+   (monkeypatch-counted), and the striper's frame-count discipline
+   gates bursts at their real cost while dropping drained streams
+   without buying window for them.
+3. REAL 3-process jobs — the executor engages on a recursive-doubling
+   allreduce (``plan_native_fires`` advances, zero fallback copies,
+   bitwise-stable results), and a mixed fleet (one rank opted out via
+   the ``coll_plan_native`` cvar) interoperates frame-for-frame: the
+   wire bytes are the contract, so results stay bitwise identical.
+4. FAULT TOLERANCE — a SIGKILL mid-plan-fire surfaces as the typed
+   ERR_PROC_FAILED naming the dead process within the detection
+   interval (the C slice loop re-checks the FT epoch between 100 ms
+   slices; it never turns into an untyped 30 s timeout).
+"""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from ompi_release_tpu.coll import native_exec as nx
+from ompi_release_tpu.coll import plan as cplan
+from ompi_release_tpu.mca import var as mca_var
+from ompi_release_tpu.runtime.state import JobState
+from ompi_release_tpu.runtime.wire import WireRouter
+from ompi_release_tpu.tools.tpurun import Job
+from ompi_release_tpu.utils.errors import ErrorCode, MPIError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_native = pytest.mark.skipif(
+    not nx.available(), reason="planexec symbols not in the loaded .so")
+
+
+# ---------------------------------------------------------------------------
+# 1. descriptor blob round-trip (device-free)
+# ---------------------------------------------------------------------------
+
+class TestBlob:
+    @needs_native
+    def test_round_trip_through_c_parser(self):
+        """A hand-built two-round descriptor table parses: counts and
+        the 8-aligned pool layout come back through the handle."""
+        from ompi_release_tpu.native.bindings import PlanExec
+
+        rounds = [
+            {"depth": 2,
+             "streams": [(0, [(b"PRE0", b"MID0", 64, 0, 64,
+                               ((0, 0, 0, 64),))])],
+             "rsrcs": [(1, [(0, 24, 0, 24, b"PRE1", b"MID1")])]},
+            {"depth": 2,
+             "streams": [(1, [(b"PRE2", b"MID2", 24, 0, 24,
+                               ((1, 0, 0, 24),))])],
+             "rsrcs": [(0, [(1, 64, 0, 64, b"PRE3", b"MID3")])]},
+        ]
+        blob = nx.build_blob(7, [64], [24, 64], [3, 5], rounds)
+        px = PlanExec(blob)
+        try:
+            assert px.round_count == 2
+            assert px.input_count == 1
+            assert px.pool_count == 2
+            # 24 is already 8-aligned, so the layout is 0 / 24 / 88
+            assert px.pool_total == 88
+        finally:
+            px.close()
+
+    @needs_native
+    def test_garbage_blob_is_rejected(self):
+        from ompi_release_tpu.native.bindings import PlanExec
+
+        with pytest.raises(Exception):
+            PlanExec(b"not a descriptor table at all")
+
+    def test_align8(self):
+        assert [nx._align8(v) for v in (0, 1, 7, 8, 9)] == \
+            [0, 8, 8, 8, 16]
+
+
+# ---------------------------------------------------------------------------
+# 1b. byte-provenance matcher (device-free)
+# ---------------------------------------------------------------------------
+
+def _arena_of(*regions):
+    """Build an arena the way the prober does: random separators
+    around every region; returns (arena, a_arr, bounds)."""
+    rng = np.random.default_rng(0xBEEF)
+    arrs = [np.frombuffer(r, dtype=np.uint8) for r in regions]
+    arena, bounds = nx._build_arena(
+        rng, arrs, [])  # all regions as "inputs"
+    return arena, np.frombuffer(arena, dtype=np.uint8), bounds
+
+
+class TestMatchPayload:
+    def test_whole_region_and_slice(self):
+        rng = np.random.default_rng(1)
+        r0 = rng.bytes(64)
+        arena, a_arr, bounds = _arena_of(r0)
+        segs = nx._match_payload(r0, arena, a_arr, bounds)
+        assert segs == ((0, 0, 0, 64),)
+        segs = nx._match_payload(r0[16:48], arena, a_arr, bounds)
+        assert segs == ((0, 0, 16, 32),)
+
+    def test_concatenation_across_regions(self):
+        """A payload stitched from two source regions decomposes into
+        two segs — the scatter-gather form the C executor emits."""
+        rng = np.random.default_rng(2)
+        r0, r1 = rng.bytes(64), rng.bytes(64)
+        arena, a_arr, bounds = _arena_of(r0, r1)
+        segs = nx._match_payload(r0[:32] + r1[32:], arena, a_arr,
+                                 bounds)
+        assert segs == ((0, 0, 0, 32), (0, 1, 32, 32))
+
+    def test_adjacent_spans_merge(self):
+        rng = np.random.default_rng(3)
+        r0 = rng.bytes(256)
+        arena, a_arr, bounds = _arena_of(r0)
+        # one contiguous source span must come back as ONE seg even
+        # though matching proceeds window by window
+        segs = nx._match_payload(r0, arena, a_arr, bounds)
+        assert len(segs) == 1
+
+    def test_duplicate_regions_resolve_deterministically(self):
+        """Bytes appearing in two regions (a round-0 send aliasing an
+        argument) resolve to a FIXED pick — longest span, then lowest
+        arena offset — so both probe seeds infer the same map and the
+        cross-probe equality proof stays meaningful."""
+        rng = np.random.default_rng(4)
+        dup = rng.bytes(32)
+        arena, a_arr, bounds = _arena_of(dup, dup)
+        assert nx._match_payload(dup, arena, a_arr, bounds) == \
+            ((0, 0, 0, 32),)
+        # the longer candidate wins even when it sits later
+        rng = np.random.default_rng(7)
+        tail = rng.bytes(32)
+        arena, a_arr, bounds = _arena_of(dup, dup + tail)
+        assert nx._match_payload(dup + tail, arena, a_arr, bounds) \
+            == ((0, 1, 0, 64),)
+
+    def test_foreign_bytes_fail(self):
+        rng = np.random.default_rng(5)
+        arena, a_arr, bounds = _arena_of(rng.bytes(64))
+        with pytest.raises(nx._ProbeFail):
+            nx._match_payload(rng.bytes(32), arena, a_arr, bounds)
+
+    def test_tiny_payload_fails(self):
+        rng = np.random.default_rng(6)
+        r0 = rng.bytes(64)
+        arena, a_arr, bounds = _arena_of(r0)
+        with pytest.raises(nx._ProbeFail):
+            nx._match_payload(r0[:8], arena, a_arr, bounds)
+
+
+# ---------------------------------------------------------------------------
+# 1c. graceful withdrawal
+# ---------------------------------------------------------------------------
+
+class _Plan:
+    def __init__(self):
+        rnd = cplan.WireRound(((1, (((4,), "int32"),)),), ((1, 1),),
+                              ((1, (None,)),), 9, 2)
+        self.rounds = [rnd]
+        self.gen = 0
+        self.cid = 1
+        self.timeout_ms = 1000
+
+
+class _State:
+    def __init__(self):
+        self.plan = _Plan()
+
+
+class TestWithdrawal:
+    def test_cvar_off_withdraws(self):
+        old = mca_var.get("coll_plan_native", True)
+        mca_var.set_value("coll_plan_native", 0)
+        try:
+            # m is never touched once the cvar says no
+            assert nx.try_compile(_State(), object(), None, (), {}) \
+                is None
+        finally:
+            mca_var.set_value("coll_plan_native", old)
+
+    def test_missing_symbols_withdraw(self, monkeypatch):
+        monkeypatch.setattr(nx, "available", lambda: False)
+        assert nx.try_compile(_State(), object(), None, (), {}) is None
+
+    def test_inline_sentinel_withdraws(self):
+        # obs_sentinel=2 interleaves ctl frames with the planned
+        # rounds — the C reap would stash them mid-fire, so the
+        # executor must leave inline-checked comms to PlannedXchg
+        # (the gate once read a nonexistent cvar name and engaged
+        # anyway, derailing the sentinel's posting seq)
+        old = mca_var.get("obs_sentinel", 0)
+        mca_var.set_value("obs_sentinel", 2)
+        try:
+            assert nx.try_compile(_State(), object(), None, (), {}) \
+                is None
+        finally:
+            mca_var.set_value("obs_sentinel", old)
+
+    def test_try_compile_never_raises(self):
+        # a state with no plan, then one whose module explodes on
+        # attribute access: both are selection outcomes, not errors
+        class _NoPlan:
+            plan = None
+
+        assert nx.try_compile(_NoPlan(), object(), None, (), {}) is None
+
+        class _Hostile:
+            def __getattr__(self, k):
+                raise RuntimeError("boom")
+
+        assert nx.try_compile(_State(), _Hostile(), None, (), {}) \
+            is None
+
+
+# ---------------------------------------------------------------------------
+# 2a. satellite: PlannedXchg per-fire asarray skip
+# ---------------------------------------------------------------------------
+
+class _FakeModule:
+    """Minimal stand-in honoring the slice of the hier-module contract
+    PlannedXchg uses: planned sends and arrival-order reaping."""
+
+    def __init__(self, arrivals):
+        self.arrivals = arrivals
+        self.sent = []
+
+        class _C:
+            name = "fake_comm"
+
+        self.comm = _C()
+
+    def _send_all_planned(self, rnd, sends):
+        self.sent.append((rnd, sends))
+
+    def _reap(self, recvs, cb, timeout_ms, record=True):
+        for src, cnt in sorted(recvs.items()):
+            for k in range(cnt):
+                cb(src, self.arrivals[src][k])
+
+
+def _one_round_plan(peer=1, src=2, shape=(8,), dtype="int32"):
+    rnd = cplan.WireRound(
+        ((peer, ((shape, dtype),)),), ((src, 1),),
+        ((peer, (None,)),), 11, 2)
+    return cplan.WirePlan(0, 1, [rnd], 1000)
+
+
+class TestAsarraySkip:
+    def test_as_nd_is_identity_for_ndarrays(self, monkeypatch):
+        calls = []
+        real = np.asarray
+        monkeypatch.setattr(
+            cplan, "_np_asarray",
+            lambda a, *k, **kw: calls.append(1) or real(a, *k, **kw))
+        a = np.arange(4, dtype=np.int32)
+        assert cplan._as_nd(a) is a
+        assert not calls
+        assert cplan._as_nd([1, 2]).tolist() == [1, 2]
+        assert len(calls) == 1
+
+    def test_round_meta_skips_converted_inputs(self, monkeypatch):
+        calls = []
+        real = np.asarray
+        monkeypatch.setattr(
+            cplan, "_np_asarray",
+            lambda a, *k, **kw: calls.append(1) or real(a, *k, **kw))
+        a = np.arange(8, dtype=np.int32)
+        meta = cplan._round_meta({1: [a, a]})
+        assert meta == ((1, (((8,), "int32"), ((8,), "int32"))),)
+        assert not calls
+
+    def test_planned_exchange_zero_asarray_for_ndarrays(
+            self, monkeypatch):
+        """The per-fire fast path: ndarray sends ride straight into
+        the comparison tuple — zero conversions per exchange."""
+        arr = np.arange(8, dtype=np.int32)
+        m = _FakeModule({2: [np.ones(3, np.int32)]})
+        px = cplan.PlannedXchg(m, _one_round_plan())
+        calls = []
+        real = np.asarray
+        monkeypatch.setattr(
+            cplan, "_np_asarray",
+            lambda a, *k, **kw: calls.append(1) or real(a, *k, **kw))
+        got = px.exchange({1: [arr]}, {2: 1})
+        assert not calls
+        assert got[2][0].tolist() == [1, 1, 1]
+        # the planned send saw the SAME array object — no copy
+        assert m.sent[0][1][1][0] is arr
+
+    def test_planned_exchange_divergence_is_typed(self):
+        m = _FakeModule({2: [np.ones(3, np.int32)]})
+        px = cplan.PlannedXchg(m, _one_round_plan())
+        with pytest.raises(MPIError) as ei:
+            px.exchange({1: [np.zeros((9, 9), np.float64)]}, {2: 1})
+        assert ei.value.code == ErrorCode.ERR_INTERN
+        assert "diverged" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# 2b. satellite: frame-count-exact stripe gating
+# ---------------------------------------------------------------------------
+
+class _Arb:
+    def __init__(self):
+        self.events = []
+
+    def enter(self, cls):
+        self.events.append(("enter", cls))
+
+    def gate(self, cls, cost=1):
+        self.events.append(("gate", cls, cost))
+
+    def leave(self, cls):
+        self.events.append(("leave", cls))
+
+
+def _gen(log, label, n):
+    for k in range(n):
+        log.append((label, k))
+        yield
+
+
+class TestStripeCounts:
+    def test_partial_tail_gates_at_real_cost(self):
+        """counts=(5, 2), depth=3: stream B's single burst costs 2,
+        stream A's tail burst costs 2 — never the full depth."""
+        log, arb = [], _Arb()
+        WireRouter._stripe([_gen(log, "a", 5), _gen(log, "b", 2)], 3,
+                           arbiter=arb, cls="bulk", counts=(5, 2))
+        gates = [e for e in arb.events if e[0] == "gate"]
+        assert gates == [("gate", "bulk", 3), ("gate", "bulk", 2),
+                         ("gate", "bulk", 2)]
+        assert len([e for e in log if e[0] == "a"]) == 5
+        assert len([e for e in log if e[0] == "b"]) == 2
+        assert arb.events[0] == ("enter", "bulk")
+        assert arb.events[-1] == ("leave", "bulk")
+
+    def test_drained_stream_is_dropped_without_gating(self):
+        """A zero-count stream must not pass the gate NOR be pulled:
+        window bought for frames that never exist starves the other
+        classes for nothing."""
+        log, arb = [], _Arb()
+        WireRouter._stripe([_gen(log, "a", 4), _gen(log, "dead", 9)],
+                           2, arbiter=arb, cls="lat", counts=(4, 0))
+        gates = [e for e in arb.events if e[0] == "gate"]
+        assert gates == [("gate", "lat", 2), ("gate", "lat", 2)]
+        assert not [e for e in log if e[0] == "dead"]
+
+    def test_legacy_no_counts_gates_full_depth(self):
+        """Without counts (interpreted path) behavior is unchanged:
+        every live stream's burst is gated at the full depth."""
+        log, arb = [], _Arb()
+        WireRouter._stripe([_gen(log, "a", 4)], 3,
+                           arbiter=arb, cls="c", counts=None)
+        gates = [e for e in arb.events if e[0] == "gate"]
+        assert gates == [("gate", "c", 3), ("gate", "c", 3)]
+        assert len(log) == 4
+
+    def test_no_arbiter_counts_still_bound_pulls(self):
+        log = []
+        g = _gen(log, "a", 9)
+        WireRouter._stripe([g], 4, counts=(6,))
+        # exactly the counted frames were pulled, none past the plan
+        assert len(log) == 6
+
+
+# ---------------------------------------------------------------------------
+# 3 + 4. real 3-process jobs
+# ---------------------------------------------------------------------------
+
+APP_PRELUDE = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, %r)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_release_tpu as mpi
+    from ompi_release_tpu.mca import pvar, var as mca_var
+    from ompi_release_tpu.runtime.runtime import Runtime
+
+    def _pv(name):
+        p = pvar.PVARS.lookup(name)
+        return float(p.read()) if p is not None else 0.0
+
+    world = mpi.init()
+    rt = Runtime.current()
+    me = rt.bootstrap["process_index"]
+    off = rt.local_rank_offset
+    n = world.size
+    mca_var.set_value("hier_inter_algorithm", "recursive_doubling")
+""" % REPO)
+
+
+def _run_job(tmp_path, capfd, body, n=3, timeout=240, job_kw=None):
+    app = tmp_path / "app.py"
+    app.write_text(APP_PRELUDE + textwrap.dedent(body))
+    job = Job(n, [sys.executable, str(app)], [],
+              heartbeat_s=0.5, miss_limit=8, **(job_kw or {}))
+    rc = job.run(timeout_s=timeout)
+    out = capfd.readouterr()
+    return rc, out.out + out.err, job
+
+
+class TestNativeJobs:
+    def test_native_engages_bitwise_stable(self, tmp_path, capfd):
+        """Recursive-doubling allreduce on 3 processes: the plan
+        freezes on fire 1, compiles natively, and every later fire
+        runs the whole frozen schedule C-side — fires counted, zero
+        per-fire fallbacks, zero contiguous-path copies, and the
+        results bitwise-identical to the recorded (interpreted)
+        fire."""
+        rc, out, _ = _run_job(tmp_path, capfd, """
+            x = np.stack([np.arange(256, dtype=np.int32)
+                          * (off + i + 1) for i in range(2)])
+            want = sum(np.arange(256, dtype=np.int32) * (r + 1)
+                       for r in range(n))
+            first = None
+            for it in range(5):
+                got = np.asarray(world.allreduce(x))
+                np.testing.assert_array_equal(got[0], want)
+                if first is None:
+                    first = got.copy()
+                np.testing.assert_array_equal(got, first)  # BITWISE
+            fires = _pv("plan_native_fires")
+            assert fires >= 3, fires
+            assert _pv("plan_native_fallbacks") == 0
+            assert _pv("plan_pool_hits") >= fires
+            assert _pv("plan_pool_bytes") > 0
+            assert _pv("wire_native_fallback_copies") == 0
+            world.barrier()
+            print(f"NATIVE-OK {me} fires={fires}", flush=True)
+            mpi.finalize()
+        """)
+        assert rc == 0, out
+        for me in range(3):
+            assert f"NATIVE-OK {me} " in out
+
+    def test_mixed_fleet_bitwise_parity(self, tmp_path, capfd):
+        """One rank opts out (cvar off — same wire position as a rank
+        whose .so lacks the symbols): its fires stay interpreted,
+        the others go native, and because the wire bytes are the
+        contract the results are STILL bitwise identical on every
+        rank."""
+        rc, out, _ = _run_job(tmp_path, capfd, """
+            if me == 2:
+                mca_var.set_value("coll_plan_native", 0)
+            x = np.stack([np.arange(128, dtype=np.int32)
+                          * (off + i + 1) for i in range(2)])
+            want = sum(np.arange(128, dtype=np.int32) * (r + 1)
+                       for r in range(n))
+            for it in range(4):
+                got = np.asarray(world.allreduce(x))
+                np.testing.assert_array_equal(got[0], want)  # BITWISE
+            fires = _pv("plan_native_fires")
+            if me == 2:
+                assert fires == 0, fires
+            else:
+                assert fires >= 2, fires
+            world.barrier()
+            print(f"MIXED-OK {me} fires={fires}", flush=True)
+            mpi.finalize()
+        """)
+        assert rc == 0, out
+        for me in range(3):
+            assert f"MIXED-OK {me} " in out
+
+    def test_sigkill_mid_plan_fire_is_typed_and_fast(
+            self, tmp_path, capfd):
+        """FT contract: rank 1 dies between native fires; the
+        survivors' next fire surfaces ERR_PROC_FAILED (or the revoke
+        that follows) naming the dead process well inside the
+        detection interval — the C slice loop re-checks the FT word
+        every ~100 ms, so death never becomes a silent hang."""
+        rc, out, _ = _run_job(tmp_path, capfd, """
+            x = np.stack([np.arange(64, dtype=np.int32)
+                          * (off + i + 1) for i in range(2)])
+            for it in range(3):  # freeze + native fires
+                world.allreduce(x)
+            assert me == 2 or _pv("plan_native_fires") >= 1
+            world.barrier()
+            if me == 1:
+                time.sleep(0.5)
+                import signal
+                os.kill(os.getpid(), signal.SIGKILL)
+            t0 = time.monotonic()
+            try:
+                for it in range(50):
+                    world.allreduce(x)
+                raise AssertionError("collective with dead peer ran")
+            except mpi.MPIError as e:
+                dt = time.monotonic() - t0
+                assert e.code in (mpi.ErrorCode.ERR_PROC_FAILED,
+                                  mpi.ErrorCode.ERR_REVOKED), e
+                assert dt < 20, f"typed error took {dt:.1f}s"
+                if e.code == mpi.ErrorCode.ERR_PROC_FAILED:
+                    assert "1" in str(e)  # names the dead process
+            print(f"FT-NATIVE-OK {me}", flush=True)
+            mpi.finalize()
+        """, job_kw={"on_failure": "continue"})
+        assert rc == 0, out
+        assert "FT-NATIVE-OK 0" in out
+        assert "FT-NATIVE-OK 2" in out

@@ -53,7 +53,8 @@ CHECKED = ("ompi_release_tpu/coll/pipeline.py",
            "ompi_release_tpu/obs/nativeev.py",
            "ompi_release_tpu/btl/nativewire.py",
            "ompi_release_tpu/osc/plan.py",
-           "ompi_release_tpu/oshmem/shmem.py")
+           "ompi_release_tpu/oshmem/shmem.py",
+           "ompi_release_tpu/coll/native_exec.py")
 
 #: attribute calls that ARE emit sites when ungated
 EMIT_ATTRS = {"record", "begin", "body", "end", "arm"}
